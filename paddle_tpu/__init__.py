@@ -1,0 +1,37 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle Fluid ~1.2 (reference: /root/reference), built on
+JAX/XLA/Pallas/pjit idioms.
+
+Architecture (vs the reference, see SURVEY.md):
+  * Program/Block/Operator IR (framework/program.py) — serializable
+    program-as-data like ProgramDesc, but executed by compiling the WHOLE
+    program into one jitted XLA function (framework/executor.py), not by an
+    op-by-op interpreter.
+  * Autodiff: append_backward marks a vjp boundary; XLA differentiates
+    (framework/backward.py).  Optimizers are in-program ops (optimizer.py).
+  * Parallelism: jax.sharding.Mesh + pjit/shard_map replace
+    ParallelExecutor/NCCL/pserver (parallel/).
+  * Hot ops get Pallas TPU kernels (kernels/).
+"""
+from . import core
+from .core.place import CPUPlace, TPUPlace, CUDAPlace, default_place
+from .core import flags, profiler
+from .framework.program import (Program, Block, Variable, Parameter,
+                                program_guard, default_main_program,
+                                default_startup_program,
+                                reset_default_programs)
+from .framework import unique_name
+from .framework.executor import Executor, Scope, global_scope
+from .framework.backward import append_backward
+from .framework.layer_helper import ParamAttr
+from .framework import initializer
+from . import layers
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import io
+from . import metrics
+from . import nets
+from .parallel import ParallelExecutor, ExecutionStrategy, BuildStrategy
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from . import dtypes, enforce, flags, place, profiler  # noqa: F401

@@ -1,0 +1,61 @@
+"""Dtype plane for the framework.
+
+Capability parity with the reference's VarType dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:105) and the software
+float16 type (platform/float16.h).  On TPU the native low-precision type is
+bfloat16 (MXU-preferred), so bf16 is first-class here rather than fp16.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical names -> jnp dtypes.  These are the dtypes kernels may be
+# registered for; mirrors VarType.Type minus the LoD/reader plumbing types.
+_DTYPE_MAP = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+_CANONICAL = {np.dtype(v).name: k for k, v in _DTYPE_MAP.items()}
+_CANONICAL["bfloat16"] = "bfloat16"
+
+
+def convert_dtype(dtype) -> str:
+    """Normalise any dtype spelling (str, np.dtype, jnp dtype) to a canonical
+    framework name such as ``'float32'``."""
+    if isinstance(dtype, str):
+        if dtype in _DTYPE_MAP:
+            return dtype
+        # numpy-style spellings
+        name = np.dtype(dtype).name if dtype != "bfloat16" else "bfloat16"
+        if name in _CANONICAL:
+            return _CANONICAL[name]
+        raise ValueError(f"Unsupported dtype: {dtype!r}")
+    if dtype in (jnp.bfloat16,) or getattr(dtype, "name", "") == "bfloat16":
+        return "bfloat16"
+    name = np.dtype(dtype).name
+    if name not in _CANONICAL:
+        raise ValueError(f"Unsupported dtype: {dtype!r}")
+    return _CANONICAL[name]
+
+
+def to_jnp_dtype(dtype):
+    """Framework/any dtype -> jnp dtype object."""
+    return _DTYPE_MAP[convert_dtype(dtype)]
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

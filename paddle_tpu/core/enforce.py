@@ -1,0 +1,36 @@
+"""Error-reporting plane.
+
+Equivalent capability to the reference's PADDLE_ENFORCE macro family
+(/root/reference/paddle/fluid/platform/enforce.h): rich errors carrying the
+failing condition and user message.  Python exceptions already carry stack
+traces, so this is a thin layer providing uniform error types.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Raised when an internal framework invariant is violated."""
+
+
+class InvalidArgumentError(ValueError):
+    """Raised when user-provided arguments are invalid (shape/dtype/attr)."""
+
+
+def enforce(cond, msg: str = "", *args):
+    if not cond:
+        raise EnforceNotMet(msg % args if args else msg)
+
+
+def enforce_eq(a, b, msg: str = ""):
+    if a != b:
+        raise EnforceNotMet(f"Expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_gt(a, b, msg: str = ""):
+    if not a > b:
+        raise EnforceNotMet(f"Expected {a!r} > {b!r}. {msg}")
+
+
+def check_arg(cond, msg: str = ""):
+    if not cond:
+        raise InvalidArgumentError(msg)

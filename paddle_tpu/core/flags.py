@@ -1,0 +1,59 @@
+"""Process-wide config plane.
+
+Equivalent capability to the reference's gflags plane (96 DEFINE_* flags across
+fluid; whitelisted env exposure at python/paddle/fluid/__init__.py:95-152).
+Flags are declared with defaults, overridable via ``PTPU_<NAME>`` environment
+variables at import, and mutable at runtime via set_flag/get_flag.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = ""):
+    env = os.environ.get("PTPU_" + name.upper())
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _FLAGS[name] = value
+
+
+def get_flag(name: str) -> Any:
+    return _FLAGS[name]
+
+
+def set_flag(name: str, value: Any):
+    if name not in _FLAGS:
+        raise KeyError(f"Unknown flag {name!r}")
+    _FLAGS[name] = value
+
+
+def all_flags() -> Dict[str, Any]:
+    return dict(_FLAGS)
+
+
+# --- Flag registry (mirrors the reference's whitelisted knobs where they ---
+# --- still make sense on TPU)                                            ---
+define_flag("check_nan_inf", False,
+            "Scan every fetched value for NaN/Inf (ref FLAGS_check_nan_inf).")
+define_flag("deterministic", False,
+            "Force deterministic reductions/samplers "
+            "(ref FLAGS_cpu_deterministic/cudnn_deterministic).")
+define_flag("use_pallas_kernels", True,
+            "Use hand-written Pallas TPU kernels for hot ops when available.")
+define_flag("default_dtype", "float32", "Default parameter dtype.")
+define_flag("matmul_precision", "default",
+            "jax matmul precision: default|high|highest.")
+define_flag("executor_log_compiles", False,
+            "Log every program (re)compilation in the executor.")
+define_flag("rng_seed", 0, "Global RNG seed used when a program has no seed.")

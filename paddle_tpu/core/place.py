@@ -1,0 +1,107 @@
+"""Places and device meshes.
+
+Capability parity with the reference's Place variant
+(/root/reference/paddle/fluid/platform/place.h: CPUPlace / CUDAPlace /
+CUDAPinnedPlace) and DeviceContextPool (platform/device_context.h:319).
+
+TPU-first design: a Place resolves to one jax.Device for single-device
+execution, and MeshPlace wraps a jax.sharding.Mesh for SPMD execution — the
+reference's ParallelExecutor places-list becomes a named mesh.  There is no
+per-device stream/handle bundle to manage; XLA owns scheduling.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+class Place:
+    """Base device tag."""
+
+    device_kind: str = "any"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def jax_device(self) -> jax.Device:
+        devs = self._platform_devices()
+        if self.device_id >= len(devs):
+            raise ValueError(
+                f"{self!r}: only {len(devs)} device(s) of kind "
+                f"{self.device_kind!r} visible")
+        return devs[self.device_id]
+
+    def _platform_devices(self):
+        if self.device_kind == "any":
+            return jax.devices()
+        try:
+            return jax.devices(self.device_kind)
+        except RuntimeError:
+            return jax.devices()
+
+
+class CPUPlace(Place):
+    device_kind = "cpu"
+
+
+class TPUPlace(Place):
+    """The accelerator place (ref CUDAPlace -> TPU).  Falls back to the default
+    jax backend when no TPU platform is present (e.g. CPU test meshes)."""
+    device_kind = "tpu"
+
+    def _platform_devices(self):
+        for kind in ("tpu", "axon"):
+            try:
+                devs = jax.devices(kind)
+                if devs:
+                    return devs
+            except RuntimeError:
+                continue
+        return jax.devices()
+
+
+# Alias so scripts written against the reference's spelling still read well.
+CUDAPlace = TPUPlace
+
+
+def default_place() -> Place:
+    """Accelerator if present, else CPU."""
+    try:
+        d = jax.devices()[0]
+    except RuntimeError:
+        return CPUPlace(0)
+    if d.platform in ("tpu", "axon"):
+        return TPUPlace(0)
+    return CPUPlace(0)
+
+
+def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
+              devices: Optional[Sequence[jax.Device]] = None) -> jax.sharding.Mesh:
+    """Build a device mesh.  Replaces the reference's places-list +
+    NCCLContextMap (platform/nccl_helper.h:83): collectives ride ICI within a
+    mesh axis instead of NCCL rings."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {tuple(shape)} needs {n} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(shape))
+    return jax.sharding.Mesh(arr, tuple(axis_names))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None) -> jax.sharding.Mesh:
+    devs = jax.devices()
+    n = num_devices or len(devs)
+    return make_mesh((n,), ("data",), devs)

@@ -1,0 +1,104 @@
+"""Profiling / tracing plane.
+
+Capability parity with the reference's RecordEvent/RecordBlock RAII markers and
+EnableProfiler/DisableProfiler (platform/profiler.h:72,99,117,122) plus the
+CUPTI DeviceTracer -> chrome trace path (platform/device_tracer.cc:41,
+tools/timeline.py).
+
+TPU-native: host-side scoping uses jax.profiler.TraceAnnotation (shows up in
+XPlane/TensorBoard and perfetto, the chrome://tracing successor); whole-profile
+capture uses jax.profiler.start_trace/stop_trace.  A lightweight host-event
+recorder is kept for environments without the profiler plugin so
+`profiler.profiler()` always yields usable per-scope wall timings.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+
+_events: List[dict] = []
+_enabled = False
+
+
+class RecordEvent:
+    """Context manager marking a named host scope (ref profiler.h:99)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        if _enabled:
+            _events.append({
+                "name": self.name,
+                "ts": self._t0,
+                "dur": time.perf_counter() - self._t0,
+            })
+        return False
+
+
+RecordBlock = RecordEvent  # ref profiler.h:117 — same capability on host side
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def enable_profiler(trace_dir: Optional[str] = None):
+    global _enabled
+    _enabled = True
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+
+
+def disable_profiler(sorted_key: str = "total", trace_dir_used: bool = False):
+    global _enabled
+    _enabled = False
+    if trace_dir_used:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def profiler(trace_dir: Optional[str] = None, print_summary: bool = True):
+    """`with profiler.profiler(): ...` — ref python/paddle/fluid/profiler.py."""
+    enable_profiler(trace_dir)
+    try:
+        yield
+    finally:
+        disable_profiler(trace_dir_used=trace_dir is not None)
+        if print_summary:
+            print(summary())
+
+
+def summary() -> str:
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for e in _events:
+        agg[e["name"]].append(e["dur"])
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+        lines.append(f"{name:<40}{len(durs):>8}{sum(durs)*1e3:>12.3f}"
+                     f"{sum(durs)/len(durs)*1e3:>12.3f}")
+    return "\n".join(lines)
+
+
+def export_chrome_trace(path: str):
+    """Dump host events as chrome://tracing JSON (ref tools/timeline.py)."""
+    trace = {"traceEvents": [
+        {"name": e["name"], "ph": "X", "pid": 0, "tid": 0,
+         "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6}
+        for e in _events]}
+    with open(path, "w") as f:
+        json.dump(trace, f)

@@ -1,0 +1,1 @@
+from . import program, registry, executor, backward  # noqa: F401

@@ -1,0 +1,175 @@
+"""LayerHelper: shared machinery for layer functions.
+
+Capability parity with /root/reference/python/paddle/fluid/layer_helper.py:
+creates parameters (wiring their initializer into the startup program),
+creates output vars, appends ops, and applies activations / bias.
+
+TPU-first addition: output shapes/dtypes are inferred by abstract evaluation
+of the op's own lowering function (jax.eval_shape) — one source of truth
+instead of the reference's separate C++ InferShape functions
+(framework/shape_inference.h).  Dynamic (batch) dims use -1 and are restored
+after abstract eval.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core import flags
+from ..core.dtypes import to_jnp_dtype
+from .program import (Block, Parameter, Variable, default_main_program,
+                      default_startup_program)
+from . import unique_name
+from .initializer import Initializer, XavierInitializer, ConstantInitializer
+from .registry import LowerContext, get_op_def
+
+_DYN_SUBST = 97  # prime sentinel substituted for -1 dims during abstract eval
+
+
+class ParamAttr:
+    """Parameter attribute bundle (ref python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer: Optional[Initializer] = None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, sharding=None):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.sharding = sharding
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return None
+        raise ValueError(f"bad param_attr: {attr!r}")
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+        self.main_program = kwargs.get("main_program") or default_main_program()
+        self.startup_program = (kwargs.get("startup_program")
+                                or default_startup_program())
+
+    @property
+    def block(self) -> Block:
+        return self.main_program.current_block()
+
+    def name(self, suffix: str = "") -> str:
+        base = self.kwargs.get("name") or unique_name.generate(self.layer_type)
+        return f"{base}.{suffix}" if suffix else base
+
+    # -- vars/params -------------------------------------------------------
+    def create_variable_for_type_inference(self, dtype="float32",
+                                           stop_gradient=False) -> Variable:
+        return self.block.create_var(
+            name=unique_name.generate(self.layer_type + ".tmp"),
+            dtype=dtype, stop_gradient=stop_gradient)
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_parameter(self, attr, shape, dtype="float32",
+                         is_bias: bool = False,
+                         default_initializer: Optional[Initializer] = None
+                         ) -> Optional[Parameter]:
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:
+            return None
+        name = attr.name or unique_name.generate(
+            self.kwargs.get("name") or self.layer_type
+        ) + (".b_0" if is_bias else ".w_0")
+        init = attr.initializer or default_initializer or (
+            ConstantInitializer(0.0) if is_bias else XavierInitializer())
+        shape = [int(s) for s in shape]
+        # main-program parameter
+        p = self.main_program.global_block().create_parameter(
+            name, shape, dtype=dtype, trainable=attr.trainable,
+            regularizer=attr.regularizer, sharding=attr.sharding)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        # startup-program twin + init op (ref layer_helper set_variable_initializer)
+        sb = self.startup_program.global_block()
+        if not sb.has_var(name):
+            sp = sb.create_parameter(name, shape, dtype=dtype,
+                                     trainable=attr.trainable,
+                                     sharding=attr.sharding)
+            init(sp, sb)
+        return p
+
+    # -- op append with abstract-eval shape inference ----------------------
+    def append_op(self, type: str, inputs: Dict[str, Sequence[Variable]],
+                  outputs: Dict[str, Sequence[Variable]],
+                  attrs: Optional[Dict[str, Any]] = None):
+        attrs = attrs or {}
+        in_names = {k: [v.name for v in vs] for k, vs in inputs.items()}
+        out_names = {k: [v.name for v in vs] for k, vs in outputs.items()}
+        op = self.block.append_op(type, in_names, out_names, attrs)
+        self._infer_shapes(type, inputs, outputs, attrs)
+        return op
+
+    def _infer_shapes(self, type, inputs, outputs, attrs):
+        from ..core.dtypes import convert_dtype
+        opdef = get_op_def(type)
+
+        def abstract(v: Variable):
+            shape = tuple(_DYN_SUBST if s == -1 else int(s)
+                          for s in (v.shape or ()))
+            return jax.ShapeDtypeStruct(shape, to_jnp_dtype(v.dtype))
+
+        ins_abs = {k: [abstract(v) for v in vs] for k, vs in inputs.items()}
+        flat_in = [a for vs in ins_abs.values() for a in vs]
+        slots = [k for k, vs in ins_abs.items() for _ in vs]
+
+        def g(*arrs):
+            d: Dict[str, List[Any]] = {}
+            for slot, a in zip(slots, arrs):
+                d.setdefault(slot, []).append(a)
+            ctx = LowerContext(jax.random.PRNGKey(0))
+            return {k: list(v) for k, v in opdef.lower(ctx, d, attrs).items()}
+
+        try:
+            out_abs = jax.eval_shape(g, *flat_in)
+        except Exception:
+            return  # shape inference is best-effort build-time metadata
+
+        had_dyn = any(-1 in (v.shape or ())
+                      for vs in inputs.values() for v in vs)
+        for slot, vars_ in outputs.items():
+            for v, sd in zip(vars_, out_abs.get(slot, [])):
+                shape = list(sd.shape)
+                if had_dyn:
+                    # restore -1 where the sentinel survived (possibly folded
+                    # into a product by reshape/flatten — sentinel is prime)
+                    shape = [-1 if s != 0 and s % _DYN_SUBST == 0 else s
+                             for s in shape]
+                v.shape = tuple(shape)
+                v.dtype = convert_dtype(sd.dtype)
+
+    # -- activation/bias sugar (ref layer_helper.py) -----------------------
+    def append_bias_op(self, input_var: Variable, bias: Optional[Parameter],
+                       dim_start: int = 1) -> Variable:
+        if bias is None:
+            return input_var
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op("elementwise_add",
+                       {"X": [input_var], "Y": [bias]}, {"Out": [out]},
+                       {"axis": dim_start})
+        return out
+
+    def append_activation(self, input_var: Variable,
+                          act: Optional[str]) -> Variable:
+        if act is None:
+            return input_var
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(act, {"X": [input_var]}, {"Out": [out]}, {})
+        return out

@@ -1,0 +1,386 @@
+"""Program IR: the serializable graph-program representation.
+
+Capability parity with the reference's ProgramDesc/BlockDesc/OpDesc/VarDesc
+protobuf plane (/root/reference/paddle/fluid/framework/framework.proto:43,165,
+171,184) and its Python mirrors (python/paddle/fluid/framework.py: Variable:224,
+Operator:529, Block:972, Program:1477, Parameter:2071).
+
+TPU-first difference: the program is *not* interpreted op-by-op.  It is a
+build-time artifact — the Executor lowers an entire (program, feed, fetch)
+triple into ONE jitted XLA function (see framework/executor.py).  The IR exists
+for the capabilities that need program-as-data: serialization
+(save/load_inference_model), source-to-source autodiff bookkeeping, program
+transformation passes (quantization, pruning), and introspection.
+
+Nested blocks encode control flow (while/cond) exactly like the reference's
+BLOCK attributes; they lower to lax.while_loop / lax.cond.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..core.enforce import check_arg, enforce
+from . import unique_name
+
+GRAD_SUFFIX = "@GRAD"  # ref framework: core.grad_var_suffix()
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class Variable:
+    """A named tensor slot in a Block (ref framework.py:224).
+
+    shape may contain -1 for data-dependent dims (batch); persistable vars
+    live in the Scope across runs (parameters, optimizer state, BN stats).
+    """
+
+    def __init__(self, block: "Block", name: str, shape=None, dtype="float32",
+                 persistable: bool = False, stop_gradient: bool = False,
+                 is_data: bool = False, lod_level: int = 0):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        # LoD survives only as metadata at the data edge; ragged batches are
+        # represented densely (padding + masks/segment-ids) on TPU.
+        self.lod_level = lod_level
+
+    @property
+    def grad_name(self) -> str:
+        return grad_var_name(self.name)
+
+    def astype(self, dtype):
+        from ..layers import tensor as tensor_layers
+        return tensor_layers.cast(self, dtype)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype}, persistable={self.persistable})")
+
+    def to_dict(self):
+        return {
+            "name": self.name, "shape": list(self.shape or ()),
+            "dtype": self.dtype, "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient, "is_data": self.is_data,
+            "lod_level": self.lod_level,
+            "is_parameter": isinstance(self, Parameter),
+            "trainable": getattr(self, "trainable", None),
+        }
+
+
+class Parameter(Variable):
+    """A trainable persistable Variable (ref framework.py:2071)."""
+
+    def __init__(self, block, name, shape, dtype="float32", trainable=True,
+                 regularizer=None, sharding=None, **kw):
+        check_arg(shape is not None and all(int(s) > 0 for s in shape),
+                  f"Parameter {name!r} needs a fully-static shape, got {shape}")
+        super().__init__(block, name, shape=shape, dtype=dtype,
+                         persistable=True, **kw)
+        self.trainable = trainable
+        self.regularizer = regularizer
+        # PartitionSpec-style tuple for SPMD placement of this parameter
+        # (replaces pserver param-shard placement, transpiler VarBlock:65).
+        self.sharding = sharding
+
+
+class Operator:
+    """One op invocation: (type, input/output var-name slots, attrs)
+    (ref framework.py:529, framework.proto OpDesc:43)."""
+
+    def __init__(self, block: "Block", type: str,
+                 inputs: Optional[Dict[str, Sequence[str]]] = None,
+                 outputs: Optional[Dict[str, Sequence[str]]] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        from .registry import get_op_def  # late import
+        self.block = block
+        self.type = type
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+        get_op_def(type)  # validates the op exists
+
+    def input_names(self) -> List[str]:
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self) -> List[str]:
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def __repr__(self):
+        return f"Op({self.type}, in={self.inputs}, out={self.outputs})"
+
+    def to_dict(self):
+        return {"type": self.type, "inputs": self.inputs,
+                "outputs": self.outputs, "attrs": _jsonable_attrs(self.attrs)}
+
+
+def _jsonable_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        else:
+            out[k] = v
+    return out
+
+
+def _attrs_from_json(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and "__ndarray__" in v:
+            out[k] = np.array(v["__ndarray__"], dtype=v["dtype"])
+        else:
+            out[k] = v
+    return out
+
+
+class Block:
+    """Ordered op list + var map; nested via parent_idx (ref framework.py:972,
+    framework.proto BlockDesc:171)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def parent(self) -> Optional["Block"]:
+        return (self.program.blocks[self.parent_idx]
+                if self.parent_idx >= 0 else None)
+
+    def create_var(self, name=None, **kw) -> Variable:
+        name = name or unique_name.generate("tmp")
+        v = Variable(self, name, **kw)
+        self.vars[name] = v
+        self.program._bump()
+        return v
+
+    def create_parameter(self, name, shape, dtype="float32", **kw) -> Parameter:
+        p = Parameter(self, name, shape, dtype=dtype, **kw)
+        self.vars[name] = p
+        self.program._bump()
+        return p
+
+    def var(self, name: str) -> Variable:
+        """Find var here or in ancestor blocks (ref Scope parent walk)."""
+        b: Optional[Block] = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent
+        raise KeyError(f"Variable {name!r} not found in block {self.idx}")
+
+    def has_var(self, name: str) -> bool:
+        try:
+            self.var(name)
+            return True
+        except KeyError:
+            return False
+
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None,
+                  index: Optional[int] = None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        if index is None:
+            self.ops.append(op)
+        else:
+            self.ops.insert(index, op)
+        self.program._bump()
+        return op
+
+    def to_dict(self):
+        return {"idx": self.idx, "parent_idx": self.parent_idx,
+                "vars": [v.to_dict() for v in self.vars.values()],
+                "ops": [op.to_dict() for op in self.ops]}
+
+
+class Program:
+    """A whole trainable/serializable program (ref framework.py:1477)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self._current_block_idx = 0
+        self.random_seed: Optional[int] = None
+        # version bumps on any mutation -> executor cache invalidation
+        self._version = 0
+
+    # -- structure ---------------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self._current_block_idx]
+
+    def create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self._current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self._current_block_idx = b.idx
+        self._bump()
+        return b
+
+    def rollback(self):
+        self._current_block_idx = self.current_block().parent_idx
+
+    def _bump(self):
+        self._version += 1
+
+    # -- introspection -----------------------------------------------------
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.list_vars() if isinstance(v, Parameter)]
+
+    def __repr__(self):
+        lines = []
+        for b in self.blocks:
+            lines.append(f"Block {b.idx} (parent {b.parent_idx}):")
+            for op in b.ops:
+                lines.append(f"  {op!r}")
+        return "\n".join(lines)
+
+    # -- transforms --------------------------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep copy; for_test flips is_test attrs (dropout/BN switch to
+        inference behaviour) — ref framework.py Program.clone."""
+        p = Program.from_dict(self.to_dict())
+        p.random_seed = self.random_seed
+        if for_test:
+            for b in p.blocks:
+                for op in b.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+        return p
+
+    def prune(self, feed_names: Sequence[str],
+              fetch_names: Sequence[str]) -> "Program":
+        """Backward-slice the program to the ops needed to compute
+        fetch_names from feed_names (+persistables).  This is the core of
+        save_inference_model (ref python io.py:570)."""
+        src = self.global_block()
+        needed = set(fetch_names)
+        keep: List[Operator] = []
+        for op in reversed(src.ops):
+            outs = set(op.output_names())
+            if outs & needed:
+                keep.append(op)
+                needed |= set(op.input_names())
+        keep.reverse()
+
+        used = set(feed_names) | set(fetch_names)
+        for op in keep:
+            used |= set(op.input_names()) | set(op.output_names())
+        # control-flow ops pull in whole sub-blocks: keep those blocks (and
+        # the global vars their ops touch) intact
+        for b in self.blocks[1:]:
+            for op in b.ops:
+                used |= set(op.input_names()) | set(op.output_names())
+
+        # clone the full program (preserving sub-block structure), then
+        # rewrite block 0 down to the kept slice
+        p = self.clone()
+        dst = p.global_block()
+        dst.ops = []
+        dst.vars = {name: v for name, v in dst.vars.items() if name in used}
+        for v in dst.vars.values():
+            v.block = dst
+        for op in keep:
+            dst.append_op(op.type, copy.deepcopy(op.inputs),
+                          copy.deepcopy(op.outputs),
+                          copy.deepcopy(op.attrs))
+        return p
+
+    # -- serialization (ref ProgramDesc proto; JSON here) ------------------
+    def to_dict(self):
+        return {"version": 1, "random_seed": self.random_seed,
+                "blocks": [b.to_dict() for b in self.blocks]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Program":
+        p = Program()
+        p.random_seed = d.get("random_seed")
+        # recreate blocks
+        for bd in d["blocks"][1:]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            p.blocks.append(b)
+        for bd in d["blocks"]:
+            b = p.blocks[bd["idx"]]
+            for vd in bd["vars"]:
+                if vd.get("is_parameter"):
+                    b.create_parameter(vd["name"], vd["shape"], vd["dtype"],
+                                       trainable=bool(vd.get("trainable", True)))
+                else:
+                    b.create_var(vd["name"],
+                                 shape=vd["shape"] or None,
+                                 dtype=vd["dtype"],
+                                 persistable=vd["persistable"],
+                                 stop_gradient=vd["stop_gradient"],
+                                 is_data=vd["is_data"],
+                                 lod_level=vd.get("lod_level", 0))
+            for od in bd["ops"]:
+                b.append_op(od["type"], od["inputs"], od["outputs"],
+                            _attrs_from_json(od["attrs"]))
+        p._current_block_idx = 0
+        return p
+
+    def serialize_to_string(self) -> bytes:
+        return json.dumps(self.to_dict()).encode("utf-8")
+
+    @staticmethod
+    def parse_from_string(s: bytes) -> "Program":
+        return Program.from_dict(json.loads(s.decode("utf-8")))
+
+
+# --- default program plumbing (ref framework.py:2155,2173,2223) -----------
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    global _main_program, _startup_program
+    prev_main, prev_startup = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_main, prev_startup
+
+
+def reset_default_programs():
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+    unique_name.reset()

@@ -1,0 +1,97 @@
+"""Op registry: the kernel-dispatch plane.
+
+Capability parity with the reference's OpRegistry/OpInfoMap + REGISTER_OPERATOR
+/ REGISTER_OP_*_KERNEL macros (/root/reference/paddle/fluid/framework/
+op_registry.h:65,196) and OperatorWithKernel dispatch (operator.cc:764-817).
+
+TPU-first difference: an op registers ONE `lower` function that emits jax/XLA
+(or Pallas) computation for all devices — XLA owns per-backend kernel
+selection, layout, and fusion, so the reference's (place, dtype, layout,
+library) OpKernelType dispatch and implicit data-transform machinery
+(framework/data_transform.cc) are unnecessary.  Dtype promotion/casting is
+explicit in lowering code.
+
+The reference's per-op GradOpDescMaker (grad_op_desc_maker.h:34) is subsumed
+by jax.vjp over lowered forward segments (see framework/backward.py), so ops
+get exact gradients for free; ops may still override with a custom VJP (e.g.
+Pallas flash-attention).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..core.enforce import EnforceNotMet
+
+# lower(ctx, ins: {slot: [jax.Array]}, attrs) -> {slot: [jax.Array]}
+LowerFn = Callable[["LowerContext", Dict[str, List[Any]], Dict[str, Any]],
+                   Dict[str, List[Any]]]
+
+
+@dataclasses.dataclass
+class OpDef:
+    type: str
+    lower: LowerFn
+    # ops whose outputs must NOT be differentiated through even if reached
+    # (metrics, assigns of ints, etc.)
+    stop_gradient: bool = False
+    # doc string for introspection (ref OpProtoMaker comments)
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(type: str, stop_gradient: bool = False, doc: str = ""):
+    """Decorator: @register_op("relu") def _(ctx, ins, attrs): ..."""
+    def deco(fn: LowerFn):
+        if type in _REGISTRY:
+            raise EnforceNotMet(f"op {type!r} registered twice")
+        _REGISTRY[type] = OpDef(type, fn, stop_gradient=stop_gradient,
+                                doc=doc or (fn.__doc__ or ""))
+        return fn
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    if type not in _REGISTRY:
+        # ops/__init__ registers everything lazily on first touch
+        from .. import ops as _ops  # noqa: F401
+        if type not in _REGISTRY:
+            raise EnforceNotMet(f"Operator {type!r} is not registered. "
+                                f"Known: {sorted(_REGISTRY)[:20]}...")
+    return _REGISTRY[type]
+
+
+def registered_ops() -> List[str]:
+    from .. import ops as _ops  # noqa: F401
+    return sorted(_REGISTRY)
+
+
+class LowerContext:
+    """Per-trace lowering context handed to every op's lower().
+
+    Carries what the reference's ExecutionContext (operator.h:166) carried —
+    minus scope/stream, plus functional RNG: ops draw keys via ctx.rng(),
+    derived deterministically from the program seed and an op counter.
+    """
+
+    def __init__(self, root_key, is_test: bool = False, mesh=None):
+        self._root_key = root_key
+        self._counter = 0
+        self.is_test = is_test
+        self.mesh = mesh
+
+    def rng(self):
+        self._counter += 1
+        return jax.random.fold_in(self._root_key, self._counter)
+
+
+def single_input(ins: Dict[str, List[Any]], slot: str = "X"):
+    vs = ins.get(slot, [])
+    if len(vs) != 1:
+        raise EnforceNotMet(f"expected exactly one input in slot {slot!r}, "
+                            f"got {len(vs)}")
+    return vs[0]

@@ -1,0 +1,28 @@
+"""Unique name generator (ref python/paddle/fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+_counters = defaultdict(int)
+
+
+def generate(key: str) -> str:
+    _counters[key] += 1
+    return f"{key}_{_counters[key] - 1}"
+
+
+def reset():
+    _counters.clear()
+
+
+@contextlib.contextmanager
+def guard():
+    """Fresh namespace scope (used by tests to get deterministic names)."""
+    global _counters
+    saved = _counters
+    _counters = defaultdict(int)
+    try:
+        yield
+    finally:
+        _counters = saved

@@ -1,0 +1,141 @@
+"""Save/load of variables and inference models.
+
+Capability parity with /root/reference/python/paddle/fluid/io.py
+(save_vars:89, save_persistables:270, load_vars:313, load_persistables:490,
+save_inference_model:570, load_inference_model:704) and the save/load ops
+(operators/save_op.cc, load_op.cc, save_combine_op.cc).
+
+Format: one .npz per save (combine-style) + program JSON.  Orbax-style
+sharded checkpointing for the distributed path lives in
+paddle_tpu/incubate/checkpoint.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.enforce import check_arg
+from .framework.executor import Executor, Scope, global_scope
+from .framework.program import Program, Variable, default_main_program
+
+MODEL_FILENAME = "__model__"
+PARAMS_FILENAME = "__params__.npz"
+
+
+def _to_numpy(v):
+    arr = np.asarray(v)
+    if arr.dtype.name == "bfloat16":
+        # npz has no bf16; store as f32 with a marker handled in load
+        return arr.astype(np.float32), "bfloat16"
+    return arr, arr.dtype.name
+
+
+def save_vars(executor: Executor, dirname: str, var_names: Sequence[str],
+              scope: Optional[Scope] = None,
+              filename: str = PARAMS_FILENAME):
+    scope = scope or executor.scope
+    os.makedirs(dirname, exist_ok=True)
+    arrays, dtypes = {}, {}
+    for name in var_names:
+        val = scope.find_var(name)
+        check_arg(val is not None, f"var {name!r} not found in scope")
+        arr, dt = _to_numpy(val)
+        arrays[name] = arr
+        dtypes[name] = dt
+    # write through a file object so np.savez cannot append '.npz' to a
+    # custom filename and break the load path
+    with open(os.path.join(dirname, filename), "wb") as f:
+        np.savez(f, **arrays)
+    with open(os.path.join(dirname, filename + ".dtypes"), "w") as f:
+        json.dump(dtypes, f)
+
+
+def save_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None,
+                      filename: str = PARAMS_FILENAME):
+    program = main_program or default_main_program()
+    names = [v.name for v in program.list_vars() if v.persistable]
+    names = [n for n in names if executor.scope.find_var(n) is not None]
+    save_vars(executor, dirname, names, filename=filename)
+
+
+def save_params(executor, dirname, main_program=None,
+                filename=PARAMS_FILENAME):
+    program = main_program or default_main_program()
+    names = [p.name for p in program.all_parameters()]
+    save_vars(executor, dirname, names, filename=filename)
+
+
+def load_vars(executor: Executor, dirname: str,
+              var_names: Optional[Sequence[str]] = None,
+              scope: Optional[Scope] = None,
+              filename: str = PARAMS_FILENAME):
+    import jax
+    scope = scope or executor.scope
+    path = os.path.join(dirname, filename)
+    data = np.load(path)
+    dtypes = {}
+    dt_path = path + ".dtypes"
+    if os.path.exists(dt_path):
+        with open(dt_path) as f:
+            dtypes = json.load(f)
+    device = executor.place.jax_device()
+    names = var_names if var_names is not None else list(data.files)
+    for name in names:
+        check_arg(name in data.files, f"{name!r} missing in checkpoint")
+        arr = data[name]
+        if dtypes.get(name) == "bfloat16":
+            import jax.numpy as jnp
+            arr = arr.astype(jnp.bfloat16)
+        scope.set_var(name, jax.device_put(arr, device))
+
+
+def load_persistables(executor, dirname, main_program=None,
+                      filename=PARAMS_FILENAME):
+    program = main_program or default_main_program()
+    names = [v.name for v in program.list_vars() if v.persistable]
+    load_vars(executor, dirname, names, filename=filename)
+
+
+load_params = load_persistables
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable],
+                         executor: Executor,
+                         main_program: Optional[Program] = None,
+                         model_filename: str = MODEL_FILENAME,
+                         params_filename: str = PARAMS_FILENAME):
+    """Prune program to the inference slice + save params
+    (ref io.py:570)."""
+    program = main_program or default_main_program()
+    program = program.clone(for_test=True)
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in target_vars]
+    pruned = program.prune(feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"program": pruned.to_dict(),
+            "feed_names": list(feeded_var_names),
+            "fetch_names": fetch_names}
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(meta, f)
+    persist = [v.name for v in pruned.list_vars() if v.persistable]
+    persist = [n for n in persist if executor.scope.find_var(n) is not None]
+    save_vars(executor, dirname, persist, filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname: str, executor: Executor,
+                         model_filename: str = MODEL_FILENAME,
+                         params_filename: str = PARAMS_FILENAME):
+    """ref io.py:704 — returns (program, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, model_filename)) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    names = [v.name for v in program.list_vars() if v.persistable]
+    if names:
+        load_vars(executor, dirname, names, filename=params_filename)
+    return program, meta["feed_names"], meta["fetch_names"]

@@ -1,0 +1,11 @@
+"""Layers package (ref python/paddle/fluid/layers/)."""
+from . import nn
+from . import tensor
+from .nn import *  # noqa: F401,F403
+from .tensor import (create_tensor, fill_constant,  # noqa: F401
+                     fill_constant_batch_size_like, cast, concat, sums,
+                     assign, argmin, argmax, argsort, ones, zeros,
+                     ones_like, zeros_like, reverse, linspace, eye, diag)
+from .math_op_patch import monkey_patch_variable
+
+monkey_patch_variable()

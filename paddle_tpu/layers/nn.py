@@ -1,0 +1,754 @@
+"""NN layer functions — the user-facing model-building API.
+
+Capability parity with /root/reference/python/paddle/fluid/layers/nn.py
+(157 layer fns; fc:186, embedding:295, conv2d:1736, batch_norm:2705, ...).
+Each function creates params via LayerHelper (initializers go to the startup
+program) and appends ops to the main program.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper, ParamAttr
+from ..framework.initializer import ConstantInitializer, NormalInitializer
+from ..framework.program import Variable, default_main_program
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         append_batch_size: bool = True, lod_level: int = 0) -> Variable:
+    """Input placeholder (ref layers/io.py data)."""
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = helper.block.create_var(name=name, shape=shape, dtype=dtype,
+                                  is_data=True, stop_gradient=True,
+                                  lod_level=lod_level)
+    return var
+
+
+def fc(input: Union[Variable, List[Variable]], size: int, num_flatten_dims=1,
+       param_attr=None, bias_attr=None, act=None, name=None) -> Variable:
+    """Fully-connected (ref layers/nn.py:186): out = act(sum_i(X_i W_i) + b)."""
+    helper = LayerHelper("fc", name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for i, x in enumerate(inputs):
+        in_features = int(np.prod([d for d in x.shape[num_flatten_dims:]]))
+        w = helper.create_parameter(
+            param_attr if not isinstance(param_attr, (list, tuple))
+            else param_attr[i],
+            shape=[in_features, size], dtype=x.dtype)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op("mul", {"X": [x], "Y": [w]}, {"Out": [out]},
+                         {"x_num_col_dims": num_flatten_dims,
+                          "y_num_col_dims": 1})
+        mul_results.append(out)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(inputs[0].dtype)
+        helper.append_op("sum", {"X": mul_results}, {"Out": [pre_bias]}, {})
+    bias = helper.create_parameter(bias_attr, shape=[size],
+                                   dtype=pre_bias.dtype, is_bias=True)
+    pre_act = helper.append_bias_op(pre_bias, bias,
+                                    dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act, act)
+
+
+def embedding(input: Variable, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None) -> Variable:
+    """ref layers/nn.py:295.  is_sparse is accepted for API parity; sparse
+    grads are an XLA scatter-add, no SelectedRows needed."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(
+        param_attr, shape=list(size), dtype=dtype,
+        default_initializer=NormalInitializer(0.0, 1.0 / np.sqrt(size[1])))
+    out = helper.create_variable_for_type_inference(dtype)
+    if padding_idx is None:
+        pad_attr = -1  # kNoPadding sentinel (ref lookup_table_op.h)
+    else:
+        # ref layers/nn.py embedding: negative idx counts from vocab end
+        pad_attr = int(padding_idx) if padding_idx >= 0 else (
+            int(size[0]) + int(padding_idx))
+    helper.append_op("lookup_table", {"W": [w], "Ids": [input]},
+                     {"Out": [out]}, {"padding_idx": pad_attr})
+    return out
+
+
+def conv2d(input: Variable, num_filters: int, filter_size, stride=1,
+           padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None) -> Variable:
+    """ref layers/nn.py:1736 (NCHW, OIHW weights)."""
+    helper = LayerHelper("conv2d", name=name)
+    c_in = int(input.shape[1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (
+        filter_size, filter_size)
+    w_shape = [num_filters, c_in // groups, fs[0], fs[1]]
+    fan_in = (c_in // groups) * fs[0] * fs[1]
+    w = helper.create_parameter(
+        param_attr, shape=w_shape, dtype=input.dtype,
+        default_initializer=NormalInitializer(0.0, np.sqrt(2.0 / fan_in)))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv2d", {"Input": [input], "Filter": [w]},
+                     {"Output": [out]},
+                     {"strides": _pair(stride), "paddings": _pair(padding),
+                      "dilations": _pair(dilation), "groups": groups})
+    bias = helper.create_parameter(bias_attr, shape=[num_filters],
+                                   dtype=input.dtype, is_bias=True)
+    pre_act = helper.append_bias_op(out, bias, dim_start=1)
+    return helper.append_activation(pre_act, act)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     act=None, name=None) -> Variable:
+    helper = LayerHelper("conv2d_transpose", name=name)
+    c_in = int(input.shape[1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (
+        filter_size, filter_size)
+    w = helper.create_parameter(
+        param_attr, shape=[c_in, num_filters // groups, fs[0], fs[1]],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv2d_transpose", {"Input": [input], "Filter": [w]},
+                     {"Output": [out]},
+                     {"strides": _pair(stride), "paddings": _pair(padding),
+                      "dilations": _pair(dilation), "groups": groups})
+    bias = helper.create_parameter(bias_attr, shape=[num_filters],
+                                   dtype=input.dtype, is_bias=True)
+    pre_act = helper.append_bias_op(out, bias, dim_start=1)
+    return helper.append_activation(pre_act, act)
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [int(v), int(v)]
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None) -> Variable:
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pool2d", {"X": [input]}, {"Out": [out]},
+                     {"ksize": _pair(pool_size),
+                      "pooling_type": "avg" if pool_type == "avg" else "max",
+                      "strides": _pair(pool_stride),
+                      "paddings": _pair(pool_padding),
+                      "global_pooling": global_pooling,
+                      "ceil_mode": ceil_mode, "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    h, w = int(input.shape[2]), int(input.shape[3])
+    oh, ow = (pool_size if isinstance(pool_size, (list, tuple))
+              else (pool_size, pool_size))
+    stride = [h // oh, w // ow]
+    ksize = [h - (oh - 1) * stride[0], w - (ow - 1) * stride[1]]
+    return pool2d(input, ksize, pool_type, stride, 0, name=name)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               moving_mean_name=None, moving_variance_name=None,
+               use_global_stats=False, name=None) -> Variable:
+    """ref layers/nn.py:2705."""
+    helper = LayerHelper("batch_norm", name=name)
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    scale = helper.create_parameter(
+        param_attr, shape=[c], dtype="float32",
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype="float32",
+                                   is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, trainable=False,
+                  initializer=ConstantInitializer(0.0)),
+        shape=[c], dtype="float32")
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, trainable=False,
+                  initializer=ConstantInitializer(1.0)),
+        shape=[c], dtype="float32")
+    saved_mean = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "batch_norm",
+        {"X": [input], "Scale": [scale], "Bias": [bias],
+         "Mean": [mean], "Variance": [variance]},
+        {"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+         "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "data_layout": data_layout, "use_global_stats": use_global_stats})
+    return helper.append_activation(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None) -> Variable:
+    helper = LayerHelper("layer_norm", name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(
+            param_attr, shape=norm_shape, dtype="float32",
+            default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, shape=norm_shape,
+                                    dtype="float32", is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference("float32", True)
+    var = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("layer_norm", inputs,
+                     {"Y": [out], "Mean": [mean], "Variance": [var]},
+                     {"begin_norm_axis": begin_norm_axis,
+                      "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None) -> Variable:
+    helper = LayerHelper("group_norm", name=name)
+    c = int(input.shape[1])
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(
+            param_attr, shape=[c], dtype="float32",
+            default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[c], dtype="float32",
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference("float32", True)
+    var = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("group_norm", inputs,
+                     {"Y": [out], "Mean": [mean], "Variance": [var]},
+                     {"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            dropout_implementation="downgrade_in_infer", name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference("uint8", True)
+    helper.append_op("dropout", {"X": [x]},
+                     {"Out": [out], "Mask": [mask]},
+                     {"dropout_prob": dropout_prob, "is_test": is_test,
+                      "seed": seed or 0,
+                      "dropout_implementation": dropout_implementation})
+    return out
+
+
+def softmax(input, axis=-1, name=None):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("softmax", {"X": [input]}, {"Out": [out]},
+                     {"axis": axis})
+    return out
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_softmax", {"X": [input]}, {"Out": [out]},
+                     {"axis": axis})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cross_entropy",
+                     {"X": [input], "Label": [label]}, {"Y": [out]},
+                     {"soft_label": soft_label,
+                      "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    sm = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     {"Logits": [logits], "Label": [label]},
+                     {"Loss": [loss], "Softmax": [sm]},
+                     {"soft_label": soft_label,
+                      "ignore_index": ignore_index})
+    return (loss, sm) if return_softmax else loss
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("square_error_cost",
+                     {"X": [input], "Label": [label]}, {"Out": [out]}, {})
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mean", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def _reduce_layer(op_name):
+    def f(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_name, name=name)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        attrs = {"keep_dim": keep_dim,
+                 "reduce_all": dim is None,
+                 "dim": [0] if dim is None else (
+                     dim if isinstance(dim, (list, tuple)) else [dim])}
+        helper.append_op(op_name, {"X": [input]}, {"Out": [out]}, attrs)
+        return out
+    f.__name__ = op_name
+    return f
+
+
+reduce_sum = _reduce_layer("reduce_sum")
+reduce_mean = _reduce_layer("reduce_mean")
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+reduce_prod = _reduce_layer("reduce_prod")
+
+
+def reshape(x, shape, inplace=False, name=None):
+    helper = LayerHelper("reshape", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reshape", {"X": [x]}, {"Out": [out]},
+                     {"shape": list(shape)})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("transpose", {"X": [x]}, {"Out": [out]},
+                     {"axis": list(perm)})
+    return out
+
+
+def split(input, num_or_sections, dim=0, name=None):
+    helper = LayerHelper("split", name=name)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "sections": [], "axis": dim}
+    else:
+        n = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op("split", {"X": [input]}, {"Out": outs}, attrs)
+    return outs
+
+
+def stack(x: Sequence[Variable], axis=0):
+    helper = LayerHelper("stack")
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", {"X": list(x)}, {"Y": [out]}, {"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    n = num if num is not None else int(x.shape[axis])
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(n)]
+    helper.append_op("unstack", {"X": [x]}, {"Y": outs}, {"axis": axis})
+    return outs
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("squeeze", {"X": [input]}, {"Out": [out]},
+                     {"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("unsqueeze", {"X": [input]}, {"Out": [out]},
+                     {"axes": list(axes)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("flatten", {"X": [x]}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand", {"X": [x]}, {"Out": [out]},
+                     {"expand_times": list(expand_times)})
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", {"Input": [input]}, {"Out": [out]},
+                     {"axes": list(axes), "starts": list(starts),
+                      "ends": list(ends)})
+    return out
+
+
+def gather(input, index, axis=0):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", {"X": [input], "Index": [index]},
+                     {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True):
+    helper = LayerHelper("scatter")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter",
+                     {"X": [input], "Ids": [index], "Updates": [updates]},
+                     {"Out": [out]}, {"overwrite": overwrite})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("matmul", {"X": [x], "Y": [y]}, {"Out": [out]},
+                     {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                      "alpha": alpha})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mul", {"X": [x], "Y": [y]}, {"Out": [out]},
+                     {"x_num_col_dims": x_num_col_dims,
+                      "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    vals = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("top_k", {"X": [input]},
+                     {"Out": [vals], "Indices": [ids]}, {"k": int(k)})
+    return vals, ids
+
+
+def accuracy(input, label, k=1, name=None):
+    """ref layers/metric_op.py accuracy: topk + accuracy op."""
+    vals, ids = topk(input, k)
+    helper = LayerHelper("accuracy", name=name)
+    acc = helper.create_variable_for_type_inference("float32", True)
+    correct = helper.create_variable_for_type_inference("int32", True)
+    total = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("accuracy",
+                     {"Out": [vals], "Indices": [ids], "Label": [label]},
+                     {"Accuracy": [acc], "Correct": [correct],
+                      "Total": [total]}, {})
+    return acc
+
+
+def auc(input, label, num_thresholds=4095, name=None):
+    """ref layers/metric_op.py auc — streaming AUC with persistable stats."""
+    helper = LayerHelper("auc", name=name)
+    stat_pos = helper.create_parameter(
+        ParamAttr(name=helper.name("stat_pos"), trainable=False,
+                  initializer=ConstantInitializer(0.0)),
+        shape=[num_thresholds + 1], dtype="float32")
+    stat_neg = helper.create_parameter(
+        ParamAttr(name=helper.name("stat_neg"), trainable=False,
+                  initializer=ConstantInitializer(0.0)),
+        shape=[num_thresholds + 1], dtype="float32")
+    auc_out = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("auc",
+                     {"Predict": [input], "Label": [label],
+                      "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+                     {"AUC": [auc_out], "StatPosOut": [stat_pos],
+                      "StatNegOut": [stat_neg]},
+                     {"num_thresholds": num_thresholds})
+    return auc_out, [stat_pos, stat_neg]
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("one_hot", {"X": [input]}, {"Out": [out]},
+                     {"depth": int(depth)})
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip", {"X": [x]}, {"Out": [out]},
+                     {"min": float(min), "max": float(max)})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip_by_norm", {"X": [x]}, {"Out": [out]},
+                     {"max_norm": float(max_norm)})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    helper = LayerHelper("scale", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", {"X": [x]}, {"Out": [out]},
+                     {"scale": float(scale), "bias": float(bias),
+                      "bias_after_scale": bias_after_scale})
+    return out
+
+
+def elementwise_op_layer(op_name):
+    def f(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_name, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_name, {"X": [x], "Y": [y]}, {"Out": [out]},
+                         {"axis": axis})
+        return helper.append_activation(out, act)
+    f.__name__ = op_name
+    return f
+
+
+elementwise_add = elementwise_op_layer("elementwise_add")
+elementwise_sub = elementwise_op_layer("elementwise_sub")
+elementwise_mul = elementwise_op_layer("elementwise_mul")
+elementwise_div = elementwise_op_layer("elementwise_div")
+elementwise_max = elementwise_op_layer("elementwise_max")
+elementwise_min = elementwise_op_layer("elementwise_min")
+elementwise_pow = elementwise_op_layer("elementwise_pow")
+
+
+def _unary_layer(op_name):
+    def f(x, name=None, **attrs):
+        helper = LayerHelper(op_name, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_name, {"X": [x]}, {"Out": [out]}, attrs)
+        return out
+    f.__name__ = op_name
+    return f
+
+
+# activations / unary math exposed as layers (ref layers/ops.py is
+# auto-generated from OpProtos; here we enumerate)
+for _name in ["relu", "sigmoid", "logsigmoid", "tanh", "tanh_shrink", "exp",
+              "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin",
+              "round", "reciprocal", "log", "square", "softplus",
+              "softsign", "elu", "relu6", "stanh", "hard_shrink",
+              "softshrink", "hard_sigmoid", "swish", "hard_swish", "mish",
+              "thresholded_relu", "erf", "selu", "sign", "gelu",
+              "leaky_relu", "brelu", "soft_relu"]:
+    globals()[_name] = _unary_layer(_name)
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pow", {"X": [x]}, {"Out": [out]}, {"factor": factor})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [int(x.shape[1])]
+    else:
+        alpha_shape = [int(np.prod(x.shape[1:]))]
+    alpha = helper.create_parameter(
+        param_attr, shape=alpha_shape, dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", {"X": [x], "Alpha": [alpha]}, {"Out": [out]},
+                     {"mode": mode})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("maxout", {"X": [x]}, {"Out": [out]},
+                     {"groups": groups})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("lrn", {"X": [input]},
+                     {"Out": [out], "MidOut": [mid]},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad", {"X": [x]}, {"Out": [out]},
+                     {"paddings": list(paddings),
+                      "pad_value": float(pad_value)})
+    return out
+
+
+def pad2d(input, paddings, mode="constant", pad_value=0.0, name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pad2d", {"X": [input]}, {"Out": [out]},
+                     {"paddings": list(paddings), "mode": mode,
+                      "pad_value": float(pad_value)})
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, name=None):
+    helper = LayerHelper("interpolate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    method = "bilinear" if resample.upper() == "BILINEAR" else "nearest"
+    attrs = {"interp_method": method, "align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = scale
+    helper.append_op("interpolate", {"X": [input]}, {"Out": [out]}, attrs)
+    return out
+
+
+resize_bilinear = image_resize
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "NEAREST", name=name)
+
+
+def sequence_mask(x, maxlen, dtype="int64"):
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("sequence_mask", {"X": [x]}, {"Y": [out]},
+                     {"maxlen": int(maxlen), "out_dtype": str(dtype)})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("norm", {"X": [x]}, {"Out": [out], "Norm": [norm]},
+                     {"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def cos_sim(x, y):
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xn = helper.create_variable_for_type_inference(x.dtype, True)
+    yn = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("cos_sim", {"X": [x], "Y": [y]},
+                     {"Out": [out], "XNorm": [xn], "YNorm": [yn]}, {})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     {"X": [x], "Label": [label]}, {"Out": [out]},
+                     {"ignore_index": ignore_index, "normalize": normalize})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    helper = LayerHelper("smooth_l1_loss")
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype, True)
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    helper.append_op("smooth_l1_loss", inputs,
+                     {"Out": [loss], "Diff": [diff]}, {"sigma": sigma})
+    return loss
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    residual = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("huber_loss", {"X": [input], "Y": [label]},
+                     {"Out": [out], "Residual": [residual]},
+                     {"delta": float(delta)})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """ref layers/nn.py label_smooth — composed from primitives."""
+    smoothed = scale(label, 1.0 - epsilon)
+    k = int(label.shape[-1])
+    if prior_dist is not None:
+        return elementwise_add(smoothed, scale(prior_dist, epsilon))
+    return scale(smoothed, 1.0, bias=epsilon / k)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
+                                   max=1.0, input_dim_idx=0,
+                                   output_dim_idx=0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random_batch_size_like", {"Input": [input]},
+                     {"Out": [out]},
+                     {"shape": list(shape), "dtype": str(dtype),
+                      "min": float(min), "max": float(max), "seed": seed,
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random", {}, {"Out": [out]},
+                     {"shape": list(shape), "dtype": str(dtype),
+                      "mean": mean, "std": std, "seed": seed})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random", {}, {"Out": [out]},
+                     {"shape": list(shape), "dtype": str(dtype),
+                      "min": float(min), "max": float(max), "seed": seed})
+    return out
+
+
+def where(condition, x, y):
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("where", {"Condition": [condition], "X": [x],
+                               "Y": [y]}, {"Out": [out]}, {})
+    return out

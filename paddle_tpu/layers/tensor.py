@@ -1,0 +1,157 @@
+"""Tensor-building layer functions (ref python/paddle/fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.program import Variable
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.block.create_var(name=helper.name(), dtype=dtype,
+                                   persistable=persistable)
+
+
+def fill_constant(shape, dtype, value, name=None, out=None):
+    helper = LayerHelper("fill_constant", name=name)
+    out = out or helper.create_variable_for_type_inference(dtype)
+    helper.append_op("fill_constant", {}, {"Out": [out]},
+                     {"shape": list(shape), "dtype": str(dtype),
+                      "value": float(value)})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("fill_constant_batch_size_like", {"Input": [input]},
+                     {"Out": [out]},
+                     {"shape": list(shape), "dtype": str(dtype),
+                      "value": float(value), "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def cast(x: Variable, dtype) -> Variable:
+    helper = LayerHelper("cast")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("cast", {"X": [x]}, {"Out": [out]},
+                     {"out_dtype": str(dtype)})
+    return out
+
+
+def concat(input: Sequence[Variable], axis=0, name=None) -> Variable:
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", {"X": list(input)}, {"Out": [out]},
+                     {"axis": axis})
+    return out
+
+
+def sums(input: Sequence[Variable], out=None) -> Variable:
+    helper = LayerHelper("sum")
+    out = out or helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", {"X": list(input)}, {"Out": [out]}, {})
+    return out
+
+
+def assign(input, output: Optional[Variable] = None) -> Variable:
+    helper = LayerHelper("assign")
+    if isinstance(input, Variable):
+        output = output or helper.create_variable_for_type_inference(
+            input.dtype)
+        helper.append_op("assign", {"X": [input]}, {"Out": [output]}, {})
+    else:
+        arr = np.asarray(input)
+        output = output or helper.create_variable_for_type_inference(
+            str(arr.dtype))
+        helper.append_op("assign_value", {}, {"Out": [output]},
+                         {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                          "values": arr})
+    return output
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper("arg_min")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_min", {"X": [x]}, {"Out": [out]}, {"axis": axis})
+    out.stop_gradient = True
+    return out
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper("arg_max")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_max", {"X": [x]}, {"Out": [out]}, {"axis": axis})
+    out.stop_gradient = True
+    return out
+
+
+def argsort(x, axis=-1, descending=False):
+    helper = LayerHelper("argsort")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ids = helper.create_variable_for_type_inference("int64")
+    helper.append_op("argsort", {"X": [x]},
+                     {"Out": [out], "Indices": [ids]},
+                     {"axis": axis, "descending": descending})
+    return out, ids
+
+
+def ones(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones_like(x):
+    helper = LayerHelper("fill_any_like")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_any_like", {"X": [x]}, {"Out": [out]},
+                     {"value": 1.0})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("fill_zeros_like")
+    out = out or helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_zeros_like", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if isinstance(axis, int):
+        axis = [axis]
+    helper.append_op("reverse", {"X": [x]}, {"Out": [out]}, {"axis": axis})
+    return out
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("linspace", {}, {"Out": [out]},
+                     {"start": float(start), "stop": float(stop),
+                      "num": int(num), "dtype": str(dtype)})
+    return out
+
+
+def diag(diagonal: np.ndarray):
+    return assign(np.diag(np.asarray(diagonal)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("eye", {}, {"Out": [out]},
+                     {"num_rows": int(num_rows),
+                      "num_columns": int(num_columns or num_rows),
+                      "dtype": str(dtype)})
+    return out

@@ -1,0 +1,78 @@
+"""Composed network helpers (ref python/paddle/fluid/nets.py:
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention)."""
+from __future__ import annotations
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None):
+    conv_out = layers.conv2d(input, num_filters, filter_size,
+                             stride=conv_stride, padding=conv_padding,
+                             dilation=conv_dilation, groups=conv_groups,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             act=act)
+    return layers.pool2d(conv_out, pool_size, pool_type, pool_stride,
+                         pool_padding, global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act="relu",
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max"):
+    tmp = input
+    if isinstance(conv_with_batchnorm, bool):
+        conv_with_batchnorm = [conv_with_batchnorm] * len(conv_num_filter)
+    if isinstance(conv_batchnorm_drop_rate, (int, float)):
+        conv_batchnorm_drop_rate = ([conv_batchnorm_drop_rate]
+                                    * len(conv_num_filter))
+    for i, nf in enumerate(conv_num_filter):
+        local_act = None if conv_with_batchnorm[i] else conv_act
+        tmp = layers.conv2d(tmp, nf, conv_filter_size,
+                            padding=conv_padding, act=local_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i] > 0:
+                tmp = layers.dropout(tmp, conv_batchnorm_drop_rate[i])
+    return layers.pool2d(tmp, pool_size, pool_type, pool_stride)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, 2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head attention composed from program ops
+    (ref nets.py scaled_dot_product_attention).  For the fused Pallas
+    flash-attention path use layers.nn-level models with
+    kernels/flash_attention."""
+    d_key = int(queries.shape[-1]) // num_heads
+
+    def _split_heads(x):
+        b = x.shape[0]
+        t = int(x.shape[1])
+        d = int(x.shape[2])
+        y = layers.reshape(x, [0 if b == -1 else b, t, num_heads,
+                               d // num_heads])
+        return layers.transpose(y, [0, 2, 1, 3])
+
+    q = _split_heads(queries)
+    k = _split_heads(keys)
+    v = _split_heads(values)
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=float(d_key) ** -0.5)
+    weights = layers.softmax(scores)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_rate,
+                                 dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(weights, v)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    t = int(ctx.shape[2]) if len(ctx.shape) > 2 else -1
+    return layers.reshape(ctx, [0, int(queries.shape[1]),
+                                int(queries.shape[2])])

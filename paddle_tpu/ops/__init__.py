@@ -1,0 +1,22 @@
+"""Op library: every module registers its ops on import.
+
+Capability parity target: the reference's op census
+(/root/reference/paddle/fluid/operators/, ~330 ops — see SURVEY.md §2.3).
+Each op here is a single `lower` function emitting jax/XLA (or Pallas); see
+framework/registry.py for why that replaces per-device kernel registration.
+"""
+from . import structural  # feed/fetch/autodiff pseudo-ops
+from . import creation
+from . import elementwise
+from . import activation
+from . import math_ops
+from . import reduce_ops
+from . import tensor_manip
+from . import nn_ops
+from . import loss_ops
+from . import metric_ops
+from . import optimizer_ops
+from . import control_flow
+from . import sequence_ops
+from . import detection_ops
+from . import collective_ops

@@ -1,0 +1,104 @@
+"""Pointwise activation / unary math functors.
+
+Parity: the ~30 functors in /root/reference/paddle/fluid/operators/
+activation_op.h (relu, sigmoid, tanh, exp, sqrt, rsqrt, abs, ceil, floor,
+cos, sin, round, reciprocal, log, square, softplus, softsign, brelu,
+leaky_relu, soft_relu, elu, relu6, pow, stanh, hard_shrink, hard_sigmoid,
+swish, thresholded_relu) + gelu, selu, prelu, maxout, hard_swish, mish.
+
+All are trivially fused by XLA into neighbouring matmuls/convs — exactly the
+fusion the reference needed handwritten fused_ops/ and xbyak JIT kernels for
+(operators/math/jit_kernel*.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+def _unary(name, fn):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(single_input(ins), attrs)]}
+    return _lower
+
+
+_unary("relu", lambda x, a: jax.nn.relu(x))
+_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_unary("tanh", lambda x, a: jnp.tanh(x))
+_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_unary("exp", lambda x, a: jnp.exp(x))
+_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_unary("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_unary("abs", lambda x, a: jnp.abs(x))
+_unary("ceil", lambda x, a: jnp.ceil(x))
+_unary("floor", lambda x, a: jnp.floor(x))
+_unary("cos", lambda x, a: jnp.cos(x))
+_unary("sin", lambda x, a: jnp.sin(x))
+_unary("round", lambda x, a: jnp.round(x))
+_unary("reciprocal", lambda x, a: 1.0 / x)
+_unary("log", lambda x, a: jnp.log(x))
+_unary("square", lambda x, a: jnp.square(x))
+_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_unary("softsign", lambda x, a: jax.nn.soft_sign(x))
+_unary("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0),
+                                      a.get("t_max", 24.0)))
+_unary("leaky_relu", lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)))
+_unary("soft_relu",
+       lambda x, a: jnp.log1p(jnp.exp(jnp.clip(
+           x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))))
+_unary("elu", lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)))
+_unary("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_unary("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_unary("stanh", lambda x, a: a.get("scale_b", 1.7159) *
+       jnp.tanh(a.get("scale_a", 2.0 / 3.0) * x))
+_unary("hard_shrink",
+       lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+_unary("softshrink",
+       lambda x, a: jnp.sign(x) * jax.nn.relu(jnp.abs(x) -
+                                              a.get("lambda", 0.5)))
+_unary("hard_sigmoid",
+       lambda x, a: jnp.clip(a.get("slope", 0.2) * x +
+                             a.get("offset", 0.5), 0.0, 1.0))
+_unary("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_unary("hard_swish",
+       lambda x, a: x * jnp.clip(x + a.get("offset", 3.0), 0.0,
+                                 a.get("threshold", 6.0)) /
+       a.get("scale", 6.0))
+_unary("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_unary("thresholded_relu",
+       lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0))
+_unary("gelu",
+       lambda x, a: jax.nn.gelu(x, approximate=bool(a.get("approximate",
+                                                          False))))
+_unary("erf", lambda x, a: jax.lax.erf(x))
+_unary("selu", lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
+    x > 0, x, a.get("alpha", 1.6732632423543772) * (jnp.exp(x) - 1)))
+_unary("sign", lambda x, a: jnp.sign(x))
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    """ref operators/prelu_op.cc — modes: all | channel | element."""
+    x = single_input(ins)
+    alpha = single_input(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    """ref operators/maxout_op.cc (NCHW, groups along C)."""
+    x = single_input(ins)
+    groups = int(attrs["groups"])
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    x = x.reshape((n, c // groups, groups) + rest)
+    return {"Out": [x.max(axis=2)]}

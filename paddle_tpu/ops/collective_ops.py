@@ -1,0 +1,97 @@
+"""Collective communication ops — the ICI/DCN plane.
+
+Parity: the reference's raw NCCL ops (/root/reference/paddle/fluid/operators/
+nccl/nccl_op.cc — ncclAllReduce/Bcast/Reduce as program ops) and the
+collective op-handles of ParallelExecutor (details/all_reduce_op_handle.cc,
+broadcast_op_handle.cc, reduce_op_handle.cc).
+
+TPU-first: these lower to jax.lax collectives over a *named mesh axis* and
+are only meaningful when the program is executed under shard_map / pjit with
+that axis in scope (parallel/ modules arrange this).  For ordinary
+data-parallel training these ops are NOT needed — XLA inserts the gradient
+psum automatically from sharding annotations; they exist for explicit
+SPMD programs (context/expert parallelism, manual pipelines).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+@register_op("c_allreduce_sum")
+def _c_allreduce_sum(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jax.lax.psum(x, axis_name=attrs.get("axis_name",
+                                                        "data"))]}
+
+
+@register_op("c_allreduce_max")
+def _c_allreduce_max(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jax.lax.pmax(x, axis_name=attrs.get("axis_name",
+                                                        "data"))]}
+
+
+@register_op("c_allreduce_mean")
+def _c_allreduce_mean(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jax.lax.pmean(x, axis_name=attrs.get("axis_name",
+                                                         "data"))]}
+
+
+@register_op("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jax.lax.all_gather(
+        x, axis_name=attrs.get("axis_name", "data"),
+        axis=int(attrs.get("axis", 0)), tiled=True)]}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jax.lax.psum_scatter(
+        x, axis_name=attrs.get("axis_name", "data"),
+        scatter_dimension=int(attrs.get("axis", 0)), tiled=True)]}
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    """Broadcast from root: implemented as select + psum (XLA lowers this
+    to an efficient collective)."""
+    x = single_input(ins)
+    axis_name = attrs.get("axis_name", "data")
+    root = int(attrs.get("root", 0))
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": [jax.lax.psum(masked, axis_name=axis_name)]}
+
+
+@register_op("c_ppermute")
+def _c_ppermute(ctx, ins, attrs):
+    """Ring permute — the building block of ring attention / pipeline
+    parallelism (no reference analogue; TPU-native capability)."""
+    x = single_input(ins)
+    axis_name = attrs.get("axis_name", "data")
+    shift = int(attrs.get("shift", 1))
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": [jax.lax.ppermute(x, axis_name, perm)]}
+
+
+@register_op("c_alltoall")
+def _c_alltoall(ctx, ins, attrs):
+    x = single_input(ins)
+    axis_name = attrs.get("axis_name", "data")
+    split_axis = int(attrs.get("split_axis", 0))
+    concat_axis = int(attrs.get("concat_axis", 0))
+    return {"Out": [jax.lax.all_to_all(x, axis_name, split_axis,
+                                       concat_axis, tiled=True)]}
+
+
+@register_op("c_sync_calc_stream")
+def _c_sync(ctx, ins, attrs):
+    """No-op on TPU: XLA owns stream ordering (ref c_sync_*_stream ops)."""
+    return {"Out": [single_input(ins)]}

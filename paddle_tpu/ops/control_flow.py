@@ -1,0 +1,129 @@
+"""Control-flow ops: structured, compiler-friendly loops/branches.
+
+Parity: operators/controlflow/ (while_op.cc, conditional_block_op.cc,
+compare/logical ops live in ops/elementwise.py) and the RNN substrate
+(recurrent_op.cc).
+
+TPU-first design: the reference's while/conditional run a sub-block through
+a nested Executor with per-iteration scopes.  Here sub-blocks lower into
+lax.while_loop / lax.cond / lax.scan with an explicit carry — the set of
+vars the sub-block writes.  Shapes must be loop-invariant (XLA requirement),
+which the reference's TensorArray-style dynamic shapes violate; the
+DynamicRNN capability is covered by `scan` over padded/packed sequences
+(see layers/control_flow.py StaticRNN).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+def _lower_block(ctx, env: Dict, block) -> Dict:
+    """Run a sub-block's ops against an env copy; returns the final env."""
+    from ..framework.executor import run_ops_in_env  # shared lowering loop
+    return run_ops_in_env(ctx, env, block.ops)
+
+
+def _block_written_vars(block) -> List[str]:
+    written = []
+    for op in block.ops:
+        for names in op.outputs.values():
+            for n in names:
+                if n and n not in written:
+                    written.append(n)
+    return written
+
+
+@register_op("while")
+def _while(ctx, ins, attrs):
+    """attrs: sub_block (block idx), condition (var name).
+    Carry = condition var + every var written in the sub-block that already
+    exists outside (loop-carried state)."""
+    program = ctx.program
+    block = program.blocks[int(attrs["sub_block"])]
+    cond_name = attrs["condition"]
+    env = ctx.env  # the executor exposes the live env to control-flow ops
+    written = _block_written_vars(block)
+    carried = [n for n in written if n in env]
+    if cond_name not in carried and cond_name in env:
+        carried.append(cond_name)
+
+    def cond_fn(carry):
+        return carry[cond_name].reshape(())
+
+    def body_fn(carry):
+        benv = dict(env)
+        benv.update(carry)
+        benv = _lower_block(ctx, benv, block)
+        return {n: benv[n] for n in carried}
+
+    init = {n: env[n] for n in carried}
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    return {"Out": [final[n] for n in attrs.get("out_vars", carried)]}
+
+
+@register_op("conditional_block")
+def _conditional_block(ctx, ins, attrs):
+    """attrs: sub_block; Cond input scalar bool.  Vars written by the block
+    are emitted through 'Out' (attrs out_vars order); when the condition is
+    false the pre-existing values (or zeros) pass through."""
+    program = ctx.program
+    block = program.blocks[int(attrs["sub_block"])]
+    cond = single_input(ins, "Cond").reshape(())
+    env = ctx.env
+    out_vars = attrs["out_vars"]
+
+    def then_fn(_):
+        benv = _lower_block(ctx, dict(env), block)
+        return tuple(benv[n] for n in out_vars)
+
+    # else-branch shapes come from abstract-evaluating the then-branch —
+    # robust for sub-block-local temps that exist nowhere else
+    out_abs = jax.eval_shape(then_fn, None)
+
+    def else_fn(_):
+        return tuple(env[n] if n in env else jnp.zeros(a.shape, a.dtype)
+                     for n, a in zip(out_vars, out_abs))
+
+    outs = jax.lax.cond(cond, then_fn, else_fn, operand=None)
+    return {"Out": list(outs)}
+
+
+@register_op("scan")
+def _scan(ctx, ins, attrs):
+    """TPU-native sequence loop: lax.scan over the leading time axis.
+    attrs: sub_block, carry_vars (names), x_vars (scanned inputs -> block
+    var names), y_vars (per-step outputs collected).
+    This is the engine under StaticRNN/DynamicRNN-capability
+    (ref operators/recurrent_op.cc — per-timestep scopes become the carry)."""
+    program = ctx.program
+    block = program.blocks[int(attrs["sub_block"])]
+    env = ctx.env
+    carry_names = list(attrs["carry_vars"])
+    x_names = list(attrs.get("x_vars", []))
+    y_names = list(attrs.get("y_vars", []))
+    xs = {n: env[n] for n in x_names}
+
+    def body(carry, x_t):
+        benv = dict(env)
+        benv.update(carry)
+        benv.update(x_t)
+        benv = _lower_block(ctx, benv, block)
+        new_carry = {n: benv[n] for n in carry_names}
+        ys = tuple(benv[n] for n in y_names)
+        return new_carry, ys
+
+    init = {n: env[n] for n in carry_names}
+    final_carry, ys = jax.lax.scan(body, init, xs)
+    return {"CarryOut": [final_carry[n] for n in carry_names],
+            "Ys": list(ys)}
+
+
+@register_op("increment_loop_counter")
+def _increment_counter(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [x + attrs.get("step", 1)]}

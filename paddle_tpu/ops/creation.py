@@ -1,0 +1,187 @@
+"""Tensor creation / fill / cast ops.
+
+Parity: fill_constant, fill_constant_batch_size_like, fill_zeros_like,
+fill_any_like, uniform_random, gaussian_random, truncated_gaussian_random,
+assign, assign_value, cast, shape, one_hot, range, eye, linspace
+(/root/reference/paddle/fluid/operators/fill_constant_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc, one_hot_op.cc, ...).
+
+Random ops draw from the functional RNG plane (ctx.rng()); the per-op `seed`
+attr (reference semantics: 0 = use global generator) is honoured by folding a
+nonzero seed into a fixed key.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import to_jnp_dtype
+from ..framework.registry import register_op, single_input
+
+
+def _op_key(ctx, attrs):
+    seed = int(attrs.get("seed", 0) or 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.rng()
+
+
+@register_op("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_cbsl(ctx, ins, attrs):
+    """Shape copied from Input's batch dim (ref
+    fill_constant_batch_size_like_op.cc)."""
+    x = single_input(ins, "Input")
+    shape = list(attrs["shape"])
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = x.shape[in_idx]
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0), dtype)]}
+
+
+@register_op("uniform_random_batch_size_like")
+def _uniform_random_bsl(ctx, ins, attrs):
+    """Shape copied from Input's batch dim (ref
+    uniform_random_batch_size_like_op.cc); trace-time static."""
+    x = single_input(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[int(attrs.get("output_dim_idx", 0))] = x.shape[
+        int(attrs.get("input_dim_idx", 0))]
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    lo, hi = float(attrs.get("min", -1.0)), float(attrs.get("max", 1.0))
+    u = jax.random.uniform(_op_key(ctx, attrs), tuple(shape), jnp.float32,
+                           lo, hi)
+    return {"Out": [u.astype(dtype)]}
+
+
+@register_op("gaussian_random_batch_size_like")
+def _gaussian_random_bsl(ctx, ins, attrs):
+    x = single_input(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[int(attrs.get("output_dim_idx", 0))] = x.shape[
+        int(attrs.get("input_dim_idx", 0))]
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    g = jax.random.normal(_op_key(ctx, attrs), tuple(shape), jnp.float32)
+    return {"Out": [(g * float(attrs.get("std", 1.0))
+                     + float(attrs.get("mean", 0.0))).astype(dtype)]}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(single_input(ins))]}
+
+
+@register_op("fill_any_like")
+def _fill_any_like(ctx, ins, attrs):
+    x = single_input(ins)
+    dtype = attrs.get("dtype")
+    dtype = to_jnp_dtype(dtype) if dtype else x.dtype
+    return {"Out": [jnp.full_like(x, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx, ins, attrs):
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    lo, hi = float(attrs.get("min", -1.0)), float(attrs.get("max", 1.0))
+    u = jax.random.uniform(_op_key(ctx, attrs), shape, jnp.float32, lo, hi)
+    return {"Out": [u.astype(dtype)]}
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx, ins, attrs):
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    mean, std = float(attrs.get("mean", 0.0)), float(attrs.get("std", 1.0))
+    g = jax.random.normal(_op_key(ctx, attrs), shape, jnp.float32)
+    return {"Out": [(g * std + mean).astype(dtype)]}
+
+
+@register_op("truncated_gaussian_random")
+def _trunc_gaussian(ctx, ins, attrs):
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs["shape"])
+    mean, std = float(attrs.get("mean", 0.0)), float(attrs.get("std", 1.0))
+    g = jax.random.truncated_normal(_op_key(ctx, attrs), -2.0, 2.0, shape,
+                                    jnp.float32)
+    return {"Out": [(g * std + mean).astype(dtype)]}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [single_input(ins)]}
+
+
+@register_op("assign_value")
+def _assign_value(ctx, ins, attrs):
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    vals = np.asarray(attrs["values"]).reshape(attrs["shape"])
+    return {"Out": [jnp.asarray(vals, dtype=dtype)]}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [x.astype(to_jnp_dtype(attrs["out_dtype"]))]}
+
+
+@register_op("shape", stop_gradient=True)
+def _shape(ctx, ins, attrs):
+    x = single_input(ins, "Input")
+    return {"Out": [jnp.asarray(x.shape, dtype=jnp.int64)]}
+
+
+@register_op("one_hot", stop_gradient=True)
+def _one_hot(ctx, ins, attrs):
+    x = single_input(ins)
+    depth = int(attrs["depth"])
+    if x.shape and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return {"Out": [jax.nn.one_hot(x.astype(jnp.int32), depth,
+                                   dtype=jnp.float32)]}
+
+
+@register_op("range", stop_gradient=True)
+def _range(ctx, ins, attrs):
+    start = single_input(ins, "Start")
+    end = single_input(ins, "End")
+    step = single_input(ins, "Step")
+    # shapes must be static under jit: require python scalars via attrs when
+    # used inside programs; this op is mainly used at build time.
+    n = int(attrs["len"]) if "len" in attrs else None
+    if n is None:
+        raise ValueError("range op inside a program needs a static 'len' attr")
+    return {"Out": [(start + step * jnp.arange(n, dtype=start.dtype))]}
+
+
+@register_op("eye", stop_gradient=True)
+def _eye(ctx, ins, attrs):
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.eye(int(attrs["num_rows"]),
+                            int(attrs.get("num_columns",
+                                          attrs["num_rows"])), dtype=dtype)]}
+
+
+@register_op("linspace", stop_gradient=True)
+def _linspace(ctx, ins, attrs):
+    dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.linspace(float(attrs["start"]), float(attrs["stop"]),
+                                 int(attrs["num"]), dtype=dtype)]}
+
+
+@register_op("sampling_id", stop_gradient=True)
+def _sampling_id(ctx, ins, attrs):
+    """Sample one category id per row from a probability matrix
+    (ref operators/sampling_id_op.cc)."""
+    x = single_input(ins)
+    ids = jax.random.categorical(_op_key(ctx, attrs), jnp.log(x + 1e-20),
+                                 axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}

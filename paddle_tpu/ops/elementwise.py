@@ -1,0 +1,87 @@
+"""Elementwise binary ops with the reference's axis-broadcast semantics,
+plus comparison and logical ops.
+
+Parity: operators/elementwise/ (elementwise_op_function.h broadcast
+machinery; add/sub/mul/div/min/max/pow/mod/floordiv), operators/controlflow/
+compare_op.cc, logical_op.cc.
+
+Reference broadcast rule: Y's dims align with X starting at `axis`
+(axis == -1 -> trailing alignment), then NumPy-style broadcast.  XLA fuses
+the resulting broadcast+op into surrounding computation, so this costs
+nothing at run time.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _align(x, y, axis):
+    if x.ndim == y.ndim:
+        return x, y
+    if y.ndim > x.ndim:  # allow either operand to be the smaller one
+        y_al, x_al = _align(y, x, axis)
+        return x_al, y_al
+    axis = int(axis)
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return x, y.reshape(new_shape)
+
+
+def _binary(name, fn, out_slot="Out"):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = _align(x, y, attrs.get("axis", -1))
+        return {out_slot: [_fn(x, y)]}
+    return _lower
+
+
+_binary("elementwise_add", jnp.add)
+_binary("elementwise_sub", jnp.subtract)
+_binary("elementwise_mul", jnp.multiply)
+_binary("elementwise_div", jnp.divide)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_pow", jnp.power)
+_binary("elementwise_mod", jnp.mod)
+_binary("elementwise_floordiv", jnp.floor_divide)
+
+
+def _compare(name, fn):
+    @register_op(name, stop_gradient=True)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = _align(x, y, attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+    return _lower
+
+
+_compare("equal", jnp.equal)
+_compare("not_equal", jnp.not_equal)
+_compare("less_than", jnp.less)
+_compare("less_equal", jnp.less_equal)
+_compare("greater_than", jnp.greater)
+_compare("greater_equal", jnp.greater_equal)
+
+
+@register_op("logical_and", stop_gradient=True)
+def _land(ctx, ins, attrs):
+    return {"Out": [jnp.logical_and(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("logical_or", stop_gradient=True)
+def _lor(ctx, ins, attrs):
+    return {"Out": [jnp.logical_or(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("logical_xor", stop_gradient=True)
+def _lxor(ctx, ins, attrs):
+    return {"Out": [jnp.logical_xor(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("logical_not", stop_gradient=True)
+def _lnot(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}

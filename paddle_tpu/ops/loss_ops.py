@@ -1,0 +1,201 @@
+"""Loss ops.
+
+Parity: cross_entropy (operators/cross_entropy_op.cc),
+softmax_with_cross_entropy (softmax_with_cross_entropy_op.cc — fused,
+numerically-stable path; the TPU version is exactly the log-softmax fusion
+XLA produces), sigmoid_cross_entropy_with_logits, square_error_cost,
+smooth_l1, huber_loss, log_loss, hinge_loss, modified_huber_loss, bpr_loss,
+margin_rank_loss, rank_loss, mse_loss, kldiv_loss, npair/center etc. later.
+Label convention follows the reference: integer labels have a trailing dim
+of 1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+def _squeeze_label(label):
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        return label.squeeze(-1)
+    return label
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    """X is a probability distribution (post-softmax)."""
+    x = single_input(ins)
+    label = single_input(ins, "Label")
+    ignore_index = int(attrs.get("ignore_index", -100))
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + 1e-20), axis=-1, keepdims=True)
+    else:
+        lab = _squeeze_label(label).astype(jnp.int32)
+        picked = jnp.take_along_axis(x, lab[..., None], axis=-1)
+        loss = -jnp.log(picked + 1e-20)
+        loss = jnp.where(lab[..., None] == ignore_index, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_xent(ctx, ins, attrs):
+    logits = single_input(ins, "Logits")
+    label = single_input(ins, "Label")
+    ignore_index = int(attrs.get("ignore_index", -100))
+    log_p = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    softmax = jnp.exp(log_p)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
+    else:
+        lab = _squeeze_label(label).astype(jnp.int32)
+        picked = jnp.take_along_axis(log_p, lab[..., None], axis=-1)
+        loss = -picked
+        loss = jnp.where(lab[..., None] == ignore_index, 0.0, loss)
+    return {"Loss": [loss.astype(logits.dtype)],
+            "Softmax": [softmax.astype(logits.dtype)]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_xent(ctx, ins, attrs):
+    x = single_input(ins)
+    label = single_input(ins, "Label")
+    ignore_index = int(attrs.get("ignore_index", -100))
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = jnp.where(label == ignore_index, 0.0, loss)
+    if attrs.get("normalize", False):
+        norm = jnp.maximum(jnp.sum((label != ignore_index)
+                                   .astype(loss.dtype)), 1.0)
+        loss = loss / norm
+    return {"Out": [loss]}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    return {"Out": [jnp.square(x - label)]}
+
+
+@register_op("mse_loss")
+def _mse_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    return {"Out": [jnp.mean(jnp.square(x - label))]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    """ref smooth_l1_loss_op.cc; sigma2-weighted huber on (X - Y)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = float(attrs.get("sigma", 1.0))
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    val = jnp.where(ad < 1.0 / s2, 0.5 * s2 * jnp.square(diff),
+                    ad - 0.5 / s2)
+    if ins.get("OutsideWeight"):
+        val = val * ins["OutsideWeight"][0]
+    out = jnp.sum(val.reshape(val.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [diff]}
+
+
+@register_op("huber_loss")
+def _huber(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = float(attrs.get("delta", 1.0))
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * jnp.square(r),
+                    delta * (ar - 0.5 * delta))
+    return {"Out": [out], "Residual": [r]}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p = single_input(ins, "Predicted")
+    label = single_input(ins, "Labels")
+    eps = float(attrs.get("epsilon", 1e-4))
+    out = (-label * jnp.log(p + eps)
+           - (1 - label) * jnp.log(1 - p + eps))
+    return {"Loss": [out]}
+
+
+@register_op("hinge_loss")
+def _hinge(ctx, ins, attrs):
+    logits = single_input(ins, "Logits")
+    label = single_input(ins, "Labels")
+    signed = 2.0 * label - 1.0
+    return {"Loss": [jax.nn.relu(1.0 - signed * logits)]}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber(ctx, ins, attrs):
+    x = single_input(ins)
+    y = single_input(ins, "Y")
+    signed = 2.0 * y - 1.0
+    z = x * signed
+    out = jnp.where(z >= -1.0, jnp.square(jax.nn.relu(1.0 - z)), -4.0 * z)
+    return {"Out": [out], "IntermediateVal": [z]}
+
+
+@register_op("bpr_loss")
+def _bpr(ctx, ins, attrs):
+    """Bayesian personalized ranking (ref bpr_loss_op.cc)."""
+    x = single_input(ins)
+    label = _squeeze_label(single_input(ins, "Label")).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, label[..., None], axis=-1)
+    diff = x - pos
+    loss = jnp.mean(jnp.log1p(jnp.exp(diff)), axis=-1, keepdims=True)
+    return {"Y": [loss]}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank(ctx, ins, attrs):
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    label = single_input(ins, "Label")
+    margin = float(attrs.get("margin", 0.0))
+    out = jax.nn.relu(-label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    label = single_input(ins, "Label")
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    out = jnp.log1p(jnp.exp(d)) - label * d
+    return {"Out": [out]}
+
+
+@register_op("kldiv_loss")
+def _kldiv(ctx, ins, attrs):
+    x = single_input(ins)
+    target = single_input(ins, "Target")
+    loss = target * (jnp.log(target + 1e-20) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
+
+
+@register_op("npair_loss")
+def _npair(ctx, ins, attrs):
+    anchor = single_input(ins, "Anchor")
+    positive = single_input(ins, "Positive")
+    labels = single_input(ins, "Labels").astype(jnp.float32)
+    l2 = float(attrs.get("l2_reg", 0.002))
+    sim = anchor @ positive.T
+    lab = labels.reshape(-1, 1)
+    same = (lab == lab.T).astype(jnp.float32)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    xent = -jnp.mean(jnp.sum(same * logp, axis=1))
+    reg = l2 * (jnp.mean(jnp.sum(jnp.square(anchor), 1))
+                + jnp.mean(jnp.sum(jnp.square(positive), 1))) / 2.0
+    return {"Out": [xent + reg]}

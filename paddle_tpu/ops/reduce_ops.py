@@ -1,0 +1,71 @@
+"""Reductions, argmax/sort/topk.
+
+Parity: operators/reduce_ops/ (reduce_sum/mean/max/min/prod/all/any),
+arg_max/arg_min (operators/arg_min_max_op_base.h), argsort, top_k, cumsum.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+def _axes(attrs, ndim):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % ndim for d in dim)
+
+
+def _reduce(name, fn):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x = single_input(ins)
+        return {"Out": [_fn(x, axis=_axes(attrs, x.ndim),
+                            keepdims=bool(attrs.get("keep_dim", False)))]}
+    return _lower
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all)
+_reduce("reduce_any", jnp.any)
+
+
+@register_op("arg_max", stop_gradient=True)
+def _arg_max(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.argmax(x, axis=int(attrs.get("axis", -1)))
+                    .astype(jnp.int64)]}
+
+
+@register_op("arg_min", stop_gradient=True)
+def _arg_min(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.argmin(x, axis=int(attrs.get("axis", -1)))
+                    .astype(jnp.int64)]}
+
+
+@register_op("argsort", stop_gradient=True)
+def _argsort(ctx, ins, attrs):
+    x = single_input(ins)
+    axis = int(attrs.get("axis", -1))
+    descending = bool(attrs.get("descending", False))
+    key = -x if descending else x
+    idx = jnp.argsort(key, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k", stop_gradient=True)
+def _top_k(ctx, ins, attrs):
+    x = single_input(ins)
+    k = int(attrs["k"])
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}

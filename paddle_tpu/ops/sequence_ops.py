@@ -1,0 +1,225 @@
+"""Sequence ops over dense padded batches with explicit lengths/masks.
+
+Parity target: the reference's LoD-aware sequence family
+(/root/reference/paddle/fluid/operators/sequence_ops/ — 16 ops) and LoD
+plumbing (lod_reset, sequence_mask, ...).
+
+TPU-first design (SURVEY.md §7 hard part (a)): LoD ragged batches are
+replaced by dense (batch, max_len, ...) tensors + a Length vector (or
+sequence mask).  Each op takes X (+ optionally Length) and honours padding
+via masking — static shapes, so everything stays jittable and
+MXU-friendly.  This is the documented design decision, not an omission:
+the *capability bar* (train attention/RNN models on variable-length
+sequences) is met by mask-aware ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+def _mask(x, ins, time_axis=1):
+    """(batch, T) float mask from optional Length input."""
+    if not ins.get("Length"):
+        return jnp.ones(x.shape[:2], dtype=jnp.float32)
+    length = ins["Length"][0].reshape(-1)
+    t = x.shape[time_axis]
+    return (jnp.arange(t)[None, :] < length[:, None]).astype(jnp.float32)
+
+
+@register_op("sequence_mask", stop_gradient=True)
+def _sequence_mask(ctx, ins, attrs):
+    length = single_input(ins)
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_mask needs a static maxlen attr on TPU")
+    out = (jnp.arange(maxlen)[None, :] <
+           length.reshape(-1, 1)).astype(jnp.int32)
+    out_dtype = attrs.get("out_dtype", "int64")
+    from ..core.dtypes import to_jnp_dtype
+    return {"Y": [out.astype(to_jnp_dtype(out_dtype))]}
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    """average|sum|sqrt|max|last|first over the time axis with padding
+    masked out (ref sequence_ops/sequence_pool_op.cc)."""
+    x = single_input(ins)
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    m = _mask(x, ins)
+    m_exp = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    cnt = jnp.maximum(jnp.sum(m, axis=1), 1.0).reshape(
+        (-1,) + (1,) * (x.ndim - 2))
+    if ptype == "AVERAGE":
+        out = jnp.sum(x * m_exp, axis=1) / cnt
+    elif ptype == "SUM":
+        out = jnp.sum(x * m_exp, axis=1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m_exp, axis=1) / jnp.sqrt(cnt)
+    elif ptype == "MAX":
+        big_neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m_exp > 0, x, big_neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(jnp.sum(m, axis=1).astype(jnp.int32) - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = single_input(ins)
+    m = _mask(x, ins)
+    logits = jnp.where(m > 0, x, -1e9)
+    return {"Out": [jax.nn.softmax(logits, axis=1) * m]}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """Broadcast each row along a new time axis sized like Y's
+    (dense analogue of sequence_expand_op.cc)."""
+    x = single_input(ins)
+    y = single_input(ins, "Y")
+    t = y.shape[1]
+    return {"Out": [jnp.repeat(x[:, None], t, axis=1)]}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    """Reverse valid timesteps only, keeping padding in place."""
+    x = single_input(ins)
+    if not ins.get("Length"):
+        return {"Y": [jnp.flip(x, axis=1)]}
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    rev = jnp.where(idx < length[:, None], length[:, None] - 1 - idx, idx)
+    out = jnp.take_along_axis(
+        x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+    return {"Y": [out]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    x = single_input(ins)
+    off = int(attrs["offset"])
+    length = int(attrs["length"])
+    return {"Out": [x[:, off:off + length]]}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    """Already-dense input: pad/trim time axis to padded_length."""
+    x = single_input(ins)
+    target = int(attrs["padded_length"])
+    t = x.shape[1]
+    if t >= target:
+        out = x[:, :target]
+    else:
+        pads = [(0, 0), (0, target - t)] + [(0, 0)] * (x.ndim - 2)
+        out = jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))
+    length = (ins["Length"][0] if ins.get("Length")
+              else jnp.full((x.shape[0],), t, jnp.int64))
+    return {"Out": [out], "Length": [length]}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx, ins, attrs):
+    """Dense world: masking stand-in — zero out positions past Length."""
+    x = single_input(ins)
+    m = _mask(x, ins)
+    return {"Out": [x * m.reshape(m.shape + (1,) * (x.ndim - 2))]}
+
+
+@register_op("sequence_enumerate", stop_gradient=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding n-gram windows of ids (ref sequence_enumerate_op.cc)."""
+    x = single_input(ins)  # (batch, T)
+    win = int(attrs["win_size"])
+    pad = int(attrs.get("pad_value", 0))
+    t = x.shape[1]
+    padded = jnp.pad(x, [(0, 0), (0, win - 1)], constant_values=pad)
+    cols = jnp.stack([padded[:, i:i + t] for i in range(win)], axis=-1)
+    return {"Out": [cols]}
+
+
+@register_op("sequence_erase", stop_gradient=True)
+def _sequence_erase(ctx, ins, attrs):
+    """Mask out tokens (replace with pad 0) — dense analogue of erase."""
+    x = single_input(ins)
+    tokens = jnp.asarray(attrs["tokens"])
+    hit = jnp.isin(x, tokens)
+    return {"Out": [jnp.where(hit, 0, x)]}
+
+
+@register_op("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    x = single_input(ins)
+    y = single_input(ins, "Y")
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1])
+                                     + x.shape[1:])]}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    x = single_input(ins)
+    new_dim = int(attrs["new_dim"])
+    b = x.shape[0]
+    return {"Out": [x.reshape(b, -1, new_dim)]}
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(ctx, ins, attrs):
+    x = single_input(ins)
+    ids = single_input(ins, "Ids").astype(jnp.int32)
+    upd = single_input(ins, "Updates")
+    b = x.shape[0]
+    rows = jnp.repeat(jnp.arange(b)[:, None], ids.shape[1], axis=1)
+    return {"Out": [x.at[rows, ids].add(upd)]}
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    """LoD is edge metadata only; dense passthrough."""
+    return {"Out": [single_input(ins)]}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (ref row_conv_op.cc): (B, T, D) x
+    (future_ctx+1, D) -> (B, T, D)."""
+    x = single_input(ins)
+    w = single_input(ins, "Filter")
+    ctx_len = w.shape[0]
+    outs = jnp.zeros_like(x)
+    padded = jnp.pad(x, [(0, 0), (0, ctx_len - 1), (0, 0)])
+    for i in range(ctx_len):
+        outs = outs + padded[:, i:i + x.shape[1]] * w[i][None, None, :]
+    return {"Out": [outs]}
+
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    """Sinusoidal PE added in-graph (ref add_position_encoding_op.cc)."""
+    x = single_input(ins)  # (B, T, D)
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    return {"Out": [alpha * x + beta * pe[None].astype(x.dtype)]}

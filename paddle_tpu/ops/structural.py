@@ -1,0 +1,25 @@
+"""Structural pseudo-ops the Executor itself interprets.
+
+`feed`/`fetch` mirror the reference's feed/fetch ops (operators/controlflow/
+feed_op.cc, fetch_op.cc) — here they are program-level markers only; the
+Executor passes feeds/fetches as function inputs/outputs.  `autodiff` is the
+marker appended by framework/backward.py and expanded by the Executor via
+jax.vjp.
+"""
+from ..framework.registry import register_op
+
+
+@register_op("feed", doc="structural: executor input marker")
+def _feed(ctx, ins, attrs):
+    return {"Out": ins.get("X", [])}
+
+
+@register_op("fetch", doc="structural: executor output marker")
+def _fetch(ctx, ins, attrs):
+    return {"Out": ins.get("X", [])}
+
+
+@register_op("autodiff", doc="structural: vjp boundary (framework/backward.py)")
+def _autodiff(ctx, ins, attrs):
+    raise RuntimeError("autodiff op is expanded by the Executor; "
+                       "it must not be lowered directly")

@@ -1,0 +1,357 @@
+"""Shape/layout/indexing manipulation ops.
+
+Parity: reshape, transpose, concat, split, stack, unstack, squeeze,
+unsqueeze, flatten, expand, expand_as, slice, gather, gather_nd, scatter,
+scatter_nd_add, pad, pad2d, pad_constant_like, crop, reverse, flip,
+multiplex, space_to_depth, unbind, tile, roll, where, masked_select-era
+is_empty, shard_index (/root/reference/paddle/fluid/operators/*.cc).
+All are pure layout ops — XLA folds most of them into surrounding fusions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op, single_input
+
+
+@register_op("reshape")
+def _reshape(ctx, ins, attrs):
+    x = single_input(ins)
+    shape = list(attrs["shape"])
+    # ref reshape semantics: 0 means copy input dim at that position
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": [x.reshape(shape)]}
+
+
+@register_op("reshape2")
+def _reshape2(ctx, ins, attrs):
+    x = single_input(ins)
+    shape = list(attrs["shape"])
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": [x.reshape(shape)],
+            "XShape": [jnp.asarray(x.shape, jnp.int64)]}
+
+
+@register_op("transpose")
+def _transpose(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.transpose(x, attrs["axis"])]}
+
+
+@register_op("transpose2")
+def _transpose2(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.transpose(x, attrs["axis"])],
+            "XShape": [jnp.asarray(x.shape, jnp.int64)]}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=int(attrs.get("axis", 0)))]}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = single_input(ins)
+    axis = int(attrs.get("axis", 0))
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, int(num), axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=int(attrs.get("axis", 0)))]}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    x = single_input(ins)
+    axis = int(attrs.get("axis", 0))
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("unbind")
+def _unbind(ctx, ins, attrs):
+    return _unstack(ctx, ins, attrs)
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs):
+    x = single_input(ins)
+    axes = attrs.get("axes", [])
+    if axes:
+        for ax in sorted((a % x.ndim for a in axes), reverse=True):
+            if x.shape[ax] == 1:
+                x = jnp.squeeze(x, ax)
+    else:
+        x = jnp.squeeze(x)
+    return {"Out": [x]}
+
+
+@register_op("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    orig = single_input(ins)
+    out = _squeeze(ctx, ins, attrs)["Out"]
+    return {"Out": out, "XShape": [jnp.asarray(orig.shape, jnp.int64)]}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    x = single_input(ins)
+    for ax in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, ax)
+    return {"Out": [x]}
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    orig = single_input(ins)
+    out = _unsqueeze(ctx, ins, attrs)["Out"]
+    return {"Out": out, "XShape": [jnp.asarray(orig.shape, jnp.int64)]}
+
+
+@register_op("flatten")
+def _flatten(ctx, ins, attrs):
+    x = single_input(ins)
+    axis = int(attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return {"Out": [x.reshape(lead, -1)]}
+
+
+@register_op("flatten2")
+def _flatten2(ctx, ins, attrs):
+    orig = single_input(ins)
+    out = _flatten(ctx, ins, attrs)["Out"]
+    return {"Out": out, "XShape": [jnp.asarray(orig.shape, jnp.int64)]}
+
+
+@register_op("flatten_contiguous_range")
+def _flatten_range(ctx, ins, attrs):
+    x = single_input(ins)
+    start = int(attrs.get("start_axis", 1)) % x.ndim
+    stop = int(attrs.get("stop_axis", -1)) % x.ndim
+    shape = (x.shape[:start] + (int(np.prod(x.shape[start:stop + 1])),)
+             + x.shape[stop + 1:])
+    return {"Out": [x.reshape(shape)]}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    """ref expand_op.cc: tile each dim by expand_times."""
+    x = single_input(ins)
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("expand_as")
+def _expand_as(ctx, ins, attrs):
+    x = single_input(ins)
+    target = single_input(ins, "target_tensor" if "target_tensor" in ins
+                          else "Y")
+    return {"Out": [jnp.broadcast_to(x, target.shape)]}
+
+
+@register_op("tile")
+def _tile(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.tile(x, attrs["repeat_times"])]}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    """ref slice_op.cc: static begin/end per listed axis."""
+    x = single_input(ins, "Input")
+    axes = attrs["axes"]
+    starts, ends = attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[ax] = slice(s, e)
+    out = x[tuple(idx)]
+    for ax in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, ax)
+    return {"Out": [out]}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = single_input(ins, "Input")
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                            attrs["strides"]):
+        idx[ax] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("gather")
+def _gather(ctx, ins, attrs):
+    x = single_input(ins)
+    idx = single_input(ins, "Index")
+    axis = int(attrs.get("axis", 0))
+    if idx.ndim == 2 and idx.shape[-1] == 1:
+        idx = idx.squeeze(-1)
+    return {"Out": [jnp.take(x, idx.astype(jnp.int32), axis=axis)]}
+
+
+@register_op("gather_nd")
+def _gather_nd(ctx, ins, attrs):
+    x = single_input(ins)
+    idx = single_input(ins, "Index").astype(jnp.int32)
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register_op("scatter")
+def _scatter(ctx, ins, attrs):
+    """ref scatter_op.cc: overwrite (default) or add rows of X at Ids."""
+    x = single_input(ins)
+    ids = single_input(ins, "Ids").astype(jnp.int32)
+    upd = single_input(ins, "Updates")
+    if ids.ndim == 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": [out]}
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(ctx, ins, attrs):
+    x = single_input(ins)
+    idx = single_input(ins, "Index").astype(jnp.int32)
+    upd = single_input(ins, "Updates")
+    return {"Out": [x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)]}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    x = single_input(ins)
+    p = attrs["paddings"]  # [d0_lo, d0_hi, d1_lo, d1_hi, ...]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads, constant_values=attrs.get(
+        "pad_value", 0.0))]}
+
+
+@register_op("pad2d")
+def _pad2d(ctx, ins, attrs):
+    """NCHW spatial pad, modes constant/reflect/edge (ref pad2d_op.cc)."""
+    x = single_input(ins)
+    t, b, l, r = attrs["paddings"]
+    mode = attrs.get("mode", "constant")
+    pads = [(0, 0), (0, 0), (t, b), (l, r)]
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, pads,
+                                constant_values=attrs.get("pad_value", 0.0))]}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": [jnp.pad(x, pads, mode=jmode)]}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("crop")
+def _crop(ctx, ins, attrs):
+    x = single_input(ins)
+    offsets = attrs["offsets"]
+    shape = attrs["shape"]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.flip(x, axis=tuple(attrs["axis"]))]}
+
+
+@register_op("flip")
+def _flip(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.flip(x, axis=tuple(attrs["axis"]))]}
+
+
+@register_op("roll")
+def _roll(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.roll(x, attrs["shifts"],
+                             axis=tuple(attrs.get("axis", [0])))]}
+
+
+@register_op("multiplex")
+def _multiplex(ctx, ins, attrs):
+    """Row-wise select among candidate tensors by Ids (ref multiplex_op.cc)."""
+    ids = single_input(ins, "Ids").astype(jnp.int32).reshape(-1)
+    stacked = jnp.stack(ins["X"], axis=0)  # (K, N, ...)
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": [stacked[ids, rows]]}
+
+
+@register_op("where")
+def _where(ctx, ins, attrs):
+    c = single_input(ins, "Condition")
+    return {"Out": [jnp.where(c, ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("where_index", stop_gradient=True)
+def _where_index(ctx, ins, attrs):
+    """Nonzero indices — needs static size; gated for in-jit use."""
+    c = single_input(ins, "Condition")
+    n = int(np.prod(c.shape))
+    idx = jnp.nonzero(c, size=n, fill_value=-1)
+    return {"Out": [jnp.stack(idx, axis=-1).astype(jnp.int64)]}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    x = single_input(ins)  # NCHW
+    bs = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [x.reshape(n, c * bs * bs, h // bs, w // bs)]}
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    x = single_input(ins)  # NCHW
+    r = int(attrs["upscale_factor"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": [x.reshape(n, c // (r * r), h * r, w * r)]}
+
+
+@register_op("is_empty", stop_gradient=True)
+def _is_empty(ctx, ins, attrs):
+    x = single_input(ins)
+    return {"Out": [jnp.asarray(int(np.prod(x.shape)) == 0)]}
+
+
+@register_op("shard_index", stop_gradient=True)
+def _shard_index(ctx, ins, attrs):
+    """Map global ids to shard-local ids (ref shard_index_op.cc) — the
+    building block for sharded embedding lookups."""
+    x = single_input(ins)
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = int(attrs.get("ignore_value", -1))
+    size = (index_num + nshards - 1) // nshards
+    in_shard = (x // size) == shard_id
+    return {"Out": [jnp.where(in_shard, x % size, ignore)]}

@@ -1,0 +1,428 @@
+"""Optimizers — program rewriters appending update ops.
+
+Capability parity with /root/reference/python/paddle/fluid/optimizer.py
+(Optimizer:43, minimize:294 = append_backward + _create_optimization_pass;
+SGD:326, Momentum:372, LarsMomentum:456, Adagrad:541, Adam:616, Adamax,
+DecayedAdagrad, Adadelta, RMSProp, Ftrl, ModelAverage:1373) and
+regularizer/clip application.
+
+The update stays IN the program as ops (ops/optimizer_ops.py); accumulators
+(moments, beta pows) are persistable vars initialised in the startup
+program — exactly the reference's _add_accumulator contract.  The whole
+(forward + vjp + updates) program compiles to one XLA executable with
+donated param buffers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.initializer import ConstantInitializer
+from .framework.program import (Parameter, Program, Variable,
+                                default_main_program,
+                                default_startup_program, grad_var_name)
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._lr_input = learning_rate
+        self.regularization = regularization
+        self._name = name or unique_name.generate(type(self).__name__)
+        self._accumulators: Dict[str, Dict[str, str]] = {}
+        self._lr_var: Optional[Variable] = None
+
+    # -- learning rate -----------------------------------------------------
+    def _create_lr_var(self, program: Program) -> Variable:
+        if isinstance(self._lr_input, Variable):
+            return self._lr_input
+        block = program.global_block()
+        name = self._name + ".lr"
+        if block.has_var(name):
+            return block.var(name)
+        lr = block.create_var(name=name, shape=[1], dtype="float32",
+                              persistable=True, stop_gradient=True)
+        sb = default_startup_program().global_block()
+        if not sb.has_var(name):
+            sb.create_var(name=name, shape=[1], dtype="float32",
+                          persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": [name]},
+                         attrs={"shape": [1], "dtype": "float32",
+                                "value": float(self._lr_input)})
+        return lr
+
+    # -- accumulators (ref optimizer.py _add_accumulator) ------------------
+    def _add_accumulator(self, name: str, param: Parameter, block,
+                         fill_value=0.0, shape=None, dtype=None) -> str:
+        acc_name = f"{self._name}.{param.name}.{name}"
+        self._accumulators.setdefault(name, {})[param.name] = acc_name
+        if block.has_var(acc_name):
+            return acc_name
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or param.dtype
+        block.create_var(name=acc_name, shape=shape, dtype=dtype,
+                         persistable=True, stop_gradient=True)
+        sb = default_startup_program().global_block()
+        if not sb.has_var(acc_name):
+            sb.create_var(name=acc_name, shape=shape, dtype=dtype,
+                          persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": [acc_name]},
+                         attrs={"shape": shape, "dtype": dtype,
+                                "value": float(fill_value)})
+        return acc_name
+
+    # -- the per-param update op (subclass hook) ---------------------------
+    def _append_optimize_op(self, block, param: Parameter, grad_name: str,
+                            lr_name: str):
+        raise NotImplementedError
+
+    def _create_accumulators(self, block, params: List[Parameter]):
+        pass
+
+    # -- minimize (ref optimizer.py:294) -----------------------------------
+    def minimize(self, loss: Variable, startup_program=None,
+                 parameter_list=None, no_grad_set=None
+                 ) -> Tuple[None, List[Tuple[Parameter, Variable]]]:
+        from .clip import append_gradient_clip_ops
+        program = loss.block.program
+        param_grads = append_backward(loss, parameter_list, no_grad_set)
+        block = program.global_block()
+        append_gradient_clip_ops(program, param_grads)
+        lr = self._create_lr_var(program)
+        self._create_accumulators(block, [p for p, _ in param_grads])
+        for param, grad in param_grads:
+            reg = param.regularizer or self.regularization
+            if reg is not None:
+                reg.append_regularization_op(param, grad.name, block)
+            # per-param lr scaling (ParamAttr.learning_rate)
+            lr_name = lr.name
+            plr = getattr(param, "optimize_attr",
+                          {"learning_rate": 1.0})["learning_rate"]
+            if plr != 1.0:
+                scaled = f"{self._name}.{param.name}.lr"
+                if not block.has_var(scaled):
+                    block.create_var(name=scaled, shape=[1],
+                                     dtype="float32", stop_gradient=True)
+                block.append_op("scale", {"X": [lr.name]},
+                                {"Out": [scaled]}, {"scale": float(plr)})
+                lr_name = scaled
+            self._append_optimize_op(block, param, grad.name, lr_name)
+        return None, param_grads
+
+
+class SGD(Optimizer):
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        block.append_op("sgd",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name]}, {})
+
+
+SGDOptimizer = SGD
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("velocity", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        vel = self._accumulators["velocity"][param.name]
+        block.append_op("momentum",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Velocity": [vel], "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "VelocityOut": [vel]},
+                        {"mu": self._momentum,
+                         "use_nesterov": self._use_nesterov})
+
+
+MomentumOptimizer = Momentum
+
+
+class LarsMomentum(Optimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=1e-3,
+                 lars_weight_decay=5e-4, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("velocity", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        vel = self._accumulators["velocity"][param.name]
+        block.append_op("lars_momentum",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Velocity": [vel], "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "VelocityOut": [vel]},
+                        {"mu": self._momentum,
+                         "lars_coeff": self._lars_coeff,
+                         "lars_weight_decay": self._lars_weight_decay})
+
+
+LarsMomentumOptimizer = LarsMomentum
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment1", p, block)
+            self._add_accumulator("moment2", p, block)
+            self._add_accumulator("beta1_pow", p, block,
+                                  fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, block,
+                                  fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        a = self._accumulators
+        m1 = a["moment1"][param.name]
+        m2 = a["moment2"][param.name]
+        b1 = a["beta1_pow"][param.name]
+        b2 = a["beta2_pow"][param.name]
+        block.append_op("adam",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Moment1": [m1], "Moment2": [m2],
+                         "Beta1Pow": [b1], "Beta2Pow": [b2],
+                         "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "Moment1Out": [m1],
+                         "Moment2Out": [m2], "Beta1PowOut": [b1],
+                         "Beta2PowOut": [b2]},
+                        {"beta1": self._beta1, "beta2": self._beta2,
+                         "epsilon": self._epsilon})
+
+
+AdamOptimizer = Adam
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        a = self._accumulators
+        m1 = a["moment1"][param.name]
+        m2 = a["moment2"][param.name]
+        b1 = a["beta1_pow"][param.name]
+        b2 = a["beta2_pow"][param.name]
+        block.append_op("adamw",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Moment1": [m1], "Moment2": [m2],
+                         "Beta1Pow": [b1], "Beta2Pow": [b2],
+                         "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "Moment1Out": [m1],
+                         "Moment2Out": [m2], "Beta1PowOut": [b1],
+                         "Beta2PowOut": [b2]},
+                        {"beta1": self._beta1, "beta2": self._beta2,
+                         "epsilon": self._epsilon, "coeff": self._coeff})
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p, block)
+            self._add_accumulator("inf_norm", p, block)
+            self._add_accumulator("beta1_pow", p, block,
+                                  fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        a = self._accumulators
+        m = a["moment"][param.name]
+        inf = a["inf_norm"][param.name]
+        b1 = a["beta1_pow"][param.name]
+        block.append_op("adamax",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Moment": [m], "InfNorm": [inf], "Beta1Pow": [b1],
+                         "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "MomentOut": [m],
+                         "InfNormOut": [inf], "Beta1PowOut": [b1]},
+                        {"beta1": self._beta1, "beta2": self._beta2,
+                         "epsilon": self._epsilon})
+
+
+AdamaxOptimizer = Adamax
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        m = self._accumulators["moment"][param.name]
+        block.append_op("adagrad",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Moment": [m], "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "MomentOut": [m]},
+                        {"epsilon": self._epsilon})
+
+
+AdagradOptimizer = Adagrad
+
+
+class DecayedAdagrad(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        m = self._accumulators["moment"][param.name]
+        block.append_op("decayed_adagrad",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Moment": [m], "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "MomentOut": [m]},
+                        {"decay": self._decay, "epsilon": self._epsilon})
+
+
+DecayedAdagradOptimizer = DecayedAdagrad
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("avg_squared_grad", p, block)
+            self._add_accumulator("avg_squared_update", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        a = self._accumulators
+        g = a["avg_squared_grad"][param.name]
+        u = a["avg_squared_update"][param.name]
+        block.append_op("adadelta",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "AvgSquaredGrad": [g], "AvgSquaredUpdate": [u]},
+                        {"ParamOut": [param.name], "AvgSquaredGradOut": [g],
+                         "AvgSquaredUpdateOut": [u]},
+                        {"rho": self._rho, "epsilon": self._epsilon})
+
+
+AdadeltaOptimizer = Adadelta
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("mean_square", p, block)
+            self._add_accumulator("moment", p, block)
+            if self._centered:
+                self._add_accumulator("mean_grad", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        a = self._accumulators
+        ms = a["mean_square"][param.name]
+        m = a["moment"][param.name]
+        ins = {"Param": [param.name], "Grad": [grad_name],
+               "MeanSquare": [ms], "Moment": [m], "LearningRate": [lr_name]}
+        outs = {"ParamOut": [param.name], "MeanSquareOut": [ms],
+                "MomentOut": [m]}
+        if self._centered:
+            mg = a["mean_grad"][param.name]
+            ins["MeanGrad"] = [mg]
+            outs["MeanGradOut"] = [mg]
+        block.append_op("rmsprop", ins, outs,
+                        {"decay": self._rho, "epsilon": self._epsilon,
+                         "momentum": self._momentum,
+                         "centered": self._centered})
+
+
+RMSPropOptimizer = RMSProp
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("squared", p, block)
+            self._add_accumulator("linear", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        a = self._accumulators
+        sq = a["squared"][param.name]
+        lin = a["linear"][param.name]
+        block.append_op("ftrl",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "SquaredAccumulator": [sq],
+                         "LinearAccumulator": [lin],
+                         "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "SquaredAccumOut": [sq],
+                         "LinearAccumOut": [lin]},
+                        {"l1": self._l1, "l2": self._l2,
+                         "lr_power": self._lr_power})
+
+
+FtrlOptimizer = Ftrl
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment1", p, block)
+            self._add_accumulator("moment2", p, block)
+            self._add_accumulator("beta1_pow", p, block,
+                                  fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, block,
+                                  fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        a = self._accumulators
+        block.append_op("lamb",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Moment1": [a["moment1"][param.name]],
+                         "Moment2": [a["moment2"][param.name]],
+                         "Beta1Pow": [a["beta1_pow"][param.name]],
+                         "Beta2Pow": [a["beta2_pow"][param.name]],
+                         "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name],
+                         "Moment1Out": [a["moment1"][param.name]],
+                         "Moment2Out": [a["moment2"][param.name]],
+                         "Beta1PowOut": [a["beta1_pow"][param.name]],
+                         "Beta2PowOut": [a["beta2_pow"][param.name]]},
+                        {"beta1": self._beta1, "beta2": self._beta2,
+                         "epsilon": self._epsilon,
+                         "weight_decay": self._wd})
+
+
+LambOptimizer = Lamb

@@ -1,0 +1,3 @@
+from .parallel_executor import (ParallelExecutor, ExecutionStrategy,
+                                BuildStrategy)  # noqa: F401
+from .env import init_distributed_env, get_world_info  # noqa: F401

@@ -1,0 +1,47 @@
+"""Multi-host distributed environment bootstrap.
+
+Capability parity with the reference's multi-node rendezvous:
+gen_nccl_id_op (rank0 ncclGetUniqueId RPC-broadcast,
+/root/reference/paddle/fluid/operators/distributed_ops/gen_nccl_id_op.cc:31)
+and the PADDLE_TRAINER_* env-var topology plane
+(benchmark/fluid/README.md:35-47, contrib/trainer.py role parsing).
+
+TPU-native: jax.distributed.initialize handles the rendezvous through the
+coordinator; afterwards jax.devices() spans all hosts and meshes laid out
+over it put the batch axis on DCN between hosts and ICI within a host.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+
+
+def get_world_info() -> Tuple[int, int, Optional[str]]:
+    """(trainer_id, num_trainers, coordinator) from PADDLE_*-compatible or
+    PTPU_* env vars."""
+    rank = int(os.environ.get("PTPU_TRAINER_ID",
+                              os.environ.get("PADDLE_TRAINER_ID", "0")))
+    world = int(os.environ.get("PTPU_TRAINERS_NUM",
+                               os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+    endpoint = os.environ.get(
+        "PTPU_COORDINATOR",
+        os.environ.get("PADDLE_CURRENT_ENDPOINT"))
+    return rank, world, endpoint
+
+
+def init_distributed_env(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None):
+    """Replaces the gen_nccl_id handshake.  No-op for single-host."""
+    rank, world, endpoint = get_world_info()
+    coordinator_address = coordinator_address or endpoint
+    num_processes = num_processes or world
+    process_id = process_id if process_id is not None else rank
+    if num_processes <= 1 or coordinator_address is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True

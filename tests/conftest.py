@@ -1,0 +1,42 @@
+"""Test config: force an 8-device virtual CPU platform.
+
+This validates multi-chip sharding logic without TPU hardware (the
+reference's analogue: CPU_NUM faking multi-device,
+python/paddle/fluid/parallel_executor.py, and test_dist_base.py).
+
+Environment quirks of this image (documented for future sessions):
+  * sitecustomize imports the axon TPU plugin AND jax._src.config at
+    interpreter startup, so JAX_PLATFORMS env changes made here are too
+    late — but backends initialize lazily, so jax.config.update still
+    works as long as it runs before the first jax.devices()/jit call.
+  * XLA_FLAGS is read by the CPU client at backend init, which has not
+    happened yet when conftest runs, so the env write below is effective.
+  * Setting PYTHONPATH (to anything) breaks axon plugin discovery — never
+    set it; run pytest from the repo root instead.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs + a fresh scope."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import executor as executor_mod
+    pt.reset_default_programs()
+    executor_mod._global_scope = executor_mod.Scope()
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    from paddle_tpu.core.place import make_mesh
+    assert len(jax.devices()) >= 8, "tests require 8 virtual CPU devices"
+    return make_mesh((8,), ("data",))

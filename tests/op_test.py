@@ -1,0 +1,152 @@
+"""OpTest harness: the framework's per-op test contract.
+
+Mirrors /root/reference/python/paddle/fluid/tests/unittests/op_test.py:132:
+a test sets op_type/inputs/outputs/attrs; check_output runs the single op
+through a scratch Program+Executor and compares against the numpy
+reference; check_grad compares analytic (vjp) gradients against numeric
+finite differences (ref get_numeric_gradient in testsuite.py) — keeping
+exactly the reference's validation contract.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.program import grad_var_name
+
+
+class OpTest:
+    op_type: str = ""
+
+    def setup(self):
+        """Subclasses set self.inputs / self.outputs / self.attrs here."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def _build(self, extra_fetch: Sequence[str] = ()):
+        self.attrs = getattr(self, "attrs", {})
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            block = main.global_block()
+            in_slots, feeds = {}, {}
+            for slot, val in self.inputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = []
+                for i, arr in enumerate(vals):
+                    name = f"{slot}_{i}"
+                    arr = np.asarray(arr)
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=str(arr.dtype), is_data=True)
+                    feeds[name] = arr
+                    names.append(name)
+                in_slots[slot] = names
+            out_slots = {}
+            for slot, val in self.outputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = []
+                for i, _ in enumerate(vals):
+                    name = f"out_{slot}_{i}"
+                    block.create_var(name=name, dtype="float32")
+                    names.append(name)
+                out_slots[slot] = names
+            block.append_op(self.op_type, in_slots, out_slots, self.attrs)
+        return main, feeds, out_slots
+
+    def check_output(self, atol=1e-5, rtol=1e-5, place=None):
+        self.setup()
+        main, feeds, out_slots = self._build()
+        exe = pt.Executor(place or pt.CPUPlace())
+        fetch_names, expected = [], []
+        for slot, val in self.outputs.items():
+            vals = val if isinstance(val, list) else [val]
+            for name, arr in zip(out_slots[slot], vals):
+                if arr is None:
+                    continue
+                fetch_names.append(name)
+                expected.append(np.asarray(arr))
+        got = exe.run(main, feed=feeds, fetch_list=fetch_names)
+        for name, e, g in zip(fetch_names, expected, got):
+            np.testing.assert_allclose(
+                np.asarray(g, dtype=np.float64),
+                np.asarray(e, dtype=np.float64),
+                atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type}:{name} mismatch")
+
+    # ------------------------------------------------------------------
+    def check_grad(self, inputs_to_check: Sequence[str], output_name: str,
+                   max_relative_error=0.005, delta=5e-3, place=None,
+                   no_grad_set=None):
+        """Finite-difference vs analytic gradients of sum(output) w.r.t.
+        each checked input (reference semantics: scalar loss = mean? ref
+        uses sum via output@GRAD of ones — we use sum)."""
+        self.setup()
+        for slot in inputs_to_check:
+            analytic = self._analytic_grad(slot, output_name, place)
+            numeric = self._numeric_grad(slot, output_name, delta, place)
+            abs_max = max(np.abs(numeric).max(), np.abs(analytic).max(),
+                          1e-3)
+            diff = np.abs(analytic - numeric).max() / abs_max
+            assert diff <= max_relative_error, (
+                f"{self.op_type} grad wrt {slot}: rel err {diff:.4g} > "
+                f"{max_relative_error} (analytic {analytic.ravel()[:5]}, "
+                f"numeric {numeric.ravel()[:5]})")
+
+    def _scalarize(self, main, out_name):
+        """loss = sum(out^2): nonzero grads even for outputs with constant
+        sum (softmax rows); both analytic and numeric paths share it."""
+        block = main.global_block()
+        block.create_var(name="sq__", dtype="float32")
+        block.append_op("square", {"X": [out_name]}, {"Out": ["sq__"]}, {})
+        block.create_var(name="loss__", dtype="float32")
+        block.append_op("reduce_sum", {"X": ["sq__"]},
+                        {"Out": ["loss__"]}, {"reduce_all": True})
+        return "loss__"
+
+    def _analytic_grad(self, slot, output_name, place):
+        main, feeds, out_slots = self._build()
+        block = main.global_block()
+        # promote the checked input to a Parameter so append_backward sees it
+        in_name = f"{slot}_0"
+        v = block.vars[in_name]
+        from paddle_tpu.framework.program import Parameter
+        p = Parameter(block, in_name, shape=v.shape, dtype=v.dtype)
+        block.vars[in_name] = p
+        out_name = out_slots[output_name][0]
+        loss_name = self._scalarize(main, out_name)
+        with pt.program_guard(main):
+            pt.append_backward(block.var(loss_name), parameter_list=[p])
+        exe = pt.Executor(place or pt.CPUPlace())
+        feed = dict(feeds)
+        param_val = feed.pop(in_name)
+        exe.scope.set_var(in_name, param_val)
+        g, = exe.run(main, feed=feed,
+                     fetch_list=[grad_var_name(in_name)])
+        return np.asarray(g, np.float64)
+
+    def _numeric_grad(self, slot, output_name, delta, place):
+        main, feeds, out_slots = self._build()
+        out_name = out_slots[output_name][0]
+        loss_name = self._scalarize(main, out_name)
+        exe = pt.Executor(place or pt.CPUPlace())
+        in_name = f"{slot}_0"
+        base = np.asarray(feeds[in_name], np.float64)
+        grad = np.zeros_like(base, np.float64)
+        flat = base.ravel()
+        gflat = grad.ravel()
+
+        def run_with(x):
+            f = dict(feeds)
+            f[in_name] = x.reshape(base.shape).astype(feeds[in_name].dtype)
+            out, = exe.run(main, feed=f, fetch_list=[loss_name])
+            return float(np.asarray(out, np.float64))
+
+        for i in range(flat.size):
+            x = flat.copy()
+            x[i] += delta
+            fp = run_with(x)
+            x[i] -= 2 * delta
+            fm = run_with(x)
+            gflat[i] = (fp - fm) / (2 * delta)
+        return grad

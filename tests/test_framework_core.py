@@ -1,0 +1,215 @@
+"""Core substrate tests: program IR, executor, autodiff, optimizer.
+
+Mirrors the reference's C++-unit tier (framework/*_test.cc) + the
+fit_a_line book test (python/paddle/fluid/tests/book/test_fit_a_line.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_program_build_and_serialize():
+    main = pt.Program()
+    startup = pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[13])
+        y = layers.fc(x, size=1)
+    assert main.global_block().has_var(y.name)
+    # one mul + one elementwise_add
+    types = [op.type for op in main.global_block().ops]
+    assert "mul" in types and "elementwise_add" in types
+    # round-trip
+    s = main.serialize_to_string()
+    clone = pt.Program.parse_from_string(s)
+    assert [op.type for op in clone.global_block().ops] == types
+    assert clone.global_block().var(y.name).dtype == "float32"
+
+
+def test_shape_inference_through_layers():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 32, 32])
+        c = layers.conv2d(x, num_filters=8, filter_size=3, padding=1)
+        p = layers.pool2d(c, pool_size=2, pool_stride=2)
+        f = layers.fc(p, size=10)
+    assert tuple(c.shape) == (-1, 8, 32, 32)
+    assert tuple(p.shape) == (-1, 8, 16, 16)
+    assert tuple(f.shape) == (-1, 10)
+
+
+def test_executor_fill_and_fetch():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = layers.fill_constant([2, 3], "float32", 5.0)
+        b = layers.scale(a, scale=2.0, bias=1.0)
+    exe = pt.Executor(pt.CPUPlace())
+    b_val, = exe.run(main, fetch_list=[b])
+    np.testing.assert_allclose(b_val, np.full((2, 3), 11.0))
+
+
+def test_startup_initializes_params():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.fc(x, size=8)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    params = main.all_parameters()
+    assert len(params) == 2  # w + b
+    for p in params:
+        val = exe.scope.find_var(p.name)
+        assert val is not None and tuple(val.shape) == tuple(p.shape)
+
+
+def test_linear_regression_converges():
+    """fit_a_line capability: loss must decrease under SGD."""
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 42
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = pt.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for i in range(60):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = xb @ w_true
+        lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, losses[::10]
+
+
+def test_adam_converges():
+    rng = np.random.RandomState(1)
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 7
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    w = rng.randn(4, 1).astype(np.float32)
+    first = last = None
+    for i in range(80):
+        xb = rng.randn(32, 4).astype(np.float32)
+        yb = np.tanh(xb @ w).astype(np.float32)
+        lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert last < first * 0.5
+
+
+def test_grad_vars_materialize():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[3])
+        y = layers.fc(x, size=2)
+        loss = layers.mean(y)
+        params = main.all_parameters()
+        grads = pt.append_backward(loss)
+    assert len(grads) == 2
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    w = params[0]
+    g, = exe.run(main, feed={"x": np.ones((5, 3), np.float32)},
+                 fetch_list=[w.name + "@GRAD"])
+    assert g.shape == tuple(w.shape)
+    # d(mean(xW+b))/dW = x_mean / 2 outputs
+    np.testing.assert_allclose(g, np.full(g.shape, 0.5), atol=1e-6)
+
+
+def test_program_clone_for_test_flips_is_test():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        d = layers.dropout(x, dropout_prob=0.5)
+    test_prog = main.clone(for_test=True)
+    ops = [op for op in test_prog.global_block().ops
+           if op.type == "dropout"]
+    assert ops and ops[0].attrs["is_test"] is True
+
+
+def test_prune_slices_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    pruned = main.prune(["x"], [pred.name])
+    types = [op.type for op in pruned.global_block().ops]
+    assert "square_error_cost" not in types and "mean" not in types
+    assert "mul" in types
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        pred = layers.fc(x, size=3)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xb = np.ones((2, 4), np.float32)
+    out1, = exe.run(main, feed={"x": xb}, fetch_list=[pred])
+    pt.io.save_persistables(exe, str(tmp_path), main_program=main)
+    # clobber params, reload, outputs must match
+    scope2 = pt.Scope()
+    exe2 = pt.Executor(pt.CPUPlace(), scope=scope2)
+    pt.io.load_persistables(exe2, str(tmp_path), main_program=main)
+    out2, = exe2.run(main, feed={"x": xb}, fetch_list=[pred])
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1, act="tanh")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xb = np.random.RandomState(3).randn(5, 4).astype(np.float32)
+    # one training step, then reference output via an inference-only slice
+    exe.run(main, feed={"x": xb, "y": np.zeros((5, 1), np.float32)},
+            fetch_list=[loss])
+    infer_prog = main.prune(["x"], [pred.name])
+    ref, = exe.run(infer_prog, feed={"x": xb}, fetch_list=[pred.name])
+    pt.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                               main_program=main)
+    scope2 = pt.Scope()
+    exe2 = pt.Executor(pt.CPUPlace(), scope=scope2)
+    prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path), exe2)
+    out, = exe2.run(prog, feed={"x": xb}, fetch_list=fetches)
+    np.testing.assert_allclose(ref, out, rtol=1e-5)
+
+
+def test_regularizer_and_clip():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = pt.optimizer.SGD(
+            0.1, regularization=pt.regularizer.L2Decay(0.01))
+        opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    lv, = exe.run(main, feed={"x": np.ones((4, 4), np.float32),
+                              "y": np.ones((4, 1), np.float32)},
+                  fetch_list=[loss])
+    assert np.isfinite(lv).all()

@@ -1,0 +1,284 @@
+"""Op tests: math/elementwise/reduce families (numpy-checked + finite-diff
+grads, mirroring the reference's test_elementwise_add_op.py etc.)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.randn(5, 3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMulFlatten(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        y = rng.randn(12, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(2, 12) @ y)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        y = rng.randn(3, 6, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_Y": True}
+        self.outputs = {"Out": x @ y.transpose(0, 2, 1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        y = rng.randn(3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def setup(self):
+        x = rng.rand(4, 5).astype(np.float32) + 0.5
+        y = rng.rand(4, 5).astype(np.float32) + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setup(self):
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    op_type = "reduce_mean"
+
+    def setup(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.mean())}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setup(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSumNary(OpTest):
+    op_type = "sum"
+
+    def setup(self):
+        xs = [rng.randn(3, 4).astype(np.float32) for _ in range(3)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+
+    def test(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        x = rng.randn(4, 7).astype(np.float32)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup(self):
+        x = rng.randn(4, 10).astype(np.float32)
+        k = 3
+        idx = np.argsort(-x, axis=1)[:, :k]
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": np.take_along_axis(x, idx, 1),
+                        "Indices": idx.astype(np.int64)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup(self):
+        xs = [rng.randn(2, i + 2).astype(np.float32) for i in range(3)]
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate(xs, axis=1)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose"
+
+    def setup(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 2, 0]}
+        self.outputs = {"Out": x.transpose(1, 2, 0)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReshape(OpTest):
+    op_type = "reshape"
+
+    def setup(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, -1]}
+        self.outputs = {"Out": x.reshape(2, 12)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def setup(self):
+        x = rng.randn(10, 4).astype(np.float32)
+        idx = np.array([1, 3, 5], np.int64)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestActivations:
+    cases = {
+        "relu": lambda x: np.maximum(x, 0),
+        "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+        "tanh": np.tanh,
+        "exp": np.exp,
+        "square": np.square,
+        "softplus": lambda x: np.log1p(np.exp(x)),
+        "leaky_relu": lambda x: np.where(x > 0, x, 0.02 * x),
+        "gelu": lambda x: x * 0.5 * (1 + np.vectorize(
+            lambda v: float(__import__("math").erf(v / np.sqrt(2))))(x)),
+    }
+
+    @pytest.mark.parametrize("name", sorted(cases))
+    def test_forward(self, name):
+        class T(OpTest):
+            op_type = name
+
+            def setup(self):
+                x = rng.randn(3, 4).astype(np.float32)
+                self.inputs = {"X": x}
+                self.outputs = {"Out": TestActivations.cases[name](x)}
+        t = T()
+        t.check_output(atol=1e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("name", ["relu", "sigmoid", "tanh", "square"])
+    def test_grad(self, name):
+        class T(OpTest):
+            op_type = name
+
+            def setup(self):
+                # keep away from relu kink
+                x = rng.randn(3, 4).astype(np.float32)
+                x = np.where(np.abs(x) < 0.1, 0.5, x)
+                self.inputs = {"X": x}
+                self.outputs = {"Out": TestActivations.cases[name](x)}
+        T().check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def setup(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype(np.int32)}
+
+    def test(self):
+        self.check_output()
+
+
+class TestClipByNorm(OpTest):
+    op_type = "clip_by_norm"
+
+    def setup(self):
+        x = rng.randn(4, 4).astype(np.float32) * 10
+        norm = np.sqrt((x ** 2).sum())
+        self.inputs = {"X": x}
+        self.attrs = {"max_norm": 1.0}
+        self.outputs = {"Out": x / norm if norm > 1 else x}
+
+    def test(self):
+        self.check_output()
