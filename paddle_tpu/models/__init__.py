@@ -1,0 +1,9 @@
+"""Model zoo: the five BASELINE.json configs (+ extras), built on the layers
+DSL so every model is a serializable Program that compiles to one XLA
+executable."""
+from . import lenet
+from . import resnet
+from . import vgg
+from . import transformer
+from . import deepfm
+from . import bert

@@ -1,0 +1,88 @@
+"""DeepFM CTR — BASELINE config 4 (high-dim sparse embedding).
+
+Capability parity with the reference's CTR models
+(/root/reference/python/paddle/fluid/tests/unittests/dist_ctr.py and the
+distributed-lookup-table path, transpiler/distribute_transpiler.py:1010) —
+the pserver-sharded embedding becomes a Mesh-sharded in-HBM table: the
+embedding Parameter carries a PartitionSpec that row-shards it over the
+'model' axis, and XLA turns the lookup into all-gather/collective ops
+(see parallel/sharded_embedding.py for the shard_map path).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..framework.layer_helper import ParamAttr
+
+
+class DeepFMConfig:
+    def __init__(self, num_field=39, vocab_size=1000001, embed_dim=10,
+                 fc_sizes=(400, 400, 400), sparse_shard_axis=None):
+        self.num_field = num_field
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.fc_sizes = tuple(fc_sizes)
+        # PartitionSpec axis name to row-shard the big tables over (e.g.
+        # "model"); None = replicated.
+        self.sparse_shard_axis = sparse_shard_axis
+
+
+def deepfm(feat_ids, feat_vals, cfg: DeepFMConfig):
+    """feat_ids [B,F] int64, feat_vals [B,F] float32 -> p(click) [B,1].
+
+    FM first-order + second-order + deep MLP (DeepFM, Guo et al. 2017);
+    same capability class as the reference CTR example but one dense graph.
+    """
+    shard = ((cfg.sparse_shard_axis, None)
+             if cfg.sparse_shard_axis else None)
+    # first-order weights: [V,1] table
+    w1 = layers.embedding(
+        feat_ids, size=[cfg.vocab_size, 1],
+        param_attr=ParamAttr(name="fm_w1", sharding=shard))      # [B,F,1]
+    first_order = layers.reduce_sum(
+        layers.elementwise_mul(layers.squeeze(w1, [2]), feat_vals),
+        dim=[1], keep_dim=True)                                   # [B,1]
+
+    # second-order: embeddings [V,K]
+    emb = layers.embedding(
+        feat_ids, size=[cfg.vocab_size, cfg.embed_dim],
+        param_attr=ParamAttr(name="fm_emb", sharding=shard))      # [B,F,K]
+    vals = layers.unsqueeze(feat_vals, [2])                       # [B,F,1]
+    xv = layers.elementwise_mul(emb, vals)                        # [B,F,K]
+    sum_sq = layers.square(layers.reduce_sum(xv, dim=[1]))        # [B,K]
+    sq_sum = layers.reduce_sum(layers.square(xv), dim=[1])        # [B,K]
+    second_order = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum),
+                          dim=[1], keep_dim=True), scale=0.5)     # [B,1]
+
+    # deep part
+    deep = layers.reshape(xv, [-1, cfg.num_field * cfg.embed_dim])
+    for size in cfg.fc_sizes:
+        deep = layers.fc(deep, size=size, act="relu")
+    deep_out = layers.fc(deep, size=1, act=None)                  # [B,1]
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(first_order, second_order), deep_out)
+    return logit
+
+
+def build_train_net(cfg: DeepFMConfig):
+    feat_ids = layers.data("feat_ids", [cfg.num_field], dtype="int64")
+    feat_vals = layers.data("feat_vals", [cfg.num_field], dtype="float32")
+    label = layers.data("label", [1], dtype="float32")
+    logit = deepfm(feat_ids, feat_vals, cfg)
+    cost = layers.sigmoid_cross_entropy_with_logits(logit, label)
+    avg_cost = layers.mean(cost)
+    prob = layers.sigmoid(logit)
+    return [feat_ids, feat_vals, label], avg_cost, prob
+
+
+def make_fake_batch(cfg: DeepFMConfig, batch_size: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {
+        "feat_ids": rng.randint(0, cfg.vocab_size,
+                                (batch_size, cfg.num_field)).astype("int64"),
+        "feat_vals": rng.rand(batch_size, cfg.num_field).astype("float32"),
+        "label": rng.randint(0, 2, (batch_size, 1)).astype("float32"),
+    }

@@ -1,0 +1,44 @@
+"""MNIST LeNet — BASELINE config 1.
+
+Capability parity with the reference book example
+(/root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py:
+`convolutional_neural_network`), built on the paddle_tpu layers DSL and
+compiled as one XLA program by the Executor.
+"""
+from __future__ import annotations
+
+from .. import layers, nets
+
+
+def lenet(images, num_classes: int = 10):
+    """conv5x5x20-pool2 -> conv5x5x50-pool2 (+BN) -> fc10 softmax."""
+    conv_pool_1 = nets.simple_img_conv_pool(
+        input=images, filter_size=5, num_filters=20,
+        pool_size=2, pool_stride=2, act="relu")
+    conv_pool_1 = layers.batch_norm(conv_pool_1)
+    conv_pool_2 = nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=50,
+        pool_size=2, pool_stride=2, act="relu")
+    return layers.fc(conv_pool_2, size=num_classes, act="softmax")
+
+
+def softmax_regression(images, num_classes: int = 10):
+    """ref book test_recognize_digits.py softmax_regression."""
+    return layers.fc(images, size=num_classes, act="softmax")
+
+
+def multilayer_perceptron(images, num_classes: int = 10):
+    h1 = layers.fc(images, size=200, act="tanh")
+    h2 = layers.fc(h1, size=200, act="tanh")
+    return layers.fc(h2, size=num_classes, act="softmax")
+
+
+def build_train_net(net_fn=lenet, img_shape=(1, 28, 28)):
+    """Builds (feeds, avg_loss, acc, prediction) in the default program."""
+    images = layers.data("img", list(img_shape), dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    prediction = net_fn(images)
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_loss = layers.mean(cost)
+    acc = layers.accuracy(input=prediction, label=label)
+    return [images, label], avg_loss, acc, prediction

@@ -1,0 +1,257 @@
+"""Transformer-base NMT — BASELINE config 3 (WMT en-de class model).
+
+Capability parity with the reference's transformer configs
+(/root/reference/python/paddle/fluid/tests/unittests/dist_transformer.py and
+benchmark/fluid/models/machine_translation.py), re-designed TPU-first:
+
+  * dense static shapes (bucketed padding + additive attention bias) instead
+    of LoD ragged batches — see SURVEY.md "hard parts (a)";
+  * attention is expressed as batched matmuls that XLA tiles onto the MXU;
+    the fused Pallas flash-attention kernel (kernels/flash_attention.py) is
+    used by the executor when FLAGS_use_pallas_attention is on;
+  * the same graph shards over a Mesh for dp/tp/sp without change — the
+    Parameter.sharding PartitionSpecs carry the layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..framework.initializer import NumpyArrayInitializer
+from ..framework.layer_helper import ParamAttr
+
+
+def position_encoding_table(max_len: int, d_model: int) -> np.ndarray:
+    """Sinusoid table (ref dist_transformer.py position_encoding_init)."""
+    pos = np.arange(max_len)[:, None].astype("float64")
+    div = np.power(10000.0, 2 * (np.arange(d_model) // 2) / d_model)[None, :]
+    ang = pos / div
+    table = np.zeros((max_len, d_model), dtype="float32")
+    table[:, 0::2] = np.sin(ang[:, 0::2])
+    table[:, 1::2] = np.cos(ang[:, 1::2])
+    return table
+
+
+def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
+                         d_model, n_head=1, dropout_rate=0.0):
+    """ref dist_transformer.py multi_head_attention — q/k/v projections,
+    split heads, scaled-dot-product with additive bias, combine, out-proj."""
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+
+    q = layers.fc(queries, size=d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+    k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+    v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+
+    def split_heads(x, d):
+        # [B,T,nh*d] -> [B,nh,T,d]
+        y = layers.reshape(x, [0, 0, n_head, d])
+        return layers.transpose(y, [0, 2, 1, 3])
+
+    q, k, v = split_heads(q, d_key), split_heads(k, d_key), split_heads(
+        v, d_value)
+
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=float(d_key) ** -0.5)
+    if attn_bias is not None:
+        scores = layers.elementwise_add(scores, attn_bias)
+    weights = layers.softmax(scores)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_rate,
+                                 dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(weights, v)                     # [B,nh,T,dv]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, n_head * d_value])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def positionwise_ffn(x, d_inner, d_model, dropout_rate=0.0):
+    hidden = layers.fc(x, size=d_inner, num_flatten_dims=2, act="relu")
+    if dropout_rate:
+        hidden = layers.dropout(hidden, dropout_rate,
+                                dropout_implementation="upscale_in_train")
+    return layers.fc(hidden, size=d_model, num_flatten_dims=2)
+
+
+def pre_post_process(prev_out, out, cmd, dropout_rate=0.0):
+    """ref dist_transformer.py pre_post_process_layer: a=add, n=norm, d=drop."""
+    for c in cmd:
+        if c == "a":
+            out = layers.elementwise_add(out, prev_out) if prev_out is not None else out
+        elif c == "n":
+            out = layers.layer_norm(out, begin_norm_axis=len(out.shape) - 1)
+        elif c == "d" and dropout_rate:
+            out = layers.dropout(out, dropout_rate,
+                                 dropout_implementation="upscale_in_train")
+    return out
+
+
+def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
+                  dropout_rate=0.0):
+    attn = multi_head_attention(
+        pre_post_process(None, x, "n"), None, None, attn_bias,
+        d_key, d_value, d_model, n_head, dropout_rate)
+    attn_out = pre_post_process(x, attn, "da", dropout_rate)
+    ffn = positionwise_ffn(pre_post_process(None, attn_out, "n"),
+                           d_inner, d_model, dropout_rate)
+    return pre_post_process(attn_out, ffn, "da", dropout_rate)
+
+
+def decoder_layer(x, enc_out, slf_attn_bias, dec_enc_attn_bias, n_head,
+                  d_key, d_value, d_model, d_inner, dropout_rate=0.0):
+    slf = multi_head_attention(
+        pre_post_process(None, x, "n"), None, None, slf_attn_bias,
+        d_key, d_value, d_model, n_head, dropout_rate)
+    slf_out = pre_post_process(x, slf, "da", dropout_rate)
+    cross = multi_head_attention(
+        pre_post_process(None, slf_out, "n"), enc_out, enc_out,
+        dec_enc_attn_bias, d_key, d_value, d_model, n_head, dropout_rate)
+    cross_out = pre_post_process(slf_out, cross, "da", dropout_rate)
+    ffn = positionwise_ffn(pre_post_process(None, cross_out, "n"),
+                           d_inner, d_model, dropout_rate)
+    return pre_post_process(cross_out, ffn, "da", dropout_rate)
+
+
+def pad_bias(mask, neg: float = -1e9):
+    """[B,T] {0,1} padding mask -> [B,1,1,T] additive attention bias
+    (0 where attendable, `neg` at pads)."""
+    b = layers.scale(mask, scale=-neg, bias=neg)
+    return layers.unsqueeze(b, [1, 2])
+
+
+def prepare_embedding(ids, vocab_size, d_model, max_len, dropout_rate=0.0,
+                      name="src"):
+    """Token embedding * sqrt(d_model) + sinusoid position encoding."""
+    emb = layers.embedding(
+        ids, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=f"{name}_word_emb"))
+    emb = layers.scale(emb, scale=float(d_model) ** 0.5)
+    seq_len = int(ids.shape[1])
+    if seq_len > max_len:
+        raise ValueError(f"sequence length {seq_len} exceeds the model's "
+                         f"max_length {max_len}")
+    pos_table = position_encoding_table(max_len, d_model)[:seq_len]
+    helper_attr = ParamAttr(
+        name=f"{name}_pos_enc_{seq_len}", trainable=False,
+        initializer=NumpyArrayInitializer(pos_table))
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper("pos_enc")
+    pos = helper.create_parameter(helper_attr, shape=[seq_len, d_model],
+                                  dtype="float32")
+    pos_var = layers.unsqueeze(pos, [0])                 # [1,T,D]
+    out = layers.elementwise_add(emb, pos_var)
+    if dropout_rate:
+        out = layers.dropout(out, dropout_rate,
+                             dropout_implementation="upscale_in_train")
+    return out
+
+
+def encoder(src_ids, src_attn_bias, n_layer, n_head, d_key, d_value,
+            d_model, d_inner, vocab_size, max_len, dropout_rate=0.0):
+    x = prepare_embedding(src_ids, vocab_size, d_model, max_len,
+                          dropout_rate, name="src")
+    for _ in range(n_layer):
+        x = encoder_layer(x, src_attn_bias, n_head, d_key, d_value,
+                          d_model, d_inner, dropout_rate)
+    return pre_post_process(None, x, "n")
+
+
+def decoder(tgt_ids, enc_out, slf_attn_bias, dec_enc_attn_bias, n_layer,
+            n_head, d_key, d_value, d_model, d_inner, vocab_size, max_len,
+            dropout_rate=0.0):
+    x = prepare_embedding(tgt_ids, vocab_size, d_model, max_len,
+                          dropout_rate, name="tgt")
+    for _ in range(n_layer):
+        x = decoder_layer(x, enc_out, slf_attn_bias, dec_enc_attn_bias,
+                          n_head, d_key, d_value, d_model, d_inner,
+                          dropout_rate)
+    return pre_post_process(None, x, "n")
+
+
+class TransformerConfig:
+    """Transformer-base hyperparameters (ref dist_transformer.py
+    TrainTaskConfig/ModelHyperParams)."""
+
+    def __init__(self, src_vocab_size=30000, tgt_vocab_size=30000,
+                 max_length=256, n_layer=6, n_head=8, d_model=512,
+                 d_inner=2048, dropout=0.1, label_smooth_eps=0.1):
+        self.src_vocab_size = src_vocab_size
+        self.tgt_vocab_size = tgt_vocab_size
+        self.max_length = max_length
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.d_model = d_model
+        self.d_key = d_model // n_head
+        self.d_value = d_model // n_head
+        self.d_inner = d_inner
+        self.dropout = dropout
+        self.label_smooth_eps = label_smooth_eps
+
+
+def build_train_net(cfg: TransformerConfig, src_len: int, tgt_len: int,
+                    is_test: bool = False):
+    """Feeds: src_ids [B,Ts] int64, tgt_ids [B,Tt] int64, lbl_ids [B,Tt]
+    int64, src_mask [B,Ts] float32 (1=token, 0=pad), tgt_mask [B,Tt].
+    Attention biases are derived in-graph from the masks (dense, TPU-first —
+    no LoD)."""
+    dropout = 0.0 if is_test else cfg.dropout
+    src_ids = layers.data("src_ids", [src_len], dtype="int64")
+    tgt_ids = layers.data("tgt_ids", [tgt_len], dtype="int64")
+    lbl_ids = layers.data("lbl_ids", [tgt_len], dtype="int64")
+    src_mask = layers.data("src_mask", [src_len], dtype="float32")
+    tgt_mask = layers.data("tgt_mask", [tgt_len], dtype="float32")
+
+    neg_inf = -1e9
+    src_attn_bias = pad_bias(src_mask)
+    tgt_pad_bias = pad_bias(tgt_mask)
+    # causal bias [1,1,Tt,Tt]
+    causal = np.triu(np.full((tgt_len, tgt_len), neg_inf, dtype="float32"), 1)
+    causal_var = layers.assign(causal[None, None, :, :])
+    tgt_slf_bias = layers.elementwise_add(tgt_pad_bias, causal_var)
+
+    enc_out = encoder(src_ids, src_attn_bias, cfg.n_layer, cfg.n_head,
+                      cfg.d_key, cfg.d_value, cfg.d_model, cfg.d_inner,
+                      cfg.src_vocab_size, cfg.max_length, dropout)
+    dec_out = decoder(tgt_ids, enc_out, tgt_slf_bias, src_attn_bias,
+                      cfg.n_layer, cfg.n_head, cfg.d_key, cfg.d_value,
+                      cfg.d_model, cfg.d_inner, cfg.tgt_vocab_size,
+                      cfg.max_length, dropout)
+
+    logits = layers.fc(dec_out, size=cfg.tgt_vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+    logits2d = layers.reshape(logits, [-1, cfg.tgt_vocab_size])
+    label2d = layers.reshape(lbl_ids, [-1, 1])
+    if cfg.label_smooth_eps and not is_test:
+        soft = layers.label_smooth(
+            layers.one_hot(label2d, cfg.tgt_vocab_size),
+            epsilon=cfg.label_smooth_eps)
+        soft = layers.reshape(soft, [-1, cfg.tgt_vocab_size])
+        cost = layers.softmax_with_cross_entropy(logits2d, soft,
+                                                 soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(logits2d, label2d)
+    weights2d = layers.reshape(tgt_mask, [-1, 1])
+    weighted = layers.elementwise_mul(cost, weights2d)
+    sum_cost = layers.reduce_sum(weighted)
+    token_count = layers.reduce_sum(weights2d)
+    avg_cost = layers.elementwise_div(sum_cost, token_count)
+
+    feeds = [src_ids, tgt_ids, lbl_ids, src_mask, tgt_mask]
+    return feeds, avg_cost, logits
+
+
+def make_fake_batch(cfg: TransformerConfig, batch_size: int, src_len: int,
+                    tgt_len: int, seed: int = 0):
+    """Synthetic WMT-like batch for tests/benchmarks."""
+    rng = np.random.RandomState(seed)
+    feed = {
+        "src_ids": rng.randint(1, cfg.src_vocab_size, (batch_size, src_len)).astype("int64"),
+        "tgt_ids": rng.randint(1, cfg.tgt_vocab_size, (batch_size, tgt_len)).astype("int64"),
+        "lbl_ids": rng.randint(1, cfg.tgt_vocab_size, (batch_size, tgt_len)).astype("int64"),
+        "src_mask": np.ones((batch_size, src_len), dtype="float32"),
+        "tgt_mask": np.ones((batch_size, tgt_len), dtype="float32"),
+    }
+    return feed
