@@ -1,0 +1,61 @@
+"""Benchmark: Transformer-base LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference publishes no V100/Fluid transformer numbers in-repo
+(BASELINE.md — `benchmark/fluid/` is a harness without committed results);
+the operative bar is BASELINE.json's north star ">=0.9x V100 step-time".
+We take 50k tokens/s as the V100 mixed-precision transformer-base anchor
+(typical fp16 V100 throughput for d512/L6 seq512 training), so
+vs_baseline = tokens_per_sec / 50_000.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+V100_TOKENS_PER_SEC = 50_000.0
+
+
+def main():
+    from paddle_tpu.parallel import hybrid, topology
+
+    mesh = topology.make_hybrid_mesh(dp=1, pp=1, tp=1,
+                                     devices=jax.devices()[:1])
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = hybrid.HybridConfig(
+        vocab_size=32000, seq_len=512, d_model=512, n_heads=8,
+        n_layers=6, d_ff=2048, n_microbatches=1,
+        compute_dtype=jax.numpy.bfloat16 if on_tpu else jax.numpy.float32,
+        remat=False)
+    batch = 32 if on_tpu else 4
+    params = hybrid.init_params(mesh, cfg, seed=0)
+    opt = hybrid.init_opt_state(params)
+    step = hybrid.build_train_step(mesh, cfg)
+    tokens, labels = hybrid.make_fake_lm_batch(cfg, global_batch=batch)
+
+    # warmup / compile
+    params, opt, loss = step(params, opt, tokens, labels)
+    jax.block_until_ready(loss)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt, loss = step(params, opt, tokens, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    toks_per_sec = batch * cfg.seq_len / dt
+    print(json.dumps({
+        "metric": "transformer_base_train_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(toks_per_sec / V100_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

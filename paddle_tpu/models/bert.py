@@ -97,12 +97,10 @@ def build_pretrain_net(cfg: BertConfig, seq_len: int,
     h = layers.fc(picked, size=cfg.hidden_size, act="gelu")
     h = layers.layer_norm(h, begin_norm_axis=1)
     mlm_logits = layers.fc(h, size=cfg.vocab_size)           # [B*P,V]
-    mask_label2d = layers.reshape(mask_label, [-1, 1])
-    mlm_cost = layers.softmax_with_cross_entropy(mlm_logits, mask_label2d)
-    w = layers.reshape(mask_weight, [-1, 1])
+    mlm_cost = layers.softmax_with_cross_entropy(mlm_logits, mask_label)
     mlm_loss = layers.elementwise_div(
-        layers.reduce_sum(layers.elementwise_mul(mlm_cost, w)),
-        layers.elementwise_add(layers.reduce_sum(w),
+        layers.reduce_sum(layers.elementwise_mul(mlm_cost, mask_weight)),
+        layers.elementwise_add(layers.reduce_sum(mask_weight),
                                layers.assign(np.array(1e-6, "float32"))))
 
     # --- NSP head ---------------------------------------------------------

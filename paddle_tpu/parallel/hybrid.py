@@ -1,0 +1,390 @@
+"""Hybrid-parallel Transformer LM training step: dp × pp × tp(+sp) × ep.
+
+This is the TPU-native superset of the reference's entire distributed stack
+(SURVEY.md §2.3 table "Parallelism strategies"): where the reference only has
+data parallelism (ParallelExecutor SSA graph + NCCL allreduce,
+/root/reference/paddle/fluid/framework/details/multi_devices_graph_pass.cc:572;
+pserver mode, transpiler/distribute_transpiler.py:268), this module composes
+
+  dp — batch sharding, gradient psum            (≈ NCCL allreduce :107)
+  pp — GPipe pipeline over 'pp' via ppermute,
+       microbatch scan                          (new capability)
+  tp — Megatron tensor parallel: column/row
+       sharded matmuls, vocab-parallel
+       embedding + cross entropy                (new capability)
+  sp — Megatron sequence parallelism: activations between blocks are
+       sequence-sharded over the SAME tp axis; all_gather before the
+       column-parallel matmuls, psum_scatter after the row-parallel ones
+  ep — expert parallelism: switch-MoE FFN, experts sharded over the dp
+       axis, token dispatch via all_to_all      (≈ the *capability* of the
+       sharded pserver embedding path, distribute_transpiler.py:1010)
+
+Everything is per-device code inside ONE jax.shard_map over the full mesh —
+collectives are explicit (psum / all_gather / psum_scatter / ppermute /
+all_to_all), exactly the scaling-book recipe — and jax.grad differentiates
+through all of them, which is what replaces the reference's hand-built
+backward comm ops.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .topology import grad_reduce_axes
+
+
+@dataclass
+class HybridConfig:
+    vocab_size: int = 32000
+    seq_len: int = 128
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4            # total dense blocks; must divide by pp
+    d_ff: int = 1024
+    n_microbatches: int = 2      # pipeline microbatches (per dp replica)
+    moe_experts: int = 0         # 0 = dense only; else experts per MESH dp axis total
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
+    compute_dtype: Any = jnp.float32   # bfloat16 on real TPU runs
+    remat: bool = True           # jax.checkpoint each stage (HBM for FLOPs)
+    learning_rate: float = 1e-3
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
+
+
+def _specs(mesh: Mesh, cfg: HybridConfig) -> Dict[str, P]:
+    """PartitionSpec per parameter leaf. Grad reduction axes are derived as
+    (mesh axes) - (axes named in the spec)."""
+    s = {
+        "embed": P("tp", None),            # vocab-parallel rows
+        "pos": P(None, None),
+        "ln_f": P(None),
+        # stacked per-layer block weights, axis 0 = layer -> pp
+        "ln1": P("pp", None, None),
+        # [L, D, H, 3*hd]: heads axis shards over tp (column parallel)
+        "wqkv": P("pp", None, "tp", None),
+        # [L, H, hd, D]: heads axis shards over tp (row parallel)
+        "wo": P("pp", "tp", None, None),
+        "ln2": P("pp", None, None),
+        "w1": P("pp", None, "tp"),          # column parallel
+        "w2": P("pp", "tp", None),          # row parallel
+    }
+    if cfg.moe_experts:
+        s.update({
+            "moe_gate": P("pp", None, None),          # [pp, D, E] replicated/tp
+            "moe_w1": P("pp", "dp", None, None),      # [pp, E, D, Fe]
+            "moe_w2": P("pp", "dp", None, None),      # [pp, E, Fe, D]
+            "moe_ln": P("pp", None, None),
+        })
+    return s
+
+
+def init_params(mesh: Mesh, cfg: HybridConfig, seed: int = 0):
+    """Global param pytree laid out across the mesh per _specs."""
+    rng = np.random.RandomState(seed)
+    D, Ff, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    Pp = mesh.shape["pp"]
+    assert L % Pp == 0, "n_layers must be divisible by pp"
+    assert cfg.d_model % cfg.n_heads == 0
+    assert cfg.n_heads % mesh.shape["tp"] == 0, "heads must divide by tp"
+    assert cfg.vocab_size % mesh.shape["tp"] == 0
+    assert cfg.seq_len % mesh.shape["tp"] == 0, "seq must divide by tp (sp)"
+
+    def g(*shape, scale=None):
+        scale = scale if scale is not None else (1.0 / np.sqrt(shape[-2]))
+        return (rng.randn(*shape) * scale).astype("float32")
+
+    params = {
+        "embed": g(cfg.vocab_size, D, scale=0.02),
+        "pos": g(cfg.seq_len, D, scale=0.02),
+        "ln_f": np.ones((D,), "float32"),
+        # per-layer stacks; ln kept [L, 1, D] so scan slices stay rank-2
+        "ln1": np.ones((L, 1, D), "float32"),
+        # head-major qkv so tp shards whole heads: [L, D, H, 3*hd]
+        "wqkv": g(L, D, cfg.n_heads, 3 * (D // cfg.n_heads)),
+        "wo": g(L, cfg.n_heads, D // cfg.n_heads, D,
+                scale=1.0 / np.sqrt(D)),
+        "ln2": np.ones((L, 1, D), "float32"),
+        "w1": g(L, D, Ff),
+        "w2": g(L, Ff, D),
+    }
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        assert E % mesh.shape["dp"] == 0, "experts must divide by dp (ep)"
+        Fe = Ff
+        params["moe_gate"] = g(Pp, D, E, scale=0.02)
+        params["moe_w1"] = g(Pp, E, D, Fe)
+        params["moe_w2"] = g(Pp, E, Fe, D)
+        params["moe_ln"] = np.ones((Pp, 1, D), "float32")
+
+    specs = _specs(mesh, cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def init_opt_state(params):
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return {"m": zeros, "v": jax.tree.map(jnp.zeros_like, params),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+# --------------------------------------------------------------------------
+# per-device building blocks (run inside shard_map)
+# --------------------------------------------------------------------------
+
+def _ln(x, scale, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale
+
+
+def _attention(h_full, wqkv, wo, dtype):
+    """Causal MHA on the full sequence with locally-held heads (tp) —
+    all matmuls hit the MXU; XLA fuses mask+softmax.
+    wqkv: [D, Hl, 3*hd] head-major; wo: [Hl, hd, D]."""
+    mb, T, D = h_full.shape
+    hd = wqkv.shape[-1] // 3
+    qkv = jnp.einsum("btd,dhe->bthe", h_full, wqkv.astype(dtype))
+    q, k, v = jnp.split(qkv, 3, axis=-1)          # [mb, T, Hl, hd]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", w, v)     # [mb, T, Hl, hd]
+    return jnp.einsum("bqhd,hdf->bqf", ctx, wo.astype(dtype))
+
+
+def _moe_ffn(x_s, gate_w, w1e, w2e, cfg: HybridConfig, dp_size, dtype):
+    """Switch (top-1) MoE with expert parallelism over the dp axis.
+
+    x_s: [S, D] local tokens (seq-sharded). Experts: E total, E/dp local.
+    Returns (out [S, D], aux_loss scalar)."""
+    S, D = x_s.shape
+    E = gate_w.shape[-1]
+    El = E // dp_size
+    C = max(1, int(cfg.moe_capacity_factor * S / E))
+
+    logits = jnp.einsum("sd,de->se", x_s, gate_w.astype(dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    expert = jnp.argmax(probs, -1)                       # [S]
+    gate = jnp.max(probs, -1)                            # [S]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+    # aux load-balance loss (Switch Transformer eq. 4)
+    density = jnp.mean(onehot, 0)
+    density_proxy = jnp.mean(probs, 0)
+    aux = E * jnp.sum(density * density_proxy)
+    # position of each token within its expert; drop beyond capacity
+    pos = (jnp.cumsum(onehot, 0) - 1.0) * onehot         # [S, E]
+    keep = (pos < C) & (onehot > 0)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                            dtype=jnp.float32) * keep[..., None]  # [S,E,C]
+    combine = pos_oh * gate[:, None, None]
+    dispatch = pos_oh
+    xd = jnp.einsum("sec,sd->ecd", dispatch,
+                    x_s.astype(jnp.float32)).astype(dtype)       # [E,C,D]
+    # all_to_all over dp: rows of E -> owning rank; gather my experts' tokens
+    xd = lax.all_to_all(xd, "dp", split_axis=0, concat_axis=0, tiled=True)
+    xd = xd.reshape(dp_size, El, C, D).transpose(1, 0, 2, 3)
+    xd = xd.reshape(El, dp_size * C, D)                   # [El, dp*C, D]
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", xd, w1e.astype(dtype)))
+    o = jnp.einsum("ecf,efd->ecd", h, w2e.astype(dtype))  # [El, dp*C, D]
+    o = o.reshape(El, dp_size, C, D).transpose(1, 0, 2, 3).reshape(E, C, D)
+    o = lax.all_to_all(o, "dp", split_axis=0, concat_axis=0, tiled=True)
+    out = jnp.einsum("sec,ecd->sd", combine,
+                     o.astype(jnp.float32)).astype(dtype)
+    return out, aux
+
+
+def build_train_step(mesh: Mesh, cfg: HybridConfig):
+    """Returns step(params, opt_state, tokens, labels) -> (params, opt_state,
+    loss). tokens/labels: [B, T] int32, B divisible by dp*n_microbatches."""
+    Dp, Pp, Tp = mesh.shape["dp"], mesh.shape["pp"], mesh.shape["tp"]
+    dtype = cfg.compute_dtype
+    n_local_heads = cfg.n_heads // Tp
+    Ts = cfg.seq_len // Tp                 # sequence shard (sp)
+    M = cfg.n_microbatches
+    Lp = cfg.n_layers // Pp
+    specs = _specs(mesh, cfg)
+
+    def grad_reduce(g, spec):
+        axes = grad_reduce_axes(mesh.axis_names, spec)
+        return lax.psum(g, axes) if axes else g
+
+    # ---- per-device code -------------------------------------------------
+    def embed_micro(p, ids):                  # ids [mb, T] -> [mb, Ts, D]
+        tp_r = lax.axis_index("tp")
+        Vl = p["embed"].shape[0]
+        off = tp_r * Vl
+        idx = ids - off
+        valid = (idx >= 0) & (idx < Vl)
+        part = jnp.take(p["embed"], jnp.clip(idx, 0, Vl - 1), axis=0)
+        part = jnp.where(valid[..., None], part, 0.0)
+        part = part + p["pos"][None, :, :] / Tp   # pos added once after psum
+        emb = lax.psum_scatter(part, "tp", scatter_dimension=1, tiled=True)
+        return emb.astype(dtype)               # [mb, Ts, D]
+
+    def block(x_s, lp):                        # one dense block, sp resident
+        h = _ln(x_s.astype(jnp.float32), lp["ln1"][0]).astype(dtype)
+        h_full = lax.all_gather(h, "tp", axis=1, tiled=True)   # sp gather
+        a = _attention(h_full, lp["wqkv"], lp["wo"], dtype)
+        a_s = lax.psum_scatter(a.astype(jnp.float32), "tp",
+                               scatter_dimension=1, tiled=True)
+        x_s = x_s + a_s.astype(dtype)
+        h = _ln(x_s.astype(jnp.float32), lp["ln2"][0]).astype(dtype)
+        h_full = lax.all_gather(h, "tp", axis=1, tiled=True)
+        f = jax.nn.relu(jnp.einsum("btd,df->btf", h_full,
+                                   lp["w1"].astype(dtype)))
+        f = jnp.einsum("btf,fd->btd", f, lp["w2"].astype(dtype))
+        f_s = lax.psum_scatter(f.astype(jnp.float32), "tp",
+                               scatter_dimension=1, tiled=True)
+        return x_s + f_s.astype(dtype)
+
+    def stage(p, x_s):                          # Lp blocks (+ optional MoE)
+        block_params = {k: p[k] for k in
+                        ("ln1", "wqkv", "wo", "ln2", "w1", "w2")}
+
+        def body(x, lp):
+            return block(x, lp), None
+        x_s, _ = lax.scan(body, x_s, block_params)
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.moe_experts:
+            mb = x_s.shape[0]
+            h = _ln(x_s.astype(jnp.float32), p["moe_ln"][0][0]).astype(dtype)
+            flat = h.reshape(-1, cfg.d_model)
+            out, aux = _moe_ffn(flat, p["moe_gate"][0], p["moe_w1"][0],
+                                p["moe_w2"][0], cfg, Dp, dtype)
+            x_s = x_s + out.reshape(mb, Ts, cfg.d_model)
+        return x_s, aux
+
+    stage_fn = jax.checkpoint(stage) if cfg.remat else stage
+
+    def vocab_parallel_xent(p, x_s, labels):
+        """x_s [N, Ts, D] seq-sharded hidden; labels [N, T]. Megatron
+        vocab-parallel cross entropy; returns mean loss over tokens."""
+        x = _ln(x_s.astype(jnp.float32), p["ln_f"])
+        x_full = lax.all_gather(x, "tp", axis=1, tiled=True)   # [N, T, D]
+        logits = jnp.einsum("btd,vd->btv", x_full.astype(dtype),
+                            p["embed"].astype(dtype)).astype(jnp.float32)
+        # stability shift is gradient-free (pmax has no AD rule, and the
+        # shift cancels in lse - label_logit anyway)
+        m = lax.pmax(lax.stop_gradient(jnp.max(logits, -1)), "tp")
+        se = jnp.sum(jnp.exp(logits - m[..., None]), -1)
+        lse = jnp.log(lax.psum(se, "tp")) + m                   # [N, T]
+        tp_r = lax.axis_index("tp")
+        Vl = logits.shape[-1]
+        idx = labels - tp_r * Vl
+        valid = (idx >= 0) & (idx < Vl)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, Vl - 1)[..., None], axis=-1)[..., 0]
+        label_logit = lax.psum(jnp.where(valid, picked, 0.0), "tp")
+        return jnp.mean(lse - label_logit)
+
+    def forward_loss(params, tokens, labels):
+        """Per-device loss: full pipeline over M microbatches."""
+        pp_r = lax.axis_index("pp")
+        B_loc = tokens.shape[0]
+        mb = B_loc // M
+        tok_m = tokens.reshape(M, mb, cfg.seq_len)
+        state0 = jnp.zeros((mb, Ts, cfg.d_model), dtype)
+        outs0 = jnp.zeros((M, mb, Ts, cfg.d_model), dtype)
+
+        def tick(carry, t):
+            state, outs, aux_acc = carry
+            in_idx = jnp.clip(t, 0, M - 1)
+            x0 = embed_micro(params, tok_m[in_idx])
+            inp = jnp.where(pp_r == 0, x0, state)
+            out, aux = stage_fn(params, inp)
+            # mask bubble ticks: stage s computes valid data for s<=t<s+M
+            valid = (t >= pp_r) & (t < pp_r + M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            o_idx = t - (Pp - 1)
+            write = (pp_r == Pp - 1) & (o_idx >= 0)
+            slot = jnp.clip(o_idx, 0, M - 1)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(write, out, outs[slot]), slot, 0)
+            nxt = lax.ppermute(out, "pp",
+                               [(i, (i + 1) % Pp) for i in range(Pp)])
+            return (nxt, outs, aux_acc), None
+
+        (state, outs, aux_acc), _ = lax.scan(
+            tick, (state0, outs0, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + Pp - 1))
+
+        lbl_m = labels.reshape(M, mb, cfg.seq_len)
+        xent = vocab_parallel_xent(params, outs.reshape(M * mb, Ts, -1),
+                                   lbl_m.reshape(M * mb, cfg.seq_len))
+        is_last = (pp_r == Pp - 1).astype(jnp.float32)
+        loss_dev = xent * is_last
+        loss = lax.psum(loss_dev, "pp")          # replicate across pp
+        if cfg.moe_experts:
+            # pmean over tp: each tp rank routed its own sequence shard, so
+            # average to keep the scalar replicated and the grad coefficient
+            # independent of tp size
+            aux_all = lax.pmean(lax.psum(aux_acc, "pp"), "tp") / (M * Pp)
+            loss = loss + cfg.moe_aux_weight * aux_all
+        return lax.pmean(loss, "dp")             # dp average (grad sync)
+
+    def adam_update(p, g, m, v, step):
+        m = cfg.adam_b1 * m + (1 - cfg.adam_b1) * g
+        v = cfg.adam_b2 * v + (1 - cfg.adam_b2) * g * g
+        mh = m / (1 - cfg.adam_b1 ** step)
+        vh = v / (1 - cfg.adam_b2 ** step)
+        return p - cfg.learning_rate * mh / (jnp.sqrt(vh) + cfg.adam_eps), m, v
+
+    def device_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(forward_loss)(params, tokens, labels)
+        grads = {k: grad_reduce(g, specs[k]) for k, g in grads.items()}
+        step = opt_state["step"] + 1
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            new_p[k], new_m[k], new_v[k] = adam_update(
+                params[k], grads[k], opt_state["m"][k], opt_state["v"][k],
+                step.astype(jnp.float32))
+        return new_p, {"m": new_m, "v": new_v, "step": step}, loss
+
+    pspecs = specs
+    ospecs = {"m": specs, "v": specs, "step": P()}
+    data_spec = P("dp", None)
+
+    sharded = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(pspecs, ospecs, data_spec, data_spec),
+        out_specs=(pspecs, ospecs, P()),
+        check_vma=False)
+
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def make_fake_lm_batch(cfg: HybridConfig, global_batch: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, cfg.vocab_size,
+                         (global_batch, cfg.seq_len)).astype("int32")
+    labels = np.roll(tokens, -1, axis=1)
+    return tokens, labels
+
+
+# --- single-device reference (for parity tests) ---------------------------
+
+def reference_loss(params_host, cfg: HybridConfig, tokens, labels):
+    """Same math, no parallelism, f32 — ground truth for the hybrid step."""
+    p = {k: np.asarray(v).astype("float32") for k, v in params_host.items()}
+    x = p["embed"][tokens] + p["pos"][None]
+    H, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
+    for l in range(cfg.n_layers):
+        h = _ln(x, p["ln1"][l][0])
+        x = x + _attention(jnp.asarray(h), p["wqkv"][l], p["wo"][l],
+                           jnp.float32)
+        h = _ln(x, p["ln2"][l][0])
+        x = x + jax.nn.relu(h @ p["w1"][l]) @ p["w2"][l]
+    x = _ln(x, p["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", x, p["embed"])
+    lse = jax.scipy.special.logsumexp(logits, -1)
+    picked = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+    return jnp.mean(lse - picked)

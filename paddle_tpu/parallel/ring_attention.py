@@ -1,0 +1,185 @@
+"""Ring attention: context parallelism for long sequences.
+
+The reference has NO long-context strategy (SURVEY.md §5: its story is LoD
+ragged batching + DynamicRNN, /root/reference/python/paddle/fluid/layers/
+control_flow.py:1395) — this module supplies the TPU-native capability:
+sequences sharded over a 'cp' mesh axis, with K/V blocks rotated around the
+ring via ppermute while each device accumulates its queries' attention in
+flash-attention style (running max + running sum), so the full sequence
+never materialises on any one chip.  Overlap of the permute with the local
+block matmul is XLA's latency-hiding scheduler's job.
+
+Math: blockwise softmax accumulation (Liu et al., Ring Attention, 2023;
+same recurrence as FlashAttention).  jax.grad differentiates through the
+scan+ppermute, giving the reverse ring automatically.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .topology import grad_reduce_axes
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Per-device blockwise attention; call inside shard_map.
+
+    q,k,v: [B, Ts, H, hd] — local sequence chunk (global seq = cp * Ts).
+    Returns [B, Ts, H, hd].  Chunk i holds global positions
+    [i*Ts, (i+1)*Ts); causal masking is exact across chunks.
+    """
+    cp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    B, Ts, H, hd = q.shape
+    scale = 1.0 / np.sqrt(hd)
+    q_pos = rank * Ts + jnp.arange(Ts)                    # global q positions
+
+    def step(carry, r):
+        o, m, l, kc, vc = carry
+        # kc/vc originated on rank (rank - r) mod cp
+        src = (rank - r) % cp
+        k_pos = src * Ts + jnp.arange(Ts)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale  # [B,H,Ts,Ts]
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]       # [Ts, Ts]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))       # [B,H,Ts]
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vc)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o_new, m_new, l_new, kc, vc), None
+
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    m0 = jnp.full((B, H, Ts), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Ts), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k.astype(jnp.float32), v.astype(jnp.float32)),
+        jnp.arange(cp))
+    l = jnp.maximum(l, 1e-20)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def plain_attention(q, k, v, causal: bool = True):
+    """Single-device reference for parity tests; q,k,v [B,T,H,hd]."""
+    B, T, H, hd = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+# --------------------------------------------------------------------------
+# Context-parallel LM training step (dp × cp)
+# --------------------------------------------------------------------------
+
+@dataclass
+class ContextParallelConfig:
+    vocab_size: int = 32000
+    seq_len: int = 2048          # global sequence
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 1024
+    compute_dtype: Any = jnp.float32
+    learning_rate: float = 1e-3
+
+
+def _ln(x, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    return (x - mu) * lax.rsqrt(jnp.var(x, -1, keepdims=True) + eps)
+
+
+def cp_specs():
+    return {
+        "embed": P(None, None),
+        "pos": P("cp", None),            # position table is seq-sharded too
+        "wqkv": P(None, None, None, None),
+        "wo": P(None, None, None, None),
+        "w1": P(None, None, None),
+        "w2": P(None, None, None),
+    }
+
+
+def cp_init_params(mesh: Mesh, cfg: ContextParallelConfig, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    D, L = cfg.d_model, cfg.n_layers
+    hd = D // cfg.n_heads
+
+    def g(*shape, scale=0.02):
+        return (rng.randn(*shape) * scale).astype("float32")
+
+    params = {
+        "embed": g(cfg.vocab_size, D),
+        "pos": g(cfg.seq_len, D),
+        "wqkv": g(L, D, cfg.n_heads, 3 * hd, scale=1 / np.sqrt(D)),
+        "wo": g(L, cfg.n_heads, hd, D, scale=1 / np.sqrt(D)),
+        "w1": g(L, D, cfg.d_ff, scale=1 / np.sqrt(D)),
+        "w2": g(L, cfg.d_ff, D, scale=1 / np.sqrt(cfg.d_ff)),
+    }
+    specs = cp_specs()
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def cp_build_train_step(mesh: Mesh, cfg: ContextParallelConfig):
+    """SGD step over tokens [B, T_global] with sequence sharded on 'cp'.
+    Every activation is [B, Ts, ...]; attention is the ring."""
+    specs = cp_specs()
+    dtype = cfg.compute_dtype
+
+    def grad_reduce(g, spec):
+        axes = grad_reduce_axes(mesh.axis_names, spec)
+        return lax.psum(g, axes) if axes else g
+
+    def forward_loss(p, tokens, labels):
+        x = jnp.take(p["embed"], tokens, axis=0) + p["pos"][None]
+        x = x.astype(dtype)
+
+        def layer(x, lp):
+            h = _ln(x.astype(jnp.float32)).astype(dtype)
+            qkv = jnp.einsum("btd,dhe->bthe", h, lp["wqkv"].astype(dtype))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            a = ring_attention(q, k, v, "cp", causal=True)
+            x = x + jnp.einsum("bqhd,hdf->bqf", a, lp["wo"].astype(dtype))
+            h = _ln(x.astype(jnp.float32)).astype(dtype)
+            f = jax.nn.relu(jnp.einsum("btd,df->btf", h,
+                                       lp["w1"].astype(dtype)))
+            x = x + jnp.einsum("btf,fd->btd", f, lp["w2"].astype(dtype))
+            return x, None
+
+        lp = {k: p[k] for k in ("wqkv", "wo", "w1", "w2")}
+        x, _ = lax.scan(layer, x, lp)
+        x = _ln(x.astype(jnp.float32))
+        logits = jnp.einsum("btd,vd->btv", x, p["embed"])
+        lse = jax.scipy.special.logsumexp(logits, -1)
+        picked = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+        # token mean over the local chunk, then over cp and dp
+        return lax.pmean(lax.pmean(jnp.mean(lse - picked), "cp"), "dp")
+
+    def device_step(p, tokens, labels):
+        loss, grads = jax.value_and_grad(forward_loss)(p, tokens, labels)
+        grads = {k: grad_reduce(g, specs[k]) for k, g in grads.items()}
+        new_p = {k: p[k] - cfg.learning_rate * grads[k] for k in p}
+        return new_p, loss
+
+    data_spec = P("dp", "cp")
+    sharded = jax.shard_map(device_step, mesh=mesh,
+                            in_specs=(specs, data_spec, data_spec),
+                            out_specs=(specs, P()),
+                            check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -1,0 +1,62 @@
+"""Mesh topology for hybrid parallelism.
+
+The reference's parallel plane is data-parallel only (SURVEY.md §2.3:
+ParallelExecutor+NCCL, pserver, NCCL2 multi-node — multi_devices_graph_pass.cc,
+listen_and_serv_op.cc).  The TPU-native design generalises it to a named
+device mesh with axes:
+
+  dp — data parallel (reference: ParallelExecutor replicas / trainers)
+  pp — pipeline parallel (no reference equivalent; new capability)
+  tp — tensor parallel, also carries Megatron-style sequence parallelism
+       for activations (no reference equivalent)
+  cp — context parallel (ring attention) for long sequences — replaces the
+       reference's LoD/DynamicRNN story for long inputs (SURVEY.md §5)
+
+Axis order is outermost-first; on real slices put tp innermost so its
+collectives ride the fastest ICI links.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def make_hybrid_mesh(dp: int = 1, pp: int = 1, tp: int = 1,
+                     devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * pp * tp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices for mesh dp={dp} pp={pp} "
+                         f"tp={tp}, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, pp, tp)
+    return jax.sharding.Mesh(arr, ("dp", "pp", "tp"))
+
+
+def make_context_mesh(dp: int = 1, cp: int = 1,
+                      devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * cp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, cp)
+    return jax.sharding.Mesh(arr, ("dp", "cp"))
+
+
+def grad_reduce_axes(mesh_axes, spec):
+    """Mesh axes a gradient must be psum'ed over for a param with this
+    PartitionSpec: every axis the param is *replicated* on (i.e. not named
+    in the spec).  Shared by the manual-collective training steps."""
+    named = {a for part in spec if part
+             for a in (part if isinstance(part, tuple) else (part,))}
+    return tuple(set(mesh_axes) - named)
+
+
+def auto_factor(n: int) -> Tuple[int, int, int]:
+    """Pick (dp, pp, tp) for n devices: prefer real (>=2) pp and tp when n
+    allows, remaining into dp."""
+    pp = 2 if n % 2 == 0 and n >= 4 else 1
+    tp = 2 if (n // pp) % 2 == 0 and n >= 2 else 1
+    dp = n // (pp * tp)
+    return dp, pp, tp
