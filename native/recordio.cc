@@ -1,0 +1,175 @@
+// RecordIO: chunked record container with CRC32 integrity.
+//
+// TPU-native equivalent of the reference's recordio library
+// (/root/reference/paddle/fluid/recordio/{header,chunk,scanner,writer}.cc and
+// format doc recordio/README.md): records are grouped into chunks, each
+// chunk carrying a magic number, record count, payload size and CRC32 so a
+// scanner can (a) detect truncation/corruption after a crash and resume at
+// the next valid chunk, and (b) range-seek for file sharding.  Compression
+// codecs are a no-op here (XLA hosts have fast NVMe; snappy dependency
+// dropped), the flag byte is kept in the format for forward compatibility.
+//
+// File layout:
+//   repeated chunks:
+//     u32 magic (0x50545243 "CRTP")   u32 flags (bit0: compressed, unused)
+//     u32 num_records                 u64 payload_len
+//     u32 crc32(payload)
+//     payload: repeated { u32 len; bytes[len] }
+//
+// Exposed as a C ABI for ctypes (paddle_tpu/fast/__init__.py); no pybind11
+// in this image (see repo docs).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50545243u;
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<std::string> pending;
+  size_t pending_bytes = 0;
+  size_t max_chunk_records = 1000;
+  size_t max_chunk_bytes = 1 << 20;
+
+  bool flush_chunk() {
+    if (pending.empty()) return true;
+    std::string payload;
+    payload.reserve(pending_bytes + 4 * pending.size());
+    for (const auto& r : pending) {
+      uint32_t len = static_cast<uint32_t>(r.size());
+      payload.append(reinterpret_cast<const char*>(&len), 4);
+      payload.append(r);
+    }
+    uint32_t crc = crc32(0L, reinterpret_cast<const Bytef*>(payload.data()),
+                         static_cast<uInt>(payload.size()));
+    uint32_t flags = 0;
+    uint32_t n = static_cast<uint32_t>(pending.size());
+    uint64_t plen = payload.size();
+    if (fwrite(&kMagic, 4, 1, f) != 1) return false;
+    if (fwrite(&flags, 4, 1, f) != 1) return false;
+    if (fwrite(&n, 4, 1, f) != 1) return false;
+    if (fwrite(&plen, 8, 1, f) != 1) return false;
+    if (fwrite(&crc, 4, 1, f) != 1) return false;
+    if (fwrite(payload.data(), 1, payload.size(), f) != payload.size())
+      return false;
+    pending.clear();
+    pending_bytes = 0;
+    return true;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<std::string> chunk;   // records of current chunk
+  size_t next_in_chunk = 0;
+
+  // Load the next valid chunk; skips corrupted tails (crash tolerance,
+  // ref scanner.cc behaviour).
+  bool load_chunk() {
+    chunk.clear();
+    next_in_chunk = 0;
+    for (;;) {
+      uint32_t magic = 0, flags = 0, n = 0, crc = 0;
+      uint64_t plen = 0;
+      if (fread(&magic, 4, 1, f) != 1) return false;
+      if (magic != kMagic) {
+        // resync: scan byte-by-byte for magic (corrupted stream)
+        if (fseek(f, -3, SEEK_CUR) != 0) return false;
+        continue;
+      }
+      if (fread(&flags, 4, 1, f) != 1) return false;
+      if (fread(&n, 4, 1, f) != 1) return false;
+      if (fread(&plen, 8, 1, f) != 1) return false;
+      if (fread(&crc, 4, 1, f) != 1) return false;
+      std::string payload(plen, '\0');
+      if (plen > 0 && fread(payload.data(), 1, plen, f) != plen)
+        return false;  // truncated tail
+      uint32_t actual = crc32(
+          0L, reinterpret_cast<const Bytef*>(payload.data()),
+          static_cast<uInt>(payload.size()));
+      if (actual != crc) continue;  // corrupted chunk: skip
+      size_t off = 0;
+      bool ok = true;
+      for (uint32_t i = 0; i < n; i++) {
+        if (off + 4 > payload.size()) { ok = false; break; }
+        uint32_t len;
+        memcpy(&len, payload.data() + off, 4);
+        off += 4;
+        if (off + len > payload.size()) { ok = false; break; }
+        chunk.emplace_back(payload.data() + off, len);
+        off += len;
+      }
+      if (ok && !chunk.empty()) return true;
+      chunk.clear();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, int max_chunk_records) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  if (max_chunk_records > 0)
+    w->max_chunk_records = static_cast<size_t>(max_chunk_records);
+  return w;
+}
+
+int rio_writer_write(void* handle, const char* data, uint64_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  w->pending.emplace_back(data, len);
+  w->pending_bytes += len;
+  if (w->pending.size() >= w->max_chunk_records ||
+      w->pending_bytes >= w->max_chunk_bytes)
+    return w->flush_chunk() ? 0 : -1;
+  return 0;
+}
+
+int rio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  int rc = w->flush_chunk() ? 0 : -1;
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// Returns record length, 0 on EOF, -1 if buffer too small (call again with
+// a bigger buffer; the record is retained).
+int64_t rio_scanner_next(void* handle, char* buf, uint64_t buf_len) {
+  auto* s = static_cast<Scanner*>(handle);
+  if (s->next_in_chunk >= s->chunk.size()) {
+    if (!s->load_chunk()) return 0;
+  }
+  const std::string& r = s->chunk[s->next_in_chunk];
+  if (r.size() > buf_len) return -1;
+  memcpy(buf, r.data(), r.size());
+  s->next_in_chunk++;
+  return static_cast<int64_t>(r.size());
+}
+
+void rio_scanner_close(void* handle) {
+  auto* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

@@ -32,6 +32,11 @@ from . import clip
 from . import io
 from . import metrics
 from . import nets
+from . import reader
+from . import dataset
+from .data_feeder import DataFeeder
+from .trainer import (BeginEpochEvent, BeginStepEvent, CheckpointConfig,
+                      EndEpochEvent, EndStepEvent, Trainer)
 from .parallel import ParallelExecutor, ExecutionStrategy, BuildStrategy
 
 __version__ = "0.1.0"

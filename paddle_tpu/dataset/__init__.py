@@ -1,0 +1,4 @@
+"""Datasets (ref python/paddle/dataset/): local-cache parse when files are
+present, deterministic synthetic fallback otherwise (no network egress).
+Schemas match the reference's readers sample-for-sample."""
+from . import cifar, common, imdb, imikolov, mnist, uci_housing

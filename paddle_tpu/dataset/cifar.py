@@ -1,0 +1,69 @@
+"""CIFAR-10/100 (ref python/paddle/dataset/cifar.py).
+
+Sample schema: (image float32[3072] in [0,1], label int).
+Synthetic fallback: class-colored noise images, deterministic.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME
+
+TRAIN_N, TEST_N = 4096, 512
+
+
+def _synthetic(n, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n)
+    imgs = rng.rand(n, 3, 32, 32).astype("float32") * 0.4
+    for c in range(num_classes):
+        idx = labels == c
+        imgs[idx, c % 3] += 0.4 + 0.2 * ((c // 3) % 2)
+    return np.clip(imgs, 0, 1).reshape(n, 3072), labels
+
+
+def _tar_reader(path, sub_name):
+    with tarfile.open(path, mode="r") as f:
+        names = [n for n in f.getnames() if sub_name in n]
+        for name in names:
+            batch = pickle.load(f.extractfile(name), encoding="latin1")
+            for s, l in zip(batch["data"],
+                            batch.get("labels", batch.get("fine_labels"))):
+                yield s.astype("float32") / 255.0, int(l)
+
+
+def _creator(kind, num_classes, n, seed):
+    fname = "cifar-10-python.tar.gz" if num_classes == 10 else \
+        "cifar-100-python.tar.gz"
+    path = os.path.join(DATA_HOME, "cifar", fname)
+    sub = ("data_batch" if kind == "train" else "test_batch") \
+        if num_classes == 10 else kind
+
+    def reader():
+        if os.path.exists(path):
+            yield from _tar_reader(path, sub)
+        else:
+            imgs, labels = _synthetic(n, num_classes, seed)
+            for img, lbl in zip(imgs, labels):
+                yield img, int(lbl)
+    return reader
+
+
+def train10():
+    return _creator("train", 10, TRAIN_N, seed=0)
+
+
+def test10():
+    return _creator("test", 10, TEST_N, seed=1)
+
+
+def train100():
+    return _creator("train", 100, TRAIN_N, seed=2)
+
+
+def test100():
+    return _creator("test", 100, TEST_N, seed=3)

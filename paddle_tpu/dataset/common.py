@@ -1,0 +1,64 @@
+"""Dataset cache/shard helpers (ref python/paddle/dataset/common.py).
+
+The reference downloads public datasets into ~/.cache/paddle/dataset
+(common.py `download`).  This environment has no network egress, so every
+dataset module accepts a local cache if present and otherwise falls back to
+a *deterministic synthetic* generator with the same sample schema —
+documented per module.  The split/sharding helpers are exact capability
+ports.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+from typing import Callable, List
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def must_mkdirs(path: str):
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def split(reader: Callable, line_count: int, suffix: str = "%05d.pickle",
+          dumper=pickle.dump):
+    """Split a reader's samples into chunked files (ref common.py split)."""
+    indx_f = 0
+    lines = []
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= (indx_f + 1) * line_count - 1:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern: str, trainer_count: int,
+                         trainer_id: int, loader=pickle.load):
+    """Read this trainer's shard of chunked files (ref common.py
+    cluster_files_reader) — the file-level sharding used for multi-host
+    input (each host reads files where index % trainer_count == trainer_id)."""
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for i, fn in enumerate(flist):
+            if i % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    lines = loader(f)
+                    yield from lines
+    return reader

@@ -1,0 +1,40 @@
+"""IMDB sentiment (ref python/paddle/dataset/imdb.py).
+
+Sample schema: (token ids list[int], label 0/1). word_dict() -> vocab map.
+Synthetic fallback: two token distributions (positive/negative skew),
+deterministic — models can fit it, keeping the LSTM/text-class benchmark
+(BASELINE.md "LSTM text-class") runnable offline.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB = 5000
+TRAIN_N, TEST_N = 2048, 256
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(20, 120))
+            # positive reviews skew to low ids, negative to high ids
+            if label:
+                ids = rng.zipf(1.3, length) % (VOCAB // 2)
+            else:
+                ids = VOCAB // 2 + (rng.zipf(1.3, length) % (VOCAB // 2))
+            yield list(np.clip(ids, 0, VOCAB - 1).astype(int)), label
+    return reader
+
+
+def train(word_idx=None):
+    return _creator(TRAIN_N, seed=0)
+
+
+def test(word_idx=None):
+    return _creator(TEST_N, seed=1)

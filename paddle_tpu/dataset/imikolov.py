@@ -1,0 +1,39 @@
+"""PTB-style n-gram language model data (ref python/paddle/dataset/
+imikolov.py — word2vec book example). Sample: tuple of n token ids.
+Synthetic fallback: Markov-chain token stream, deterministic."""
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB = 2000
+TRAIN_N, TEST_N = 4096, 512
+
+
+def build_dict(min_word_freq: int = 50):
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _creator(n_samples, n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        # sticky Markov chain: next ~ (cur + small step) mod VOCAB
+        cur = int(rng.randint(VOCAB))
+        window = []
+        count = 0
+        while count < n_samples:
+            step = int(rng.choice([1, 2, 3, 5, 7]))
+            cur = (cur + step) % VOCAB
+            window.append(cur)
+            if len(window) == n:
+                yield tuple(window)
+                window = window[1:]
+                count += 1
+    return reader
+
+
+def train(word_idx=None, n: int = 5):
+    return _creator(TRAIN_N, n, seed=0)
+
+
+def test(word_idx=None, n: int = 5):
+    return _creator(TEST_N, n, seed=1)

@@ -1,0 +1,72 @@
+"""MNIST dataset (ref python/paddle/dataset/mnist.py).
+
+Sample schema: (image float32[784] scaled to [-1, 1], label int in [0, 10)).
+If the real IDX files exist under DATA_HOME/mnist (user-provided; no egress
+in this environment), they are parsed; otherwise a deterministic synthetic
+set with the same schema is generated (class-dependent blob patterns so
+models can actually fit it).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from .common import DATA_HOME
+
+TRAIN_N, TEST_N = 8192, 1024
+
+
+def _real_path(kind: str):
+    d = os.path.join(DATA_HOME, "mnist")
+    img = os.path.join(d, f"{kind}-images-idx3-ubyte.gz")
+    lbl = os.path.join(d, f"{kind}-labels-idx1-ubyte.gz")
+    return (img, lbl) if os.path.exists(img) and os.path.exists(lbl) else None
+
+
+def _parse_idx(img_path, lbl_path):
+    with gzip.open(lbl_path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(img_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    return images, labels
+
+
+def _synthetic(n: int, seed: int):
+    """Class-conditional gaussian blobs on a 28x28 grid."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    yy, xx = np.mgrid[0:28, 0:28]
+    images = np.empty((n, 784), dtype=np.float32)
+    for c in range(10):
+        cy, cx = 6 + 2 * (c // 5) * 6, 4 + (c % 5) * 5
+        blob = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0)
+        idx = np.where(labels == c)[0]
+        noise = rng.rand(len(idx), 784).astype(np.float32) * 0.3
+        images[idx] = blob.ravel()[None, :].astype(np.float32) + noise
+    images = images / images.max()
+    return (images * 255).astype(np.uint8), labels.astype(np.uint8)
+
+
+def _reader_creator(kind: str, n: int, seed: int):
+    def reader():
+        real = _real_path(kind)
+        if real:
+            images, labels = _parse_idx(*real)
+        else:
+            images, labels = _synthetic(n, seed)
+        for img, lbl in zip(images, labels):
+            yield img.astype("float32") / 127.5 - 1.0, int(lbl)
+    return reader
+
+
+def train():
+    return _reader_creator("train", TRAIN_N, seed=0)
+
+
+def test():
+    return _reader_creator("t10k", TEST_N, seed=1)

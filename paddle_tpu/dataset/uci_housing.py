@@ -1,0 +1,47 @@
+"""UCI housing (ref python/paddle/dataset/uci_housing.py).
+
+Sample schema: (features float32[13] normalized, price float32[1]).
+Synthetic fallback: linear ground truth + noise, deterministic.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+FEATURE_NUM = 13
+TRAIN_N, TEST_N = 404, 102
+
+
+def _load():
+    path = os.path.join(DATA_HOME, "uci_housing", "housing.data")
+    if os.path.exists(path):
+        data = np.loadtxt(path)
+        feats = data[:, :-1].astype("float32")
+        prices = data[:, -1:].astype("float32")
+    else:
+        rng = np.random.RandomState(42)
+        feats = rng.randn(TRAIN_N + TEST_N, FEATURE_NUM).astype("float32")
+        w = rng.randn(FEATURE_NUM, 1).astype("float32")
+        prices = (feats @ w + 22.5
+                  + 0.5 * rng.randn(TRAIN_N + TEST_N, 1)).astype("float32")
+    mu, sigma = feats.mean(0), feats.std(0) + 1e-6
+    return (feats - mu) / sigma, prices
+
+
+def _creator(lo, hi):
+    def reader():
+        feats, prices = _load()
+        for i in range(lo, min(hi, len(feats))):
+            yield feats[i], prices[i]
+    return reader
+
+
+def train():
+    return _creator(0, TRAIN_N)
+
+
+def test():
+    return _creator(TRAIN_N, TRAIN_N + TEST_N)

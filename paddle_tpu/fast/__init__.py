@@ -1,0 +1,160 @@
+"""Native (C++) runtime bindings: recordio + async data loader.
+
+ctypes binding to native/libpaddle_tpu_native.so (built by `make -C
+native/`); pybind11 is not in this image, so the ABI is plain C (see
+native/recordio.cc).  `available()` gates callers; paddle_tpu/recordio.py is
+the pure-Python fallback with the identical on-disk format (the two are
+cross-tested in tests/test_recordio.py).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, List, Optional, Sequence
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__),
+                         "libpaddle_tpu_native.so")
+_lib = None
+_load_failed = False   # cache build/load failure: never retry the compile
+
+
+def _try_build() -> bool:
+    native_dir = os.path.join(os.path.dirname(__file__), "..", "..",
+                              "native")
+    if not os.path.isdir(native_dir):
+        return False
+    try:
+        subprocess.run(["make", "-C", native_dir], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    if not os.path.exists(_LIB_PATH) and not _try_build():
+        _load_failed = True
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.rio_writer_open.restype = ctypes.c_void_p
+    lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rio_writer_write.restype = ctypes.c_int
+    lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+    lib.rio_writer_close.restype = ctypes.c_int
+    lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.rio_scanner_open.restype = ctypes.c_void_p
+    lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+    lib.rio_scanner_next.restype = ctypes.c_int64
+    lib.rio_scanner_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+    lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+    lib.loader_create.restype = ctypes.c_void_p
+    lib.loader_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_int]
+    lib.loader_next.restype = ctypes.c_int64
+    lib.loader_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint64]
+    lib.loader_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeRecordIOWriter:
+    def __init__(self, path: str, max_chunk_records: int = 1000):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.rio_writer_open(path.encode(), max_chunk_records)
+        if not self._h:
+            raise IOError(f"cannot open {path!r} for writing")
+
+    def write(self, record: bytes):
+        if self._lib.rio_writer_write(self._h, record, len(record)) != 0:
+            raise IOError("recordio write failed")
+
+    def close(self):
+        if self._h:
+            if self._lib.rio_writer_close(self._h) != 0:
+                raise IOError("recordio flush failed")
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def native_scan(path: str) -> Iterator[bytes]:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    h = lib.rio_scanner_open(path.encode())
+    if not h:
+        raise IOError(f"cannot open {path!r}")
+    buf_len = 1 << 20
+    buf = ctypes.create_string_buffer(buf_len)
+    try:
+        while True:
+            n = lib.rio_scanner_next(h, buf, buf_len)
+            if n == 0:
+                break
+            if n == -1:
+                buf_len *= 2
+                buf = ctypes.create_string_buffer(buf_len)
+                continue
+            yield buf.raw[:n]
+    finally:
+        lib.rio_scanner_close(h)
+
+
+class AsyncDataLoader:
+    """Multithreaded native prefetch over recordio shards; iterate to get
+    raw record bytes (order is nondeterministic across shards)."""
+
+    def __init__(self, files: Sequence[str], num_threads: int = 4,
+                 queue_capacity: int = 256):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        joined = "\n".join(files).encode()
+        self._h = lib.loader_create(joined, num_threads, queue_capacity)
+        if not self._h:
+            raise IOError("loader_create failed")
+
+    def __iter__(self):
+        buf_len = 1 << 20
+        buf = ctypes.create_string_buffer(buf_len)
+        while True:
+            n = self._lib.loader_next(self._h, buf, buf_len)
+            if n == 0:
+                break
+            if n < 0:
+                buf_len = max(buf_len * 2, -n)
+                buf = ctypes.create_string_buffer(buf_len)
+                continue
+            yield buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._lib.loader_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

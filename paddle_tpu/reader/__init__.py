@@ -1,0 +1,4 @@
+"""Reader pipeline (ref python/paddle/reader/)."""
+from .decorator import (PipeReader, batch, buffered, cache, chain, compose,
+                        firstn, map_readers, multiprocess_reader, shuffle,
+                        xmap_readers)

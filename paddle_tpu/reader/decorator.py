@@ -1,0 +1,275 @@
+"""Composable reader decorators.
+
+Capability parity with /root/reference/python/paddle/reader/decorator.py
+(map_readers:36, shuffle:58, chain:93, compose:125, buffered:172,
+xmap_readers:243, multiprocess_reader:338, PipeReader:438) and
+python/paddle/batch.py.  A *reader* is a zero-arg callable returning an
+iterator of samples; a *reader creator* builds readers.  The buffered/xmap
+decorators are the host-side async input pipeline (the reference's C++
+double_buffer / py_reader role is played by `buffered` + the executor's
+async dispatch; the native-code path is paddle_tpu/fast/ when built).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import queue
+import random as _random
+import subprocess
+import threading
+from typing import Callable, Iterable, List, Sequence
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "xmap_readers", "multiprocess_reader", "batch",
+           "cache", "PipeReader"]
+
+
+def map_readers(func, *readers):
+    """Apply func to the items of several readers zipped together."""
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size: int, seed=None):
+    """Pool-shuffle within a sliding buffer (ref decorator.py:58)."""
+    def data_reader():
+        rng = _random.Random(seed)
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back (ref decorator.py:93)."""
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into tuple samples (ref decorator.py:125)."""
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        iters = itertools.zip_longest(*rs) if not check_alignment else zip(*rs)
+        for outputs in iters:
+            if check_alignment and any(o is None for o in outputs):
+                raise RuntimeError("readers not aligned")
+            yield sum((make_tuple(o) for o in outputs), ())
+    return reader
+
+
+def buffered(reader, size: int):
+    """Background-thread prefetch into a bounded queue (ref :172) —
+    overlaps host input work with device steps.  Producer exceptions are
+    re-raised in the consumer (not swallowed as end-of-data)."""
+    class _End:
+        pass
+
+    class _Error:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def data_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def producer():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as exc:   # propagate to consumer
+                q.put(_Error(exc))
+            else:
+                q.put(_End)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            if isinstance(e, _Error):
+                raise e.exc
+            yield e
+    return data_reader
+
+
+def firstn(reader, n: int):
+    def data_reader():
+        return itertools.islice(reader(), n)
+    return data_reader
+
+
+def cache(reader):
+    """Materialise once, then replay from memory.  A failed first pass
+    leaves nothing cached (no partial/duplicated data on retry)."""
+    state = {"data": None}
+
+    def data_reader():
+        if state["data"] is None:
+            state["data"] = list(reader())   # atomic: assign only on success
+        return iter(state["data"])
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map over samples with worker threads (ref :243)."""
+    def data_reader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        END = object()
+        errors: list = []
+
+        def feeder():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as exc:
+                errors.append(exc)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(END)
+
+        def worker():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is END:
+                        return
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as exc:
+                errors.append(exc)
+            finally:
+                out_q.put(END)   # always signal, even on mapper failure
+
+        threading.Thread(target=feeder, daemon=True).start()
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is END:
+                    finished += 1
+                    continue
+                i, d = item
+                pending[i] = d
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is END:
+                    finished += 1
+                    continue
+                yield item[1]
+        if errors:
+            raise errors[0]
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe: bool = True, queue_size: int = 1000):
+    """Fan-in several readers, each in its own process (ref :338).
+    Samples must be picklable."""
+    def data_reader():
+        q: multiprocessing.Queue = multiprocessing.Queue(queue_size)
+
+        def worker(r):
+            try:
+                for d in r():
+                    q.put(d)
+            finally:
+                q.put(None)    # always signal, even on failure
+
+        procs = [multiprocessing.Process(target=worker, args=(r,),
+                                         daemon=True) for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            # bounded wait so an OOM-killed worker (which can't reach its
+            # finally) doesn't hang the consumer forever
+            try:
+                d = q.get(timeout=1.0)
+            except queue.Empty:
+                dead = [p for p in procs if not p.is_alive()]
+                if len(dead) == len(procs):
+                    break
+                continue
+            if d is None:
+                finished += 1
+            else:
+                yield d
+        failed = []
+        for p in procs:
+            p.join()
+            if p.exitcode not in (0, None):
+                failed.append(p.exitcode)
+        if failed:
+            raise RuntimeError(
+                f"multiprocess_reader: {len(failed)} worker(s) died with "
+                f"exit codes {failed}")
+    return data_reader
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group samples into lists of batch_size (ref python/paddle/batch.py)."""
+    def batch_reader():
+        b = []
+        for d in reader():
+            b.append(d)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (ref decorator.py:438)."""
+
+    def __init__(self, command: str, bufsize: int = 8192):
+        self.command = command
+        self.bufsize = bufsize
+
+    def get_line(self, cut_lines: bool = True, line_break: bytes = b"\n"):
+        proc = subprocess.Popen(self.command.split(),
+                                stdout=subprocess.PIPE, bufsize=self.bufsize)
+        remained = b""
+        assert proc.stdout is not None
+        while True:
+            buf = proc.stdout.read(self.bufsize)
+            if not buf:
+                break
+            if cut_lines:
+                lines = (remained + buf).split(line_break)
+                remained = lines.pop(-1)
+                yield from lines
+            else:
+                yield buf
+        if remained:
+            yield remained

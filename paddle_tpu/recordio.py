@@ -1,0 +1,102 @@
+"""Pure-Python recordio: identical on-disk format to native/recordio.cc.
+
+Capability parity with the reference's recordio
+(/root/reference/paddle/fluid/recordio/ + python recordio_writer.py):
+chunked records with CRC32, crash-tolerant scan.  The native C++ path
+(paddle_tpu/fast) is preferred for throughput; this module guarantees the
+format works everywhere and is the cross-check in tests.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Iterator, List
+
+MAGIC = 0x50545243
+_HEADER = struct.Struct("<IIIQI")   # magic, flags, num_records, payload_len, crc
+
+
+class RecordIOWriter:
+    def __init__(self, path: str, max_chunk_records: int = 1000,
+                 max_chunk_bytes: int = 1 << 20):
+        self._f = open(path, "wb")
+        self._pending: List[bytes] = []
+        self._pending_bytes = 0
+        self.max_chunk_records = max_chunk_records
+        self.max_chunk_bytes = max_chunk_bytes
+
+    def write(self, record: bytes):
+        self._pending.append(bytes(record))
+        self._pending_bytes += len(record)
+        if (len(self._pending) >= self.max_chunk_records
+                or self._pending_bytes >= self.max_chunk_bytes):
+            self._flush_chunk()
+
+    def _flush_chunk(self):
+        if not self._pending:
+            return
+        payload = b"".join(struct.pack("<I", len(r)) + r
+                           for r in self._pending)
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._f.write(_HEADER.pack(MAGIC, 0, len(self._pending),
+                                   len(payload), crc))
+        self._f.write(payload)
+        self._pending = []
+        self._pending_bytes = 0
+
+    def close(self):
+        self._flush_chunk()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def scan(path: str) -> Iterator[bytes]:
+    """Yield records; skip corrupted/truncated chunks (crash tolerance)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    n = len(data)
+    while off + _HEADER.size <= n:
+        magic, flags, num, plen, crc = _HEADER.unpack_from(data, off)
+        if magic != MAGIC:
+            off += 1   # resync scan
+            continue
+        start = off + _HEADER.size
+        end = start + plen
+        if end > n:
+            break      # truncated tail
+        payload = data[start:end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            off += 1   # corrupted: resync from next byte
+            continue
+        p = 0
+        records = []
+        ok = True
+        for _ in range(num):
+            if p + 4 > len(payload):
+                ok = False
+                break
+            (rlen,) = struct.unpack_from("<I", payload, p)
+            p += 4
+            if p + rlen > len(payload):
+                ok = False
+                break
+            records.append(payload[p:p + rlen])
+            p += rlen
+        if ok:
+            yield from records
+        off = end
+
+
+def write_records(path: str, records) -> int:
+    cnt = 0
+    with RecordIOWriter(path) as w:
+        for r in records:
+            w.write(r)
+            cnt += 1
+    return cnt

@@ -1,0 +1,161 @@
+"""Reader decorators, datasets, DataFeeder, Trainer event loop +
+checkpoint rotation/resume (the reference's contract:
+python/paddle/fluid/contrib/trainer.py + reader/decorator.py tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, reader
+from paddle_tpu.dataset import mnist, uci_housing
+
+
+def test_reader_decorators_compose():
+    r = reader.batch(
+        reader.shuffle(lambda: iter(range(100)), buf_size=32, seed=0), 10)
+    batches = list(r())
+    assert len(batches) == 10
+    assert sorted(sum(batches, [])) == list(range(100))
+
+    r2 = reader.chain(lambda: iter([1, 2]), lambda: iter([3]))
+    assert list(r2()) == [1, 2, 3]
+
+    r3 = reader.compose(lambda: iter([1, 2]), lambda: iter([(10, 20),
+                                                            (30, 40)]))
+    assert list(r3()) == [(1, 10, 20), (2, 30, 40)]
+
+    r4 = reader.buffered(lambda: iter(range(7)), 3)
+    assert list(r4()) == list(range(7))
+
+    r5 = reader.xmap_readers(lambda x: x * 2, lambda: iter(range(10)),
+                             process_num=3, buffer_size=8, order=True)
+    assert list(r5()) == [x * 2 for x in range(10)]
+
+    r6 = reader.map_readers(lambda a, b: a + b, lambda: iter([1, 2]),
+                            lambda: iter([10, 20]))
+    assert list(r6()) == [11, 22]
+
+
+def test_reader_errors_propagate():
+    def bad_reader():
+        yield 1
+        raise IOError("disk gone")
+
+    with pytest.raises(IOError):
+        list(reader.buffered(bad_reader, 4)())
+
+    def bad_mapper(x):
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        list(reader.xmap_readers(bad_mapper, lambda: iter(range(5)),
+                                 process_num=2, buffer_size=4)())
+
+    # cache: failed first pass leaves nothing cached
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        yield 1
+        if calls["n"] == 1:
+            raise RuntimeError("flake")
+        yield 2
+
+    c = reader.cache(flaky)
+    with pytest.raises(RuntimeError):
+        list(c())
+    assert list(c()) == [1, 2]
+    assert list(c()) == [1, 2]
+
+
+def test_mnist_dataset_schema():
+    sample = next(mnist.train()())
+    img, lbl = sample
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert 0 <= lbl < 10
+    assert -1.0 <= img.min() and img.max() <= 1.0
+
+
+def test_data_feeder_pads_ragged():
+    main = pt.Program()
+    with pt.program_guard(main, pt.Program()):
+        ids = layers.data("ids", [16], dtype="int64")
+        lbl = layers.data("label", [1], dtype="int64")
+    feeder = pt.DataFeeder([ids, lbl], pad_to={"ids": 16}, emit_masks=True)
+    batch = [([1, 2, 3], 0), ([4, 5], 1)]
+    feed = feeder.feed(batch)
+    assert feed["ids"].shape == (2, 16)
+    assert feed["ids_mask"].sum() == 5
+    assert feed["label"].shape == (2, 1)
+
+
+def test_trainer_mnist_with_checkpoint_resume(tmp_path):
+    def train_func():
+        img = layers.data("img", [784], dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        pred = layers.fc(img, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        acc = layers.accuracy(pred, label)
+        return [loss, acc]
+
+    def optimizer_func():
+        return pt.optimizer.Adam(learning_rate=1e-3)
+
+    train_reader = reader.batch(
+        reader.firstn(mnist.train(), 64), batch_size=16)
+
+    ckpt = pt.CheckpointConfig(str(tmp_path), max_num_checkpoints=2,
+                               step_interval=2)
+    seen = {"steps": 0, "losses": []}
+
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent):
+            seen["steps"] += 1
+            seen["losses"].append(float(event.metrics[0]))
+
+    trainer = pt.Trainer(train_func, optimizer_func, place=pt.CPUPlace(),
+                         checkpoint_config=ckpt)
+    trainer.train(num_epochs=2, event_handler=handler,
+                  reader=train_reader, feed_order=["img", "label"])
+    assert seen["steps"] == 8
+    assert seen["losses"][-1] < seen["losses"][0]
+
+    # rotation kept at most 2 checkpoints
+    dirs = [d for d in os.listdir(tmp_path) if d.startswith("checkpoint_")]
+    assert len(dirs) <= 2
+
+    # test() path
+    metrics = trainer.test(reader=train_reader, feed_order=["img", "label"])
+    assert np.isfinite(metrics[0])
+
+    # resume: fresh trainer picks up the checkpoint, epoch offset honored
+    trainer2 = pt.Trainer(train_func, optimizer_func, place=pt.CPUPlace(),
+                          checkpoint_config=ckpt)
+    assert trainer2.epoch_offset >= 1
+    m2 = trainer2.test(reader=train_reader, feed_order=["img", "label"])
+    np.testing.assert_allclose(m2[0], metrics[0], rtol=1e-5)
+
+
+def test_trainer_uci_housing_linear_regression():
+    """The book's fit_a_line example (ref tests/book/test_fit_a_line.py)."""
+    def train_func():
+        x = layers.data("x", [13], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, act=None)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    train_reader = reader.batch(
+        reader.shuffle(uci_housing.train(), buf_size=256, seed=0), 32)
+    losses = []
+
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent):
+            losses.append(float(event.metrics[0]))
+
+    trainer = pt.Trainer(train_func,
+                         lambda: pt.optimizer.SGD(learning_rate=0.05),
+                         place=pt.CPUPlace())
+    trainer.train(num_epochs=12, event_handler=handler,
+                  reader=train_reader, feed_order=["x", "y"])
+    assert losses[-1] < losses[0] * 0.2, f"{losses[0]} -> {losses[-1]}"
