@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7: the load-bearing
+cuDNN/cuBLAS/xbyak kernels' TPU-native replacements)."""
+from .flash_attention import flash_attention
+from .layer_norm import fused_layer_norm

@@ -7,6 +7,7 @@ program) and appends ops to the main program.
 """
 from __future__ import annotations
 
+import copy
 from typing import List, Optional, Sequence, Union
 
 import numpy as np
@@ -743,6 +744,42 @@ def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
     helper.append_op("uniform_random", {}, {"Out": [out]},
                      {"shape": list(shape), "dtype": str(dtype),
                       "min": float(min), "max": float(max), "seed": seed})
+    return out
+
+
+def fused_multihead_attention(queries, keys, values, n_head, causal=False,
+                              param_attr=None, name=None):
+    """Projected multi-head attention as ONE fused op (flash kernel on
+    TPU).  queries/keys/values: [B, T, D]; returns [B, T, D].  The unfused
+    composition lives in nets.scaled_dot_product_attention."""
+    helper = LayerHelper("fused_attention", name=name)
+    d_model = int(queries.shape[-1])
+
+    def proj_attr(suffix):
+        # a shared named param_attr would alias all four projections to one
+        # parameter; derive a unique name per projection instead
+        a = ParamAttr._to_attr(param_attr)
+        if a is not None and a.name:
+            a = copy.copy(a)
+            a.name = f"{a.name}.{suffix}"
+        return a
+
+    projs = []
+    for x, sfx in zip((queries, keys, values), ("q", "k", "v")):
+        w = helper.create_parameter(proj_attr(sfx),
+                                    shape=[d_model, d_model],
+                                    dtype=queries.dtype)
+        out = helper.create_variable_for_type_inference(queries.dtype)
+        helper.append_op("matmul", {"X": [x], "Y": [w]}, {"Out": [out]}, {})
+        projs.append(out)
+    att = helper.create_variable_for_type_inference(queries.dtype)
+    helper.append_op("fused_attention",
+                     {"Q": [projs[0]], "K": [projs[1]], "V": [projs[2]]},
+                     {"Out": [att]}, {"n_head": n_head, "causal": causal})
+    wo = helper.create_parameter(proj_attr("o"), shape=[d_model, d_model],
+                                 dtype=queries.dtype)
+    out = helper.create_variable_for_type_inference(queries.dtype)
+    helper.append_op("matmul", {"X": [att], "Y": [wo]}, {"Out": [out]}, {})
     return out
 
 

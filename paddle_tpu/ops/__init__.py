@@ -20,3 +20,4 @@ from . import control_flow
 from . import sequence_ops
 from . import detection_ops
 from . import collective_ops
+from . import attention_ops

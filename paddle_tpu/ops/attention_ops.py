@@ -1,0 +1,46 @@
+"""Fused attention program ops backed by the Pallas flash kernel.
+
+No reference equivalent exists (2018 codebase computes attention as
+unfused matmul+softmax ops, e.g. nets.scaled_dot_product_attention in
+python/paddle/fluid/nets.py) — this op is the TPU-native upgrade: one
+program op that lowers to kernels/flash_attention.py, O(T) memory.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import flags
+from ..framework.registry import register_op
+
+
+@register_op("fused_attention")
+def _fused_attention(ctx, ins, attrs):
+    """Q,K,V: [B, T, n_head*d].  Out: [B, T, n_head*d].
+    attrs: n_head, causal, scale (0 => 1/sqrt(d))."""
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    n_head = int(attrs["n_head"])
+    causal = bool(attrs.get("causal", False))
+    B, T, E = q.shape
+    d = E // n_head
+
+    def split(x):
+        return x.reshape(B, T, n_head, d).transpose(0, 2, 1, 3)
+
+    scale = float(attrs.get("scale", 0.0)) or None
+    if flags.get_flag("use_pallas_kernels"):
+        from ..kernels.flash_attention import flash_attention
+        o = flash_attention(split(q), split(k), split(v), causal=causal,
+                            scale=scale)
+    else:
+        import numpy as np
+        import jax
+        qh, kh, vh = split(q), split(k), split(v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * (
+            scale or 1.0 / np.sqrt(d))
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+    out = o.transpose(0, 2, 1, 3).reshape(B, T, E)
+    return {"Out": [out]}

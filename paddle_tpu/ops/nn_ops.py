@@ -232,10 +232,19 @@ def _batch_norm(ctx, ins, attrs):
 
 @register_op("layer_norm")
 def _layer_norm(ctx, ins, attrs):
-    """ref layer_norm_op.cc: normalise over dims >= begin_norm_axis."""
+    """ref layer_norm_op.cc: normalise over dims >= begin_norm_axis.
+    Fast path: the fused Pallas kernel (kernels/layer_norm.py) when
+    normalising a single trailing axis with affine params."""
     x = single_input(ins)
     eps = float(attrs.get("epsilon", 1e-5))
     axis = int(attrs.get("begin_norm_axis", 1))
+    from ..core import flags as _flags
+    if (_flags.get_flag("use_pallas_kernels") and axis == x.ndim - 1
+            and ins.get("Scale") and ins.get("Bias")):
+        from ..kernels.layer_norm import fused_layer_norm
+        y, mean, var = fused_layer_norm(x, ins["Scale"][0], ins["Bias"][0],
+                                        eps=eps, return_stats=True)
+        return {"Y": [y], "Mean": [mean], "Variance": [var]}
     axes = tuple(range(axis, x.ndim))
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
