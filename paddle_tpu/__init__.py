@@ -34,6 +34,9 @@ from . import metrics
 from . import nets
 from . import reader
 from . import dataset
+from . import transpiler
+from . import inference
+from . import distributed
 from .data_feeder import DataFeeder
 from .trainer import (BeginEpochEvent, BeginStepEvent, CheckpointConfig,
                       EndEpochEvent, EndStepEvent, Trainer)

@@ -1,0 +1,2 @@
+"""Distributed coordination utilities (ref go/ layer of the reference)."""
+from .task_queue import Task, TaskMaster, TaskMasterClient, serve_master

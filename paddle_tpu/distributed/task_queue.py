@@ -1,0 +1,251 @@
+"""Fault-tolerant dataset-task master (the go/master capability).
+
+Capability parity with /root/reference/go/master/service.go: the master
+partitions input shards into leased tasks (`partition()` service.go:89,
+`SetDataset:280`), hands them to trainers (`GetTask:368`), requeues tasks
+whose lease times out (`:341`) or that fail (`TaskFailed:455`, max 3
+retries), marks completions (`TaskFinished:411`), and persists queue state
+so a restarted master resumes where it left off (etcd snapshot `:207`,
+recover `:166`).
+
+TPU-native redesign: no etcd — state snapshots to a JSON file with atomic
+rename (the same CRC-and-rename discipline as go/pserver/service.go:346);
+transport is a thread-per-connection JSON-lines TCP server (the Go RPC
+layer's role), so trainers on any host of the pod can lease work.  For
+preemption-tolerant TPU training the master runs on the coordinator host.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+MAX_FAILURES = 3          # ref service.go failureMax
+DEFAULT_TIMEOUT = 60.0    # lease seconds (ref chunkTimeout)
+
+
+@dataclass
+class Task:
+    task_id: int
+    shards: List[str]
+    epoch: int = 0
+    failures: int = 0
+
+
+class TaskMaster:
+    """In-process core; wrap with serve_master() for TCP access."""
+
+    def __init__(self, snapshot_path: Optional[str] = None,
+                 lease_timeout: float = DEFAULT_TIMEOUT,
+                 snapshot_interval: float = 0.5):
+        self._lock = threading.Lock()
+        self.snapshot_path = snapshot_path
+        self.lease_timeout = lease_timeout
+        # throttle: snapshots are recovery hints (pending leases are void
+        # on restart anyway), so per-op durability buys nothing — write at
+        # most every snapshot_interval seconds
+        self.snapshot_interval = snapshot_interval
+        self._last_snapshot = 0.0
+        self.todo: List[Task] = []
+        self.pending: Dict[int, dict] = {}   # task_id -> {task, deadline}
+        self.done: List[Task] = []
+        self.failed_forever: List[Task] = []
+        self._next_id = 0
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # -- dataset ----------------------------------------------------------
+    def set_dataset(self, shard_paths: List[str], shards_per_task: int = 1):
+        """ref SetDataset/partition (service.go:280,89)."""
+        with self._lock:
+            if self.todo or self.pending or self.done:
+                return  # already initialised (idempotent like the ref)
+            for i in range(0, len(shard_paths), shards_per_task):
+                self.todo.append(Task(self._next_id,
+                                      shard_paths[i:i + shards_per_task]))
+                self._next_id += 1
+            self._snapshot(force=True)
+
+    # -- trainer API ------------------------------------------------------
+    def get_task(self) -> Optional[Task]:
+        """Lease a task (ref GetTask:368); None => drained or all leased."""
+        with self._lock:
+            self._requeue_expired()
+            if not self.todo:
+                return None
+            t = self.todo.pop(0)
+            self.pending[t.task_id] = {
+                "task": t, "deadline": time.time() + self.lease_timeout}
+            self._snapshot()
+            return t
+
+    def task_finished(self, task_id: int) -> bool:
+        """ref TaskFinished:411."""
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent is None:
+                return False
+            self.done.append(ent["task"])
+            # epoch rollover: when everything is done, recycle (ref master
+            # re-queues for the next pass)
+            if not self.todo and not self.pending:
+                for t in self.done:
+                    t.epoch += 1
+                    t.failures = 0
+                self.todo = self.done
+                self.done = []
+            self._snapshot()
+            return True
+
+    def task_failed(self, task_id: int) -> bool:
+        """ref TaskFailed:455 — requeue up to MAX_FAILURES."""
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent is None:
+                return False
+            t = ent["task"]
+            t.failures += 1
+            if t.failures >= MAX_FAILURES:
+                self.failed_forever.append(t)
+            else:
+                self.todo.append(t)
+            self._snapshot()
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            self._requeue_expired()
+            return {"todo": len(self.todo), "pending": len(self.pending),
+                    "done": len(self.done),
+                    "failed_forever": len(self.failed_forever)}
+
+    # -- internals --------------------------------------------------------
+    def _requeue_expired(self):
+        """Lease timeout -> back on the queue (ref checkTimeoutFunc:341)."""
+        now = time.time()
+        expired = [tid for tid, e in self.pending.items()
+                   if e["deadline"] < now]
+        for tid in expired:
+            t = self.pending.pop(tid)["task"]
+            t.failures += 1
+            if t.failures >= MAX_FAILURES:
+                self.failed_forever.append(t)
+            else:
+                self.todo.append(t)
+
+    def _snapshot(self, force: bool = False):
+        if not self.snapshot_path:
+            return
+        now = time.time()
+        if not force and now - self._last_snapshot < self.snapshot_interval:
+            return
+        self._last_snapshot = now
+        state = {
+            "next_id": self._next_id,
+            "todo": [t.__dict__ for t in self.todo],
+            # pending tasks snapshot back into todo: on master restart
+            # their leases are void anyway (ref recover semantics)
+            "pending": [e["task"].__dict__ for e in self.pending.values()],
+            "done": [t.__dict__ for t in self.done],
+            "failed_forever": [t.__dict__ for t in self.failed_forever],
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)   # atomic (ref service.go:346)
+
+    def _recover(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self._next_id = state["next_id"]
+        self.todo = [Task(**d) for d in state["todo"] + state["pending"]]
+        self.done = [Task(**d) for d in state["done"]]
+        self.failed_forever = [Task(**d) for d in state["failed_forever"]]
+
+
+# -- TCP transport (JSON lines) -------------------------------------------
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        master: TaskMaster = self.server.master   # type: ignore
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                method = req["method"]
+                if method == "get_task":
+                    t = master.get_task()
+                    resp = {"ok": True, "task": t.__dict__ if t else None}
+                elif method == "task_finished":
+                    resp = {"ok": master.task_finished(req["task_id"])}
+                elif method == "task_failed":
+                    resp = {"ok": master.task_failed(req["task_id"])}
+                elif method == "set_dataset":
+                    master.set_dataset(req["shards"],
+                                       req.get("shards_per_task", 1))
+                    resp = {"ok": True}
+                elif method == "stats":
+                    resp = {"ok": True, "stats": master.stats()}
+                else:
+                    resp = {"ok": False, "error": f"bad method {method}"}
+            except Exception as e:   # keep the server alive
+                resp = {"ok": False, "error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_master(master: TaskMaster, host: str = "127.0.0.1",
+                 port: int = 0):
+    """Start the TCP front end; returns (server, (host, port)).  Call
+    server.shutdown() to stop."""
+    srv = _Server((host, port), _Handler)
+    srv.master = master   # type: ignore
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address
+
+
+class TaskMasterClient:
+    """Trainer-side client (ref python/paddle/v2/master/client.py:29)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout)
+        self._f = self._sock.makefile("rwb")
+
+    def _call(self, **req) -> dict:
+        self._f.write((json.dumps(req) + "\n").encode())
+        self._f.flush()
+        resp = json.loads(self._f.readline())
+        if not resp.get("ok") and "error" in resp:
+            raise RuntimeError(f"master error: {resp['error']}")
+        return resp
+
+    def set_dataset(self, shards: List[str], shards_per_task: int = 1):
+        self._call(method="set_dataset", shards=shards,
+                   shards_per_task=shards_per_task)
+
+    def get_task(self) -> Optional[Task]:
+        resp = self._call(method="get_task")
+        return Task(**resp["task"]) if resp.get("task") else None
+
+    def task_finished(self, task_id: int):
+        self._call(method="task_finished", task_id=task_id)
+
+    def task_failed(self, task_id: int):
+        self._call(method="task_failed", task_id=task_id)
+
+    def stats(self) -> dict:
+        return self._call(method="stats")["stats"]
+
+    def close(self):
+        self._f.close()
+        self._sock.close()

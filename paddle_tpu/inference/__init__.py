@@ -1,0 +1,3 @@
+"""Inference/deployment layer (ref /root/reference/paddle/fluid/inference/)."""
+from .predictor import (AnalysisConfig, NativeConfig, Predictor,
+                        create_predictor)

@@ -21,3 +21,4 @@ from . import sequence_ops
 from . import detection_ops
 from . import collective_ops
 from . import attention_ops
+from . import quantize_ops

@@ -61,6 +61,8 @@ def _conv2d(ctx, ins, attrs):
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         preferred_element_type=_acc(x))
+    if ins.get("Bias"):    # optional fused bias (inference transpiler fold)
+        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
     return {"Output": [out.astype(x.dtype)]}
 
 

@@ -1,0 +1,76 @@
+"""Fake quantization ops for QAT.
+
+Parity with /root/reference/paddle/fluid/operators/fake_quantize_op.cc
+(abs-max and moving-average-abs-max variants) and fake_dequantize_op.cc.
+Quantize-dequantize in one op (straight-through estimator): rounding is
+a zero-gradient op, so the executor's whole-program vjp sees identity —
+exactly the reference's QAT training semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, single_input
+
+
+def _ste_round(x):
+    """round with straight-through gradient."""
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+@register_op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = single_input(ins)
+    bit_length = int(attrs.get("bit_length", 8))
+    qmax = float(2 ** (bit_length - 1) - 1)
+    scale = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = jnp.maximum(scale, 1e-8)
+    q = _ste_round(jnp.clip(x / scale * qmax, -qmax, qmax))
+    return {"Out": [(q * scale / qmax).astype(x.dtype)],
+            "OutScale": [scale]}
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+def _fake_quantize_ma(ctx, ins, attrs):
+    x = single_input(ins)
+    in_scale = ins["InScale"][0]
+    bit_length = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False))
+    qmax = float(2 ** (bit_length - 1) - 1)
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = jnp.where(is_test, in_scale,
+                      rate * in_scale + (1 - rate) * cur)
+    scale = jnp.maximum(scale, 1e-8)
+    q = _ste_round(jnp.clip(x / scale * qmax, -qmax, qmax))
+    return {"Out": [(q * scale / qmax).astype(x.dtype)],
+            "OutScale": [scale]}
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def _fake_cw_quant(ctx, ins, attrs):
+    """Per-channel weight quantization.  quant_axis: 0 for conv2d (OIHW
+    output channels), 1 for mul/matmul ([in, out]) and conv2d_transpose
+    (IOHW) — ref quantization pass semantics."""
+    x = single_input(ins)
+    bit_length = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    qmax = float(2 ** (bit_length - 1) - 1)
+    axes = tuple(a for a in range(x.ndim) if a != axis)
+    scale = jnp.max(jnp.abs(x), axis=axes).astype(jnp.float32)
+    scale = jnp.maximum(scale, 1e-8)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = scale.reshape(shape)
+    q = _ste_round(jnp.clip(x / s * qmax, -qmax, qmax))
+    return {"Out": [(q * s / qmax).astype(x.dtype)],
+            "OutScale": [scale]}
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize(ctx, ins, attrs):
+    x = single_input(ins)
+    scale = ins["Scale"][0]
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [(x * scale / max_range).astype(x.dtype)]}

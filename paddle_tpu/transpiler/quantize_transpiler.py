@@ -1,0 +1,116 @@
+"""QAT quantize transpiler.
+
+Capability parity with /root/reference/python/paddle/fluid/contrib/quantize/
+quantize_transpiler.py:81 (QuantizeTranspiler): rewrites a training program
+so every quantizable op (mul/matmul/conv2d) reads fake-quantized inputs and
+weights — abs_max or moving_average_abs_max activation quantization,
+channel-wise abs_max weight quantization — training stays fp with
+straight-through gradients, export folds to int8 scales.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..framework.program import Program
+from ..framework import unique_name
+
+QUANTIZABLE_OPS = ("mul", "matmul", "conv2d", "conv2d_transpose")
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 moving_rate: float = 0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.moving_rate = moving_rate
+
+    def training_transpile(self, program: Optional[Program] = None,
+                           startup_program: Optional[Program] = None):
+        from ..framework.program import (default_main_program,
+                                         default_startup_program)
+        program = program or default_main_program()
+        self._startup = startup_program or default_startup_program()
+        block = program.global_block()
+        params = {p.name for p in program.all_parameters()}
+        quantized = {}   # original var name -> quantized var name
+
+        new_ops: list = []
+        for op in list(block.ops):
+            if op.type in QUANTIZABLE_OPS:
+                self._consumer_type = op.type
+                for slot, names in op.inputs.items():
+                    new_names = []
+                    for n in names:
+                        if n not in quantized:
+                            qname = self._insert_quant(block, new_ops, n,
+                                                       n in params)
+                            quantized[n] = qname
+                        new_names.append(quantized[n])
+                    op.inputs[slot] = new_names
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump()
+        return program
+
+    def _insert_quant(self, block, new_ops, name: str, is_weight: bool):
+        var = block.var(name)
+        qname = unique_name.generate(name + ".quantized")
+        out = block.create_var(qname, shape=var.shape, dtype=var.dtype)
+        scale = block.create_var(unique_name.generate(name + ".scale"),
+                                 dtype="float32")
+        if is_weight:
+            if self.weight_quantize_type == "channel_wise_abs_max":
+                op_type = "fake_channel_wise_quantize_abs_max"
+            else:
+                op_type = "fake_quantize_abs_max"
+            attrs = {"bit_length": self.weight_bits}
+            inputs = {"X": [name]}
+        else:
+            if self.activation_quantize_type == "moving_average_abs_max":
+                op_type = "fake_quantize_moving_average_abs_max"
+                # moving scale is persistable state, initialised to 1.0 in
+                # the startup program (ref quantize_transpiler scale state)
+                in_scale = block.create_var(
+                    unique_name.generate(name + ".in_scale"),
+                    shape=[], dtype="float32", persistable=True)
+                sb = self._startup.global_block()
+                sb.create_var(in_scale.name, shape=[], dtype="float32",
+                              persistable=True)
+                sb.append_op("fill_constant", {},
+                             {"Out": [in_scale.name]},
+                             {"shape": [], "dtype": "float32",
+                              "value": 1.0})
+                inputs = {"X": [name], "InScale": [in_scale.name]}
+                attrs = {"bit_length": self.activation_bits,
+                         "moving_rate": self.moving_rate, "is_test": False}
+                # OutScale writes back the persistable InScale var, so the
+                # moving average actually advances across steps (executor
+                # persists state by var name)
+                scale = in_scale
+            else:
+                op_type = "fake_quantize_abs_max"
+                attrs = {"bit_length": self.activation_bits}
+                inputs = {"X": [name]}
+        if is_weight and op_type == "fake_channel_wise_quantize_abs_max":
+            # ref quantization_pass: quant_axis 1 for mul/matmul ([in,out])
+            # and conv2d_transpose (IOHW), 0 for conv2d (OIHW)
+            attrs["quant_axis"] = 0 if self._consumer_type == "conv2d" else 1
+        from ..framework.program import Operator
+        op = Operator(block, op_type, inputs,
+                      {"Out": [qname], "OutScale": [scale.name]}, attrs)
+        new_ops.append(op)
+        return qname
+
+    def freeze_program(self, program: Program):
+        """Export-time: flip moving-average quant ops to is_test (scales
+        frozen) — the int8 kernel swap is XLA's int8 matmul when targeted."""
+        for b in program.blocks:
+            for op in b.ops:
+                if op.type == "fake_quantize_moving_average_abs_max":
+                    op.attrs["is_test"] = True
+        program._bump()
+        return program

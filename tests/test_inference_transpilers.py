@@ -1,0 +1,199 @@
+"""Predictor, quantize/inference transpilers, task master
+(ref test tiers: inference/tests/api analyzers, test_quantize_transpiler,
+go master service tests)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.distributed import TaskMaster, TaskMasterClient, serve_master
+from paddle_tpu.inference import AnalysisConfig, create_predictor
+from paddle_tpu.transpiler import (DistributeTranspiler, InferenceTranspiler,
+                                   QuantizeTranspiler, memory_optimize)
+
+
+def _train_lenet_and_save(tmp_path, steps=2):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28], dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        from paddle_tpu.models.lenet import lenet
+        pred = lenet(img)
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        pt.optimizer.SGD(0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(4, 1, 28, 28).astype("float32"),
+            "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+    for _ in range(steps):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, ["img"], [pred], exe, main_program=main)
+    return d, feed, exe, main, pred
+
+
+def test_predictor_end_to_end(tmp_path):
+    d, feed, exe, main, pred = _train_lenet_and_save(tmp_path)
+    cfg = AnalysisConfig(model_dir=d, use_tpu=False)
+    p = create_predictor(cfg)
+    assert p.get_input_names() == ["img"]
+    p.prepare({"img": feed["img"]})           # AOT
+    out, = p.run({"img": feed["img"]})
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+    # parity with the test-mode clone of the training program (the saved
+    # model is clone(for_test=True): BN uses global stats, dropout off)
+    test_prog = main.clone(for_test=True).prune(["img"], [pred.name])
+    ref, = exe.run(test_prog, feed={"img": feed["img"]},
+                   fetch_list=[pred.name])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # clone shares compiled state
+    p2 = p.clone()
+    out2, = p2.run({"img": feed["img"]})
+    np.testing.assert_allclose(out2, out)
+    with pytest.raises(Exception):
+        p.run({})
+
+
+def test_inference_transpiler_folds_conv_bn(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [3, 16, 16], dtype="float32")
+        conv = layers.conv2d(img, 8, 3, bias_attr=False)
+        bn = layers.batch_norm(conv, is_test=True)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(2, 3, 16, 16).astype("float32")}
+    before, = exe.run(main, feed=feed, fetch_list=[bn])
+
+    test_prog = main.clone(for_test=True)
+    n_ops_before = len(test_prog.global_block().ops)
+    InferenceTranspiler().transpile(test_prog, scope=exe.scope)
+    assert len(test_prog.global_block().ops) < n_ops_before
+    assert not any(op.type == "batch_norm"
+                   for op in test_prog.global_block().ops)
+    after, = exe.run(test_prog, feed=feed, fetch_list=[bn.name])
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_quantize_transpiler_qat_trains():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    QuantizeTranspiler().training_transpile(main, startup)
+    quant_ops = [op.type for op in main.global_block().ops
+                 if op.type.startswith("fake_")]
+    assert len(quant_ops) >= 4   # act+weight per fc
+    with pt.program_guard(main, startup):
+        pt.optimizer.SGD(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 1).astype("float32")
+    feed = {"x": rng.randn(64, 16).astype("float32")}
+    feed["y"] = feed["x"] @ w
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_memory_optimize_api_parity():
+    p = pt.Program()
+    assert memory_optimize(p) is p
+
+
+def test_distribute_transpiler_contract():
+    t = DistributeTranspiler()
+    prog = pt.Program()
+    t.transpile(trainer_id=0, program=prog, trainers=2)
+    assert t.get_trainer_program() is prog
+    with pytest.raises(NotImplementedError):
+        t.get_pserver_program("127.0.0.1:6174")
+
+
+def test_task_master_lease_retry_snapshot(tmp_path):
+    snap = str(tmp_path / "master.json")
+    m = TaskMaster(snapshot_path=snap, lease_timeout=0.2)
+    m.set_dataset([f"shard{i}" for i in range(6)], shards_per_task=2)
+    srv, (host, port) = serve_master(m)
+    try:
+        c = TaskMasterClient(host, port)
+        t1 = c.get_task()
+        t2 = c.get_task()
+        assert t1.task_id != t2.task_id
+        c.task_finished(t1.task_id)
+        c.task_failed(t2.task_id)          # requeued
+        t2b = c.get_task()
+        ids = {t2.task_id}
+        # lease timeout requeues the un-acked task
+        t3 = c.get_task()
+        assert t3 is not None
+        time.sleep(0.3)
+        stats = c.stats()
+        assert stats["todo"] >= 1          # t3 expired back to todo
+        c.close()
+    finally:
+        srv.shutdown()
+
+    # master restart recovers state from snapshot
+    m2 = TaskMaster(snapshot_path=snap)
+    s = m2.stats()
+    assert s["todo"] + s["pending"] + s["done"] == 3
+
+
+def test_task_master_epoch_rollover():
+    m = TaskMaster()
+    m.set_dataset(["a", "b"])
+    t1, t2 = m.get_task(), m.get_task()
+    m.task_finished(t1.task_id)
+    m.task_finished(t2.task_id)
+    t = m.get_task()
+    assert t is not None and t.epoch == 1
+
+
+def test_moving_average_scale_state_advances():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        h = layers.fc(x, size=4)
+        loss = layers.mean(h)
+    QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max"
+    ).training_transpile(main, startup)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    scale_names = [v.name for v in main.list_vars()
+                   if v.persistable and ".in_scale" in v.name]
+    assert scale_names
+    rng = np.random.RandomState(0)
+    feed = {"x": (rng.randn(32, 8) * 50).astype("float32")}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    s1 = float(np.asarray(exe.scope.find_var(scale_names[0])))
+    exe.run(main, feed=feed, fetch_list=[loss])
+    s2 = float(np.asarray(exe.scope.find_var(scale_names[0])))
+    assert s1 != 1.0, "scale must move after step 1"
+    assert s2 != s1, "scale must keep moving"
+
+
+def test_conv_bn_fold_skipped_when_conv_output_reused():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [3, 8, 8], dtype="float32")
+        conv = layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        bn = layers.batch_norm(conv, is_test=True)
+        both = layers.elementwise_add(bn, conv)   # skip reads pre-BN var
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    test_prog = main.clone(for_test=True)
+    InferenceTranspiler().transpile(test_prog, scope=exe.scope)
+    assert any(op.type == "batch_norm"
+               for op in test_prog.global_block().ops)
