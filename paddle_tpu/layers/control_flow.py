@@ -1,0 +1,228 @@
+"""Control-flow layer DSL (ref python/paddle/fluid/layers/control_flow.py:
+While:504, Switch:1139, IfElse:1265, StaticRNN:278, DynamicRNN:1395).
+
+TPU-first: the block-builder API is preserved (context managers appending
+ops into sub-blocks) but the sub-blocks lower to lax.while_loop/lax.cond/
+lax.scan, so shapes must be loop-invariant and ragged sequences come in
+padded with masks (DynamicRNN capability = StaticRNN over padded batch +
+sequence_mask; SURVEY.md hard part (a/b))."""
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.program import Variable, default_main_program
+from ..framework import unique_name
+from . import tensor as tensor_layers
+
+
+class While:
+    """ref control_flow.py:504.
+
+    i = layers.fill_constant([1], "int64", 0)
+    n = layers.fill_constant([1], "int64", 10)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        ... ops writing loop state (must re-assign cond via layers.assign)
+    """
+
+    def __init__(self, cond: Variable, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.program = self.helper.main_program
+
+    @contextlib.contextmanager
+    def block(self):
+        parent_idx = self.program._current_block_idx
+        sub = self.program.create_block()
+        yield
+        self.program._current_block_idx = parent_idx
+        parent = self.program.blocks[parent_idx]
+        parent.append_op("while", {"Cond": [self.cond_var.name]}, {},
+                         {"sub_block": sub.idx,
+                          "condition": self.cond_var.name})
+
+
+class Switch:
+    """ref control_flow.py:1139 — builds a chain of conditional blocks.
+
+    with layers.Switch() as switch:
+        with switch.case(cond1): ...assign...
+        with switch.default(): ...assign...
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.program = self.helper.main_program
+        self._case_conds: List[Variable] = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @contextlib.contextmanager
+    def case(self, condition: Variable):
+        # condition AND not(any previous condition)
+        from . import nn
+        cond = condition
+        for prev in self._case_conds:
+            notp = nn.logical_not(prev)
+            cond = nn.logical_and(cond, notp)
+        self._case_conds.append(condition)
+        with _conditional_block(self.program, cond):
+            yield
+
+    @contextlib.contextmanager
+    def default(self):
+        from . import nn
+        assert self._case_conds, "default() requires at least one case()"
+        cond = nn.logical_not(self._case_conds[0])
+        for prev in self._case_conds[1:]:
+            cond = nn.logical_and(cond, nn.logical_not(prev))
+        with _conditional_block(self.program, cond):
+            yield
+
+
+@contextlib.contextmanager
+def _conditional_block(program, cond: Variable):
+    parent_idx = program._current_block_idx
+    sub = program.create_block()
+    yield
+    program._current_block_idx = parent_idx
+    parent = program.blocks[parent_idx]
+    # out_vars: every pre-existing var the sub-block writes
+    written = []
+    for op in sub.ops:
+        for names in op.outputs.values():
+            for n in names:
+                if n and n not in written:
+                    written.append(n)
+    outs = [n for n in written if parent.has_var(n)]
+    parent.append_op("conditional_block", {"Cond": [cond.name]},
+                     {"Out": outs},
+                     {"sub_block": sub.idx, "out_vars": outs})
+
+
+class StaticRNN:
+    """ref control_flow.py:278 — per-timestep block over [B, T, ...]
+    inputs, lowered to ONE lax.scan.
+
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)            # [B, D] slice of [B, T, D]
+        h_prev = rnn.memory(init=h0)       # carried state
+        h = layers.fc(concat([x_t, h_prev]), size=H, act="tanh")
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()                            # [B, T, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.program = self.helper.main_program
+        self._x: List[tuple] = []          # (outer var, inner var)
+        self._memories: List[dict] = []
+        self._outputs: List[Variable] = []
+        self._sub = None
+        self._parent_idx = None
+        self._result: Optional[List[Variable]] = None
+
+    @contextlib.contextmanager
+    def step(self):
+        self._parent_idx = self.program._current_block_idx
+        self._sub = self.program.create_block()
+        yield
+        self.program._current_block_idx = self._parent_idx
+        self._finalize()
+
+    def step_input(self, x: Variable) -> Variable:
+        """x: [B, T, ...] outer var; returns the per-step [B, ...] var."""
+        inner = self._sub.create_var(
+            name=unique_name.generate("rnn_step_in"), dtype=x.dtype,
+            shape=(x.shape[0],) + tuple(x.shape[2:]) if x.shape else None)
+        self._x.append((x, inner))
+        return inner
+
+    def memory(self, init: Variable) -> Variable:
+        """Carried state initialised from `init` [B, H]."""
+        inner = self._sub.create_var(
+            name=unique_name.generate("rnn_mem"), dtype=init.dtype,
+            shape=init.shape)
+        self._memories.append({"init": init, "pre": inner, "new": None})
+        return inner
+
+    def update_memory(self, mem: Variable, new: Variable):
+        for m in self._memories:
+            if m["pre"].name == mem.name:
+                m["new"] = new
+                return
+        raise ValueError(f"{mem.name} is not a memory of this StaticRNN")
+
+    def step_output(self, out: Variable):
+        self._outputs.append(out)
+
+    def _finalize(self):
+        parent = self.program.blocks[self._parent_idx]
+        for m in self._memories:
+            if m["new"] is None:
+                raise ValueError("every memory needs update_memory()")
+        # carry var names: inside the block, after running ops, the carry
+        # value for memory m is m['new']; the scan op maps carry slot name
+        # pre -> reads new. We implement by appending assign new->pre.
+        for m in self._memories:
+            self._sub.append_op("assign", {"X": [m["new"].name]},
+                                {"Out": [m["pre"].name]}, {})
+        carry = [m["pre"].name for m in self._memories]
+        # x vars are scanned over time: the op needs [T, B, ...]; outer
+        # vars are [B, T, ...] so transpose first in the parent block
+        x_names = []
+        for outer, inner in self._x:
+            perm = list(range(len(outer.shape)))
+            perm[0], perm[1] = 1, 0
+            t_var = parent.create_var(
+                name=unique_name.generate(outer.name + ".tbd"),
+                dtype=outer.dtype)
+            parent.append_op("transpose", {"X": [outer.name]},
+                             {"Out": [t_var.name]}, {"axis": perm})
+            x_names.append((t_var.name, inner.name))
+        y_names = [o.name for o in self._outputs]
+
+        outs = [parent.create_var(name=unique_name.generate("rnn_out"),
+                                  dtype=o.dtype) for o in self._outputs]
+        carry_outs = [parent.create_var(
+            name=unique_name.generate("rnn_carry"), dtype=m["init"].dtype)
+            for m in self._memories]
+        parent.append_op(
+            "static_rnn_scan",
+            {"Init": [m["init"].name for m in self._memories],
+             "X": [t for t, _ in x_names]},
+            {"Ys": [o.name for o in outs],
+             "CarryOut": [c.name for c in carry_outs]},
+            {"sub_block": self._sub.idx,
+             "carry_vars": carry,
+             "x_inner_vars": [i for _, i in x_names],
+             "y_vars": y_names})
+        self._result = outs
+
+    def __call__(self) -> Variable:
+        """Returns the first step_output stacked over time as [B, T, ...]."""
+        helper = LayerHelper("static_rnn_out")
+        out = self._result[0]
+        tr = helper.create_variable_for_type_inference(out.dtype)
+        # scan stacks as [T, B, ...] -> transpose back
+        nd = len(self._outputs[0].shape or (0, 0)) + 1
+        perm = list(range(nd))
+        perm[0], perm[1] = 1, 0
+        helper.main_program.current_block().append_op(
+            "transpose", {"X": [out.name]}, {"Out": [tr.name]},
+            {"axis": perm})
+        return tr
+
+    def outputs(self) -> List[Variable]:
+        return self._result

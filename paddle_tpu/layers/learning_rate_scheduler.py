@@ -1,0 +1,174 @@
+"""In-graph learning-rate schedules.
+
+Capability parity with /root/reference/python/paddle/fluid/layers/
+learning_rate_scheduler.py (noam_decay, exponential_decay, natural_exp_
+decay, inverse_time_decay, polynomial_decay, piecewise_decay, cosine_decay
++ linear_lr_warmup in the era's usage): a persistable global-step counter
+increments once per program run and the decayed lr is computed in-graph, so
+the schedule serializes with the program and resumes from checkpoints
+(the counter is persistable state like any optimizer accumulator).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.program import (Variable, default_main_program,
+                                 default_startup_program)
+from ..framework.registry import register_op, single_input
+from ..framework import unique_name
+
+GLOBAL_STEP_VAR = "@lr_global_step@"
+
+
+@register_op("lr_schedule")
+def _lr_schedule(ctx, ins, attrs):
+    step = single_input(ins, "Step").astype(jnp.float32).reshape(())
+    kind = attrs["kind"]
+    if kind == "noam":
+        d, warmup = float(attrs["d_model"]), float(attrs["warmup_steps"])
+        lr = d ** -0.5 * jnp.minimum(jnp.maximum(step, 1.0) ** -0.5,
+                                     jnp.maximum(step, 1.0) * warmup ** -1.5)
+    elif kind == "exponential":
+        base, decay_steps = float(attrs["lr"]), float(attrs["decay_steps"])
+        rate, stair = float(attrs["decay_rate"]), bool(attrs["staircase"])
+        e = step / decay_steps
+        e = jnp.floor(e) if stair else e
+        lr = base * rate ** e
+    elif kind == "natural_exp":
+        base, decay_steps = float(attrs["lr"]), float(attrs["decay_steps"])
+        rate, stair = float(attrs["decay_rate"]), bool(attrs["staircase"])
+        e = step / decay_steps
+        e = jnp.floor(e) if stair else e
+        lr = base * jnp.exp(-rate * e)
+    elif kind == "inverse_time":
+        base, decay_steps = float(attrs["lr"]), float(attrs["decay_steps"])
+        rate, stair = float(attrs["decay_rate"]), bool(attrs["staircase"])
+        e = step / decay_steps
+        e = jnp.floor(e) if stair else e
+        lr = base / (1.0 + rate * e)
+    elif kind == "polynomial":
+        base, decay_steps = float(attrs["lr"]), float(attrs["decay_steps"])
+        end, power = float(attrs["end_lr"]), float(attrs["power"])
+        if attrs["cycle"]:
+            div = jnp.ceil(jnp.maximum(step, 1.0) / decay_steps)
+            total = decay_steps * jnp.maximum(div, 1.0)
+        else:
+            total = decay_steps
+        s = jnp.minimum(step, total)
+        lr = (base - end) * (1 - s / total) ** power + end
+    elif kind == "piecewise":
+        boundaries = list(attrs["boundaries"])
+        values = list(attrs["values"])
+        lr = jnp.asarray(values[0], jnp.float32)
+        for b, v in zip(boundaries, values[1:]):
+            lr = jnp.where(step >= b, jnp.float32(v), lr)
+    elif kind == "cosine":
+        base, step_each = float(attrs["lr"]), float(attrs["step_each_epoch"])
+        epochs = float(attrs["epochs"])
+        cur_epoch = jnp.floor(step / step_each)
+        lr = base / 2.0 * (jnp.cos(cur_epoch * math.pi / epochs) + 1.0)
+    elif kind == "linear_warmup":
+        start, end = float(attrs["start_lr"]), float(attrs["end_lr"])
+        warmup = float(attrs["warmup_steps"])
+        frac = jnp.clip(step / warmup, 0.0, 1.0)
+        warm = start + (end - start) * frac
+        after = ins["After"][0].astype(jnp.float32).reshape(()) \
+            if ins.get("After") else jnp.float32(end)
+        lr = jnp.where(step < warmup, warm, after)
+    else:
+        raise ValueError(f"unknown lr schedule {kind!r}")
+    return {"Out": [lr.reshape(1)]}
+
+
+def _global_step(helper: LayerHelper) -> Variable:
+    """Shared persistable counter, incremented once per scheduler build
+    point (one increment per program run)."""
+    block = helper.main_program.global_block()
+    if block.has_var(GLOBAL_STEP_VAR):
+        return block.var(GLOBAL_STEP_VAR)
+    step = block.create_var(name=GLOBAL_STEP_VAR, shape=[1],
+                            dtype="int64", persistable=True,
+                            stop_gradient=True)
+    sb = helper.startup_program.global_block()
+    if not sb.has_var(GLOBAL_STEP_VAR):
+        sb.create_var(GLOBAL_STEP_VAR, shape=[1], dtype="int64",
+                      persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": [GLOBAL_STEP_VAR]},
+                     attrs={"shape": [1], "dtype": "int64", "value": 0})
+    block.append_op("increment_loop_counter", {"X": [GLOBAL_STEP_VAR]},
+                    {"Out": [GLOBAL_STEP_VAR]}, {"step": 1})
+    return step
+
+
+def _schedule(kind: str, inputs=None, **attrs) -> Variable:
+    helper = LayerHelper("lr_schedule")
+    step = _global_step(helper)
+    out = helper.block.create_var(
+        name=unique_name.generate(f"lr_{kind}"), shape=[1],
+        dtype="float32", stop_gradient=True)
+    ins = {"Step": [GLOBAL_STEP_VAR]}
+    for k, v in (inputs or {}).items():
+        ins[k] = [v.name if isinstance(v, Variable) else v]
+    helper.main_program.global_block().append_op(
+        "lr_schedule", ins, {"Out": [out.name]}, {"kind": kind, **attrs})
+    return out
+
+
+def noam_decay(d_model, warmup_steps):
+    return _schedule("noam", d_model=d_model, warmup_steps=warmup_steps)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    return _schedule("exponential", lr=learning_rate,
+                     decay_steps=decay_steps, decay_rate=decay_rate,
+                     staircase=staircase)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    return _schedule("natural_exp", lr=learning_rate,
+                     decay_steps=decay_steps, decay_rate=decay_rate,
+                     staircase=staircase)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    return _schedule("inverse_time", lr=learning_rate,
+                     decay_steps=decay_steps, decay_rate=decay_rate,
+                     staircase=staircase)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    return _schedule("polynomial", lr=learning_rate,
+                     decay_steps=decay_steps, end_lr=end_learning_rate,
+                     power=power, cycle=cycle)
+
+
+def piecewise_decay(boundaries, values):
+    assert len(values) == len(boundaries) + 1
+    return _schedule("piecewise", boundaries=list(boundaries),
+                     values=[float(v) for v in values])
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return _schedule("cosine", lr=learning_rate,
+                     step_each_epoch=step_each_epoch, epochs=epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """learning_rate may be a float or a schedule Variable to switch to
+    after warmup."""
+    inputs = {}
+    attrs = dict(warmup_steps=warmup_steps, start_lr=start_lr,
+                 end_lr=end_lr)
+    if isinstance(learning_rate, Variable):
+        inputs["After"] = learning_rate
+    else:
+        attrs["end_lr"] = float(learning_rate)
+    return _schedule("linear_warmup", inputs=inputs, **attrs)

@@ -747,6 +747,44 @@ def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
     return out
 
 
+def _binary_compare_layer(op_name, out_dtype="bool"):
+    def f(x, y, cond=None, name=None):
+        helper = LayerHelper(op_name, name=name)
+        out = cond or helper.create_variable_for_type_inference(out_dtype)
+        helper.append_op(op_name, {"X": [x], "Y": [y]}, {"Out": [out]}, {})
+        return out
+    f.__name__ = op_name
+    return f
+
+
+less_than = _binary_compare_layer("less_than")
+less_equal = _binary_compare_layer("less_equal")
+greater_than = _binary_compare_layer("greater_than")
+greater_equal = _binary_compare_layer("greater_equal")
+equal = _binary_compare_layer("equal")
+not_equal = _binary_compare_layer("not_equal")
+logical_and = _binary_compare_layer("logical_and")
+logical_or = _binary_compare_layer("logical_or")
+logical_xor = _binary_compare_layer("logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    out = out or helper.create_variable_for_type_inference("bool")
+    helper.append_op("logical_not", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def increment(x, value=1.0, in_place=True, name=None):
+    """ref layers/tensor increment: x += value (in place by default)."""
+    helper = LayerHelper("increment", name=name)
+    out = x if in_place else helper.create_variable_for_type_inference(
+        x.dtype)
+    helper.append_op("increment", {"X": [x]}, {"Out": [out]},
+                     {"step": float(value)})
+    return out
+
+
 def fused_multihead_attention(queries, keys, values, n_head, causal=False,
                               param_attr=None, name=None):
     """Projected multi-head attention as ONE fused op (flash kernel on

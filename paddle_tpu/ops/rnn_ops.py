@@ -1,0 +1,171 @@
+"""Recurrent ops: LSTM / GRU via lax.scan.
+
+Capability parity with the reference's RNN op family
+(/root/reference/paddle/fluid/operators/lstm_op.cc "dynamic_lstm",
+gru_op.cc "dynamic_gru", lstm_unit_op.cc, gru_unit_op.cc, cudnn_lstm_op —
+plus the math in operators/math/lstm_compute.cc / gru_compute.cc and the
+xbyak JIT lstm kernels).  TPU-first differences:
+
+  * sequences are dense [B, T, ...] with an optional float mask [B, T]
+    (1=token) instead of LoD ragged batches — masked steps carry the
+    previous state through, which reproduces LoD semantics for
+    right-padded batches (SURVEY.md hard part (a));
+  * the recurrence is ONE lax.scan over time: XLA keeps h/c in registers
+    /VMEM across steps and fuses the gate math into the per-step matmul;
+  * gate order is i, f, c(candidate), o for LSTM and u(update), r(reset),
+    c for GRU (documented — we do not chase the reference's weight memory
+    layout, only its function).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op, single_input
+
+_ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "identity": lambda x: x}
+
+
+def _acc(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+@register_op("lstm")
+def _lstm(ctx, ins, attrs):
+    """Input [B,T,4H] (pre-projected x@Wx+b, ref dynamic_lstm contract),
+    Weight [H,4H] recurrent, optional H0/C0 [B,H], Mask [B,T].
+    Outputs: Hidden [B,T,H], LastH [B,H], LastC [B,H]."""
+    x = single_input(ins, "Input")
+    w = single_input(ins, "Weight")
+    B, T, H4 = x.shape
+    H = H4 // 4
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    reverse = bool(attrs.get("is_reverse", False))
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+
+    xt_seq = jnp.swapaxes(x, 0, 1)                      # [T,B,4H]
+    if reverse:
+        xt_seq = xt_seq[::-1]
+    mask_seq = None
+    if mask is not None:
+        mask_seq = jnp.swapaxes(mask, 0, 1)[..., None]  # [T,B,1]
+        if reverse:
+            mask_seq = mask_seq[::-1]
+
+    def step(carry, inp):
+        h, c = carry
+        if mask_seq is None:
+            xt = inp
+        else:
+            xt, m = inp
+        gates = xt + jnp.matmul(h, w, preferred_element_type=_acc(x))\
+            .astype(x.dtype)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        g = cand_act(g)
+        c_new = f * c + i * g
+        h_new = o * cell_act(c_new)
+        if mask_seq is not None:
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+        return (h_new, c_new), h_new
+
+    (h_last, c_last), hs = lax.scan(
+        step, (h0, c0),
+        xt_seq if mask_seq is None else (xt_seq, mask_seq))
+    if reverse:
+        hs = hs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)                     # [B,T,H]
+    return {"Hidden": [hidden], "LastH": [h_last], "LastC": [c_last]}
+
+
+@register_op("gru")
+def _gru(ctx, ins, attrs):
+    """Input [B,T,3H] pre-projected, Weight [H,3H] (u|r|c blocks),
+    optional H0 [B,H], Mask [B,T].  Outputs Hidden [B,T,H], LastH."""
+    x = single_input(ins, "Input")
+    w = single_input(ins, "Weight")
+    B, T, H3 = x.shape
+    H = H3 // 3
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    reverse = bool(attrs.get("is_reverse", False))
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    w_g = w[:, :2 * H]                                  # update|reset
+    w_c = w[:, 2 * H:]
+
+    xt_seq = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xt_seq = xt_seq[::-1]
+    mask_seq = None
+    if mask is not None:
+        mask_seq = jnp.swapaxes(mask, 0, 1)[..., None]
+        if reverse:
+            mask_seq = mask_seq[::-1]
+
+    def step(h, inp):
+        if mask_seq is None:
+            xt = inp
+        else:
+            xt, m = inp
+        xg, xc = xt[:, :2 * H], xt[:, 2 * H:]
+        ur = gate_act(xg + jnp.matmul(h, w_g,
+                                      preferred_element_type=_acc(x))
+                      .astype(x.dtype))
+        u, r = ur[:, :H], ur[:, H:]
+        c = cand_act(xc + jnp.matmul(r * h, w_c,
+                                     preferred_element_type=_acc(x))
+                     .astype(x.dtype))
+        h_new = u * h + (1 - u) * c
+        if mask_seq is not None:
+            h_new = m * h_new + (1 - m) * h
+        return h_new, h_new
+
+    h_last, hs = lax.scan(step, h0,
+                          xt_seq if mask_seq is None else (xt_seq, mask_seq))
+    if reverse:
+        hs = hs[::-1]
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """One step (ref lstm_unit_op.cc): X [B,4H] pre-activation, C_prev."""
+    x = single_input(ins, "X")
+    c_prev = single_input(ins, "C_prev")
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    i, f, g, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + jax.nn.sigmoid(
+        i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """One step (ref gru_unit_op.cc): Input [B,3H] pre-projected,
+    HiddenPrev [B,H], Weight [H,3H]."""
+    x = single_input(ins, "Input")
+    h = single_input(ins, "HiddenPrev")
+    w = single_input(ins, "Weight")
+    H = h.shape[-1]
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    xg, xc = x[:, :2 * H], x[:, 2 * H:]
+    ur = gate_act(xg + jnp.matmul(h, w[:, :2 * H],
+                                  preferred_element_type=_acc(x))
+                  .astype(x.dtype))
+    u, r = ur[:, :H], ur[:, H:]
+    c = cand_act(xc + jnp.matmul(r * h, w[:, 2 * H:],
+                                 preferred_element_type=_acc(x))
+                 .astype(x.dtype))
+    h_new = u * h + (1 - u) * c
+    return {"Hidden": [h_new], "Gate": [jnp.concatenate([u, r], -1)],
+            "ResetHiddenPrev": [r * h]}
