@@ -91,16 +91,21 @@ class TaskMaster:
             if ent is None:
                 return False
             self.done.append(ent["task"])
-            # epoch rollover: when everything is done, recycle (ref master
-            # re-queues for the next pass)
-            if not self.todo and not self.pending:
-                for t in self.done:
-                    t.epoch += 1
-                    t.failures = 0
-                self.todo = self.done
-                self.done = []
+            self._maybe_rollover()
             self._snapshot()
             return True
+
+    def _maybe_rollover(self):
+        """Epoch rollover: when no work is outstanding, recycle done tasks
+        for the next pass (ref master re-queues).  Shared by every path
+        that can drain the queue — finish, failure, and lease expiry —
+        so a final failed task can't strand the done list forever."""
+        if not self.todo and not self.pending and self.done:
+            for t in self.done:
+                t.epoch += 1
+                t.failures = 0
+            self.todo = self.done
+            self.done = []
 
     def task_failed(self, task_id: int) -> bool:
         """ref TaskFailed:455 — requeue up to MAX_FAILURES."""
@@ -114,6 +119,7 @@ class TaskMaster:
                 self.failed_forever.append(t)
             else:
                 self.todo.append(t)
+            self._maybe_rollover()
             self._snapshot()
             return True
 
@@ -137,6 +143,8 @@ class TaskMaster:
                 self.failed_forever.append(t)
             else:
                 self.todo.append(t)
+        if expired:
+            self._maybe_rollover()
 
     def _snapshot(self, force: bool = False):
         if not self.snapshot_path:

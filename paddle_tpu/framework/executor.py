@@ -279,7 +279,7 @@ class Executor:
         persist = sorted({v.name for v in program.list_vars() if v.persistable})
         state = {n: scope.find_var(n) for n in persist if scope.has_var(n)}
 
-        key = (id(program), program._version,
+        key = (program._uid, program._version,
                tuple(sorted((n, a.shape, str(a.dtype))
                             for n, a in dev_feeds.items())),
                tuple(fetch_names),

@@ -18,6 +18,7 @@ BLOCK attributes; they lower to lax.while_loop / lax.cond.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import copy
 import json
 from typing import Any, Dict, List, Optional, Sequence
@@ -216,12 +217,17 @@ class Block:
 class Program:
     """A whole trainable/serializable program (ref framework.py:1477)."""
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self._current_block_idx = 0
         self.random_seed: Optional[int] = None
         # version bumps on any mutation -> executor cache invalidation
         self._version = 0
+        # process-unique id: executor cache keys use this instead of
+        # id(program), whose value a GC'd program can bequeath to a new one
+        self._uid = next(Program._uid_counter)
 
     # -- structure ---------------------------------------------------------
     def global_block(self) -> Block:

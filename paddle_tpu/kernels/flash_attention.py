@@ -47,7 +47,7 @@ def _pick_block(t: int, target: int) -> int:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr,
-                *, block_q, block_k, nk, scale, causal):
+                *, block_q, block_k, nk, scale, causal, kv_len):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -74,6 +74,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr,
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len is not None:
+            # sequence was padded up to a tile multiple: mask padded keys
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)
         m_prev = m_scr[:, :1]                      # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -91,8 +96,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr,
         lse_ref[0] = (m_scr[:, :1] + jnp.log(l)).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k):
-    """q,k,v: [BH, T, d] -> (o [BH, T, d], lse [BH, T])."""
+def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
+               kv_len=None):
+    """q,k,v: [BH, T, d] -> (o [BH, T, d], lse [BH, T]).  kv_len: actual
+    key length when T includes tile padding (mask keys >= kv_len)."""
     BH, T, d = q.shape
     block_q = block_q or _pick_block(T, 512)
     block_k = block_k or _pick_block(T, 1024)
@@ -103,7 +110,7 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k):
     grid = (BH, T // block_q, nk)
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, nk=nk, scale=scale,
-                               causal=causal)
+                               causal=causal, kv_len=kv_len)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -137,7 +144,7 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k):
     return o, lse[..., 0]
 
 
-def _flash_bwd(scale, causal, res, do):
+def _flash_bwd(scale, causal, kv_len, res, do):
     """Blockwise recompute backward (FlashAttention-2 recurrence) — pure
     XLA lax.scan, no [T,T] HBM tensor."""
     q, k, v, o, lse = res
@@ -156,10 +163,12 @@ def _flash_bwd(scale, causal, res, do):
         ks = lax.dynamic_slice_in_dim(kf, bi * blk, blk, axis=1)
         vs = lax.dynamic_slice_in_dim(vf, bi * blk, blk, axis=1)
         s = jnp.einsum("bqd,bkd->bqk", qf, ks) * scale
+        k_pos = bi * blk + jnp.arange(blk)
         if causal:
-            k_pos = bi * blk + jnp.arange(blk)
             mask = q_idx[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None], s, NEG_INF)
+        if kv_len is not None:
+            s = jnp.where((k_pos < kv_len)[None, None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, :, None])                    # [BH, T, blk]
         dv = jnp.einsum("bqk,bqd->bkd", p, dof)
         dp = jnp.einsum("bqd,bkd->bqk", dof, vs)
@@ -176,23 +185,30 @@ def _flash_bwd(scale, causal, res, do):
 
 
 @functools.lru_cache(maxsize=64)
-def _make_flash(scale, causal, interpret, block_q, block_k):
+def _make_flash(scale, causal, interpret, block_q, block_k, kv_len=None):
     @jax.custom_vjp
     def f(q, k, v):
         o, _ = _flash_fwd(q, k, v, scale, causal, interpret, block_q,
-                          block_k)
+                          block_k, kv_len)
         return o
 
     def fwd(q, k, v):
         o, lse = _flash_fwd(q, k, v, scale, causal, interpret, block_q,
-                            block_k)
+                            block_k, kv_len)
         return o, (q, k, v, o, lse)
 
     def bwd(res, g):
-        return _flash_bwd(scale, causal, res, g)
+        return _flash_bwd(scale, causal, kv_len, res, g)
 
     f.defvjp(fwd, bwd)
     return f
+
+
+# Sequence lengths are padded up to a multiple of this before entering the
+# kernel: it guarantees every auto-picked block is >= 128, satisfying the
+# TPU (8, 128) VMEM tile constraints for both the [block_q, d] blocks and
+# the [block_q, block_k] score intermediates (pallas_guide.md).
+_SEQ_GRANULE = 128
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float = None,
@@ -200,8 +216,10 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
                     block_k: int = None):
     """q,k,v: [B, H, T, d] (or [BH, T, d]).  Returns same shape.
 
-    Any T works (power-of-two blocks <= 512/1024 are auto-picked to divide
-    T); d should be <= 128 for MXU-sized tiles.
+    Any T works: sequences not divisible by 128 are internally padded to
+    the next multiple (padded keys are masked out, padded query rows are
+    sliced off), so the kernel always sees MXU-tileable blocks; d should
+    be <= 128.
     """
     squeeze = False
     if q.ndim == 3:
@@ -212,13 +230,22 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
         scale = 1.0 / np.sqrt(d)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    if block_q is not None and T % block_q:
-        raise ValueError(f"block_q {block_q} must divide seq len {T}")
-    if block_k is not None and T % block_k:
-        raise ValueError(f"block_k {block_k} must divide seq len {T}")
+    Tp = -(-T // _SEQ_GRANULE) * _SEQ_GRANULE
+    kv_len = T if Tp != T else None
+    if block_q is not None and Tp % block_q:
+        raise ValueError(f"block_q {block_q} must divide padded seq {Tp}")
+    if block_k is not None and Tp % block_k:
+        raise ValueError(f"block_k {block_k} must divide padded seq {Tp}")
+    q = q.reshape(B * H, T, d)
+    k = k.reshape(B * H, T, d)
+    v = v.reshape(B * H, T, d)
+    if kv_len is not None:
+        pad = ((0, 0), (0, Tp - T), (0, 0))
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
     f = _make_flash(float(scale), bool(causal), bool(interpret),
-                    block_q, block_k)
-    out = f(q.reshape(B * H, T, d), k.reshape(B * H, T, d),
-            v.reshape(B * H, T, d))
+                    block_q, block_k, kv_len)
+    out = f(q, k, v)
+    if kv_len is not None:
+        out = out[:, :T]
     out = out.reshape(B, H, T, d)
     return out[:, 0] if squeeze else out

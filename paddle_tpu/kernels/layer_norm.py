@@ -82,9 +82,7 @@ def _make_ln(eps, interpret):
         return (y, mean, rstd), (x2d, gamma, mean, rstd)
 
     def bwd(res, g):
-        # cotangents for the auxiliary (mean, rstd) outputs are treated as
-        # zero — they feed stop-gradient stat vars in the op layer
-        gy = g[0]
+        gy, gmean, grstd = g
         x, gamma, mean, rstd = res
         xf = x.astype(jnp.float32)
         gyf = gy.astype(jnp.float32)
@@ -97,6 +95,11 @@ def _make_ln(eps, interpret):
         dx = (wg - jnp.mean(wg, axis=1, keepdims=True)
               - xhat * jnp.mean(wg * xhat, axis=1, keepdims=True))
         dx = dx * rstd[:, None]
+        # cotangents through the auxiliary stats outputs:
+        #   dmean/dx_j = 1/D;  drstd/dx_j = -rstd^3 * (x_j - mu) / D
+        dx = dx + gmean.astype(jnp.float32)[:, None] / D
+        dx = dx - (grstd.astype(jnp.float32) * rstd ** 3)[:, None] \
+            * (xf - mean[:, None]) / D
         return dx.astype(x.dtype), dgamma, dbeta
 
     f.defvjp(fwd, bwd)

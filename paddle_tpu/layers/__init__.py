@@ -1,11 +1,20 @@
 """Layers package (ref python/paddle/fluid/layers/)."""
 from . import nn
 from . import tensor
+from . import rnn
+from . import control_flow
+from . import learning_rate_scheduler
 from .nn import *  # noqa: F401,F403
 from .tensor import (create_tensor, fill_constant,  # noqa: F401
                      fill_constant_batch_size_like, cast, concat, sums,
                      assign, argmin, argmax, argsort, ones, zeros,
                      ones_like, zeros_like, reverse, linspace, eye, diag)
+from .rnn import (dynamic_lstm, dynamic_gru, gru_unit,  # noqa: F401
+                  lstm_unit, lstm_layer)
+from .control_flow import While, Switch, StaticRNN  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup)
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

@@ -42,9 +42,17 @@ class While:
         yield
         self.program._current_block_idx = parent_idx
         parent = self.program.blocks[parent_idx]
-        parent.append_op("while", {"Cond": [self.cond_var.name]}, {},
+        # loop-carried state = every pre-existing var the sub-block writes;
+        # route it through the op's Out so the final values land in the env
+        from ..ops.control_flow import _block_written_vars
+        outs = [n for n in _block_written_vars(sub) if parent.has_var(n)]
+        if self.cond_var.name not in outs:
+            outs.append(self.cond_var.name)
+        parent.append_op("while", {"Cond": [self.cond_var.name]},
+                         {"Out": outs},
                          {"sub_block": sub.idx,
-                          "condition": self.cond_var.name})
+                          "condition": self.cond_var.name,
+                          "out_vars": outs})
 
 
 class Switch:
@@ -97,13 +105,8 @@ def _conditional_block(program, cond: Variable):
     program._current_block_idx = parent_idx
     parent = program.blocks[parent_idx]
     # out_vars: every pre-existing var the sub-block writes
-    written = []
-    for op in sub.ops:
-        for names in op.outputs.values():
-            for n in names:
-                if n and n not in written:
-                    written.append(n)
-    outs = [n for n in written if parent.has_var(n)]
+    from ..ops.control_flow import _block_written_vars
+    outs = [n for n in _block_written_vars(sub) if parent.has_var(n)]
     parent.append_op("conditional_block", {"Cond": [cond.name]},
                      {"Out": outs},
                      {"sub_block": sub.idx, "out_vars": outs})

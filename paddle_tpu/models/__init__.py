@@ -7,3 +7,4 @@ from . import vgg
 from . import transformer
 from . import deepfm
 from . import bert
+from . import stacked_lstm

@@ -1,0 +1,68 @@
+"""Stacked-LSTM text classification — the reference's LSTM benchmark config.
+
+Capability parity with /root/reference/benchmark/fluid/stacked_dynamic_lstm.py
+and benchmark/README.md:103-119 (2x lstm + fc, h=512, bs64 rows of the GPU
+table): embedding -> [fc(4H) -> dynamic_lstm] x n_layers -> max pool over
+time -> fc softmax.
+
+TPU-first: sequences are dense [B, T] int32 with a float mask [B, T]
+(1=token) instead of LoD ragged batches (SURVEY.md hard part (a)); the
+per-timestep recurrence is ONE lax.scan inside the whole-program jit
+(ops/rnn_ops.py), so XLA keeps h/c resident across steps.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+
+
+def stacked_lstm_net(words, mask, dict_dim, num_classes=2, emb_dim=128,
+                     hidden_dim=128, num_layers=2):
+    """words [B, T] int64, mask [B, T] float32.  Returns softmax prediction.
+
+    Mirrors the reference net: each stack level feeds the previous hidden
+    sequence through an fc to 4H gates then an LSTM; final max-over-time
+    pool of the last layer's hidden states -> fc softmax.
+    """
+    emb = layers.embedding(words, size=[dict_dim, emb_dim])
+    x = emb
+    for _ in range(num_layers):
+        proj = layers.fc(x, size=hidden_dim * 4, num_flatten_dims=2,
+                         bias_attr=False)
+        x, _ = layers.dynamic_lstm(proj, size=hidden_dim * 4, mask=mask)
+    # masked max pool over time: push padded steps to a large negative
+    neg = layers.scale(mask, scale=-1.0, bias=1.0)        # 1 at pad
+    neg = layers.scale(neg, scale=-1e9)                   # -1e9 at pad
+    x = layers.elementwise_add(x, layers.unsqueeze(neg, [2]))
+    pooled = layers.reduce_max(x, dim=1)                  # [B, H]
+    return layers.fc(pooled, size=num_classes, act="softmax")
+
+
+def build_train_net(dict_dim=1000, seq_len=32, num_classes=2,
+                    emb_dim=64, hidden_dim=64, num_layers=2):
+    """Builds (feeds, avg_loss, acc, prediction) in the default program."""
+    words = layers.data("words", [seq_len], dtype="int64")
+    mask = layers.data("mask", [seq_len], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    pred = stacked_lstm_net(words, mask, dict_dim, num_classes=num_classes,
+                            emb_dim=emb_dim, hidden_dim=hidden_dim,
+                            num_layers=num_layers)
+    cost = layers.cross_entropy(input=pred, label=label)
+    avg_loss = layers.mean(cost)
+    acc = layers.accuracy(input=pred, label=label)
+    return [words, mask, label], avg_loss, acc, pred
+
+
+def make_fake_batch(batch_size, dict_dim=1000, seq_len=32, num_classes=2,
+                    seed=0):
+    """Synthetic separable task: class decides which vocab half dominates."""
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, num_classes, (batch_size, 1)).astype("int64")
+    band = dict_dim // num_classes          # each class owns a vocab band
+    words = rng.randint(0, band, (batch_size, seq_len)).astype("int64")
+    words = words + band * label
+    lens = rng.randint(seq_len // 2, seq_len + 1, (batch_size,))
+    mask = (np.arange(seq_len)[None, :] < lens[:, None]).astype("float32")
+    words = (words * mask).astype("int64")
+    return {"words": words, "mask": mask, "label": label}

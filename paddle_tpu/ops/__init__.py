@@ -17,6 +17,7 @@ from . import loss_ops
 from . import metric_ops
 from . import optimizer_ops
 from . import control_flow
+from . import rnn_ops
 from . import sequence_ops
 from . import detection_ops
 from . import collective_ops

@@ -63,7 +63,21 @@ def _while(ctx, ins, attrs):
 
     init = {n: env[n] for n in carried}
     final = jax.lax.while_loop(cond_fn, body_fn, init)
-    return {"Out": [final[n] for n in attrs.get("out_vars", carried)]}
+    # one produced value per out_vars entry (run_ops_in_env zips them in
+    # order); vars that could not be carried pass through unchanged
+    out_vars = attrs.get("out_vars", carried)
+    outs = []
+    for n in out_vars:
+        if n in final:
+            outs.append(final[n])
+        elif n in env:
+            outs.append(env[n])
+        else:
+            from ..core.enforce import EnforceNotMet
+            raise EnforceNotMet(
+                f"while loop output {n!r} has no value before the loop; "
+                f"initialise it (e.g. fill_constant) so it can be carried")
+    return {"Out": outs}
 
 
 @register_op("conditional_block")
@@ -121,6 +135,36 @@ def _scan(ctx, ins, attrs):
     final_carry, ys = jax.lax.scan(body, init, xs)
     return {"CarryOut": [final_carry[n] for n in carry_names],
             "Ys": list(ys)}
+
+
+@register_op("static_rnn_scan")
+def _static_rnn_scan(ctx, ins, attrs):
+    """The engine under layers.StaticRNN: lax.scan with explicit init
+    values and scanned inputs (ref operators/recurrent_op.cc — per-timestep
+    scopes become the carry).
+
+    Inputs: Init (one value per memory), X (scanned [T, B, ...] arrays).
+    attrs: sub_block, carry_vars (inner memory var names), x_inner_vars
+    (inner per-step var names, aligned with X), y_vars (per-step outputs)."""
+    program = ctx.program
+    block = program.blocks[int(attrs["sub_block"])]
+    env = ctx.env
+    carry_names = list(attrs["carry_vars"])
+    x_inner = list(attrs.get("x_inner_vars", []))
+    y_names = list(attrs.get("y_vars", []))
+    inits = tuple(ins.get("Init", []))
+    xs = tuple(ins.get("X", []))
+
+    def body(carry, x_t):
+        benv = dict(env)
+        benv.update(dict(zip(carry_names, carry)))
+        benv.update(dict(zip(x_inner, x_t)))
+        benv = _lower_block(ctx, benv, block)
+        new_carry = tuple(benv[n] for n in carry_names)
+        return new_carry, tuple(benv[n] for n in y_names)
+
+    final_carry, ys = jax.lax.scan(body, inits, xs)
+    return {"Ys": list(ys), "CarryOut": list(final_carry)}
 
 
 @register_op("increment_loop_counter")

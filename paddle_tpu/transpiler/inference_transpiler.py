@@ -24,13 +24,15 @@ class InferenceTranspiler:
         scope = scope or global_scope()
         block = program.global_block()
         ops = block.ops
-        # consumer count per var: fold only when the conv output feeds the
-        # BN exclusively (a skip connection reading the pre-BN activation
-        # must keep the unfused conv)
+        # consumer count per var across ALL blocks: fold only when the conv
+        # output feeds the BN exclusively (a skip connection or sub-block
+        # reading the pre-BN activation must keep the unfused conv)
         consumers: dict = {}
-        for op in ops:
-            for n in op.input_names():
-                consumers[n] = consumers.get(n, 0) + 1
+        for b in program.blocks:
+            for bop in b.ops:
+                for n in bop.input_names():
+                    consumers[n] = consumers.get(n, 0) + 1
+        from ..framework.program import Operator
         new_ops = []
         i = 0
         while i < len(ops):
@@ -42,9 +44,13 @@ class InferenceTranspiler:
                     == nxt.inputs.get("X", [None])[0]
                     and consumers.get(op.outputs["Output"][0], 0) == 1):
                 self._fold(scope, op, nxt)
-                # rewire: conv writes BN's output var directly
-                op.outputs["Output"] = [nxt.outputs["Y"][0]]
+                # conv keeps its own output var (a fetch of it stays legal —
+                # it now holds the post-BN value, which is the only value
+                # that exists after folding); alias the BN output onto it
                 new_ops.append(op)
+                new_ops.append(Operator(
+                    block, "assign", {"X": [op.outputs["Output"][0]]},
+                    {"Out": [nxt.outputs["Y"][0]]}, {}))
                 i += 2
                 continue
             new_ops.append(op)

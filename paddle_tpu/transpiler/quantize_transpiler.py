@@ -36,20 +36,24 @@ class QuantizeTranspiler:
         self._startup = startup_program or default_startup_program()
         block = program.global_block()
         params = {p.name for p in program.all_parameters()}
-        quantized = {}   # original var name -> quantized var name
+        # cache key: (var name, weight quant_axis for this consumer kind) —
+        # a weight feeding both a conv2d (axis 0) and a mul/matmul (axis 1)
+        # must get two channel-wise quantizations, not reuse the first
+        quantized = {}
 
         new_ops: list = []
         for op in list(block.ops):
             if op.type in QUANTIZABLE_OPS:
                 self._consumer_type = op.type
+                axis_kind = 0 if op.type == "conv2d" else 1
                 for slot, names in op.inputs.items():
                     new_names = []
                     for n in names:
-                        if n not in quantized:
-                            qname = self._insert_quant(block, new_ops, n,
-                                                       n in params)
-                            quantized[n] = qname
-                        new_names.append(quantized[n])
+                        key = (n, axis_kind if n in params else None)
+                        if key not in quantized:
+                            quantized[key] = self._insert_quant(
+                                block, new_ops, n, n in params)
+                        new_names.append(quantized[key])
                     op.inputs[slot] = new_names
             new_ops.append(op)
         block.ops = new_ops

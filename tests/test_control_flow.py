@@ -1,0 +1,113 @@
+"""Control-flow layer DSL: While / Switch / StaticRNN lowering to
+lax.while_loop / lax.cond / lax.scan (ref python/paddle/fluid/layers/
+control_flow.py:504,1139,278 and operators/controlflow/)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_while_loop_accumulates():
+    """i = 0; acc = 0; while i < 5: acc += i; i += 1  ->  acc == 10."""
+    i = layers.fill_constant([1], "float32", 0.0, name="i")
+    n = layers.fill_constant([1], "float32", 5.0, name="n")
+    acc = layers.fill_constant([1], "float32", 0.0, name="acc")
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        acc2 = layers.elementwise_add(acc, i)
+        layers.assign(acc2, acc)
+        i2 = layers.increment(i, value=1.0, in_place=False)
+        layers.assign(i2, i)
+        layers.less_than(i, n, cond=cond)
+    exe = pt.Executor(pt.CPUPlace())
+    out_acc, out_i = exe.run(pt.default_main_program(),
+                             fetch_list=[acc, i])
+    assert float(out_acc) == 10.0
+    assert float(out_i) == 5.0
+
+
+def test_switch_picks_branch():
+    """Switch writes different lr values depending on a step counter."""
+    step = layers.fill_constant([1], "float32", 7.0, name="step")
+    thresh = layers.fill_constant([1], "float32", 5.0, name="thresh")
+    lr = layers.fill_constant([1], "float32", 0.0, name="lr")
+    with layers.Switch() as switch:
+        with switch.case(layers.less_than(step, thresh)):
+            v = layers.fill_constant([1], "float32", 0.1)
+            layers.assign(v, lr)
+        with switch.default():
+            v = layers.fill_constant([1], "float32", 0.01)
+            layers.assign(v, lr)
+    exe = pt.Executor(pt.CPUPlace())
+    out, = exe.run(pt.default_main_program(), fetch_list=[lr])
+    assert abs(float(out) - 0.01) < 1e-7
+
+
+def test_switch_first_case():
+    step = layers.fill_constant([1], "float32", 2.0, name="step")
+    thresh = layers.fill_constant([1], "float32", 5.0, name="thresh")
+    lr = layers.fill_constant([1], "float32", 0.0, name="lr")
+    with layers.Switch() as switch:
+        with switch.case(layers.less_than(step, thresh)):
+            v = layers.fill_constant([1], "float32", 0.1)
+            layers.assign(v, lr)
+        with switch.default():
+            v = layers.fill_constant([1], "float32", 0.01)
+            layers.assign(v, lr)
+    exe = pt.Executor(pt.CPUPlace())
+    out, = exe.run(pt.default_main_program(), fetch_list=[lr])
+    assert abs(float(out) - 0.1) < 1e-7
+
+
+def test_static_rnn_matches_numpy():
+    """StaticRNN with h_new = tanh(x_t @ W + h_prev @ U) vs numpy."""
+    B, T, D, H = 2, 4, 3, 3
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(B, T, D).astype("float32") * 0.5
+    h0_np = np.zeros((B, H), "float32")
+
+    x = layers.data("x", [T, D], dtype="float32")
+    h0 = layers.data("h0", [H], dtype="float32")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(init=h0)
+        cat = layers.concat([x_t, h_prev], axis=1)
+        h = layers.fc(cat, size=H, act="tanh", bias_attr=False)
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    w_name, = [n for n in exe.scope.var_names() if n.endswith(".w_0")]
+    w = np.asarray(exe.scope.find_var(w_name))
+    got, = exe.run(pt.default_main_program(),
+                   feed={"x": x_np, "h0": h0_np}, fetch_list=[out])
+
+    h = h0_np.astype("float64")
+    expect = np.zeros((B, T, H))
+    for t in range(T):
+        h = np.tanh(np.concatenate([x_np[:, t], h], -1) @ w)
+        expect[:, t] = h
+    np.testing.assert_allclose(got, expect, atol=1e-5)
+    assert got.shape == (B, T, H)
+
+
+def test_while_inside_training_program():
+    """A while loop can coexist with autodiff in one program (the loop here
+    post-processes a trained value; the reference pattern is program-level
+    mixing of control flow and backward ops)."""
+    x = layers.data("x", [4], dtype="float32")
+    w_out = layers.fc(x, size=1, bias_attr=False)
+    loss = layers.mean(layers.square(w_out))
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(3, 4).astype("float32")}
+    l0, = exe.run(pt.default_main_program(), feed=feed, fetch_list=[loss])
+    l1, = exe.run(pt.default_main_program(), feed=feed, fetch_list=[loss])
+    assert float(l1) < float(l0)

@@ -74,11 +74,18 @@ def test_inference_transpiler_folds_conv_bn(tmp_path):
     test_prog = main.clone(for_test=True)
     n_ops_before = len(test_prog.global_block().ops)
     InferenceTranspiler().transpile(test_prog, scope=exe.scope)
-    assert len(test_prog.global_block().ops) < n_ops_before
+    # conv+bn becomes conv+assign (the assign aliases the BN output name
+    # so external fetches of either var keep working); BN math is gone
+    assert len(test_prog.global_block().ops) <= n_ops_before
     assert not any(op.type == "batch_norm"
                    for op in test_prog.global_block().ops)
     after, = exe.run(test_prog, feed=feed, fetch_list=[bn.name])
     np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+    # the pre-BN conv output name is still fetchable post-fold
+    conv_out = [op for op in test_prog.global_block().ops
+                if op.type == "conv2d"][0].outputs["Output"][0]
+    via_conv, = exe.run(test_prog, feed=feed, fetch_list=[conv_out])
+    np.testing.assert_allclose(via_conv, before, rtol=1e-4, atol=1e-5)
 
 
 def test_quantize_transpiler_qat_trains():

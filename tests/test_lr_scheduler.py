@@ -1,0 +1,100 @@
+"""In-graph LR schedules vs closed-form references (ref python/paddle/
+fluid/layers/learning_rate_scheduler.py), plus end-to-end use as an
+optimizer's learning rate."""
+import math
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run_schedule(build_fn, steps):
+    """Build the schedule in a fresh program and run `steps` times,
+    returning the lr seen at each run (global step increments per run)."""
+    lr = build_fn()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    vals = []
+    for _ in range(steps):
+        out, = exe.run(pt.default_main_program(), fetch_list=[lr])
+        vals.append(float(out))
+    return vals
+
+
+def test_exponential_decay():
+    vals = _run_schedule(
+        lambda: layers.exponential_decay(0.1, decay_steps=2,
+                                         decay_rate=0.5), 5)
+    # step counter increments before the lr read: steps seen are 1..5
+    expect = [0.1 * 0.5 ** (s / 2.0) for s in range(1, 6)]
+    np.testing.assert_allclose(vals, expect, rtol=1e-6)
+
+
+def test_piecewise_decay():
+    vals = _run_schedule(
+        lambda: layers.piecewise_decay([3, 6], [0.1, 0.05, 0.01]), 8)
+    expect = [0.1, 0.1, 0.05, 0.05, 0.05, 0.01, 0.01, 0.01]
+    np.testing.assert_allclose(vals, expect, rtol=1e-6)
+
+
+def test_noam_decay():
+    d, warm = 64, 4
+    vals = _run_schedule(lambda: layers.noam_decay(d, warm), 8)
+    expect = [d ** -0.5 * min(s ** -0.5, s * warm ** -1.5)
+              for s in range(1, 9)]
+    np.testing.assert_allclose(vals, expect, rtol=1e-5)
+
+
+def test_polynomial_decay():
+    vals = _run_schedule(
+        lambda: layers.polynomial_decay(0.1, decay_steps=4,
+                                        end_learning_rate=0.01, power=1.0),
+        6)
+    expect = []
+    for s in range(1, 7):
+        ss = min(s, 4)
+        expect.append((0.1 - 0.01) * (1 - ss / 4.0) + 0.01)
+    np.testing.assert_allclose(vals, expect, rtol=1e-5)
+
+
+def test_cosine_decay():
+    vals = _run_schedule(
+        lambda: layers.cosine_decay(0.1, step_each_epoch=2, epochs=4), 4)
+    expect = [0.1 / 2 * (math.cos(math.floor(s / 2) * math.pi / 4) + 1)
+              for s in range(1, 5)]
+    np.testing.assert_allclose(vals, expect, rtol=1e-5)
+
+
+def test_linear_warmup_then_constant():
+    vals = _run_schedule(
+        lambda: layers.linear_lr_warmup(0.1, warmup_steps=4, start_lr=0.0,
+                                        end_lr=0.1), 6)
+    expect = [0.1 * min(s / 4.0, 1.0) for s in range(1, 7)]
+    np.testing.assert_allclose(vals, expect, rtol=1e-5)
+
+
+def test_scheduler_drives_optimizer():
+    """lr Variable feeds SGD; training still reduces loss and the schedule
+    value changes across steps (the reference wiring: optimizer takes the
+    schedule var as learning_rate)."""
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    lr = layers.exponential_decay(0.1, decay_steps=2, decay_rate=0.9)
+    opt = pt.optimizer.SGD(learning_rate=lr)
+    opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype("float32"),
+            "y": rng.randn(8, 1).astype("float32")}
+    losses, lrs = [], []
+    for _ in range(4):
+        lo, lv = exe.run(pt.default_main_program(), feed=feed,
+                         fetch_list=[loss, lr])
+        losses.append(float(lo))
+        lrs.append(float(lv))
+    assert losses[-1] < losses[0]
+    assert lrs[0] != lrs[-1]          # schedule actually advanced

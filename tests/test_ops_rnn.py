@@ -1,0 +1,245 @@
+"""RNN op family: numpy-forward + finite-difference grad checks (the
+reference's per-op contract, unittests/op_test.py:132, applied to
+operators/lstm_op.cc / gru_op.cc / lstm_unit_op.cc / gru_unit_op.cc), plus
+the stacked-LSTM model (benchmark/fluid/stacked_dynamic_lstm.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+from op_test import OpTest
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(x, w, h0=None, c0=None, mask=None, reverse=False):
+    """x [B,T,4H] pre-projected, w [H,4H]; gate order i,f,g,o."""
+    B, T, H4 = x.shape
+    H = H4 // 4
+    h = np.zeros((B, H)) if h0 is None else h0.copy()
+    c = np.zeros((B, H)) if c0 is None else c0.copy()
+    hs = np.zeros((B, T, H))
+    ts = range(T - 1, -1, -1) if reverse else range(T)
+    for t in ts:
+        gates = x[:, t] + h @ w
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = _sig(i), _sig(f), _sig(o)
+        g = np.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * np.tanh(c_new)
+        if mask is not None:
+            m = mask[:, t:t + 1]
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+        h, c = h_new, c_new
+        hs[:, t] = h
+    return hs, h, c
+
+
+def np_gru(x, w, h0=None, mask=None, reverse=False):
+    """x [B,T,3H], w [H,3H] = [u|r blocks, c block]."""
+    B, T, H3 = x.shape
+    H = H3 // 3
+    h = np.zeros((B, H)) if h0 is None else h0.copy()
+    hs = np.zeros((B, T, H))
+    w_g, w_c = w[:, :2 * H], w[:, 2 * H:]
+    ts = range(T - 1, -1, -1) if reverse else range(T)
+    for t in ts:
+        xg, xc = x[:, t, :2 * H], x[:, t, 2 * H:]
+        ur = _sig(xg + h @ w_g)
+        u, r = ur[:, :H], ur[:, H:]
+        cand = np.tanh(xc + (r * h) @ w_c)
+        h_new = u * h + (1 - u) * cand
+        if mask is not None:
+            m = mask[:, t:t + 1]
+            h_new = m * h_new + (1 - m) * h
+        h = h_new
+        hs[:, t] = h
+    return hs, h
+
+
+class TestLSTM(OpTest):
+    op_type = "lstm"
+    reverse = False
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        B, T, H = 2, 3, 2
+        x = rng.randn(B, T, 4 * H).astype("float32") * 0.5
+        w = rng.randn(H, 4 * H).astype("float32") * 0.5
+        hs, h, c = np_lstm(x.astype("float64"), w.astype("float64"),
+                           reverse=self.reverse)
+        self.inputs = {"Input": x, "Weight": w}
+        self.attrs = {"is_reverse": self.reverse}
+        self.outputs = {"Hidden": hs, "LastH": h, "LastC": c}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.01)
+
+
+class TestLSTMReverse(TestLSTM):
+    reverse = True
+
+    def test_grad(self):
+        pass  # same math reversed; forward covers the flip
+
+
+class TestLSTMMasked(OpTest):
+    op_type = "lstm"
+
+    def setup(self):
+        rng = np.random.RandomState(3)
+        B, T, H = 2, 4, 2
+        x = rng.randn(B, T, 4 * H).astype("float32") * 0.5
+        w = rng.randn(H, 4 * H).astype("float32") * 0.5
+        mask = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], dtype="float32")
+        h0 = rng.randn(B, H).astype("float32") * 0.1
+        c0 = rng.randn(B, H).astype("float32") * 0.1
+        hs, h, c = np_lstm(x.astype("float64"), w.astype("float64"),
+                           h0.astype("float64"), c0.astype("float64"), mask)
+        self.inputs = {"Input": x, "Weight": w, "H0": h0, "C0": c0,
+                       "Mask": mask}
+        self.outputs = {"Hidden": hs, "LastH": h, "LastC": c}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestGRU(OpTest):
+    op_type = "gru"
+
+    def setup(self):
+        rng = np.random.RandomState(11)
+        B, T, H = 2, 3, 2
+        x = rng.randn(B, T, 3 * H).astype("float32") * 0.5
+        w = rng.randn(H, 3 * H).astype("float32") * 0.5
+        hs, h = np_gru(x.astype("float64"), w.astype("float64"))
+        self.inputs = {"Input": x, "Weight": w}
+        self.outputs = {"Hidden": hs, "LastH": h}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.01)
+
+
+class TestGRUMasked(OpTest):
+    op_type = "gru"
+
+    def setup(self):
+        rng = np.random.RandomState(5)
+        B, T, H = 2, 4, 2
+        x = rng.randn(B, T, 3 * H).astype("float32") * 0.5
+        w = rng.randn(H, 3 * H).astype("float32") * 0.5
+        mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], dtype="float32")
+        hs, h = np_gru(x.astype("float64"), w.astype("float64"), mask=mask)
+        self.inputs = {"Input": x, "Weight": w, "Mask": mask}
+        self.outputs = {"Hidden": hs, "LastH": h}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestLSTMUnit(OpTest):
+    op_type = "lstm_unit"
+
+    def setup(self):
+        rng = np.random.RandomState(13)
+        B, H = 3, 4
+        x = rng.randn(B, 4 * H).astype("float32")
+        c_prev = rng.randn(B, H).astype("float32")
+        i, f, g, o = np.split(x.astype("float64"), 4, axis=-1)
+        c = _sig(f + 0.5) * c_prev + _sig(i) * np.tanh(g)
+        h = _sig(o) * np.tanh(c)
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.attrs = {"forget_bias": 0.5}
+        self.outputs = {"C": c, "H": h}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "H", max_relative_error=0.01)
+
+
+class TestGRUUnit(OpTest):
+    op_type = "gru_unit"
+
+    def setup(self):
+        rng = np.random.RandomState(17)
+        B, H = 3, 4
+        x = rng.randn(B, 3 * H).astype("float32")
+        h = rng.randn(B, H).astype("float32")
+        w = rng.randn(H, 3 * H).astype("float32") * 0.5
+        xf, hf, wf = (a.astype("float64") for a in (x, h, w))
+        ur = _sig(xf[:, :2 * H] + hf @ wf[:, :2 * H])
+        u, r = ur[:, :H], ur[:, H:]
+        cand = np.tanh(xf[:, 2 * H:] + (r * hf) @ wf[:, 2 * H:])
+        h_new = u * hf + (1 - u) * cand
+        self.inputs = {"Input": x, "HiddenPrev": h, "Weight": w}
+        self.outputs = {"Hidden": h_new, "Gate": np.concatenate([u, r], -1),
+                        "ResetHiddenPrev": r * hf}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input"], "Hidden", max_relative_error=0.01)
+
+
+# ---------------------------------------------------------------------------
+# layer + model tier
+# ---------------------------------------------------------------------------
+
+def test_dynamic_lstm_layer_runs():
+    words = layers.data("x", [5, 16], dtype="float32")
+    hidden, last_c = layers.dynamic_lstm(words, size=16)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    h, c = exe.run(pt.default_main_program(),
+                   feed={"x": rng.randn(2, 5, 16).astype("float32")},
+                   fetch_list=[hidden, last_c])
+    assert h.shape == (2, 5, 4)
+    assert c.shape == (2, 4)
+    assert np.isfinite(h).all()
+
+
+def test_dynamic_gru_layer_runs():
+    x = layers.data("x", [5, 12], dtype="float32")
+    hidden = layers.dynamic_gru(x, size=4)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    h, = exe.run(pt.default_main_program(),
+                 feed={"x": rng.randn(2, 5, 12).astype("float32")},
+                 fetch_list=[hidden])
+    assert h.shape == (2, 5, 4)
+    assert np.isfinite(h).all()
+
+
+def test_stacked_lstm_model_trains():
+    """The LSTM benchmark config (reference benchmark/README.md:103-119):
+    loss must decrease on a separable synthetic batch."""
+    feeds, avg_loss, acc, pred = models.stacked_lstm.build_train_net(
+        dict_dim=200, seq_len=12, emb_dim=16, hidden_dim=16, num_layers=2)
+    opt = pt.optimizer.Adam(learning_rate=1e-2)
+    opt.minimize(avg_loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = models.stacked_lstm.make_fake_batch(8, dict_dim=200, seq_len=12)
+    losses = []
+    for _ in range(6):
+        out, = exe.run(pt.default_main_program(), feed=feed,
+                       fetch_list=[avg_loss])
+        losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

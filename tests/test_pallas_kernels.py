@@ -140,6 +140,57 @@ def test_flash_attention_odd_seq_lengths():
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("T", [7, 100, 129])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_nondivisible_seq(T, causal):
+    """Prime/odd T takes the internal pad-to-128 path: forward AND grads
+    must match the unpadded reference exactly."""
+    rng = np.random.RandomState(5)
+    q, k, v = [jnp.asarray(rng.randn(1, 2, T, 16).astype("float32"))
+               for _ in range(3)]
+    out = flash_attention(q, k, v, causal=causal)
+    ref = ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ref_attention(q, k, v, causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_fused_layer_norm_stats_grads_propagate():
+    """Differentiating through the mean/var returned by
+    return_stats=True must match the unfused reference (the VJP carries
+    the stats cotangents, not silently zeroing them)."""
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 32).astype("float32"))
+    g = jnp.asarray(rng.rand(32).astype("float32") + 0.5)
+    b = jnp.asarray(rng.randn(32).astype("float32"))
+
+    def loss_fused(x):
+        y, mean, var = fused_layer_norm(x, g, b, return_stats=True)
+        return jnp.sum(y ** 2) + jnp.sum(mean ** 2) + jnp.sum(var ** 2)
+
+    def loss_ref(x):
+        mu = x.mean(-1)
+        var = x.var(-1)
+        y = ((x - mu[:, None]) / jnp.sqrt(var[:, None] + 1e-5)) * g + b
+        return jnp.sum(y ** 2) + jnp.sum(mu ** 2) + jnp.sum(var ** 2)
+
+    gf = jax.grad(loss_fused)(x)
+    gr = jax.grad(loss_ref)(x)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_fused_mha_named_attr_does_not_alias():
     import paddle_tpu as pt
     from paddle_tpu import layers
