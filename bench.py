@@ -1,13 +1,19 @@
-"""Benchmark: Transformer-base LM training throughput on one TPU chip.
+"""Benchmark: Transformer LM training throughput on one TPU chip, through
+the REAL framework stack — layers DSL -> Program -> whole-program-jit
+Executor — with the Pallas flash-attention + fused layer-norm kernels and
+bf16 mixed precision (FLAGS_amp_bf16) on.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Baseline: the reference publishes no V100/Fluid transformer numbers in-repo
-(BASELINE.md — `benchmark/fluid/` is a harness without committed results);
-the operative bar is BASELINE.json's north star ">=0.9x V100 step-time".
-We take 50k tokens/s as the V100 mixed-precision transformer-base anchor
-(typical fp16 V100 throughput for d512/L6 seq512 training), so
-vs_baseline = tokens_per_sec / 50_000.
+Baseline: the reference publishes no V100/Fluid transformer numbers
+in-repo (BASELINE.md); the operative bar is BASELINE.json's north star
+">=0.9x V100 step-time".  We take 50k tokens/s as the V100
+mixed-precision transformer-base anchor (typical fp16 V100 throughput for
+d512/L6 training), so vs_baseline = tokens_per_sec / 50_000.
+
+r01 recorded 87,793 tok/s on a hand-written shard_map step OUTSIDE the
+framework; this bench runs the Program/Executor path itself (the judged
+surface) and also reports achieved TFLOP/s and MFU vs the v5e bf16 peak.
 """
 from __future__ import annotations
 
@@ -18,42 +24,57 @@ import jax
 import numpy as np
 
 V100_TOKENS_PER_SEC = 50_000.0
+V5E_BF16_PEAK = 197e12
 
 
 def main():
-    from paddle_tpu.parallel import hybrid, topology
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    from paddle_tpu.core import flags
 
-    mesh = topology.make_hybrid_mesh(dp=1, pp=1, tp=1,
-                                     devices=jax.devices()[:1])
     on_tpu = jax.devices()[0].platform == "tpu"
-    cfg = hybrid.HybridConfig(
-        vocab_size=32000, seq_len=512, d_model=512, n_heads=8,
-        n_layers=6, d_ff=2048, n_microbatches=1,
-        compute_dtype=jax.numpy.bfloat16 if on_tpu else jax.numpy.float32,
-        remat=False)
-    batch = 32 if on_tpu else 4
-    params = hybrid.init_params(mesh, cfg, seed=0)
-    opt = hybrid.init_opt_state(params)
-    step = hybrid.build_train_step(mesh, cfg)
-    tokens, labels = hybrid.make_fake_lm_batch(cfg, global_batch=batch)
+    flags.set_flag("amp_bf16", True)
 
-    # warmup / compile
-    params, opt, loss = step(params, opt, tokens, labels)
-    jax.block_until_ready(loss)
+    D, F, L, V, T = 512, 2048, 6, 32000, 512
+    batch = 32 if on_tpu else 2
+    if not on_tpu:                       # keep the CPU dev loop tractable
+        V, L = 2000, 2
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=V, tgt_vocab_size=V, max_length=T,
+        n_layer=L, n_head=8, d_model=D, d_inner=F, dropout=0.0)
+    feeds, avg_cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=T, fused_attention=True)
+    pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    exe = pt.Executor(pt.TPUPlace(0) if on_tpu else pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = models.transformer.make_fake_lm_batch(cfg, batch, T)
+    main_prog = pt.default_main_program()
+
+    # warmup: initial compile + one layout-settling recompile
+    for _ in range(3):
+        out, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
 
     iters = 20 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, opt, loss = step(params, opt, tokens, labels)
-    jax.block_until_ready(loss)
+        out, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                       return_numpy=False)   # pipelined: no per-step sync
+    jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
 
-    toks_per_sec = batch * cfg.seq_len / dt
+    toks_per_sec = batch * T / dt
+    # train FLOPs/token = 3x fwd: qkvo+ffn matmuls, causal attention, logits
+    flops_tok = 3 * (L * (8 * D * D + 4 * D * F) + L * 4 * T * D + 2 * D * V)
+    tflops = toks_per_sec * flops_tok / 1e12
     print(json.dumps({
-        "metric": "transformer_base_train_tokens_per_sec_per_chip",
+        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
         "value": round(toks_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(toks_per_sec / V100_TOKENS_PER_SEC, 3),
+        "tflops": round(tflops, 1),
+        "mfu": round(tflops * 1e12 / V5E_BF16_PEAK, 3) if on_tpu else None,
+        "config": f"d{D} L{L} T{T} B{batch} V{V} fused+amp, executor path",
+        "loss": round(float(np.asarray(out)), 4),
     }))
 
 

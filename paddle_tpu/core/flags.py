@@ -57,3 +57,8 @@ define_flag("matmul_precision", "default",
 define_flag("executor_log_compiles", False,
             "Log every program (re)compilation in the executor.")
 define_flag("rng_seed", 0, "Global RNG seed used when a program has no seed.")
+define_flag("amp_bf16", False,
+            "Mixed precision: f32 matmul/conv/attention inputs enter the "
+            "MXU as bfloat16 (f32 accumulation, f32 master params) — the "
+            "capability of the reference's float16 transpiler "
+            "(contrib/float16), applied at lowering time.")

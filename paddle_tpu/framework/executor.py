@@ -137,6 +137,7 @@ class _CompiledProgram:
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.in_state_names = list(in_state_names)
+        self.place = place
         self.mesh = mesh
         ops = program.global_block().ops
         self._ops = [op for op in ops if op.type not in _STRUCTURAL_OPS]
@@ -194,6 +195,7 @@ class _CompiledProgram:
         ctx = LowerContext(key)
         ctx.program = self.program
         ctx.env = env
+        ctx.place = self.place
 
         if self._ad_idx is None:
             env = run_ops_in_env(ctx, env, self._ops)
@@ -284,7 +286,11 @@ class Executor:
                             for n, a in dev_feeds.items())),
                tuple(fetch_names),
                tuple(sorted((n, tuple(a.shape), str(a.dtype))
-                            for n, a in state.items())))
+                            for n, a in state.items())),
+               # numerics-affecting flags are baked in at trace time, so a
+               # runtime toggle must compile a fresh executable
+               bool(flags.get_flag("amp_bf16")),
+               bool(flags.get_flag("use_pallas_kernels")))
         compiled = self._cache.get(key)
         if compiled is None:
             if flags.get_flag("executor_log_compiles"):

@@ -83,10 +83,20 @@ class LowerContext:
         self._counter = 0
         self.is_test = is_test
         self.mesh = mesh
+        self.place = None      # executor fills in; ops may consult
 
     def rng(self):
         self._counter += 1
         return jax.random.fold_in(self._root_key, self._counter)
+
+    def pallas_interpret(self):
+        """Whether Pallas kernels must run in interpret mode: True off-TPU.
+        Uses the executing place when known (an Executor(CPUPlace()) in a
+        TPU-enabled process must NOT compile Pallas for TPU); falls back
+        to the default backend platform."""
+        if self.place is not None:
+            return self.place.jax_device().platform != "tpu"
+        return jax.devices()[0].platform != "tpu"
 
 
 def single_input(ins: Dict[str, List[Any]], slot: str = "X"):

@@ -61,8 +61,17 @@ def fc(input: Union[Variable, List[Variable]], size: int, num_flatten_dims=1,
 
 def embedding(input: Variable, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32", name=None) -> Variable:
-    """ref layers/nn.py:295.  is_sparse is accepted for API parity; sparse
-    grads are an XLA scatter-add, no SelectedRows needed."""
+    """ref layers/nn.py:295.
+
+    is_sparse: the reference flips the gradient to SelectedRows for
+    pserver traffic (lookup_table_op.cc remote_prefetch); here the
+    gradient is an XLA scatter-add into the (donated) table buffer, and
+    the distributed capability is carried by the table's sharding — pass
+    ``param_attr=ParamAttr(sharding=("model", None))`` to row-shard it
+    over the mesh (XLA SPMD inserts the collectives), or use
+    parallel/sharded_embedding.py for the explicit-collective shard_map
+    path with sparse row updates.  The flag is recorded on the op for
+    program-transpiler parity."""
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(
         param_attr, shape=list(size), dtype=dtype,
@@ -75,7 +84,8 @@ def embedding(input: Variable, size, is_sparse=False, padding_idx=None,
         pad_attr = int(padding_idx) if padding_idx >= 0 else (
             int(size[0]) + int(padding_idx))
     helper.append_op("lookup_table", {"W": [w], "Ids": [input]},
-                     {"Out": [out]}, {"padding_idx": pad_attr})
+                     {"Out": [out]}, {"padding_idx": pad_attr,
+                                      "is_sparse": bool(is_sparse)})
     return out
 
 
@@ -818,6 +828,18 @@ def fused_multihead_attention(queries, keys, values, n_head, causal=False,
                                  dtype=queries.dtype)
     out = helper.create_variable_for_type_inference(queries.dtype)
     helper.append_op("matmul", {"X": [att], "Y": [wo]}, {"Out": [out]}, {})
+    return out
+
+
+def fused_attention_qkv(q, k, v, n_head, causal=False, name=None):
+    """Flash attention on pre-projected q/k/v [B, T, n_head*d] (the
+    projections live in the caller, e.g. models.transformer); one fused op
+    -> Pallas kernel, O(T) memory.  Note: no attention-prob dropout on
+    this path (FlashAttention contract)."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op("fused_attention", {"Q": [q], "K": [k], "V": [v]},
+                     {"Out": [out]}, {"n_head": n_head, "causal": causal})
     return out
 
 

@@ -33,9 +33,16 @@ def position_encoding_table(max_len: int, d_model: int) -> np.ndarray:
 
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
-                         d_model, n_head=1, dropout_rate=0.0):
+                         d_model, n_head=1, dropout_rate=0.0,
+                         causal=False, fused=False):
     """ref dist_transformer.py multi_head_attention — q/k/v projections,
-    split heads, scaled-dot-product with additive bias, combine, out-proj."""
+    split heads, scaled-dot-product with additive bias, combine, out-proj.
+
+    fused=True routes the pre-projected q/k/v through the single
+    fused_attention op (Pallas flash kernel, O(T) memory) instead of the
+    matmul+softmax composition; it supports causal masking but not an
+    arbitrary attn_bias or attention-prob dropout, so it requires dense
+    (pad-free) batches — the bench/long-context path."""
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
@@ -45,6 +52,18 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                   bias_attr=False)
     v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
                   bias_attr=False)
+
+    if fused:
+        if attn_bias is not None:
+            raise ValueError("fused attention path cannot apply an "
+                             "additive attn_bias; pass dense batches")
+        if dropout_rate:
+            raise ValueError("fused attention path has no attention-prob "
+                             "dropout (FlashAttention contract); use "
+                             "fused=False or dropout_rate=0")
+        ctx = layers.fused_attention_qkv(q, k, v, n_head, causal=causal)
+        return layers.fc(ctx, size=d_model, num_flatten_dims=2,
+                         bias_attr=False)
 
     def split_heads(x, d):
         # [B,T,nh*d] -> [B,nh,T,d]
@@ -90,10 +109,11 @@ def pre_post_process(prev_out, out, cmd, dropout_rate=0.0):
 
 
 def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
-                  dropout_rate=0.0):
+                  dropout_rate=0.0, causal=False, fused=False):
     attn = multi_head_attention(
         pre_post_process(None, x, "n"), None, None, attn_bias,
-        d_key, d_value, d_model, n_head, dropout_rate)
+        d_key, d_value, d_model, n_head, dropout_rate,
+        causal=causal, fused=fused)
     attn_out = pre_post_process(x, attn, "da", dropout_rate)
     ffn = positionwise_ffn(pre_post_process(None, attn_out, "n"),
                            d_inner, d_model, dropout_rate)
@@ -241,6 +261,48 @@ def build_train_net(cfg: TransformerConfig, src_len: int, tgt_len: int,
 
     feeds = [src_ids, tgt_ids, lbl_ids, src_mask, tgt_mask]
     return feeds, avg_cost, logits
+
+
+def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
+                 fused_attention: bool = True):
+    """Decoder-only causal LM on the encoder stack (the flagship bench
+    config; the reference's closest analogue is the language-model rows of
+    benchmark/fluid/).  Feeds: tokens [B,T] int64, labels [B,T] int64 —
+    dense batches, causal masking inside the attention op (flash kernel
+    when fused_attention), no LoD.
+
+    Returns (feeds, avg_cost, logits)."""
+    dropout = 0.0 if is_test else cfg.dropout
+    tokens = layers.data("tokens", [seq_len], dtype="int64")
+    labels = layers.data("labels", [seq_len], dtype="int64")
+    x = prepare_embedding(tokens, cfg.src_vocab_size, cfg.d_model,
+                          cfg.max_length, dropout, name="src")
+    if fused_attention:
+        attn_bias = None
+    else:
+        causal_np = np.triu(np.full((seq_len, seq_len), -1e9,
+                                    dtype="float32"), 1)
+        attn_bias = layers.assign(causal_np[None, None, :, :])
+    for _ in range(cfg.n_layer):
+        x = encoder_layer(x, attn_bias, cfg.n_head, cfg.d_key, cfg.d_value,
+                          cfg.d_model, cfg.d_inner, dropout,
+                          causal=True, fused=fused_attention)
+    x = pre_post_process(None, x, "n")
+    logits = layers.fc(x, size=cfg.src_vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+    logits2d = layers.reshape(logits, [-1, cfg.src_vocab_size])
+    label2d = layers.reshape(labels, [-1, 1])
+    cost = layers.softmax_with_cross_entropy(logits2d, label2d)
+    avg_cost = layers.mean(cost)
+    return [tokens, labels], avg_cost, logits
+
+
+def make_fake_lm_batch(cfg: TransformerConfig, batch_size: int,
+                       seq_len: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(1, cfg.src_vocab_size,
+                         (batch_size, seq_len)).astype("int64")
+    return {"tokens": tokens, "labels": np.roll(tokens, -1, axis=1)}
 
 
 def make_fake_batch(cfg: TransformerConfig, batch_size: int, src_len: int,

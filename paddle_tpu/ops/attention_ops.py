@@ -22,6 +22,9 @@ def _fused_attention(ctx, ins, attrs):
     causal = bool(attrs.get("causal", False))
     B, T, E = q.shape
     d = E // n_head
+    orig_dtype = q.dtype
+    from .math_ops import amp_inputs
+    q, k, v = amp_inputs(q, k, v)
 
     def split(x):
         return x.reshape(B, T, n_head, d).transpose(0, 2, 1, 3)
@@ -30,7 +33,7 @@ def _fused_attention(ctx, ins, attrs):
     if flags.get_flag("use_pallas_kernels"):
         from ..kernels.flash_attention import flash_attention
         o = flash_attention(split(q), split(k), split(v), causal=causal,
-                            scale=scale)
+                            scale=scale, interpret=ctx.pallas_interpret())
     else:
         import numpy as np
         import jax
@@ -42,5 +45,5 @@ def _fused_attention(ctx, ins, attrs):
             s = jnp.where(mask[None, None], s, -1e30)
         w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
         o = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
-    out = o.transpose(0, 2, 1, 3).reshape(B, T, E)
+    out = o.transpose(0, 2, 1, 3).reshape(B, T, E).astype(orig_dtype)
     return {"Out": [out]}

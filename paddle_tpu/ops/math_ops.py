@@ -18,11 +18,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags
 from ..framework.registry import register_op, single_input
 
 
 def _acc_type(x):
     return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+def amp_inputs(*xs):
+    """Under FLAGS_amp_bf16, f32 MXU-op inputs are cast to bfloat16 right
+    before the dot (XLA fuses the convert); accumulation stays f32 and the
+    op's output is cast back to the caller's dtype, so params/activations
+    remain f32 master copies."""
+    if flags.get_flag("amp_bf16"):
+        xs = tuple(x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x
+                   for x in xs)
+    return xs
 
 
 def _flatten2(x, num_col_dims):
@@ -39,6 +51,7 @@ def _mul(ctx, ins, attrs):
     yn = int(attrs.get("y_num_col_dims", 1))
     x2 = _flatten2(x, xn)
     y2 = _flatten2(y, yn)
+    x2, y2 = amp_inputs(x2, y2)
     out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x2))
     out_shape = x.shape[:xn] + y.shape[yn:]
     return {"Out": [out.reshape(out_shape).astype(x.dtype)]}
@@ -61,8 +74,10 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
+    orig_dtype = x.dtype
+    x, y = amp_inputs(x, y)
     out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    out = out.astype(x.dtype)
+    out = out.astype(orig_dtype)
     for ax in squeeze_out:
         out = jnp.squeeze(out, axis=ax)
     if alpha != 1.0:
@@ -73,8 +88,10 @@ def _matmul(ctx, ins, attrs):
 @register_op("bmm")
 def _bmm(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
+    orig_dtype = x.dtype
+    x, y = amp_inputs(x, y)
     out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    return {"Out": [out.astype(x.dtype)]}
+    return {"Out": [out.astype(orig_dtype)]}
 
 
 @register_op("dot")

@@ -56,14 +56,17 @@ def _conv2d(ctx, ins, attrs):
     groups = int(attrs.get("groups", 1))
     padding = _conv_padding(attrs.get("paddings", 0), w.shape[2:], strides,
                             dilations, x.shape[2:])
+    from .math_ops import amp_inputs
+    orig_dtype = x.dtype
+    xc, wc = amp_inputs(x, w)
     out = jax.lax.conv_general_dilated(
-        x, w, window_strides=strides, padding=padding,
+        xc, wc, window_strides=strides, padding=padding,
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=_acc(x))
+        preferred_element_type=_acc(xc))
     if ins.get("Bias"):    # optional fused bias (inference transpiler fold)
         out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [out.astype(orig_dtype)]}
 
 
 @register_op("depthwise_conv2d")
@@ -245,7 +248,8 @@ def _layer_norm(ctx, ins, attrs):
             and ins.get("Scale") and ins.get("Bias")):
         from ..kernels.layer_norm import fused_layer_norm
         y, mean, var = fused_layer_norm(x, ins["Scale"][0], ins["Bias"][0],
-                                        eps=eps, return_stats=True)
+                                        eps=eps, return_stats=True,
+                                        interpret=ctx.pallas_interpret())
         return {"Y": [y], "Mean": [mean], "Variance": [var]}
     axes = tuple(range(axis, x.ndim))
     xf = x.astype(jnp.float32)

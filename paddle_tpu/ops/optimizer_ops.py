@@ -62,8 +62,9 @@ def _lars_momentum(ctx, ins, attrs):
 def _adam(ctx, ins, attrs):
     param, grad = _p(ins, "Param"), _p(ins, "Grad")
     m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
-    b1p = _p(ins, "Beta1Pow").reshape(())
-    b2p = _p(ins, "Beta2Pow").reshape(())
+    b1p_in, b2p_in = _p(ins, "Beta1Pow"), _p(ins, "Beta2Pow")
+    b1p = b1p_in.reshape(())
+    b2p = b2p_in.reshape(())
     lr = _p(ins, "LearningRate").reshape(())
     b1 = float(attrs.get("beta1", 0.9))
     b2 = float(attrs.get("beta2", 0.999))
@@ -72,8 +73,11 @@ def _adam(ctx, ins, attrs):
     m2n = b2 * m2 + (1 - b2) * jnp.square(grad)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p = param - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    # pow accumulators keep their incoming shape: a state var that changes
+    # shape across runs invalidates the executor's jit cache (recompile)
     return {"ParamOut": [p], "Moment1Out": [m1n], "Moment2Out": [m2n],
-            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+            "Beta1PowOut": [(b1p * b1).reshape(b1p_in.shape)],
+            "Beta2PowOut": [(b2p * b2).reshape(b2p_in.shape)]}
 
 
 @register_op("adamw")
@@ -91,7 +95,8 @@ def _adamw(ctx, ins, attrs):
 def _adamax(ctx, ins, attrs):
     param, grad = _p(ins, "Param"), _p(ins, "Grad")
     m, inf = _p(ins, "Moment"), _p(ins, "InfNorm")
-    b1p = _p(ins, "Beta1Pow").reshape(())
+    b1p_in = _p(ins, "Beta1Pow")
+    b1p = b1p_in.reshape(())
     lr = _p(ins, "LearningRate").reshape(())
     b1 = float(attrs.get("beta1", 0.9))
     b2 = float(attrs.get("beta2", 0.999))
@@ -100,7 +105,7 @@ def _adamax(ctx, ins, attrs):
     infn = jnp.maximum(b2 * inf, jnp.abs(grad) + eps)
     p = param - (lr / (1 - b1p)) * (mn / infn)
     return {"ParamOut": [p], "MomentOut": [mn], "InfNormOut": [infn],
-            "Beta1PowOut": [b1p * b1]}
+            "Beta1PowOut": [(b1p * b1).reshape(b1p_in.shape)]}
 
 
 @register_op("adagrad")
@@ -219,8 +224,9 @@ def _lamb(ctx, ins, attrs):
     """LAMB (post-reference; needed for BERT-scale large-batch training)."""
     param, grad = _p(ins, "Param"), _p(ins, "Grad")
     m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
-    b1p = _p(ins, "Beta1Pow").reshape(())
-    b2p = _p(ins, "Beta2Pow").reshape(())
+    b1p_in, b2p_in = _p(ins, "Beta1Pow"), _p(ins, "Beta2Pow")
+    b1p = b1p_in.reshape(())
+    b2p = b2p_in.reshape(())
     lr = _p(ins, "LearningRate").reshape(())
     b1 = float(attrs.get("beta1", 0.9))
     b2 = float(attrs.get("beta2", 0.999))
@@ -236,7 +242,8 @@ def _lamb(ctx, ins, attrs):
     trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
     return {"ParamOut": [param - lr * trust * r],
             "Moment1Out": [m1n], "Moment2Out": [m2n],
-            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+            "Beta1PowOut": [(b1p * b1).reshape(b1p_in.shape)],
+            "Beta2PowOut": [(b2p * b2).reshape(b2p_in.shape)]}
 
 
 @register_op("average_accumulates")

@@ -86,3 +86,87 @@ def test_vgg_cifar_builds():
             "label": rng.randint(0, 10, (2, 1)).astype("int64")}
     losses = _train(feeds, avg_loss, feed, steps=1, lr=0.01)
     assert np.isfinite(losses).all()
+
+
+def test_lm_fused_attention_trains():
+    """Decoder-only LM (the bench config) with the fused flash-attention
+    path: loss decreases; parity with the unfused build at init."""
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=200, tgt_vocab_size=200, max_length=16,
+        n_layer=2, n_head=2, d_model=32, d_inner=64, dropout=0.0)
+    feeds, avg_cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=16, fused_attention=True)
+    feed = models.transformer.make_fake_lm_batch(cfg, 4, 16)
+    losses = _train(feeds, avg_cost, feed, steps=4,
+                    opt=pt.optimizer.Adam(learning_rate=1e-3))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_lm_fused_matches_unfused_loss():
+    """fused_attention=True/False compute the same math (same seed)."""
+    vals = []
+    for fused in (True, False):
+        pt.reset_default_programs()
+        from paddle_tpu.framework import executor as em
+        em._global_scope = em.Scope()
+        cfg = models.transformer.TransformerConfig(
+            src_vocab_size=100, tgt_vocab_size=100, max_length=8,
+            n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+        feeds, avg_cost, _ = models.transformer.build_lm_net(
+            cfg, seq_len=8, fused_attention=fused)
+        exe = pt.Executor(pt.CPUPlace())
+        pt.default_startup_program().random_seed = 7
+        exe.run(pt.default_startup_program())
+        feed = models.transformer.make_fake_lm_batch(cfg, 2, 8)
+        out, = exe.run(pt.default_main_program(), feed=feed,
+                       fetch_list=[avg_cost])
+        vals.append(float(out))
+    np.testing.assert_allclose(vals[0], vals[1], rtol=1e-4)
+
+
+def test_amp_bf16_close_to_f32():
+    """FLAGS_amp_bf16 keeps the loss within bf16 tolerance of f32."""
+    from paddle_tpu.core import flags
+    vals = []
+    for amp in (False, True):
+        pt.reset_default_programs()
+        from paddle_tpu.framework import executor as em
+        em._global_scope = em.Scope()
+        flags.set_flag("amp_bf16", amp)
+        try:
+            cfg = models.transformer.TransformerConfig(
+                src_vocab_size=100, tgt_vocab_size=100, max_length=8,
+                n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+            feeds, avg_cost, _ = models.transformer.build_lm_net(
+                cfg, seq_len=8, fused_attention=False)
+            exe = pt.Executor(pt.CPUPlace())
+            pt.default_startup_program().random_seed = 7
+            exe.run(pt.default_startup_program())
+            feed = models.transformer.make_fake_lm_batch(cfg, 2, 8)
+            out, = exe.run(pt.default_main_program(), feed=feed,
+                           fetch_list=[avg_cost])
+            vals.append(float(out))
+        finally:
+            flags.set_flag("amp_bf16", False)
+    np.testing.assert_allclose(vals[0], vals[1], rtol=2e-2)
+
+
+def test_adam_state_signature_stable():
+    """Adam's pow accumulators must keep their shape across steps — a
+    changed state signature forces a silent full recompile every run
+    (caught live on TPU: 12s/step instead of 70ms)."""
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 4).astype("float32"),
+            "y": rng.randn(4, 1).astype("float32")}
+    for _ in range(3):
+        exe.run(pt.default_main_program(), feed=feed, fetch_list=[loss])
+    assert len(exe._cache) == 2, (
+        f"executor recompiled: {len(exe._cache)} cache entries")

@@ -1,0 +1,136 @@
+"""Sharded sparse-embedding capability (the reference's pserver
+distributed-lookup-table, transpiler/distribute_transpiler.py:1010,1274 +
+parameter_prefetch.cc): shard_map row-sharded lookup + sparse scatter
+updates, and the declarative Program-path equivalent on DeepFM."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.core.place import make_mesh
+from paddle_tpu.parallel import sharded_embedding as se
+
+
+def _mesh(dp, mp):
+    return make_mesh((dp, mp), ("data", "model"))
+
+
+def test_row_sharded_lookup_matches_take():
+    mesh = _mesh(2, 4)
+    V, D, B, F = 32, 4, 6, 3
+    rng = np.random.RandomState(0)
+    table = rng.randn(V, D).astype("float32")
+    ids = rng.randint(0, V, (B, F)).astype("int32")
+
+    def f(table, ids):
+        return se.row_sharded_lookup(table, ids)
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(jax.sharding.PartitionSpec("model", None),
+                  jax.sharding.PartitionSpec("data", None)),
+        out_specs=jax.sharding.PartitionSpec("data", None, None),
+        check_vma=False))(table, ids)
+    np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
+
+
+def test_ctr_step_parity_vs_reference():
+    """One sharded step == one dense single-device step (params + loss)."""
+    cfg = se.ShardedCTRConfig(vocab_size=64, num_field=5, embed_dim=4,
+                              fc_sizes=(8,), learning_rate=0.1)
+    mesh = _mesh(4, 2)
+    params = se.init_ctr_params(mesh, cfg, seed=3)
+    host = {k: np.asarray(v) for k, v in params.items()}
+    ids, vals, label = se.make_fake_ctr_batch(cfg, batch=8, seed=1)
+
+    step = se.build_ctr_train_step(mesh, cfg)
+    new_params, loss = step(params, ids, vals, label)
+
+    ref_params, ref_loss = se.reference_ctr_step(host, cfg, ids, vals,
+                                                 label)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in ref_params:
+        np.testing.assert_allclose(
+            np.asarray(new_params[k]), np.asarray(ref_params[k]),
+            rtol=2e-4, atol=1e-6, err_msg=f"param {k} diverged")
+
+
+def test_ctr_million_row_table_trains():
+    """BASELINE config 4 scale: a 1M-row table trains sharded; loss
+    decreases over steps on a repeated batch."""
+    cfg = se.ShardedCTRConfig(vocab_size=1_000_000, num_field=10,
+                              embed_dim=8, fc_sizes=(32,),
+                              learning_rate=0.5)
+    mesh = _mesh(2, 4)
+    params = se.init_ctr_params(mesh, cfg, seed=0)
+    step = se.build_ctr_train_step(mesh, cfg)
+    ids, vals, label = se.make_fake_ctr_batch(cfg, batch=16, seed=0)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, ids, vals, label)
+        losses.append(float(jax.block_until_ready(loss)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_sparse_update_touches_only_looked_up_rows():
+    cfg = se.ShardedCTRConfig(vocab_size=64, num_field=2, embed_dim=4,
+                              fc_sizes=(8,), learning_rate=0.1)
+    mesh = _mesh(2, 2)
+    params = se.init_ctr_params(mesh, cfg, seed=0)
+    before = np.asarray(params["emb"]).copy()
+    ids = np.array([[3, 17], [3, 40], [9, 60], [61, 5]], dtype="int32")
+    vals = np.ones((4, 2), "float32")
+    label = np.ones((4, 1), "float32")
+    step = se.build_ctr_train_step(mesh, cfg)
+    new_params, _ = step(params, ids, vals, label)
+    after = np.asarray(new_params["emb"])
+    touched = sorted(set(ids.ravel().tolist()))
+    untouched = [i for i in range(64) if i not in touched]
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+    assert np.abs(after[touched] - before[touched]).max() > 0
+
+
+def test_deepfm_program_path_sharded_parity():
+    """DeepFM (config 4) through the Program/Executor path with the table
+    Parameter row-sharded over 'model': loss parity vs replicated run —
+    XLA SPMD supplies the collectives the transpiler's pserver split
+    provided (distribute_transpiler.py:1010)."""
+    losses = {}
+    for axis in (None, "model"):
+        pt.reset_default_programs()
+        from paddle_tpu.framework import executor as em
+        em._global_scope = em.Scope()
+        cfg = models.deepfm.DeepFMConfig(
+            num_field=6, vocab_size=80, embed_dim=4, fc_sizes=(16,),
+            sparse_shard_axis=axis)
+        feeds, avg_cost, prob = models.deepfm.build_train_net(cfg)
+        pt.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+        pt.default_startup_program().random_seed = 11
+        feed = models.deepfm.make_fake_batch(cfg, 8)
+        if axis is None:
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(pt.default_startup_program())
+        else:
+            mesh = _mesh(4, 2)
+            exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+            exe.run(pt.default_startup_program())
+        run = []
+        for _ in range(3):
+            out, = exe.run(pt.default_main_program(), feed=feed,
+                           fetch_list=[avg_cost])
+            run.append(float(out))
+        losses[axis] = run
+    np.testing.assert_allclose(losses[None], losses["model"],
+                               rtol=1e-4, atol=1e-6)
+    assert losses["model"][-1] < losses["model"][0]
+
+
+def test_embedding_is_sparse_attr_recorded():
+    x = layers.data("x", [4], dtype="int64")
+    layers.embedding(x, size=[10, 4], is_sparse=True)
+    op = [o for o in pt.default_main_program().global_block().ops
+          if o.type == "lookup_table"][0]
+    assert op.attrs["is_sparse"] is True
