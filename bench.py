@@ -63,8 +63,9 @@ def main():
     dt = (time.perf_counter() - t0) / iters
 
     toks_per_sec = batch * T / dt
-    # train FLOPs/token = 3x fwd: qkvo+ffn matmuls, causal attention, logits
-    flops_tok = 3 * (L * (8 * D * D + 4 * D * F) + L * 4 * T * D + 2 * D * V)
+    # train FLOPs/token = 3x fwd: qkvo+ffn matmuls, CAUSAL attention
+    # (~T/2 keys per query -> 2*T*D per layer), logits
+    flops_tok = 3 * (L * (8 * D * D + 4 * D * F) + L * 2 * T * D + 2 * D * V)
     tflops = toks_per_sec * flops_tok / 1e12
     print(json.dumps({
         "metric": "transformer_lm_train_tokens_per_sec_per_chip",

@@ -1,0 +1,240 @@
+"""Sharded, corruption-safe checkpointing (orbax-style, self-contained).
+
+Capability parity with the reference's durable-checkpoint discipline:
+  * /root/reference/go/pserver/service.go:346 — checkpoint() computes a
+    CRC32 over the serialized state, writes to a temp file, then commits
+    with an atomic rename; a torn write is detected at load;
+  * contrib/trainer.py:663,763 — serial-numbered directories + rotation;
+  * SURVEY.md §5 — the TPU equivalent must shard: every process saves
+    only its addressable shards of each jax.Array, and load reassembles
+    (or re-shards) them, so a multi-host mesh never funnels the whole
+    model through one host.
+
+Layout of one checkpoint:
+    <root>/checkpoint_<serial>/
+        shard_00000-of-00001.npz       per-process piece file
+        manifest.json                  written LAST = commit point
+The manifest records every array's global shape/dtype, each piece's
+slice, and a CRC32 per shard file.  A checkpoint without a manifest, or
+whose shard CRCs mismatch, is invalid and is skipped by
+latest_checkpoint() — resume falls back to the newest valid serial.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+
+
+class CheckpointCorrupt(Exception):
+    pass
+
+
+def _npdtype(name):
+    if name == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def _pieces_of(name: str, value) -> list:
+    """Split a value into (key, slices, np_array) pieces this process
+    owns.  jax.Arrays contribute their addressable shards; host arrays
+    contribute one full piece."""
+    import jax
+    pieces = []
+    if isinstance(value, jax.Array):
+        for i, sh in enumerate(value.addressable_shards):
+            if sh.replica_id != 0:
+                # replicated arrays expose one identical shard per device;
+                # write the data once, not once per replica
+                continue
+            idx = []
+            for d, sl in enumerate(sh.index):
+                start = 0 if sl.start is None else int(sl.start)
+                stop = (value.shape[d] if sl.stop is None else int(sl.stop))
+                idx.append((start, stop))
+            dat = np.asarray(sh.data)
+            if dat.dtype.name == "bfloat16":
+                dat = dat.astype(np.float32)
+            pieces.append((f"{name}@{i}", idx, dat))
+    else:
+        arr = np.asarray(value)
+        if arr.dtype.name == "bfloat16":
+            arr = arr.astype(np.float32)
+        pieces.append((f"{name}@0", [(0, s) for s in arr.shape], arr))
+    return pieces
+
+
+def save_state(dirname: str, state: Dict[str, Any],
+               meta: Optional[dict] = None,
+               process_index: Optional[int] = None,
+               num_processes: Optional[int] = None):
+    """Write this process's shard of `state` + (on process 0) the manifest.
+
+    Single-process callers can ignore process arguments."""
+    import jax
+    p = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if num_processes is None else num_processes
+    os.makedirs(dirname, exist_ok=True)
+    shard_name = f"shard_{p:05d}-of-{n:05d}.npz"
+
+    arrays, entries = {}, {}
+    for name, value in state.items():
+        dtype = np.asarray(value).dtype.name if not hasattr(value, "dtype") \
+            else value.dtype.name
+        shape = list(np.shape(value))
+        pcs = []
+        for key, idx, dat in _pieces_of(name, value):
+            arrays[key] = dat
+            pcs.append({"key": key, "index": idx, "shard": shard_name})
+        entries[name] = {"shape": shape, "dtype": str(dtype),
+                         "pieces": pcs}
+
+    tmp = os.path.join(dirname, shard_name + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    with open(tmp, "rb") as f:
+        crc = zlib.crc32(f.read())
+    os.replace(tmp, os.path.join(dirname, shard_name))  # atomic (ref :346)
+
+    # every process contributes a sidecar; process 0 merges them into the
+    # manifest, which is written last as the commit point
+    side = {"entries": entries, "crc": {shard_name: crc}}
+    side_path = os.path.join(dirname, f".side_{p:05d}.json")
+    with open(side_path + ".tmp", "w") as f:
+        json.dump(side, f)
+    os.replace(side_path + ".tmp", side_path)
+
+    if p == 0:
+        # barrier via the shared filesystem: every process writes its
+        # sidecar atomically; process 0 waits for all of them before
+        # merging (multi-host saves share the checkpoint dir)
+        import time
+        deadline = time.time() + 300.0
+        merged_entries: Dict[str, dict] = {}
+        crcs: Dict[str, int] = {}
+        for q in range(n):
+            qp = os.path.join(dirname, f".side_{q:05d}.json")
+            while not os.path.exists(qp):
+                if time.time() > deadline:
+                    raise CheckpointCorrupt(
+                        f"timed out waiting for process {q}'s shard "
+                        f"sidecar {qp}")
+                time.sleep(0.05)
+            with open(qp) as f:
+                s = json.load(f)
+            crcs.update(s["crc"])
+            for name, e in s["entries"].items():
+                if name in merged_entries:
+                    merged_entries[name]["pieces"].extend(e["pieces"])
+                else:
+                    merged_entries[name] = e
+        manifest = {"entries": merged_entries, "crc": crcs,
+                    "meta": meta or {}, "num_processes": n}
+        mtmp = os.path.join(dirname, MANIFEST + ".tmp")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(mtmp, os.path.join(dirname, MANIFEST))
+
+
+def is_valid(dirname: str) -> bool:
+    """Manifest present and every shard file matches its CRC."""
+    mpath = os.path.join(dirname, MANIFEST)
+    if not os.path.exists(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        for shard, crc in manifest["crc"].items():
+            path = os.path.join(dirname, shard)
+            with open(path, "rb") as f:
+                if zlib.crc32(f.read()) != crc:
+                    return False
+    except (OSError, ValueError, KeyError):
+        return False
+    return True
+
+
+def load_state(dirname: str, device=None) -> Tuple[Dict[str, Any], dict]:
+    """Reassemble the full state from all shard files (CRC-checked).
+    Returns (state, meta); arrays are host numpy (caller re-shards via
+    device_put with its own shardings)."""
+    mpath = os.path.join(dirname, MANIFEST)
+    if not os.path.exists(mpath):
+        raise CheckpointCorrupt(f"no manifest in {dirname}")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    shard_data = {}
+    for shard, crc in manifest["crc"].items():
+        path = os.path.join(dirname, shard)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CheckpointCorrupt(f"missing shard {shard}: {e}")
+        if zlib.crc32(raw) != crc:
+            raise CheckpointCorrupt(f"CRC mismatch in {shard}")
+        import io as _io
+        shard_data[shard] = np.load(_io.BytesIO(raw))
+    state = {}
+    for name, e in manifest["entries"].items():
+        dt = _npdtype(e["dtype"])
+        store_dt = np.float32 if e["dtype"] == "bfloat16" else dt
+        out = np.zeros(e["shape"], dtype=store_dt)
+        for pc in e["pieces"]:
+            dat = shard_data[pc["shard"]][pc["key"]]
+            sl = tuple(slice(a, b) for a, b in pc["index"])
+            out[sl] = dat
+        state[name] = out.astype(dt) if e["dtype"] == "bfloat16" else out
+    return state, manifest.get("meta", {})
+
+
+# -- serial-numbered rotation (ref contrib/trainer.py:663,763) -------------
+
+def _serial_dir(root: str, serial: int) -> str:
+    return os.path.join(root, f"checkpoint_{serial}")
+
+
+def save_checkpoint(root: str, state: Dict[str, Any],
+                    meta: Optional[dict] = None, max_keep: int = 3,
+                    **proc_kw) -> int:
+    serial = latest_checkpoint(root, require_valid=False) + 1
+    save_state(_serial_dir(root, serial), state, meta, **proc_kw)
+    serials = sorted(
+        int(n.split("_")[-1]) for n in os.listdir(root)
+        if n.startswith("checkpoint_") and n.split("_")[-1].isdigit())
+    if max_keep > 0:
+        for s in serials[:-max_keep]:
+            shutil.rmtree(_serial_dir(root, s), ignore_errors=True)
+    return serial
+
+
+def latest_checkpoint(root: str, require_valid: bool = True) -> int:
+    """Newest serial; with require_valid, newest whose CRCs verify —
+    a torn/corrupt checkpoint is skipped so resume falls back."""
+    if not os.path.isdir(root):
+        return -1
+    serials = sorted(
+        (int(n.split("_")[-1]) for n in os.listdir(root)
+         if n.startswith("checkpoint_") and n.split("_")[-1].isdigit()),
+        reverse=True)
+    for s in serials:
+        if not require_valid or is_valid(_serial_dir(root, s)):
+            return s
+    return -1
+
+
+def load_checkpoint(root: str, serial: Optional[int] = None
+                    ) -> Tuple[Dict[str, Any], dict, int]:
+    s = latest_checkpoint(root) if serial is None else serial
+    if s < 0:
+        raise CheckpointCorrupt(f"no valid checkpoint under {root}")
+    state, meta = load_state(_serial_dir(root, s))
+    return state, meta, s

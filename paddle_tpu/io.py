@@ -5,9 +5,10 @@ Capability parity with /root/reference/python/paddle/fluid/io.py
 save_inference_model:570, load_inference_model:704) and the save/load ops
 (operators/save_op.cc, load_op.cc, save_combine_op.cc).
 
-Format: one .npz per save (combine-style) + program JSON.  Orbax-style
-sharded checkpointing for the distributed path lives in
-paddle_tpu/incubate/checkpoint.py.
+Format: one .npz per save (combine-style) + program JSON.  Durable
+sharded checkpointing (per-process shard files, CRC32 + atomic rename,
+rotation, corrupt-fallback resume) lives in paddle_tpu/incubate/
+checkpoint.py and backs the Trainer's checkpoint cadence.
 """
 from __future__ import annotations
 

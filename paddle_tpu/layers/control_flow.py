@@ -196,10 +196,23 @@ class StaticRNN:
             x_names.append((t_var.name, inner.name))
         y_names = [o.name for o in self._outputs]
 
-        outs = [parent.create_var(name=unique_name.generate("rnn_out"),
-                                  dtype=o.dtype) for o in self._outputs]
+        # output shapes: scan stacks per-step outputs as [T, ...]; T comes
+        # from the first scanned input's time axis when static
+        T = None
+        if self._x:
+            outer0 = self._x[0][0]
+            if outer0.shape and len(outer0.shape) > 1:
+                T = outer0.shape[1]
+        outs = []
+        for o in self._outputs:
+            shape = ((T,) + tuple(o.shape)) if (T is not None and
+                                                o.shape is not None) else None
+            outs.append(parent.create_var(
+                name=unique_name.generate("rnn_out"), dtype=o.dtype,
+                shape=shape))
         carry_outs = [parent.create_var(
-            name=unique_name.generate("rnn_carry"), dtype=m["init"].dtype)
+            name=unique_name.generate("rnn_carry"), dtype=m["init"].dtype,
+            shape=m["init"].shape)
             for m in self._memories]
         parent.append_op(
             "static_rnn_scan",
@@ -222,6 +235,8 @@ class StaticRNN:
         nd = len(self._outputs[0].shape or (0, 0)) + 1
         perm = list(range(nd))
         perm[0], perm[1] = 1, 0
+        if out.shape is not None and len(out.shape) >= 2:
+            tr.shape = (out.shape[1], out.shape[0]) + tuple(out.shape[2:])
         helper.main_program.current_block().append_op(
             "transpose", {"X": [out.name]}, {"Out": [tr.name]},
             {"axis": perm})

@@ -8,3 +8,4 @@ from . import transformer
 from . import deepfm
 from . import bert
 from . import stacked_lstm
+from . import machine_translation

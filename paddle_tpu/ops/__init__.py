@@ -19,6 +19,7 @@ from . import optimizer_ops
 from . import control_flow
 from . import rnn_ops
 from . import sequence_ops
+from . import beam_search_ops
 from . import detection_ops
 from . import collective_ops
 from . import attention_ops

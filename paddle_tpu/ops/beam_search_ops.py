@@ -1,0 +1,84 @@
+"""Beam search ops.
+
+Capability parity with /root/reference/paddle/fluid/operators/
+beam_search_op.cc and beam_search_decode_op.cc, redesigned TPU-first:
+the reference walks LoD-structured candidate lists per source sentence;
+here everything is dense [batch, beam] tensors + lax.top_k, so one step
+is a couple of MXU/VPU-friendly ops and the whole decode loop lives
+inside a single lax.scan (layers.StaticRNN) under jit — no host control
+flow, no dynamic shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op, single_input
+
+NEG_INF = -1e9
+
+
+@register_op("beam_search", stop_gradient=True)
+def _beam_search(ctx, ins, attrs):
+    """One expansion step.
+
+    Inputs:
+      PreScores [B, K] cumulative log-probs (init row = [0, -inf, ...]);
+      PreIds    [B, K] previous token per beam (to detect finished beams);
+      LogProbs  [B, K, V] next-token log-probs.
+    attrs: beam_size K (= input K), end_id.
+    Outputs: Scores [B, K], Ids [B, K], Parents [B, K] int32.
+
+    Finished beams (PreIds == end_id) are frozen: they can only emit
+    end_id again at zero cost, so their cumulative score is carried
+    unchanged (ref beam_search_op.cc end-token handling)."""
+    pre_scores = single_input(ins, "PreScores")
+    pre_ids = single_input(ins, "PreIds")
+    log_probs = single_input(ins, "LogProbs")
+    B, K, V = log_probs.shape
+    end_id = int(attrs.get("end_id", 1))
+
+    finished = (pre_ids.astype(jnp.int32) == end_id)           # [B, K]
+    # finished beams: only end_id continuation, at zero added cost
+    only_end = jnp.full((V,), NEG_INF, log_probs.dtype).at[end_id].set(0.0)
+    step_lp = jnp.where(finished[..., None], only_end[None, None, :],
+                        log_probs)
+    total = pre_scores[..., None] + step_lp                    # [B, K, V]
+    flat = total.reshape(B, K * V)
+    scores, idx = lax.top_k(flat, K)                           # [B, K]
+    parents = (idx // V).astype(jnp.int32)
+    ids = (idx % V).astype(jnp.int32)
+    outs = {"Scores": [scores], "Ids": [ids], "Parents": [parents]}
+    if ins.get("State"):
+        # fused beam reorder: State [B, K, ...] gathered by parent, so
+        # the decode loop needs no separate flat-index gather plumbing
+        state = ins["State"][0]
+        binc = jnp.arange(B)[:, None]
+        outs["StateOut"] = [state[binc, parents]]
+    return outs
+
+
+@register_op("beam_search_decode", stop_gradient=True)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stored (ids, parents) steps into full hypotheses.
+
+    Inputs: Ids [T, B, K], Parents [T, B, K] (the per-step outputs of
+    `beam_search`, stacked by the scan), Scores [B, K] final cumulative.
+    Outputs: SentenceIds [B, K, T] int32, SentenceScores [B, K]
+    (ref beam_search_decode_op.cc, dense instead of LoD trees)."""
+    ids = single_input(ins, "Ids").astype(jnp.int32)
+    parents = single_input(ins, "Parents").astype(jnp.int32)
+    scores = single_input(ins, "Scores")
+    T, B, K = ids.shape
+    binc = jnp.arange(B)[:, None]                              # [B, 1]
+
+    def back(beam, t):
+        tok = ids[t][binc, beam]                               # [B, K]
+        par = parents[t][binc, beam]
+        return par, tok
+
+    init = jnp.tile(jnp.arange(K)[None, :], (B, 1))            # [B, K]
+    _, toks = lax.scan(back, init, jnp.arange(T - 1, -1, -1))
+    sent = jnp.flip(jnp.transpose(toks, (1, 2, 0)), axis=-1)   # [B, K, T]
+    return {"SentenceIds": [sent], "SentenceScores": [scores]}

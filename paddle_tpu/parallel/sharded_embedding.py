@@ -28,7 +28,7 @@ explicit-collective engine and the sparse-update fast path.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Tuple
 
 import jax

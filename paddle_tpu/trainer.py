@@ -11,9 +11,7 @@ Executor — data parallelism is a sharding, not a program rewrite.
 """
 from __future__ import annotations
 
-import json
 import os
-import shutil
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -61,9 +59,6 @@ class CheckpointConfig:
         self.step_interval = max(1, int(step_interval))
 
 
-SERIAL_FILE = "_serial_meta.json"
-
-
 class Trainer:
     """train_func builds (loss, [metrics...]) in the default program and
     returns either loss or [loss, metric, ...]."""
@@ -108,51 +103,45 @@ class Trainer:
                 self._load_checkpoint(serial)
 
     # -- checkpoint plumbing (ref save_checkpoint:663, rotation) ----------
-    def _ckpt_dir(self, serial: int) -> str:
-        return os.path.join(self.checkpoint_cfg.checkpoint_dir,
-                            f"checkpoint_{serial}")
+    # Durable format: incubate/checkpoint.py — per-process shard files,
+    # CRC32 + atomic rename (go/pserver/service.go:346 semantics), the
+    # manifest as commit point.  A checkpoint torn by a crash fails its
+    # CRC and resume falls back to the newest valid serial.
+
+    def _persist_state(self):
+        names = [v.name for v in self.train_program.list_vars()
+                 if v.persistable]
+        return {n: self.scope.find_var(n) for n in names
+                if self.scope.has_var(n)}
 
     def _latest_serial(self) -> int:
-        root = self.checkpoint_cfg.checkpoint_dir
-        if not os.path.isdir(root):
-            return -1
-        serials = []
-        for name in os.listdir(root):
-            if name.startswith("checkpoint_"):
-                try:
-                    s = int(name.split("_")[-1])
-                except ValueError:
-                    continue
-                if os.path.exists(os.path.join(root, name, SERIAL_FILE)):
-                    serials.append(s)
-        return max(serials) if serials else -1
+        from .incubate import checkpoint as ckpt
+        return ckpt.latest_checkpoint(self.checkpoint_cfg.checkpoint_dir)
 
     def _save_checkpoint(self, epoch_id: int, step_id: int,
                          epoch_complete: bool = False):
-        serial = self._latest_serial() + 1
-        d = self._ckpt_dir(serial)
-        os.makedirs(d, exist_ok=True)
-        pio.save_persistables(self.exe, d, main_program=self.train_program)
+        from .incubate import checkpoint as ckpt
         # epoch-boundary checkpoints resume at epoch_id+1; mid-epoch
         # (step-interval) checkpoints restart their epoch — without data
         # iterator state that epoch's earlier steps are replayed, which is
         # the reference Trainer's semantic too (contrib/trainer.py:663)
-        with open(os.path.join(d, SERIAL_FILE), "w") as f:
-            json.dump({"epoch": epoch_id + 1 if epoch_complete else epoch_id,
-                       "step": step_id}, f)
-        # rotation
-        root = self.checkpoint_cfg.checkpoint_dir
-        keep = self.checkpoint_cfg.max_num_checkpoints
-        serials = sorted(s for s in range(serial + 1)
-                         if os.path.isdir(self._ckpt_dir(s)))
-        for s in serials[:-keep] if keep > 0 else []:
-            shutil.rmtree(self._ckpt_dir(s), ignore_errors=True)
+        meta = {"epoch": epoch_id + 1 if epoch_complete else epoch_id,
+                "step": step_id}
+        ckpt.save_checkpoint(
+            self.checkpoint_cfg.checkpoint_dir, self._persist_state(),
+            meta, max_keep=self.checkpoint_cfg.max_num_checkpoints)
 
     def _load_checkpoint(self, serial: int):
-        d = self._ckpt_dir(serial)
-        pio.load_persistables(self.exe, d, main_program=self.train_program)
-        with open(os.path.join(d, SERIAL_FILE)) as f:
-            meta = json.load(f)
+        import jax
+        from .incubate import checkpoint as ckpt
+        state, meta, _ = ckpt.load_checkpoint(
+            self.checkpoint_cfg.checkpoint_dir, serial)
+        device = self.exe.place.jax_device() if self.exe.mesh is None \
+            else None
+        for name, arr in state.items():
+            if device is not None:
+                arr = jax.device_put(arr, device)
+            self.scope.set_var(name, arr)
         self.epoch_offset = int(meta.get("epoch", 0))
 
     # -- loops -------------------------------------------------------------

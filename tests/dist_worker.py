@@ -1,0 +1,80 @@
+"""Worker for the multi-host harness test (the reference's pattern:
+unittests/test_dist_base.py:212 spawns localhost trainer subprocesses).
+
+Run:  python tests/dist_worker.py <coordinator> <world> <rank> <out.json>
+
+Each process contributes its local CPU device to the global mesh via
+parallel/env.init_distributed_env (the gen_nccl_id-equivalent rendezvous),
+then trains a tiny DP linear model with an explicit grad psum and reports
+per-step losses + final weights.
+"""
+import json
+import os
+import sys
+
+# repo root on sys.path (PYTHONPATH must stay unset — axon plugin quirk,
+# tests/conftest.py)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+
+def main():
+    coordinator, world, rank, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    import jax
+    from paddle_tpu.parallel import env as penv
+
+    ok = penv.init_distributed_env(coordinator_address=coordinator,
+                                   num_processes=world, process_id=rank)
+    assert ok, "init_distributed_env returned False"
+    assert jax.process_count() == world
+    devices = jax.devices()
+    assert len(devices) >= world, devices
+
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices[:world]), ("data",))
+    B_loc, D = 4, 3
+    rng = np.random.RandomState(0)
+    # deterministic GLOBAL batch; this process feeds its slice
+    x_all = rng.randn(world * B_loc, D).astype("float32")
+    y_all = (x_all @ np.array([[1.0], [-2.0], [0.5]], "float32")
+             ).astype("float32")
+    sl = slice(rank * B_loc, (rank + 1) * B_loc)
+    xs = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data", None)), x_all[sl])
+    ys = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data", None)), y_all[sl])
+
+    def device_step(w, x, y):
+        def loss_fn(w):
+            pred = x @ w
+            return jnp.sum((pred - y) ** 2) / (world * B_loc)
+
+        lp, g = jax.value_and_grad(loss_fn)(w)
+        g = lax.psum(g, "data")
+        return w - 0.1 * g, lax.psum(lp, "data")
+
+    step = jax.jit(jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(P(), P("data", None), P("data", None)),
+        out_specs=(P(), P()), check_vma=False))
+
+    w = jnp.zeros((D, 1), jnp.float32)
+    losses = []
+    for _ in range(5):
+        w, loss = step(w, xs, ys)
+        losses.append(float(jax.block_until_ready(loss)))
+    result = {"rank": rank, "losses": losses,
+              "w": np.asarray(w).ravel().tolist()}
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    print("WORKER_OK", rank)
+
+
+if __name__ == "__main__":
+    main()

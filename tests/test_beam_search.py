@@ -1,0 +1,114 @@
+"""Beam search ops (ref operators/beam_search_op.cc,
+beam_search_decode_op.cc) + the machine-translation book example
+(ref tests/book/test_machine_translation.py): train seq2seq+attention,
+then beam-decode with finite scores."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+from op_test import OpTest
+
+
+def np_beam_step(pre_scores, pre_ids, log_probs, end_id):
+    B, K, V = log_probs.shape
+    lp = log_probs.copy()
+    for b in range(B):
+        for k in range(K):
+            if pre_ids[b, k] == end_id:
+                lp[b, k, :] = -1e9
+                lp[b, k, end_id] = 0.0
+    total = pre_scores[..., None] + lp
+    flat = total.reshape(B, K * V)
+    idx = np.argsort(-flat, axis=1)[:, :K]
+    scores = np.take_along_axis(flat, idx, axis=1)
+    return scores, (idx % V).astype("int32"), (idx // V).astype("int32")
+
+
+class TestBeamSearch(OpTest):
+    op_type = "beam_search"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        B, K, V = 2, 3, 7
+        pre_scores = rng.randn(B, K).astype("float32")
+        pre_ids = rng.randint(2, V, (B, K)).astype("int32")
+        pre_ids[0, 1] = 1                       # one finished beam
+        log_probs = np.log(
+            rng.dirichlet(np.ones(V), size=(B, K)).astype("float32"))
+        scores, ids, parents = np_beam_step(
+            pre_scores.astype("float64"), pre_ids,
+            log_probs.astype("float64"), end_id=1)
+        self.inputs = {"PreScores": pre_scores, "PreIds": pre_ids,
+                       "LogProbs": log_probs}
+        self.attrs = {"beam_size": K, "end_id": 1}
+        self.outputs = {"Scores": scores, "Ids": ids, "Parents": parents}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+def test_beam_search_decode_backtracks():
+    """Hand-built 2-step trellis: backtracking recovers the right paths."""
+    # T=2, B=1, K=2
+    ids = np.array([[[5, 6]], [[7, 8]]], dtype="int32")      # [T,B,K]
+    parents = np.array([[[0, 0]], [[1, 0]]], dtype="int32")
+    scores = np.array([[-0.5, -1.0]], dtype="float32")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        b = main.global_block()
+        for n, a in (("ids", ids), ("parents", parents), ("sc", scores)):
+            b.create_var(name=n, shape=a.shape, dtype=str(a.dtype),
+                         is_data=True)
+        b.create_var(name="sent", dtype="int32")
+        b.create_var(name="sent_sc", dtype="float32")
+        b.append_op("beam_search_decode",
+                    {"Ids": ["ids"], "Parents": ["parents"],
+                     "Scores": ["sc"]},
+                    {"SentenceIds": ["sent"], "SentenceScores": ["sent_sc"]},
+                    {})
+    exe = pt.Executor(pt.CPUPlace())
+    sent, sc = exe.run(main, feed={"ids": ids, "parents": parents,
+                                   "sc": scores},
+                       fetch_list=["sent", "sent_sc"])
+    # beam 0 at t=1 came from parent 1 (token 6), then token 7
+    np.testing.assert_array_equal(sent[0, 0], [6, 7])
+    np.testing.assert_array_equal(sent[0, 1], [5, 8])
+    np.testing.assert_allclose(sc, scores)
+
+
+def test_machine_translation_trains_and_decodes():
+    """Book-example contract: loss decreases; beam decode then yields
+    finite, sorted scores and in-vocab tokens."""
+    V, Ts = 20, 5
+    feeds, avg_cost = models.machine_translation.build_train_net(
+        src_vocab=V, tgt_vocab=V, src_len=Ts, tgt_len=Ts,
+        emb_dim=16, hidden_dim=16)
+    pt.optimizer.Adam(learning_rate=5e-3).minimize(avg_cost)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = models.machine_translation.make_copy_task_batch(8, Ts, V)
+    losses = []
+    for _ in range(8):
+        out, = exe.run(pt.default_main_program(), feed=feed,
+                       fetch_list=[avg_cost])
+        losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+    # decode program shares the trained parameters through the scope
+    decode_prog, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(decode_prog, startup2):
+        dfeeds, sent, sent_scores = \
+            models.machine_translation.build_decode_net(
+                src_vocab=V, tgt_vocab=V, src_len=Ts, beam_size=3,
+                max_len=6, emb_dim=16, hidden_dim=16)
+    ids, scores = exe.run(decode_prog, feed={"src": feed["src"]},
+                          fetch_list=[sent, sent_scores])
+    B = feed["src"].shape[0]
+    assert ids.shape == (B, 3, 6)
+    assert scores.shape == (B, 3)
+    assert np.isfinite(scores).all()
+    assert (ids >= 0).all() and (ids < V).all()
+    # beams are returned best-first
+    assert (np.diff(scores, axis=1) <= 1e-5).all()

@@ -1,0 +1,74 @@
+"""Multi-host rendezvous harness: 2 localhost processes train a DP model
+through parallel/env.init_distributed_env with loss parity vs a
+single-process run (the reference's test_dist_base.py:212,502 contract)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _reference_losses():
+    """Single-process ground truth of the worker's training loop."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3).astype("float64")
+    y = x @ np.array([[1.0], [-2.0], [0.5]])
+    w = np.zeros((3, 1))
+    losses = []
+    for _ in range(5):
+        pred = x @ w
+        losses.append(float(np.sum((pred - y) ** 2) / 8))
+        g = 2 * x.T @ (pred - y) / 8
+        w = w - 0.1 * g
+    return losses, w.ravel()
+
+
+def test_two_process_dp_parity(tmp_path):
+    world = 2
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    procs, outs = [], []
+    for rank in range(world):
+        out = str(tmp_path / f"r{rank}.json")
+        outs.append(out)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)      # one CPU device per process
+        env.pop("PYTHONPATH", None)     # axon plugin quirk: never set it
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "dist_worker.py"),
+             coordinator, str(world), str(rank), out],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout.decode(errors="replace"))
+    for rc, log in zip((p.returncode for p in procs), logs):
+        assert rc == 0, f"worker failed rc={rc}:\n{log[-2000:]}"
+
+    ref_losses, ref_w = _reference_losses()
+    results = [json.load(open(o)) for o in outs]
+    for r in results:
+        np.testing.assert_allclose(r["losses"], ref_losses,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(r["w"], ref_w, rtol=1e-4, atol=1e-6)
+    # both ranks agree bit-for-bit on the replicated weights
+    np.testing.assert_array_equal(results[0]["w"], results[1]["w"])
