@@ -46,6 +46,11 @@ def all_flags() -> Dict[str, Any]:
 # --- still make sense on TPU)                                            ---
 define_flag("check_nan_inf", False,
             "Scan every fetched value for NaN/Inf (ref FLAGS_check_nan_inf).")
+define_flag("check_nan_inf_per_op", False,
+            "Debug mode: run the program eagerly (un-jitted) and scan "
+            "every op's outputs, naming the first op that produces "
+            "NaN/Inf (the reference's per-op scan, operator.cc:829). "
+            "Slow; for localization only.")
 define_flag("deterministic", False,
             "Force deterministic reductions/samplers "
             "(ref FLAGS_cpu_deterministic/cudnn_deterministic).")

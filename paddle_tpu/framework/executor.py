@@ -124,7 +124,28 @@ def run_ops_in_env(ctx, env: Dict[str, Any], ops) -> Dict[str, Any]:
             for n, v in zip(names, produced):
                 if n:
                     env[n] = v
+        if flags.get_flag("check_nan_inf_per_op"):
+            _check_op_outputs_finite(op, outs)
     return env
+
+
+def _check_op_outputs_finite(op, outs):
+    """Per-op NaN/Inf localization (ref operator.cc:829) — only effective
+    when the values are concrete (the executor runs the program eagerly
+    under FLAGS_check_nan_inf_per_op; traced values are skipped)."""
+    for slot, vals in outs.items():
+        for name, v in zip(op.outputs.get(slot, []), vals):
+            if isinstance(v, jax.core.Tracer):
+                return
+            try:
+                arr = np.asarray(v)
+            except Exception:
+                continue
+            if (np.issubdtype(arr.dtype, np.floating)
+                    and not np.isfinite(arr).all()):
+                raise EnforceNotMet(
+                    f"NaN/Inf produced by op {op.type!r} in output "
+                    f"{slot}:{name!r} (FLAGS_check_nan_inf_per_op)")
 
 
 class _CompiledProgram:
@@ -328,7 +349,13 @@ class Executor:
         self._run_counter += 1
 
         with RecordEvent(f"executor.run#{len(compiled.fetch_names)}f"):
-            fetches, new_state = compiled._jitted(state, dev_feeds, root)
+            if flags.get_flag("check_nan_inf_per_op"):
+                # eager (un-jitted) run so every op's outputs are concrete
+                # and the first NaN/Inf source is named
+                fetches, new_state = compiled._step(state, dev_feeds, root)
+            else:
+                fetches, new_state = compiled._jitted(state, dev_feeds,
+                                                      root)
 
         for n, v in new_state.items():
             scope.set_var(n, v)

@@ -20,6 +20,9 @@ from . import control_flow
 from . import rnn_ops
 from . import sequence_ops
 from . import beam_search_ops
+from . import crf_ops
+from . import sampling_ops
+from . import misc_ops
 from . import detection_ops
 from . import collective_ops
 from . import attention_ops

@@ -350,3 +350,503 @@ def _box_clip(ctx, ins, attrs):
                      jnp.clip(b[..., 2], 0, w[..., 0]),
                      jnp.clip(b[..., 3], 0, h[..., 0])], axis=-1)
     return {"Output": [out.reshape(boxes.shape)]}
+
+
+@register_op("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    """ref affine_grid_op.cc: Theta [N,2,3] -> sampling grid [N,H,W,2]
+    in normalized [-1, 1] coords."""
+    theta = single_input(ins, "Theta").astype(jnp.float32)
+    if ins.get("OutputShape"):
+        shp = ins["OutputShape"][0]
+        n, _, h, w = [int(v) for v in np.asarray(shp)]
+    else:
+        n, _, h, w = attrs["output_shape"]
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                      # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)          # [H, W, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)    # [N, H, W, 2]
+    return {"Output": [grid]}
+
+
+def _roi_batch_ids(ins, R):
+    if ins.get("RoisBatchId"):
+        return ins["RoisBatchId"][0].reshape(-1).astype(jnp.int32)
+    if ins.get("RoisNum"):
+        num = ins["RoisNum"][0].reshape(-1).astype(jnp.int32)
+        return jnp.repeat(jnp.arange(num.shape[0]), num,
+                          total_repeat_length=R)
+    return jnp.zeros((R,), jnp.int32)
+
+
+@register_op("roi_align")
+def _roi_align(ctx, ins, attrs):
+    """ref detection-era roi_align_op.cc: bilinear-sampled average over
+    each bin.  X [N,C,H,W], ROIs [R,4] (x1,y1,x2,y2 image coords);
+    roi->image mapping via RoisNum (dense) or RoisBatchId (LoD
+    replacement).  attrs: pooled_height/width, spatial_scale,
+    sampling_ratio."""
+    x = single_input(ins, "X").astype(jnp.float32)
+    rois = single_input(ins, "ROIs").astype(jnp.float32)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    ratio = ratio if ratio > 0 else 2
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bids = _roi_batch_ids(ins, R)
+
+    def one_roi(roi, bid):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample points: ph*ratio x pw*ratio bilinear taps
+        sy = y1 + (jnp.arange(ph * ratio) + 0.5) * bin_h / ratio
+        sx = x1 + (jnp.arange(pw * ratio) + 0.5) * bin_w / ratio
+        sy = jnp.clip(sy, 0.0, H - 1.0)
+        sx = jnp.clip(sx, 0.0, W - 1.0)
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = sy - y0
+        wx = sx - x0
+        img = x[bid]                                   # [C, H, W]
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0]
+        v11 = img[:, y1i][:, :, x1i]
+        val = (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+               + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+               + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+               + v11 * wy[None, :, None] * wx[None, None, :])
+        val = val.reshape(C, ph, ratio, pw, ratio)
+        return jnp.mean(val, axis=(2, 4))              # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(rois, bids)
+    return {"Out": [out]}
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """ref roi_pool_op.cc: max pool per bin (quantized boundaries)."""
+    x = single_input(ins, "X").astype(jnp.float32)
+    rois = single_input(ins, "ROIs").astype(jnp.float32)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bids = _roi_batch_ids(ins, R)
+    yy = jnp.arange(H)
+    xx = jnp.arange(W)
+
+    def one_roi(roi, bid):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        img = x[bid]
+        def one_bin(i, j):
+            hs = jnp.floor(y1 + i * rh / ph)
+            he = jnp.ceil(y1 + (i + 1) * rh / ph)
+            ws = jnp.floor(x1 + j * rw / pw)
+            we = jnp.ceil(x1 + (j + 1) * rw / pw)
+            inside = ((yy[:, None] >= hs) & (yy[:, None] < he)
+                      & (xx[None, :] >= ws) & (xx[None, :] < we))
+            masked = jnp.where(inside[None], img, -jnp.inf)
+            m = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(m), m, 0.0)
+        rows = []
+        for i in range(ph):
+            cols = [one_bin(i, j) for j in range(pw)]
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)                # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(rois, bids)
+    return {"Out": [out]}
+
+
+@register_op("generate_proposals", stop_gradient=True)
+def _generate_proposals(ctx, ins, attrs):
+    """ref detection/generate_proposals_op.cc, dense static shapes:
+    Scores [N,A,H,W], BboxDeltas [N,4A,H,W], ImInfo [N,3] (h,w,scale),
+    Anchors [H,W,A,4], Variances same shape.  Output RpnRois
+    [N, post_nms_topN, 4] (-1-padded) + RpnRoiProbs [N, post_nms_topN]."""
+    scores = single_input(ins, "Scores").astype(jnp.float32)
+    deltas = single_input(ins, "BboxDeltas").astype(jnp.float32)
+    im_info = single_input(ins, "ImInfo").astype(jnp.float32)
+    anchors = single_input(ins, "Anchors").astype(jnp.float32)
+    variances = (ins["Variances"][0].astype(jnp.float32)
+                 if ins.get("Variances") else jnp.ones_like(anchors))
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    N, A, H, W = scores.shape
+    total = A * H * W
+    pre_n = min(pre_n, total)
+    anc = anchors.reshape(-1, 4)                        # [H*W*A, 4]
+    var = variances.reshape(-1, 4)
+
+    def one_image(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(-1)           # [H*W*A]
+        d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # decode (anchor + variance-scaled deltas, ref box_coder math)
+        aw = anc[:, 2] - anc[:, 0] + 1
+        ah = anc[:, 3] - anc[:, 1] + 1
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        bw = jnp.exp(jnp.clip(var[:, 2] * d[:, 2], -10, 10)) * aw
+        bh = jnp.exp(jnp.clip(var[:, 3] * d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], axis=1)
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 1], 0, info[0] - 1),
+                           jnp.clip(boxes[:, 2], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=1)
+        ms = min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        s = jnp.where(keep, s, -1e9)
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        cand = boxes[top_i]
+        sel = _nms_single_class(cand, top_s, nms_thresh, -1e9 + 1, post_n)
+        rois = jnp.where(sel[:, None] >= 0,
+                         cand[jnp.clip(sel, 0, pre_n - 1)], -1.0)
+        probs = jnp.where(sel >= 0, top_s[jnp.clip(sel, 0, pre_n - 1)],
+                          0.0)
+        return rois, probs
+
+    rois, probs = jax.vmap(one_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs]}
+
+
+@register_op("rpn_target_assign", stop_gradient=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """ref rpn_target_assign_op.cc, dense redesign: instead of
+    variable-length index lists, emit per-anchor labels (1 pos / 0 neg /
+    -1 ignore) and regression targets + a sampling mask drawn with the
+    functional RNG.  Anchor [A,4], GtBoxes [N,G,4] (-1 pads)."""
+    anchor = single_input(ins, "Anchor").astype(jnp.float32)
+    gt = single_input(ins, "GtBoxes").astype(jnp.float32)
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    A = anchor.shape[0]
+    N, G, _ = gt.shape
+
+    def one_image(key, gtb):
+        valid_gt = gtb[:, 2] > gtb[:, 0]
+        ax1, ay1, ax2, ay2 = anchor.T
+        area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+        gx1, gy1, gx2, gy2 = gtb.T
+        area_g = jnp.maximum(gx2 - gx1, 0) * jnp.maximum(gy2 - gy1, 0)
+        ix1 = jnp.maximum(ax1[:, None], gx1[None, :])
+        iy1 = jnp.maximum(ay1[:, None], gy1[None, :])
+        ix2 = jnp.minimum(ax2[:, None], gx2[None, :])
+        iy2 = jnp.minimum(ay2[:, None], gy2[None, :])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        iou = inter / jnp.maximum(
+            area_a[:, None] + area_g[None, :] - inter, 1e-10)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        labels = jnp.full((A,), -1, jnp.int32)
+        labels = jnp.where(best_iou >= pos_thr, 1, labels)
+        labels = jnp.where((best_iou < neg_thr) & (best_iou >= 0), 0,
+                           labels)
+        # each gt's best anchor is positive (ref behavior)
+        best_anchor = jnp.argmax(jnp.where(valid_gt[None, :], iou, -2.0),
+                                 axis=0)
+        labels = labels.at[best_anchor].set(
+            jnp.where(valid_gt, 1, labels[best_anchor]))
+        matched = gtb[best_gt]
+        aw = jnp.maximum(ax2 - ax1, 1.0)
+        ah = jnp.maximum(ay2 - ay1, 1.0)
+        gw = jnp.maximum(matched[:, 2] - matched[:, 0], 1.0)
+        gh = jnp.maximum(matched[:, 3] - matched[:, 1], 1.0)
+        tx = ((matched[:, 0] + matched[:, 2]) / 2
+              - (ax1 + ax2) / 2) / aw
+        ty = ((matched[:, 1] + matched[:, 3]) / 2
+              - (ay1 + ay2) / 2) / ah
+        tw = jnp.log(gw / aw)
+        th = jnp.log(gh / ah)
+        targets = jnp.stack([tx, ty, tw, th], axis=1)
+        return labels, targets
+
+    keys = jax.random.split(ctx.rng(), N)
+    labels, targets = jax.vmap(one_image)(keys, gt)
+    return {"Labels": [labels], "BboxTargets": [targets],
+            "LocationIndex": [jnp.argsort(-labels, axis=1)],
+            "ScoreIndex": [jnp.argsort(labels == -1, axis=1)]}
+
+
+@register_op("detection_map", stop_gradient=True)
+def _detection_map(ctx, ins, attrs):
+    """ref detection_map_op.cc, integral mAP over dense inputs:
+    Detection [M,6] rows (label, score, x1, y1, x2, y2; label<0 pads),
+    GtLabel [G,1], GtBox [G,4] (dense single-image or pre-flattened
+    batch with -1 pads).  Output MAP [1]."""
+    det = single_input(ins, "DetectRes").astype(jnp.float32)
+    gt_label = single_input(ins, "Label").astype(jnp.float32)
+    overlap = float(attrs.get("overlap_threshold", 0.5))
+    # gt rows: (label, x1, y1, x2, y2)
+    g_lbl = gt_label[:, 0]
+    g_box = gt_label[:, 1:5] if gt_label.shape[1] >= 5 else None
+    valid_gt = g_lbl >= 0
+    d_lbl, d_score, d_box = det[:, 0], det[:, 1], det[:, 2:6]
+    valid_d = d_lbl >= 0
+    order = jnp.argsort(-jnp.where(valid_d, d_score, -jnp.inf))
+    d_lbl, d_box = d_lbl[order], d_box[order]
+    valid_d = valid_d[order]
+    M = det.shape[0]
+    G = gt_label.shape[0]
+
+    def iou_row(b):
+        ix1 = jnp.maximum(b[0], g_box[:, 0])
+        iy1 = jnp.maximum(b[1], g_box[:, 1])
+        ix2 = jnp.minimum(b[2], g_box[:, 2])
+        iy2 = jnp.minimum(b[3], g_box[:, 3])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        ab = jnp.maximum(b[2] - b[0], 0) * jnp.maximum(b[3] - b[1], 0)
+        ag = jnp.maximum(g_box[:, 2] - g_box[:, 0], 0) * jnp.maximum(
+            g_box[:, 3] - g_box[:, 1], 0)
+        return inter / jnp.maximum(ab + ag - inter, 1e-10)
+
+    def body(carry, i):
+        used, tp, fp = carry
+        ious = iou_row(d_box[i])
+        same = (g_lbl == d_lbl[i]) & valid_gt & ~used
+        ious = jnp.where(same, ious, -1.0)
+        j = jnp.argmax(ious)
+        hit = (ious[j] >= overlap) & valid_d[i]
+        used = used.at[j].set(used[j] | hit)
+        tp = tp.at[i].set(jnp.where(valid_d[i] & hit, 1.0, 0.0))
+        fp = fp.at[i].set(jnp.where(valid_d[i] & ~hit, 1.0, 0.0))
+        return (used, tp, fp), None
+
+    init = (jnp.zeros((G,), bool), jnp.zeros((M,)), jnp.zeros((M,)))
+    (used, tp, fp), _ = jax.lax.scan(body, init, jnp.arange(M))
+    ctp = jnp.cumsum(tp)
+    cfp = jnp.cumsum(fp)
+    n_gt = jnp.maximum(jnp.sum(valid_gt.astype(jnp.float32)), 1.0)
+    recall = ctp / n_gt
+    precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+    # integral AP: sum precision at each new tp
+    ap = jnp.sum(jnp.where(tp > 0, precision, 0.0)) / n_gt
+    return {"MAP": [ap.reshape(1)], "AccumPosCount": [ctp],
+            "AccumTruePos": [tp], "AccumFalsePos": [fp]}
+
+
+@register_op("target_assign", stop_gradient=True)
+def _target_assign(ctx, ins, attrs):
+    """ref detection/target_assign_op.cc: scatter per-prior matched gt
+    rows (dense: MatchIndices [N, Np] with -1 for unmatched).
+    X [N, G, K] gt attributes -> Out [N, Np, K] + OutWeight [N, Np, 1]."""
+    x = single_input(ins, "X")
+    match = single_input(ins, "MatchIndices").astype(jnp.int32)
+    mismatch_value = attrs.get("mismatch_value", 0)
+    n, np_, = match.shape
+    gat = jnp.take_along_axis(
+        x, jnp.clip(match, 0, x.shape[1] - 1)[..., None], axis=1)
+    ok = (match >= 0)[..., None]
+    out = jnp.where(ok, gat, mismatch_value)
+    return {"Out": [out], "OutWeight": [ok.astype(x.dtype)]}
+
+
+@register_op("mine_hard_examples", stop_gradient=True)
+def _mine_hard_examples(ctx, ins, attrs):
+    """ref detection/mine_hard_examples_op.cc (max_negative mode):
+    keep the hardest negatives up to neg_pos_ratio * #pos per image.
+    ClsLoss [N, Np], MatchIndices [N, Np] (-1 = negative).  Returns an
+    updated NegIndices mask (dense 0/1) instead of LoD index lists."""
+    loss = single_input(ins, "ClsLoss")
+    match = single_input(ins, "MatchIndices").astype(jnp.int32)
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    n, np_ = loss.shape
+    is_neg = match < 0
+    n_pos = jnp.sum((~is_neg).astype(jnp.int32), axis=1)
+    n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32),
+                        jnp.sum(is_neg.astype(jnp.int32), axis=1))
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.zeros_like(match).at[
+        jnp.arange(n)[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(np_), (n, np_)))
+    keep = is_neg & (rank < n_neg[:, None])
+    return {"NegIndices": [keep.astype(jnp.int32)],
+            "UpdatedMatchIndices": [jnp.where(keep, -1, match)]}
+
+
+@register_op("psroi_pool")
+def _psroi_pool(ctx, ins, attrs):
+    """ref psroi_pool_op.cc: position-sensitive RoI average pooling.
+    X [N, C=out_c*ph*pw, H, W], ROIs [R, 4]."""
+    x = single_input(ins, "X").astype(jnp.float32)
+    rois = single_input(ins, "ROIs").astype(jnp.float32)
+    out_c = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bids = _roi_batch_ids(ins, R)
+    yy = jnp.arange(H)
+    xx = jnp.arange(W)
+
+    def one_roi(roi, bid):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        img = x[bid].reshape(out_c, ph, pw, H, W)
+        rows = []
+        for i in range(ph):
+            cols = []
+            for j in range(pw):
+                hs = jnp.floor(y1 + i * rh / ph)
+                he = jnp.ceil(y1 + (i + 1) * rh / ph)
+                ws = jnp.floor(x1 + j * rw / pw)
+                we = jnp.ceil(x1 + (j + 1) * rw / pw)
+                inside = ((yy[:, None] >= hs) & (yy[:, None] < he)
+                          & (xx[None, :] >= ws) & (xx[None, :] < we))
+                cnt = jnp.maximum(jnp.sum(inside), 1)
+                v = jnp.sum(jnp.where(inside[None], img[:, i, j], 0.0),
+                            axis=(1, 2)) / cnt
+                cols.append(v)
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)               # [out_c, ph, pw]
+
+    out = jax.vmap(one_roi)(rois, bids)
+    return {"Out": [out]}
+
+
+@register_op("generate_proposal_labels", stop_gradient=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """ref detection/generate_proposal_labels_op.cc, dense redesign:
+    sample a fixed batch_size_per_im of rois per image, label them by
+    IoU vs gt, and emit box-regression targets (fixed shapes + weights
+    instead of LoD)."""
+    rois = single_input(ins, "RpnRois").astype(jnp.float32)   # [N,R,4]
+    gt_boxes = single_input(ins, "GtBoxes").astype(jnp.float32)  # [N,G,4]
+    gt_classes = single_input(ins, "GtClasses").astype(jnp.int32)  # [N,G]
+    per_im = int(attrs.get("batch_size_per_im", 256))
+    fg_thr = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    N, R, _ = rois.shape
+
+    def one(roi, gtb, gtc):
+        valid_gt = gtb[:, 2] > gtb[:, 0]
+        ix1 = jnp.maximum(roi[:, None, 0], gtb[None, :, 0])
+        iy1 = jnp.maximum(roi[:, None, 1], gtb[None, :, 1])
+        ix2 = jnp.minimum(roi[:, None, 2], gtb[None, :, 2])
+        iy2 = jnp.minimum(roi[:, None, 3], gtb[None, :, 3])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        ar = jnp.maximum(roi[:, 2] - roi[:, 0], 0) * jnp.maximum(
+            roi[:, 3] - roi[:, 1], 0)
+        ag = jnp.maximum(gtb[:, 2] - gtb[:, 0], 0) * jnp.maximum(
+            gtb[:, 3] - gtb[:, 1], 0)
+        iou = inter / jnp.maximum(ar[:, None] + ag[None] - inter, 1e-10)
+        iou = jnp.where(valid_gt[None], iou, -1.0)
+        best = jnp.max(iou, axis=1)
+        bgt = jnp.argmax(iou, axis=1)
+        fg = best >= fg_thr
+        bg = (best < bg_hi) & (best >= 0)
+        labels = jnp.where(fg, gtc[bgt], 0)
+        labels = jnp.where(fg | bg, labels, -1)
+        # take top per_im by (fg first, then score=iou)
+        pri = jnp.where(fg, 2.0 + best, jnp.where(bg, 1.0 - best, -1.0))
+        k = min(per_im, R)
+        _, sel = jax.lax.top_k(pri, k)
+        m = gtb[bgt[sel]]
+        r = roi[sel]
+        rw = jnp.maximum(r[:, 2] - r[:, 0], 1.0)
+        rh = jnp.maximum(r[:, 3] - r[:, 1], 1.0)
+        mw = jnp.maximum(m[:, 2] - m[:, 0], 1.0)
+        mh = jnp.maximum(m[:, 3] - m[:, 1], 1.0)
+        t = jnp.stack([
+            ((m[:, 0] + m[:, 2]) - (r[:, 0] + r[:, 2])) / 2 / rw,
+            ((m[:, 1] + m[:, 3]) - (r[:, 1] + r[:, 3])) / 2 / rh,
+            jnp.log(mw / rw), jnp.log(mh / rh)], axis=1)
+        lab_s = labels[sel]
+        w = (lab_s > 0).astype(jnp.float32)[:, None]
+        return r, lab_s, t * w, w
+
+    out = jax.vmap(one)(rois, gt_boxes, gt_classes)
+    r, labels, targets, weights = out
+    return {"Rois": [r], "LabelsInt32": [labels],
+            "BboxTargets": [targets], "BboxInsideWeights": [weights],
+            "BboxOutsideWeights": [weights]}
+
+
+@register_op("yolov3_loss")
+def _yolov3_loss(ctx, ins, attrs):
+    """ref yolov3_loss_op.cc, simplified dense: objectness + box + class
+    losses against assigned anchors.  X [N, A*(5+C), H, W],
+    GtBox [N, G, 4] (cx, cy, w, h normalized), GtLabel [N, G]."""
+    x = single_input(ins, "X").astype(jnp.float32)
+    gt_box = single_input(ins, "GTBox").astype(jnp.float32)
+    gt_label = single_input(ins, "GTLabel").astype(jnp.int32)
+    anchors = list(attrs["anchors"])
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    N, CC, H, W = x.shape
+    A = len(anchors) // 2
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    pred_xy = jax.nn.sigmoid(x[:, :, 0:2])
+    pred_wh = x[:, :, 2:4]
+    pred_obj = x[:, :, 4]
+    pred_cls = x[:, :, 5:]
+
+    def one(px, pw, pobj, pcls, gtb, gtl):
+        valid = gtb[:, 2] > 0
+        # assign each gt to the cell containing its center + best anchor
+        gi = jnp.clip((gtb[:, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[:, 1] * H).astype(jnp.int32), 0, H - 1)
+        aw = jnp.asarray(anchors[0::2], jnp.float32) / W
+        ah = jnp.asarray(anchors[1::2], jnp.float32) / H
+        inter = (jnp.minimum(gtb[:, 2:3], aw[None]) *
+                 jnp.minimum(gtb[:, 3:4], ah[None]))
+        union = (gtb[:, 2:3] * gtb[:, 3:4] + aw[None] * ah[None] - inter)
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
+        obj_t = jnp.zeros((A, H, W))
+        obj_t = obj_t.at[best_a, gj, gi].max(
+            jnp.where(valid, 1.0, 0.0))
+        # box loss at assigned cells
+        tx = gtb[:, 0] * W - gi
+        ty = gtb[:, 1] * H - gj
+        tw = jnp.log(jnp.maximum(gtb[:, 2] / aw[best_a], 1e-9))
+        th = jnp.log(jnp.maximum(gtb[:, 3] / ah[best_a], 1e-9))
+        px_g = px[best_a, :, gj, gi]
+        pw_g = pw[best_a, :, gj, gi]
+        box_l = (jnp.square(px_g[:, 0] - tx) + jnp.square(px_g[:, 1] - ty)
+                 + jnp.square(pw_g[:, 0] - tw)
+                 + jnp.square(pw_g[:, 1] - th))
+        box_loss = jnp.sum(jnp.where(valid, box_l, 0.0))
+        # objectness BCE everywhere
+        z = pobj
+        obj_bce = jnp.maximum(z, 0) - z * obj_t + jnp.log1p(
+            jnp.exp(-jnp.abs(z)))
+        obj_loss = jnp.sum(obj_bce)
+        # class BCE at assigned cells
+        pc = pcls[best_a, :, gj, gi]
+        onehot = jax.nn.one_hot(gtl, class_num)
+        cls_bce = jnp.maximum(pc, 0) - pc * onehot + jnp.log1p(
+            jnp.exp(-jnp.abs(pc)))
+        cls_loss = jnp.sum(jnp.where(valid[:, None], cls_bce, 0.0))
+        return box_loss + obj_loss + cls_loss
+
+    losses = jax.vmap(one)(pred_xy, pred_wh, pred_obj, pred_cls,
+                           gt_box, gt_label)
+    return {"Loss": [losses]}

@@ -1,0 +1,517 @@
+"""Op-census breadth: the remaining reference operators.
+
+Parity targets (all under /root/reference/paddle/fluid/operators/):
+sequence_conv_op.cc, shuffle_channel (era: shuffle_channel_op.cc),
+unique_op.cc (+unique_with_counts), hash_op.cc, similarity_focus_op.cc,
+conv_shift_op.cc, spp_op.cc, random_crop_op.cc, lstmp_op.cc,
+cudnn_lstm_op.cc, pool_op.cc (pool3d), conv_transpose_op.cc
+(conv3d_transpose), lod_rank_table_op.cc, and the SelectedRows plumbing
+family (split_ids_op.cc, merge_ids_op.cc, merge_selected_rows_op.cc,
+split_selected_rows_op.cc, get_tensor_from_selected_rows_op.cc,
+lookup_sparse_table_op.cc).
+
+TPU-first redesigns worth noting:
+  * anything with variable-length outputs (unique, the SelectedRows
+    family) keeps STATIC shapes: outputs are input-sized with -1/0 pads
+    plus explicit counts — the dense idiom this framework uses instead
+    of LoD/SelectedRows dynamic shapes;
+  * SelectedRows {rows, values} is represented as an (Ids, Values) pair
+    of dense tensors; sharding ops preserve original positions so a
+    merge is a sum — no host-side row bookkeeping.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.registry import register_op, single_input
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window conv over time (ref sequence_conv_op.cc):
+    X [B,T,D], Filter [ctx_len*D, M]; zero-padded context."""
+    x = single_input(ins, "X")
+    w = single_input(ins, "Filter")
+    ctx_len = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    ctx_start = int(attrs.get("contextStart",
+                              attrs.get("context_start", -(ctx_len // 2))))
+    B, T, D = x.shape
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        sh = jnp.roll(x, -off, axis=1)
+        idx = jnp.arange(T) + off
+        valid = ((idx >= 0) & (idx < T))[None, :, None]
+        cols.append(jnp.where(valid, sh, 0.0))
+    col = jnp.concatenate(cols, axis=-1)            # [B,T,ctx_len*D]
+    out = jnp.einsum("btk,km->btm", col, w.astype(col.dtype))
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    """ref shuffle_channel_op.cc: [N, g*c, H, W] -> interleave groups."""
+    x = single_input(ins, "X")
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    y = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+    return {"Out": [y.reshape(n, c, h, w)]}
+
+
+def _unique_static(x):
+    """(first_occurrence_mask, compacted values (-1 pad), index map,
+    counts) with static shapes."""
+    n = x.shape[0]
+    order = jnp.argsort(x, stable=True)
+    xs = x[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), xs[1:] != xs[:-1]])
+    uniq_rank = jnp.cumsum(first.astype(jnp.int32)) - 1     # per sorted pos
+    n_uniq = uniq_rank[-1] + 1
+    uniq_vals = jnp.full((n,), -1, x.dtype).at[uniq_rank].set(xs)
+    # index: original position -> unique rank
+    index = jnp.zeros((n,), jnp.int32).at[order].set(uniq_rank)
+    counts = jnp.zeros((n,), jnp.int32).at[uniq_rank].add(1)
+    return uniq_vals, index, counts, n_uniq
+
+
+@register_op("unique", stop_gradient=True)
+def _unique(ctx, ins, attrs):
+    """ref unique_op.cc — static-shape redesign: Out is input-sized,
+    -1-padded beyond the unique count (returned in `Count`)."""
+    x = single_input(ins, "X").reshape(-1)
+    vals, index, _, n_uniq = _unique_static(x)
+    return {"Out": [vals], "Index": [index],
+            "Count": [n_uniq.reshape(1)]}
+
+
+@register_op("unique_with_counts", stop_gradient=True)
+def _unique_with_counts(ctx, ins, attrs):
+    x = single_input(ins, "X").reshape(-1)
+    vals, index, counts, n_uniq = _unique_static(x)
+    return {"Out": [vals], "Index": [index], "Count": [counts],
+            "UniqueCount": [n_uniq.reshape(1)]}
+
+
+@register_op("hash", stop_gradient=True)
+def _hash(ctx, ins, attrs):
+    """ref hash_op.cc: num_hash independent hashes of int rows, each
+    modulo mod_by.  X [N, k] int -> Out [N, num_hash, 1] int64."""
+    x = single_input(ins, "X").astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 100000))
+    # xor-multiply rows with per-hash odd constants (splitmix-style)
+    seeds = (np.arange(1, num_hash + 1, dtype=np.uint32)
+             * np.uint32(0x9E3779B1)) | np.uint32(1)
+    h = jnp.zeros((x.shape[0], num_hash), jnp.uint32)
+    for j in range(x.shape[1]):
+        col = x[:, j][:, None]
+        h = (h ^ (col * seeds[None, :])) * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+    out = (h % jnp.uint32(mod_by)).astype(jnp.int32)
+    return {"Out": [out[:, :, None]]}
+
+
+@register_op("similarity_focus", stop_gradient=True)
+def _similarity_focus(ctx, ins, attrs):
+    """ref similarity_focus_op.cc: for each selected channel, mark the
+    per-row and per-column argmax cells of the [A, B] map; the union
+    mask broadcasts over all channels."""
+    x = single_input(ins, "X")
+    axis = int(attrs.get("axis", 1))
+    indexes = list(attrs.get("indexes", [0]))
+    if axis != 1:
+        x = jnp.moveaxis(x, axis, 1)
+    n, c, a, b = x.shape
+    mask = jnp.zeros((n, a, b), x.dtype)
+    for idx in indexes:
+        m = x[:, idx]                                 # [N, A, B]
+        row_max = m == jnp.max(m, axis=2, keepdims=True)
+        col_max = m == jnp.max(m, axis=1, keepdims=True)
+        mask = jnp.maximum(mask, (row_max | col_max).astype(x.dtype))
+    out = jnp.broadcast_to(mask[:, None], x.shape)
+    if axis != 1:
+        out = jnp.moveaxis(out, 1, axis)
+    return {"Out": [out]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """ref conv_shift_op.cc: circular correlation
+    out[b, i] = sum_j x[b, (i + j - M//2) mod N] * y[b, j]."""
+    x = single_input(ins, "X")
+    y = single_input(ins, "Y")
+    B, N = x.shape
+    M = y.shape[1]
+    half = M // 2
+    terms = []
+    for j in range(M):
+        terms.append(jnp.roll(x, half - j, axis=1) * y[:, j:j + 1])
+    return {"Out": [sum(terms)]}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (ref spp_op.cc): levels 0..H-1 with
+    2^l x 2^l adaptive bins, concatenated -> [N, C*sum(4^l)]."""
+    x = single_input(ins, "X")
+    levels = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        # adaptive pooling: split H/W into `bins` nearly-equal pieces
+        ys = np.linspace(0, h, bins + 1).astype(int)
+        xs = np.linspace(0, w, bins + 1).astype(int)
+        for i in range(bins):
+            for j in range(bins):
+                patch = x[:, :, ys[i]:max(ys[i + 1], ys[i] + 1),
+                          xs[j]:max(xs[j + 1], xs[j] + 1)]
+                red = (jnp.max if ptype == "max" else jnp.mean)
+                outs.append(red(patch, axis=(2, 3)))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("random_crop", stop_gradient=True)
+def _random_crop(ctx, ins, attrs):
+    """ref random_crop_op.cc: crop `shape` from the trailing dims at a
+    random offset (functional RNG)."""
+    x = single_input(ins, "X")
+    shape = list(attrs["shape"])
+    nd = len(shape)
+    lead = x.ndim - nd
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(limit, 0) + 1))
+    idx = [0] * lead + list(starts)
+    sizes = list(x.shape[:lead]) + shape
+    return {"Out": [lax.dynamic_slice(x, idx, sizes)]}
+
+
+# -- fused / projected RNN tier -------------------------------------------
+
+def _lstm_scan(x_seq, wh, h0, c0, proj=None):
+    """x_seq [T,B,4H] pre-projected; wh [P or H, 4H]; optional proj
+    [H, P] (LSTMP, ref lstmp_op.cc)."""
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + h @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        hid = jax.nn.sigmoid(o) * jnp.tanh(c)
+        h = hid @ proj if proj is not None else hid
+        return (h, c), h
+
+    return lax.scan(step, (h0, c0), x_seq)
+
+
+@register_op("lstmp")
+def _lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (ref lstmp_op.cc): Input [B,T,4H]
+    pre-projected, Weight [P,4H], ProjWeight [H,P]."""
+    x = single_input(ins, "Input")
+    w = single_input(ins, "Weight")
+    pw = single_input(ins, "ProjWeight")
+    B, T, H4 = x.shape
+    H = H4 // 4
+    P = pw.shape[1]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, P), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+    (h, c), hs = _lstm_scan(jnp.swapaxes(x, 0, 1), w, h0, c0, proj=pw)
+    return {"Projection": [jnp.swapaxes(hs, 0, 1)], "LastH": [h],
+            "LastC": [c]}
+
+
+@register_op("cudnn_lstm")
+def _cudnn_lstm(ctx, ins, attrs):
+    """Multi-layer (optionally bidirectional) fused LSTM (ref
+    cudnn_lstm_op.cc).  Input [B,T,D]; W: ONE flat packed weight param
+    (cudnn convention), sliced per (layer, direction) into
+    wx [in,4H] | wh [H,4H] | b [4H].  attrs: hidden_size, num_layers,
+    is_bidirec."""
+    x = single_input(ins, "Input")
+    w = single_input(ins, "W").reshape(-1)
+    H = int(attrs["hidden_size"])
+    L = int(attrs.get("num_layers", 1))
+    bidi = bool(attrs.get("is_bidirec", False))
+    ndir = 2 if bidi else 1
+    B, T, D = x.shape
+    off = 0
+
+    def take(n, shape):
+        nonlocal off
+        v = w[off:off + n].reshape(shape)
+        off += n
+        return v
+
+    seq = jnp.swapaxes(x, 0, 1)                     # [T,B,·]
+    for l in range(L):
+        din = D if l == 0 else H * ndir
+        outs = []
+        for d in range(ndir):
+            wx = take(din * 4 * H, (din, 4 * H))
+            wh = take(H * 4 * H, (H, 4 * H))
+            b = take(4 * H, (4 * H,))
+            s = seq[::-1] if d == 1 else seq
+            xp = s @ wx + b
+            h0 = jnp.zeros((B, H), x.dtype)
+            (_, _), hs = _lstm_scan(xp, wh, h0, h0)
+            outs.append(hs[::-1] if d == 1 else hs)
+        seq = jnp.concatenate(outs, axis=-1) if bidi else outs[0]
+    out = jnp.swapaxes(seq, 0, 1)
+    last_h = out[:, -1, :]
+    return {"Out": [out], "LastH": [last_h], "LastC": [last_h]}
+
+
+# -- pooling / conv 3d -----------------------------------------------------
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    """ref pool_op.cc 3-D: NCDHW max/avg."""
+    x = single_input(ins, "X")
+    k = attrs.get("ksize", 2)
+    k = tuple(k) if isinstance(k, (list, tuple)) else (k,) * 3
+    s = attrs.get("strides", k)
+    s = tuple(s) if isinstance(s, (list, tuple)) else (s,) * 3
+    p = attrs.get("paddings", 0)
+    p = tuple(p) if isinstance(p, (list, tuple)) else (p,) * 3
+    ptype = attrs.get("pooling_type", "max")
+    pad = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max,
+                                (1, 1) + k, (1, 1) + s, pad)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add,
+                                   (1, 1) + k, (1, 1) + s, pad)
+        out = summed / float(np.prod(k))
+    return {"Out": [out]}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """ref conv_transpose_op.cc 3-D; filter IODHW, gradient-of-conv
+    formulation via lhs_dilation."""
+    x = single_input(ins, "Input")
+    w = single_input(ins, "Filter")
+    st = attrs.get("strides", 1)
+    st = tuple(st) if isinstance(st, (list, tuple)) else (st,) * 3
+    p = attrs.get("paddings", 0)
+    p = tuple(p) if isinstance(p, (list, tuple)) else (p,) * 3
+    kd, kh, kw = w.shape[2], w.shape[3], w.shape[4]
+    pad = [(kd - 1 - p[0], kd - 1 - p[0]),
+           (kh - 1 - p[1], kh - 1 - p[1]),
+           (kw - 1 - p[2], kw - 1 - p[2])]
+    w_t = jnp.swapaxes(jnp.flip(w, axis=(2, 3, 4)), 0, 1)
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1, 1), padding=pad,
+        lhs_dilation=st,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out.astype(x.dtype)]}
+
+
+# -- LoD / SelectedRows plumbing (dense redesigns) -------------------------
+
+@register_op("lod_rank_table", stop_gradient=True)
+def _lod_rank_table(ctx, ins, attrs):
+    """ref lod_rank_table_op.cc: sort sequences by length desc.  Dense
+    input: Mask [B,T] (1=token) or Lengths [B]; outputs the sorted
+    indices + lengths (what DynamicRNN used the table for)."""
+    x = single_input(ins, "X")
+    lens = (jnp.sum(x, axis=1) if x.ndim > 1 else x).astype(jnp.int32)
+    order = jnp.argsort(-lens, stable=True).astype(jnp.int32)
+    return {"Out": [order], "Lengths": [lens[order]]}
+
+
+@register_op("lookup_sparse_table")
+def _lookup_sparse_table(ctx, ins, attrs):
+    """ref lookup_sparse_table_op.cc: pserver-side auto-growing table
+    lookup; on TPU the table is a dense (sharded) param — same math as
+    lookup_table."""
+    w = single_input(ins, "W")
+    ids = single_input(ins, "Ids").reshape(-1).astype(jnp.int32)
+    return {"Out": [jnp.take(w, ids, axis=0)]}
+
+
+@register_op("split_ids", stop_gradient=True)
+def _split_ids(ctx, ins, attrs):
+    """ref split_ids_op.cc: route ids to N shards by id % N.  Dense:
+    each output keeps the input length with -1 where the id is not
+    owned, so positions are preserved and a later merge is a sum."""
+    ids = single_input(ins, "Ids").reshape(-1).astype(jnp.int32)
+    n = int(attrs.get("num_shards", 1))
+    outs = [jnp.where(ids % n == i, ids, -1) for i in range(n)]
+    return {"Out": outs}
+
+
+@register_op("merge_ids", stop_gradient=True)
+def _merge_ids(ctx, ins, attrs):
+    """ref merge_ids_op.cc: merge per-shard row tensors back to the
+    original order.  With split_ids' position-preserving -1 padding the
+    merge is an elementwise sum of the shard outputs (rows for unowned
+    positions are zero)."""
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("merge_selected_rows", stop_gradient=True)
+def _merge_selected_rows(ctx, ins, attrs):
+    """ref merge_selected_rows_op.cc: sum duplicate rows.  (Ids, Values)
+    pair, static shapes: output ids are -1 beyond the unique count and
+    values are segment-summed."""
+    ids = single_input(ins, "Ids").reshape(-1).astype(jnp.int32)
+    vals = single_input(ins, "Values")
+    from .misc_ops import _unique_static
+    uniq, index, _, n_uniq = _unique_static(ids)
+    summed = jnp.zeros((ids.shape[0],) + vals.shape[1:],
+                       vals.dtype).at[index].add(vals)
+    return {"OutIds": [uniq], "Out": [summed]}
+
+
+@register_op("split_selected_rows", stop_gradient=True)
+def _split_selected_rows(ctx, ins, attrs):
+    """ref split_selected_rows_op.cc: split rows into height sections
+    (pserver param blocks).  Dense: per-section local ids (-1 pad) +
+    zeroed values for unowned rows."""
+    ids = single_input(ins, "Ids").reshape(-1).astype(jnp.int32)
+    vals = single_input(ins, "Values")
+    sections = list(attrs["height_sections"])
+    outs_ids, outs_vals = [], []
+    off = 0
+    for h in sections:
+        own = (ids >= off) & (ids < off + h)
+        outs_ids.append(jnp.where(own, ids - off, -1))
+        outs_vals.append(jnp.where(own[:, None], vals, 0))
+        off += h
+    return {"OutIds": outs_ids, "Out": outs_vals}
+
+
+@register_op("get_tensor_from_selected_rows", stop_gradient=True)
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    """ref get_tensor_from_selected_rows_op.cc: scatter (Ids, Values)
+    into a dense [height, D] tensor."""
+    ids = single_input(ins, "Ids").reshape(-1).astype(jnp.int32)
+    vals = single_input(ins, "Values")
+    height = int(attrs["height"])
+    valid = ids >= 0
+    idx = jnp.where(valid, ids, 0)
+    out = jnp.zeros((height,) + vals.shape[1:], vals.dtype)
+    out = out.at[idx].add(jnp.where(valid[:, None], vals, 0))
+    return {"Out": [out]}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    """ref label_smooth_op.cc: (1-eps)*y + eps*prior (uniform default)."""
+    x = single_input(ins, "X")
+    eps = float(attrs.get("epsilon", 0.1))
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+    else:
+        prior = 1.0 / x.shape[-1]
+    return {"Out": [(1.0 - eps) * x + eps * prior]}
+
+
+@register_op("fill")
+def _fill(ctx, ins, attrs):
+    """ref fill_op.cc: constant data baked into attrs."""
+    from ..core.dtypes import to_jnp_dtype
+    value = np.asarray(attrs["value"],
+                       dtype=to_jnp_dtype(attrs.get("dtype", "float32")))
+    return {"Out": [jnp.asarray(value).reshape(attrs["shape"])]}
+
+
+@register_op("print", stop_gradient=True)
+def _print(ctx, ins, attrs):
+    """ref print_op.cc: passthrough + host-side print (jax.debug)."""
+    x = single_input(ins, "In" if ins.get("In") else "X")
+    msg = attrs.get("message", "")
+    jax.debug.print(msg + "{x}", x=x)
+    return {"Out": [x]}
+
+
+@register_op("delete_var", stop_gradient=True)
+def _delete_var(ctx, ins, attrs):
+    """ref delete_var_op.cc — liveness is XLA's job; accepted no-op."""
+    return {}
+
+
+@register_op("max_sequence_len", stop_gradient=True)
+def _max_sequence_len(ctx, ins, attrs):
+    """ref max_sequence_len_op.cc over the dense mask idiom."""
+    x = single_input(ins, "RankTable" if ins.get("RankTable") else "X")
+    lens = jnp.sum(x, axis=1) if x.ndim > 1 else x
+    return {"Out": [jnp.max(lens).astype(jnp.int32).reshape(1)]}
+
+
+@register_op("reorder_lod_tensor_by_rank", stop_gradient=True)
+def _reorder_by_rank(ctx, ins, attrs):
+    """ref reorder_lod_tensor_by_rank_op.cc: permute batch rows by the
+    rank-table order (dense: RankTable = the order indices)."""
+    x = single_input(ins, "X")
+    order = single_input(ins, "RankTable").reshape(-1).astype(jnp.int32)
+    return {"Out": [jnp.take(x, order, axis=0)]}
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    """ref tensor_array_to_tensor_op.cc: stack/concat the array entries
+    (dense: the 'array' is the op's X input list)."""
+    xs = ins["X"]
+    axis = int(attrs.get("axis", 0))
+    if attrs.get("use_stack", False):
+        out = jnp.stack(xs, axis=axis)
+    else:
+        out = jnp.concatenate(xs, axis=axis)
+    return {"Out": [out],
+            "OutIndex": [jnp.asarray([x.shape[axis] for x in xs],
+                                     jnp.int32)]}
+
+
+@register_op("split_lod_tensor", stop_gradient=True)
+def _split_lod_tensor(ctx, ins, attrs):
+    """ref split_lod_tensor_op.cc: route rows by a boolean mask into the
+    true/false branches.  Dense: both outputs keep the input size with
+    rows zeroed where not selected (positions preserved for the merge)."""
+    x = single_input(ins, "X")
+    mask = single_input(ins, "Mask").reshape(-1).astype(bool)
+    shape = (slice(None),) + (None,) * (x.ndim - 1)
+    m = mask[shape]
+    return {"OutTrue": [jnp.where(m, x, 0)],
+            "OutFalse": [jnp.where(m, 0, x)]}
+
+
+@register_op("merge_lod_tensor", stop_gradient=True)
+def _merge_lod_tensor(ctx, ins, attrs):
+    """ref merge_lod_tensor_op.cc: inverse of split_lod_tensor under the
+    position-preserving dense contract."""
+    in_true = single_input(ins, "InTrue")
+    in_false = single_input(ins, "InFalse")
+    mask = single_input(ins, "Mask").reshape(-1).astype(bool)
+    m = mask[(slice(None),) + (None,) * (in_true.ndim - 1)]
+    return {"Out": [jnp.where(m, in_true, in_false)]}
+
+
+@register_op("unpool")
+def _unpool(ctx, ins, attrs):
+    """ref unpool_op.cc: max-unpooling by indices from
+    pool2d_with_index.  X [N,C,h,w], Indices flat positions into the
+    unpooled [H,W]."""
+    x = single_input(ins, "X")
+    idx = single_input(ins, "Indices").astype(jnp.int32)
+    uh, uw = attrs["unpooled_height"], attrs["unpooled_width"]
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, uh * uw), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(x.reshape(n, c, -1))
+    return {"Out": [out.reshape(n, c, uh, uw)]}

@@ -1,0 +1,136 @@
+"""Sampled / structured classification losses.
+
+Capability parity with /root/reference/paddle/fluid/operators/nce_op.cc,
+hierarchical_sigmoid_op.cc, teacher_student_sigmoid_loss_op.cc,
+positive_negative_pair_op.cc — TPU-first: negative sampling draws from
+the functional RNG (ctx.rng()), the hsigmoid default tree is the
+reference's complete binary tree over classes, and everything is dense
+batched math (no SelectedRows side outputs; grads are XLA scatter-adds).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op, single_input
+
+
+@register_op("nce")
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (ref nce_op.cc, uniform sampler).
+
+    Input [B,D], Weight [N,D], optional Bias [N], Label [B] (or [B,1]).
+    attrs: num_total_classes N, num_neg_samples (default 10).
+    Output: Cost [B,1]; SampleLogits/SampleLabels for parity."""
+    x = single_input(ins, "Input").astype(jnp.float32)
+    w = single_input(ins, "Weight").astype(jnp.float32)
+    label = single_input(ins, "Label")
+    if label.ndim == 2:
+        label = label[:, 0]
+    label = label.astype(jnp.int32)
+    bias = ins["Bias"][0].astype(jnp.float32) if ins.get("Bias") else None
+    B, D = x.shape
+    N = int(attrs.get("num_total_classes", w.shape[0]))
+    k = int(attrs.get("num_neg_samples", 10))
+
+    neg = jax.random.randint(ctx.rng(), (B, k), 0, N)       # uniform noise
+    samples = jnp.concatenate([label[:, None], neg], axis=1)  # [B, 1+k]
+    sw = w[samples]                                          # [B,1+k,D]
+    logits = jnp.einsum("bd,bkd->bk", x, sw)
+    if bias is not None:
+        logits = logits + bias[samples]
+    # NCE objective with uniform noise q = 1/N:  P(data|u) =
+    # sigmoid(logit - log(k*q))
+    log_kq = np.log(k / N)
+    adj = logits - log_kq
+    lbl = jnp.zeros((B, 1 + k), jnp.float32).at[:, 0].set(1.0)
+    # stable sigmoid cross entropy
+    loss = jnp.maximum(adj, 0) - adj * lbl + jnp.log1p(jnp.exp(-jnp.abs(adj)))
+    cost = jnp.sum(loss, axis=1, keepdims=True)
+    return {"Cost": [cost], "SampleLogits": [logits],
+            "SampleLabels": [samples]}
+
+
+@register_op("hierarchical_sigmoid")
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """Hierarchical softmax over the reference's default complete binary
+    tree (ref hierarchical_sigmoid_op.cc + operators/math/matrix_bit_code.h:
+    internal node for class c at each step = path of (c + num_classes) in
+    a heap layout; code bit = child direction).
+
+    Input X [B,D], W [num_classes-1, D], Label [B], optional Bias
+    [num_classes-1].  Output Cost [B,1], PreOut [B, max_code_length]."""
+    x = single_input(ins, "X").astype(jnp.float32)
+    w = single_input(ins, "W").astype(jnp.float32)
+    label = single_input(ins, "Label")
+    if label.ndim == 2:
+        label = label[:, 0]
+    label = label.astype(jnp.int32)
+    bias = ins["Bias"][0].astype(jnp.float32) if ins.get("Bias") else None
+    num_classes = int(attrs["num_classes"])
+    B, D = x.shape
+    # heap path: node ids of (label + num_classes) up to the root (id 1);
+    # matrix_bit_code.h: calc_index = path node - num_classes ... the
+    # reference uses SimpleCode: code(d) = (c + num_classes) >> (L-d) ...
+    L = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+    node = label + num_classes
+    # step j (from leaf up): parent nodes; weight row = node//2 - 1
+    costs = jnp.zeros((B,), jnp.float32)
+    preouts = []
+    for _ in range(L):
+        parent = node // 2
+        bit = (node % 2).astype(jnp.float32)     # 1 = right child
+        row = parent - 1                          # internal node index
+        valid = parent >= 1
+        row_c = jnp.clip(row, 0, w.shape[0] - 1)
+        z = jnp.einsum("bd,bd->b", x, w[row_c])
+        if bias is not None:
+            z = z + bias[row_c]
+        # sigmoid xent against the bit
+        step_cost = jnp.maximum(z, 0) - z * bit + jnp.log1p(
+            jnp.exp(-jnp.abs(z)))
+        costs = costs + jnp.where(valid & (row >= 0), step_cost, 0.0)
+        preouts.append(z)
+        node = parent
+    pre = jnp.stack(preouts, axis=1)
+    return {"Out": [costs[:, None]], "PreOut": [pre]}
+
+
+@register_op("teacher_student_sigmoid_loss")
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    """ref teacher_student_sigmoid_loss_op.cc: distillation loss mixing
+    hard 0/1 CTR label with a soft teacher score."""
+    x = single_input(ins, "X").astype(jnp.float32)
+    label = single_input(ins, "Label").astype(jnp.float32)
+    soft_max_up = float(attrs.get("soft_max_up_bound", 15.0))
+    soft_max_lo = float(attrs.get("soft_max_lower_bound", -15.0))
+    z = x.reshape(label.shape)
+    hard = (label > 0.5).astype(jnp.float32)
+    ce = jnp.maximum(z, 0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    zc = jnp.clip(z, soft_max_lo, soft_max_up)
+    soft = jnp.log1p(jnp.exp(zc)) - label * zc
+    use_soft = (label > 0.0) & (label < 1.0)
+    return {"Y": [jnp.where(use_soft, soft, ce)]}
+
+
+@register_op("positive_negative_pair", stop_gradient=True)
+def _positive_negative_pair(ctx, ins, attrs):
+    """ref positive_negative_pair_op.cc: within each query id, count
+    (pos, neg, neutral) score-ordering pairs between items of different
+    labels.  Score [N,1], Label [N,1], QueryID [N,1]."""
+    score = single_input(ins, "Score").reshape(-1).astype(jnp.float32)
+    label = single_input(ins, "Label").reshape(-1).astype(jnp.float32)
+    qid = single_input(ins, "QueryID").reshape(-1).astype(jnp.int32)
+    same_q = qid[:, None] == qid[None, :]
+    li, lj = label[:, None], label[None, :]
+    si, sj = score[:, None], score[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)
+    pairs = same_q & (li != lj) & upper.astype(bool)
+    hi_right = jnp.where(li > lj, si - sj, sj - si)     # margin of the
+    pos = jnp.sum((pairs & (hi_right > 0)).astype(jnp.float32))
+    neg = jnp.sum((pairs & (hi_right < 0)).astype(jnp.float32))
+    neu = jnp.sum((pairs & (hi_right == 0)).astype(jnp.float32))
+    return {"PositivePair": [pos.reshape(1)],
+            "NegativePair": [neg.reshape(1)],
+            "NeutralPair": [neu.reshape(1)]}

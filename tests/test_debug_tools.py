@@ -1,0 +1,95 @@
+"""Debug plane: per-op NaN localization (ref operator.cc:829 under
+FLAGS_check_nan_inf) and the device-trace profiler wiring
+(ref platform/device_tracer.cc:41 -> jax.profiler xplane)."""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags, profiler
+from paddle_tpu.core.enforce import EnforceNotMet
+
+
+def test_per_op_nan_check_names_offending_op():
+    """A deliberately-NaN program (log of a negative) is localized to the
+    producing op, not just the fetch."""
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.log(x)                # NaN for negative inputs
+    z = layers.scale(y, scale=2.0)   # NaN propagates
+    out = layers.mean(z)
+    exe = pt.Executor(pt.CPUPlace())
+    flags.set_flag("check_nan_inf_per_op", True)
+    try:
+        with pytest.raises(EnforceNotMet) as ei:
+            exe.run(pt.default_main_program(),
+                    feed={"x": np.array([[1., -1., 2., 3.]], "float32")},
+                    fetch_list=[out])
+        assert "'log'" in str(ei.value)
+    finally:
+        flags.set_flag("check_nan_inf_per_op", False)
+
+
+def test_per_op_nan_check_passes_clean_program():
+    x = layers.data("x", [4], dtype="float32")
+    out = layers.mean(layers.exp(x))
+    exe = pt.Executor(pt.CPUPlace())
+    flags.set_flag("check_nan_inf_per_op", True)
+    try:
+        v, = exe.run(pt.default_main_program(),
+                     feed={"x": np.ones((2, 4), "float32")},
+                     fetch_list=[out])
+        assert np.isfinite(v).all()
+    finally:
+        flags.set_flag("check_nan_inf_per_op", False)
+
+
+def test_fetch_level_nan_check_still_works():
+    x = layers.data("x", [4], dtype="float32")
+    out = layers.mean(layers.log(x))
+    exe = pt.Executor(pt.CPUPlace())
+    flags.set_flag("check_nan_inf", True)
+    try:
+        with pytest.raises(EnforceNotMet):
+            exe.run(pt.default_main_program(),
+                    feed={"x": -np.ones((2, 4), "float32")},
+                    fetch_list=[out])
+    finally:
+        flags.set_flag("check_nan_inf", False)
+
+
+def test_device_trace_capture(tmp_path):
+    """enable_profiler(trace_dir) captures an xplane trace of device work
+    (the CUPTI DeviceTracer capability)."""
+    trace_dir = str(tmp_path / "trace")
+    x = layers.data("x", [8], dtype="float32")
+    out = layers.mean(layers.fc(x, size=8))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    profiler.enable_profiler(trace_dir)
+    try:
+        exe.run(pt.default_main_program(),
+                feed={"x": np.ones((4, 8), "float32")}, fetch_list=[out])
+    finally:
+        profiler.disable_profiler(trace_dir_used=True)
+    produced = glob.glob(os.path.join(trace_dir, "**", "*"),
+                         recursive=True)
+    assert any(p.endswith(".xplane.pb") or "trace" in os.path.basename(p)
+               for p in produced if os.path.isfile(p)), produced
+
+
+def test_host_event_summary_and_chrome_trace(tmp_path):
+    profiler.reset_profiler()
+    profiler.enable_profiler()
+    with profiler.RecordEvent("my_scope"):
+        pass
+    profiler.disable_profiler()
+    s = profiler.summary()
+    assert "my_scope" in s
+    path = str(tmp_path / "trace.json")
+    profiler.export_chrome_trace(path)
+    import json
+    trace = json.load(open(path))
+    assert any(e["name"] == "my_scope" for e in trace["traceEvents"])

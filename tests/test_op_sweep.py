@@ -1,0 +1,980 @@
+"""Whole-registry op sweep — the reference's per-op test contract
+(unittests/op_test.py:132) applied to EVERY registered op.
+
+Three tiers, mirroring the reference:
+  1. every op is invoked with valid inputs and must produce
+     finite, well-shaped outputs (the sweep below);
+  2. ops with a `ref` get their outputs checked against numpy;
+  3. ops in GRAD_CHECK get analytic-vs-finite-difference gradient
+     checks through the program autodiff (OpTest.check_grad).
+
+A coverage gate asserts every registered op is either swept here,
+exempted with a reason (structural/collective/covered-elsewhere), or
+carries a dedicated test file.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework.registry import registered_ops
+
+from op_test import OpTest
+
+rng = np.random.RandomState(1234)
+
+
+def f32(*shape, scale=1.0, positive=False):
+    a = rng.randn(*shape).astype("float32") * scale
+    return np.abs(a) + 0.5 if positive else a
+
+
+def i64(*shape, lo=0, hi=10):
+    return rng.randint(lo, hi, shape).astype("int64")
+
+
+# --------------------------------------------------------------------------
+# spec table: op -> dict(inputs, attrs, outs, ref (optional), skip_finite)
+# `inputs` values are callables (fresh data per run) or arrays.
+# --------------------------------------------------------------------------
+
+def unary(name, ref=None, positive=False, **attrs):
+    return {"inputs": {"X": f32(2, 6, positive=positive, scale=0.8)},
+            "attrs": attrs, "outs": ["Out"], "ref": ref}
+
+
+def binary(name, ref=None, **attrs):
+    return {"inputs": {"X": f32(2, 6), "Y": f32(2, 6)}, "attrs": attrs,
+            "outs": ["Out"], "ref": ref}
+
+
+def reduce(name, **attrs):
+    return {"inputs": {"X": f32(2, 3, 4)}, "attrs": attrs, "outs": ["Out"]}
+
+
+SPECS = {
+    # --- unary math -------------------------------------------------------
+    "abs": unary("abs", ref=lambda i: np.abs(i["X"])),
+    "ceil": unary("ceil", ref=lambda i: np.ceil(i["X"])),
+    "floor": unary("floor", ref=lambda i: np.floor(i["X"])),
+    "round": unary("round"),
+    "cos": unary("cos", ref=lambda i: np.cos(i["X"])),
+    "sin": unary("sin", ref=lambda i: np.sin(i["X"])),
+    "exp": unary("exp", ref=lambda i: np.exp(i["X"])),
+    "erf": unary("erf"),
+    "log": unary("log", positive=True,
+                 ref=lambda i: np.log(i["X"])),
+    "sqrt": unary("sqrt", positive=True,
+                  ref=lambda i: np.sqrt(i["X"])),
+    "rsqrt": unary("rsqrt", positive=True,
+                   ref=lambda i: 1 / np.sqrt(i["X"])),
+    "square": unary("square", ref=lambda i: i["X"] ** 2),
+    "reciprocal": unary("reciprocal", positive=True,
+                        ref=lambda i: 1 / i["X"]),
+    "sign": unary("sign", ref=lambda i: np.sign(i["X"])),
+    "sigmoid": unary("sigmoid",
+                     ref=lambda i: 1 / (1 + np.exp(-i["X"]))),
+    "logsigmoid": unary("logsigmoid"),
+    "tanh": unary("tanh", ref=lambda i: np.tanh(i["X"])),
+    "tanh_shrink": unary("tanh_shrink",
+                         ref=lambda i: i["X"] - np.tanh(i["X"])),
+    "softplus": unary("softplus"),
+    "softsign": unary("softsign",
+                      ref=lambda i: i["X"] / (1 + np.abs(i["X"]))),
+    "relu": unary("relu", ref=lambda i: np.maximum(i["X"], 0)),
+    "relu6": unary("relu6",
+                   ref=lambda i: np.clip(i["X"], 0, 6)),
+    "leaky_relu": unary("leaky_relu", alpha=0.1),
+    "elu": unary("elu"),
+    "selu": unary("selu"),
+    "gelu": unary("gelu"),
+    "brelu": unary("brelu", t_min=-1.0, t_max=1.0),
+    "soft_relu": unary("soft_relu"),
+    "hard_shrink": unary("hard_shrink", threshold=0.5),
+    "hard_sigmoid": unary("hard_sigmoid"),
+    "hard_swish": unary("hard_swish"),
+    "swish": unary("swish"),
+    "mish": unary("mish"),
+    "stanh": unary("stanh"),
+    "thresholded_relu": unary("thresholded_relu", threshold=0.3),
+    "softshrink": unary("softshrink", **{"lambda": 0.3}),
+    "maxout": {"inputs": {"X": f32(2, 8, 3, 3)}, "attrs": {"groups": 2},
+               "outs": ["Out"]},
+    "prelu": {"inputs": {"X": f32(2, 6), "Alpha": f32(1, scale=0.1)},
+              "attrs": {"mode": "all"}, "outs": ["Out"]},
+    "pow": unary("pow", factor=2.0),
+    "clip": unary("clip", min=-0.5, max=0.5,
+                  ref=lambda i: np.clip(i["X"], -0.5, 0.5)),
+    "clip_by_norm": unary("clip_by_norm", max_norm=1.0),
+    "scale": unary("scale", scale=2.0, bias=1.0,
+                   ref=lambda i: i["X"] * 2 + 1),
+    "cast": {"inputs": {"X": f32(2, 3)},
+             "attrs": {"out_dtype": "float32"}, "outs": ["Out"]},
+    "isfinite": {"inputs": {"X": f32(2, 3)}, "attrs": {}, "outs": ["Out"],
+                 "skip_finite": True},
+    "is_empty": {"inputs": {"X": f32(2, 3)}, "attrs": {}, "outs": ["Out"],
+                 "skip_finite": True},
+    "logical_not": {"inputs": {"X": i64(2, 3, hi=2).astype(bool)},
+                    "attrs": {}, "outs": ["Out"], "skip_finite": True},
+    "increment": unary("increment", step=1.0),
+    "shape": {"inputs": {"Input": f32(2, 3)}, "attrs": {},
+              "outs": ["Out"], "skip_finite": True},
+
+    # --- binary / broadcast ----------------------------------------------
+    "elementwise_add": binary("a", ref=lambda i: i["X"] + i["Y"]),
+    "elementwise_sub": binary("s", ref=lambda i: i["X"] - i["Y"]),
+    "elementwise_mul": binary("m", ref=lambda i: i["X"] * i["Y"]),
+    "elementwise_div": {"inputs": {"X": f32(2, 6),
+                                   "Y": f32(2, 6, positive=True)},
+                        "attrs": {}, "outs": ["Out"],
+                        "ref": lambda i: i["X"] / i["Y"]},
+    "elementwise_max": binary("x", ref=lambda i: np.maximum(i["X"], i["Y"])),
+    "elementwise_min": binary("n", ref=lambda i: np.minimum(i["X"], i["Y"])),
+    "elementwise_pow": {"inputs": {"X": f32(2, 6, positive=True),
+                                   "Y": f32(2, 6, scale=0.3)},
+                        "attrs": {}, "outs": ["Out"]},
+    "elementwise_mod": {"inputs": {"X": i64(2, 3, lo=1, hi=20),
+                                   "Y": i64(2, 3, lo=1, hi=5)},
+                        "attrs": {}, "outs": ["Out"], "skip_finite": True},
+    "elementwise_floordiv": {"inputs": {"X": i64(2, 3, lo=1, hi=20),
+                                        "Y": i64(2, 3, lo=1, hi=5)},
+                             "attrs": {}, "outs": ["Out"],
+                             "skip_finite": True},
+    "minus": binary("minus", ref=lambda i: i["X"] - i["Y"]),
+    "less_than": {**binary("lt"), "skip_finite": True},
+    "less_equal": {**binary("le"), "skip_finite": True},
+    "greater_than": {**binary("gt"), "skip_finite": True},
+    "greater_equal": {**binary("ge"), "skip_finite": True},
+    "equal": {**binary("eq"), "skip_finite": True},
+    "not_equal": {**binary("ne"), "skip_finite": True},
+    "logical_and": {"inputs": {"X": i64(2, 3, hi=2).astype(bool),
+                               "Y": i64(2, 3, hi=2).astype(bool)},
+                    "attrs": {}, "outs": ["Out"], "skip_finite": True},
+    "logical_or": {"inputs": {"X": i64(2, 3, hi=2).astype(bool),
+                              "Y": i64(2, 3, hi=2).astype(bool)},
+                   "attrs": {}, "outs": ["Out"], "skip_finite": True},
+    "logical_xor": {"inputs": {"X": i64(2, 3, hi=2).astype(bool),
+                               "Y": i64(2, 3, hi=2).astype(bool)},
+                    "attrs": {}, "outs": ["Out"], "skip_finite": True},
+
+    # --- reductions -------------------------------------------------------
+    "reduce_sum": {**reduce("rs", dim=[1]),
+                   "ref": lambda i: i["X"].sum(1)},
+    "reduce_mean": {**reduce("rm", dim=[1]),
+                    "ref": lambda i: i["X"].mean(1)},
+    "reduce_max": {**reduce("rx", dim=[1]),
+                   "ref": lambda i: i["X"].max(1)},
+    "reduce_min": {**reduce("rn", dim=[1]),
+                   "ref": lambda i: i["X"].min(1)},
+    "reduce_prod": reduce("rp", dim=[2]),
+    "reduce_all": {"inputs": {"X": i64(2, 3, hi=2).astype(bool)},
+                   "attrs": {"reduce_all": True}, "outs": ["Out"],
+                   "skip_finite": True},
+    "reduce_any": {"inputs": {"X": i64(2, 3, hi=2).astype(bool)},
+                   "attrs": {"reduce_all": True}, "outs": ["Out"],
+                   "skip_finite": True},
+    "mean": {"inputs": {"X": f32(2, 6)}, "attrs": {}, "outs": ["Out"],
+             "ref": lambda i: i["X"].mean()},
+    "sum": {"inputs": {"X": [f32(2, 3), f32(2, 3)]}, "attrs": {},
+            "outs": ["Out"]},
+    "cumsum": {"inputs": {"X": f32(2, 5)}, "attrs": {"axis": 1},
+               "outs": ["Out"], "ref": lambda i: i["X"].cumsum(1)},
+    "norm": {"inputs": {"X": f32(2, 6)}, "attrs": {"axis": 1},
+             "outs": ["Out", "Norm"]},
+    "l1_norm": {"inputs": {"X": f32(2, 6)}, "attrs": {}, "outs": ["Out"],
+                "ref": lambda i: np.abs(i["X"]).sum()},
+    "squared_l2_norm": {"inputs": {"X": f32(2, 6)}, "attrs": {},
+                        "outs": ["Out"],
+                        "ref": lambda i: (i["X"] ** 2).sum()},
+    "squared_l2_distance": {"inputs": {"X": f32(4, 6), "Y": f32(4, 6)},
+                            "attrs": {}, "outs": ["Out", "sub_result"]},
+    "frobenius_norm" if "frobenius_norm" in [] else "dot":
+        {"inputs": {"X": f32(3, 4), "Y": f32(3, 4)}, "attrs": {},
+         "outs": ["Out"],
+         "ref": lambda i: (i["X"] * i["Y"]).sum(-1, keepdims=True)},
+
+    # --- matmul family ----------------------------------------------------
+    "mul": {"inputs": {"X": f32(3, 4), "Y": f32(4, 5)}, "attrs": {},
+            "outs": ["Out"], "ref": lambda i: i["X"] @ i["Y"]},
+    "matmul": {"inputs": {"X": f32(2, 3, 4), "Y": f32(2, 4, 5)},
+               "attrs": {}, "outs": ["Out"],
+               "ref": lambda i: i["X"] @ i["Y"]},
+    "bmm": {"inputs": {"X": f32(2, 3, 4), "Y": f32(2, 4, 5)},
+            "attrs": {}, "outs": ["Out"], "ref": lambda i: i["X"] @ i["Y"]},
+    "bilinear_tensor_product": {
+        "inputs": {"X": f32(2, 3), "Y": f32(2, 4),
+                   "Weight": f32(5, 3, 4)},
+        "attrs": {}, "outs": ["Out"]},
+
+    # --- shape / indexing -------------------------------------------------
+    "reshape": {"inputs": {"X": f32(2, 6)}, "attrs": {"shape": [3, 4]},
+                "outs": ["Out"], "ref": lambda i: i["X"].reshape(3, 4)},
+    "reshape2": {"inputs": {"X": f32(2, 6)}, "attrs": {"shape": [3, 4]},
+                 "outs": ["Out"]},
+    "transpose": {"inputs": {"X": f32(2, 3, 4)},
+                  "attrs": {"axis": [0, 2, 1]}, "outs": ["Out"],
+                  "ref": lambda i: i["X"].transpose(0, 2, 1)},
+    "transpose2": {"inputs": {"X": f32(2, 3, 4)},
+                   "attrs": {"axis": [0, 2, 1]}, "outs": ["Out"]},
+    "flatten": {"inputs": {"X": f32(2, 3, 4)}, "attrs": {"axis": 1},
+                "outs": ["Out"]},
+    "flatten2": {"inputs": {"X": f32(2, 3, 4)}, "attrs": {"axis": 1},
+                 "outs": ["Out"]},
+    "flatten_contiguous_range": {"inputs": {"X": f32(2, 3, 4)},
+                                 "attrs": {"start_axis": 1,
+                                           "stop_axis": 2},
+                                 "outs": ["Out"]},
+    "squeeze": {"inputs": {"X": f32(2, 1, 4)}, "attrs": {"axes": [1]},
+                "outs": ["Out"]},
+    "squeeze2": {"inputs": {"X": f32(2, 1, 4)}, "attrs": {"axes": [1]},
+                 "outs": ["Out"]},
+    "unsqueeze": {"inputs": {"X": f32(2, 4)}, "attrs": {"axes": [1]},
+                  "outs": ["Out"]},
+    "unsqueeze2": {"inputs": {"X": f32(2, 4)}, "attrs": {"axes": [1]},
+                   "outs": ["Out"]},
+    "stack": {"inputs": {"X": [f32(2, 3), f32(2, 3)]},
+              "attrs": {"axis": 0}, "outs": ["Y"]},
+    "unstack": {"inputs": {"X": f32(2, 3)}, "attrs": {"axis": 0},
+                "outs": ["Y", "Y"]},
+    "unbind": {"inputs": {"X": f32(2, 3)}, "attrs": {"axis": 0},
+               "outs": ["Y", "Y"]},
+    "concat": {"inputs": {"X": [f32(2, 3), f32(2, 3)]},
+               "attrs": {"axis": 0}, "outs": ["Out"]},
+    "split": {"inputs": {"X": f32(4, 3)}, "attrs": {"num": 2, "axis": 0,
+                                                    "sections": []},
+              "outs": ["Out", "Out"]},
+    "slice": {"inputs": {"Input": f32(4, 5)},
+              "attrs": {"axes": [1], "starts": [1], "ends": [3]},
+              "outs": ["Out"], "ref": lambda i: i["Input"][:, 1:3]},
+    "strided_slice": {"inputs": {"Input": f32(4, 6)},
+                      "attrs": {"axes": [1], "starts": [0], "ends": [6],
+                                "strides": [2]},
+                      "outs": ["Out"]},
+    "expand": {"inputs": {"X": f32(1, 3)},
+               "attrs": {"expand_times": [2, 1]}, "outs": ["Out"]},
+    "expand_as": {"inputs": {"X": f32(1, 3), "Y": f32(4, 3)},
+                  "attrs": {}, "outs": ["Out"]},
+    "tile": {"inputs": {"X": f32(1, 3)},
+             "attrs": {"repeat_times": [2, 2]}, "outs": ["Out"]},
+    "gather": {"inputs": {"X": f32(5, 3),
+                          "Index": i64(3, hi=5)},
+               "attrs": {}, "outs": ["Out"],
+               "ref": lambda i: i["X"][i["Index"]]},
+    "gather_nd": {"inputs": {"X": f32(3, 4),
+                             "Index": i64(2, 2, hi=3)},
+                  "attrs": {}, "outs": ["Out"]},
+    "scatter": {"inputs": {"X": f32(5, 3), "Ids": i64(2, hi=5),
+                           "Updates": f32(2, 3)},
+                "attrs": {}, "outs": ["Out"]},
+    "scatter_nd_add": {"inputs": {"X": f32(5, 3),
+                                  "Index": i64(2, 1, hi=5),
+                                  "Updates": f32(2, 3)},
+                       "attrs": {}, "outs": ["Out"]},
+    "multiplex": {"inputs": {"Ids": i64(3, 1, hi=2),
+                             "X": [f32(3, 4), f32(3, 4)]},
+                  "attrs": {}, "outs": ["Out"]},
+    "where": {"inputs": {"Condition": i64(2, 3, hi=2).astype(bool),
+                         "X": f32(2, 3), "Y": f32(2, 3)},
+              "attrs": {}, "outs": ["Out"]},
+    "where_index": {"inputs": {"Condition": i64(4, hi=2).astype(bool)},
+                    "attrs": {}, "outs": ["Out"], "skip_finite": True},
+    "arg_max": {"inputs": {"X": f32(3, 5)}, "attrs": {"axis": 1},
+                "outs": ["Out"], "skip_finite": True,
+                "ref": lambda i: i["X"].argmax(1)},
+    "arg_min": {"inputs": {"X": f32(3, 5)}, "attrs": {"axis": 1},
+                "outs": ["Out"], "skip_finite": True},
+    "argsort": {"inputs": {"X": f32(3, 5)}, "attrs": {"axis": 1},
+                "outs": ["Out", "Indices"], "skip_finite": True},
+    "top_k": {"inputs": {"X": f32(3, 6)}, "attrs": {"k": 2},
+              "outs": ["Out", "Indices"], "skip_finite": True},
+    "one_hot": {"inputs": {"X": i64(4, 1, hi=5)}, "attrs": {"depth": 5},
+                "outs": ["Out"]},
+    "roll": {"inputs": {"X": f32(3, 4)},
+             "attrs": {"shifts": [1], "axis": [1]}, "outs": ["Out"]},
+    "flip": {"inputs": {"X": f32(3, 4)}, "attrs": {"axis": [1]},
+             "outs": ["Out"], "ref": lambda i: i["X"][:, ::-1]},
+    "reverse": {"inputs": {"X": f32(3, 4)}, "attrs": {"axis": [0]},
+                "outs": ["Out"]},
+    "crop": {"inputs": {"X": f32(4, 5)},
+             "attrs": {"offsets": [1, 1], "shape": [2, 3]},
+             "outs": ["Out"]},
+    "pad": {"inputs": {"X": f32(2, 3)},
+            "attrs": {"paddings": [1, 1, 0, 0], "pad_value": 0.0},
+            "outs": ["Out"]},
+    "pad2d": {"inputs": {"X": f32(1, 2, 3, 3)},
+              "attrs": {"paddings": [1, 1, 1, 1]}, "outs": ["Out"]},
+    "pad3d": {"inputs": {"X": f32(1, 2, 3, 3, 3)},
+              "attrs": {"paddings": [1, 1, 1, 1, 1, 1]}, "outs": ["Out"]},
+    "pad_constant_like": {"inputs": {"X": f32(4, 5), "Y": f32(2, 3)},
+                          "attrs": {"pad_value": 0.0}, "outs": ["Out"]},
+    "space_to_depth": {"inputs": {"X": f32(1, 2, 4, 4)},
+                       "attrs": {"blocksize": 2}, "outs": ["Out"]},
+    "pixel_shuffle": {"inputs": {"X": f32(1, 8, 3, 3)},
+                      "attrs": {"upscale_factor": 2}, "outs": ["Out"]},
+    "shard_index": {"inputs": {"X": i64(4, 1, hi=16)},
+                    "attrs": {"index_num": 16, "nshards": 2,
+                              "shard_id": 0, "ignore_value": -1},
+                    "outs": ["Out"], "skip_finite": True},
+
+    # --- creation ---------------------------------------------------------
+    "fill_constant": {"inputs": {},
+                      "attrs": {"shape": [2, 3], "dtype": "float32",
+                                "value": 1.5},
+                      "outs": ["Out"],
+                      "ref": lambda i: np.full((2, 3), 1.5, "float32")},
+    "fill_constant_batch_size_like": {
+        "inputs": {"Input": f32(4, 2)},
+        "attrs": {"shape": [-1, 3], "dtype": "float32", "value": 2.0},
+        "outs": ["Out"]},
+    "fill_zeros_like": {"inputs": {"X": f32(2, 3)}, "attrs": {},
+                        "outs": ["Out"]},
+    "fill_any_like": {"inputs": {"X": f32(2, 3)}, "attrs": {"value": 3.0},
+                      "outs": ["Out"]},
+    "fill": {"inputs": {},
+             "attrs": {"shape": [2, 2], "dtype": "float32",
+                       "value": [1.0, 2.0, 3.0, 4.0]},
+             "outs": ["Out"]},
+    "assign": {"inputs": {"X": f32(2, 3)}, "attrs": {}, "outs": ["Out"]},
+    "assign_value": {"inputs": {},
+                     "attrs": {"shape": [2], "dtype": "float32",
+                               "values": np.array([1., 2.], "float32")},
+                     "outs": ["Out"]},
+    "eye": {"inputs": {}, "attrs": {"num_rows": 3, "dtype": "float32"},
+            "outs": ["Out"]},
+    "linspace": {"inputs": {}, "attrs": {"start": 0.0, "stop": 1.0,
+                                         "num": 5, "dtype": "float32"},
+                 "outs": ["Out"]},
+    "range": {"inputs": {"Start": np.zeros((1,), "float32"),
+                         "End": np.full((1,), 5.0, "float32"),
+                         "Step": np.ones((1,), "float32")},
+              "attrs": {"len": 5}, "outs": ["Out"]},
+    "uniform_random": {"inputs": {},
+                       "attrs": {"shape": [2, 3], "min": -1.0,
+                                 "max": 1.0, "dtype": "float32"},
+                       "outs": ["Out"]},
+    "gaussian_random": {"inputs": {},
+                        "attrs": {"shape": [2, 3], "dtype": "float32"},
+                        "outs": ["Out"]},
+    "truncated_gaussian_random": {
+        "inputs": {}, "attrs": {"shape": [2, 3], "dtype": "float32"},
+        "outs": ["Out"]},
+    "uniform_random_batch_size_like": {
+        "inputs": {"Input": f32(4, 2)},
+        "attrs": {"shape": [-1, 3], "dtype": "float32"}, "outs": ["Out"]},
+    "gaussian_random_batch_size_like": {
+        "inputs": {"Input": f32(4, 2)},
+        "attrs": {"shape": [-1, 3], "dtype": "float32"}, "outs": ["Out"]},
+    "sampling_id": {"inputs": {"X": np.full((3, 4), 0.25, "float32")},
+                    "attrs": {}, "outs": ["Out"], "skip_finite": True},
+
+    # --- nn ---------------------------------------------------------------
+    "conv2d": {"inputs": {"Input": f32(1, 2, 6, 6),
+                          "Filter": f32(3, 2, 3, 3, scale=0.3)},
+               "attrs": {}, "outs": ["Output"]},
+    "depthwise_conv2d": {"inputs": {"Input": f32(1, 2, 6, 6),
+                                    "Filter": f32(2, 1, 3, 3)},
+                         "attrs": {}, "outs": ["Output"]},
+    "conv3d": {"inputs": {"Input": f32(1, 2, 4, 4, 4),
+                          "Filter": f32(3, 2, 2, 2, 2)},
+               "attrs": {}, "outs": ["Output"]},
+    "conv2d_transpose": {"inputs": {"Input": f32(1, 2, 4, 4),
+                                    "Filter": f32(2, 3, 3, 3)},
+                         "attrs": {}, "outs": ["Output"]},
+    "conv3d_transpose": {"inputs": {"Input": f32(1, 2, 3, 3, 3),
+                                    "Filter": f32(2, 3, 2, 2, 2)},
+                         "attrs": {}, "outs": ["Output"]},
+    "pool2d": {"inputs": {"X": f32(1, 2, 4, 4)},
+               "attrs": {"ksize": [2, 2], "pooling_type": "max"},
+               "outs": ["Out"]},
+    "pool3d": {"inputs": {"X": f32(1, 2, 4, 4, 4)},
+               "attrs": {"ksize": [2, 2, 2], "pooling_type": "avg"},
+               "outs": ["Out"]},
+    "pool2d_with_index": {"inputs": {"X": f32(1, 2, 4, 4)},
+                          "attrs": {"ksize": [2, 2]},
+                          "outs": ["Out", "Mask"], "skip_finite": True},
+    "unpool": {"inputs": {"X": f32(1, 1, 2, 2, positive=True),
+                          "Indices": np.array(
+                              [[[[0, 3], [12, 15]]]], "int64")},
+               "attrs": {"unpooled_height": 4, "unpooled_width": 4},
+               "outs": ["Out"]},
+    "batch_norm": {"inputs": {"X": f32(4, 3), "Scale": f32(3),
+                              "Bias": f32(3),
+                              "Mean": np.zeros(3, "float32"),
+                              "Variance": np.ones(3, "float32")},
+                   "attrs": {"is_test": True}, "outs": ["Y"]},
+    "instance_norm": {"inputs": {"X": f32(2, 3, 4, 4)},
+                      "attrs": {}, "outs": ["Y"]},
+    "layer_norm": {"inputs": {"X": f32(4, 6), "Scale": f32(6),
+                              "Bias": f32(6)},
+                   "attrs": {"begin_norm_axis": 1}, "outs": ["Y"]},
+    "group_norm": {"inputs": {"X": f32(2, 4, 3, 3), "Scale": f32(4),
+                              "Bias": f32(4)},
+                   "attrs": {"groups": 2}, "outs": ["Y"]},
+    "lrn": {"inputs": {"X": f32(1, 4, 3, 3)}, "attrs": {}, "outs": ["Out"]},
+    "softmax": {"inputs": {"X": f32(3, 5)}, "attrs": {}, "outs": ["Out"]},
+    "log_softmax": {"inputs": {"X": f32(3, 5)}, "attrs": {},
+                    "outs": ["Out"]},
+    "sequence_softmax": {"inputs": {"X": f32(3, 5)}, "attrs": {},
+                         "outs": ["Out"]},
+    "dropout": {"inputs": {"X": f32(3, 5)},
+                "attrs": {"dropout_prob": 0.5, "is_test": True},
+                "outs": ["Out"]},
+    "lookup_table": {"inputs": {"W": f32(10, 4), "Ids": i64(3, 2)},
+                     "attrs": {}, "outs": ["Out"]},
+    "lookup_table_v2": {"inputs": {"W": f32(10, 4), "Ids": i64(3, 2)},
+                        "attrs": {}, "outs": ["Out"]},
+    "lookup_sparse_table": {"inputs": {"W": f32(10, 4),
+                                       "Ids": i64(3)},
+                            "attrs": {}, "outs": ["Out"]},
+    "embedding" if False else "im2sequence": {
+        "inputs": {"X": f32(1, 1, 4, 4)},
+        "attrs": {"kernels": [2, 2], "strides": [2, 2],
+                  "paddings": [0, 0, 0, 0]},
+        "outs": ["Out"]},
+    "affine_channel": {"inputs": {"X": f32(1, 3, 2, 2),
+                                  "Scale": f32(3), "Bias": f32(3)},
+                       "attrs": {}, "outs": ["Out"]},
+    "affine_grid": {"inputs": {"Theta": f32(2, 2, 3, scale=0.3)},
+                    "attrs": {"output_shape": [2, 1, 4, 4]},
+                    "outs": ["Output"]},
+    "grid_sampler": {"inputs": {"X": f32(1, 2, 4, 4),
+                                "Grid": f32(1, 3, 3, 2, scale=0.4)},
+                     "attrs": {}, "outs": ["Output"]},
+    "interpolate": {"inputs": {"X": f32(1, 2, 4, 4)},
+                    "attrs": {"out_h": 8, "out_w": 8,
+                              "interp_method": "bilinear"},
+                    "outs": ["Out"]},
+    "bilinear_interp": {"inputs": {"X": f32(1, 2, 4, 4)},
+                        "attrs": {"out_h": 8, "out_w": 8},
+                        "outs": ["Out"]},
+    "nearest_interp": {"inputs": {"X": f32(1, 2, 4, 4)},
+                       "attrs": {"out_h": 8, "out_w": 8},
+                       "outs": ["Out"]},
+    "row_conv": {"inputs": {"X": f32(2, 5, 3),
+                            "Filter": f32(3, 3, scale=0.3)},
+                 "attrs": {}, "outs": ["Out"]},
+    "add_position_encoding": {"inputs": {"X": f32(2, 5, 4)},
+                              "attrs": {}, "outs": ["Out"]},
+    "cos_sim": {"inputs": {"X": f32(3, 4), "Y": f32(3, 4)},
+                "attrs": {}, "outs": ["Out"]},
+    "spp": {"inputs": {"X": f32(1, 2, 4, 4)},
+            "attrs": {"pyramid_height": 2}, "outs": ["Out"]},
+    "shuffle_channel": {"inputs": {"X": f32(1, 4, 2, 2)},
+                        "attrs": {"group": 2}, "outs": ["Out"]},
+    "conv_shift": {"inputs": {"X": f32(2, 6), "Y": f32(2, 3)},
+                   "attrs": {}, "outs": ["Out"]},
+    "similarity_focus": {"inputs": {"X": f32(1, 2, 3, 3)},
+                         "attrs": {"axis": 1, "indexes": [0]},
+                         "outs": ["Out"]},
+    "random_crop": {"inputs": {"X": f32(1, 2, 6, 6)},
+                    "attrs": {"shape": [4, 4]}, "outs": ["Out"]},
+    "sequence_conv": {"inputs": {"X": f32(2, 5, 3),
+                                 "Filter": f32(9, 4)},
+                      "attrs": {"contextLength": 3, "contextStart": -1},
+                      "outs": ["Out"]},
+
+    # --- RNN --------------------------------------------------------------
+    "lstm": {"inputs": {"Input": f32(2, 4, 8), "Weight": f32(2, 8)},
+             "attrs": {}, "outs": ["Hidden", "LastH", "LastC"]},
+    "gru": {"inputs": {"Input": f32(2, 4, 6), "Weight": f32(2, 6)},
+            "attrs": {}, "outs": ["Hidden", "LastH"]},
+    "lstm_unit": {"inputs": {"X": f32(3, 8), "C_prev": f32(3, 2)},
+                  "attrs": {}, "outs": ["C", "H"]},
+    "gru_unit": {"inputs": {"Input": f32(3, 6), "HiddenPrev": f32(3, 2),
+                            "Weight": f32(2, 6)},
+                 "attrs": {}, "outs": ["Hidden"]},
+    "lstmp": {"inputs": {"Input": f32(2, 4, 8), "Weight": f32(3, 8),
+                         "ProjWeight": f32(2, 3)},
+              "attrs": {}, "outs": ["Projection", "LastH", "LastC"]},
+    "cudnn_lstm": {"inputs": {"Input": f32(2, 4, 3),
+                              "W": f32(3 * 4 * 5 + 5 * 4 * 5 + 4 * 5)},
+                   "attrs": {"hidden_size": 5, "num_layers": 1},
+                   "outs": ["Out"]},
+
+    # --- losses / metrics -------------------------------------------------
+    "cross_entropy": {"inputs": {
+        "X": np.full((3, 4), 0.25, "float32"), "Label": i64(3, 1, hi=4)},
+        "attrs": {}, "outs": ["Y"]},
+    "softmax_with_cross_entropy": {"inputs": {
+        "Logits": f32(3, 5), "Label": i64(3, 1, hi=5)},
+        "attrs": {}, "outs": ["Loss"]},
+    "sigmoid_cross_entropy_with_logits": {"inputs": {
+        "X": f32(3, 4), "Label": i64(3, 4, hi=2).astype("float32")},
+        "attrs": {}, "outs": ["Out"]},
+    "bpr_loss": {"inputs": {"X": np.abs(f32(3, 4)) + 0.1,
+                            "Label": i64(3, 1, hi=4)},
+                 "attrs": {}, "outs": ["Y"]},
+    "hinge_loss": {"inputs": {"Logits": f32(4, 1),
+                              "Labels": i64(4, 1, hi=2).astype("float32")},
+                   "attrs": {}, "outs": ["Loss"]},
+    "huber_loss": {"inputs": {"X": f32(4, 1), "Y": f32(4, 1)},
+                   "attrs": {"delta": 1.0}, "outs": ["Out"]},
+    "modified_huber_loss": {"inputs": {
+        "X": f32(4, 1), "Y": i64(4, 1, hi=2).astype("float32")},
+        "attrs": {}, "outs": ["Out"]},
+    "smooth_l1_loss": {"inputs": {"X": f32(4, 3), "Y": f32(4, 3)},
+                       "attrs": {}, "outs": ["Out", "Diff"]},
+    "log_loss": {"inputs": {
+        "Predicted": np.random.RandomState(0).rand(4, 1).astype(
+            "float32") * 0.8 + 0.1,
+        "Labels": i64(4, 1, hi=2).astype("float32")},
+        "attrs": {}, "outs": ["Loss"]},
+    "margin_rank_loss": {"inputs": {"X1": f32(4, 1), "X2": f32(4, 1),
+                                    "Label": np.sign(f32(4, 1))},
+                         "attrs": {}, "outs": ["Out"]},
+    "rank_loss": {"inputs": {"Left": f32(4, 1), "Right": f32(4, 1),
+                             "Label": i64(4, 1, hi=2).astype("float32")},
+                  "attrs": {}, "outs": ["Out"]},
+    "mse_loss": {"inputs": {"X": f32(4, 3), "Label": f32(4, 3)},
+                 "attrs": {}, "outs": ["Out"]},
+    "square_error_cost": {"inputs": {"X": f32(4, 3), "Label": f32(4, 3)},
+                          "attrs": {}, "outs": ["Out"]},
+    "kldiv_loss": {"inputs": {
+        "X": np.log(np.random.RandomState(1).rand(3, 4).astype(
+            "float32") + 0.1),
+        "Target": np.random.RandomState(2).rand(3, 4).astype("float32")},
+        "attrs": {"reduction": "mean"}, "outs": ["Loss"]},
+    "npair_loss": {"inputs": {"Anchor": f32(3, 4), "Positive": f32(3, 4),
+                              "Labels": i64(3, hi=3).astype("float32")},
+                   "attrs": {}, "outs": ["Out"]},
+    "label_smooth": {"inputs": {"X": np.eye(3, 4, dtype="float32")},
+                     "attrs": {"epsilon": 0.1}, "outs": ["Out"]},
+    "teacher_student_sigmoid_loss": {
+        "inputs": {"X": f32(4, 1),
+                   "Label": np.random.RandomState(3).rand(4, 1).astype(
+                       "float32")},
+        "attrs": {}, "outs": ["Y"]},
+    "accuracy": {"inputs": {"Out": np.full((4, 3), 0.33, "float32"),
+                            "Indices": i64(4, 1, hi=3),
+                            "Label": i64(4, 1, hi=3)},
+                 "attrs": {}, "outs": ["Accuracy"]},
+    "auc": {"inputs": {
+        "Predict": np.random.RandomState(4).rand(6, 2).astype("float32"),
+        "Label": i64(6, 1, hi=2),
+        "StatPos": np.zeros((4096,), "float32"),
+        "StatNeg": np.zeros((4096,), "float32")},
+        "attrs": {}, "outs": ["AUC"]},
+    "precision_recall": {"inputs": {
+        "MaxProbs": np.random.RandomState(5).rand(4, 1).astype("float32"),
+        "Indices": i64(4, 1, hi=2), "Labels": i64(4, 1, hi=2),
+        "StatesInfo": np.zeros((2, 3), "float32")},
+        "attrs": {"class_number": 2}, "outs": ["BatchMetrics"]},
+    "positive_negative_pair": {"inputs": {
+        "Score": np.random.RandomState(6).rand(6, 1).astype("float32"),
+        "Label": i64(6, 1, hi=2).astype("float32"),
+        "QueryID": i64(6, 1, hi=2)},
+        "attrs": {}, "outs": ["PositivePair", "NegativePair"]},
+    "mean_iou": {"inputs": {"Predictions": i64(8, hi=3),
+                            "Labels": i64(8, hi=3)},
+                 "attrs": {"num_classes": 3},
+                 "outs": ["OutMeanIou"]},
+    "edit_distance": {"inputs": {"Hyps": i64(2, 4, hi=5),
+                                 "Refs": i64(2, 4, hi=5)},
+                      "attrs": {}, "outs": ["Out"]},
+    "chunk_eval": {"inputs": {"Inference": i64(1, 6, hi=3),
+                              "Label": i64(1, 6, hi=3)},
+                   "attrs": {"num_chunk_types": 1},
+                   "outs": ["Precision", "Recall"]},
+    "nce": {"inputs": {"Input": f32(3, 4), "Weight": f32(8, 4),
+                       "Label": i64(3, 1, hi=8)},
+            "attrs": {"num_total_classes": 8, "num_neg_samples": 3},
+            "outs": ["Cost"]},
+    "hierarchical_sigmoid": {"inputs": {"X": f32(3, 4),
+                                        "W": f32(7, 4),
+                                        "Label": i64(3, hi=8)},
+                             "attrs": {"num_classes": 8}, "outs": ["Out"]},
+    "linear_chain_crf": {"inputs": {"Emission": f32(2, 4, 3),
+                                    "Transition": f32(5, 3),
+                                    "Label": i64(2, 4, hi=3)},
+                         "attrs": {}, "outs": ["LogLikelihood"]},
+    "crf_decoding": {"inputs": {"Emission": f32(2, 4, 3),
+                                "Transition": f32(5, 3)},
+                     "attrs": {}, "outs": ["ViterbiPath"],
+                     "skip_finite": True},
+    "warpctc": {"inputs": {"Logits": f32(2, 5, 4),
+                           "Label": i64(2, 2, lo=1, hi=4)},
+                "attrs": {}, "outs": ["Loss"]},
+    "ctc_align": {"inputs": {"Input": i64(2, 6, hi=3)},
+                  "attrs": {}, "outs": ["Output"], "skip_finite": True},
+
+    # --- sequence ---------------------------------------------------------
+    "sequence_concat": {"inputs": {"X": [f32(2, 3, 4), f32(2, 2, 4)]},
+                        "attrs": {}, "outs": ["Out"]},
+    "sequence_enumerate": {"inputs": {"X": i64(2, 5, hi=9)},
+                           "attrs": {"win_size": 2}, "outs": ["Out"],
+                           "skip_finite": True},
+    "sequence_erase": {"inputs": {"X": i64(2, 5, hi=5)},
+                       "attrs": {"tokens": [0]}, "outs": ["Out"],
+                       "skip_finite": True},
+    "sequence_expand": {"inputs": {"X": f32(2, 3), "Y": f32(2, 3)},
+                        "attrs": {}, "outs": ["Out"]},
+    "sequence_expand_as": {"inputs": {"X": f32(2, 3), "Y": f32(2, 3)},
+                           "attrs": {}, "outs": ["Out"]},
+    "sequence_mask": {"inputs": {"X": i64(3, lo=1, hi=5)},
+                      "attrs": {"maxlen": 5}, "outs": ["Y"],
+                      "skip_finite": True},
+    "sequence_pad": {"inputs": {"X": f32(2, 4, 3),
+                                "Length": i64(2, lo=1, hi=4)},
+                     "attrs": {"padded_length": 5}, "outs": ["Out"]},
+    "sequence_unpad": {"inputs": {"X": f32(2, 5, 3),
+                                  "Length": i64(2, lo=1, hi=5)},
+                       "attrs": {}, "outs": ["Out"]},
+    "sequence_pool": {"inputs": {"X": f32(2, 4, 3)},
+                      "attrs": {"pooltype": "SUM"}, "outs": ["Out"]},
+    "sequence_reshape": {"inputs": {"X": f32(2, 4, 6)},
+                         "attrs": {"new_dim": 3}, "outs": ["Out"]},
+    "sequence_reverse": {"inputs": {"X": f32(2, 4, 3)},
+                         "attrs": {}, "outs": ["Y"]},
+    "sequence_scatter": {"inputs": {"X": f32(2, 6),
+                                    "Ids": i64(2, 3, hi=6),
+                                    "Updates": f32(2, 3)},
+                         "attrs": {}, "outs": ["Out"]},
+    "sequence_slice": {"inputs": {"X": f32(3, 5, 2)},
+                       "attrs": {"offset": 1, "length": 2},
+                       "outs": ["Out"]},
+    "lod_reset": {"inputs": {"X": f32(4, 3)}, "attrs": {"target_lod": []},
+                  "outs": ["Out"]},
+    "lod_rank_table": {"inputs": {"X": np.array(
+        [[1, 1, 0], [1, 1, 1]], "float32")},
+        "attrs": {}, "outs": ["Out"], "skip_finite": True},
+    "max_sequence_len": {"inputs": {"X": np.array(
+        [[1, 1, 0], [1, 1, 1]], "float32")},
+        "attrs": {}, "outs": ["Out"], "skip_finite": True},
+    "reorder_lod_tensor_by_rank": {
+        "inputs": {"X": f32(3, 4), "RankTable": i64(3, hi=3) * 0 + np.arange(3)},
+        "attrs": {}, "outs": ["Out"]},
+    "tensor_array_to_tensor": {"inputs": {"X": [f32(2, 3), f32(2, 3)]},
+                               "attrs": {"axis": 0}, "outs": ["Out"]},
+    "split_lod_tensor": {"inputs": {
+        "X": f32(4, 3), "Mask": i64(4, 1, hi=2).astype(bool)},
+        "attrs": {}, "outs": ["OutTrue", "OutFalse"]},
+    "merge_lod_tensor": {"inputs": {
+        "InTrue": f32(4, 3), "InFalse": f32(4, 3),
+        "Mask": i64(4, 1, hi=2).astype(bool)},
+        "attrs": {}, "outs": ["Out"]},
+    "beam_search": {"inputs": {
+        "PreScores": f32(2, 3), "PreIds": i64(2, 3, hi=5),
+        "LogProbs": f32(2, 3, 5)},
+        "attrs": {"beam_size": 3, "end_id": 1},
+        "outs": ["Scores", "Ids", "Parents"], "skip_finite": True},
+    "beam_search_decode": {"inputs": {
+        "Ids": i64(4, 2, 3, hi=5), "Parents": i64(4, 2, 3, hi=3),
+        "Scores": f32(2, 3)},
+        "attrs": {}, "outs": ["SentenceIds", "SentenceScores"],
+        "skip_finite": True},
+
+    # --- selected-rows / ids plumbing ------------------------------------
+    "unique": {"inputs": {"X": i64(6, hi=4)}, "attrs": {},
+               "outs": ["Out"], "skip_finite": True},
+    "unique_with_counts": {"inputs": {"X": i64(6, hi=4)}, "attrs": {},
+                           "outs": ["Out", "Count"],
+                           "skip_finite": True},
+    "hash": {"inputs": {"X": i64(4, 2, hi=100)},
+             "attrs": {"num_hash": 2, "mod_by": 1000}, "outs": ["Out"],
+             "skip_finite": True},
+    "split_ids": {"inputs": {"Ids": i64(5, hi=20)},
+                  "attrs": {"num_shards": 2}, "outs": ["Out", "Out"],
+                  "skip_finite": True},
+    "merge_ids": {"inputs": {"X": [f32(4, 2), f32(4, 2)]},
+                  "attrs": {}, "outs": ["Out"]},
+    "merge_selected_rows": {"inputs": {"Ids": i64(4, hi=3),
+                                       "Values": f32(4, 2)},
+                            "attrs": {}, "outs": ["OutIds", "Out"],
+                            "skip_finite": True},
+    "split_selected_rows": {"inputs": {"Ids": i64(4, hi=10),
+                                       "Values": f32(4, 2)},
+                            "attrs": {"height_sections": [5, 5]},
+                            "outs": ["OutIds", "Out"],
+                            "skip_finite": True},
+    "get_tensor_from_selected_rows": {
+        "inputs": {"Ids": i64(3, hi=6), "Values": f32(3, 2)},
+        "attrs": {"height": 6}, "outs": ["Out"]},
+
+    # --- detection --------------------------------------------------------
+    "iou_similarity": {"inputs": {
+        "X": np.array([[0., 0., 2., 2.]], "float32"),
+        "Y": np.array([[1., 1., 3., 3.]], "float32")},
+        "attrs": {}, "outs": ["Out"]},
+    "box_coder": {"inputs": {
+        "PriorBox": np.array([[0., 0., 2., 2.]], "float32"),
+        "TargetBox": np.array([[1., 1., 3., 3.]], "float32")},
+        "attrs": {"code_type": "encode_center_size"}, "outs": ["OutputBox"]},
+    "box_clip": {"inputs": {
+        "Input": f32(1, 4, 4, scale=5),
+        "ImInfo": np.array([[8., 8., 1.]], "float32")},
+        "attrs": {}, "outs": ["Output"]},
+    "prior_box": {"inputs": {"Input": f32(1, 2, 3, 3),
+                             "Image": f32(1, 3, 12, 12)},
+                  "attrs": {"min_sizes": [4.0], "aspect_ratios": [1.0],
+                            "variances": [0.1, 0.1, 0.2, 0.2]},
+                  "outs": ["Boxes", "Variances"]},
+    "density_prior_box": {"inputs": {"Input": f32(1, 2, 3, 3),
+                                     "Image": f32(1, 3, 12, 12)},
+                          "attrs": {"fixed_sizes": [4.0],
+                                    "fixed_ratios": [1.0],
+                                    "densities": [1],
+                                    "variances": [0.1, 0.1, 0.2, 0.2]},
+                          "outs": ["Boxes", "Variances"]},
+    "anchor_generator": {"inputs": {"Input": f32(1, 2, 3, 3)},
+                         "attrs": {"anchor_sizes": [16.0],
+                                   "aspect_ratios": [1.0],
+                                   "stride": [4.0, 4.0]},
+                         "outs": ["Anchors", "Variances"]},
+    "multiclass_nms": {"inputs": {
+        "BBoxes": np.abs(f32(1, 4, 4, scale=3)),
+        "Scores": np.random.RandomState(7).rand(1, 2, 4).astype(
+            "float32")},
+        "attrs": {"keep_top_k": 3}, "outs": ["Out"],
+        "skip_finite": True},
+    "bipartite_match": {"inputs": {
+        "DistMat": np.random.RandomState(8).rand(3, 3).astype("float32")},
+        "attrs": {}, "outs": ["ColToRowMatchIndices"],
+        "skip_finite": True},
+    "polygon_box_transform": {"inputs": {"X": f32(1, 8, 2, 2)},
+                              "attrs": {}, "outs": ["Output"]},
+    "yolo_box": {"inputs": {"X": f32(1, 7, 2, 2),
+                            "ImgSize": np.array([[32, 32]], "int64")},
+                 "attrs": {"anchors": [2, 3], "class_num": 2,
+                           "conf_thresh": 0.01, "downsample": 16},
+                 "outs": ["Boxes", "Scores"]},
+    "yolov3_loss": {"inputs": {
+        "X": f32(1, 7, 4, 4),
+        "GTBox": np.array([[[0.5, 0.5, 0.3, 0.4]]], "float32"),
+        "GTLabel": np.array([[1]], "int64")},
+        "attrs": {"anchors": [10, 13], "class_num": 2},
+        "outs": ["Loss"]},
+    "roi_align": {"inputs": {
+        "X": f32(1, 2, 8, 8), "ROIs": np.array([[1., 1., 6., 6.]],
+                                               "float32")},
+        "attrs": {"pooled_height": 2, "pooled_width": 2}, "outs": ["Out"]},
+    "roi_pool": {"inputs": {
+        "X": f32(1, 2, 8, 8), "ROIs": np.array([[1., 1., 6., 6.]],
+                                               "float32")},
+        "attrs": {"pooled_height": 2, "pooled_width": 2}, "outs": ["Out"]},
+    "psroi_pool": {"inputs": {
+        "X": f32(1, 8, 6, 6), "ROIs": np.array([[1., 1., 5., 5.]],
+                                               "float32")},
+        "attrs": {"output_channels": 2, "pooled_height": 2,
+                  "pooled_width": 2}, "outs": ["Out"]},
+    "generate_proposals": {"inputs": {
+        "Scores": np.random.RandomState(9).rand(1, 2, 3, 3).astype(
+            "float32"),
+        "BboxDeltas": f32(1, 8, 3, 3, scale=0.1),
+        "ImInfo": np.array([[24., 24., 1.]], "float32"),
+        "Anchors": np.abs(f32(3, 3, 2, 4, scale=6))},
+        "attrs": {"post_nms_topN": 4}, "outs": ["RpnRois"],
+        "skip_finite": True},
+    "rpn_target_assign": {"inputs": {
+        "Anchor": np.abs(f32(6, 4, scale=8)),
+        "GtBoxes": np.abs(f32(1, 2, 4, scale=8))},
+        "attrs": {}, "outs": ["Labels", "BboxTargets"],
+        "skip_finite": True},
+    "generate_proposal_labels": {"inputs": {
+        "RpnRois": np.abs(f32(1, 6, 4, scale=8)),
+        "GtBoxes": np.abs(f32(1, 2, 4, scale=8)),
+        "GtClasses": i64(1, 2, lo=1, hi=3)},
+        "attrs": {"batch_size_per_im": 4}, "outs": ["Rois"],
+        "skip_finite": True},
+    "target_assign": {"inputs": {
+        "X": f32(1, 3, 4),
+        "MatchIndices": np.array([[0, -1, 2]], "int32")},
+        "attrs": {}, "outs": ["Out", "OutWeight"]},
+    "mine_hard_examples": {"inputs": {
+        "ClsLoss": np.abs(f32(1, 6)),
+        "MatchIndices": np.array([[0, -1, -1, 1, -1, -1]], "int32")},
+        "attrs": {}, "outs": ["NegIndices"], "skip_finite": True},
+    "detection_map": {"inputs": {
+        "DetectRes": np.array([[1., 0.9, 0., 0., 2., 2.],
+                               [1., 0.5, 4., 4., 6., 6.]], "float32"),
+        "Label": np.array([[1., 0., 0., 2., 2.]], "float32")},
+        "attrs": {}, "outs": ["MAP"]},
+
+    # --- quant / misc -----------------------------------------------------
+    "fake_quantize_abs_max": {"inputs": {"X": f32(3, 4)},
+                              "attrs": {"bit_length": 8},
+                              "outs": ["Out", "OutScale"]},
+    "fake_channel_wise_quantize_abs_max": {
+        "inputs": {"X": f32(3, 4)},
+        "attrs": {"bit_length": 8, "quant_axis": 0},
+        "outs": ["Out", "OutScale"]},
+    "fake_quantize_moving_average_abs_max": {
+        "inputs": {"X": f32(3, 4),
+                   "InScale": np.ones((), "float32")},
+        "attrs": {"bit_length": 8}, "outs": ["Out", "OutScale"]},
+    "fake_dequantize_max_abs": {
+        "inputs": {"X": f32(3, 4), "Scale": np.ones((1,), "float32")},
+        "attrs": {"max_range": 127.0}, "outs": ["Out"]},
+    "mean_iou" if False else "one_hot_v2" if False else "print": {
+        "inputs": {"X": f32(2, 2)}, "attrs": {"message": "sweep: "},
+        "outs": ["Out"]},
+    "lr_schedule": {"inputs": {"Step": np.array([3], "int64")},
+                    "attrs": {"kind": "exponential", "lr": 0.1,
+                              "decay_steps": 2, "decay_rate": 0.5,
+                              "staircase": False},
+                    "outs": ["Out"]},
+    "increment_loop_counter": {"inputs": {"X": np.array([1], "int64")},
+                               "attrs": {"step": 1}, "outs": ["Out"],
+                               "skip_finite": True},
+}
+
+# ops whose execution is validated by dedicated tests / harnesses, or that
+# are structural and cannot run standalone
+EXEMPT = {
+    "feed": "structural (executor implements)",
+    "fetch": "structural",
+    "data": "structural",
+    "autodiff": "structural pseudo-op (framework/backward.py tests)",
+    "while": "control flow — tests/test_control_flow.py",
+    "conditional_block": "control flow — tests/test_control_flow.py",
+    "scan": "control flow engine — tests/test_control_flow.py",
+    "static_rnn_scan": "control flow — tests/test_control_flow.py",
+    "delete_var": "documented no-op (XLA owns liveness)",
+    "fused_attention": "tests/test_pallas_kernels.py",
+    "c_allreduce_sum": "mesh collective — tests/test_parallel_executor.py",
+    "c_allreduce_max": "mesh collective",
+    "c_allreduce_mean": "mesh collective",
+    "c_allgather": "mesh collective",
+    "c_alltoall": "mesh collective",
+    "c_broadcast": "mesh collective",
+    "c_ppermute": "mesh collective",
+    "c_reducescatter": "mesh collective",
+    "c_sync_calc_stream": "mesh collective no-op",
+    "sgd": "optimizer — tests/test_models.py training",
+    "momentum": "optimizer — exercised via Optimizer tests",
+    "lars_momentum": "optimizer",
+    "adam": "optimizer — test_adam_state_signature_stable",
+    "adamw": "optimizer",
+    "adamax": "optimizer",
+    "adagrad": "optimizer",
+    "decayed_adagrad": "optimizer",
+    "adadelta": "optimizer",
+    "rmsprop": "optimizer",
+    "ftrl": "optimizer",
+    "lamb": "optimizer",
+    "proximal_gd": "optimizer",
+    "proximal_adagrad": "optimizer",
+    "average_accumulates": "optimizer (ModelAverage)",
+}
+
+
+def _materialize(v):
+    return v() if callable(v) else v
+
+
+def run_spec(op_type, spec):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        in_map, feeds = {}, {}
+        for slot, val in spec["inputs"].items():
+            vals = val if isinstance(val, list) else [val]
+            names = []
+            for k, arr in enumerate(vals):
+                arr = np.asarray(_materialize(arr))
+                name = f"in_{slot}_{k}"
+                block.create_var(name=name, shape=arr.shape,
+                                 dtype=str(arr.dtype), is_data=True)
+                feeds[name] = arr
+                names.append(name)
+            in_map[slot] = names
+        out_map, fetch = {}, []
+        counts = {}
+        for slot in spec["outs"]:
+            counts[slot] = counts.get(slot, 0) + 1
+        done = {}
+        for slot, cnt in counts.items():
+            names = []
+            for k in range(cnt):
+                name = f"out_{slot}_{k}"
+                block.create_var(name=name, dtype="float32")
+                names.append(name)
+                fetch.append(name)
+            out_map[slot] = names
+        block.append_op(op_type, in_map, out_map, spec.get("attrs", {}))
+    exe = pt.Executor(pt.CPUPlace())
+    outs = exe.run(main, feed=feeds, fetch_list=fetch)
+    return {n: v for n, v in zip(fetch, outs)}, feeds
+
+
+@pytest.mark.parametrize("op_type", sorted(SPECS))
+def test_op_smoke(op_type):
+    spec = SPECS[op_type]
+    outs, feeds = run_spec(op_type, spec)
+    for name, v in outs.items():
+        arr = np.asarray(v)
+        assert arr.size > 0 or op_type in ("is_empty",), \
+            f"{op_type}:{name} empty"
+        if not spec.get("skip_finite") and np.issubdtype(
+                arr.dtype, np.floating):
+            assert np.isfinite(arr).all(), f"{op_type}:{name} not finite"
+    ref = spec.get("ref")
+    if ref is not None:
+        ins = {slot: feeds[f"in_{slot}_0"] for slot in spec["inputs"]}
+        expect = np.asarray(ref(ins))
+        got = np.asarray(outs[f"out_{spec['outs'][0]}_0"])
+        np.testing.assert_allclose(
+            got.reshape(expect.shape).astype("float64"),
+            expect.astype("float64"), rtol=1e-4, atol=1e-5,
+            err_msg=f"{op_type} numpy mismatch")
+
+
+def test_registry_fully_covered():
+    """Every registered op is swept, exempted with a reason, or has a
+    dedicated test elsewhere (this is the gate that caught the dead RNN
+    family in round 1)."""
+    missing = [op for op in registered_ops()
+               if op not in SPECS and op not in EXEMPT]
+    assert not missing, f"ops with no test coverage: {missing}"
+
+
+# --------------------------------------------------------------------------
+# finite-difference gradient sweep for the differentiable core
+# --------------------------------------------------------------------------
+
+GRAD_CHECK = {
+    "exp": ("X", "Out"), "tanh": ("X", "Out"), "sigmoid": ("X", "Out"),
+    "log": ("X", "Out"), "sqrt": ("X", "Out"), "square": ("X", "Out"),
+    "softplus": ("X", "Out"), "gelu": ("X", "Out"),
+    "elementwise_add": ("X", "Out"), "elementwise_mul": ("X", "Out"),
+    "elementwise_div": ("X", "Out"), "elementwise_sub": ("Y", "Out"),
+    "mul": ("X", "Out"), "matmul": ("Y", "Out"), "bmm": ("X", "Out"),
+    "reduce_sum": ("X", "Out"), "reduce_mean": ("X", "Out"),
+    "softmax": ("X", "Out"), "log_softmax": ("X", "Out"),
+    "layer_norm": ("X", "Y"), "scale": ("X", "Out"),
+    "conv2d": ("Input", "Output"), "cos_sim": ("X", "Out"),
+    "sequence_conv": ("X", "Out"), "row_conv": ("X", "Out"),
+    "lstm": ("Input", "Hidden"), "gru": ("Input", "Hidden"),
+    "lstmp": ("Input", "Projection"),
+    "linear_chain_crf": ("Emission", "LogLikelihood"),
+    "warpctc": ("Logits", "Loss"),
+    "huber_loss": ("X", "Out"), "mse_loss": ("X", "Out"),
+    "smooth_l1_loss": ("X", "Out"),
+    "softmax_with_cross_entropy": ("Logits", "Loss"),
+    "sigmoid_cross_entropy_with_logits": ("X", "Out"),
+    "hierarchical_sigmoid": ("X", "Out"),
+    "bilinear_tensor_product": ("X", "Out"),
+    "conv_shift": ("X", "Out"), "dot": ("X", "Out"),
+    "prelu": ("X", "Out"), "pad": ("X", "Out"),
+    "cumsum": ("X", "Out"), "l1_norm": ("X", "Out"),
+    "squared_l2_norm": ("X", "Out"),
+}
+
+
+@pytest.mark.parametrize("op_type", sorted(GRAD_CHECK))
+def test_op_grad(op_type):
+    spec = SPECS[op_type]
+    in_slot, out_slot = GRAD_CHECK[op_type]
+
+    class T(OpTest):
+        pass
+
+    t = T()
+    T.op_type = op_type
+
+    def setup(self):
+        self.inputs = {k: _materialize(v)
+                       for k, v in spec["inputs"].items()}
+        self.attrs = dict(spec.get("attrs", {}))
+        self.outputs = {s: np.zeros(1, "float32") for s in spec["outs"]}
+
+    T.setup = setup
+    t.check_grad([in_slot], out_slot, max_relative_error=0.02)

@@ -1,0 +1,434 @@
+"""CRF/CTC family vs brute-force enumeration, sampled losses, and the
+misc op-census additions (ref operators/linear_chain_crf_op.cc,
+warpctc_op.cc, nce_op.cc, hierarchical_sigmoid_op.cc, ...), plus the
+label_semantic_roles-style CRF tagging model."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run_op(op_type, inputs, attrs, out_slots, place=None):
+    """Build + run a single op; returns dict slot -> np array."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        in_map, feeds = {}, {}
+        for slot, arr in inputs.items():
+            arr = np.asarray(arr)
+            name = f"in_{slot}"
+            block.create_var(name=name, shape=arr.shape,
+                             dtype=str(arr.dtype), is_data=True)
+            feeds[name] = arr
+            in_map[slot] = [name]
+        out_map = {}
+        for slot in out_slots:
+            name = f"out_{slot}"
+            block.create_var(name=name, dtype="float32")
+            out_map[slot] = [name]
+        block.append_op(op_type, in_map, out_map, attrs)
+    exe = pt.Executor(place or pt.CPUPlace())
+    vals = exe.run(main, feed=feeds,
+                   fetch_list=[f"out_{s}" for s in out_slots])
+    return dict(zip(out_slots, vals))
+
+
+# ---------------------------------------------------------------------------
+# CRF: brute force over all tag paths
+# ---------------------------------------------------------------------------
+
+def _crf_brute(em, trans, label=None):
+    """Returns (log_z, best_path, gold_score_fn)."""
+    T, N = em.shape
+    start, stop, w = trans[0], trans[1], trans[2:]
+
+    def path_score(path):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, T):
+            s += w[path[t - 1], path[t]] + em[t, path[t]]
+        return s + stop[path[-1]]
+
+    scores = {p: path_score(p)
+              for p in itertools.product(range(N), repeat=T)}
+    log_z = np.logaddexp.reduce(list(scores.values()))
+    best = max(scores, key=scores.get)
+    return log_z, best, path_score
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 4, 3
+    em = rng.randn(B, T, N).astype("float32")
+    trans = rng.randn(N + 2, N).astype("float32") * 0.5
+    label = rng.randint(0, N, (B, T)).astype("int64")
+    out = _run_op("linear_chain_crf",
+                  {"Emission": em, "Transition": trans, "Label": label},
+                  {}, ["LogLikelihood"])
+    for b in range(B):
+        log_z, _, path_score = _crf_brute(em[b].astype("float64"),
+                                          trans.astype("float64"))
+        expect = path_score(tuple(label[b])) - log_z
+        np.testing.assert_allclose(out["LogLikelihood"][b, 0], expect,
+                                   rtol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    B, T, N = 2, 4, 3
+    em = rng.randn(B, T, N).astype("float32")
+    trans = rng.randn(N + 2, N).astype("float32") * 0.5
+    out = _run_op("crf_decoding",
+                  {"Emission": em, "Transition": trans}, {},
+                  ["ViterbiPath"])
+    for b in range(B):
+        _, best, _ = _crf_brute(em[b].astype("float64"),
+                                trans.astype("float64"))
+        np.testing.assert_array_equal(out["ViterbiPath"][b], best)
+
+
+def test_crf_grad_flows():
+    """-mean(llh) trains the transition matrix (finite grads)."""
+    rng = np.random.RandomState(2)
+    B, T, N = 2, 3, 3
+    em_np = rng.randn(B, T, N).astype("float32")
+    label_np = rng.randint(0, N, (B, T)).astype("int64")
+    em = layers.data("em", [T, N], dtype="float32")
+    label = layers.data("lbl", [T], dtype="int64")
+    helper_block = pt.default_main_program().global_block()
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    helper = LayerHelper("crf")
+    trans = helper.create_parameter(None, shape=[N + 2, N],
+                                    dtype="float32")
+    llh = helper.create_variable_for_type_inference("float32")
+    helper_block.append_op(
+        "linear_chain_crf",
+        {"Emission": [em.name], "Transition": [trans.name],
+         "Label": [label.name]},
+        {"LogLikelihood": [llh.name]}, {})
+    loss = layers.mean(layers.scale(llh, scale=-1.0))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(5):
+        out, = exe.run(pt.default_main_program(),
+                       feed={"em": em_np, "lbl": label_np},
+                       fetch_list=[loss])
+        losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# CTC: brute force over alignments
+# ---------------------------------------------------------------------------
+
+def _ctc_brute(logp, label, blank=0):
+    """-log sum over all T-paths collapsing to `label`."""
+    T, C = logp.shape
+
+    def collapse(path):
+        out, prev = [], -1
+        for p in path:
+            if p != prev and p != blank:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(label):
+            total = np.logaddexp(total, sum(logp[t, p]
+                                            for t, p in enumerate(path)))
+    return -total
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(3)
+    B, T, C, S = 2, 4, 3, 2
+    logits = rng.randn(B, T, C).astype("float32")
+    label = np.array([[1, 2], [2, 1]], dtype="int64")
+    out = _run_op("warpctc", {"Logits": logits, "Label": label}, {},
+                  ["Loss"])
+    logp = logits.astype("float64")
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    for b in range(B):
+        expect = _ctc_brute(logp[b], label[b])
+        np.testing.assert_allclose(out["Loss"][b, 0], expect, rtol=1e-4)
+
+
+def test_warpctc_grad_trains():
+    rng = np.random.RandomState(4)
+    B, T, C = 2, 5, 4
+    x_np = rng.randn(B, T, C).astype("float32")
+    lbl_np = np.array([[1, 2, 3], [3, 1, 2]], dtype="int64")
+    x = layers.data("x", [T, C], dtype="float32")
+    lbl = layers.data("lbl", [3], dtype="int64")
+    h = layers.fc(x, size=C, num_flatten_dims=2)
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    helper = LayerHelper("ctc")
+    loss_var = helper.create_variable_for_type_inference("float32")
+    pt.default_main_program().global_block().append_op(
+        "warpctc", {"Logits": [h.name], "Label": [lbl.name]},
+        {"Loss": [loss_var.name]}, {"blank": 0})
+    loss = layers.mean(loss_var)
+    pt.optimizer.Adam(5e-2).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(6):
+        out, = exe.run(pt.default_main_program(),
+                       feed={"x": x_np, "lbl": lbl_np}, fetch_list=[loss])
+        losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_ctc_align():
+    x = np.array([[0, 1, 1, 0, 2, 2, 3],
+                  [1, 1, 0, 1, 0, 0, 0]], dtype="int32")
+    out = _run_op("ctc_align", {"Input": x}, {"blank": 0},
+                  ["Output"])["Output"]
+    np.testing.assert_array_equal(out[0][:3], [1, 2, 3])
+    assert (out[0][3:] == 0).all()
+    np.testing.assert_array_equal(out[1][:2], [1, 1])
+
+
+def test_chunk_eval_counts():
+    # IOB with 1 type: B=0, I=1, O=2
+    lab = np.array([[0, 1, 2, 0, 1, 1]], dtype="int64")
+    inf = np.array([[0, 1, 2, 0, 2, 2]], dtype="int64")  # 2nd chunk wrong
+    out = _run_op("chunk_eval", {"Inference": inf, "Label": lab},
+                  {"num_chunk_types": 1},
+                  ["Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"])
+    assert int(out["NumLabelChunks"][0]) == 2
+    assert int(out["NumInferChunks"][0]) == 2
+    assert int(out["NumCorrectChunks"][0]) == 1
+    np.testing.assert_allclose(out["Precision"][0], 0.5)
+    np.testing.assert_allclose(out["Recall"][0], 0.5)
+
+
+# ---------------------------------------------------------------------------
+# sampled losses
+# ---------------------------------------------------------------------------
+
+def test_hierarchical_sigmoid_is_distribution():
+    """exp(-cost(c)) over all classes sums to 1 (complete binary tree)."""
+    rng = np.random.RandomState(5)
+    D, num_classes = 6, 8
+    x = rng.randn(1, D).astype("float32")
+    w = rng.randn(num_classes - 1, D).astype("float32")
+    probs = []
+    for c in range(num_classes):
+        out = _run_op("hierarchical_sigmoid",
+                      {"X": x, "W": w,
+                       "Label": np.array([c], dtype="int64")},
+                      {"num_classes": num_classes}, ["Out"])
+        probs.append(np.exp(-out["Out"][0, 0]))
+    np.testing.assert_allclose(sum(probs), 1.0, rtol=1e-4)
+
+
+def test_nce_trains():
+    rng = np.random.RandomState(6)
+    B, D, N = 8, 16, 50
+    x_np = rng.randn(B, D).astype("float32")
+    lbl_np = rng.randint(0, N, (B, 1)).astype("int64")
+    x = layers.data("x", [D], dtype="float32")
+    lbl = layers.data("lbl", [1], dtype="int64")
+    h = layers.fc(x, size=D)
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    helper = LayerHelper("nce")
+    w = helper.create_parameter(None, shape=[N, D], dtype="float32")
+    cost = helper.create_variable_for_type_inference("float32")
+    pt.default_main_program().global_block().append_op(
+        "nce", {"Input": [h.name], "Weight": [w.name], "Label": [lbl.name]},
+        {"Cost": [cost.name]},
+        {"num_total_classes": N, "num_neg_samples": 5})
+    loss = layers.mean(cost)
+    pt.optimizer.Adam(1e-2).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(8):
+        out, = exe.run(pt.default_main_program(),
+                       feed={"x": x_np, "lbl": lbl_np}, fetch_list=[loss])
+        losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# the label_semantic_roles book tier: CRF tagging model
+# ---------------------------------------------------------------------------
+
+def test_crf_tagging_model_trains_and_decodes():
+    """ref tests/book/test_label_semantic_roles.py contract: BiGRU-class
+    encoder + CRF loss trains; Viterbi accuracy on the train batch
+    improves over training."""
+    rng = np.random.RandomState(7)
+    V, T, N, E = 30, 6, 4, 16
+    B = 8
+    words_np = rng.randint(0, V, (B, T)).astype("int64")
+    # synthetic rule: tag = word % N (learnable from embeddings)
+    label_np = (words_np % N).astype("int64")
+
+    words = layers.data("words", [T], dtype="int64")
+    label = layers.data("label", [T], dtype="int64")
+    emb = layers.embedding(words, size=[V, E])
+    feat = layers.fc(emb, size=N, num_flatten_dims=2)
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    helper = LayerHelper("crf")
+    trans = helper.create_parameter(pt.ParamAttr(name="crf_trans"),
+                                    shape=[N + 2, N], dtype="float32")
+    llh = helper.create_variable_for_type_inference("float32")
+    block = pt.default_main_program().global_block()
+    block.append_op("linear_chain_crf",
+                    {"Emission": [feat.name], "Transition": [trans.name],
+                     "Label": [label.name]},
+                    {"LogLikelihood": [llh.name]}, {})
+    loss = layers.mean(layers.scale(llh, scale=-1.0))
+    path = helper.create_variable_for_type_inference("int32")
+    block.append_op("crf_decoding",
+                    {"Emission": [feat.name], "Transition": [trans.name]},
+                    {"ViterbiPath": [path.name]}, {})
+    pt.optimizer.Adam(5e-2).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    accs, losses = [], []
+    for _ in range(15):
+        lo, p = exe.run(pt.default_main_program(),
+                        feed={"words": words_np, "label": label_np},
+                        fetch_list=[loss, path])
+        losses.append(float(lo))
+        accs.append(float((p == label_np).mean()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert accs[-1] > accs[0]
+    assert accs[-1] > 0.8
+
+
+# ---------------------------------------------------------------------------
+# misc census additions
+# ---------------------------------------------------------------------------
+
+def test_unique_and_counts():
+    x = np.array([5, 3, 5, 7, 3, 3], dtype="int32")
+    out = _run_op("unique_with_counts", {"X": x}, {},
+                  ["Out", "Index", "Count", "UniqueCount"])
+    assert int(out["UniqueCount"][0]) == 3
+    np.testing.assert_array_equal(out["Out"][:3], [3, 5, 7])
+    # index maps each element to its unique slot
+    np.testing.assert_array_equal(out["Out"][out["Index"].astype(int)], x)
+
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(8)
+    B, T, D, M, CL = 2, 5, 3, 4, 3
+    x = rng.randn(B, T, D).astype("float32")
+    w = rng.randn(CL * D, M).astype("float32")
+    out = _run_op("sequence_conv", {"X": x, "Filter": w},
+                  {"contextLength": CL, "contextStart": -1}, ["Out"])
+    expect = np.zeros((B, T, M))
+    xp = np.pad(x, ((0, 0), (1, 1), (0, 0)))
+    for t in range(T):
+        ctxwin = xp[:, t:t + CL].reshape(B, -1)
+        expect[:, t] = ctxwin @ w
+    np.testing.assert_allclose(out["Out"], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_split_merge_ids_round_trip():
+    ids = np.array([3, 4, 5, 9, 12], dtype="int64")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        block = main.global_block()
+        block.create_var(name="ids", shape=ids.shape, dtype="int64",
+                         is_data=True)
+        for i in range(3):
+            block.create_var(name=f"s{i}", dtype="int64")
+        block.append_op("split_ids", {"Ids": ["ids"]},
+                        {"Out": ["s0", "s1", "s2"]}, {"num_shards": 3})
+    exe = pt.Executor(pt.CPUPlace())
+    s = exe.run(main, feed={"ids": ids}, fetch_list=["s0", "s1", "s2"])
+    for i in range(3):
+        owned = s[i][s[i] >= 0]
+        assert all(v % 3 == i for v in owned)
+    # merged positions reconstruct the original ids
+    merged = np.maximum.reduce(s)
+    np.testing.assert_array_equal(merged, ids)
+
+
+def test_merge_selected_rows_sums_duplicates():
+    ids = np.array([2, 0, 2, 1], dtype="int64")
+    vals = np.arange(8, dtype="float32").reshape(4, 2)
+    out = _run_op("merge_selected_rows", {"Ids": ids, "Values": vals},
+                  {}, ["OutIds", "Out"])
+    np.testing.assert_array_equal(out["OutIds"][:3], [0, 1, 2])
+    np.testing.assert_allclose(out["Out"][2], vals[0] + vals[2])
+
+
+def test_get_tensor_from_selected_rows():
+    ids = np.array([1, 3], dtype="int64")
+    vals = np.array([[1., 2.], [3., 4.]], dtype="float32")
+    out = _run_op("get_tensor_from_selected_rows",
+                  {"Ids": ids, "Values": vals}, {"height": 5}, ["Out"])
+    assert out["Out"].shape == (5, 2)
+    np.testing.assert_allclose(out["Out"][1], [1, 2])
+    np.testing.assert_allclose(out["Out"][3], [3, 4])
+    assert (out["Out"][[0, 2, 4]] == 0).all()
+
+
+def test_cudnn_lstm_matches_reference_loop():
+    rng = np.random.RandomState(9)
+    B, T, D, H = 2, 4, 3, 5
+    x = rng.randn(B, T, D).astype("float32")
+    n_w = D * 4 * H + H * 4 * H + 4 * H
+    w = (rng.randn(n_w) * 0.5).astype("float32")
+    out = _run_op("cudnn_lstm", {"Input": x, "W": w},
+                  {"hidden_size": H, "num_layers": 1}, ["Out"])["Out"]
+    # numpy single-layer reference
+    wx = w[:D * 4 * H].reshape(D, 4 * H)
+    wh = w[D * 4 * H:D * 4 * H + H * 4 * H].reshape(H, 4 * H)
+    b = w[D * 4 * H + H * 4 * H:]
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((B, H))
+    c = np.zeros((B, H))
+    expect = np.zeros((B, T, H))
+    for t in range(T):
+        g = x[:, t] @ wx + h @ wh + b
+        i, f, gg, o = np.split(g, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        expect[:, t] = h
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_shapes_and_center():
+    x = np.zeros((1, 1, 8, 8), dtype="float32")
+    x[0, 0, 2:6, 2:6] = 1.0
+    rois = np.array([[2., 2., 6., 6.]], dtype="float32")
+    out = _run_op("roi_align", {"X": x, "ROIs": rois},
+                  {"pooled_height": 2, "pooled_width": 2,
+                   "spatial_scale": 1.0}, ["Out"])["Out"]
+    assert out.shape == (1, 1, 2, 2)
+    assert out.min() > 0.5     # entirely inside the bright square
+
+
+def test_generate_proposals_shapes():
+    rng = np.random.RandomState(10)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype("float32")
+    deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype("float32")
+    im_info = np.array([[32., 32., 1.]], dtype="float32")
+    anchors = (rng.rand(H, W, A, 4) * 16).astype("float32")
+    anchors[..., 2:] += 8
+    out = _run_op("generate_proposals",
+                  {"Scores": scores, "BboxDeltas": deltas,
+                   "ImInfo": im_info, "Anchors": anchors},
+                  {"post_nms_topN": 5, "pre_nms_topN": 20},
+                  ["RpnRois", "RpnRoiProbs"])
+    assert out["RpnRois"].shape == (1, 5, 4)
+    assert out["RpnRoiProbs"].shape == (1, 5)
