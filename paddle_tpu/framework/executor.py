@@ -132,11 +132,15 @@ def run_ops_in_env(ctx, env: Dict[str, Any], ops) -> Dict[str, Any]:
 def _check_op_outputs_finite(op, outs):
     """Per-op NaN/Inf localization (ref operator.cc:829) — only effective
     when the values are concrete (the executor runs the program eagerly
-    under FLAGS_check_nan_inf_per_op; traced values are skipped)."""
+    under FLAGS_check_nan_inf_per_op; traced values are skipped).
+    NaNs born inside the backward re-trace surface at the `autodiff`
+    pseudo-op, whose outputs (the named grad vars) are concrete here —
+    so a gradient NaN is attributed to autodiff + the grad var name, not
+    to a forward op."""
     for slot, vals in outs.items():
         for name, v in zip(op.outputs.get(slot, []), vals):
             if isinstance(v, jax.core.Tracer):
-                return
+                continue
             try:
                 arr = np.asarray(v)
             except Exception:

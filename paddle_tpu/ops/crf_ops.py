@@ -235,8 +235,8 @@ def _chunk_eval(ctx, ins, attrs):
     """Chunk-level precision/recall/F1 for IOB tagging (ref
     chunk_eval_op.cc, plain IOB scheme).  Inference/Label [B,T] int tag
     ids laid out as the reference's IOB: tag = chunk_type * 2 (+0 for B,
-    +1 for I); num_chunk_types attr; `excluded_chunk_types` ignored tags.
-    Optional Mask [B,T]."""
+    +1 for I); num_chunk_types attr; `excluded_chunk_types` chunk types
+    are remapped to Outside before counting.  Optional Mask [B,T]."""
     inf = single_input(ins, "Inference")
     lab = single_input(ins, "Label")
     if inf.ndim == 3:
@@ -249,6 +249,9 @@ def _chunk_eval(ctx, ins, attrs):
             else jnp.ones(inf.shape, jnp.bool_))
     n_types = int(attrs["num_chunk_types"])
     outside = 2 * n_types     # ids >= 2*num_chunk_types are Outside
+    for ex in attrs.get("excluded_chunk_types", []) or []:
+        inf = jnp.where(inf // 2 == int(ex), outside, inf)
+        lab = jnp.where(lab // 2 == int(ex), outside, lab)
 
     def chunk_starts(tags):
         typ = tags // 2

@@ -20,19 +20,29 @@ import numpy as np
 from ..framework.registry import register_op, single_input
 
 
+def _iou_matrix(a, b):
+    """Pairwise IoU of xyxy boxes a [N,4] vs b [M,4] -> [N,M].
+
+    The single implementation behind iou_similarity, rpn_target_assign,
+    generate_proposal_labels and detection_map (degenerate boxes clamp
+    to zero area; epsilon guards empty unions).
+    """
+    area = lambda v: jnp.maximum(v[:, 2] - v[:, 0], 0) * jnp.maximum(
+        v[:, 3] - v[:, 1], 0)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
 @register_op("iou_similarity", stop_gradient=True)
 def _iou_similarity(ctx, ins, attrs):
     x = single_input(ins)          # (N, 4) xmin,ymin,xmax,ymax
     y = single_input(ins, "Y")     # (M, 4)
-    area = lambda b: jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
-        b[:, 3] - b[:, 1], 0)
-    ix1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
-    iy1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
-    ix2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
-    iy2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
-    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
-    union = area(x)[:, None] + area(y)[None, :] - inter
-    return {"Out": [inter / jnp.maximum(union, 1e-10)]}
+    return {"Out": [_iou_matrix(x, y)]}
 
 
 @register_op("box_coder", stop_gradient=True)
@@ -359,6 +369,12 @@ def _affine_grid(ctx, ins, attrs):
     theta = single_input(ins, "Theta").astype(jnp.float32)
     if ins.get("OutputShape"):
         shp = ins["OutputShape"][0]
+        if isinstance(shp, jax.core.Tracer):
+            from ..core.enforce import EnforceNotMet
+            raise EnforceNotMet(
+                "affine_grid: OutputShape must be a trace-time constant "
+                "under the jitted executor (grid dims set the output "
+                "shape); pass the static `output_shape` attr instead")
         n, _, h, w = [int(v) for v in np.asarray(shp)]
     else:
         n, _, h, w = attrs["output_shape"]
@@ -547,17 +563,7 @@ def _rpn_target_assign(ctx, ins, attrs):
     def one_image(key, gtb):
         valid_gt = gtb[:, 2] > gtb[:, 0]
         ax1, ay1, ax2, ay2 = anchor.T
-        area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
-        gx1, gy1, gx2, gy2 = gtb.T
-        area_g = jnp.maximum(gx2 - gx1, 0) * jnp.maximum(gy2 - gy1, 0)
-        ix1 = jnp.maximum(ax1[:, None], gx1[None, :])
-        iy1 = jnp.maximum(ay1[:, None], gy1[None, :])
-        ix2 = jnp.minimum(ax2[:, None], gx2[None, :])
-        iy2 = jnp.minimum(ay2[:, None], gy2[None, :])
-        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
-        iou = inter / jnp.maximum(
-            area_a[:, None] + area_g[None, :] - inter, 1e-10)
-        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        iou = jnp.where(valid_gt[None, :], _iou_matrix(anchor, gtb), -1.0)
         best_gt = jnp.argmax(iou, axis=1)
         best_iou = jnp.max(iou, axis=1)
         labels = jnp.full((A,), -1, jnp.int32)
@@ -581,6 +587,22 @@ def _rpn_target_assign(ctx, ins, attrs):
         tw = jnp.log(gw / aw)
         th = jnp.log(gh / ah)
         targets = jnp.stack([tx, ty, tw, th], axis=1)
+        # subsample to rpn_batch_size_per_im at rpn_fg_fraction positives
+        # (ref behavior); excess anchors are set back to -1 (ignored).
+        # Static shapes: rank anchors by a random draw and keep the first
+        # fg_cap / bg_cap of each class.
+        batch = int(attrs.get("rpn_batch_size_per_im", 256))
+        fg_cap = int(batch * float(attrs.get("rpn_fg_fraction", 0.5)))
+        kpos, kneg = jax.random.split(key)
+        pos = labels == 1
+        r = jax.random.uniform(kpos, (A,))
+        pos_rank = jnp.argsort(jnp.argsort(jnp.where(pos, r, 2.0)))
+        labels = jnp.where(pos & (pos_rank >= fg_cap), -1, labels)
+        n_fg = jnp.minimum(jnp.sum(pos), fg_cap)
+        neg = labels == 0
+        r2 = jax.random.uniform(kneg, (A,))
+        neg_rank = jnp.argsort(jnp.argsort(jnp.where(neg, r2, 2.0)))
+        labels = jnp.where(neg & (neg_rank >= batch - n_fg), -1, labels)
         return labels, targets
 
     keys = jax.random.split(ctx.rng(), N)
@@ -593,15 +615,24 @@ def _rpn_target_assign(ctx, ins, attrs):
 @register_op("detection_map", stop_gradient=True)
 def _detection_map(ctx, ins, attrs):
     """ref detection_map_op.cc, integral mAP over dense inputs:
-    Detection [M,6] rows (label, score, x1, y1, x2, y2; label<0 pads),
-    GtLabel [G,1], GtBox [G,4] (dense single-image or pre-flattened
-    batch with -1 pads).  Output MAP [1]."""
+    Detection [M,6] rows (label, score, x1, y1, x2, y2; label<0 pads);
+    ground truth either as Label [G,5] rows (label, x1, y1, x2, y2) or
+    as Label [G,1] + separate GtBox [G,4] (dense single-image or
+    pre-flattened batch with -1 pads).  Output MAP [1]."""
     det = single_input(ins, "DetectRes").astype(jnp.float32)
     gt_label = single_input(ins, "Label").astype(jnp.float32)
     overlap = float(attrs.get("overlap_threshold", 0.5))
     # gt rows: (label, x1, y1, x2, y2)
     g_lbl = gt_label[:, 0]
-    g_box = gt_label[:, 1:5] if gt_label.shape[1] >= 5 else None
+    if gt_label.shape[1] >= 5:
+        g_box = gt_label[:, 1:5]
+    elif ins.get("GtBox"):
+        g_box = ins["GtBox"][0].astype(jnp.float32).reshape(-1, 4)
+    else:
+        from ..core.enforce import EnforceNotMet
+        raise EnforceNotMet(
+            "detection_map needs boxes: pass Label as [G,5] "
+            "(label,x1,y1,x2,y2) or provide a GtBox [G,4] input")
     valid_gt = g_lbl >= 0
     d_lbl, d_score, d_box = det[:, 0], det[:, 1], det[:, 2:6]
     valid_d = d_lbl >= 0
@@ -612,15 +643,7 @@ def _detection_map(ctx, ins, attrs):
     G = gt_label.shape[0]
 
     def iou_row(b):
-        ix1 = jnp.maximum(b[0], g_box[:, 0])
-        iy1 = jnp.maximum(b[1], g_box[:, 1])
-        ix2 = jnp.minimum(b[2], g_box[:, 2])
-        iy2 = jnp.minimum(b[3], g_box[:, 3])
-        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
-        ab = jnp.maximum(b[2] - b[0], 0) * jnp.maximum(b[3] - b[1], 0)
-        ag = jnp.maximum(g_box[:, 2] - g_box[:, 0], 0) * jnp.maximum(
-            g_box[:, 3] - g_box[:, 1], 0)
-        return inter / jnp.maximum(ab + ag - inter, 1e-10)
+        return _iou_matrix(b[None, :], g_box)[0]
 
     def body(carry, i):
         used, tp, fp = carry
@@ -636,14 +659,25 @@ def _detection_map(ctx, ins, attrs):
 
     init = (jnp.zeros((G,), bool), jnp.zeros((M,)), jnp.zeros((M,)))
     (used, tp, fp), _ = jax.lax.scan(body, init, jnp.arange(M))
-    ctp = jnp.cumsum(tp)
-    cfp = jnp.cumsum(fp)
-    n_gt = jnp.maximum(jnp.sum(valid_gt.astype(jnp.float32)), 1.0)
-    recall = ctp / n_gt
+    # Per-class integral AP, averaged over classes that have ground truth
+    # (VOC mAP).  Detections are globally score-sorted, so the same-class
+    # prefix sums below walk each class's PR curve in score order.
+    same_cls = (d_lbl[None, :] == d_lbl[:, None]) & (
+        jnp.arange(M)[None, :] <= jnp.arange(M)[:, None])
+    ctp = jnp.sum(jnp.where(same_cls, tp[None, :], 0.0), axis=1)
+    cfp = jnp.sum(jnp.where(same_cls, fp[None, :], 0.0), axis=1)
     precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
-    # integral AP: sum precision at each new tp
-    ap = jnp.sum(jnp.where(tp > 0, precision, 0.0)) / n_gt
-    return {"MAP": [ap.reshape(1)], "AccumPosCount": [ctp],
+    # gt count for the class of detection i
+    n_gt_of = jnp.sum((g_lbl[None, :] == d_lbl[:, None])
+                      & valid_gt[None, :], axis=1).astype(jnp.float32)
+    terms = jnp.where(tp > 0, precision / jnp.maximum(n_gt_of, 1.0), 0.0)
+    # number of distinct classes present in the ground truth
+    gs = jnp.sort(jnp.where(valid_gt, g_lbl, jnp.inf))
+    first = jnp.concatenate([jnp.ones((1,), bool), gs[1:] != gs[:-1]])
+    n_classes = jnp.sum(jnp.where(jnp.isfinite(gs), first, False)
+                        .astype(jnp.float32))
+    ap = jnp.sum(terms) / jnp.maximum(n_classes, 1.0)
+    return {"MAP": [ap.reshape(1)], "AccumPosCount": [jnp.cumsum(tp)],
             "AccumTruePos": [tp], "AccumFalsePos": [fp]}
 
 
@@ -748,17 +782,7 @@ def _generate_proposal_labels(ctx, ins, attrs):
 
     def one(roi, gtb, gtc):
         valid_gt = gtb[:, 2] > gtb[:, 0]
-        ix1 = jnp.maximum(roi[:, None, 0], gtb[None, :, 0])
-        iy1 = jnp.maximum(roi[:, None, 1], gtb[None, :, 1])
-        ix2 = jnp.minimum(roi[:, None, 2], gtb[None, :, 2])
-        iy2 = jnp.minimum(roi[:, None, 3], gtb[None, :, 3])
-        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
-        ar = jnp.maximum(roi[:, 2] - roi[:, 0], 0) * jnp.maximum(
-            roi[:, 3] - roi[:, 1], 0)
-        ag = jnp.maximum(gtb[:, 2] - gtb[:, 0], 0) * jnp.maximum(
-            gtb[:, 3] - gtb[:, 1], 0)
-        iou = inter / jnp.maximum(ar[:, None] + ag[None] - inter, 1e-10)
-        iou = jnp.where(valid_gt[None], iou, -1.0)
+        iou = jnp.where(valid_gt[None], _iou_matrix(roi, gtb), -1.0)
         best = jnp.max(iou, axis=1)
         bgt = jnp.argmax(iou, axis=1)
         fg = best >= fg_thr
@@ -834,11 +858,33 @@ def _yolov3_loss(ctx, ins, attrs):
                  + jnp.square(pw_g[:, 0] - tw)
                  + jnp.square(pw_g[:, 1] - th))
         box_loss = jnp.sum(jnp.where(valid, box_l, 0.0))
-        # objectness BCE everywhere
+        # objectness BCE everywhere, except cells whose predicted box
+        # overlaps some gt above ignore_thresh (ref semantics: such
+        # duplicate-quality predictions are ignored, not pushed to 0)
+        ci = (jnp.arange(W, dtype=jnp.float32))[None, None, :]
+        cj = (jnp.arange(H, dtype=jnp.float32))[None, :, None]
+        pcx = (px[:, 0] + ci) / W
+        pcy = (px[:, 1] + cj) / H
+        pbw = jnp.exp(jnp.clip(pw[:, 0], -10, 10)) * aw[:, None, None]
+        pbh = jnp.exp(jnp.clip(pw[:, 1], -10, 10)) * ah[:, None, None]
+
+        def iou_vs_gt(g):
+            ix = (jnp.minimum(pcx + pbw / 2, g[0] + g[2] / 2)
+                  - jnp.maximum(pcx - pbw / 2, g[0] - g[2] / 2))
+            iy = (jnp.minimum(pcy + pbh / 2, g[1] + g[3] / 2)
+                  - jnp.maximum(pcy - pbh / 2, g[1] - g[3] / 2))
+            inter_g = jnp.maximum(ix, 0) * jnp.maximum(iy, 0)
+            return inter_g / jnp.maximum(
+                pbw * pbh + g[2] * g[3] - inter_g, 1e-10)
+
+        ious = jax.vmap(iou_vs_gt)(gtb)          # [G, A, H, W]
+        best = jnp.max(jnp.where(valid[:, None, None, None], ious, 0.0),
+                       axis=0)
+        obj_w = jnp.where((best > ignore_thresh) & (obj_t == 0.0), 0.0, 1.0)
         z = pobj
         obj_bce = jnp.maximum(z, 0) - z * obj_t + jnp.log1p(
             jnp.exp(-jnp.abs(z)))
-        obj_loss = jnp.sum(obj_bce)
+        obj_loss = jnp.sum(obj_bce * obj_w)
         # class BCE at assigned cells
         pc = pcls[best_a, :, gj, gi]
         onehot = jax.nn.one_hot(gtl, class_num)
@@ -850,3 +896,72 @@ def _yolov3_loss(ctx, ins, attrs):
     losses = jax.vmap(one)(pred_xy, pred_wh, pred_obj, pred_cls,
                            gt_box, gt_label)
     return {"Loss": [losses]}
+
+
+@register_op("roi_perspective_transform")
+def _roi_perspective_transform(ctx, ins, attrs):
+    """ref detection/roi_perspective_transform_op.cc: warp a quadrilateral
+    RoI (8 coords: x1,y1,...,x4,y4 clockwise from top-left) into a
+    transformed_height x transformed_width rectangle with bilinear
+    sampling.  Homography solved per RoI via an 8x8 linear system (the
+    classic getPerspectiveTransform), vmapped over RoIs — no scalar loops,
+    so XLA batches the solves and the gathers tile onto the VPU."""
+    x = single_input(ins, "X")
+    rois = single_input(ins, "ROIs").reshape(-1, 8)
+    batch_idx = (ins["BatchIdx"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("BatchIdx")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    th = int(attrs.get("transformed_height", 8))
+    tw = int(attrs.get("transformed_width", 8))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    _, C, H, W = x.shape
+
+    def one(quad, b):
+        # src quad corners (feature-map coords), dst rect corners
+        sx = quad[0::2] * scale
+        sy = quad[1::2] * scale
+        dx = jnp.asarray([0.0, tw - 1.0, tw - 1.0, 0.0])
+        dy = jnp.asarray([0.0, 0.0, th - 1.0, th - 1.0])
+        # solve for H mapping dst -> src: [x',y',1] ~ M @ [x,y,1]
+        rows = []
+        for i in range(4):
+            rows.append(jnp.stack([dx[i], dy[i], 1.0, 0.0, 0.0, 0.0,
+                                   -dx[i] * sx[i], -dy[i] * sx[i]]))
+            rows.append(jnp.stack([0.0, 0.0, 0.0, dx[i], dy[i], 1.0,
+                                   -dx[i] * sy[i], -dy[i] * sy[i]]))
+        A = jnp.stack(rows)
+        rhs = jnp.stack([sx[0], sy[0], sx[1], sy[1],
+                         sx[2], sy[2], sx[3], sy[3]])
+        h8 = jnp.linalg.solve(A + 1e-8 * jnp.eye(8), rhs)
+        M = jnp.concatenate([h8, jnp.ones((1,))]).reshape(3, 3)
+        gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(gx)
+        src = M @ jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])
+        sxp = src[0] / (src[2] + 1e-8)
+        syp = src[1] / (src[2] + 1e-8)
+        # bilinear sample, zero outside
+        x0 = jnp.floor(sxp)
+        y0 = jnp.floor(syp)
+        wx = sxp - x0
+        wy = syp - y0
+        valid = ((sxp >= 0) & (sxp <= W - 1) & (syp >= 0) & (syp <= H - 1))
+        x0i = jnp.clip(x0, 0, W - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+        y0i = jnp.clip(y0, 0, H - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+        img = x[b]  # [C,H,W]
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        val = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+               + v10 * (1 - wx) * wy + v11 * wx * wy)
+        val = jnp.where(valid[None, :], val, 0.0)
+        return val.reshape(C, th, tw), valid.reshape(th, tw), M
+
+    outs, masks, mats = jax.vmap(one)(rois, batch_idx)
+    return {"Out": [outs.astype(x.dtype)],
+            "Mask": [masks.astype(jnp.int32)],
+            "TransformMatrix": [mats]}

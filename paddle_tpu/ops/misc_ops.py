@@ -252,6 +252,7 @@ def _cudnn_lstm(ctx, ins, attrs):
         return v
 
     seq = jnp.swapaxes(x, 0, 1)                     # [T,B,·]
+    last_hs, last_cs = [], []
     for l in range(L):
         din = D if l == 0 else H * ndir
         outs = []
@@ -262,12 +263,15 @@ def _cudnn_lstm(ctx, ins, attrs):
             s = seq[::-1] if d == 1 else seq
             xp = s @ wx + b
             h0 = jnp.zeros((B, H), x.dtype)
-            (_, _), hs = _lstm_scan(xp, wh, h0, h0)
+            (h_T, c_T), hs = _lstm_scan(xp, wh, h0, h0)
             outs.append(hs[::-1] if d == 1 else hs)
+            last_hs.append(h_T)
+            last_cs.append(c_T)
         seq = jnp.concatenate(outs, axis=-1) if bidi else outs[0]
     out = jnp.swapaxes(seq, 0, 1)
-    last_h = out[:, -1, :]
-    return {"Out": [out], "LastH": [last_h], "LastC": [last_h]}
+    # cudnn convention: [num_layers*ndir, B, H]
+    return {"Out": [out], "LastH": [jnp.stack(last_hs)],
+            "LastC": [jnp.stack(last_cs)]}
 
 
 # -- pooling / conv 3d -----------------------------------------------------
@@ -290,7 +294,13 @@ def _pool3d(ctx, ins, attrs):
     else:
         summed = lax.reduce_window(x, 0.0, lax.add,
                                    (1, 1) + k, (1, 1) + s, pad)
-        out = summed / float(np.prod(k))
+        if attrs.get("exclusive", True) and any(pi != 0 for pi in p):
+            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+            counts = lax.reduce_window(ones, 0.0, lax.add,
+                                       (1, 1) + k, (1, 1) + s, pad)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(k))
     return {"Out": [out]}
 
 
@@ -370,7 +380,6 @@ def _merge_selected_rows(ctx, ins, attrs):
     values are segment-summed."""
     ids = single_input(ins, "Ids").reshape(-1).astype(jnp.int32)
     vals = single_input(ins, "Values")
-    from .misc_ops import _unique_static
     uniq, index, _, n_uniq = _unique_static(ids)
     summed = jnp.zeros((ids.shape[0],) + vals.shape[1:],
                        vals.dtype).at[index].add(vals)
@@ -515,3 +524,49 @@ def _unpool(ctx, ins, attrs):
         jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
         idx.reshape(n, c, -1)].add(x.reshape(n, c, -1))
     return {"Out": [out.reshape(n, c, uh, uw)]}
+
+
+@register_op("lod_array_length", stop_gradient=True)
+def _lod_array_length(ctx, ins, attrs):
+    """ref lod_array_length_op.cc: number of entries in a tensor array.
+    Dense: the 'array' is the op's X input list, so the length is static."""
+    return {"Out": [jnp.asarray([len(ins["X"])], jnp.int64)]}
+
+
+@register_op("lod_tensor_to_array", stop_gradient=True)
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """ref lod_tensor_to_array_op.cc: slice a batch into per-timestep
+    entries ordered by the rank table.  Dense redesign: X is [B, T, ...];
+    rows are permuted into rank order (longest first) and each timestep
+    becomes one output entry [B, ...].  The inverse is array_to_lod_tensor."""
+    x = single_input(ins, "X")
+    order = single_input(ins, "RankTable").reshape(-1).astype(jnp.int32)
+    xs = jnp.take(x, order, axis=0)
+    return {"Out": [xs[:, t] for t in range(x.shape[1])]}
+
+
+@register_op("array_to_lod_tensor", stop_gradient=True)
+def _array_to_lod_tensor(ctx, ins, attrs):
+    """ref array_to_lod_tensor_op.cc: stack per-timestep entries back to a
+    [B, T, ...] batch and undo the rank-table permutation (inverse of
+    lod_tensor_to_array under the dense contract)."""
+    xs = ins["X"]
+    order = single_input(ins, "RankTable").reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(xs, axis=1)
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=jnp.int32))
+    return {"Out": [jnp.take(stacked, inv, axis=0)]}
+
+
+@register_op("shrink_rnn_memory")
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """ref shrink_rnn_memory_op.cc: at step I keep only the rows whose
+    sequence is still active.  The reference slices to the first k rows of
+    the rank-sorted batch; the dense static-shape redesign keeps [B, ...]
+    and zero-masks finished rows (RankTable = lengths sorted desc, i.e.
+    the Lengths output of lod_rank_table)."""
+    x = single_input(ins, "X")
+    lens = single_input(ins, "RankTable").reshape(-1).astype(jnp.int32)
+    step = single_input(ins, "I").reshape(()).astype(jnp.int32)
+    active = (lens > step).astype(x.dtype)
+    return {"Out": [x * active[(slice(None),) + (None,) * (x.ndim - 1)]]}

@@ -165,21 +165,20 @@ def _pool2d(ctx, ins, attrs):
     return {"Out": [out.astype(x.dtype)]}
 
 
-@register_op("pool2d_with_index")
-def _pool2d_with_index(ctx, ins, attrs):
-    """max_pool2d_with_index (ref pool_with_index_op.cc): also returns the
-    flat spatial argmax index per window."""
-    x = single_input(ins)
-    out = _pool2d(ctx, ins, dict(attrs, pooling_type="max"))["Out"][0]
-    n, c, h, w = x.shape
-    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+def _max_pool_with_index(x, attrs, nd):
+    """Shared rank-parameterized max pool that also carries the flat
+    spatial argmax index through a (value, index) reduce_window
+    (ref pool_with_index_op.cc; serves the 2-D and 3-D registrations)."""
+    ksize = _pair(attrs["ksize"], nd)
+    strides = _pair(attrs.get("strides", 1), nd)
+    p = _pair(attrs.get("paddings", 0), nd)
+    spatial = x.shape[2:]
+    flat_idx = jnp.arange(int(np.prod(spatial)),
+                          dtype=jnp.float32).reshape((1, 1) + spatial)
     flat_idx = jnp.broadcast_to(flat_idx, x.shape)
-    ksize = _pair(attrs["ksize"])
-    strides = _pair(attrs.get("strides", 1))
-    p = _pair(attrs.get("paddings", 0))
     window = (1, 1) + tuple(ksize)
     strides_full = (1, 1) + tuple(strides)
-    pads_full = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    pads_full = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
 
     def select(a, b):
         av, ai = a
@@ -187,11 +186,17 @@ def _pool2d_with_index(ctx, ins, attrs):
         take_b = bv > av
         return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
 
-    neg = jnp.full_like(x, -jnp.inf)
-    (vals, idxs) = jax.lax.reduce_window(
+    vals, idxs = jax.lax.reduce_window(
         (x, flat_idx), (-jnp.inf, 0.0),
         lambda a, b: select(a, b), window, strides_full, pads_full)
-    return {"Out": [vals], "Mask": [idxs.astype(jnp.int64)]}
+    return {"Out": [vals.astype(x.dtype)], "Mask": [idxs.astype(jnp.int64)]}
+
+
+@register_op("pool2d_with_index")
+def _pool2d_with_index(ctx, ins, attrs):
+    """max_pool2d_with_index (ref pool_with_index_op.cc): also returns the
+    flat spatial argmax index per window."""
+    return _max_pool_with_index(single_input(ins), attrs, 2)
 
 
 @register_op("batch_norm")
@@ -495,3 +500,17 @@ def _pad3d(ctx, ins, attrs):
     pads = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
     return {"Out": [jnp.pad(x, pads,
                             constant_values=attrs.get("value", 0.0))]}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """Reference-registered name for pool2d_with_index
+    (ref pool_with_index_op.cc registers max_pool2d_with_index)."""
+    return _pool2d_with_index(ctx, ins, attrs)
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """ref pool_with_index_op.cc (3-D): max pool over NCDHW windows plus
+    the flat spatial argmax index per window."""
+    return _max_pool_with_index(single_input(ins), attrs, 3)

@@ -187,7 +187,7 @@ SPECS = {
                         "ref": lambda i: (i["X"] ** 2).sum()},
     "squared_l2_distance": {"inputs": {"X": f32(4, 6), "Y": f32(4, 6)},
                             "attrs": {}, "outs": ["Out", "sub_result"]},
-    "frobenius_norm" if "frobenius_norm" in [] else "dot":
+    "dot":
         {"inputs": {"X": f32(3, 4), "Y": f32(3, 4)}, "attrs": {},
          "outs": ["Out"],
          "ref": lambda i: (i["X"] * i["Y"]).sum(-1, keepdims=True)},
@@ -425,7 +425,7 @@ SPECS = {
     "lookup_sparse_table": {"inputs": {"W": f32(10, 4),
                                        "Ids": i64(3)},
                             "attrs": {}, "outs": ["Out"]},
-    "embedding" if False else "im2sequence": {
+    "im2sequence": {
         "inputs": {"X": f32(1, 1, 4, 4)},
         "attrs": {"kernels": [2, 2], "strides": [2, 2],
                   "paddings": [0, 0, 0, 0]},
@@ -803,7 +803,7 @@ SPECS = {
     "fake_dequantize_max_abs": {
         "inputs": {"X": f32(3, 4), "Scale": np.ones((1,), "float32")},
         "attrs": {"max_range": 127.0}, "outs": ["Out"]},
-    "mean_iou" if False else "one_hot_v2" if False else "print": {
+    "print": {
         "inputs": {"X": f32(2, 2)}, "attrs": {"message": "sweep: "},
         "outs": ["Out"]},
     "lr_schedule": {"inputs": {"Step": np.array([3], "int64")},
@@ -814,6 +814,36 @@ SPECS = {
     "increment_loop_counter": {"inputs": {"X": np.array([1], "int64")},
                                "attrs": {"step": 1}, "outs": ["Out"],
                                "skip_finite": True},
+    # --- LoD / tensor-array plumbing (dense redesigns) -------------------
+    "lod_array_length": {"inputs": {"X": [f32(2, 3), f32(2, 3)]},
+                         "attrs": {}, "outs": ["Out"]},
+    "lod_tensor_to_array": {
+        "inputs": {"X": f32(3, 4, 2),
+                   "RankTable": np.array([2, 0, 1], "int64")},
+        "attrs": {}, "outs": ["Out"] * 4},
+    "array_to_lod_tensor": {
+        "inputs": {"X": [f32(3, 2) for _ in range(4)],
+                   "RankTable": np.array([2, 0, 1], "int64")},
+        "attrs": {}, "outs": ["Out"]},
+    "shrink_rnn_memory": {
+        "inputs": {"X": f32(3, 4),
+                   "RankTable": np.array([4, 3, 1], "int64"),
+                   "I": np.array([2], "int64")},
+        "attrs": {}, "outs": ["Out"]},
+    "max_pool2d_with_index": {
+        "inputs": {"X": f32(2, 3, 8, 8)},
+        "attrs": {"ksize": 2, "strides": 2}, "outs": ["Out", "Mask"]},
+    "max_pool3d_with_index": {
+        "inputs": {"X": f32(1, 2, 4, 4, 4)},
+        "attrs": {"ksize": 2, "strides": 2}, "outs": ["Out", "Mask"]},
+    "roi_perspective_transform": {
+        "inputs": {"X": f32(2, 3, 16, 16),
+                   "ROIs": np.array([[2, 2, 12, 3, 13, 13, 1, 12],
+                                     [0, 0, 15, 0, 15, 15, 0, 15]],
+                                    "float32"),
+                   "BatchIdx": np.array([0, 1], "int64")},
+        "attrs": {"transformed_height": 6, "transformed_width": 5},
+        "outs": ["Out", "Mask"]},
 }
 
 # ops whose execution is validated by dedicated tests / harnesses, or that
