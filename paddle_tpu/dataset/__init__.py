@@ -1,4 +1,6 @@
 """Datasets (ref python/paddle/dataset/): local-cache parse when files are
 present, deterministic synthetic fallback otherwise (no network egress).
 Schemas match the reference's readers sample-for-sample."""
-from . import cifar, common, imdb, imikolov, mnist, uci_housing
+from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
+               movielens, mq2007, sentiment, uci_housing, voc2012, wmt14,
+               wmt16)

@@ -1,0 +1,51 @@
+"""CoNLL-2005 SRL (ref python/paddle/dataset/conll05.py).
+
+Sample schema (ref conll05.py:199): (word_ids, ctx_n2, ctx_n1, ctx_0,
+ctx_p1, ctx_p2, verb_ids, mark, label_ids) — 9 parallel int lists per
+sentence (ctx/verb/mark are repeated per token).
+Synthetic fallback: deterministic tag structure tied to word ids.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+WORD_DICT_LEN = 44068
+VERB_DICT_LEN = 3162
+LABEL_DICT_LEN = 59
+TEST_N = 512
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_DICT_LEN)}
+    verb_dict = {f"v{i}": i for i in range(VERB_DICT_LEN)}
+    label_dict = {f"t{i}": i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """ref conll05.py:218: pretrained word embedding table."""
+    rng = np.random.RandomState(123)
+    return rng.randn(WORD_DICT_LEN, 32).astype("float32") * 0.1
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(5, 40))
+            words = rng.randint(0, WORD_DICT_LEN, length)
+            verb_pos = int(rng.randint(0, length))
+            verb = int(words[verb_pos] % VERB_DICT_LEN)
+            pad = lambda off: np.clip(
+                np.roll(words, -off), 0, WORD_DICT_LEN - 1)
+            mark = (np.arange(length) == verb_pos).astype(int)
+            labels = ((words + verb) % LABEL_DICT_LEN).astype(int)
+            yield (list(words.astype(int)), list(pad(-2).astype(int)),
+                   list(pad(-1).astype(int)), list(words.astype(int)),
+                   list(pad(1).astype(int)), list(pad(2).astype(int)),
+                   [verb] * length, list(mark), list(labels))
+    return reader
+
+
+def test():
+    return _creator(TEST_N, 1)

@@ -1,0 +1,38 @@
+"""102-category flowers (ref python/paddle/dataset/flowers.py).
+
+Sample schema: (image chw float32 in [0,1], label int 0..101).
+Synthetic fallback: class-colored gaussian blobs, deterministic.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES = 102
+SIZE = (3, 32, 32)     # synthetic keeps a small canvas; reference center-
+                       # crops 224 — models take the shape from the sample
+TRAIN_N, TEST_N, VALID_N = 1024, 128, 128
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, N_CLASSES))
+            base = np.zeros(SIZE, np.float32)
+            base[label % 3] = (label / N_CLASSES)
+            img = np.clip(base + rng.rand(*SIZE).astype(np.float32) * .3,
+                          0, 1)
+            yield img, label
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _creator(TRAIN_N, 0)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _creator(TEST_N, 1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _creator(VALID_N, 2)

@@ -1,0 +1,68 @@
+"""MovieLens-1M (ref python/paddle/dataset/movielens.py).
+
+Sample schema (ref movielens.py:167 `usr.value() + mov.value() +
+[[rating]]`): [user_id, gender_id, age_id, job_id, movie_id,
+category_ids list, title_ids list, [rating]].
+Synthetic fallback: deterministic preference structure (rating depends
+on user/movie id parity) so models can fit it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+MAX_USER_ID = 6040
+MAX_MOVIE_ID = 3952
+MAX_JOB_ID = 20
+age_table = [1, 18, 25, 35, 45, 50, 56]
+CATEGORIES = ["Action", "Adventure", "Animation", "Children's", "Comedy",
+              "Crime", "Documentary", "Drama", "Fantasy", "Film-Noir",
+              "Horror", "Musical", "Mystery", "Romance", "Sci-Fi",
+              "Thriller", "War", "Western"]
+TITLE_VOCAB = 5174
+TRAIN_N, TEST_N = 4096, 512
+
+
+def max_user_id():
+    return MAX_USER_ID
+
+
+def max_movie_id():
+    return MAX_MOVIE_ID
+
+
+def max_job_id():
+    return MAX_JOB_ID
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"w{i}": i for i in range(TITLE_VOCAB)}
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = int(rng.randint(1, MAX_USER_ID + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, MAX_JOB_ID + 1))
+            mid = int(rng.randint(1, MAX_MOVIE_ID + 1))
+            cats = list(rng.randint(0, len(CATEGORIES),
+                                    rng.randint(1, 4)).astype(int))
+            title = list(rng.randint(0, TITLE_VOCAB,
+                                     rng.randint(2, 9)).astype(int))
+            rating = float(1 + (uid + mid) % 5)
+            yield [uid, gender, age, job, mid, cats, title, [rating]]
+    return reader
+
+
+def train():
+    return _creator(TRAIN_N, 0)
+
+
+def test():
+    return _creator(TEST_N, 1)

@@ -1,0 +1,62 @@
+"""MQ2007 learning-to-rank (ref python/paddle/dataset/mq2007.py).
+
+Modes (ref gen_point/gen_pair/gen_list): pointwise (score, 46-dim
+feature), pairwise (better, worse features), listwise
+(query_id, scores list, feature matrix).
+Synthetic fallback: relevance = thresholded linear function of features.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+FEATURE_DIM = 46
+N_QUERIES = 339
+
+
+def _queries(seed):
+    rng = np.random.RandomState(seed)
+    w = np.linspace(-1, 1, FEATURE_DIM)
+    for qid in range(N_QUERIES):
+        n_docs = int(rng.randint(5, 20))
+        feats = rng.rand(n_docs, FEATURE_DIM).astype("float32")
+        raw = feats @ w
+        rel = np.digitize(raw, np.quantile(raw, [0.5, 0.8]))
+        yield qid, rel.astype(int), feats
+
+
+def train_point(seed=0):
+    def reader():
+        for _, rel, feats in _queries(seed):
+            for r, f in zip(rel, feats):
+                yield float(r), f
+    return reader
+
+
+def train_pair(seed=0):
+    def reader():
+        rng = np.random.RandomState(seed + 1)
+        for _, rel, feats in _queries(seed):
+            for _ in range(len(rel)):
+                i, j = rng.randint(0, len(rel), 2)
+                if rel[i] == rel[j]:
+                    continue
+                hi, lo = (i, j) if rel[i] > rel[j] else (j, i)
+                yield feats[hi], feats[lo]
+    return reader
+
+
+def train_list(seed=0):
+    def reader():
+        for qid, rel, feats in _queries(seed):
+            yield qid, list(rel.astype(float)), feats
+    return reader
+
+
+def train(format="pairwise"):
+    return {"pointwise": train_point, "pairwise": train_pair,
+            "listwise": train_list}[format]()
+
+
+def test(format="pairwise"):
+    return {"pointwise": train_point, "pairwise": train_pair,
+            "listwise": train_list}[format](seed=7)

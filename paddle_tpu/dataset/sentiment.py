@@ -1,0 +1,31 @@
+"""Movie-review sentiment (ref python/paddle/dataset/sentiment.py,
+NLTK movie_reviews).  Sample schema: (word_ids list, label 0/1)."""
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB = 5147
+TRAIN_N, TEST_N = 1600, 400
+
+
+def get_word_dict():
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(10, 80))
+            ids = (rng.zipf(1.35, length) + (0 if label else VOCAB // 2))
+            yield list(np.clip(ids, 0, VOCAB - 1).astype(int)), label
+    return reader
+
+
+def train():
+    return _creator(TRAIN_N, 0)
+
+
+def test():
+    return _creator(TEST_N, 1)

@@ -1,0 +1,38 @@
+"""PASCAL VOC2012 segmentation (ref python/paddle/dataset/voc2012.py).
+
+Sample schema: (image chw float32, label hw int32 segmentation mask).
+Synthetic fallback: rectangles of the class id on background 0.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES = 21
+SIZE = 32
+TRAIN_N, TEST_N, VAL_N = 512, 128, 128
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            img = rng.rand(3, SIZE, SIZE).astype(np.float32)
+            mask = np.zeros((SIZE, SIZE), np.int32)
+            cls = int(rng.randint(1, N_CLASSES))
+            x0, y0 = rng.randint(0, SIZE // 2, 2)
+            mask[y0:y0 + SIZE // 2, x0:x0 + SIZE // 2] = cls
+            img[0][mask > 0] += cls / N_CLASSES
+            yield np.clip(img, 0, 1), mask
+    return reader
+
+
+def train():
+    return _creator(TRAIN_N, 0)
+
+
+def test():
+    return _creator(TEST_N, 1)
+
+
+def val():
+    return _creator(VAL_N, 2)

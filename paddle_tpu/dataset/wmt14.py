@@ -1,0 +1,44 @@
+"""WMT-14 en-fr (ref python/paddle/dataset/wmt14.py).
+
+Sample schema (ref wmt14.py:113): (src_ids, trg_ids, trg_ids_next) with
+<s>=0, <e>=1, <unk>=2 and trg_ids = [<s>] + sentence,
+trg_ids_next = sentence + [<e>].
+Synthetic fallback: target = deterministic function of source.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+START, END, UNK = 0, 1, 2
+TRAIN_N, TEST_N = 2048, 256
+
+
+def _creator(n, seed, dict_size):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(3, 12))
+            src = rng.randint(3, dict_size, length)
+            trg = (src + 7) % (dict_size - 3) + 3     # deterministic map
+            src_ids = list(src.astype(int))
+            trg_ids = [START] + list(trg.astype(int))
+            trg_next = list(trg.astype(int)) + [END]
+            yield src_ids, trg_ids, trg_next
+    return reader
+
+
+def train(dict_size):
+    return _creator(TRAIN_N, 0, dict_size)
+
+
+def test(dict_size):
+    return _creator(TEST_N, 1, dict_size)
+
+
+def get_dict(dict_size, reverse=False):
+    src = {f"w{i}": i for i in range(dict_size)}
+    trg = {f"w{i}": i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

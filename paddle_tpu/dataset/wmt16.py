@@ -1,0 +1,26 @@
+"""WMT-16 en-de (ref python/paddle/dataset/wmt16.py); same sample
+schema as wmt14 but with per-language dict sizes."""
+from __future__ import annotations
+
+from . import wmt14
+
+START, END, UNK = wmt14.START, wmt14.END, wmt14.UNK
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14._creator(wmt14.TRAIN_N, 0, min(src_dict_size,
+                                                trg_dict_size))
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14._creator(wmt14.TEST_N, 1, min(src_dict_size,
+                                               trg_dict_size))
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14._creator(256, 2, min(src_dict_size, trg_dict_size))
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {f"{lang}{i}": i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d

@@ -4,7 +4,25 @@ from . import tensor
 from . import rnn
 from . import control_flow
 from . import learning_rate_scheduler
+from . import sequence
 from .nn import *  # noqa: F401,F403
+from .sequence import (  # noqa: F401
+    sequence_conv, sequence_pool, sequence_first_step, sequence_last_step,
+    sequence_softmax, sequence_concat, sequence_slice, sequence_expand,
+    sequence_expand_as, sequence_pad, sequence_unpad, sequence_reshape,
+    sequence_enumerate, sequence_scatter, sequence_reverse, lod_reset,
+    linear_chain_crf, crf_decoding, chunk_eval, warpctc,
+    ctc_greedy_decoder, edit_distance, nce, hsigmoid, sampling_id,
+    beam_search, beam_search_decode, conv3d, conv3d_transpose, pool3d,
+    adaptive_pool3d, roi_pool, roi_align, psroi_pool, im2sequence,
+    grid_sampler, affine_grid, affine_channel, space_to_depth, crop,
+    pad_constant_like, image_resize_short, random_crop, bpr_loss,
+    rank_loss, margin_rank_loss, log_loss, dice_loss, mean_iou,
+    multiplex, row_conv, bilinear_tensor_product, add_position_encoding,
+    similarity_focus, hash, merge_selected_rows,
+    get_tensor_from_selected_rows, shape, sum,
+    gaussian_random_batch_size_like, autoincreased_step_counter, lstm,
+    dynamic_lstmp)
 from .tensor import (create_tensor, fill_constant,  # noqa: F401
                      fill_constant_batch_size_like, cast, concat, sums,
                      assign, argmin, argmax, argsort, ones, zeros,

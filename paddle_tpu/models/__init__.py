@@ -9,3 +9,4 @@ from . import deepfm
 from . import bert
 from . import stacked_lstm
 from . import machine_translation
+from . import book

@@ -76,3 +76,11 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     t = int(ctx.shape[2]) if len(ctx.shape) > 2 else -1
     return layers.reshape(ctx, [0, int(queries.shape[1]),
                                 int(queries.shape[2])])
+
+
+def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",
+                       pool_type="max", mask=None):
+    """ref nets.py sequence_conv_pool: context conv over time + pool."""
+    conv_out = layers.sequence_conv(input, num_filters=num_filters,
+                                    filter_size=filter_size, act=act)
+    return layers.sequence_pool(conv_out, pool_type, mask=mask)

@@ -243,6 +243,9 @@ def _cudnn_lstm(ctx, ins, attrs):
     bidi = bool(attrs.get("is_bidirec", False))
     ndir = 2 if bidi else 1
     B, T, D = x.shape
+    # optional initial states, cudnn convention [L*ndir, B, H]
+    init_h = (ins["InitH"][0] if ins.get("InitH") else None)
+    init_c = (ins["InitC"][0] if ins.get("InitC") else None)
     off = 0
 
     def take(n, shape):
@@ -262,8 +265,13 @@ def _cudnn_lstm(ctx, ins, attrs):
             b = take(4 * H, (4 * H,))
             s = seq[::-1] if d == 1 else seq
             xp = s @ wx + b
-            h0 = jnp.zeros((B, H), x.dtype)
-            (h_T, c_T), hs = _lstm_scan(xp, wh, h0, h0)
+            li = l * ndir + d
+            zero = jnp.zeros((B, H), x.dtype)
+            h0 = (zero if init_h is None
+                  else (init_h[li] if init_h.ndim == 3 else init_h))
+            c0 = (zero if init_c is None
+                  else (init_c[li] if init_c.ndim == 3 else init_c))
+            (h_T, c_T), hs = _lstm_scan(xp, wh, h0, c0)
             outs.append(hs[::-1] if d == 1 else hs)
             last_hs.append(h_T)
             last_cs.append(c_T)
@@ -278,30 +286,15 @@ def _cudnn_lstm(ctx, ins, attrs):
 
 @register_op("pool3d")
 def _pool3d(ctx, ins, attrs):
-    """ref pool_op.cc 3-D: NCDHW max/avg."""
-    x = single_input(ins, "X")
-    k = attrs.get("ksize", 2)
-    k = tuple(k) if isinstance(k, (list, tuple)) else (k,) * 3
-    s = attrs.get("strides", k)
-    s = tuple(s) if isinstance(s, (list, tuple)) else (s,) * 3
-    p = attrs.get("paddings", 0)
-    p = tuple(p) if isinstance(p, (list, tuple)) else (p,) * 3
-    ptype = attrs.get("pooling_type", "max")
-    pad = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
-    if ptype == "max":
-        out = lax.reduce_window(x, -jnp.inf, lax.max,
-                                (1, 1) + k, (1, 1) + s, pad)
-    else:
-        summed = lax.reduce_window(x, 0.0, lax.add,
-                                   (1, 1) + k, (1, 1) + s, pad)
-        if attrs.get("exclusive", True) and any(pi != 0 for pi in p):
-            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
-            counts = lax.reduce_window(ones, 0.0, lax.add,
-                                       (1, 1) + k, (1, 1) + s, pad)
-            out = summed / counts
-        else:
-            out = summed / float(np.prod(k))
-    return {"Out": [out]}
+    """ref pool_op.cc 3-D: NCDHW max/avg (shared _pool_nd machinery —
+    global_pooling / ceil_mode / exclusive avg all supported)."""
+    from .nn_ops import _pool_nd
+    attrs = dict(attrs)
+    if "ksize" not in attrs and not attrs.get("global_pooling", False):
+        attrs["ksize"] = 2
+    if "strides" not in attrs:
+        attrs["strides"] = attrs.get("ksize", 2)
+    return {"Out": [_pool_nd(single_input(ins, "X"), attrs, 3)]}
 
 
 @register_op("conv3d_transpose")

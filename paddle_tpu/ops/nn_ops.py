@@ -123,20 +123,19 @@ def _conv2d_transpose(ctx, ins, attrs):
     return {"Output": [out.astype(x.dtype)]}
 
 
-@register_op("pool2d")
-def _pool2d(ctx, ins, attrs):
-    """ref pool_op.cc: max|avg, global_pooling, exclusive avg, NCHW."""
-    x = single_input(ins)
+def _pool_nd(x, attrs, nd):
+    """Shared N-D pooling (ref pool_op.cc): max|avg, global_pooling,
+    ceil_mode, exclusive avg — serves pool2d (NCHW) and pool3d (NCDHW)."""
     ptype = attrs.get("pooling_type", "max")
     if attrs.get("global_pooling", False):
         ksize = x.shape[2:]
-        pads = [(0, 0), (0, 0)]
-        strides = (1, 1)
+        pads = [(0, 0)] * nd
+        strides = (1,) * nd
     else:
-        ksize = _pair(attrs["ksize"])
-        strides = _pair(attrs.get("strides", 1))
-        p = _pair(attrs.get("paddings", 0))
-        pads = [(p[0], p[0]), (p[1], p[1])]
+        ksize = _pair(attrs["ksize"], nd)
+        strides = _pair(attrs.get("strides", 1), nd)
+        p = _pair(attrs.get("paddings", 0), nd)
+        pads = [(pi, pi) for pi in p]
     if attrs.get("ceil_mode", False):
         new_pads = []
         for i, (lo, hi) in enumerate(pads):
@@ -162,7 +161,13 @@ def _pool2d(ctx, ins, attrs):
             out = summed / counts
         else:
             out = summed / float(np.prod(ksize))
-    return {"Out": [out.astype(x.dtype)]}
+    return out.astype(x.dtype)
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    """ref pool_op.cc: max|avg, global_pooling, exclusive avg, NCHW."""
+    return {"Out": [_pool_nd(single_input(ins), attrs, 2)]}
 
 
 def _max_pool_with_index(x, attrs, nd):

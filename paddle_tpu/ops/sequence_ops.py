@@ -75,10 +75,12 @@ def _sequence_pool(ctx, ins, attrs):
 
 @register_op("sequence_softmax")
 def _sequence_softmax(ctx, ins, attrs):
+    """Masked softmax over the time axis ([B,T] or [B,T,...])."""
     x = single_input(ins)
     m = _mask(x, ins)
-    logits = jnp.where(m > 0, x, -1e9)
-    return {"Out": [jax.nn.softmax(logits, axis=1) * m]}
+    m_exp = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    logits = jnp.where(m_exp > 0, x, -1e9)
+    return {"Out": [jax.nn.softmax(logits, axis=1) * m_exp]}
 
 
 @register_op("sequence_expand")

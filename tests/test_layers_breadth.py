@@ -1,0 +1,378 @@
+"""Every layer wrapper in paddle_tpu/layers/sequence.py builds a program
+and runs through the Executor (the reference's layer-function contract:
+each fn in layers/nn.py has a unittest building + running it)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(42)
+
+
+def run_net(build, feeds):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        fetch = [o.name for o in outs]
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feeds, fetch_list=fetch)
+
+
+def seq_data(b=2, t=6, d=4):
+    return rng.randn(b, t, d).astype("float32")
+
+
+def test_sequence_conv_pool_softmax():
+    x = seq_data()
+
+    def build():
+        v = layers.data("x", [6, 4])
+        c = layers.sequence_conv(v, num_filters=5, filter_size=3)
+        p = layers.sequence_pool(c, "max")
+        s = layers.sequence_softmax(c)
+        f = layers.sequence_first_step(c)
+        l = layers.sequence_last_step(c)
+        return c, p, s, f, l
+
+    c, p, s, f, l = run_net(build, {"x": x})
+    assert c.shape == (2, 6, 5) and p.shape == (2, 5)
+    assert np.allclose(np.asarray(s).sum(1), 1.0, atol=1e-5)
+    assert np.allclose(f, np.asarray(c)[:, 0])
+    assert np.allclose(l, np.asarray(c)[:, -1])
+
+
+def test_sequence_manipulation():
+    x = seq_data()
+
+    def build():
+        v = layers.data("x", [6, 4])
+        sl = layers.sequence_slice(v, offset=1, length=3)
+        rv = layers.sequence_reverse(v)
+        rs = layers.sequence_reshape(v, new_dim=8)
+        cc = layers.sequence_concat([v, v])
+        return sl, rv, rs, cc
+
+    sl, rv, rs, cc = run_net(build, {"x": x})
+    assert sl.shape == (2, 3, 4)
+    assert np.allclose(rv, x[:, ::-1])
+    assert rs.shape == (2, 3, 8)
+    assert cc.shape == (2, 12, 4)
+
+
+def test_sequence_pad_unpad_expand():
+    x = seq_data(2, 4, 3)
+
+    def build():
+        v = layers.data("x", [4, 3])
+        ln = layers.data("len", [], dtype="int64")
+        padded, out_len = layers.sequence_pad(v, pad_value=0.0, maxlen=6,
+                                              length=ln)
+        unp = layers.sequence_unpad(padded, ln)
+        row = layers.sequence_pool(v, "first")
+        ex = layers.sequence_expand(row, v)
+        exa = layers.sequence_expand_as(row, v)
+        return padded, unp, ex, exa
+
+    feeds = {"x": x, "len": np.array([4, 2], "int64")}
+    padded, unp, ex, exa = run_net(build, feeds)
+    assert padded.shape == (2, 6, 3)
+    assert np.all(padded[:, 4:] == 0)
+    assert ex.shape == (2, 4, 3) and exa.shape == (2, 4, 3)
+
+
+def test_sequence_enumerate_scatter():
+    ids = rng.randint(0, 9, (2, 5)).astype("int64")
+
+    def build():
+        v = layers.data("ids", [5], dtype="int64")
+        en = layers.sequence_enumerate(v, win_size=2)
+        return (en,)
+
+    en, = run_net(build, {"ids": ids})
+    assert en.shape == (2, 5, 2)
+
+
+def test_crf_layers_train_and_decode():
+    B, T, N = 2, 5, 3
+    em = rng.randn(B, T, N).astype("float32")
+    lab = rng.randint(0, N, (B, T)).astype("int64")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        e = layers.data("em", [T, N])
+        l = layers.data("lab", [T], dtype="int64")
+        ll = layers.linear_chain_crf(e, l, param_attr=pt.ParamAttr("crfw"))
+        loss = layers.mean(layers.scale(ll, scale=-1.0))
+        path = layers.crf_decoding(e, param_attr=pt.ParamAttr("crfw"))
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(4):
+        out, p = exe.run(main, feed={"em": em, "lab": lab},
+                         fetch_list=[loss, path])
+        losses.append(float(out))
+    assert losses[-1] < losses[0]
+    assert np.asarray(p).shape == (B, T)
+
+
+def test_warpctc_and_greedy_decoder():
+    B, T, C = 2, 8, 5
+    logits = rng.randn(B, T, C).astype("float32")
+    lab = rng.randint(1, C, (B, 3)).astype("int64")
+
+    def build():
+        lg = layers.data("lg", [T, C])
+        lb = layers.data("lb", [3], dtype="int64")
+        loss = layers.warpctc(lg, lb, blank=0)
+        dec = layers.ctc_greedy_decoder(lg, blank=0)
+        return loss, dec
+
+    loss, dec = run_net(build, {"lg": logits, "lb": lab})
+    assert loss.shape == (B, 1) and np.all(np.asarray(loss) > 0)
+    assert dec.shape == (B, T)
+
+
+def test_edit_distance_layer():
+    h = np.array([[1, 2, 3, 0]], "int64")
+    r = np.array([[1, 3, 3, 0]], "int64")
+
+    def build():
+        a = layers.data("h", [4], dtype="int64")
+        b = layers.data("r", [4], dtype="int64")
+        d, n = layers.edit_distance(a, b, normalized=False)
+        return d, n
+
+    d, n = run_net(build, {"h": h, "r": r})
+    assert float(d[0]) == 1.0
+
+
+def test_nce_hsigmoid_sampling():
+    B, D, N = 4, 6, 10
+    x = rng.randn(B, D).astype("float32")
+    lab = rng.randint(0, N, (B, 1)).astype("int64")
+
+    def build():
+        v = layers.data("x", [D])
+        l = layers.data("lab", [1], dtype="int64")
+        c1 = layers.mean(layers.nce(v, l, num_total_classes=N,
+                                    num_neg_samples=3))
+        c2 = layers.mean(layers.hsigmoid(v, l, num_classes=N))
+        probs = layers.softmax(layers.fc(v, size=N))
+        sid = layers.sampling_id(probs)
+        return c1, c2, sid
+
+    c1, c2, sid = run_net(build, {"x": x, "lab": lab})
+    assert np.isfinite(c1) and np.isfinite(c2)
+    assert sid.shape == (B,) and (sid >= 0).all() and (sid < N).all()
+
+
+def test_vision_extras():
+    img = rng.randn(2, 3, 8, 8).astype("float32")
+    vol = rng.randn(1, 2, 4, 4, 4).astype("float32")
+    rois = np.array([[0, 0, 7, 7], [2, 2, 6, 6]], "float32")
+
+    def build():
+        v = layers.data("img", [3, 8, 8])
+        w = layers.data("vol", [2, 4, 4, 4])
+        r = layers.data("rois", [4], append_batch_size=False)
+        c3 = layers.conv3d(w, num_filters=4, filter_size=3, padding=1)
+        p3 = layers.pool3d(w, pool_size=2, pool_stride=2)
+        a3 = layers.adaptive_pool3d(w, pool_size=2)
+        t3 = layers.conv3d_transpose(w, num_filters=2, filter_size=2,
+                                     stride=2)
+        rp = layers.roi_pool(v, r, pooled_height=2, pooled_width=2)
+        ra = layers.roi_align(v, r, pooled_height=2, pooled_width=2)
+        sd = layers.space_to_depth(v, blocksize=2)
+        cr = layers.crop(v, shape=[2, 3, 4, 4], offsets=[0, 0, 1, 1])
+        i2s = layers.im2sequence(v, filter_size=2, stride=2)
+        return c3, p3, a3, t3, rp, ra, sd, cr, i2s
+
+    c3, p3, a3, t3, rp, ra, sd, cr, i2s = run_net(
+        build, {"img": img, "vol": vol, "rois": rois})
+    assert c3.shape == (1, 4, 4, 4, 4)
+    assert p3.shape == (1, 2, 2, 2, 2)
+    assert a3.shape == (1, 2, 2, 2, 2)
+    assert t3.shape == (1, 2, 8, 8, 8)
+    assert rp.shape == (2, 3, 2, 2) and ra.shape == (2, 3, 2, 2)
+    assert sd.shape == (2, 12, 4, 4)
+    assert cr.shape == (2, 3, 4, 4)
+
+
+def test_grid_and_affine():
+    img = rng.randn(2, 3, 5, 5).astype("float32")
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"),
+                    (2, 1, 1))
+
+    def build():
+        v = layers.data("img", [3, 5, 5])
+        t = layers.data("theta", [2, 3])
+        g = layers.affine_grid(t, out_shape=[2, 3, 5, 5])
+        s = layers.grid_sampler(v, g)
+        ac = layers.affine_channel(v)
+        return g, s, ac
+
+    g, s, ac = run_net(build, {"img": img, "theta": theta})
+    assert g.shape == (2, 5, 5, 2)
+    # identity theta -> identity sampling
+    assert np.allclose(s, img, atol=1e-4)
+
+
+def test_loss_extras():
+    B = 4
+    pred = (rng.rand(B, 1) * 0.8 + 0.1).astype("float32")
+    lab01 = rng.randint(0, 2, (B, 1)).astype("float32")
+    left = rng.randn(B, 1).astype("float32")
+    right = rng.randn(B, 1).astype("float32")
+    seg_pred = rng.rand(B, 8).astype("float32")
+    seg_lab = rng.randint(0, 2, (B, 8)).astype("int64")
+
+    def build():
+        p = layers.data("p", [1])
+        l = layers.data("l", [1])
+        lf = layers.data("lf", [1])
+        rt = layers.data("rt", [1])
+        sp = layers.data("sp", [8])
+        sl = layers.data("sl", [8], dtype="int64")
+        ll = layers.log_loss(p, l)
+        rl = layers.rank_loss(l, lf, rt)
+        ml = layers.margin_rank_loss(l, lf, rt)
+        dl = layers.dice_loss(sp, sl)
+        bl = layers.bpr_loss(layers.softmax(layers.fc(sp, size=5)),
+                             layers.cast(l, "int64"))
+        return ll, rl, ml, dl, bl
+
+    outs = run_net(build, {"p": pred, "l": lab01, "lf": left, "rt": right,
+                           "sp": seg_pred, "sl": seg_lab})
+    for o in outs:
+        assert np.isfinite(np.asarray(o)).all()
+
+
+def test_metric_and_misc():
+    pred = rng.randint(0, 4, (8,)).astype("int64")
+    lab = rng.randint(0, 4, (8,)).astype("int64")
+    x1 = rng.randn(2, 3).astype("float32")
+
+    def build():
+        p = layers.data("p", [], dtype="int64")
+        l = layers.data("l", [], dtype="int64")
+        v = layers.data("v", [3])
+        miou, wrong, correct = layers.mean_iou(p, l, num_classes=4)
+        mx = layers.multiplex([v, v], layers.cast(
+            layers.zeros([2, 1], "float32"), "int32"))
+        sh = layers.sequence.shape(v)
+        sm = layers.sequence.sum([v, v])
+        h = layers.sequence.hash(layers.reshape(
+            layers.cast(l, "int64"), [-1, 1]), hash_size=100)
+        return miou, mx, sh, sm, h
+
+    miou, mx, sh, sm, h = run_net(build, {"p": pred, "l": lab, "v": x1})
+    assert 0.0 <= float(miou) <= 1.0
+    assert np.allclose(sm, 2 * x1)
+    assert (h < 100).all()
+
+
+def test_rowconv_bilinear_posenc():
+    x = seq_data(2, 5, 4)
+    y = rng.randn(2, 3).astype("float32")
+
+    def build():
+        v = layers.data("x", [5, 4])
+        u = layers.data("y", [3])
+        rc = layers.row_conv(v, future_context_size=2)
+        first = layers.sequence_first_step(v)
+        bt = layers.bilinear_tensor_product(first, u, size=6)
+        pe = layers.add_position_encoding(v)
+        return rc, bt, pe
+
+    rc, bt, pe = run_net(build, {"x": x, "y": y})
+    assert rc.shape == x.shape and bt.shape == (2, 6)
+    assert pe.shape == x.shape
+
+
+def test_beam_search_layers():
+    B, K, V = 2, 3, 7
+    lp = np.log(rng.dirichlet(np.ones(V), (B, K)).astype("float32"))
+    pre_ids = np.full((B, K), 2, "int64")
+    pre_scores = np.zeros((B, K), "float32")
+
+    def build():
+        pi = layers.data("pi", [K], dtype="int64")
+        ps = layers.data("ps", [K])
+        l = layers.data("lp", [K, V])
+        ids, scores, parents = layers.beam_search(
+            pi, ps, l, beam_size=K, end_id=1)
+        return ids, scores, parents
+
+    ids, scores, parents = run_net(
+        build, {"pi": pre_ids, "ps": pre_scores, "lp": lp})
+    assert ids.shape == (B, K)
+    assert (np.diff(np.asarray(scores), axis=1) <= 1e-6).all()
+
+
+def test_selected_rows_layers():
+    ids = np.array([3, 1, 3, 0], "int64")
+    vals = rng.randn(4, 2).astype("float32")
+
+    def build():
+        i = layers.data("ids", [], dtype="int64")
+        v = layers.data("vals", [2])
+        oi, ov = layers.merge_selected_rows(i, v)
+        dense = layers.get_tensor_from_selected_rows(i, v, height=5)
+        return oi, ov, dense
+
+    oi, ov, dense = run_net(build, {"ids": ids, "vals": vals})
+    assert dense.shape == (5, 2)
+    # row 3 accumulated twice
+    assert np.allclose(dense[3], vals[0] + vals[2], atol=1e-6)
+
+
+def test_lstm_fused_and_lstmp():
+    B, T, D, H = 2, 5, 4, 6
+    x = rng.randn(B, T, D).astype("float32")
+
+    def build():
+        v = layers.data("x", [T, D])
+        h0 = layers.zeros([1, B, H], "float32")
+        out, lh, lc = layers.lstm(v, h0, h0, max_len=T, hidden_size=H)
+        proj_in = layers.fc(v, size=4 * H, num_flatten_dims=2)
+        proj, cell = layers.dynamic_lstmp(proj_in, size=4 * H,
+                                          proj_size=3)
+        return out, lh, lc, proj
+
+    out, lh, lc, proj = run_net(build, {"x": x})
+    assert out.shape == (B, T, H)
+    assert lh.shape == (1, B, H) and lc.shape == (1, B, H)
+    assert proj.shape == (B, T, 3)
+
+
+def test_misc_random_and_counter():
+    x = rng.randn(3, 4).astype("float32")
+
+    def build():
+        v = layers.data("x", [4])
+        g = layers.gaussian_random_batch_size_like(v, shape=[-1, 5])
+        rc = layers.random_crop(v, shape=[2])
+        ctr = layers.autoincreased_step_counter()
+        sf = layers.similarity_focus(
+            layers.reshape(v, [3, 2, 2, 1]), axis=1, indexes=[0])
+        return g, rc, ctr, sf
+
+    g, rc, ctr, sf = run_net(build, {"x": x})
+    assert g.shape == (3, 5) and rc.shape == (3, 2)
+
+
+def test_pad_constant_like_and_concat_first():
+    big = rng.randn(2, 5).astype("float32")
+    small = rng.randn(2, 3).astype("float32")
+
+    def build():
+        b = layers.data("b", [5])
+        s = layers.data("s", [3])
+        return (layers.pad_constant_like(b, s, pad_value=9.0),)
+
+    out, = run_net(build, {"b": big, "s": small})
+    assert out.shape == (2, 5)
+    assert np.allclose(out[:, 3:], 9.0)

@@ -1,0 +1,83 @@
+"""Book-example tier: the five remaining reference book models train
+(loss decreases) and round-trip through save/load_inference_model —
+the reference's tests/book contract (train -> save -> load -> infer)."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import book
+
+rng = np.random.RandomState(7)
+B = 4
+
+
+def train(build, feeds, steps=4, lr=0.01):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        fs, loss, pred = build()
+        pt.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(steps):
+        out, = exe.run(main, feed=feeds, fetch_list=[loss])
+        losses.append(float(out))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    return main, exe, fs, pred
+
+
+def test_fit_a_line_trains_and_roundtrips():
+    feeds = {"x": rng.randn(B, 13).astype("f4"),
+             "y": rng.randn(B, 1).astype("f4")}
+    main, exe, fs, pred = train(book.fit_a_line, feeds)
+    with tempfile.TemporaryDirectory() as d:
+        pt.io.save_inference_model(d, ["x"], [pred], exe,
+                                   main_program=main)
+        prog, feed_names, fetch_vars = pt.io.load_inference_model(d, exe)
+        out, = exe.run(prog, feed={"x": feeds["x"]},
+                       fetch_list=list(fetch_vars))
+        assert np.asarray(out).shape == (B, 1)
+
+
+def test_word2vec_trains():
+    feeds = {**{f"word_{i}": rng.randint(0, 50, (B, 1)).astype("i8")
+                for i in range(4)},
+             "next_word": rng.randint(0, 50, (B, 1)).astype("i8")}
+    train(lambda: book.word2vec(dict_size=50), feeds)
+
+
+def test_recommender_system_trains():
+    feeds = {"user_id": rng.randint(0, 100, (B, 1)).astype("i8"),
+             "gender_id": rng.randint(0, 2, (B, 1)).astype("i8"),
+             "age_id": rng.randint(0, 7, (B, 1)).astype("i8"),
+             "job_id": rng.randint(0, 21, (B, 1)).astype("i8"),
+             "movie_id": rng.randint(0, 200, (B, 1)).astype("i8"),
+             "category_id": rng.randint(0, 10, (B, 3)).astype("i8"),
+             "movie_title": rng.randint(0, 500, (B, 8)).astype("i8"),
+             "score": rng.uniform(1, 5, (B, 1)).astype("f4")}
+    train(book.recommender_system, feeds)
+
+
+def test_rnn_encoder_decoder_trains():
+    feeds = {"src_word": rng.randint(0, 100, (B, 8)).astype("i8"),
+             "tgt_word": rng.randint(0, 100, (B, 8)).astype("i8"),
+             "label": rng.randint(0, 100, (B, 8)).astype("i8")}
+    train(book.rnn_encoder_decoder, feeds, lr=0.1)
+
+
+def test_db_lstm_srl_trains_and_decodes():
+    feeds = {**{f"{s}_data": rng.randint(0, 100, (B, 8)).astype("i8")
+                for s in ["word", "ctx_n2", "ctx_n1", "ctx_0",
+                          "ctx_p1", "ctx_p2"]},
+             "verb_data": rng.randint(0, 50, (B, 8)).astype("i8"),
+             "mark_data": rng.randint(0, 2, (B, 8)).astype("i8"),
+             "target": rng.randint(0, 10, (B, 8)).astype("i8")}
+    main, exe, fs, decode = train(lambda: book.db_lstm(depth=2), feeds,
+                                  lr=0.05)
+    path, = exe.run(main, feed=feeds, fetch_list=[decode])
+    path = np.asarray(path)
+    assert path.shape == (B, 8)
+    assert (path >= 0).all() and (path < 10).all()

@@ -159,3 +159,34 @@ def test_trainer_uci_housing_linear_regression():
     trainer.train(num_epochs=12, event_handler=handler,
                   reader=train_reader, feed_order=["x", "y"])
     assert losses[-1] < losses[0] * 0.2, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_dataset_breadth_schemas():
+    """All 13 reference datasets yield schema-correct samples
+    (ref python/paddle/dataset/: 13 modules)."""
+    import itertools
+
+    from paddle_tpu import dataset as D
+
+    def take(reader, n=3):
+        return list(itertools.islice(reader(), n))
+
+    img, lbl = take(D.flowers.train())[0]
+    assert img.shape[0] == 3 and 0 <= lbl < 102
+    s = take(D.movielens.train())[0]
+    assert len(s) == 8 and isinstance(s[5], list) and isinstance(s[6], list)
+    s = take(D.conll05.test())[0]
+    assert len(s) == 9 and len(set(map(len, s))) == 1  # parallel lists
+    ids, lab = take(D.sentiment.train())[0]
+    assert lab in (0, 1) and max(ids) < D.sentiment.VOCAB
+    img, mask = take(D.voc2012.train())[0]
+    assert mask.shape == img.shape[1:]
+    src, trg, trg_next = take(D.wmt14.train(100))[0]
+    assert trg[0] == D.wmt14.START and trg_next[-1] == D.wmt14.END
+    assert len(trg) == len(trg_next)
+    src, trg, _ = take(D.wmt16.train(100, 100))[0]
+    assert trg[0] == D.wmt16.START
+    hi, lo = take(D.mq2007.train("pairwise"))[0]
+    assert hi.shape == (D.mq2007.FEATURE_DIM,)
+    qid, rels, feats = take(D.mq2007.train("listwise"))[0]
+    assert feats.shape == (len(rels), D.mq2007.FEATURE_DIM)
