@@ -1,0 +1,218 @@
+// C-callable inference ABI over the paddle_tpu Predictor.
+//
+// Capability parity with the reference's native deployment ABI
+// (/root/reference/paddle/fluid/inference/api/paddle_api.h:134
+// PaddlePredictor; api_impl.h:35 NativePaddlePredictor), which serves
+// C++ applications without a Python runtime in *their* code.  TPU-native
+// design: the compute is an XLA executable managed by the Python-side
+// Predictor (inference/predictor.py), so this library embeds CPython and
+// marshals flat buffers through inference/capi_bridge.py — the host app
+// sees a pure C ABI (create / run / free / destroy + last_error).
+//
+// Threading: all entry points take the GIL (PyGILState_Ensure), so the
+// handle may be shared across host threads; clone-per-thread semantics
+// (paddle_api.h Clone) live on the Python side.
+//
+// Build: `make capi` -> libpaddle_tpu_capi.so (links libpython).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+extern "C" {
+
+typedef struct ptpu_predictor ptpu_predictor;
+
+typedef struct {
+  const char* name;       // feed name
+  int dtype;              // 0=float32, 1=int64, 2=int32
+  const int64_t* shape;
+  int rank;
+  const void* data;
+  size_t nbytes;
+} ptpu_tensor;
+
+typedef struct {
+  char name[64];
+  int dtype;
+  int64_t shape[8];
+  int rank;
+  void* data;             // malloc'd; free with ptpu_out_tensor_free
+  size_t nbytes;
+} ptpu_out_tensor;
+
+struct ptpu_predictor {
+  long pid;
+};
+
+static std::string g_last_error;
+
+static void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      g_last_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+const char* ptpu_last_error() { return g_last_error.c_str(); }
+
+// Initialize the embedded interpreter.  extra_sys_paths: ':'-separated
+// entries appended to sys.path (site-packages of the serving venv + the
+// directory holding paddle_tpu).  Safe to call more than once.
+int ptpu_init(const char* extra_sys_paths) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // other host threads can enter via PyGILState_Ensure (the header
+    // promises cross-thread handle sharing).
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  if (extra_sys_paths != nullptr && extra_sys_paths[0] != '\0') {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    std::string paths(extra_sys_paths);
+    size_t start = 0;
+    while (start <= paths.size() && rc == 0) {
+      size_t end = paths.find(':', start);
+      std::string one = paths.substr(
+          start, end == std::string::npos ? std::string::npos : end - start);
+      if (!one.empty()) {
+        PyObject* p = PyUnicode_FromString(one.c_str());
+        if (p == nullptr || PyList_Append(sys_path, p) != 0) {
+          set_error_from_python();
+          rc = -1;
+        }
+        Py_XDECREF(p);
+      }
+      if (end == std::string::npos) break;
+      start = end + 1;
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+static PyObject* bridge() {
+  return PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+}
+
+ptpu_predictor* ptpu_predictor_create(const char* model_dir,
+                                      const char* device) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  ptpu_predictor* handle = nullptr;
+  PyObject* mod = bridge();
+  if (mod != nullptr) {
+    PyObject* pid = PyObject_CallMethod(mod, "create", "ss", model_dir,
+                                        device ? device : "cpu");
+    if (pid != nullptr) {
+      handle = new ptpu_predictor{PyLong_AsLong(pid)};
+      Py_DECREF(pid);
+    } else {
+      set_error_from_python();
+    }
+    Py_DECREF(mod);
+  } else {
+    set_error_from_python();
+  }
+  PyGILState_Release(gil);
+  return handle;
+}
+
+// Returns the number of outputs written (<= max_out), or -1 on error.
+int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
+                       ptpu_out_tensor* outs, int max_out) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n_out = -1;
+  PyObject *mod = nullptr, *names = nullptr, *dtypes = nullptr,
+           *shapes = nullptr, *buffers = nullptr, *result = nullptr;
+  do {
+    mod = bridge();
+    if (mod == nullptr) break;
+    names = PyList_New(n_in);
+    dtypes = PyList_New(n_in);
+    shapes = PyList_New(n_in);
+    buffers = PyList_New(n_in);
+    if (!names || !dtypes || !shapes || !buffers) break;
+    for (int i = 0; i < n_in; ++i) {
+      PyList_SET_ITEM(names, i, PyUnicode_FromString(ins[i].name));
+      PyList_SET_ITEM(dtypes, i, PyLong_FromLong(ins[i].dtype));
+      PyObject* shp = PyList_New(ins[i].rank);
+      for (int d = 0; d < ins[i].rank; ++d) {
+        PyList_SET_ITEM(shp, d, PyLong_FromLongLong(ins[i].shape[d]));
+      }
+      PyList_SET_ITEM(shapes, i, shp);
+      PyList_SET_ITEM(
+          buffers, i,
+          PyBytes_FromStringAndSize(static_cast<const char*>(ins[i].data),
+                                    static_cast<Py_ssize_t>(ins[i].nbytes)));
+    }
+    result = PyObject_CallMethod(mod, "run", "lOOOO", h->pid, names, dtypes,
+                                 shapes, buffers);
+    if (result == nullptr) break;
+    Py_ssize_t n = PyList_Size(result);
+    if (n > max_out) n = max_out;
+    n_out = static_cast<int>(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* tup = PyList_GetItem(result, i);  // (name, code, shape, bytes)
+      const char* nm = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+      std::snprintf(outs[i].name, sizeof(outs[i].name), "%s", nm);
+      outs[i].dtype = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(tup, 1)));
+      PyObject* shp = PyTuple_GetItem(tup, 2);
+      outs[i].rank = static_cast<int>(PyTuple_Size(shp));
+      for (int d = 0; d < outs[i].rank && d < 8; ++d) {
+        outs[i].shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shp, d));
+      }
+      PyObject* raw = PyTuple_GetItem(tup, 3);
+      char* buf = nullptr;
+      Py_ssize_t len = 0;
+      PyBytes_AsStringAndSize(raw, &buf, &len);
+      outs[i].nbytes = static_cast<size_t>(len);
+      outs[i].data = std::malloc(outs[i].nbytes);
+      std::memcpy(outs[i].data, buf, outs[i].nbytes);
+    }
+  } while (false);
+  if (n_out < 0) set_error_from_python();
+  Py_XDECREF(result);
+  Py_XDECREF(buffers);
+  Py_XDECREF(shapes);
+  Py_XDECREF(dtypes);
+  Py_XDECREF(names);
+  Py_XDECREF(mod);
+  PyGILState_Release(gil);
+  return n_out;
+}
+
+void ptpu_out_tensor_free(ptpu_out_tensor* t) {
+  if (t != nullptr && t->data != nullptr) {
+    std::free(t->data);
+    t->data = nullptr;
+    t->nbytes = 0;
+  }
+}
+
+void ptpu_predictor_destroy(ptpu_predictor* h) {
+  if (h == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = bridge();
+  if (mod != nullptr) {
+    PyObject* r = PyObject_CallMethod(mod, "destroy", "l", h->pid);
+    Py_XDECREF(r);
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  delete h;
+}
+
+}  // extern "C"

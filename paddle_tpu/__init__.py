@@ -22,6 +22,7 @@ from .framework.program import (Program, Block, Variable, Parameter,
                                 reset_default_programs)
 from .framework import unique_name
 from .framework.executor import Executor, Scope, global_scope
+from .framework.async_executor import AsyncExecutor, DataFeedDesc, Slot
 from .framework.backward import append_backward
 from .framework.layer_helper import ParamAttr
 from .framework import initializer
@@ -35,6 +36,7 @@ from . import nets
 from . import reader
 from . import dataset
 from . import transpiler
+from . import imperative
 from . import inference
 from . import distributed
 from .data_feeder import DataFeeder

@@ -1,0 +1,23 @@
+"""Imperative (dygraph) mode — eager per-op execution with a grad tape.
+
+Capability parity with the reference's embryonic imperative package
+(/root/reference/paddle/fluid/imperative/: layer.h:30 VarBase, tracer.h:44
+Tracer::Trace, engine.h:25; python/paddle/fluid/imperative/base.py:28
+guard/to_variable, layers.py:26 PyLayer).
+
+TPU-first redesign: JAX is already eager outside jit, so there is no
+separate eager kernel path — imperative ops call the SAME registry
+lowering functions the Executor traces (one op library, two drivers,
+mirroring how the reference shares OpKernels between Executor and
+Tracer).  The Tracer's grad-op chain (VarBase::RunBackward walking
+pre-built grad ops) becomes a tape of (lower_fn, inputs, outputs)
+entries; backward() replays the tape in reverse through jax.vjp, so
+every registered differentiable op works imperatively with no extra
+grad registry.
+"""
+from .base import enabled, guard, to_variable
+from .layers import FC, Layer, PyLayer
+from .varbase import VarBase, trace_op
+
+__all__ = ["enabled", "guard", "to_variable", "FC", "Layer", "PyLayer",
+           "VarBase", "trace_op"]

@@ -1,0 +1,29 @@
+"""guard / enabled / to_variable (ref python/paddle/fluid/imperative/
+base.py:28)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from .varbase import Tape, VarBase, _active_tape, pop_tape, push_tape
+
+
+def enabled() -> bool:
+    return _active_tape() is not None
+
+
+@contextlib.contextmanager
+def guard(seed: int = 0):
+    """Enter imperative mode: ops recorded on a fresh tape."""
+    push_tape(Tape(seed=seed))
+    try:
+        yield
+    finally:
+        pop_tape()
+
+
+def to_variable(value, stop_gradient: bool = False) -> VarBase:
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), stop_gradient=stop_gradient)

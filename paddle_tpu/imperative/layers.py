@@ -1,0 +1,89 @@
+"""Layer / PyLayer (ref imperative/layer.h:89 Layer, python layers.py:26
+PyLayer)."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .base import to_variable
+from .varbase import VarBase, trace_op
+
+
+class Layer:
+    """Eager module: owns parameters, `forward` defines compute
+    (ref imperative/layer.h:89)."""
+
+    def __init__(self):
+        self._parameters: Dict[str, VarBase] = {}
+        self._sublayers: Dict[str, "Layer"] = {}
+        self._built = False
+
+    def create_parameter(self, name: str, shape, dtype="float32",
+                         initializer=None) -> VarBase:
+        if initializer is None:
+            fan_in = int(np.prod(shape[:-1])) or 1
+            init = np.random.RandomState(len(self._parameters)).uniform(
+                -np.sqrt(6.0 / fan_in), np.sqrt(6.0 / fan_in),
+                shape).astype(dtype)
+        else:
+            init = np.asarray(initializer, dtype=dtype).reshape(shape)
+        p = VarBase(init, stop_gradient=False, name=name)
+        self._parameters[name] = p
+        return p
+
+    def parameters(self) -> List[VarBase]:
+        ps = list(self._parameters.values())
+        for sub in self._sublayers.values():
+            ps.extend(sub.parameters())
+        return ps
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Layer):
+            self.__dict__.setdefault("_sublayers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+    def _build_once(self, *inputs):
+        pass
+
+    def __call__(self, *inputs):
+        inputs = tuple(to_variable(x) for x in inputs)
+        if not self._built:
+            self._build_once(*inputs)
+            self._built = True
+        return self.forward(*inputs)
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+
+class FC(Layer):
+    """Eager fully-connected layer — the canonical Layer example
+    (parallels the graph-mode layers.fc)."""
+
+    def __init__(self, size: int, act: str = None):
+        super().__init__()
+        self.size = size
+        self.act = act
+
+    def _build_once(self, x):
+        d = int(x.shape[-1])
+        self.w = self.create_parameter("w", [d, self.size], x.dtype)
+        self.b = self.create_parameter("b", [self.size], x.dtype,
+                                       initializer=np.zeros(self.size))
+
+    def forward(self, x):
+        out = trace_op("mul", {"X": [x], "Y": [self.w]},
+                       {"x_num_col_dims": 1, "y_num_col_dims": 1})[0]
+        out = out + self.b
+        if self.act:
+            out = trace_op(self.act, {"X": [out]}, {})[0]
+        return out
+
+
+class PyLayer(Layer):
+    """User-defined eager layer (ref python layers.py:26): subclass and
+    implement forward over VarBases."""
+
+    def forward(self, *inputs):
+        raise NotImplementedError

@@ -1,0 +1,193 @@
+"""VarBase + tape autograd (ref imperative/layer.h:30, engine.h:25).
+
+The reference's Tracer appends grad-OpDescs while forward ops run and
+RunBackward walks them; here the tape stores the forward lowering
+closure itself and backward uses jax.vjp per entry — exact gradients
+for every differentiable registered op, no per-op grad maker needed.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import EnforceNotMet
+from ..framework.registry import LowerContext, get_op_def
+
+
+class VarBase:
+    """Eager tensor (ref imperative/layer.h:30)."""
+
+    _next_id = 0
+
+    def __init__(self, value, stop_gradient: bool = False,
+                 name: Optional[str] = None):
+        self.value = jnp.asarray(value)
+        self.stop_gradient = bool(stop_gradient)
+        self.grad: Optional[jnp.ndarray] = None
+        VarBase._next_id += 1
+        self.name = name or f"eager_{VarBase._next_id}"
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, stop_gradient={self.stop_gradient})")
+
+    # -- autograd ---------------------------------------------------------
+    def backward(self):
+        """ref layer.h VarBase::RunBackward: seed d(self)=1 and walk the
+        tape in reverse."""
+        tape = _active_tape()
+        if tape is None:
+            raise EnforceNotMet(
+                "backward() outside imperative.guard(): no tape")
+        tape.backward(self)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    # arithmetic sugar via traced ops
+    def __add__(self, other):
+        return trace_op("elementwise_add",
+                        {"X": [self], "Y": [_as_var(other)]}, {})[0]
+
+    def __mul__(self, other):
+        return trace_op("elementwise_mul",
+                        {"X": [self], "Y": [_as_var(other)]}, {})[0]
+
+    def __sub__(self, other):
+        return trace_op("elementwise_sub",
+                        {"X": [self], "Y": [_as_var(other)]}, {})[0]
+
+
+def _as_var(v) -> VarBase:
+    return v if isinstance(v, VarBase) else VarBase(v, stop_gradient=True)
+
+
+class _TapeEntry:
+    __slots__ = ("fn", "in_vars", "out_vars")
+
+    def __init__(self, fn, in_vars: List[VarBase], out_vars: List[VarBase]):
+        self.fn = fn                  # flat jnp values -> flat jnp values
+        self.in_vars = in_vars
+        self.out_vars = out_vars
+
+
+class Tape:
+    """Forward-op recorder + reverse-replay engine (ref engine.h:25)."""
+
+    def __init__(self, seed: int = 0):
+        self.entries: List[_TapeEntry] = []
+        self._ctx = LowerContext(jax.random.PRNGKey(seed))
+
+    def ctx(self) -> LowerContext:
+        return self._ctx
+
+    def record(self, fn, in_vars, out_vars):
+        self.entries.append(_TapeEntry(fn, in_vars, out_vars))
+
+    def backward(self, root: VarBase):
+        grads: Dict[int, jnp.ndarray] = {
+            id(root): jnp.ones_like(root.value)}
+        for entry in reversed(self.entries):
+            out_cts = [grads.get(id(o)) for o in entry.out_vars]
+            if all(c is None for c in out_cts):
+                continue
+            cts = tuple(
+                jnp.zeros_like(o.value) if c is None else c
+                for o, c in zip(entry.out_vars, out_cts))
+            in_vals = tuple(v.value for v in entry.in_vars)
+            _, vjp_fn = jax.vjp(entry.fn, *in_vals)
+            in_cts = vjp_fn(cts)
+            for v, ct in zip(entry.in_vars, in_cts):
+                if v.stop_gradient or ct is None:
+                    continue
+                prev = grads.get(id(v))
+                grads[id(v)] = ct if prev is None else prev + ct
+        # publish .grad once per distinct var that received one
+        seen: Dict[int, VarBase] = {}
+        for entry in self.entries:
+            for v in entry.in_vars:
+                seen.setdefault(id(v), v)
+        for vid, v in seen.items():
+            g = grads.get(vid)
+            if g is not None and not v.stop_gradient:
+                v.grad = (g if v.grad is None else v.grad + g)
+
+
+_tape_stack: List[Tape] = []
+
+
+def _active_tape() -> Optional[Tape]:
+    return _tape_stack[-1] if _tape_stack else None
+
+
+def push_tape(tape: Tape):
+    _tape_stack.append(tape)
+
+
+def pop_tape():
+    _tape_stack.pop()
+
+
+_MAIN_SLOTS = ("Out", "Y", "Output", "Loss", "Cost", "Hidden")
+
+
+def _default_slot_order(outs):
+    """Main slot first (Out/Y/Output/...), then the rest sorted — so
+    trace_op(...)[0] is the principal output, not an aux like Mask."""
+    main = [s for s in _MAIN_SLOTS if s in outs]
+    rest = sorted(s for s in outs if s not in _MAIN_SLOTS)
+    return main + rest
+
+
+def trace_op(op_type: str, ins: Dict[str, Sequence[VarBase]],
+             attrs: Dict[str, Any], out_slots: Optional[List[str]] = None
+             ) -> List[VarBase]:
+    """Run one registered op eagerly (ref tracer.h:44 Tracer::Trace):
+    lower with concrete values, wrap outputs in VarBase, record on the
+    tape.  Returns outputs of `out_slots` (default: all slots, sorted,
+    main slot 'Out'-style first) flattened in order."""
+    tape = _active_tape()
+    if tape is None:
+        raise EnforceNotMet(
+            f"imperative op {op_type!r} outside imperative.guard()")
+    opdef = get_op_def(op_type)
+    ctx = tape.ctx()
+
+    in_items = [(slot, i, v) for slot, vs in sorted(ins.items())
+                for i, v in enumerate(vs)]
+    in_vars = [v for (_, _, v) in in_items]
+    # pin the RNG counter so the vjp re-execution draws the SAME keys as
+    # the forward run (dropout etc. must replay identically)
+    rng_start = ctx._counter
+
+    def fn(*flat_vals):
+        rebuilt: Dict[str, List[Any]] = {}
+        for (slot, i, _), val in zip(in_items, flat_vals):
+            rebuilt.setdefault(slot, []).append(val)
+        ctx._counter = rng_start
+        outs = opdef.lower(ctx, rebuilt, attrs)
+        slots = out_slots or _default_slot_order(outs)
+        return tuple(o for s in slots for o in outs[s])
+
+    flat_in = tuple(v.value for v in in_vars)
+    flat_out = fn(*flat_in)
+    sg = opdef.stop_gradient or all(v.stop_gradient for v in in_vars)
+    out_vars = [VarBase(o, stop_gradient=sg) for o in flat_out]
+    if not sg:
+        tape.record(fn, in_vars, out_vars)
+    return out_vars

@@ -1,0 +1,58 @@
+"""Python side of the C inference ABI (native/predictor_capi.cc).
+
+The C library (libpaddle_tpu_capi.so) embeds CPython and calls the three
+functions below with plain ints/strs/bytes — no custom types cross the
+boundary, so the C side stays small.  Counterpart of the reference's
+C++-native predictor ABI (paddle_api.h:134 PaddlePredictor /
+CreatePaddlePredictor:217) serving non-Python applications.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int64, 2: np.int32}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int64): 1,
+                np.dtype(np.int32): 2}
+
+_predictors: Dict[int, object] = {}
+_next_id = itertools.count(1)
+
+
+def create(model_dir: str, device: str = "cpu") -> int:
+    """Load a saved inference model; returns an opaque handle id."""
+    from ..core.place import CPUPlace, TPUPlace
+    from .predictor import AnalysisConfig, create_predictor
+    cfg = AnalysisConfig(model_dir=model_dir, use_tpu=(device == "tpu"))
+    pid = next(_next_id)
+    _predictors[pid] = create_predictor(cfg)
+    return pid
+
+
+def run(pid: int, names: Sequence[str], dtypes: Sequence[int],
+        shapes: Sequence[Sequence[int]], buffers: Sequence[bytes]
+        ) -> List[Tuple[str, int, Tuple[int, ...], bytes]]:
+    """One inference call.  Inputs as raw little-endian buffers; outputs
+    the same way: [(name, dtype_code, shape, bytes), ...]."""
+    pred = _predictors[pid]
+    feeds = {}
+    for name, dt, shape, buf in zip(names, dtypes, shapes, buffers):
+        arr = np.frombuffer(buf, dtype=_DTYPES[int(dt)]).reshape(
+            [int(s) for s in shape])
+        feeds[name] = arr
+    outs = pred.run(feeds)
+    result = []
+    for name, arr in zip(pred.fetch_names, outs):
+        arr = np.ascontiguousarray(arr)
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:            # normalize exotic dtypes for the ABI
+            arr = arr.astype(np.float32)
+            code = 0
+        result.append((str(name), code, tuple(arr.shape), arr.tobytes()))
+    return result
+
+
+def destroy(pid: int) -> None:
+    _predictors.pop(pid, None)

@@ -695,9 +695,23 @@ def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     """ref layers/nn.py autoincreased_step_counter: a persistent int64
-    counter incremented each run (used by LR schedulers)."""
-    from .learning_rate_scheduler import _global_step
-    return _global_step(LayerHelper("autoincreased_step_counter"))
+    counter incremented by `step` each run, starting at `begin`."""
+    helper = LayerHelper("autoincreased_step_counter")
+    name = counter_name or "@step_counter@"
+    block = helper.main_program.global_block()
+    if block.has_var(name):
+        return block.var(name)
+    ctr = block.create_var(name=name, shape=[1], dtype="int64",
+                           persistable=True, stop_gradient=True)
+    sb = helper.startup_program.global_block()
+    if not sb.has_var(name):
+        sb.create_var(name, shape=[1], dtype="int64", persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": [name]},
+                     attrs={"shape": [1], "dtype": "int64",
+                            "value": int(begin)})
+    block.append_op("increment_loop_counter", {"X": [name]},
+                    {"Out": [name]}, {"step": int(step)})
+    return ctr
 
 
 def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,

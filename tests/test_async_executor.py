@@ -1,0 +1,102 @@
+"""AsyncExecutor: multithread CTR training over sharded MultiSlot text
+files (ref framework/async_executor.h:60, data_feed.h:224,
+python async_executor.py; test pattern: unittests/test_async_executor.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+rng = np.random.RandomState(3)
+
+VOCAB = 50
+SLOT_W = 4
+
+
+def write_shards(d, n_files=4, lines_per_file=64):
+    """MultiSlot lines: sparse id slot (width<=4), dense label slot."""
+    files = []
+    for fi in range(n_files):
+        path = os.path.join(d, f"part-{fi}")
+        with open(path, "w") as f:
+            for _ in range(lines_per_file):
+                n_ids = rng.randint(1, SLOT_W + 1)
+                ids = rng.randint(0, VOCAB, n_ids)
+                # learnable structure: label = parity of first id
+                label = ids[0] % 2
+                f.write(f"{n_ids} " + " ".join(map(str, ids))
+                        + f" 1 {label}\n")
+        files.append(path)
+    return files
+
+
+def build_ctr_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", [SLOT_W], dtype="int64")
+        label = layers.data("click", [1], dtype="float32")
+        emb = layers.embedding(ids, size=[VOCAB, 8])
+        pooled = layers.sequence_pool(emb, "sum")
+        predict = layers.fc(pooled, size=1, act="sigmoid")
+        cost = layers.log_loss(predict, label)
+        avg_cost = layers.mean(cost)
+        pt.optimizer.SGD(learning_rate=0.5).minimize(avg_cost)
+    return main, startup, avg_cost
+
+
+def test_data_feed_desc_parses_multislot():
+    feed = pt.DataFeedDesc([pt.Slot("ids", "uint64", dim=4),
+                            pt.Slot("click", "float", is_dense=True,
+                                    dim=1)], batch_size=8)
+    row = feed.parse_line("3 7 8 9 1 1.0")
+    assert row["ids"].tolist() == [7, 8, 9, 0]
+    assert row["click"].tolist() == [1.0]
+    # unused slots are skipped but still consumed from the line
+    feed.set_use_slots(["click"])
+    row = feed.parse_line("3 7 8 9 1 0.0")
+    assert set(row) == {"click"}
+
+
+def test_async_executor_trains_multithreaded():
+    with tempfile.TemporaryDirectory() as d:
+        files = write_shards(d)
+        main, startup, loss = build_ctr_program()
+        feed = pt.DataFeedDesc([pt.Slot("ids", "uint64", dim=SLOT_W),
+                                pt.Slot("click", "float", is_dense=True,
+                                        dim=1)], batch_size=16)
+        exe = pt.AsyncExecutor(pt.CPUPlace())
+        exe.run_startup_program(startup)
+        first = exe.run(main, feed, files, thread_num=4,
+                        fetch=[loss.name])
+        for _ in range(3):
+            last = exe.run(main, feed, files, thread_num=4,
+                           fetch=[loss.name])
+        assert np.isfinite(first[loss.name])
+        assert last[loss.name] < first[loss.name]
+
+
+def test_async_executor_propagates_parse_errors():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bad")
+        with open(path, "w") as f:
+            f.write("2 7\n")          # truncated slot
+        main, startup, loss = build_ctr_program()
+        feed = pt.DataFeedDesc([pt.Slot("ids", "uint64", dim=SLOT_W),
+                                pt.Slot("click", "float", is_dense=True,
+                                        dim=1)], batch_size=4)
+        exe = pt.AsyncExecutor(pt.CPUPlace())
+        exe.run_startup_program(startup)
+        with pytest.raises(pt.core.enforce.EnforceNotMet):
+            exe.run(main, feed, [path], thread_num=2, fetch=[loss.name])
+
+
+def test_async_executor_missing_file():
+    main, startup, loss = build_ctr_program()
+    feed = pt.DataFeedDesc([pt.Slot("ids", "uint64", dim=SLOT_W)])
+    exe = pt.AsyncExecutor(pt.CPUPlace())
+    with pytest.raises(pt.core.enforce.EnforceNotMet):
+        exe.run(main, feed, ["/nonexistent/part-0"], thread_num=1,
+                fetch=[])

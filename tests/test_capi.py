@@ -1,0 +1,56 @@
+"""C inference ABI: build libpaddle_tpu_capi.so + the pure-C++ demo, save
+a model from Python, serve it from the C++ process (ref
+inference/api/paddle_api.h:134 PaddlePredictor ABI; test pattern:
+inference/tests/book C++ round trips)."""
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import book
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "paddle_tpu", "fast", "predictor_demo")
+
+
+def _build():
+    r = subprocess.run(["make", "capi", "demo"],
+                       cwd=os.path.join(REPO, "native"),
+                       capture_output=True, text=True)
+    return r.returncode == 0, r.stderr
+
+
+@pytest.mark.skipif(shutil.which("g++") is None
+                    or shutil.which("python3-config") is None,
+                    reason="native toolchain unavailable")
+def test_c_abi_serves_saved_model(tmp_path):
+    ok, err = _build()
+    assert ok, f"native build failed:\n{err}"
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        feeds, loss, pred = book.fit_a_line(x_dim=13)
+        pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    x = np.random.RandomState(0).randn(4, 13).astype("f4")
+    exe.run(main, feed={"x": x, "y": np.zeros((4, 1), "f4")},
+            fetch_list=[loss])
+    model_dir = str(tmp_path / "model")
+    pt.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                               main_program=main)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    site_pkgs = next(p for p in sys.path if p.endswith("site-packages"))
+    r = subprocess.run([DEMO, model_dir, f"{site_pkgs}:{REPO}", "x", "13"],
+                       capture_output=True, text=True, env=env, cwd=REPO,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "C-ABI OK: 1 outputs" in r.stdout
+    assert "shape=[2,1]" in r.stdout
