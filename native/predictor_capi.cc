@@ -46,7 +46,8 @@ struct ptpu_predictor {
   long pid;
 };
 
-static std::string g_last_error;
+// per-thread, errno-style: each host thread reads its own last error
+static thread_local std::string g_last_error;
 
 static void set_error_from_python() {
   PyObject *type, *value, *tb;
@@ -104,6 +105,8 @@ int ptpu_init(const char* extra_sys_paths) {
   return rc;
 }
 
+void ptpu_out_tensor_free(ptpu_out_tensor* t);
+
 static PyObject* bridge() {
   return PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
 }
@@ -134,6 +137,7 @@ ptpu_predictor* ptpu_predictor_create(const char* model_dir,
 int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
                        ptpu_out_tensor* outs, int max_out) {
   PyGILState_STATE gil = PyGILState_Ensure();
+  g_last_error.clear();
   int n_out = -1;
   PyObject *mod = nullptr, *names = nullptr, *dtypes = nullptr,
            *shapes = nullptr, *buffers = nullptr, *result = nullptr;
@@ -170,8 +174,15 @@ int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
       std::snprintf(outs[i].name, sizeof(outs[i].name), "%s", nm);
       outs[i].dtype = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(tup, 1)));
       PyObject* shp = PyTuple_GetItem(tup, 2);
-      outs[i].rank = static_cast<int>(PyTuple_Size(shp));
-      for (int d = 0; d < outs[i].rank && d < 8; ++d) {
+      int rank = static_cast<int>(PyTuple_Size(shp));
+      if (rank > 8) {   // shape[] holds 8 dims; refuse rather than truncate
+        g_last_error = "output tensor rank > 8 unsupported by the C ABI";
+        for (Py_ssize_t j = 0; j < i; ++j) ptpu_out_tensor_free(&outs[j]);
+        n_out = -1;
+        break;
+      }
+      outs[i].rank = rank;
+      for (int d = 0; d < rank; ++d) {
         outs[i].shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shp, d));
       }
       PyObject* raw = PyTuple_GetItem(tup, 3);
@@ -183,7 +194,7 @@ int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
       std::memcpy(outs[i].data, buf, outs[i].nbytes);
     }
   } while (false);
-  if (n_out < 0) set_error_from_python();
+  if (n_out < 0 && g_last_error.empty()) set_error_from_python();
   Py_XDECREF(result);
   Py_XDECREF(buffers);
   Py_XDECREF(shapes);

@@ -82,6 +82,10 @@ class DataFeedDesc:
                     f"MultiSlot parse error: line ended before slot "
                     f"{slot.name!r}: {line[:80]!r}")
             n = int(parts[i])
+            if i + 1 + n > len(parts):
+                raise EnforceNotMet(
+                    f"MultiSlot parse error: slot {slot.name!r} declares "
+                    f"{n} values but the line ends early: {line[:80]!r}")
             vals = parts[i + 1:i + 1 + n]
             i += 1 + n
             if not slot.is_used:

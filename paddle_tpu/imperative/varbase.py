@@ -100,6 +100,9 @@ class Tape:
         self.entries.append(_TapeEntry(fn, in_vars, out_vars))
 
     def backward(self, root: VarBase):
+        # replaying entry closures rewinds the shared RNG counter; save
+        # and restore it so ops traced after backward() draw fresh keys
+        counter_after_forward = self._ctx._counter
         grads: Dict[int, jnp.ndarray] = {
             id(root): jnp.ones_like(root.value)}
         for entry in reversed(self.entries):
@@ -126,6 +129,11 @@ class Tape:
             g = grads.get(vid)
             if g is not None and not v.stop_gradient:
                 v.grad = (g if v.grad is None else v.grad + g)
+        self._ctx._counter = counter_after_forward
+        # the tape is single-use (like the reference's grad-op chain):
+        # free intermediates so a training loop inside one guard() stays
+        # O(step) in time and memory
+        self.entries.clear()
 
 
 _tape_stack: List[Tape] = []

@@ -849,3 +849,20 @@ def where(condition, x, y):
     helper.append_op("where", {"Condition": [condition], "X": [x],
                                "Y": [y]}, {"Out": [out]}, {})
     return out
+
+
+def fused_lm_head_loss(x, vocab_size, label, param_attr=None,
+                       chunk_size=4096, name=None):
+    """Chunked remat LM head + mean softmax-CE in ONE op (owns the
+    [D, V] head weight).  Replaces fc -> softmax_with_cross_entropy ->
+    mean for big-vocab LMs without materializing [N, V] logits; see
+    ops/attention_ops.py fused_lm_head_loss."""
+    helper = LayerHelper("fused_lm_head_loss", name=name)
+    d = int(x.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[d, vocab_size],
+                                dtype=x.dtype)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fused_lm_head_loss",
+                     {"X": [x], "W": [w], "Label": [label]},
+                     {"Loss": [loss]}, {"chunk_size": chunk_size})
+    return loss

@@ -706,9 +706,11 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     sb = helper.startup_program.global_block()
     if not sb.has_var(name):
         sb.create_var(name, shape=[1], dtype="int64", persistable=True)
+        # init to begin-step: the in-program increment runs before the
+        # first read, so the first observed value is exactly `begin`
         sb.append_op("fill_constant", outputs={"Out": [name]},
                      attrs={"shape": [1], "dtype": "int64",
-                            "value": int(begin)})
+                            "value": int(begin) - int(step)})
     block.append_op("increment_loop_counter", {"X": [name]},
                     {"Out": [name]}, {"step": int(step)})
     return ctr

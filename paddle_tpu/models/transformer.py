@@ -264,7 +264,7 @@ def build_train_net(cfg: TransformerConfig, src_len: int, tgt_len: int,
 
 
 def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
-                 fused_attention: bool = True):
+                 fused_attention: bool = True, fused_head: bool = False):
     """Decoder-only causal LM on the encoder stack (the flagship bench
     config; the reference's closest analogue is the language-model rows of
     benchmark/fluid/).  Feeds: tokens [B,T] int64, labels [B,T] int64 —
@@ -288,6 +288,13 @@ def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
                           cfg.d_model, cfg.d_inner, dropout,
                           causal=True, fused=fused_attention)
     x = pre_post_process(None, x, "n")
+    if fused_head:
+        # chunked remat head: no [N, V] logits in HBM (fwd or bwd)
+        x2d = layers.reshape(x, [-1, cfg.d_model])
+        label1d = layers.reshape(labels, [-1])
+        avg_cost = layers.fused_lm_head_loss(x2d, cfg.src_vocab_size,
+                                             label1d)
+        return [tokens, labels], avg_cost, avg_cost
     logits = layers.fc(x, size=cfg.src_vocab_size, num_flatten_dims=2,
                        bias_attr=False)
     logits2d = layers.reshape(logits, [-1, cfg.src_vocab_size])

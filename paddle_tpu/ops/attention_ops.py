@@ -7,10 +7,11 @@ program op that lowers to kernels/flash_attention.py, O(T) memory.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core import flags
-from ..framework.registry import register_op
+from ..framework.registry import register_op, single_input
 
 
 @register_op("fused_attention")
@@ -47,3 +48,53 @@ def _fused_attention(ctx, ins, attrs):
         o = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
     out = o.transpose(0, 2, 1, 3).reshape(B, T, E).astype(orig_dtype)
     return {"Out": [out]}
+
+
+@register_op("fused_lm_head_loss")
+def _fused_lm_head_loss(ctx, ins, attrs):
+    """Chunked, rematerialized LM-head + softmax-CE (TPU-first fusion;
+    the reference computes fc -> softmax_with_cross_entropy materializing
+    the full [N, V] logits — operators/softmax_with_cross_entropy_op.cc).
+
+    X [N, D] activations, W [D, V] head weights, Label [N] int ->
+    Loss [1] = mean_n (logsumexp(x_n W) - (x_n W)[y_n]).
+
+    lax.scan over token chunks with jax.checkpoint: HBM holds only one
+    [chunk, V] logits block at a time, forward AND backward (backward
+    recomputes each block), instead of full [N, V] fwd plus its
+    gradient — the dominant HBM cost of big-vocab LM training.  Matmul
+    runs in bf16 under FLAGS_amp_bf16 with f32 accumulation.
+    """
+    from .math_ops import amp_inputs
+    x = single_input(ins, "X")
+    w = single_input(ins, "W")
+    label = single_input(ins, "Label").reshape(-1).astype(jnp.int32)
+    n, d = x.shape
+    chunk = int(attrs.get("chunk_size", 2048))
+    chunk = min(chunk, n)
+    n_chunks = (n + chunk - 1) // chunk
+    pad = n_chunks * chunk - n
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        label = jnp.pad(label, (0, pad), constant_values=-1)
+    xs = x.reshape(n_chunks, chunk, d)
+    ys = label.reshape(n_chunks, chunk)
+
+    def chunk_loss(w, x_c, y_c):
+        xb, wb = amp_inputs(x_c, w)
+        logits = jnp.matmul(xb, wb,
+                            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, jnp.maximum(y_c, 0)[:, None], axis=1)[:, 0]
+        valid = (y_c >= 0).astype(jnp.float32)
+        return jnp.sum((lse - gold) * valid)
+
+    remat_loss = jax.checkpoint(chunk_loss)
+
+    def body(acc, xy):
+        x_c, y_c = xy
+        return acc + remat_loss(w, x_c, y_c), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ys))
+    return {"Loss": [(total / n).reshape(1)]}

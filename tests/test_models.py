@@ -170,3 +170,43 @@ def test_adam_state_signature_stable():
         exe.run(pt.default_main_program(), feed=feed, fetch_list=[loss])
     assert len(exe._cache) == 2, (
         f"executor recompiled: {len(exe._cache)} cache entries")
+
+
+def test_fused_lm_head_matches_unfused():
+    """fused_lm_head_loss (chunked remat) == fc + softmax_with_cross_
+    entropy + mean, loss AND gradient step."""
+    rng = np.random.RandomState(5)
+    V, D, N = 97, 16, 24
+    x = rng.randn(N, D).astype("float32") * 0.5
+    w = rng.randn(D, V).astype("float32") * 0.1
+    y = rng.randint(0, V, (N,)).astype("int64")
+
+    def build(fused):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.layers.data("x", [D])
+            yv = pt.layers.data("y", [], dtype="int64")
+            if fused:
+                loss = pt.layers.fused_lm_head_loss(
+                    xv, V, yv, param_attr=pt.ParamAttr("head_w"),
+                    chunk_size=7)      # deliberately ragged chunks
+            else:
+                logits = pt.layers.fc(xv, size=V, bias_attr=False,
+                                      param_attr=pt.ParamAttr("head_w"))
+                y2 = pt.layers.reshape(yv, [-1, 1])
+                loss = pt.layers.mean(
+                    pt.layers.softmax_with_cross_entropy(logits, y2))
+            pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.global_scope().set_var("head_w", w.copy())
+        l1, = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        l2, = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        return float(np.asarray(l1).ravel()[0]), float(
+            np.asarray(l2).ravel()[0])
+
+    f1, f2 = build(True)
+    u1, u2 = build(False)
+    assert abs(f1 - u1) < 1e-4          # same loss
+    assert abs(f2 - u2) < 1e-3          # same post-SGD-step loss (grads)
+    assert f2 < f1                       # and it trains

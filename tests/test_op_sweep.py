@@ -859,6 +859,7 @@ EXEMPT = {
     "static_rnn_scan": "control flow — tests/test_control_flow.py",
     "delete_var": "documented no-op (XLA owns liveness)",
     "fused_attention": "tests/test_pallas_kernels.py",
+    "fused_lm_head_loss": "tests/test_models.py fused-vs-unfused parity",
     "c_allreduce_sum": "mesh collective — tests/test_parallel_executor.py",
     "c_allreduce_max": "mesh collective",
     "c_allreduce_mean": "mesh collective",
