@@ -82,7 +82,7 @@ class DataFeedDesc:
                     f"MultiSlot parse error: line ended before slot "
                     f"{slot.name!r}: {line[:80]!r}")
             n = int(parts[i])
-            if i + 1 + n > len(parts):
+            if n < 0 or i + 1 + n > len(parts):
                 raise EnforceNotMet(
                     f"MultiSlot parse error: slot {slot.name!r} declares "
                     f"{n} values but the line ends early: {line[:80]!r}")

@@ -92,14 +92,22 @@ class Tape:
     def __init__(self, seed: int = 0):
         self.entries: List[_TapeEntry] = []
         self._ctx = LowerContext(jax.random.PRNGKey(seed))
+        self._consumed = False
 
     def ctx(self) -> LowerContext:
         return self._ctx
 
     def record(self, fn, in_vars, out_vars):
+        self._consumed = False
         self.entries.append(_TapeEntry(fn, in_vars, out_vars))
 
     def backward(self, root: VarBase):
+        if self._consumed:
+            raise EnforceNotMet(
+                "tape already consumed by a previous backward(); trace "
+                "the forward again inside the guard before another "
+                "backward (the tape is single-use, like the reference's "
+                "grad-op chain)")
         # replaying entry closures rewinds the shared RNG counter; save
         # and restore it so ops traced after backward() draw fresh keys
         counter_after_forward = self._ctx._counter
@@ -134,6 +142,7 @@ class Tape:
         # free intermediates so a training loop inside one guard() stays
         # O(step) in time and memory
         self.entries.clear()
+        self._consumed = True
 
 
 _tape_stack: List[Tape] = []

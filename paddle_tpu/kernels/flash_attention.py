@@ -149,23 +149,25 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
 
 def _flash_bwd(scale, causal, kv_len, res, do):
     """Blockwise recompute backward (FlashAttention-2 recurrence) — pure
-    XLA lax.scan, no [T,T] HBM tensor."""
+    XLA lax.scan, no [T,T] HBM tensor.  Matmuls run in the INPUT dtype
+    (bf16 under AMP — full MXU rate) with f32 accumulation; the softmax
+    recompute (exp, the (dp - D) correction) stays f32."""
     q, k, v, o, lse = res
     BH, T, d = q.shape
     blk = _pick_block(T, 128)
     nb = T // blk
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    D = jnp.sum(dof * o.astype(jnp.float32), axis=-1)       # [BH, T]
+    mm = q.dtype                          # matmul operand dtype
+    dom = do.astype(mm)
+    D = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1)                                    # [BH, T]
     q_idx = jnp.arange(T)
 
     def kv_block(carry, bi):
         dq = carry
-        ks = lax.dynamic_slice_in_dim(kf, bi * blk, blk, axis=1)
-        vs = lax.dynamic_slice_in_dim(vf, bi * blk, blk, axis=1)
-        s = jnp.einsum("bqd,bkd->bqk", qf, ks) * scale
+        ks = lax.dynamic_slice_in_dim(k, bi * blk, blk, axis=1)
+        vs = lax.dynamic_slice_in_dim(v, bi * blk, blk, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", q, ks,
+                       preferred_element_type=jnp.float32) * scale
         k_pos = bi * blk + jnp.arange(blk)
         if causal:
             mask = q_idx[:, None] >= k_pos[None, :]
@@ -173,14 +175,20 @@ def _flash_bwd(scale, causal, kv_len, res, do):
         if kv_len is not None:
             s = jnp.where((k_pos < kv_len)[None, None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, :, None])                    # [BH, T, blk]
-        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, vs)
+        pm = p.astype(mm)
+        dv = jnp.einsum("bqk,bqd->bkd", pm, dom,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqd,bkd->bqk", dom, vs,
+                        preferred_element_type=jnp.float32)
         ds = p * (dp - D[:, :, None]) * scale
-        dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, ks)
+        dsm = ds.astype(mm)
+        dk = jnp.einsum("bqk,bqd->bkd", dsm, q,
+                        preferred_element_type=jnp.float32)
+        dq = dq + jnp.einsum("bqk,bkd->bqd", dsm, ks,
+                             preferred_element_type=jnp.float32)
         return dq, (dk, dv)
 
-    dq0 = jnp.zeros_like(qf)
+    dq0 = jnp.zeros((BH, T, d), jnp.float32)
     dq, (dks, dvs) = lax.scan(kv_block, dq0, jnp.arange(nb))
     dk = jnp.moveaxis(dks, 0, 1).reshape(BH, T, d)
     dv = jnp.moveaxis(dvs, 0, 1).reshape(BH, T, d)

@@ -271,7 +271,9 @@ def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
     dense batches, causal masking inside the attention op (flash kernel
     when fused_attention), no LoD.
 
-    Returns (feeds, avg_cost, logits)."""
+    Returns (feeds, avg_cost, logits); with fused_head=True the logits
+    are never materialized (chunked remat head) so the third element is
+    None."""
     dropout = 0.0 if is_test else cfg.dropout
     tokens = layers.data("tokens", [seq_len], dtype="int64")
     labels = layers.data("labels", [seq_len], dtype="int64")
@@ -294,7 +296,7 @@ def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
         label1d = layers.reshape(labels, [-1])
         avg_cost = layers.fused_lm_head_loss(x2d, cfg.src_vocab_size,
                                              label1d)
-        return [tokens, labels], avg_cost, avg_cost
+        return [tokens, labels], avg_cost, None
     logits = layers.fc(x, size=cfg.src_vocab_size, num_flatten_dims=2,
                        bias_attr=False)
     logits2d = layers.reshape(logits, [-1, cfg.src_vocab_size])
