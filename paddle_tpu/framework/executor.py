@@ -178,6 +178,60 @@ class _CompiledProgram:
                   "at most one autodiff op per program is supported")
         self._ad_idx = ad_idx[0] if ad_idx else None
         jit_kwargs = {"donate_argnums": (0,) if donate else ()}
+        spmd_axis = getattr(program, "_dist_spmd_axis", None)
+        if spmd_axis is not None and mesh is None:
+            raise EnforceNotMet(
+                f"this program was rewritten by DistributeTranspiler "
+                f"(collectives over axis {spmd_axis!r}); run it with "
+                f"Executor(place, mesh=...) so the axis is in scope")
+        if mesh is not None and spmd_axis is not None:
+            # Explicit-collective SPMD (the DistributeTranspiler plane):
+            # the program carries its own c_allreduce/scale ops (the
+            # reference's nccl2-mode transformation), so run the step
+            # under shard_map with the axis in scope instead of leaving
+            # collective insertion to XLA sharding propagation.
+            from jax.experimental.shard_map import shard_map
+            P = jax.sharding.PartitionSpec
+            if spmd_axis not in mesh.shape:
+                raise EnforceNotMet(
+                    f"program was transpiled over axis {spmd_axis!r} but "
+                    f"the mesh axes are {tuple(mesh.shape)}; build the "
+                    f"mesh with that axis name (or transpile with "
+                    f"axis_name matching the mesh)")
+            n_expect = getattr(program, "_dist_trainers", None)
+            axis_size = int(mesh.shape[spmd_axis])
+            if n_expect is not None and n_expect != axis_size:
+                raise EnforceNotMet(
+                    f"program was transpiled for {n_expect} trainers but "
+                    f"mesh axis {spmd_axis!r} has {axis_size} devices")
+            block = program.global_block()
+
+            def feed_spec(name):
+                if block.has_var(name) and block.var(name).is_data:
+                    return P(spmd_axis)
+                return P()
+
+            inner = self._step
+
+            def spmd_step(state, feeds, key):
+                # distinct randomness per shard (dropout etc.), like the
+                # single-trace path where each example draws its own mask
+                key = jax.random.fold_in(key,
+                                         jax.lax.axis_index(spmd_axis))
+                fetches, new_state = inner(state, feeds, key)
+                # per-shard fetches gain a leading shard axis on the host
+                return [jnp.asarray(f)[None] for f in fetches], new_state
+
+            sm = shard_map(
+                spmd_step, mesh=mesh,
+                in_specs=({n: P() for n in self.in_state_names},
+                          {n: feed_spec(n) for n in self.feed_names},
+                          P()),
+                out_specs=([P(spmd_axis)] * len(self.fetch_names),
+                           {n: P() for n in self.out_state_names}),
+                check_rep=False)
+            self._jitted = jax.jit(sm, **jit_kwargs)
+            return
         if mesh is not None:
             # SPMD plane: feeds shard along the batch axis, persistable
             # state follows each Parameter's PartitionSpec (replicated by

@@ -319,13 +319,22 @@ class Program:
 
     # -- serialization (ref ProgramDesc proto; JSON here) ------------------
     def to_dict(self):
-        return {"version": 1, "random_seed": self.random_seed,
-                "blocks": [b.to_dict() for b in self.blocks]}
+        d = {"version": 1, "random_seed": self.random_seed,
+             "blocks": [b.to_dict() for b in self.blocks]}
+        # DistributeTranspiler markers must survive clone/save/load —
+        # the inserted c_allreduce ops are meaningless without them
+        if getattr(self, "_dist_spmd_axis", None) is not None:
+            d["dist_spmd_axis"] = self._dist_spmd_axis
+            d["dist_trainers"] = getattr(self, "_dist_trainers", None)
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Program":
         p = Program()
         p.random_seed = d.get("random_seed")
+        if d.get("dist_spmd_axis") is not None:
+            p._dist_spmd_axis = d["dist_spmd_axis"]
+            p._dist_trainers = d.get("dist_trainers")
         # recreate blocks
         for bd in d["blocks"][1:]:
             b = Block(p, bd["idx"], bd["parent_idx"])

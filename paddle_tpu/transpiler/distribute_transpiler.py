@@ -18,10 +18,14 @@ shard huge params) is delivered by collectives over ICI/DCN:
   async pserver / DC-ASGD      -> not reproduced: sync collectives are
                                   strictly faster on ICI; documented gap
 
-This class keeps the reference's API so multi-role scripts run: transpile()
-validates the role layout, get_trainer_program() returns the (unchanged)
-program annotated with a data-parallel mesh hint, and get_pserver_program()
-raises with migration guidance — there are no parameter servers to run.
+This class keeps the reference's API and performs the nccl2-mode program
+transformation for real: transpile(trainers=N) inserts a
+(c_allreduce_sum, scale 1/N) pair per gradient after the backward —
+the reference's InsertAllReduceOp + CreateScaleLossGradOp — and marks
+the program so the Executor runs it under shard_map with the mesh axis
+in scope.  get_pserver_program() raises with migration guidance — there
+are no parameter servers to run.  Tested for op-structure and for loss
+parity vs single-device training in tests/test_dist_transpiler.py.
 """
 from __future__ import annotations
 
@@ -48,7 +52,15 @@ class DistributeTranspiler:
     def transpile(self, trainer_id: int, program: Optional[Program] = None,
                   pservers: str = "", trainers: int = 1,
                   sync_mode: bool = True, startup_program=None,
-                  current_endpoint: str = ""):
+                  current_endpoint: str = "", axis_name: str = "data"):
+        """Rewrite the program for collective data parallelism — the
+        nccl2-mode transformation (ref distribute_transpiler.py:213 +
+        multi_devices_graph_pass.cc InsertAllReduceOp:572 /
+        CreateScaleLossGradOp:663): after the backward, every gradient
+        is allreduce-summed over the mesh axis and scaled by 1/trainers,
+        in place (the optimizer ops downstream read the same var names).
+        The program is marked so the Executor runs it under shard_map
+        with the axis in scope."""
         self.trainer_id = trainer_id
         self.trainer_num = trainers
         self.program = program or default_main_program()
@@ -59,13 +71,40 @@ class DistributeTranspiler:
                 "async pserver mode has no TPU equivalent; proceeding with "
                 "synchronous collective data parallelism (strictly faster "
                 "over ICI)")
+        if trainers > 1:
+            self._insert_grad_allreduce(axis_name)
         self._transpiled = True
         return self
 
+    def _insert_grad_allreduce(self, axis_name: str = "data"):
+        block = self.program.global_block()
+        ad_idx = [i for i, op in enumerate(block.ops)
+                  if op.type == "autodiff"]
+        if not ad_idx:
+            return                      # inference program: nothing to do
+        idx = ad_idx[0]
+        grads = list(block.ops[idx].attrs.get("grads", []))
+        insert_at = idx + 1
+        for g in grads:
+            ar = g + "@ALLREDUCE"
+            if not block.has_var(ar):
+                block.create_var(name=ar, dtype="float32")
+            # sum over the data axis, then 1/N — writes BACK to the grad
+            # var so the optimizer ops need no rewiring
+            block.append_op("c_allreduce_sum", {"X": [g]}, {"Out": [ar]},
+                            {"axis_name": axis_name}, index=insert_at)
+            block.append_op("scale", {"X": [ar]}, {"Out": [g]},
+                            {"scale": 1.0 / self.trainer_num},
+                            index=insert_at + 1)
+            insert_at += 2
+        self.program._dist_spmd_axis = axis_name
+        self.program._dist_trainers = self.trainer_num
+
     def get_trainer_program(self, wait_port: bool = True) -> Program:
         assert self._transpiled, "call transpile() first"
-        # data parallelism is a sharding, not a program rewrite: run this
-        # program with ParallelExecutor(mesh=...) or Executor(mesh=...)
+        # run with Executor(mesh=...) — the _dist_spmd_axis marker makes
+        # the compiled step execute under shard_map so the inserted
+        # collectives have their axis in scope
         return self.program
 
     def get_pserver_program(self, endpoint: str) -> Program:

@@ -1,0 +1,129 @@
+"""DistributeTranspiler: structural assertions on the rewritten program
+(the reference's test_dist_transpiler.py pattern) + loss parity of the
+transpiled program on an 8-device mesh vs single-device training."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.place import make_mesh
+
+rng = np.random.RandomState(0)
+X = rng.randn(16, 8).astype("f4")
+Y = (X[:, :1] > 0).astype("i8")
+
+
+def build():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, size=4, act="tanh")
+        p = layers.fc(h, size=2, act="softmax")
+        loss = layers.mean(layers.cross_entropy(p, y))
+        pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    return main, startup, loss
+
+
+def test_transpile_inserts_allreduce_scale_pairs():
+    main, startup, loss = build()
+    before = [op.type for op in main.global_block().ops]
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=4)
+    prog = t.get_trainer_program()
+    ops = [op.type for op in prog.global_block().ops]
+    n_grads = len(main.global_block().ops[
+        [o.type for o in main.global_block().ops].index("autodiff")]
+        .attrs["grads"])
+    # one (c_allreduce_sum, scale) pair per gradient, inserted after
+    # the autodiff op and before the optimizer ops
+    assert ops.count("c_allreduce_sum") == n_grads
+    assert ops.count("scale") == before.count("scale") + n_grads
+    ad = ops.index("autodiff")
+    first_opt = ops.index("sgd")
+    ar_positions = [i for i, o in enumerate(ops) if o == "c_allreduce_sum"]
+    assert all(ad < i < first_opt for i in ar_positions)
+    # scale factor is 1/trainers, writing back to the grad var
+    block = prog.global_block()
+    scale_ops = [op for op in block.ops if op.type == "scale"
+                 and op.inputs["X"][0].endswith("@ALLREDUCE")]
+    assert all(abs(op.attrs["scale"] - 0.25) < 1e-9 for op in scale_ops)
+    assert prog._dist_spmd_axis == "data"
+    assert prog._dist_trainers == 4
+
+
+def test_transpile_single_trainer_is_identity():
+    main, startup, loss = build()
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=1)
+    ops = [op.type for op in t.get_trainer_program().global_block().ops]
+    assert "c_allreduce_sum" not in ops
+    assert getattr(t.get_trainer_program(), "_dist_spmd_axis", None) is None
+
+
+def test_transpiled_program_matches_single_device():
+    main, startup, loss = build()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    ref = []
+    for _ in range(5):
+        out, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        ref.append(float(np.asarray(out).ravel()[0]))
+
+    main2, startup2, loss2 = build()
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main2, trainers=8)
+    prog = t.get_trainer_program()
+    mesh = make_mesh((8,), ("data",))
+    exe2 = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe2.run(startup2)
+    dist = []
+    for _ in range(5):
+        out, = exe2.run(prog, feed={"x": X, "y": Y}, fetch_list=[loss2])
+        # per-shard losses come back stacked along the shard axis
+        assert np.asarray(out).shape[0] == 8
+        dist.append(float(np.mean(np.asarray(out))))
+    assert all(abs(a - b) < 1e-4 for a, b in zip(ref, dist)), (ref, dist)
+
+
+def test_mesh_size_mismatch_raises():
+    main, startup, loss = build()
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=4)
+    mesh = make_mesh((8,), ("data",))
+    exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe.run(startup)
+    with pytest.raises(pt.core.enforce.EnforceNotMet):
+        exe.run(t.get_trainer_program(), feed={"x": X, "y": Y},
+                fetch_list=[loss])
+
+
+def test_pserver_program_still_guides():
+    main, startup, loss = build()
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=2)
+    with pytest.raises(NotImplementedError):
+        t.get_pserver_program("127.0.0.1:6174")
+
+
+def test_markers_survive_clone_and_serde():
+    main, startup, loss = build()
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=8)
+    prog = t.get_trainer_program()
+    rt = pt.Program.from_dict(prog.to_dict())
+    assert rt._dist_spmd_axis == "data" and rt._dist_trainers == 8
+    cl = prog.clone()
+    assert getattr(cl, "_dist_spmd_axis", None) == "data"
+
+
+def test_transpiled_without_mesh_raises_clearly():
+    main, startup, loss = build()
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=8)
+    exe = pt.Executor(pt.CPUPlace())          # no mesh
+    exe.run(startup)
+    with pytest.raises(pt.core.enforce.EnforceNotMet,
+                       match="DistributeTranspiler"):
+        exe.run(t.get_trainer_program(), feed={"x": X, "y": Y},
+                fetch_list=[loss])
