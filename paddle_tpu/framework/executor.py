@@ -190,7 +190,10 @@ class _CompiledProgram:
             # reference's nccl2-mode transformation), so run the step
             # under shard_map with the axis in scope instead of leaving
             # collective insertion to XLA sharding propagation.
-            from jax.experimental.shard_map import shard_map
+            try:
+                from jax import shard_map        # jax >= 0.8
+            except ImportError:
+                from jax.experimental.shard_map import shard_map
             P = jax.sharding.PartitionSpec
             if spmd_axis not in mesh.shape:
                 raise EnforceNotMet(
@@ -222,14 +225,17 @@ class _CompiledProgram:
                 # per-shard fetches gain a leading shard axis on the host
                 return [jnp.asarray(f)[None] for f in fetches], new_state
 
-            sm = shard_map(
-                spmd_step, mesh=mesh,
+            sm_kwargs = dict(
+                mesh=mesh,
                 in_specs=({n: P() for n in self.in_state_names},
                           {n: feed_spec(n) for n in self.feed_names},
                           P()),
                 out_specs=([P(spmd_axis)] * len(self.fetch_names),
-                           {n: P() for n in self.out_state_names}),
-                check_rep=False)
+                           {n: P() for n in self.out_state_names}))
+            try:        # jax >= 0.8 renamed check_rep -> check_vma
+                sm = shard_map(spmd_step, check_vma=False, **sm_kwargs)
+            except TypeError:
+                sm = shard_map(spmd_step, check_rep=False, **sm_kwargs)
             self._jitted = jax.jit(sm, **jit_kwargs)
             return
         if mesh is not None:

@@ -27,3 +27,4 @@ from . import detection_ops
 from . import collective_ops
 from . import attention_ops
 from . import quantize_ops
+from . import fused_ops

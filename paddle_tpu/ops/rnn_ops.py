@@ -74,15 +74,18 @@ def _lstm(ctx, ins, attrs):
         if mask_seq is not None:
             h_new = m * h_new + (1 - m) * h
             c_new = m * c_new + (1 - m) * c
-        return (h_new, c_new), h_new
+        return (h_new, c_new), (h_new, c_new)
 
-    (h_last, c_last), hs = lax.scan(
+    (h_last, c_last), (hs, cs) = lax.scan(
         step, (h0, c0),
         xt_seq if mask_seq is None else (xt_seq, mask_seq))
     if reverse:
         hs = hs[::-1]
+        cs = cs[::-1]
     hidden = jnp.swapaxes(hs, 0, 1)                     # [B,T,H]
-    return {"Hidden": [hidden], "LastH": [h_last], "LastC": [c_last]}
+    cell = jnp.swapaxes(cs, 0, 1)                       # [B,T,H]
+    return {"Hidden": [hidden], "Cell": [cell],
+            "LastH": [h_last], "LastC": [c_last]}
 
 
 @register_op("gru")

@@ -844,6 +844,63 @@ SPECS = {
                    "BatchIdx": np.array([0, 1], "int64")},
         "attrs": {"transformed_height": 6, "transformed_width": 5},
         "outs": ["Out", "Mask"]},
+    # --- fused-op family (ops/fused_ops.py) ------------------------------
+    "fc": {"inputs": {"Input": f32(3, 4), "W": f32(4, 5),
+                      "Bias": f32(5)},
+           "attrs": {"activation_type": "relu"}, "outs": ["Out"]},
+    "fused_elemwise_activation": {
+        "inputs": {"X": f32(2, 6), "Y": f32(2, 6)},
+        "attrs": {"functor_list": ["elementwise_add", "relu"]},
+        "outs": ["Out"]},
+    "conv2d_fusion": {
+        "inputs": {"Input": f32(1, 3, 8, 8), "Filter": f32(4, 3, 3, 3),
+                   "Bias": f32(4)},
+        "attrs": {"strides": 1, "paddings": 1, "activation": "relu"},
+        "outs": ["Output"]},
+    "fusion_lstm": {
+        "inputs": {"X": f32(2, 5, 6), "WeightX": f32(6, 16),
+                   "WeightH": f32(4, 16), "Bias": f32(16)},
+        "attrs": {}, "outs": ["Hidden", "Cell"]},
+    "fusion_gru": {
+        "inputs": {"X": f32(2, 5, 6), "WeightX": f32(6, 12),
+                   "WeightH": f32(4, 12), "Bias": f32(12)},
+        "attrs": {}, "outs": ["Hidden"]},
+    "fused_embedding_fc_lstm": {
+        "inputs": {"Ids": i64(2, 5, hi=9), "Embeddings": f32(9, 16),
+                   "WeightH": f32(4, 16), "Bias": f32(16)},
+        "attrs": {}, "outs": ["Hidden", "Cell"]},
+    "attention_lstm": {
+        "inputs": {"X": f32(2, 5, 6), "AttentionWeight": f32(10, 1),
+                   "LSTMWeight": f32(10, 16), "LSTMBias": f32(16)},
+        "attrs": {}, "outs": ["Hidden", "Cell"]},
+    "fusion_seqconv_eltadd_relu": {
+        "inputs": {"X": f32(2, 5, 4), "Filter": f32(12, 6),
+                   "Bias": f32(6)},
+        "attrs": {"contextLength": 3}, "outs": ["Out"]},
+    "fusion_seqexpand_concat_fc": {
+        "inputs": {"X": [f32(2, 5, 4), f32(2, 3)],
+                   "FCWeight": f32(7, 6), "FCBias": f32(6)},
+        "attrs": {"fc_activation": "relu"}, "outs": ["Out"]},
+    "fusion_transpose_flatten_concat": {
+        "inputs": {"X": [f32(2, 3, 4), f32(2, 3, 4)]},
+        "attrs": {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+                  "concat_axis": 1}, "outs": ["Out"]},
+    "depthwise_conv2d_transpose": {
+        "inputs": {"Input": f32(1, 3, 5, 5), "Filter": f32(3, 1, 2, 2)},
+        "attrs": {"strides": 2, "paddings": 0}, "outs": ["Output"]},
+    "fake_quantize_range_abs_max": {
+        "inputs": {"X": f32(2, 6)}, "attrs": {"bit_length": 8},
+        "outs": ["Out", "OutScale"]},
+    "fake_init": {"inputs": {}, "attrs": {"shape": [2, 3]},
+                  "outs": ["Out"]},
+    "rnn_memory_helper": {"inputs": {"X": f32(2, 3)}, "attrs": {},
+                          "outs": ["Out"]},
+    "write_to_array": {
+        "inputs": {"X": f32(3, 4), "I": np.array([1], "int64")},
+        "attrs": {"array_len": 4}, "outs": ["Out"]},
+    "read_from_array": {
+        "inputs": {"X": f32(4, 3), "I": np.array([2], "int64")},
+        "attrs": {}, "outs": ["Out"]},
 }
 
 # ops whose execution is validated by dedicated tests / harnesses, or that
@@ -860,6 +917,10 @@ EXEMPT = {
     "delete_var": "documented no-op (XLA owns liveness)",
     "fused_attention": "tests/test_pallas_kernels.py",
     "fused_lm_head_loss": "tests/test_models.py fused-vs-unfused parity",
+    "save": "io op — tests/test_reader_trainer.py save/load-as-ops",
+    "load": "io op — dedicated test",
+    "save_combine": "io op — dedicated test",
+    "load_combine": "io op — dedicated test",
     "c_allreduce_sum": "mesh collective — tests/test_parallel_executor.py",
     "c_allreduce_max": "mesh collective",
     "c_allreduce_mean": "mesh collective",

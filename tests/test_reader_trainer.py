@@ -190,3 +190,45 @@ def test_dataset_breadth_schemas():
     assert hi.shape == (D.mq2007.FEATURE_DIM,)
     qid, rels, feats = take(D.mq2007.train("listwise"))[0]
     assert feats.shape == (len(rels), D.mq2007.FEATURE_DIM)
+
+
+def test_save_load_as_ops_roundtrip(tmp_path):
+    """The reference's checkpoint-as-ops contract (save_op.cc/load_op.cc):
+    a program containing save/load ops persists and restores vars during
+    execution."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    path = str(tmp_path / "var")
+    cpath = str(tmp_path / "combined")
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        v = layers.data("x", [3])
+        b = main.global_block()
+        b.create_var(name="saved_ok", dtype="int32")
+        b.create_var(name="csaved_ok", dtype="int32")
+        b.append_op("save", {"X": ["x"]}, {"Out": ["saved_ok"]},
+                    {"file_path": path})
+        b.append_op("save_combine", {"X": ["x", "x"]},
+                    {"Out": ["csaved_ok"]},
+                    {"file_path": cpath, "var_names": ["a", "b"]})
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed={"x": x}, fetch_list=["saved_ok", "csaved_ok"])
+
+    main2 = pt.Program()
+    with pt.program_guard(main2, pt.Program()):
+        b = main2.global_block()
+        for n in ("loaded", "la", "lb"):
+            b.create_var(name=n, dtype="float32")
+        b.append_op("load", {}, {"Out": ["loaded"]},
+                    {"file_path": path, "shape": [2, 3]})
+        b.append_op("load_combine", {}, {"Out": ["la", "lb"]},
+                    {"file_path": cpath, "var_names": ["a", "b"],
+                     "shapes": [[2, 3], [2, 3]]})
+    loaded, la, lb = exe.run(main2, feed={}, fetch_list=["loaded", "la",
+                                                         "lb"])
+    assert np.allclose(loaded, x)
+    assert np.allclose(la, x) and np.allclose(lb, x)
