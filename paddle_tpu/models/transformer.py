@@ -282,9 +282,15 @@ def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
     if fused_attention:
         attn_bias = None
     else:
-        causal_np = np.triu(np.full((seq_len, seq_len), -1e9,
-                                    dtype="float32"), 1)
-        attn_bias = layers.assign(causal_np[None, None, :, :])
+        # build the causal bias IN-GRAPH from a [T] iota — baking a
+        # [T, T] constant into the program breaks compilation at long T
+        # (e.g. 268MB at T=8192)
+        r = layers.assign(np.arange(seq_len, dtype="float32"))
+        row = layers.reshape(r, [seq_len, 1])
+        col = layers.reshape(r, [1, seq_len])
+        future = layers.cast(layers.greater_than(col, row), "float32")
+        attn_bias = layers.reshape(layers.scale(future, scale=-1e9),
+                                   [1, 1, seq_len, seq_len])
     for _ in range(cfg.n_layer):
         x = encoder_layer(x, attn_bias, cfg.n_head, cfg.d_key, cfg.d_value,
                           cfg.d_model, cfg.d_inner, dropout,

@@ -69,15 +69,17 @@ def _fused_elemwise_activation(ctx, ins, attrs):
     unary = next((f for f in functors if not f.startswith("elementwise")),
                  "identity")
     # attrs pass through to BOTH functors (scale's `scale`, leaky_relu's
-    # `alpha`, the broadcast `axis`, ...)
+    # `alpha`, the broadcast `axis`, ...).  Reference order contract
+    # (fused_elemwise_activation_op.h IsUnaryCompound): functor_list[0]
+    # is the OUTER function.
     sub_attrs = dict(attrs)
-    if functors[0] == binary:          # act(binop(x, y))
-        out = _sub(binary, ctx, {"X": [x], "Y": [y]}, sub_attrs)["Out"][0]
-        out = _sub(unary, ctx, {"X": [out]}, sub_attrs)["Out"][0]
-    else:                              # binop(x, act(y))
+    if functors[0] == binary:          # binop(x, act(y))
         ya = _sub(unary, ctx, {"X": [y]}, sub_attrs)["Out"][0]
         out = _sub(binary, ctx, {"X": [x], "Y": [ya]},
                    sub_attrs)["Out"][0]
+    else:                              # act(binop(x, y))
+        out = _sub(binary, ctx, {"X": [x], "Y": [y]}, sub_attrs)["Out"][0]
+        out = _sub(unary, ctx, {"X": [out]}, sub_attrs)["Out"][0]
     return {"Out": [out]}
 
 
@@ -167,12 +169,13 @@ def _attention_lstm(ctx, ins, attrs):
     H = lw.shape[1] // 4
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+    # the x-part of the score is loop-invariant: project once, add the
+    # h-part per step (no per-step [B,T,D+H] concat)
+    sx = jnp.einsum("btd,dk->btk", x, aw[:D])[..., 0]          # [B,T]
 
     def step(carry, _):
         h, c = carry
-        hx = jnp.concatenate(
-            [x, jnp.broadcast_to(h[:, None], (B, T, H))], axis=-1)
-        score = jnp.einsum("btd,dk->btk", hx, aw)[..., 0]      # [B,T]
+        score = sx + (h @ aw[D:])                              # [B,T]+[B,1]
         alpha = jax.nn.softmax(score, axis=1)
         ctx_vec = jnp.einsum("bt,btd->bd", alpha, x)           # [B,D]
         gates = jnp.concatenate([ctx_vec, h], axis=-1) @ lw + lb
@@ -278,15 +281,24 @@ def _rnn_memory_helper(ctx, ins, attrs):
 
 @register_op("write_to_array", stop_gradient=True)
 def _write_to_array(ctx, ins, attrs):
-    """ref tensor_array_read_write_op.cc: dense tensor-array writes are
-    stacked entries; the 'array' var holds [N, ...] with I selecting
-    the row.  Out must carry the full array (static shapes)."""
+    """ref tensor_array_read_write_op.cc, dense redesign: the 'array'
+    var holds [N, ...] with I selecting the row, and consecutive writes
+    THREAD the array explicitly — wire the previous write's Out into the
+    next write's Array input (static shapes make the array a normal
+    tensor, so there is no hidden mutable state to alias).  The first
+    write of a fresh array instead passes the static `array_len` attr."""
     x = single_input(ins, "X")
     i = single_input(ins, "I").reshape(()).astype(jnp.int32)
     if ins.get("Array"):
         arr = ins["Array"][0]
     else:
-        n = int(attrs.get("array_len", 1))
+        if "array_len" not in attrs:
+            from ..core.enforce import EnforceNotMet
+            raise EnforceNotMet(
+                "write_to_array without an Array input needs the "
+                "array_len attr (the fresh array's length); chained "
+                "writes must thread the previous Out into Array")
+        n = int(attrs["array_len"])
         arr = jnp.zeros((n,) + x.shape, x.dtype)
     return {"Out": [jax.lax.dynamic_update_index_in_dim(arr, x, i,
                                                         axis=0)]}
