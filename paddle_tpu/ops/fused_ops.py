@@ -54,9 +54,9 @@ def _fc(ctx, ins, attrs):
 
 @register_op("fused_elemwise_activation")
 def _fused_elemwise_activation(ctx, ins, attrs):
-    """ref fused/fused_elemwise_activation_op.cc: functor_list like
-    ['elementwise_add', 'relu'] (binary op then unary act, or
-    act(x) then binary)."""
+    """ref fused/fused_elemwise_activation_op.cc — functor_list[0] is
+    the OUTER function: ['elementwise_add', 'relu'] -> x + relu(y);
+    ['relu', 'elementwise_add'] -> relu(x + y)."""
     x, y = ins["X"][0], ins["Y"][0]
     functors = list(attrs.get("functor_list", ["elementwise_add", "relu"]))
     binary = next((f for f in functors if f.startswith("elementwise")),
@@ -394,3 +394,16 @@ def _load_combine(ctx, ins, attrs):
               for sh, d in zip(shapes, dtypes)),
         ordered=True)
     return {"Out": list(outs)}
+
+
+@register_op("get_places", stop_gradient=True)
+def _get_places(ctx, ins, attrs):
+    """ref operators/get_places_op.cc: enumerate available devices (the
+    v1 ParallelDo substrate).  Dense analogue: the local device count
+    (capped by device_count attr), as an int32 scalar — placement itself
+    is the mesh's job on TPU."""
+    n = jax.local_device_count()
+    cap = int(attrs.get("device_count", 0))
+    if cap:
+        n = min(n, cap)
+    return {"Out": [jnp.asarray([n], jnp.int32)]}

@@ -893,6 +893,8 @@ SPECS = {
         "outs": ["Out", "OutScale"]},
     "fake_init": {"inputs": {}, "attrs": {"shape": [2, 3]},
                   "outs": ["Out"]},
+    "get_places": {"inputs": {}, "attrs": {"device_count": 1},
+                   "outs": ["Out"], "skip_finite": True},
     "rnn_memory_helper": {"inputs": {"X": f32(2, 3)}, "attrs": {},
                           "outs": ["Out"]},
     "write_to_array": {
@@ -1048,6 +1050,41 @@ GRAD_CHECK = {
     "prelu": ("X", "Out"), "pad": ("X", "Out"),
     "cumsum": ("X", "Out"), "l1_norm": ("X", "Out"),
     "squared_l2_norm": ("X", "Out"),
+    # breadth sweep: every differentiable op with a smooth-enough spec
+    "sin": ("X", "Out"), "cos": ("X", "Out"),
+    "reciprocal": ("X", "Out"), "rsqrt": ("X", "Out"),
+    "logsigmoid": ("X", "Out"), "softsign": ("X", "Out"),
+    "tanh_shrink": ("X", "Out"), "stanh": ("X", "Out"),
+    "swish": ("X", "Out"), "mish": ("X", "Out"),
+    "elu": ("X", "Out"), "selu": ("X", "Out"),
+    "hard_sigmoid": ("X", "Out"), "soft_relu": ("X", "Out"),
+    "leaky_relu": ("X", "Out"), "pow": ("X", "Out"),
+    "elementwise_max": ("X", "Out"), "elementwise_min": ("X", "Out"),
+    "elementwise_pow": ("X", "Out"),
+    "reduce_prod": ("X", "Out"),
+    "transpose": ("X", "Out"), "concat": ("X", "Out"),
+    "expand": ("X", "Out"), "maxout": ("X", "Out"),
+    "group_norm": ("X", "Y"), "lrn": ("X", "Out"),
+    "pool2d": ("X", "Out"), "pool3d": ("X", "Out"),
+    "im2sequence": ("X", "Out"),
+    "log_loss": ("Predicted", "Loss"), "bpr_loss": ("X", "Y"),
+    "hinge_loss": ("Logits", "Loss"),
+    "rank_loss": ("Left", "Out"), "margin_rank_loss": ("X1", "Out"),
+    "cross_entropy": ("X", "Y"), "label_smooth": ("X", "Out"),
+    "kldiv_loss": ("X", "Loss"),
+    "affine_channel": ("X", "Out"), "grid_sampler": ("X", "Output"),
+    "bilinear_interp": ("X", "Out"),
+    "fc": ("Input", "Out"), "fused_elemwise_activation": ("X", "Out"),
+    "fusion_lstm": ("X", "Hidden"), "fusion_gru": ("X", "Hidden"),
+    "attention_lstm": ("X", "Hidden"),
+    "cudnn_lstm": ("Input", "Out"),
+    "conv2d_transpose": ("Input", "Output"),
+    "conv3d": ("Input", "Output"),
+    "depthwise_conv2d": ("Input", "Output"),
+    # nce: excluded — fresh negative samples per evaluation make
+    # finite differences meaningless (stochastic objective)
+    "add_position_encoding": ("X", "Out"),
+    "squared_l2_distance": ("X", "Out"),
 }
 
 
