@@ -28,9 +28,13 @@ def _acc_type(x):
 
 def amp_inputs(*xs):
     """Under FLAGS_amp_bf16, f32 MXU-op inputs are cast to bfloat16 right
-    before the dot (XLA fuses the convert); accumulation stays f32 and the
-    op's output is cast back to the caller's dtype, so params/activations
-    remain f32 master copies."""
+    before the dot (XLA fuses the convert); dot-style ops keep
+    preferred_element_type=f32 so accumulation is surfaced in f32 and
+    cast back — params/activations remain f32 master copies.
+    EXCEPTION: the conv family omits preferred_element_type (jax's conv
+    transpose rule feeds the f32 cotangent against the bf16 operand and
+    crashes), so conv outputs round through bf16 before the upcast; the
+    MXU still accumulates f32 internally."""
     if flags.get_flag("amp_bf16"):
         xs = tuple(x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x
                    for x in xs)

@@ -11,7 +11,10 @@ TPU-first notes:
  * Layout is NCHW at the API (reference contract); lowering passes explicit
    dimension_numbers to lax.conv_general_dilated and XLA's TPU layout
    assignment picks the efficient internal layout — no manual transposes.
- * Conv/matmul accumulate in f32 when inputs are bf16 (MXU-native).
+ * Matmuls surface f32 accumulation (preferred_element_type); convs
+   compute in the input dtype and upcast after (the MXU still
+   accumulates f32 internally — see math_ops.amp_inputs for why convs
+   cannot use preferred_element_type).
  * batch_norm's running-stat update is the reference's MeanOut/VarianceOut
    in-place contract: outputs write back to the same var names.
  * softmax/layer_norm have Pallas fast paths (kernels/) selected by flag.
@@ -59,14 +62,18 @@ def _conv2d(ctx, ins, attrs):
     from .math_ops import amp_inputs
     orig_dtype = x.dtype
     xc, wc = amp_inputs(x, w)
+    # NOTE: no preferred_element_type here — jax's conv transpose rule
+    # feeds the f32 cotangent straight back into conv_general_dilated
+    # against the bf16 operand and crashes; the MXU accumulates bf16
+    # convs in f32 internally regardless, so compute in bf16 and upcast.
     out = jax.lax.conv_general_dilated(
         xc, wc, window_strides=strides, padding=padding,
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=_acc(xc))
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = out.astype(orig_dtype)
     if ins.get("Bias"):    # optional fused bias (inference transpiler fold)
         out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
-    return {"Output": [out.astype(orig_dtype)]}
+    return {"Output": [out]}
 
 
 @register_op("depthwise_conv2d")
@@ -89,8 +96,7 @@ def _conv3d(ctx, ins, attrs):
     out = jax.lax.conv_general_dilated(
         x, w, strides, padding, rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        preferred_element_type=_acc(x))
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
     return {"Output": [out.astype(x.dtype)]}
 
 
@@ -118,8 +124,7 @@ def _conv2d_transpose(ctx, ins, attrs):
         x, w_t, window_strides=(1, 1), padding=pad,
         lhs_dilation=strides, rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=_acc(x))
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return {"Output": [out.astype(x.dtype)]}
 
 

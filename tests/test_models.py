@@ -210,3 +210,21 @@ def test_fused_lm_head_matches_unfused():
     assert abs(f1 - u1) < 1e-4          # same loss
     assert abs(f2 - u2) < 1e-3          # same post-SGD-step loss (grads)
     assert f2 < f1                       # and it trains
+
+
+def test_resnet_trains_under_amp_bf16():
+    """Regression: conv2d's vjp crashed under FLAGS_amp_bf16 (mixed
+    bf16/f32 into the conv transpose rule)."""
+    from paddle_tpu.core import flags
+    flags.set_flag("amp_bf16", True)
+    try:
+        feeds, avg_loss, acc, pred = models.resnet.build_train_net(
+            class_dim=10, img_shape=(3, 32, 32), depth=18)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.rand(4, 3, 32, 32).astype("float32"),
+                "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+        losses = _train(feeds, avg_loss, feed, steps=3, lr=0.05)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+    finally:
+        flags.set_flag("amp_bf16", False)
