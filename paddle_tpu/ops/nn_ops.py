@@ -45,9 +45,6 @@ def _conv_padding(padding, ksize, strides, dilations, spatial):
     return [(pi, pi) for pi in p]
 
 
-def _acc(x):
-    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
-
 
 @register_op("conv2d")
 def _conv2d(ctx, ins, attrs):
@@ -70,10 +67,9 @@ def _conv2d(ctx, ins, attrs):
         xc, wc, window_strides=strides, padding=padding,
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    out = out.astype(orig_dtype)
     if ins.get("Bias"):    # optional fused bias (inference transpiler fold)
         out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
-    return {"Output": [out]}
+    return {"Output": [out.astype(orig_dtype)]}
 
 
 @register_op("depthwise_conv2d")

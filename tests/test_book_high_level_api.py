@@ -1,0 +1,91 @@
+"""High-level-api book tier: book examples driven through the Trainer
+event loop with dataset readers (ref tests/book/high-level-api/ — the
+same examples re-expressed via fluid.Trainer)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dataset, layers
+from paddle_tpu.models import book
+from paddle_tpu.reader import decorator
+
+
+def run_trainer(train_func, feed_order, reader, epochs=2, lr=0.01):
+    losses = []
+
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent) and event.metrics:
+            losses.append(float(np.asarray(event.metrics[0]).ravel()[0]))
+
+    trainer = pt.Trainer(train_func,
+                         lambda: pt.optimizer.SGD(learning_rate=lr),
+                         place=pt.CPUPlace())
+    trainer.train(num_epochs=epochs, event_handler=handler,
+                  reader=reader, feed_order=feed_order)
+    assert losses and np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses[:6]
+    return trainer
+
+
+def test_fit_a_line_via_trainer_uci_reader():
+    """ref high-level-api/fit_a_line: Trainer + uci_housing reader."""
+    def train_func():
+        feeds, avg_cost, pred = book.fit_a_line(x_dim=13)
+        return avg_cost
+
+    reader = decorator.batch(
+        lambda: itertools.islice(dataset.uci_housing.train()(), 128), 16)
+    run_trainer(train_func, ["x", "y"], reader, epochs=3, lr=0.05)
+
+
+def test_word2vec_via_trainer_imikolov_reader():
+    """ref high-level-api/word2vec: Trainer + imikolov N-gram reader."""
+    word_dict = dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+
+    def train_func():
+        feeds, avg_cost, pred = book.word2vec(dict_size=dict_size,
+                                              embed_size=16,
+                                              hidden_size=32)
+        return avg_cost
+
+    def samples():
+        for s in itertools.islice(
+                dataset.imikolov.train(word_dict, 5)(), 256):
+            yield ([s[0]], [s[1]], [s[2]], [s[3]], [s[4]])
+
+    reader = decorator.batch(samples, 32)
+    run_trainer(train_func,
+                ["word_0", "word_1", "word_2", "word_3", "next_word"],
+                reader, epochs=2, lr=0.1)
+
+
+def test_recognize_digits_via_trainer_mnist_reader():
+    """ref high-level-api/recognize_digits: Trainer + mnist reader +
+    save/load inference round trip."""
+    from paddle_tpu import models
+
+    def train_func():
+        feeds, avg_loss, acc, pred = models.lenet.build_train_net(
+            net_fn=models.lenet.multilayer_perceptron)
+        return [avg_loss, acc]
+
+    def samples():
+        for img, lbl in itertools.islice(dataset.mnist.train()(), 256):
+            yield (np.asarray(img, "float32").reshape(1, 28, 28),
+                   [int(lbl)])
+
+    reader = decorator.batch(samples, 32)
+    trainer = run_trainer(train_func, ["img", "label"], reader,
+                          epochs=2, lr=0.1)
+    # params survive a save/load round trip
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        trainer.save_params(d)
+        t2 = pt.Trainer(train_func,
+                        lambda: pt.optimizer.SGD(learning_rate=0.1),
+                        place=pt.CPUPlace(), param_path=d)
+        m = t2.test(reader=reader, feed_order=["img", "label"])
+        assert np.isfinite(np.asarray(m[0])).all()
