@@ -852,7 +852,7 @@ def where(condition, x, y):
 
 
 def fused_lm_head_loss(x, vocab_size, label, param_attr=None,
-                       chunk_size=4096, name=None):
+                       chunk_size=4096, unroll=False, name=None):
     """Chunked remat LM head + mean softmax-CE in ONE op (owns the
     [D, V] head weight).  Replaces fc -> softmax_with_cross_entropy ->
     mean for big-vocab LMs without materializing [N, V] logits; see
@@ -864,5 +864,6 @@ def fused_lm_head_loss(x, vocab_size, label, param_attr=None,
     loss = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op("fused_lm_head_loss",
                      {"X": [x], "W": [w], "Label": [label]},
-                     {"Loss": [loss]}, {"chunk_size": chunk_size})
+                     {"Loss": [loss]}, {"chunk_size": chunk_size,
+                                        "unroll": unroll})
     return loss

@@ -1,6 +1,7 @@
 """Model zoo: the five BASELINE.json configs (+ extras), built on the layers
 DSL so every model is a serializable Program that compiles to one XLA
 executable."""
+from . import alexnet
 from . import lenet
 from . import resnet
 from . import vgg

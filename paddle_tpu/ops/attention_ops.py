@@ -92,9 +92,17 @@ def _fused_lm_head_loss(ctx, ins, attrs):
 
     remat_loss = jax.checkpoint(chunk_loss)
 
-    def body(acc, xy):
-        x_c, y_c = xy
-        return acc + remat_loss(w, x_c, y_c), None
+    if bool(attrs.get("unroll", False)):
+        # unrolled chunks: XLA can overlap/schedule across chunks at the
+        # cost of code size (attr for A/B; scan is the default)
+        total = jnp.zeros((), jnp.float32)
+        for ci in range(n_chunks):
+            total = total + remat_loss(w, xs[ci], ys[ci])
+    else:
+        def body(acc, xy):
+            x_c, y_c = xy
+            return acc + remat_loss(w, x_c, y_c), None
 
-    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ys))
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (xs, ys))
     return {"Loss": [(total / n).reshape(1)]}

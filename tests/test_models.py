@@ -212,6 +212,31 @@ def test_fused_lm_head_matches_unfused():
     assert f2 < f1                       # and it trains
 
 
+def test_fused_lm_head_unroll_matches_scan():
+    """The unroll=True A/B knob computes the identical loss."""
+    rng = np.random.RandomState(9)
+    V, D, N = 37, 8, 20
+    x = rng.randn(N, D).astype("float32")
+    y = rng.randint(0, V, (N,)).astype("int64")
+    w = rng.randn(D, V).astype("float32") * 0.1
+
+    def run(unroll):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.layers.data("x", [D])
+            yv = pt.layers.data("y", [], dtype="int64")
+            loss = pt.layers.fused_lm_head_loss(
+                xv, V, yv, param_attr=pt.ParamAttr("hw"),
+                chunk_size=6, unroll=unroll)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.global_scope().set_var("hw", w.copy())
+        out, = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        return float(np.asarray(out).ravel()[0])
+
+    assert abs(run(False) - run(True)) < 1e-5
+
+
 def test_resnet_trains_under_amp_bf16():
     """Regression: conv2d's vjp crashed under FLAGS_amp_bf16 (mixed
     bf16/f32 into the conv transpose rule)."""
@@ -228,3 +253,14 @@ def test_resnet_trains_under_amp_bf16():
         assert losses[-1] < losses[0]
     finally:
         flags.set_flag("amp_bf16", False)
+
+
+def test_alexnet_trains():
+    feeds, avg_loss, acc, pred = models.alexnet.build_train_net(
+        class_dim=10, img_shape=(3, 64, 64))
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(4, 3, 64, 64).astype("float32"),
+            "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+    losses = _train(feeds, avg_loss, feed, steps=3, lr=0.01)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
