@@ -2,6 +2,7 @@
 DSL so every model is a serializable Program that compiles to one XLA
 executable."""
 from . import alexnet
+from . import googlenet
 from . import lenet
 from . import resnet
 from . import vgg

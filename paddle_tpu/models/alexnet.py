@@ -29,9 +29,11 @@ def alexnet(images, class_dim: int = 1000, is_test: bool = False):
                       groups=2, act="relu")
     x = layers.pool2d(x, pool_size=3, pool_stride=2)
     x = layers.fc(x, size=4096, act="relu")
-    x = layers.dropout(x, drop, is_test=is_test)
+    x = layers.dropout(x, drop, is_test=is_test,
+                       dropout_implementation="upscale_in_train")
     x = layers.fc(x, size=4096, act="relu")
-    x = layers.dropout(x, drop, is_test=is_test)
+    x = layers.dropout(x, drop, is_test=is_test,
+                       dropout_implementation="upscale_in_train")
     return layers.fc(x, size=class_dim, act="softmax")
 
 

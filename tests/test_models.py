@@ -264,3 +264,14 @@ def test_alexnet_trains():
     losses = _train(feeds, avg_loss, feed, steps=3, lr=0.01)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_googlenet_trains():
+    feeds, avg_loss, acc, pred = models.googlenet.build_train_net(
+        class_dim=10, img_shape=(3, 96, 96))
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(8, 3, 96, 96).astype("float32"),
+            "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+    losses = _train(feeds, avg_loss, feed, steps=4, lr=0.002)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
