@@ -59,3 +59,12 @@ def is_floating(dtype) -> bool:
 
 def is_integer(dtype) -> bool:
     return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")
+
+
+def index_dtype():
+    """The widest integer dtype jax will actually materialize: int64 when
+    x64 is enabled, else int32.  Ops whose reference contract says int64
+    use this to avoid per-call truncation warnings under 32-bit mode
+    (the value range of indices/shapes here always fits int32)."""
+    import jax
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32

@@ -28,7 +28,7 @@ def _aux_head(x, class_dim, is_test):
     a = layers.adaptive_pool2d(x, pool_size=4, pool_type="avg")
     a = layers.conv2d(a, num_filters=128, filter_size=1, act="relu")
     a = layers.fc(a, size=1024, act="relu")
-    a = layers.dropout(a, 0.0 if is_test else 0.7, is_test=is_test,
+    a = layers.dropout(a, 0.7, is_test=is_test,
                        dropout_implementation="upscale_in_train")
     return layers.fc(a, size=class_dim, act="softmax")
 
@@ -55,9 +55,9 @@ def googlenet(images, class_dim: int = 1000, is_test: bool = False):
     x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1)
     x = _inception(x, 256, 160, 320, 32, 128, 128)    # 5a
     x = _inception(x, 384, 192, 384, 48, 128, 128)    # 5b -> 1024
-    x = layers.pool2d(x, pool_size=7, pool_stride=1,
+    x = layers.pool2d(x, pool_size=7, pool_stride=1, pool_type="avg",
                       global_pooling=True)
-    x = layers.dropout(x, 0.0 if is_test else 0.4, is_test=is_test,
+    x = layers.dropout(x, 0.4, is_test=is_test,
                       dropout_implementation="upscale_in_train")
     main = layers.fc(x, size=class_dim, act="softmax")
     return main, aux1, aux2

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.dtypes import to_jnp_dtype
+from ..core.dtypes import index_dtype, to_jnp_dtype
 from ..framework.registry import register_op, single_input
 
 
@@ -136,7 +136,7 @@ def _cast(ctx, ins, attrs):
 @register_op("shape", stop_gradient=True)
 def _shape(ctx, ins, attrs):
     x = single_input(ins, "Input")
-    return {"Out": [jnp.asarray(x.shape, dtype=jnp.int64)]}
+    return {"Out": [jnp.asarray(x.shape, dtype=index_dtype())]}
 
 
 @register_op("one_hot", stop_gradient=True)
@@ -184,4 +184,4 @@ def _sampling_id(ctx, ins, attrs):
     x = single_input(ins)
     ids = jax.random.categorical(_op_key(ctx, attrs), jnp.log(x + 1e-20),
                                  axis=-1)
-    return {"Out": [ids.astype(jnp.int64)]}
+    return {"Out": [ids.astype(index_dtype())]}

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 NEG = -1e9
@@ -288,16 +289,16 @@ def _chunk_eval(ctx, ins, attrs):
                    & type_eq[:, t])
         in_ok = both_start | cont_ok
         close = in_ok & inf_end[:, t] & lab_end[:, t]
-        count = count + close.astype(jnp.int64)
+        count = count + close.astype(index_dtype())
         in_ok = in_ok & ~close
         return (in_ok, count), None
 
     init = (jnp.zeros((inf.shape[0],), jnp.bool_),
-            jnp.zeros((inf.shape[0],), jnp.int64))
+            jnp.zeros((inf.shape[0],), index_dtype()))
     (_, counts), _ = lax.scan(step, init, jnp.arange(T))
     correct = jnp.sum(counts)
-    num_inf = jnp.sum(inf_starts.astype(jnp.int64))
-    num_lab = jnp.sum(lab_starts.astype(jnp.int64))
+    num_inf = jnp.sum(inf_starts.astype(index_dtype()))
+    num_lab = jnp.sum(lab_starts.astype(index_dtype()))
     precision = correct / jnp.maximum(num_inf, 1)
     recall = correct / jnp.maximum(num_lab, 1)
     f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)

@@ -10,6 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 
@@ -143,4 +144,4 @@ def _edit_distance(ctx, ins, attrs):
     if attrs.get("normalized", False):
         d = d / jnp.maximum(jnp.asarray(n, jnp.float32), 1.0)
     return {"Out": [d[:, None]],
-            "SequenceNum": [jnp.asarray(hyp.shape[0], jnp.int64)]}
+            "SequenceNum": [jnp.asarray(hyp.shape[0], index_dtype())]}

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 
@@ -523,7 +524,7 @@ def _unpool(ctx, ins, attrs):
 def _lod_array_length(ctx, ins, attrs):
     """ref lod_array_length_op.cc: number of entries in a tensor array.
     Dense: the 'array' is the op's X input list, so the length is static."""
-    return {"Out": [jnp.asarray([len(ins["X"])], jnp.int64)]}
+    return {"Out": [jnp.asarray([len(ins["X"])], index_dtype())]}
 
 
 @register_op("lod_tensor_to_array", stop_gradient=True)

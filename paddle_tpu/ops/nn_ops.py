@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import flags
+from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 
@@ -195,7 +196,7 @@ def _max_pool_with_index(x, attrs, nd):
     vals, idxs = jax.lax.reduce_window(
         (x, flat_idx), (-jnp.inf, 0.0),
         lambda a, b: select(a, b), window, strides_full, pads_full)
-    return {"Out": [vals.astype(x.dtype)], "Mask": [idxs.astype(jnp.int64)]}
+    return {"Out": [vals.astype(x.dtype)], "Mask": [idxs.astype(index_dtype())]}
 
 
 @register_op("pool2d_with_index")

@@ -8,6 +8,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 
@@ -42,14 +43,14 @@ _reduce("reduce_any", jnp.any)
 def _arg_max(ctx, ins, attrs):
     x = single_input(ins)
     return {"Out": [jnp.argmax(x, axis=int(attrs.get("axis", -1)))
-                    .astype(jnp.int64)]}
+                    .astype(index_dtype())]}
 
 
 @register_op("arg_min", stop_gradient=True)
 def _arg_min(ctx, ins, attrs):
     x = single_input(ins)
     return {"Out": [jnp.argmin(x, axis=int(attrs.get("axis", -1)))
-                    .astype(jnp.int64)]}
+                    .astype(index_dtype())]}
 
 
 @register_op("argsort", stop_gradient=True)
@@ -60,7 +61,7 @@ def _argsort(ctx, ins, attrs):
     key = -x if descending else x
     idx = jnp.argsort(key, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [out], "Indices": [idx.astype(index_dtype())]}
 
 
 @register_op("top_k", stop_gradient=True)
@@ -68,4 +69,4 @@ def _top_k(ctx, ins, attrs):
     x = single_input(ins)
     k = int(attrs["k"])
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(index_dtype())]}

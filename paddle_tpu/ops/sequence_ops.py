@@ -38,7 +38,7 @@ def _sequence_mask(ctx, ins, attrs):
     out = (jnp.arange(maxlen)[None, :] <
            length.reshape(-1, 1)).astype(jnp.int32)
     out_dtype = attrs.get("out_dtype", "int64")
-    from ..core.dtypes import to_jnp_dtype
+    from ..core.dtypes import index_dtype, to_jnp_dtype
     return {"Y": [out.astype(to_jnp_dtype(out_dtype))]}
 
 
@@ -134,7 +134,7 @@ def _sequence_pad(ctx, ins, attrs):
         pads = [(0, 0), (0, target - t)] + [(0, 0)] * (x.ndim - 2)
         out = jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))
     length = (ins["Length"][0] if ins.get("Length")
-              else jnp.full((x.shape[0],), t, jnp.int64))
+              else jnp.full((x.shape[0],), t, index_dtype()))
     return {"Out": [out], "Length": [length]}
 
 

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 
@@ -31,7 +32,7 @@ def _reshape2(ctx, ins, attrs):
     shape = list(attrs["shape"])
     shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
     return {"Out": [x.reshape(shape)],
-            "XShape": [jnp.asarray(x.shape, jnp.int64)]}
+            "XShape": [jnp.asarray(x.shape, index_dtype())]}
 
 
 @register_op("transpose")
@@ -44,7 +45,7 @@ def _transpose(ctx, ins, attrs):
 def _transpose2(ctx, ins, attrs):
     x = single_input(ins)
     return {"Out": [jnp.transpose(x, attrs["axis"])],
-            "XShape": [jnp.asarray(x.shape, jnp.int64)]}
+            "XShape": [jnp.asarray(x.shape, index_dtype())]}
 
 
 @register_op("concat")
@@ -101,7 +102,7 @@ def _squeeze(ctx, ins, attrs):
 def _squeeze2(ctx, ins, attrs):
     orig = single_input(ins)
     out = _squeeze(ctx, ins, attrs)["Out"]
-    return {"Out": out, "XShape": [jnp.asarray(orig.shape, jnp.int64)]}
+    return {"Out": out, "XShape": [jnp.asarray(orig.shape, index_dtype())]}
 
 
 @register_op("unsqueeze")
@@ -116,7 +117,7 @@ def _unsqueeze(ctx, ins, attrs):
 def _unsqueeze2(ctx, ins, attrs):
     orig = single_input(ins)
     out = _unsqueeze(ctx, ins, attrs)["Out"]
-    return {"Out": out, "XShape": [jnp.asarray(orig.shape, jnp.int64)]}
+    return {"Out": out, "XShape": [jnp.asarray(orig.shape, index_dtype())]}
 
 
 @register_op("flatten")
@@ -131,7 +132,7 @@ def _flatten(ctx, ins, attrs):
 def _flatten2(ctx, ins, attrs):
     orig = single_input(ins)
     out = _flatten(ctx, ins, attrs)["Out"]
-    return {"Out": out, "XShape": [jnp.asarray(orig.shape, jnp.int64)]}
+    return {"Out": out, "XShape": [jnp.asarray(orig.shape, index_dtype())]}
 
 
 @register_op("flatten_contiguous_range")
@@ -314,7 +315,7 @@ def _where_index(ctx, ins, attrs):
     c = single_input(ins, "Condition")
     n = int(np.prod(c.shape))
     idx = jnp.nonzero(c, size=n, fill_value=-1)
-    return {"Out": [jnp.stack(idx, axis=-1).astype(jnp.int64)]}
+    return {"Out": [jnp.stack(idx, axis=-1).astype(index_dtype())]}
 
 
 @register_op("space_to_depth")
