@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 
@@ -38,8 +39,9 @@ def _sequence_mask(ctx, ins, attrs):
     out = (jnp.arange(maxlen)[None, :] <
            length.reshape(-1, 1)).astype(jnp.int32)
     out_dtype = attrs.get("out_dtype", "int64")
-    from ..core.dtypes import index_dtype, to_jnp_dtype
-    return {"Y": [out.astype(to_jnp_dtype(out_dtype))]}
+    from ..core.dtypes import to_jnp_dtype
+    dt = index_dtype() if out_dtype == "int64" else to_jnp_dtype(out_dtype)
+    return {"Y": [out.astype(dt)]}
 
 
 @register_op("sequence_pool")

@@ -112,3 +112,52 @@ def test_machine_translation_trains_and_decodes():
     assert (ids >= 0).all() and (ids < V).all()
     # beams are returned best-first
     assert (np.diff(scores, axis=1) <= 1e-5).all()
+
+
+def test_machine_translation_with_wmt14_reader():
+    """The reference book flow end-to-end with the dataset module:
+    wmt14 reader -> padded batches -> train -> beam decode (ref
+    tests/book/test_machine_translation.py trains from
+    paddle.dataset.wmt14)."""
+    import itertools
+
+    from paddle_tpu import dataset
+
+    V, Ts, Tt, B = 100, 8, 9, 16
+    samples = list(itertools.islice(dataset.wmt14.train(V)(), 64))
+
+    def pad(seq, n, val=0):
+        seq = list(seq)[:n]
+        return seq + [val] * (n - len(seq))
+
+    src = np.array([pad(s, Ts) for s, t, tn in samples], "int64")
+    trg = np.array([pad(t, Tt) for s, t, tn in samples], "int64")
+    lbl = np.array([pad(tn, Tt) for s, t, tn in samples], "int64")
+
+    feeds, avg_cost = models.machine_translation.build_train_net(
+        src_vocab=V, tgt_vocab=V, src_len=Ts, tgt_len=Tt,
+        emb_dim=16, hidden_dim=32)
+    pt.optimizer.Adam(learning_rate=5e-3).minimize(avg_cost)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    losses = []
+    for epoch in range(6):
+        for i in range(0, len(samples), B):
+            out, = exe.run(pt.default_main_program(),
+                           feed={"src": src[i:i + B], "tgt": trg[i:i + B],
+                                 "lbl": lbl[i:i + B]},
+                           fetch_list=[avg_cost])
+            losses.append(float(np.asarray(out).ravel()[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+    decode_prog = pt.Program()
+    with pt.program_guard(decode_prog, pt.Program()):
+        dfeeds, sent, scores = models.machine_translation.build_decode_net(
+            src_vocab=V, tgt_vocab=V, src_len=Ts, beam_size=3,
+            max_len=Tt, emb_dim=16, hidden_dim=32)
+    ids, sc = exe.run(decode_prog, feed={"src": src[:4]},
+                      fetch_list=[sent, scores])
+    assert np.asarray(ids).shape == (4, 3, Tt)
+    assert np.isfinite(np.asarray(sc)).all()
+    assert (np.asarray(ids) < V).all() and (np.asarray(ids) >= 0).all()
