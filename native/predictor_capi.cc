@@ -133,7 +133,10 @@ ptpu_predictor* ptpu_predictor_create(const char* model_dir,
   return handle;
 }
 
-// Returns the number of outputs written (<= max_out), or -1 on error.
+// Returns the TRUE number of program outputs, or -1 on error.  Only the
+// first min(count, max_out) entries of `outs` are written, so a caller
+// seeing a return value > max_out knows outputs were dropped and can
+// retry with a larger array.  Iterate min(ret, max_out) entries.
 int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
                        ptpu_out_tensor* outs, int max_out) {
   PyGILState_STATE gil = PyGILState_Ensure();
@@ -165,9 +168,9 @@ int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
     result = PyObject_CallMethod(mod, "run", "lOOOO", h->pid, names, dtypes,
                                  shapes, buffers);
     if (result == nullptr) break;
-    Py_ssize_t n = PyList_Size(result);
-    if (n > max_out) n = max_out;
-    n_out = static_cast<int>(n);
+    Py_ssize_t n_total = PyList_Size(result);
+    Py_ssize_t n = n_total > max_out ? max_out : n_total;
+    n_out = static_cast<int>(n_total);
     for (Py_ssize_t i = 0; i < n; ++i) {
       PyObject* tup = PyList_GetItem(result, i);  // (name, code, shape, bytes)
       const char* nm = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
@@ -188,9 +191,19 @@ int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
       PyObject* raw = PyTuple_GetItem(tup, 3);
       char* buf = nullptr;
       Py_ssize_t len = 0;
-      PyBytes_AsStringAndSize(raw, &buf, &len);
+      if (PyBytes_AsStringAndSize(raw, &buf, &len) != 0) {
+        for (Py_ssize_t j = 0; j < i; ++j) ptpu_out_tensor_free(&outs[j]);
+        n_out = -1;  // error text set from the pending Python exception
+        break;
+      }
       outs[i].nbytes = static_cast<size_t>(len);
-      outs[i].data = std::malloc(outs[i].nbytes);
+      outs[i].data = std::malloc(outs[i].nbytes ? outs[i].nbytes : 1);
+      if (outs[i].data == nullptr) {
+        g_last_error = "out of memory copying output tensor";
+        for (Py_ssize_t j = 0; j < i; ++j) ptpu_out_tensor_free(&outs[j]);
+        n_out = -1;
+        break;
+      }
       std::memcpy(outs[i].data, buf, outs[i].nbytes);
     }
   } while (false);

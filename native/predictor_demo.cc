@@ -71,6 +71,7 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "run failed: %s\n", ptpu_last_error());
     return 1;
   }
+  if (n > 4) n = 4;  // run() returns the true count; only max_out written
   for (int i = 0; i < n; ++i) {
     std::printf("output %s rank=%d shape=[", outs[i].name, outs[i].rank);
     for (int d = 0; d < outs[i].rank; ++d) {

@@ -2,12 +2,15 @@
 PyLayer)."""
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List
 
 import numpy as np
 
 from .base import to_variable
 from .varbase import VarBase, trace_op
+
+_param_seed = itertools.count()
 
 
 class Layer:
@@ -23,7 +26,7 @@ class Layer:
                          initializer=None) -> VarBase:
         if initializer is None:
             fan_in = int(np.prod(shape[:-1])) or 1
-            init = np.random.RandomState(len(self._parameters)).uniform(
+            init = np.random.RandomState(next(_param_seed)).uniform(
                 -np.sqrt(6.0 / fan_in), np.sqrt(6.0 / fan_in),
                 shape).astype(dtype)
         else:

@@ -761,10 +761,12 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
     pw = helper.create_parameter(None, shape=[hidden, proj_size],
                                  dtype=input.dtype)
     proj = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
     last_h = helper.create_variable_for_type_inference(input.dtype, True)
     last_c = helper.create_variable_for_type_inference(input.dtype, True)
     helper.append_op("lstmp",
                      {"Input": [input], "Weight": [w], "ProjWeight": [pw]},
-                     {"Projection": [proj], "LastH": [last_h],
-                      "LastC": [last_c]}, {})
-    return proj, last_c
+                     {"Projection": [proj], "Cell": [cell],
+                      "LastH": [last_h], "LastC": [last_c]}, {})
+    # reference dynamic_lstmp returns (projection, per-step cell sequence)
+    return proj, cell

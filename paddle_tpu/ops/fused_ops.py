@@ -245,20 +245,42 @@ def _depthwise_conv2d_transpose(ctx, ins, attrs):
 
 @register_op("fake_quantize_range_abs_max")
 def _fake_quantize_range_abs_max(ctx, ins, attrs):
-    """ref fake_quantize_op.cc range_abs_max: running max of |x| over a
-    window; quantize against the running scale (QAT inference-friendly
-    variant of moving_average_abs_max)."""
+    """ref fake_quantize_op.cc range_abs_max: track |x|-max over the last
+    `window_size` steps in a circular scale buffer and quantize against
+    the window max.  Stateful form: feed Iter ([1] int step counter) and
+    InScales ([window_size] history) — both are updated and re-emitted as
+    OutScales/OutIter, matching the reference's Iter/OutScales contract.
+    Stateless fallback (no Iter/InScales): monotone running max of
+    InScale — a documented approximation that never decays (fine for
+    inference-scale export, wrong for shrinking activations; see
+    docs/PARITY.md)."""
     x = single_input(ins, "X")
     bits = int(attrs.get("bit_length", 8))
+    window = int(attrs.get("window_size", 10000))
     qmax = float(2 ** (bits - 1) - 1)
     from .quantize_ops import _ste_round
     cur = jnp.max(jnp.abs(x))
-    in_scale = (ins["InScale"][0].reshape(()) if ins.get("InScale")
-                else cur)
-    scale = jnp.maximum(cur, in_scale)
+    outs = {}
+    if ins.get("Iter") and ins.get("InScales"):
+        it = ins["Iter"][0].reshape(()).astype(jnp.int32)
+        hist = ins["InScales"][0].reshape(-1)[:window]
+        # The fed buffer's length is the effective window: indexing by the
+        # attr when the buffer is shorter would silently drop the update.
+        window = hist.shape[0]
+        hist = hist.at[jnp.mod(it, window)].set(cur)
+        seen = jnp.minimum(it + 1, window)
+        valid = jnp.arange(hist.shape[0]) < seen
+        scale = jnp.max(jnp.where(valid, hist, 0.0))
+        outs["OutScales"] = [hist]
+        outs["OutIter"] = [(it + 1).reshape(1)]
+    else:
+        in_scale = (ins["InScale"][0].reshape(()) if ins.get("InScale")
+                    else cur)
+        scale = jnp.maximum(cur, in_scale)
     q = jnp.clip(_ste_round(x / jnp.maximum(scale, 1e-8) * qmax),
                  -qmax, qmax)
-    return {"Out": [q * scale / qmax], "OutScale": [scale.reshape(1)]}
+    outs.update({"Out": [q * scale / qmax], "OutScale": [scale.reshape(1)]})
+    return outs
 
 
 @register_op("fake_init", stop_gradient=True)

@@ -208,7 +208,7 @@ def _lstm_scan(x_seq, wh, h0, c0, proj=None):
         c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
         hid = jax.nn.sigmoid(o) * jnp.tanh(c)
         h = hid @ proj if proj is not None else hid
-        return (h, c), h
+        return (h, c), (h, c)
 
     return lax.scan(step, (h0, c0), x_seq)
 
@@ -225,9 +225,10 @@ def _lstmp(ctx, ins, attrs):
     P = pw.shape[1]
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, P), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
-    (h, c), hs = _lstm_scan(jnp.swapaxes(x, 0, 1), w, h0, c0, proj=pw)
-    return {"Projection": [jnp.swapaxes(hs, 0, 1)], "LastH": [h],
-            "LastC": [c]}
+    (h, c), (hs, cs) = _lstm_scan(jnp.swapaxes(x, 0, 1), w, h0, c0, proj=pw)
+    return {"Projection": [jnp.swapaxes(hs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)],
+            "LastH": [h], "LastC": [c]}
 
 
 @register_op("cudnn_lstm")
@@ -272,7 +273,7 @@ def _cudnn_lstm(ctx, ins, attrs):
                   else (init_h[li] if init_h.ndim == 3 else init_h))
             c0 = (zero if init_c is None
                   else (init_c[li] if init_c.ndim == 3 else init_c))
-            (h_T, c_T), hs = _lstm_scan(xp, wh, h0, c0)
+            (h_T, c_T), (hs, _) = _lstm_scan(xp, wh, h0, c0)
             outs.append(hs[::-1] if d == 1 else hs)
             last_hs.append(h_T)
             last_cs.append(c_T)

@@ -22,7 +22,10 @@ from ..framework.registry import register_op, single_input
 
 
 def _mask(x, ins, time_axis=1):
-    """(batch, T) float mask from optional Length input."""
+    """(batch, T) float mask from an optional Mask ([B,T] 0/1) or Length
+    ([B]) input — layers/sequence.py passes either spelling."""
+    if ins.get("Mask"):
+        return ins["Mask"][0].reshape(x.shape[:2]).astype(jnp.float32)
     if not ins.get("Length"):
         return jnp.ones(x.shape[:2], dtype=jnp.float32)
     length = ins["Length"][0].reshape(-1)

@@ -204,3 +204,35 @@ def test_conv_bn_fold_skipped_when_conv_output_reused():
     InferenceTranspiler().transpile(test_prog, scope=exe.scope)
     assert any(op.type == "batch_norm"
                for op in test_prog.global_block().ops)
+
+
+def test_fake_quantize_range_abs_max_windowed():
+    """ADVICE r2: the windowed (Iter/InScales) form must track the scale
+    over the last window_size steps — a shrunk activation range drops the
+    scale once the old max rotates out of the window."""
+    import numpy as np
+    from op_test import OpTest
+
+    class _T(OpTest):
+        op_type = "fake_quantize_range_abs_max"
+
+        def setup(self):
+            self.inputs = {
+                "X": np.array([[0.5, -0.25]], "float32"),
+                "Iter": np.array([3], "int64"),       # buffer already full
+                "InScales": np.array([4.0, 2.0, 1.0], "float32"),
+            }
+            self.attrs = {"bit_length": 8, "window_size": 3}
+            qmax = 127.0
+            # slot 3 % 3 = 0 overwritten by cur=0.5 -> window [0.5, 2, 1]
+            scale = 2.0
+            x = self.inputs["X"]
+            q = np.clip(np.round(x / scale * qmax), -qmax, qmax)
+            self.outputs = {
+                "Out": q * scale / qmax,
+                "OutScale": np.array([scale], "float32"),
+                "OutScales": np.array([0.5, 2.0, 1.0], "float32"),
+                "OutIter": np.array([4], "float32"),
+            }
+
+    _T().check_output(atol=1e-6)

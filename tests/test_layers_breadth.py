@@ -340,12 +340,14 @@ def test_lstm_fused_and_lstmp():
         proj_in = layers.fc(v, size=4 * H, num_flatten_dims=2)
         proj, cell = layers.dynamic_lstmp(proj_in, size=4 * H,
                                           proj_size=3)
-        return out, lh, lc, proj
+        return out, lh, lc, proj, cell
 
-    out, lh, lc, proj = run_net(build, {"x": x})
+    out, lh, lc, proj, cell = run_net(build, {"x": x})
     assert out.shape == (B, T, H)
     assert lh.shape == (1, B, H) and lc.shape == (1, B, H)
     assert proj.shape == (B, T, 3)
+    # second return is the per-step cell sequence (reference contract)
+    assert cell.shape == (B, T, H)
 
 
 def test_misc_random_and_counter():
@@ -376,3 +378,30 @@ def test_pad_constant_like_and_concat_first():
     out, = run_net(build, {"b": big, "s": small})
     assert out.shape == (2, 5)
     assert np.allclose(out[:, 3:], 9.0)
+
+
+def test_sequence_pool_softmax_masked():
+    """ADVICE r2 (high): the Mask input must actually gate pooling and
+    softmax — padding steps contribute nothing."""
+    x = seq_data()
+    lens = np.array([4, 2])
+    m = (np.arange(6)[None, :] < lens[:, None]).astype("float32")
+
+    def build():
+        v = layers.data("x", [6, 4])
+        mk = layers.data("m", [6])
+        return (layers.sequence_pool(v, "sum", mask=mk),
+                layers.sequence_pool(v, "average", mask=mk),
+                layers.sequence_pool(v, "max", mask=mk),
+                layers.sequence_last_step(v, mask=mk),
+                layers.sequence_softmax(v, mask=mk))
+
+    s, a, mx, last, sm = run_net(build, {"x": x, "m": m})
+    for b, n in enumerate(lens):
+        assert np.allclose(s[b], x[b, :n].sum(0), atol=1e-5)
+        assert np.allclose(a[b], x[b, :n].mean(0), atol=1e-5)
+        assert np.allclose(mx[b], x[b, :n].max(0), atol=1e-5)
+        assert np.allclose(last[b], x[b, n - 1], atol=1e-6)
+        # softmax mass lives entirely on valid steps
+        assert np.allclose(np.asarray(sm)[b, :n].sum(0), 1.0, atol=1e-5)
+        assert np.allclose(np.asarray(sm)[b, n:], 0.0, atol=1e-6)
