@@ -2,7 +2,6 @@
 PyLayer)."""
 from __future__ import annotations
 
-import itertools
 from typing import Dict, List
 
 import numpy as np
@@ -10,7 +9,17 @@ import numpy as np
 from .base import to_variable
 from .varbase import VarBase, trace_op
 
-_param_seed = itertools.count()
+# Eager-parameter init stream: one process-wide RandomState so stacked
+# same-shape layers draw DIFFERENT weights (symmetry breaking), while
+# `seed_parameters(n)` restores reproducibility on demand.
+_param_rng = np.random.RandomState(0)
+
+
+def seed_parameters(seed: int) -> None:
+    """Reset the eager-mode parameter-init stream (call before building a
+    model to reproduce its initial weights)."""
+    global _param_rng
+    _param_rng = np.random.RandomState(seed)
 
 
 class Layer:
@@ -26,7 +35,7 @@ class Layer:
                          initializer=None) -> VarBase:
         if initializer is None:
             fan_in = int(np.prod(shape[:-1])) or 1
-            init = np.random.RandomState(next(_param_seed)).uniform(
+            init = _param_rng.uniform(
                 -np.sqrt(6.0 / fan_in), np.sqrt(6.0 / fan_in),
                 shape).astype(dtype)
         else:

@@ -79,14 +79,106 @@ class Optimizer:
     def _create_accumulators(self, block, params: List[Parameter]):
         pass
 
+    # -- gradient accumulation (ref ir/multi_batch_merge_pass.cc) ----------
+    def _append_grad_accumulation(self, program, block, param_grads, k):
+        """Rewrite grads into running accumulators and return the update
+        gate: every k-th `exe.run` applies the optimizer with the mean of
+        the last k micro-batch grads; other steps only accumulate.  This
+        is the reference's batch-merge capability
+        (framework/ir/multi_batch_merge_pass.cc) expressed as a program
+        transformation — the update ops are gated in-place, so one jitted
+        step serves both the accumulate and the apply iterations."""
+        cname = self._name + ".acc_counter"
+        block.create_var(name=cname, shape=[1], dtype="float32",
+                         persistable=True, stop_gradient=True)
+        sb = default_startup_program().global_block()
+        if not sb.has_var(cname):
+            sb.create_var(name=cname, shape=[1], dtype="float32",
+                          persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": [cname]},
+                         attrs={"shape": [1], "dtype": "float32",
+                                "value": 0.0})
+        block.append_op("increment", {"X": [cname]}, {"Out": [cname]},
+                        {"step": 1.0})
+
+        def tmp(suffix, dtype="float32"):
+            name = unique_name.generate(f"{self._name}.{suffix}")
+            block.create_var(name=name, dtype=dtype, stop_gradient=True)
+            return name
+
+        kc, zc = tmp("k_const"), tmp("zero_const")
+        block.append_op("fill_constant", outputs={"Out": [kc]},
+                        attrs={"shape": [1], "dtype": "float32",
+                               "value": float(k)})
+        block.append_op("fill_constant", outputs={"Out": [zc]},
+                        attrs={"shape": [1], "dtype": "float32",
+                               "value": 0.0})
+        # Wrap the counter in place (counter <- counter mod k) so it stays
+        # in [0, k) forever — an unbounded fp32 counter would saturate at
+        # 2^24 and freeze the gate.
+        block.append_op("elementwise_mod", {"X": [cname], "Y": [kc]},
+                        {"Out": [cname]})
+        eq = tmp("is_boundary_b", "bool")
+        block.append_op("equal", {"X": [cname], "Y": [zc]}, {"Out": [eq]})
+        gate = tmp("is_boundary")
+        block.append_op("cast", {"X": [eq]}, {"Out": [gate]},
+                        {"out_dtype": "float32"})
+
+        new_pairs, acc_names = [], []
+        for param, grad in param_grads:
+            acc = self._add_accumulator("grad_acc", param, block)
+            block.append_op("elementwise_add", {"X": [acc],
+                                                "Y": [grad.name]},
+                            {"Out": [acc]})
+            eff = tmp(f"{param.name}.grad_avg")
+            block.append_op("scale", {"X": [acc]}, {"Out": [eff]},
+                            {"scale": 1.0 / k})
+            new_pairs.append((param, block.var(eff)))
+            acc_names.append(acc)
+        return new_pairs, gate, acc_names
+
+    def _append_gated_optimize_op(self, block, param, grad_name, lr_name,
+                                  gate):
+        """Run the subclass update, then gate every written var back to its
+        pre-update value unless this step is an accumulation boundary."""
+        start = len(block.ops)
+        self._append_optimize_op(block, param, grad_name, lr_name)
+        written = sorted({n for op in block.ops[start:]
+                          for names in op.outputs.values() for n in names})
+        saves = []
+        for i, w in enumerate(written):
+            old = unique_name.generate(f"{w}.preupdate")
+            block.create_var(name=old, dtype="float32",
+                             stop_gradient=True)
+            # snapshot BEFORE the update ops (insert preserves order)
+            block.append_op("assign", {"X": [w]}, {"Out": [old]},
+                            index=start + i)
+            saves.append((w, old))
+        for w, old in saves:
+            diff = unique_name.generate(f"{w}.upd_delta")
+            block.create_var(name=diff, dtype="float32",
+                            stop_gradient=True)
+            block.append_op("elementwise_sub", {"X": [w], "Y": [old]},
+                            {"Out": [diff]})
+            block.append_op("elementwise_mul", {"X": [diff], "Y": [gate]},
+                            {"Out": [diff]})
+            block.append_op("elementwise_add", {"X": [old], "Y": [diff]},
+                            {"Out": [w]})
+
     # -- minimize (ref optimizer.py:294) -----------------------------------
     def minimize(self, loss: Variable, startup_program=None,
-                 parameter_list=None, no_grad_set=None
+                 parameter_list=None, no_grad_set=None,
+                 accumulate_steps: int = 1
                  ) -> Tuple[None, List[Tuple[Parameter, Variable]]]:
         from .clip import append_gradient_clip_ops
         program = loss.block.program
         param_grads = append_backward(loss, parameter_list, no_grad_set)
         block = program.global_block()
+        gate = None
+        acc_names: List[str] = []
+        if accumulate_steps and int(accumulate_steps) > 1:
+            param_grads, gate, acc_names = self._append_grad_accumulation(
+                program, block, param_grads, int(accumulate_steps))
         append_gradient_clip_ops(program, param_grads)
         lr = self._create_lr_var(program)
         self._create_accumulators(block, [p for p, _ in param_grads])
@@ -106,7 +198,20 @@ class Optimizer:
                 block.append_op("scale", {"X": [lr.name]},
                                 {"Out": [scaled]}, {"scale": float(plr)})
                 lr_name = scaled
-            self._append_optimize_op(block, param, grad.name, lr_name)
+            if gate is None:
+                self._append_optimize_op(block, param, grad.name, lr_name)
+            else:
+                self._append_gated_optimize_op(block, param, grad.name,
+                                               lr_name, gate)
+        if gate is not None:
+            # clear the accumulators on boundary steps: acc *= (1 - gate)
+            inv = unique_name.generate(f"{self._name}.not_boundary")
+            block.create_var(name=inv, dtype="float32", stop_gradient=True)
+            block.append_op("scale", {"X": [gate]}, {"Out": [inv]},
+                            {"scale": -1.0, "bias": 1.0})
+            for acc in acc_names:
+                block.append_op("elementwise_mul", {"X": [acc], "Y": [inv]},
+                                {"Out": [acc]})
         return None, param_grads
 
 
@@ -426,3 +531,165 @@ class Lamb(Optimizer):
 
 
 LambOptimizer = Lamb
+
+
+class ProximalGD(Optimizer):
+    """Proximal gradient descent with l1/l2 (ref proximal_gd_op.cc and
+    optimizer use of the registered op)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        block.append_op("proximal_gd",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name]},
+                        {"l1": self._l1, "l2": self._l2})
+
+
+ProximalGDOptimizer = ProximalGD
+
+
+class ProximalAdagrad(Optimizer):
+    """Adagrad with proximal l1/l2 regularization
+    (ref proximal_adagrad_op.cc)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _create_accumulators(self, block, params):
+        for p in params:
+            self._add_accumulator("moment", p, block)
+
+    def _append_optimize_op(self, block, param, grad_name, lr_name):
+        m = self._accumulators["moment"][param.name]
+        block.append_op("proximal_adagrad",
+                        {"Param": [param.name], "Grad": [grad_name],
+                         "Moment": [m], "LearningRate": [lr_name]},
+                        {"ParamOut": [param.name], "MomentOut": [m]},
+                        {"l1": self._l1, "l2": self._l2})
+
+
+ProximalAdagradOptimizer = ProximalAdagrad
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameter values with apply/restore swap
+    (ref /root/reference/python/paddle/fluid/optimizer.py:1373).
+
+    Construct AFTER optimizer.minimize(): appends an
+    `average_accumulates` op per parameter to the main program so every
+    training step folds the freshly-updated params into the running sums.
+    `with ma.apply(exe):` swaps params for their averages (evaluation /
+    export); `restore` (automatic on context exit) puts the trained
+    values back.  The windowing knobs are accepted for API parity; the
+    TPU lowering keeps a single running sum since the last reset — the
+    simplification is noted in docs/PARITY.md."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, program=None,
+                 startup_program=None, **kw):
+        super().__init__(0.0, **kw)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        main = program or default_main_program()
+        block = main.global_block()
+        self._params = [v for v in main.list_vars()
+                        if isinstance(v, Parameter)]
+
+        def _append_accumulation():
+            for p in self._params:
+                s1 = self._add_accumulator("sum_1", p, block)
+                num = self._add_accumulator("num_accumulates", p, block,
+                                            shape=[1])
+                block.append_op("average_accumulates",
+                                {"param": [p.name], "in_sum_1": [s1],
+                                 "in_num_accumulates": [num]},
+                                {"out_sum_1": [s1],
+                                 "out_num_accumulates": [num]}, {})
+
+        if startup_program is not None:
+            # _add_accumulator writes its fill_constant init ops into the
+            # *default* startup program; when constructed outside the
+            # original program_guard, route them to the caller's startup.
+            from .framework.program import program_guard
+            with program_guard(main, startup_program):
+                _append_accumulation()
+        else:
+            _append_accumulation()
+        self._build_swap_programs()
+
+    def _declare(self, block, name, shape, dtype):
+        if not block.has_var(name):
+            block.create_var(name=name, shape=list(shape or [1]),
+                             dtype=dtype, persistable=True,
+                             stop_gradient=True)
+
+    def _build_swap_programs(self):
+        self.apply_program = Program()
+        self.restore_program = Program()
+        ab = self.apply_program.global_block()
+        rb = self.restore_program.global_block()
+        for p in self._params:
+            s1 = self._accumulators["sum_1"][p.name]
+            num = self._accumulators["num_accumulates"][p.name]
+            backup = f"{self._name}.{p.name}.backup"
+            for blk in (ab, rb):
+                self._declare(blk, p.name, p.shape, p.dtype)
+                self._declare(blk, backup, p.shape, p.dtype)
+            self._declare(ab, s1, p.shape, p.dtype)
+            self._declare(ab, num, [1], "float32")
+            ab.append_op("assign", {"X": [p.name]}, {"Out": [backup]})
+            one = f"{self._name}.{p.name}.one"
+            denom = f"{self._name}.{p.name}.denom"
+            avg = f"{self._name}.{p.name}.avg"
+            has = f"{self._name}.{p.name}.has_acc"
+            hasf = f"{self._name}.{p.name}.has_acc_f"
+            delta = f"{self._name}.{p.name}.avg_delta"
+            for n in (one, denom, avg, hasf, delta):
+                ab.create_var(name=n, dtype="float32", stop_gradient=True)
+            ab.create_var(name=has, dtype="bool", stop_gradient=True)
+            ab.append_op("fill_constant", outputs={"Out": [one]},
+                         attrs={"shape": [1], "dtype": "float32",
+                                "value": 1.0})
+            ab.append_op("elementwise_max", {"X": [num], "Y": [one]},
+                         {"Out": [denom]})
+            ab.append_op("elementwise_div", {"X": [s1], "Y": [denom]},
+                         {"Out": [avg]})
+            # keep the live params when nothing has been accumulated yet
+            # (apply() right after startup/checkpoint load must be a no-op,
+            # not an all-zeros swap):
+            # param += (num >= 1) * (avg - param)
+            ab.append_op("greater_equal", {"X": [num], "Y": [one]},
+                         {"Out": [has]})
+            ab.append_op("cast", {"X": [has]}, {"Out": [hasf]},
+                         {"out_dtype": "float32"})
+            ab.append_op("elementwise_sub", {"X": [avg], "Y": [p.name]},
+                         {"Out": [delta]})
+            ab.append_op("elementwise_mul", {"X": [delta], "Y": [hasf]},
+                         {"Out": [delta]})
+            ab.append_op("elementwise_add", {"X": [p.name], "Y": [delta]},
+                         {"Out": [p.name]})
+            rb.append_op("assign", {"X": [backup]}, {"Out": [p.name]})
+
+    def apply(self, executor, need_restore=True):
+        """Context manager: params hold averaged values inside the block."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            executor.run(self.apply_program)
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _ctx()
+
+    def restore(self, executor):
+        executor.run(self.restore_program)

@@ -66,7 +66,7 @@ class Trainer:
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  place=None, param_path: Optional[str] = None,
                  checkpoint_config: Optional[CheckpointConfig] = None,
-                 mesh=None):
+                 mesh=None, accumulate_steps: int = 1):
         self.checkpoint_cfg = checkpoint_config
         self.scope = Scope()
         self.startup_program = Program()
@@ -88,7 +88,7 @@ class Trainer:
             opt = optimizer_func()
             check_arg(isinstance(opt, optim.Optimizer),
                       "optimizer_func must return an Optimizer")
-            opt.minimize(self.loss)
+            opt.minimize(self.loss, accumulate_steps=accumulate_steps)
 
         self.test_program = self.train_program.clone(for_test=True)
         self.exe = Executor(place, scope=self.scope, mesh=mesh)

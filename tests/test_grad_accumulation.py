@@ -1,0 +1,180 @@
+"""Gradient accumulation (ref framework/ir/multi_batch_merge_pass.cc) and
+ModelAverage (ref python/paddle/fluid/optimizer.py:1373).
+
+Contract under test: K micro-batch steps with accumulate_steps=K must
+equal ONE optimizer step on the K×-size batch (within fp tolerance), for
+both a stateless (SGD) and a stateful (Adam) optimizer; ModelAverage's
+apply/restore context swaps params for their running average and back.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+rng = np.random.RandomState(7)
+
+
+def _build_linear(opt, accumulate_steps=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1,
+                         param_attr=pt.ParamAttr(
+                             name="w",
+                             initializer=pt.initializer.ConstantInitializer(
+                                 0.5)),
+                         bias_attr=False)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        opt.minimize(loss, accumulate_steps=accumulate_steps)
+    return main, startup, loss
+
+
+def _data(n):
+    x = rng.randn(n, 3).astype("float32")
+    y = (x @ np.array([[1.0], [-2.0], [0.5]], "float32")).astype("float32")
+    return x, y
+
+
+def _run_steps(opt_fn, accumulate_steps, batches, fetch_w=True):
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    main, startup, loss = _build_linear(opt_fn(), accumulate_steps)
+    exe.run(startup)
+    for bx, by in batches:
+        exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss])
+    return np.asarray(scope.find_var("w"))
+
+
+def _check_parity(opt_fn, k=4, tol=1e-5):
+    x, y = _data(8)
+    micro = [(x[i::k], y[i::k]) for i in range(k)]
+    w_acc = _run_steps(opt_fn, k, micro)
+    w_big = _run_steps(opt_fn, 1, [(x_, y_) for x_, y_ in [(
+        np.concatenate([m[0] for m in micro]),
+        np.concatenate([m[1] for m in micro]))]])
+    assert np.allclose(w_acc, w_big, atol=tol), (w_acc, w_big)
+
+
+def test_sgd_accumulation_matches_big_batch():
+    _check_parity(lambda: optimizer.SGD(learning_rate=0.1))
+
+
+def test_adam_accumulation_matches_big_batch():
+    """Stateful optimizer: moments/beta pows must freeze on non-boundary
+    steps — gating every written var, not just the param."""
+    _check_parity(lambda: optimizer.Adam(learning_rate=0.05))
+
+
+def test_params_frozen_until_boundary():
+    x, y = _data(8)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    main, startup, loss = _build_linear(optimizer.SGD(0.1),
+                                        accumulate_steps=4)
+    exe.run(startup)
+    w0 = np.asarray(scope.find_var("w")).copy()
+    for i in range(3):
+        exe.run(main, feed={"x": x[i::4], "y": y[i::4]},
+                fetch_list=[loss])
+        assert np.allclose(np.asarray(scope.find_var("w")), w0), \
+            f"param moved on non-boundary micro-step {i}"
+    exe.run(main, feed={"x": x[3::4], "y": y[3::4]}, fetch_list=[loss])
+    assert not np.allclose(np.asarray(scope.find_var("w")), w0), \
+        "param did not move on the boundary step"
+
+
+def test_proximal_optimizers_train():
+    x, y = _data(8)
+    for opt in (optimizer.ProximalGD(0.05, l1=1e-4, l2=1e-4),
+                optimizer.ProximalAdagrad(0.1, l1=1e-4, l2=1e-4)):
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace(), scope=scope)
+        main, startup, loss = _build_linear(opt)
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": x, "y": y},
+                                fetch_list=[loss])[0]) for _ in range(15)]
+        assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_model_average_apply_restore():
+    x, y = _data(8)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        xv = layers.data("x", [3])
+        yv = layers.data("y", [1])
+        pred = layers.fc(xv, size=1,
+                         param_attr=pt.ParamAttr(
+                             name="w",
+                             initializer=pt.initializer.ConstantInitializer(
+                                 0.5)),
+                         bias_attr=False)
+        loss = layers.reduce_mean(layers.square(pred - yv))
+        optimizer.SGD(0.1).minimize(loss)
+        ma = optimizer.ModelAverage(0.15, program=main)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    exe.run(startup)
+    snaps = []
+    for _ in range(5):
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        snaps.append(np.asarray(scope.find_var("w")).copy())
+    trained = snaps[-1]
+    expect_avg = np.mean(snaps, axis=0)
+    with ma.apply(exe):
+        inside = np.asarray(scope.find_var("w")).copy()
+        assert np.allclose(inside, expect_avg, atol=1e-5), (inside,
+                                                            expect_avg)
+    restored = np.asarray(scope.find_var("w"))
+    assert np.allclose(restored, trained, atol=1e-6)
+
+
+def test_model_average_outside_guard_and_before_training():
+    """Review r3: ModelAverage built outside the program_guard must route
+    accumulator init to the caller's startup program, and apply() before
+    any training step must keep the live params (not swap in zeros)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        xv = layers.data("x", [3])
+        yv = layers.data("y", [1])
+        pred = layers.fc(xv, size=1,
+                         param_attr=pt.ParamAttr(
+                             name="w",
+                             initializer=pt.initializer.ConstantInitializer(
+                                 0.5)),
+                         bias_attr=False)
+        loss = layers.reduce_mean(layers.square(pred - yv))
+        optimizer.SGD(0.1).minimize(loss)
+    ma = optimizer.ModelAverage(0.15, program=main,
+                                startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    exe.run(startup)
+    w0 = np.asarray(scope.find_var("w")).copy()
+    with ma.apply(exe):
+        assert np.allclose(np.asarray(scope.find_var("w")), w0), \
+            "apply() with zero accumulates must be a no-op"
+    x, y = _data(4)
+    exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    w1 = np.asarray(scope.find_var("w")).copy()
+    with ma.apply(exe):
+        assert np.allclose(np.asarray(scope.find_var("w")), w1, atol=1e-6)
+    assert np.allclose(np.asarray(scope.find_var("w")), w1)
+
+
+def test_accumulation_counter_wraps():
+    """The boundary counter must stay bounded (no fp32 saturation): after
+    many steps the gate still fires every k-th run."""
+    x, y = _data(8)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    main, startup, loss = _build_linear(optimizer.SGD(0.05),
+                                        accumulate_steps=2)
+    exe.run(startup)
+    for i in range(10):
+        exe.run(main, feed={"x": x[i % 2::2], "y": y[i % 2::2]},
+                fetch_list=[loss])
+    counters = [v for v in scope.var_names() if v.endswith("acc_counter")]
+    assert counters, "accumulation counter var missing"
+    c = float(np.asarray(scope.find_var(counters[0])).reshape(()))
+    assert 0.0 <= c < 2.0, f"counter not wrapped: {c}"
