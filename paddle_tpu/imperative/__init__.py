@@ -16,7 +16,7 @@ every registered differentiable op works imperatively with no extra
 grad registry.
 """
 from .base import enabled, guard, to_variable
-from .layers import FC, Layer, PyLayer
+from .layers import FC, Layer, PyLayer, seed_parameters
 from .varbase import VarBase, trace_op
 
 __all__ = ["enabled", "guard", "to_variable", "FC", "Layer", "PyLayer",

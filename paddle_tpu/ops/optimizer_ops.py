@@ -249,9 +249,18 @@ def _lamb(ctx, ins, attrs):
 @register_op("average_accumulates")
 def _average_accumulates(ctx, ins, attrs):
     """ModelAverage support (ref average_accumulates_op.cc), simplified to
-    the sum accumulators actually consumed by optimizer.ModelAverage."""
+    the sum accumulators actually consumed by optimizer.ModelAverage.
+    Within max_average_window steps this is the exact running sum; past
+    the cap it becomes a sliding-window approximation
+    (sum <- sum * (w-1)/w + param) so the count stays bounded — an
+    unbounded fp32 count would saturate at 2^24 and freeze, and the
+    reference's bucket rotation bounds its window the same way."""
     param = _p(ins, "param")
     s1 = _p(ins, "in_sum_1")
     num = _p(ins, "in_num_accumulates").reshape(())
-    return {"out_sum_1": [s1 + param],
-            "out_num_accumulates": [num + 1]}
+    w = float(attrs.get("max_average_window", 10000))
+    in_window = (num < w).astype(s1.dtype)
+    s1_out = jnp.where(in_window > 0, s1 + param,
+                       s1 * (w - 1.0) / w + param)
+    return {"out_sum_1": [s1_out],
+            "out_num_accumulates": [jnp.minimum(num + 1, w)]}

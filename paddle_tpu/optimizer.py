@@ -610,7 +610,9 @@ class ModelAverage(Optimizer):
                                 {"param": [p.name], "in_sum_1": [s1],
                                  "in_num_accumulates": [num]},
                                 {"out_sum_1": [s1],
-                                 "out_num_accumulates": [num]}, {})
+                                 "out_num_accumulates": [num]},
+                                {"max_average_window":
+                                 float(self.max_average_window)})
 
         if startup_program is not None:
             # _add_accumulator writes its fill_constant init ops into the

@@ -178,14 +178,26 @@ class Trainer:
 
     def test(self, reader: Callable, feed_order: Sequence[str]):
         from .data_feeder import DataFeeder
-        block = self.test_program.global_block()
+        fetch = [self.loss] + self.metrics
+        # Evaluation must be side-effect free: the for_test clone still
+        # contains the backward + optimizer (+ grad-accumulation) ops, so
+        # running it whole would TRAIN on the test set and corrupt the
+        # shared scope.  Prune to the forward slice that produces the
+        # fetches (the reference prunes in clone(for_test); here prune()
+        # needs the feed names, which arrive per call).
+        key = tuple(feed_order)
+        if getattr(self, "_test_pruned_key", None) != key:
+            self._test_pruned = self.test_program.prune(
+                key, [f.name for f in fetch])
+            self._test_pruned_key = key
+        test_prog = self._test_pruned
+        block = test_prog.global_block()
         feed_vars = [block.var(n) for n in feed_order]
         feeder = DataFeeder(feed_vars)
-        fetch = [self.loss] + self.metrics
         totals = None
         count = 0
         for batch in reader():
-            vals = self.exe.run(self.test_program,
+            vals = self.exe.run(test_prog,
                                 feed=feeder.feed(batch), fetch_list=fetch)
             vals = [np.asarray(v) for v in vals]
             totals = vals if totals is None else [

@@ -9,11 +9,15 @@ What remains a program transformation on TPU:
     (memory_optimization_transpiler.py:491)
   * DistributeTranspiler — API-compatible shim mapping the pserver-era
     contract onto the mesh/sharding plane (distribute_transpiler.py:148)
+  * TensorParallelTranspiler — Megatron-style tp as a layout rewrite on
+    the Program (no 2018-reference analogue; the mode the reference
+    lacked), executed by the mesh plane's GSPMD path
 """
 from .quantize_transpiler import QuantizeTranspiler
 from .inference_transpiler import InferenceTranspiler
 from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
+from .tensor_parallel import TensorParallelTranspiler
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,

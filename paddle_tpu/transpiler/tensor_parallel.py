@@ -1,0 +1,166 @@
+"""TensorParallelTranspiler — tensor parallelism as a *program
+transformation* on the Program IR.
+
+The reference's distributed modes are all program rewrites
+(/root/reference/python/paddle/fluid/transpiler/distribute_transpiler.py:268
+rewrites a local program into trainer/pserver programs); this transpiler
+keeps that discipline for a parallelism mode the 2018 reference did not
+have: Megatron-style tensor parallelism (+ vocab-parallel embeddings).
+
+TPU-first design: the transpiler annotates each Parameter with a
+`jax.sharding.PartitionSpec`-shaped tuple and the executor's mesh plane
+(framework/executor.py in_shardings path) hands those to XLA — GSPMD
+inserts the all-reduces/all-gathers that Megatron's fused layers issue by
+hand (and the reference's pserver/NCCL machinery would have carried).
+That is the whole point of building on XLA: a *layout* transformation is
+sufficient; no communication ops need to be spliced into the program, so
+the same Program runs unchanged on 1 device or an N-way mesh.  (The
+hand-written shard_map pipeline in parallel/hybrid.py remains the
+explicit-collective reference implementation of the same math; the
+DistributeTranspiler covers the explicit-collective data-parallel plane.)
+
+Annotation recipe (the Megatron alternation), decided by a small forward
+dataflow pass over the global block:
+
+  * `lookup_table` tables  -> (axis, None)   vocab(row)-parallel
+  * `mul`/`matmul` weights -> (None, axis)   column-parallel when the
+    activation feeding them is unsharded; (axis, None) row-parallel when
+    the activation's feature dim is already sharded (the matching
+    all-reduce is GSPMD's job)
+  * bias of a column-parallel fc -> (axis,)
+  * everything else (layer_norm scales, pos tables) stays replicated.
+
+Sharded-ness of activations is tracked as a boolean "feature dim is
+model-sharded" through shape/elementwise ops — enough to reproduce the
+qkv->out_proj / ffn1->ffn2 column->row pairing on transformer blocks.
+The annotations are *advisory* for XLA: any consistent assignment is
+correct; pairing only controls where the collectives land.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core.enforce import check_arg
+from ..framework.program import Parameter, Program
+
+# ops through which "my feature dim is sharded" propagates from any input
+# to all outputs
+_PROPAGATE = {
+    "reshape", "transpose", "scale", "dropout", "softmax", "cast",
+    "relu", "gelu", "tanh", "sigmoid", "elementwise_add",
+    "elementwise_sub", "elementwise_mul", "elementwise_div", "sum",
+    "stack", "concat", "split", "unsqueeze", "squeeze",
+    "layer_norm",
+}  # matmul/mul have their own branch below (incl. the param-less case)
+
+
+class TensorParallelTranspiler:
+    """Annotate a Program's parameters for N-way tensor parallelism over
+    a named mesh axis; run the result with
+    ``Executor(place, mesh=make_mesh((dp, tp), ("data", axis_name)))``."""
+
+    def __init__(self, axis_name: str = "model"):
+        self.axis_name = axis_name
+
+    # -----------------------------------------------------------------
+    def transpile(self, program: Program,
+                  num_partitions: Optional[int] = None) -> Dict[str, tuple]:
+        """Walk the program, assign Parameter.sharding specs, and return
+        {param_name: spec} for inspection/tests.  `num_partitions` (the
+        tp degree) is used only to validate divisibility of the dims it
+        shards — the mesh supplies the actual factor at run time."""
+        axis = self.axis_name
+        block = program.global_block()
+        sharded: Dict[str, bool] = {}
+        assigned: Dict[str, tuple] = {}
+
+        def is_param(name: str) -> bool:
+            return block.has_var(name) and isinstance(block.var(name),
+                                                      Parameter)
+
+        def check_div(name: str, dim: int):
+            if num_partitions:
+                size = block.var(name).shape[dim]
+                check_arg(
+                    size % num_partitions == 0,
+                    f"tensor-parallel transpile: {name} dim {dim} "
+                    f"({size}) not divisible by {num_partitions}")
+
+        def assign(name: str, spec: tuple):
+            var = block.var(name)
+            if getattr(var, "sharding", None) is None:
+                var.sharding = spec
+                assigned[name] = spec
+
+        for op in block.ops:
+            ins: List[str] = [n for names in op.inputs.values()
+                              for n in names]
+            outs: List[str] = [n for names in op.outputs.values()
+                               for n in names if n]
+            if op.type in ("lookup_table", "lookup_table_v2"):
+                for w in op.inputs.get("W", []):
+                    if is_param(w):
+                        check_div(w, 0)
+                        assign(w, (axis, None))
+                # gathered rows come out replicated
+                for o in outs:
+                    sharded[o] = False
+            elif op.type in ("mul", "matmul"):
+                ps = [n for n in ins if is_param(n)
+                      and len(block.var(n).shape) == 2]
+                if len(ps) == 1:
+                    w = ps[0]
+                    acts = [n for n in ins if n != w]
+                    feeding_sharded = any(sharded.get(a) for a in acts)
+                    # which weight dim is the contraction vs the output:
+                    # matmul's transpose_X/transpose_Y flip them (mul has
+                    # no transpose attrs)
+                    w_is_y = w in op.inputs.get("Y", [])
+                    transposed = bool(op.attrs.get(
+                        "transpose_y" if w_is_y else "transpose_x", False))
+                    if w_is_y:
+                        contract_dim, out_dim = ((1, 0) if transposed
+                                                 else (0, 1))
+                    else:   # weight on the left: X [k, m] (or [m, k]^T)
+                        contract_dim, out_dim = ((0, 1) if transposed
+                                                 else (1, 0))
+                    if feeding_sharded:
+                        check_div(w, contract_dim)
+                        spec = [None, None]
+                        spec[contract_dim] = axis   # row-parallel
+                        assign(w, tuple(spec))
+                        out_sharded = False         # after GSPMD's psum
+                    else:
+                        check_div(w, out_dim)
+                        spec = [None, None]
+                        spec[out_dim] = axis        # column-parallel
+                        assign(w, tuple(spec))
+                        out_sharded = True
+                    for o in outs:
+                        sharded[o] = out_sharded
+                else:
+                    for o in outs:
+                        sharded[o] = any(sharded.get(n) for n in ins)
+            elif op.type == "elementwise_add" and any(
+                    is_param(n) and len(block.var(n).shape) == 1
+                    for n in ins):
+                # bias add: shard the bias like the activation it joins
+                act_sharded = any(sharded.get(n) for n in ins
+                                  if not is_param(n))
+                for n in ins:
+                    if is_param(n) and len(block.var(n).shape) == 1 \
+                            and act_sharded:
+                        check_div(n, 0)
+                        assign(n, (axis,))
+                for o in outs:
+                    sharded[o] = act_sharded
+            elif op.type in _PROPAGATE:
+                val = any(sharded.get(n) for n in ins)
+                for o in outs:
+                    sharded[o] = val
+            else:
+                # conservative: sharded-ness does not cross unknown ops
+                for o in outs:
+                    sharded[o] = False
+        program._tp_axis = axis
+        return assigned

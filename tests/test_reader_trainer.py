@@ -232,3 +232,35 @@ def test_save_load_as_ops_roundtrip(tmp_path):
                                                          "lb"])
     assert np.allclose(loaded, x)
     assert np.allclose(la, x) and np.allclose(lb, x)
+
+
+def test_trainer_test_is_side_effect_free():
+    """Review r3: Trainer.test() must not touch params or optimizer /
+    accumulation state — the for_test clone still contains update ops, so
+    the test path has to run the pruned forward slice only."""
+    def train_func():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    def optimizer_func():
+        return pt.optimizer.Adam(learning_rate=1e-2)
+
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4).astype("f4"), rng.randn(1).astype("f4"))
+            for _ in range(8)]
+    r = reader.batch(lambda: iter(data), batch_size=4)
+
+    trainer = pt.Trainer(train_func, optimizer_func, place=pt.CPUPlace(),
+                         accumulate_steps=2)
+    trainer.train(num_epochs=1, event_handler=lambda e: None, reader=r,
+                  feed_order=["x", "y"])
+    snap = {n: np.asarray(trainer.scope.find_var(n)).copy()
+            for n in trainer.scope.var_names()
+            if trainer.scope.find_var(n) is not None}
+    trainer.test(reader=r, feed_order=["x", "y"])
+    for n, before in snap.items():
+        after = np.asarray(trainer.scope.find_var(n))
+        assert np.array_equal(before, after), \
+            f"test() mutated scope var {n}"

@@ -1,0 +1,122 @@
+"""Tensor parallelism on the Program plane (VERDICT r2 item #5).
+
+Contract: a user-built Program (the transformer from models/transformer.py)
+annotated by TensorParallelTranspiler — or by hand via
+ParamAttr(sharding=...) — trains on a (data x model) mesh with per-step
+loss parity against the single-device run, the same bar the DP plane
+meets in tests/test_parallel_executor.py (and the reference meets in
+test_dist_base.py check_with_place:502).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.place import make_mesh
+from paddle_tpu.models import transformer
+from paddle_tpu.transpiler import TensorParallelTranspiler
+
+
+def _build_lm(seed=11):
+    cfg = transformer.TransformerConfig(
+        src_vocab_size=64, tgt_vocab_size=64, max_length=16, n_layer=2,
+        n_head=4, d_model=16, d_inner=32, dropout=0.0,
+        label_smooth_eps=0.0)
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = seed
+    with pt.program_guard(main, startup):
+        feeds, avg_cost, _ = transformer.build_lm_net(
+            cfg, seq_len=12, fused_attention=False)
+        pt.optimizer.SGD(0.05).minimize(avg_cost)
+    return cfg, main, startup, avg_cost
+
+
+def _batches(cfg, n=4, bs=8, seq=12):
+    rng = np.random.RandomState(3)
+    out = []
+    for _ in range(n):
+        toks = rng.randint(1, cfg.src_vocab_size, (bs, seq)).astype("int64")
+        out.append({"tokens": toks, "labels": np.roll(toks, -1, 1)})
+    return out
+
+
+def test_transpiler_assigns_megatron_recipe():
+    cfg, main, startup, loss = _build_lm()
+    specs = TensorParallelTranspiler("model").transpile(main,
+                                                       num_partitions=4)
+    vals = list(specs.values())
+    # vocab-parallel embedding, and the column->row alternation visible
+    assert ("model", None) in vals and (None, "model") in vals
+    emb = [n for n in specs if "word_emb" in n]
+    assert emb and specs[emb[0]] == ("model", None)
+    col = sum(1 for v in vals if v == (None, "model"))
+    row = sum(1 for v in vals if v == ("model", None))
+    assert col >= cfg.n_layer * 2      # qkv projections + ffn1 (+ head)
+    assert row >= cfg.n_layer * 2      # out-proj + ffn2 (+ embedding)
+
+
+def test_transpiler_divisibility_enforced():
+    cfg, main, startup, loss = _build_lm()
+    with pytest.raises(Exception):
+        TensorParallelTranspiler("model").transpile(main, num_partitions=7)
+
+
+def _train(main, startup, loss, batches, mesh=None, batch_axis="data"):
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), scope=scope, mesh=mesh,
+                      batch_axis=batch_axis)
+    exe.run(startup)
+    return scope, [float(np.asarray(
+        exe.run(main, feed=f, fetch_list=[loss.name])[0]))
+        for f in batches]
+
+
+def test_tensor_parallel_loss_parity():
+    """Program-built transformer LM: single device vs 2x4 (data x model)
+    mesh after the tp transpile — per-step losses must match."""
+    cfg, main, startup, loss = _build_lm()
+    batches = _batches(cfg)
+    _, single = _train(main, startup, loss, batches)
+
+    cfg2, main2, startup2, loss2 = _build_lm()   # same seed -> same init
+    TensorParallelTranspiler("model").transpile(main2, num_partitions=4)
+    mesh = make_mesh((2, 4), ("data", "model"))
+    scope, par = _train(main2, startup2, loss2, batches, mesh=mesh)
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+
+    # the weights really live sharded over the model axis
+    sharded = [n for n in scope.var_names()
+               if main2.global_block().has_var(n)
+               and getattr(main2.global_block().var(n), "sharding", None)]
+    w = scope.find_var(sharded[0])
+    assert not w.sharding.is_fully_replicated
+
+
+def test_manual_param_attr_sharding_parity():
+    """The ParamAttr(sharding=...) spelling — no transpiler — reaches the
+    same plane: hand-annotated fc pair (column then row parallel)."""
+    def build(seed=9, shard=False):
+        col = pt.ParamAttr(sharding=(None, "model")) if shard else None
+        row = pt.ParamAttr(sharding=("model", None)) if shard else None
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = seed
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16])
+            y = layers.data("y", [1])
+            h = layers.fc(x, size=32, act="relu", param_attr=col,
+                          bias_attr=False)
+            p = layers.fc(h, size=1, param_attr=row, bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(p, y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(1)
+    w = rng.randn(16, 1).astype("float32")
+    batches = [{"x": (xb := rng.randn(16, 16).astype("float32")),
+                "y": xb @ w} for _ in range(4)]
+    main, startup, loss = build()
+    _, single = _train(main, startup, loss, batches)
+    main2, startup2, loss2 = build(shard=True)
+    mesh = make_mesh((2, 4), ("data", "model"))
+    _, par = _train(main2, startup2, loss2, batches, mesh=mesh)
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
