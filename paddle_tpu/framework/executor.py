@@ -331,6 +331,7 @@ class Executor:
         self.mesh = mesh
         self.batch_axis = batch_axis
         self._cache: Dict[tuple, _CompiledProgram] = {}
+        self._root_keys: Dict[int, Any] = {}
         self._run_counter = 0
 
     # ------------------------------------------------------------------
@@ -407,7 +408,9 @@ class Executor:
 
         seed = (program.random_seed if program.random_seed is not None
                 else flags.get_flag("rng_seed"))
-        root = jax.random.PRNGKey(seed)
+        root = self._root_keys.get(seed)
+        if root is None:        # cache: PRNGKey is a device computation
+            root = self._root_keys[seed] = jax.random.PRNGKey(seed)
         if program.random_seed is None:
             root = jax.random.fold_in(root, self._run_counter)
         self._run_counter += 1

@@ -20,4 +20,4 @@ from .layers import FC, Layer, PyLayer, seed_parameters
 from .varbase import VarBase, trace_op
 
 __all__ = ["enabled", "guard", "to_variable", "FC", "Layer", "PyLayer",
-           "VarBase", "trace_op"]
+           "VarBase", "trace_op", "seed_parameters"]

@@ -2,3 +2,4 @@
 cuDNN/cuBLAS/xbyak kernels' TPU-native replacements)."""
 from .flash_attention import flash_attention
 from .layer_norm import fused_layer_norm
+from .lm_head import lm_head_xent

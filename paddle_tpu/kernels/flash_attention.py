@@ -15,9 +15,11 @@ design per /opt/skills/guides/pallas_guide.md:
     (preferred_element_type), softmax statistics in f32.
   * causal: blocks fully above the diagonal skip their compute via
     pl.when.
-  * backward: custom_vjp recomputes blockwise under lax.scan (XLA fuses
-    it) from the saved (o, lse) — FlashAttention-2 recurrence, also
-    without [T, T] HBM tensors.
+  * backward: two Pallas kernels (dk/dv with the Q dimension innermost,
+    dq with the K dimension innermost) recomputing probabilities from the
+    saved (o, lse) — the FlashAttention-2 recurrence, also without [T, T]
+    HBM tensors.  The delta term rowsum(do*o) is precomputed in XLA.
+    Causal blocks above the diagonal skip compute in both kernels.
 
 On non-TPU platforms the kernel runs in interpret mode (tests), so the op
 surface is identical everywhere.  Measured on v5e (bf16, d=64, causal,
@@ -49,6 +51,12 @@ def _pick_block(t: int, target: int) -> int:
     return b
 
 
+def _bmm(a, b, contract, batch=((0,), (0,))):
+    """Batched matmul over leading g dim with f32 accumulation."""
+    return jax.lax.dot_general(a, b, (contract, batch),
+                               preferred_element_type=jnp.float32)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr,
                 *, block_q, block_k, nk, scale, causal, kv_len):
     qi = pl.program_id(1)
@@ -65,42 +73,57 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0]                               # [block_q, d]
-        k = k_ref[0]                               # [block_k, d]
-        v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+        # scale folded into q: one [g, bq, d] multiply instead of a
+        # [g, bq, bk] one on the scores (the VPU is the bottleneck here)
+        q = q_ref[...] * jnp.asarray(scale, q_ref.dtype)
+        k = k_ref[...]                             # [g, block_k, d]
+        v = v_ref[...]
+        s = _bmm(q, k, ((2,), (2,)))               # [g, block_q, block_k]
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where((q_pos >= k_pos)[None], s, NEG_INF)
         if kv_len is not None:
             # sequence was padded up to a tile multiple: mask padded keys
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos < kv_len, s, NEG_INF)
-        m_prev = m_scr[:, :1]                      # [block_q, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            s = jnp.where((k_pos < kv_len)[None], s, NEG_INF)
+        m_prev = m_scr[:, :, :1]                   # [g, block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
-        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=1,
-                                                     keepdims=True)
-        m_scr[:, :1] = m_new
-        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        l_scr[:, :, :1] = l_scr[:, :, :1] * corr + jnp.sum(
+            p, axis=2, keepdims=True)
+        m_scr[:, :, :1] = m_new
+        acc_scr[...] = acc_scr[...] * corr + _bmm(
+            p.astype(v.dtype), v, ((2,), (1,)))
 
     @pl.when(ki == nk - 1)
     def _finish():
-        l = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l)).astype(jnp.float32)
+        l = jnp.maximum(l_scr[:, :, :1], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = (m_scr[:, :, :1] + jnp.log(l)).astype(jnp.float32)
+
+
+def _pick_group(BH: int, block_q: int, block_k: int,
+                cap: int = 1024 * 1024) -> int:
+    """Batch-heads processed per grid step.  Folding several [T, d] heads
+    into one step amortises per-step overhead (DMA issue + scalar
+    prologue) while keeping the f32 score intermediates g*block_q*block_k
+    under `cap` elements so everything stays in the 16M scoped VMEM
+    (fwd holds 2 score-sized arrays -> cap 1M; bwd holds ~4 -> cap 512K;
+    both caps sit just under limits measured to OOM on v5e)."""
+    g = 1
+    while (g < 16 and BH % (g * 2) == 0
+           and (g * 2) * block_q * block_k <= cap):
+        g *= 2
+    return g
 
 
 def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
-               kv_len=None):
+               kv_len=None, block_bh=None):
     """q,k,v: [BH, T, d] -> (o [BH, T, d], lse [BH, T]).  kv_len: actual
     key length when T includes tile padding (mask keys >= kv_len)."""
     BH, T, d = q.shape
@@ -109,8 +132,11 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
     if T % block_q or T % block_k:
         raise ValueError(f"seq len {T} not divisible by blocks "
                          f"({block_q}, {block_k})")
+    g = block_bh or _pick_group(BH, block_q, block_k)
+    if BH % g:
+        raise ValueError(f"block_bh {g} must divide batch*heads {BH}")
     nk = T // block_k
-    grid = (BH, T // block_q, nk)
+    grid = (BH // g, T // block_q, nk)
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, nk=nk, scale=scale,
                                causal=causal, kv_len=kv_len)
@@ -118,17 +144,17 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((g, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((g, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((g, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((g, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((g, block_q, 1), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -136,9 +162,9 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
             jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),       # acc
-            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
-            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
+            pltpu.VMEM((g, block_q, d), jnp.float32),       # acc
+            pltpu.VMEM((g, block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((g, block_q, _LANES), jnp.float32),  # running sum
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -147,69 +173,178 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
     return o, lse[..., 0]
 
 
-def _flash_bwd(scale, causal, kv_len, res, do):
-    """Blockwise recompute backward (FlashAttention-2 recurrence) — pure
-    XLA lax.scan, no [T,T] HBM tensor.  Matmuls run in the INPUT dtype
-    (bf16 under AMP — full MXU rate) with f32 accumulation; the softmax
-    recompute (exp, the (dp - D) correction) stays f32."""
+def _recompute_p_ds(qs, k, v, do, lse, delta, qi, ki, block_q, block_k,
+                    causal, kv_len):
+    """Shared bwd-block math: recompute p [g, block_q, block_k] from the
+    PRE-SCALED q' = q*scale and (k, lse), and the cotangent
+    ds' = p*(dp-delta) (wrt s' = q'@k^T — the scale is folded into the
+    operands so no [g, bq, bk]-wide multiply is spent on it; this path is
+    VPU-bound at small head dims).  f32 softmax math, input-dtype matmul
+    operands."""
+    s = _bmm(qs, k, ((2,), (2,)))
+    k_pos = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        s = jnp.where((q_pos >= k_pos)[None], s, NEG_INF)
+    if kv_len is not None:
+        s = jnp.where((k_pos < kv_len)[None], s, NEG_INF)
+    p = jnp.exp(s - lse)                           # [g, block_q, block_k]
+    dp = _bmm(do, v, ((2,), (2,)))
+    ds = p * (dp - delta)
+    return p, ds
+
+
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, block_q, block_k,
+                    nq, scale, causal, kv_len):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: Q blocks strictly above this K block contribute nothing
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        qs = q_ref[...] * jnp.asarray(scale, q_ref.dtype)  # [g, bq, d]
+        do = do_ref[...]
+        k = k_ref[...]                                 # [g, block_k, d]
+        v = v_ref[...]
+        p, ds = _recompute_p_ds(
+            qs, k, v, do, lse_ref[...], delta_ref[...], qi, ki,
+            block_q, block_k, causal, kv_len)
+        dv_scr[...] = dv_scr[...] + _bmm(
+            p.astype(do.dtype), do, ((1,), (1,)))
+        # dk = ds'^T @ (q*scale): the pre-scaled q' already carries scale
+        dk_scr[...] = dk_scr[...] + _bmm(
+            ds.astype(qs.dtype), qs, ((1,), (1,)))
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                   dq_ref, dq_scr, *, block_q, block_k, nk, scale, causal,
+                   kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        qs = q_ref[...] * jnp.asarray(scale, q_ref.dtype)
+        k = k_ref[...]
+        _, ds = _recompute_p_ds(
+            qs, k, v_ref[...], do_ref[...], lse_ref[...], delta_ref[...],
+            qi, ki, block_q, block_k, causal, kv_len)
+        dq_scr[...] = dq_scr[...] + _bmm(ds.astype(k.dtype), k,
+                                         ((2,), (1,)))
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        # dq = (ds' @ k) * scale — one [g, bq, d]-wide multiply at the end
+        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd(scale, causal, kv_len, interpret, res, do,
+               block_q=None, block_k=None, block_bh=None):
+    """Pallas backward: dk/dv kernel (Q innermost) + dq kernel (K
+    innermost), FlashAttention-2 recurrence recomputing p from the saved
+    (o, lse).  No [T,T] HBM tensor; matmuls run in the INPUT dtype (bf16
+    under AMP — full MXU rate) with f32 accumulation; softmax recompute
+    and the (dp - delta) correction stay f32."""
     q, k, v, o, lse = res
     BH, T, d = q.shape
-    blk = _pick_block(T, 128)
-    nb = T // blk
-    mm = q.dtype                          # matmul operand dtype
-    dom = do.astype(mm)
-    D = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                axis=-1)                                    # [BH, T]
-    q_idx = jnp.arange(T)
+    block_q = block_q or _pick_block(T, 256)
+    block_k = block_k or _pick_block(T, 512)
+    nq, nk = T // block_q, T // block_k
+    g = block_bh or _pick_group(BH, block_q, block_k, cap=512 * 1024)
+    if BH % g:
+        raise ValueError(f"block_bh {g} must divide batch*heads {BH}")
+    do = do.astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)             # [BH, T, 1]
+    lse3 = lse[..., None]                               # [BH, T, 1]
 
-    def kv_block(carry, bi):
-        dq = carry
-        ks = lax.dynamic_slice_in_dim(k, bi * blk, blk, axis=1)
-        vs = lax.dynamic_slice_in_dim(v, bi * blk, blk, axis=1)
-        s = jnp.einsum("bqd,bkd->bqk", q, ks,
-                       preferred_element_type=jnp.float32) * scale
-        k_pos = bi * blk + jnp.arange(blk)
-        if causal:
-            mask = q_idx[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None], s, NEG_INF)
-        if kv_len is not None:
-            s = jnp.where((k_pos < kv_len)[None, None, :], s, NEG_INF)
-        p = jnp.exp(s - lse[:, :, None])                    # [BH, T, blk]
-        pm = p.astype(mm)
-        dv = jnp.einsum("bqk,bqd->bkd", pm, dom,
-                        preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bqd,bkd->bqk", dom, vs,
-                        preferred_element_type=jnp.float32)
-        ds = p * (dp - D[:, :, None]) * scale
-        dsm = ds.astype(mm)
-        dk = jnp.einsum("bqk,bqd->bkd", dsm, q,
-                        preferred_element_type=jnp.float32)
-        dq = dq + jnp.einsum("bqk,bkd->bqd", dsm, ks,
-                             preferred_element_type=jnp.float32)
-        return dq, (dk, dv)
+    def q_side(ix):         # q/do/lse/delta blocks, width w, q index = ix
+        def spec(w):
+            return pl.BlockSpec((g, block_q, w),
+                                lambda b, i, j: (b, ix(i, j), 0),
+                                memory_space=pltpu.VMEM)
+        return spec
 
-    dq0 = jnp.zeros((BH, T, d), jnp.float32)
-    dq, (dks, dvs) = lax.scan(kv_block, dq0, jnp.arange(nb))
-    dk = jnp.moveaxis(dks, 0, 1).reshape(BH, T, d)
-    dv = jnp.moveaxis(dvs, 0, 1).reshape(BH, T, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    def kv_side(ix):
+        return pl.BlockSpec((g, block_k, d),
+                            lambda b, i, j: (b, ix(i, j), 0),
+                            memory_space=pltpu.VMEM)
+
+    qs, ks = q_side(lambda i, j: j), kv_side(lambda i, j: i)
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, block_k=block_k, nq=nq,
+        scale=scale, causal=causal, kv_len=kv_len)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH // g, nk, nq),
+        in_specs=[qs(d), qs(d), qs(1), qs(1), ks, ks],
+        out_specs=[ks, ks],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, d), k.dtype),
+                   jax.ShapeDtypeStruct((BH, T, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((g, block_k, d), jnp.float32),
+                        pltpu.VMEM((g, block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, do, lse3, delta, k, v)
+
+    qs2, ks2 = q_side(lambda i, j: i), kv_side(lambda i, j: j)
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_q=block_q, block_k=block_k, nk=nk,
+        scale=scale, causal=causal, kv_len=kv_len)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH // g, nq, nk),
+        in_specs=[qs2(d), qs2(d), qs2(1), qs2(1), ks2, ks2],
+        out_specs=pl.BlockSpec((g, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((g, block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, do, lse3, delta, k, v)
+    return dq, dk, dv
 
 
 @functools.lru_cache(maxsize=64)
-def _make_flash(scale, causal, interpret, block_q, block_k, kv_len=None):
+def _make_flash(scale, causal, interpret, block_q, block_k, kv_len=None,
+                block_bh=None):
     @jax.custom_vjp
     def f(q, k, v):
         o, _ = _flash_fwd(q, k, v, scale, causal, interpret, block_q,
-                          block_k, kv_len)
+                          block_k, kv_len, block_bh)
         return o
 
     def fwd(q, k, v):
         o, lse = _flash_fwd(q, k, v, scale, causal, interpret, block_q,
-                            block_k, kv_len)
+                            block_k, kv_len, block_bh)
         return o, (q, k, v, o, lse)
 
     def bwd(res, g):
-        return _flash_bwd(scale, causal, kv_len, res, g)
+        return _flash_bwd(scale, causal, kv_len, interpret, res, g,
+                          block_q, block_k, block_bh)
 
     f.defvjp(fwd, bwd)
     return f
@@ -224,7 +359,7 @@ _SEQ_GRANULE = 128
 
 def flash_attention(q, k, v, causal: bool = False, scale: float = None,
                     interpret: bool = None, block_q: int = None,
-                    block_k: int = None):
+                    block_k: int = None, block_bh: int = None):
     """q,k,v: [B, H, T, d] (or [BH, T, d]).  Returns same shape.
 
     Any T works: sequences not divisible by 128 are internally padded to
@@ -254,7 +389,7 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
         pad = ((0, 0), (0, Tp - T), (0, 0))
         q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
     f = _make_flash(float(scale), bool(causal), bool(interpret),
-                    block_q, block_k, kv_len)
+                    block_q, block_k, kv_len, block_bh)
     out = f(q, k, v)
     if kv_len is not None:
         out = out[:, :T]

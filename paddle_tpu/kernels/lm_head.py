@@ -1,0 +1,282 @@
+"""Pallas TPU fused LM-head + softmax cross-entropy.
+
+The reference computes this as fc -> softmax_with_cross_entropy
+(/root/reference/paddle/fluid/operators/softmax_with_cross_entropy_op.cc)
+materializing the full [N, V] logits; at V=32k that makes the LM head
+HBM-bound: the f32 logits round-trip HBM once in forward and 2-3 more
+times in backward (measured ~19 ms of a 57 ms d512/L6 train step on v5e
+— docs/profile_r03/breakdown.md).
+
+TPU-first design: stream the vocabulary.  Logits NEVER exist in HBM —
+only one [block_n, block_v] f32 tile lives in VMEM while a running
+(max, sumexp, label-logit) triple is carried across vocab blocks
+(online softmax, same recurrence as flash attention):
+
+  forward : grid (N/bn, V/bv), vocab innermost; out = per-token loss
+            + lse residual.  One matmul pass over W.
+  backward: python loop over token chunks; per chunk ONE kernel with
+            grid (V/bv,) recomputing the logits tile, forming
+            dlogits = (softmax - onehot) * g and feeding BOTH matmuls:
+            dx (VMEM accumulator across vocab blocks) and dW (HBM
+            accumulator via input_output_aliases, one visit per vocab
+            block per chunk).  3 matmul passes total — the minimum for
+            a rematerialized head — with all softmax arithmetic fused
+            into them.
+
+Numerics: matmuls run in the input dtype (bf16 under AMP) with f32
+accumulation; softmax statistics, loss and the dW accumulator are f32.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _pick_block_v(V: int) -> int:
+    """Largest multiple of 128 that divides V, capped at 640 (keeps the
+    f32 logits tile [block_n, block_v] a few MB).  0 if none divides —
+    caller pads V."""
+    for bv in (640, 512, 384, 256, 128):
+        if V % bv == 0:
+            return bv
+    return 0
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, loss_ref, lse_ref, m_scr, l_scr,
+                g_scr, *, block_v, nv, valid_v):
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        g_scr[:] = jnp.zeros_like(g_scr)
+
+    logits = lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [bn, bv]
+    cols = vi * block_v + lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    if valid_v is not None:                        # padded vocab tail
+        logits = jnp.where(cols < valid_v, logits, NEG_INF)
+    y = y_ref[...]                                 # [bn, 1] int32
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(
+        jnp.exp(logits - m_new), axis=1, keepdims=True)
+    m_scr[:, :1] = m_new
+    # the gold logit lives in exactly one vocab block: masked row-sum
+    g_scr[:, :1] = g_scr[:, :1] + jnp.sum(
+        jnp.where(cols == y, logits, 0.0), axis=1, keepdims=True)
+
+    @pl.when(vi == nv - 1)
+    def _finish():
+        lse = m_scr[:, :1] + jnp.log(jnp.maximum(l_scr[:, :1], 1e-30))
+        valid = (y_ref[...] >= 0).astype(jnp.float32)
+        loss_ref[...] = (lse - g_scr[:, :1]) * valid
+        lse_ref[...] = lse
+
+
+def _bwd_kernel(x_ref, w_ref, stats_ref, dw_in_ref,
+                dx_ref, dw_out_ref, *, block_v, nv, valid_v):
+    """stats packs (lse, g, label-as-f32) in one [C, 128] f32 block —
+    three separate [C, 1] inputs would each pad to 128 lanes in VMEM.
+    dx accumulates directly in its (revisited, constant-index) f32
+    output block instead of a scratch copy."""
+    vi = pl.program_id(0)
+
+    @pl.when(vi == 0)
+    def _init():
+        dx_ref[:] = jnp.zeros_like(dx_ref)
+
+    x = x_ref[...]                                 # [C, D]
+    w = w_ref[...]                                 # [D, bv]
+    logits = lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    cols = vi * block_v + lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    if valid_v is not None:
+        logits = jnp.where(cols < valid_v, logits, NEG_INF)
+    lse = stats_ref[:, 0:1]
+    g = stats_ref[:, 1:2]
+    y = stats_ref[:, 2:3].astype(jnp.int32)
+    p = jnp.exp(logits - lse)                      # [C, bv]
+    onehot = (cols == y).astype(jnp.float32)
+    dlogits = ((p - onehot) * g).astype(x.dtype)
+    dx_ref[...] = dx_ref[...] + lax.dot_general(
+        dlogits, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dw_out_ref[...] = dw_in_ref[...] + lax.dot_general(
+        x, dlogits, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _fwd(x, w, y2d, interpret, block_n, block_v, valid_v):
+    N, D = x.shape
+    _, Vp = w.shape
+    nv = Vp // block_v
+    nt = N // block_n
+    kernel = functools.partial(_fwd_kernel, block_v=block_v, nv=nv,
+                               valid_v=valid_v)
+    loss, lse = pl.pallas_call(
+        kernel,
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((D, block_v), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_n, _LANES), jnp.float32)] * 3,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, w, y2d)
+    return loss[:, 0], lse
+
+
+def _bwd(x, w, y2d, lse, g, interpret, chunk, block_v, valid_v):
+    N, D = x.shape
+    _, Vp = w.shape
+    chunk = min(chunk, N)
+    while chunk > 2048 and chunk % 2 == 0:
+        chunk //= 2        # [chunk, *] f32 tiles must fit scoped VMEM
+    # the bwd kernel holds ~3 [chunk, bv] f32 intermediates plus the
+    # [chunk, D] accumulator; shrink bv until the logits tile is <= 2MB
+    # (bv must still divide the padded vocab and keep 128 lanes)
+    bv = block_v
+    while chunk * bv * 4 > 2 * 1024 * 1024:
+        for cand in range(bv - 128, 0, -128):
+            if Vp % cand == 0:
+                bv = cand
+                break
+        else:
+            break
+    block_v = bv
+    nv = Vp // block_v
+    n_chunks = N // chunk
+    kernel = functools.partial(_bwd_kernel, block_v=block_v, nv=nv,
+                               valid_v=valid_v)
+    dw = jnp.zeros((D, Vp), jnp.float32)
+    dxs = []
+    stats = jnp.zeros((N, _LANES), jnp.float32)
+    stats = stats.at[:, 0].set(lse[:, 0])
+    # ignored (negative-label) tokens have zero loss -> zero cotangent;
+    # mask g here so the kernel's (p - onehot)*g emits no gradient for
+    # them (the forward multiplies by the same valid mask)
+    valid = (y2d[:, 0] >= 0).astype(jnp.float32)
+    stats = stats.at[:, 1].set(g.reshape(N).astype(jnp.float32) * valid)
+    stats = stats.at[:, 2].set(y2d[:, 0].astype(jnp.float32))
+    for ci in range(n_chunks):
+        sl = slice(ci * chunk, (ci + 1) * chunk)
+        dx_c, dw = pl.pallas_call(
+            kernel,
+            grid=(nv,),
+            in_specs=[
+                pl.BlockSpec((chunk, D), lambda j: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((D, block_v), lambda j: (0, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((chunk, _LANES), lambda j: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((D, block_v), lambda j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((chunk, D), lambda j: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((D, block_v), lambda j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((chunk, D), jnp.float32),
+                jax.ShapeDtypeStruct((D, Vp), jnp.float32),
+            ],
+            input_output_aliases={3: 1},   # dw accumulates in place
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(x[sl], w, stats[sl], dw)
+        dxs.append(dx_c.astype(x.dtype))
+    return jnp.concatenate(dxs, 0), dw.astype(w.dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_head(interpret, block_n, block_v, chunk, valid_v):
+    @jax.custom_vjp
+    def f(x, w, y2d):
+        loss, _ = _fwd(x, w, y2d, interpret, block_n, block_v, valid_v)
+        return loss
+
+    def fwd(x, w, y2d):
+        loss, lse = _fwd(x, w, y2d, interpret, block_n, block_v, valid_v)
+        return loss, (x, w, y2d, lse)
+
+    def bwd(res, g):
+        x, w, y2d, lse = res
+        dx, dw = _bwd(x, w, y2d, lse, g, interpret, chunk, block_v,
+                      valid_v)
+        dy = np.zeros(y2d.shape, jax.dtypes.float0)
+        return dx, dw, dy
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def lm_head_xent(x, w, labels, interpret: bool = None,
+                 block_n: int = None, block_v: int = None,
+                 chunk: int = 2048):
+    """Per-token softmax cross-entropy through a streamed LM head.
+
+    x [N, D], w [D, V], labels [N] int (negative = ignored) ->
+    loss [N] f32 (0 at ignored positions).  N must be a multiple of 256
+    (the framework pads batches); V is padded internally to a tile
+    multiple.  Differentiable wrt x and w.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    N, D = x.shape
+    V = w.shape[1]
+    bv = block_v or _pick_block_v(V) or 512
+    valid_v = None
+    if V % bv:
+        Vp = -(-V // bv) * bv
+        w = jnp.pad(w, ((0, 0), (0, Vp - V)))
+        valid_v = V
+    bn = block_n
+    if bn is None:
+        bn = min(1024, N)
+        while N % bn:
+            bn //= 2
+    if N % bn or bn < 8:
+        raise ValueError(f"token count {N} not divisible by block {bn}")
+    chunk = min(chunk, N)
+    while N % chunk:
+        chunk //= 2
+    y2d = labels.reshape(N, 1).astype(jnp.int32)
+    f = _make_head(bool(interpret), int(bn), int(bv), int(chunk),
+                   valid_v)
+    return f(x, w, y2d)

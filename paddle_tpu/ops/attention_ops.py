@@ -74,6 +74,17 @@ def _fused_lm_head_loss(ctx, ins, attrs):
     chunk = min(chunk, n)
     n_chunks = (n + chunk - 1) // chunk
     pad = n_chunks * chunk - n
+    if (flags.get_flag("use_pallas_kernels") and n % 256 == 0
+            and d <= 2048):
+        # vocab-streamed Pallas head (kernels/lm_head.py): logits never
+        # hit HBM, 1 fwd + 3 bwd matmul passes — the [N,V] HBM round
+        # trips of the scan path below were the top cost of the v5e
+        # flagship step (docs/profile_r03)
+        from ..kernels.lm_head import lm_head_xent
+        xb, wb = amp_inputs(x, w)
+        losses = lm_head_xent(xb, wb, label, chunk=chunk,
+                              interpret=ctx.pallas_interpret())
+        return {"Loss": [(jnp.sum(losses) / n).reshape(1)]}
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
         label = jnp.pad(label, (0, pad), constant_values=-1)

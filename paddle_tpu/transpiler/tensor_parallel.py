@@ -117,7 +117,7 @@ class TensorParallelTranspiler:
                     # no transpose attrs)
                     w_is_y = w in op.inputs.get("Y", [])
                     transposed = bool(op.attrs.get(
-                        "transpose_y" if w_is_y else "transpose_x", False))
+                        "transpose_Y" if w_is_y else "transpose_X", False))
                     if w_is_y:
                         contract_dim, out_dim = ((1, 0) if transposed
                                                  else (0, 1))

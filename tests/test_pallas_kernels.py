@@ -201,3 +201,89 @@ def test_fused_mha_named_attr_does_not_alias():
             x, x, x, n_head=2, param_attr=pt.ParamAttr(name="attn"))
     names = [p.name for p in main.all_parameters()]
     assert len(set(names)) == 4, names
+
+
+def ref_lm_head_loss(x, w, y):
+    logits = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) \
+        + logits.max(-1)
+    gold = np.take_along_axis(logits, np.maximum(y, 0)[:, None], 1)[:, 0]
+    return (lse - gold) * (y >= 0)
+
+
+@pytest.mark.parametrize("V", [384, 500])     # divisible + padded-tail
+def test_lm_head_xent_matches_reference(V):
+    from paddle_tpu.kernels import lm_head_xent
+    rng = np.random.RandomState(7)
+    N, D = 64, 32
+    x = jnp.asarray(rng.randn(N, D).astype("float32"))
+    w = jnp.asarray(rng.randn(D, V).astype("float32") * 0.1)
+    y = rng.randint(0, V, N).astype("int32")
+    y[5] = -1                                  # ignored position
+    out = lm_head_xent(x, w, jnp.asarray(y), block_n=32, block_v=128,
+                       chunk=32)
+    ref = ref_lm_head_loss(x, w, y)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_lm_head_xent_grads_match():
+    from paddle_tpu.kernels import lm_head_xent
+    rng = np.random.RandomState(8)
+    N, D, V = 64, 16, 256
+    x = jnp.asarray(rng.randn(N, D).astype("float32"))
+    w = jnp.asarray(rng.randn(D, V).astype("float32") * 0.1)
+    y = rng.randint(0, V, N).astype("int32")
+    y[3] = -1
+    yj = jnp.asarray(y)
+
+    def loss_k(x, w):
+        # sum + sum**2: the plain sum gives IGNORED tokens a nonzero
+        # upstream cotangent, so a kernel that fails to mask their
+        # gradient (dlogits = softmax/n instead of 0) is caught
+        per_tok = lm_head_xent(x, w, yj, block_n=32, block_v=128,
+                               chunk=32)
+        return jnp.sum(per_tok ** 2) + jnp.sum(per_tok)
+
+    def loss_ref(x, w):
+        logits = x @ w
+        lse = jax.scipy.special.logsumexp(logits, -1)
+        gold = jnp.take_along_axis(
+            logits, jnp.maximum(yj, 0)[:, None], 1)[:, 0]
+        per_tok = (lse - gold) * (yj >= 0)
+        return jnp.sum(per_tok ** 2) + jnp.sum(per_tok)
+
+    gk = jax.grad(loss_k, argnums=(0, 1))(x, w)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_fused_lm_head_op_pallas_vs_scan_path():
+    """The op's kernel path and its scan fallback must agree."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core import flags
+    rng = np.random.RandomState(9)
+    xv = rng.randn(2, 128, 32).astype("float32")
+    yv = rng.randint(0, 384, (2, 128)).astype("int64")
+    outs = []
+    for use in (True, False):
+        flags.set_flag("use_pallas_kernels", use)
+        try:
+            pt.reset_default_programs()
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", [128, 32], dtype="float32")
+                yl = layers.data("y", [128], dtype="int64")
+                x2 = layers.reshape(x, [-1, 32])
+                y2 = layers.reshape(yl, [-1])
+                loss = layers.fused_lm_head_loss(x2, 384, y2)
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup)
+            o, = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[loss])
+            outs.append(o)
+        finally:
+            flags.set_flag("use_pallas_kernels", True)
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-4, atol=2e-4)
