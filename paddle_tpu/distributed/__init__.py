@@ -1,2 +1,3 @@
 """Distributed coordination utilities (ref go/ layer of the reference)."""
+from .async_update import AsyncParameterServer, run_async_workers
 from .task_queue import Task, TaskMaster, TaskMasterClient, serve_master

@@ -351,14 +351,24 @@ class Executor:
                        for f in fetch_list]
 
         # materialise feeds: single-device -> device_put; mesh -> leave as
-        # host arrays, jit's in_shardings scatters them across devices
+        # host arrays, jit's in_shardings scatters them across devices.
+        # Multi-process mesh (jax.distributed world): every process feeds
+        # the same GLOBAL batch and each materialises only its addressable
+        # shards (the reference's trainers each feed a slice; here the
+        # deterministic global batch keeps loss parity with 1-process runs)
+        multiproc = self.mesh is not None and jax.process_count() > 1
         dev_feeds = {}
         for name, val in feed.items():
             var = block.var(name) if block.has_var(name) else None
             if self.mesh is not None:
+                if isinstance(val, jax.Array):
+                    dev_feeds[name] = val    # already device/global-laid
+                    continue
                 arr = np.asarray(val)
                 if var is not None and var.dtype is not None:
                     arr = arr.astype(to_jnp_dtype(var.dtype))
+                if multiproc:
+                    arr = self._globalize_feed(program, name, var, arr)
                 dev_feeds[name] = arr
             else:
                 dev_feeds[name] = _as_device_array(val, var, device)
@@ -429,13 +439,41 @@ class Executor:
 
         if flags.get_flag("check_nan_inf"):
             for n, v in zip(fetch_names, fetches):
-                a = np.asarray(v)
+                a = self._fetch_numpy(v)
                 if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
                     raise EnforceNotMet(f"NaN/Inf detected in fetch {n!r}")
 
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            return [self._fetch_numpy(v) for v in fetches]
         return fetches
+
+    def _globalize_feed(self, program, name, var, arr):
+        """Build a global jax.Array for `arr` (the full global batch,
+        identical on every process) matching the spec the compiled step
+        expects — data vars shard over the batch/SPMD axis, everything
+        else is replicated."""
+        P = jax.sharding.PartitionSpec
+        spec = P()
+        if var is not None:
+            if getattr(var, "sharding", None) is not None:
+                spec = P(*var.sharding)
+            elif var.is_data:
+                axis = (getattr(program, "_dist_spmd_axis", None)
+                        or self.batch_axis)
+                spec = P(axis)
+        sharding = jax.sharding.NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    def _fetch_numpy(self, v):
+        """np.asarray, gathering shards first when the fetch is not fully
+        addressable (multi-process mesh) — a collective, so every process
+        must fetch in lockstep (they run the same program loop)."""
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(
+                v, tiled=True))
+        return np.asarray(v)
 
     def close(self):
         self._cache.clear()

@@ -15,8 +15,11 @@ shard huge params) is delivered by collectives over ICI/DCN:
                                   the pattern; deepfm sparse_shard_axis)
   gen_nccl_id handshake (:213) -> jax.distributed.initialize rendezvous
                                   (parallel/env.py)
-  async pserver / DC-ASGD      -> not reproduced: sync collectives are
-                                  strictly faster on ICI; documented gap
+  async pserver / DC-ASGD      -> distributed/async_update.py: host-plane
+                                  AsyncParameterServer (stale-grad pushes,
+                                  DC-ASGD compensation); the DEVICE plane
+                                  stays sync — collectives over ICI beat
+                                  any RPC hop
 
 This class keeps the reference's API and performs the nccl2-mode program
 transformation for real: transpile(trainers=N) inserts a
@@ -68,9 +71,10 @@ class DistributeTranspiler:
         if not sync_mode:
             import warnings
             warnings.warn(
-                "async pserver mode has no TPU equivalent; proceeding with "
-                "synchronous collective data parallelism (strictly faster "
-                "over ICI)")
+                "async pserver mode is a host-plane capability here "
+                "(paddle_tpu.distributed.AsyncParameterServer); the device "
+                "data plane proceeds with synchronous collectives "
+                "(strictly faster over ICI)")
         if trainers > 1:
             self._insert_grad_allreduce(axis_name)
         self._transpiled = True

@@ -1,0 +1,50 @@
+"""Shared multi-process spawn harness for distributed tests (the
+reference's test_dist_base.py:227-291 free-port + subprocess machinery,
+extracted so every dist test uses one copy)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn_workers(script: str, world: int, tmp_path, timeout: int = 300):
+    """Run `tests/<script>` in `world` rank processes sharing a fresh
+    coordinator port; each rank writes JSON to its own out file.  Returns
+    the parsed results sorted by rank.  Asserts every worker exits 0."""
+    coordinator = f"127.0.0.1:{free_port()}"
+    procs, outs = [], []
+    for rank in range(world):
+        out = str(tmp_path / f"{script}.{rank}.json")
+        outs.append(out)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)      # one CPU device per process
+        env.pop("PYTHONPATH", None)     # axon plugin quirk: never set it
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", script),
+             coordinator, str(world), str(rank), out],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout.decode(errors="replace"))
+    for rc, log in zip((p.returncode for p in procs), logs):
+        assert rc == 0, f"{script} worker failed rc={rc}:\n{log[-3000:]}"
+    return sorted((json.load(open(o)) for o in outs),
+                  key=lambda r: r["rank"])

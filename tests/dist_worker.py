@@ -1,12 +1,22 @@
-"""Worker for the multi-host harness test (the reference's pattern:
-unittests/test_dist_base.py:212 spawns localhost trainer subprocesses).
+"""Multi-host trainer worker — the reference's dist_mnist contract
+(/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:212
+spawns localhost trainer subprocesses running dist_*.py models through the
+REAL framework stack; :502 checks per-step loss parity vs a local run).
 
 Run:  python tests/dist_worker.py <coordinator> <world> <rank> <out.json>
 
-Each process contributes its local CPU device to the global mesh via
-parallel/env.init_distributed_env (the gen_nccl_id-equivalent rendezvous),
-then trains a tiny DP linear model with an explicit grad psum and reports
-per-step losses + final weights.
+Each process:
+  * joins the jax.distributed world via parallel/env.init_distributed_env
+    (the gen_nccl_id-equivalent rendezvous, contributing 1 CPU device),
+  * builds the SAME seeded classifier Program via the layers DSL,
+  * applies DistributeTranspiler(trainers=world) — the nccl2-mode rewrite
+    inserting (c_allreduce_sum, 1/N scale) per gradient,
+  * trains it with Executor(mesh=<global 2-device mesh>) — shard_map
+    executes the collectives over the cross-process axis,
+  * reports per-step losses plus the final fc weight.
+
+tests/test_dist_env.py asserts loss parity against a single-process run
+of the identical program and bit-equality of weights across ranks.
 """
 import json
 import os
@@ -19,11 +29,48 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 import numpy as np
 
+SEED = 1234
+N, D_IN, HID, CLS = 16, 20, 32, 4
+
+
+def make_batch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, D_IN).astype("float32")
+    y = rng.randint(0, CLS, (N, 1)).astype("int64")
+    return x, y
+
+
+def build_program(pt, layers):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = SEED
+    startup.random_seed = SEED
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [D_IN])
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, size=HID, act="relu", name="fc1")
+        p = layers.fc(h, size=CLS, act="softmax", name="fc2")
+        loss = layers.mean(layers.cross_entropy(p, y))
+        pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    return main, startup, loss
+
+
+def train_steps(exe, prog, loss, steps=5):
+    x, y = make_batch()
+    losses = []
+    for _ in range(steps):
+        out, = exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss])
+        losses.append(float(np.mean(np.asarray(out))))
+    return losses
+
 
 def main():
     coordinator, world, rank, out_path = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
     import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
     from paddle_tpu.parallel import env as penv
 
     ok = penv.init_distributed_env(coordinator_address=coordinator,
@@ -33,44 +80,23 @@ def main():
     devices = jax.devices()
     assert len(devices) >= world, devices
 
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    main_p, startup, loss = build_program(pt, layers)
+    t = pt.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=rank, program=main_p, trainers=world)
+    prog = t.get_trainer_program()
 
     mesh = Mesh(np.array(devices[:world]), ("data",))
-    B_loc, D = 4, 3
-    rng = np.random.RandomState(0)
-    # deterministic GLOBAL batch; this process feeds its slice
-    x_all = rng.randn(world * B_loc, D).astype("float32")
-    y_all = (x_all @ np.array([[1.0], [-2.0], [0.5]], "float32")
-             ).astype("float32")
-    sl = slice(rank * B_loc, (rank + 1) * B_loc)
-    xs = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("data", None)), x_all[sl])
-    ys = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("data", None)), y_all[sl])
+    exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe.run(startup)
+    losses = train_steps(exe, prog, loss)
 
-    def device_step(w, x, y):
-        def loss_fn(w):
-            pred = x @ w
-            return jnp.sum((pred - y) ** 2) / (world * B_loc)
-
-        lp, g = jax.value_and_grad(loss_fn)(w)
-        g = lax.psum(g, "data")
-        return w - 0.1 * g, lax.psum(lp, "data")
-
-    step = jax.jit(jax.shard_map(
-        device_step, mesh=mesh,
-        in_specs=(P(), P("data", None), P("data", None)),
-        out_specs=(P(), P()), check_vma=False))
-
-    w = jnp.zeros((D, 1), jnp.float32)
-    losses = []
-    for _ in range(5):
-        w, loss = step(w, xs, ys)
-        losses.append(float(jax.block_until_ready(loss)))
+    wname = main_p.all_parameters()[0].name
+    w = exe.scope.find_var(wname)
+    assert w is not None, exe.scope.var_names()
+    w_host = np.asarray(w.addressable_data(0))   # replicated param
     result = {"rank": rank, "losses": losses,
-              "w": np.asarray(w).ravel().tolist()}
+              "w_sum": float(np.abs(w_host).sum()),
+              "w_head": w_host.ravel()[:8].tolist()}
     with open(out_path, "w") as f:
         json.dump(result, f)
     print("WORKER_OK", rank)
