@@ -1,19 +1,24 @@
-"""Benchmark: Transformer LM training throughput on one TPU chip, through
-the REAL framework stack — layers DSL -> Program -> whole-program-jit
-Executor — with the Pallas flash-attention + fused layer-norm kernels and
-bf16 mixed precision (FLAGS_amp_bf16) on.
+"""Benchmarks on one TPU chip through the REAL framework stack —
+layers DSL -> Program -> whole-program-jit Executor — with the Pallas
+flash-attention / fused LM-head kernels and bf16 AMP on.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Three workloads (BASELINE.json configs 2 & 3 + the flagship LM):
+  1. transformer_lm  (primary; longitudinal series vs BENCH_r02)
+  2. resnet50        (img/s/chip — BASELINE.json metric #1)
+  3. transformer_nmt (restores the r01 metric for comparison)
 
-Baseline: the reference publishes no V100/Fluid transformer numbers
-in-repo (BASELINE.md); the operative bar is BASELINE.json's north star
-">=0.9x V100 step-time".  We take 50k tokens/s as the V100
-mixed-precision transformer-base anchor (typical fp16 V100 throughput for
-d512/L6 training), so vs_baseline = tokens_per_sec / 50_000.
-
-r01 recorded 87,793 tok/s on a hand-written shard_map step OUTSIDE the
-framework; this bench runs the Program/Executor path itself (the judged
-surface) and also reports achieved TFLOP/s and MFU vs the v5e bf16 peak.
+Prints ONE JSON line: the primary workload's fields at the top level
+(driver contract) plus `workloads` carrying every row and
+`vs_baseline_basis` stating what each bar IS:
+  * resnet50: the reference's best in-repo published number — 81.69
+    img/s ResNet-50 train bs64 on 2x Xeon 6148 MKL-DNN
+    (BASELINE.md / benchmark/IntelOptimizedPaddle.md:45).  It publishes
+    no GPU-Fluid ResNet number.
+  * transformers: the reference publishes NO transformer numbers at all
+    (BASELINE.md); the bar is BASELINE.json's ">=0.9x V100 step-time"
+    north star, anchored at an ASSUMED 50k tokens/s for fp16
+    transformer-base training on one V100 (typical d512/L6 figure;
+    assumption, not a measurement).
 """
 from __future__ import annotations
 
@@ -23,21 +28,59 @@ import time
 import jax
 import numpy as np
 
-V100_TOKENS_PER_SEC = 50_000.0
+V100_TOKENS_PER_SEC = 50_000.0          # documented assumption, see above
+REF_RESNET50_IMGS_PER_SEC = 81.69       # IntelOptimizedPaddle.md:45
 V5E_BF16_PEAK = 197e12
 
+_BASIS = {
+    "transformer_lm_train_tokens_per_sec_per_chip":
+        "assumed 50k tok/s V100 fp16 transformer-base anchor "
+        "(BASELINE.json north star; reference publishes no number)",
+    "transformer_base_train_tokens_per_sec_per_chip":
+        "assumed 50k tok/s V100 fp16 transformer-base anchor "
+        "(BASELINE.json north star; reference publishes no number)",
+    "resnet50_train_imgs_per_sec_per_chip":
+        "reference's published ResNet-50 train bs64: 81.69 img/s, "
+        "2x Xeon 6148 MKL-DNN (benchmark/IntelOptimizedPaddle.md:45)",
+}
 
-def main():
+
+def _time_steps(exe, prog, feed, fetch, on_tpu):
+    iters = 20 if on_tpu else 2
+    reps = 3 if on_tpu else 1
+    dt = float("inf")
+    out = None
+    for _ in range(reps):             # best-of-reps: tunnel jitter guard
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out, = exe.run(prog, feed=feed, fetch_list=[fetch],
+                           return_numpy=False)  # pipelined
+        jax.block_until_ready(out)
+        dt = min(dt, (time.perf_counter() - t0) / iters)
+    return dt, float(np.asarray(out).ravel()[0])
+
+
+def _fresh(on_tpu):
     import paddle_tpu as pt
+    pt.reset_default_programs()
+    exe = pt.Executor(pt.TPUPlace(0) if on_tpu else pt.CPUPlace())
+    return pt, exe
+
+
+def _stage(feed, on_tpu):
+    """Stage the (constant) batch on device once: a real input pipeline
+    overlaps transfers, so the steady step pays no fresh h2d copy."""
+    if not on_tpu:
+        return feed
+    return {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+
+
+def bench_lm(on_tpu):
     from paddle_tpu import models
-    from paddle_tpu.core import flags
-
-    on_tpu = jax.devices()[0].platform == "tpu"
-    flags.set_flag("amp_bf16", True)
-
+    pt, exe = _fresh(on_tpu)
     D, F, L, V, T = 512, 2048, 6, 32000, 512
     batch = 32 if on_tpu else 2
-    if not on_tpu:                       # keep the CPU dev loop tractable
+    if not on_tpu:
         V, L = 2000, 2
     cfg = models.transformer.TransformerConfig(
         src_vocab_size=V, tgt_vocab_size=V, max_length=T,
@@ -45,49 +88,114 @@ def main():
     feeds, avg_cost, _ = models.transformer.build_lm_net(
         cfg, seq_len=T, fused_attention=True, fused_head=on_tpu)
     pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
-    exe = pt.Executor(pt.TPUPlace(0) if on_tpu else pt.CPUPlace())
     exe.run(pt.default_startup_program())
-    feed = models.transformer.make_fake_lm_batch(cfg, batch, T)
-    main_prog = pt.default_main_program()
-
-    if on_tpu:
-        # stage the (constant) batch on device once: a real input pipeline
-        # overlaps transfers with compute, so the steady-state step should
-        # not pay a fresh host->device copy per iteration
-        feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
-
-    # warmup: initial compile + one layout-settling recompile
+    feed = _stage(models.transformer.make_fake_lm_batch(cfg, batch, T),
+                  on_tpu)
+    prog = pt.default_main_program()
     for _ in range(3):
-        out, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
-
-    iters = 20 if on_tpu else 3
-    reps = 3 if on_tpu else 1
-    dt = float("inf")
-    for _ in range(reps):             # best-of-reps: tunnel jitter guard
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                           return_numpy=False)  # pipelined: no per-step sync
-        jax.block_until_ready(out)
-        dt = min(dt, (time.perf_counter() - t0) / iters)
-
-    toks_per_sec = batch * T / dt
+        exe.run(prog, feed=feed, fetch_list=[avg_cost])
+    dt, loss = _time_steps(exe, prog, feed, avg_cost, on_tpu)
+    toks = batch * T / dt
     # train FLOPs/token = 3x fwd: qkvo+ffn matmuls, CAUSAL attention
     # (~T/2 keys per query -> 2*T*D per layer), logits
-    flops_tok = 3 * (L * (8 * D * D + 4 * D * F) + L * 2 * T * D + 2 * D * V)
-    tflops = toks_per_sec * flops_tok / 1e12
-    print(json.dumps({
+    flops_tok = 3 * (L * (8 * D * D + 4 * D * F) + L * 2 * T * D
+                     + 2 * D * V)
+    tflops = toks * flops_tok / 1e12
+    return {
         "metric": "transformer_lm_train_tokens_per_sec_per_chip",
-        "value": round(toks_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(toks_per_sec / V100_TOKENS_PER_SEC, 3),
+        "value": round(toks, 1), "unit": "tokens/s",
+        "vs_baseline": round(toks / V100_TOKENS_PER_SEC, 3),
         "tflops": round(tflops, 1),
         "mfu": round(tflops * 1e12 / V5E_BF16_PEAK, 3) if on_tpu else None,
         "config": (f"d{D} L{L} T{T} B{batch} V{V} flash-attn + "
-                   + ("chunked remat LM head + " if on_tpu else "")
+                   + ("pallas streamed LM head + " if on_tpu else "")
                    + "amp, executor path"),
-        "loss": round(float(np.asarray(out).ravel()[0]), 4),
-    }))
+        "loss": round(loss, 4),
+    }
+
+
+def bench_resnet50(on_tpu):
+    from paddle_tpu import models
+    pt, exe = _fresh(on_tpu)
+    batch = 64 if on_tpu else 2
+    shape = (3, 224, 224) if on_tpu else (3, 32, 32)
+    depth = 50
+    feeds, avg_loss, acc, _ = models.resnet.build_train_net(
+        class_dim=1000, img_shape=shape, depth=depth)
+    pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+        avg_loss)
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _stage(
+        {"img": rng.rand(batch, *shape).astype("float32"),
+         "label": rng.randint(0, 1000, (batch, 1)).astype("int64")},
+        on_tpu)
+    prog = pt.default_main_program()
+    for _ in range(3):
+        exe.run(prog, feed=feed, fetch_list=[avg_loss])
+    dt, loss = _time_steps(exe, prog, feed, avg_loss, on_tpu)
+    imgs = batch / dt
+    return {
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs, 1), "unit": "img/s",
+        "vs_baseline": round(imgs / REF_RESNET50_IMGS_PER_SEC, 3),
+        "config": f"ResNet-{depth} {shape} bs{batch} momentum + amp, "
+                  f"executor path",
+        "loss": round(loss, 4),
+    }
+
+
+def bench_nmt(on_tpu):
+    from paddle_tpu import models
+    pt, exe = _fresh(on_tpu)
+    V = 8000 if on_tpu else 800
+    L = 6 if on_tpu else 2
+    batch = 64 if on_tpu else 2
+    S = 64
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=V, tgt_vocab_size=V, n_layer=L, n_head=8,
+        d_model=512, d_inner=2048, dropout=0.0)
+    feeds, avg_cost, _ = models.transformer.build_train_net(
+        cfg, src_len=S, tgt_len=S)
+    pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    exe.run(pt.default_startup_program())
+    feed = _stage(models.transformer.make_fake_batch(cfg, batch, S, S),
+                  on_tpu)
+    prog = pt.default_main_program()
+    for _ in range(3):
+        exe.run(prog, feed=feed, fetch_list=[avg_cost])
+    dt, loss = _time_steps(exe, prog, feed, avg_cost, on_tpu)
+    toks = batch * 2 * S / dt           # src+tgt tokens, r01 convention
+    return {
+        "metric": "transformer_base_train_tokens_per_sec_per_chip",
+        "value": round(toks, 1), "unit": "tokens/s",
+        "vs_baseline": round(toks / V100_TOKENS_PER_SEC, 3),
+        "config": f"NMT enc-dec d512 L{L} src/tgt {S} B{batch} V{V} "
+                  f"amp, executor path",
+        "loss": round(loss, 4),
+    }
+
+
+def main():
+    from paddle_tpu.core import flags
+    on_tpu = jax.devices()[0].platform == "tpu"
+    flags.set_flag("amp_bf16", True)
+
+    rows, errors = [], {}
+    for fn in (bench_lm, bench_resnet50, bench_nmt):
+        try:
+            rows.append(fn(on_tpu))
+        except Exception as e:          # a broken workload must not hide
+            errors[fn.__name__] = repr(e)[:300]
+
+    out = dict(rows[0]) if rows else {"metric": "none", "value": 0.0,
+                                      "unit": "", "vs_baseline": 0.0}
+    out["workloads"] = rows
+    out["vs_baseline_basis"] = {r["metric"]: _BASIS[r["metric"]]
+                                for r in rows}
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
