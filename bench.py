@@ -150,7 +150,7 @@ def bench_nmt(on_tpu):
     pt, exe = _fresh(on_tpu)
     V = 8000 if on_tpu else 800
     L = 6 if on_tpu else 2
-    batch = 64 if on_tpu else 2
+    batch = 256 if on_tpu else 2    # MXU-filling batch at this short T
     S = 64
     cfg = models.transformer.TransformerConfig(
         src_vocab_size=V, tgt_vocab_size=V, n_layer=L, n_head=8,
