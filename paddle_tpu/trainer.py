@@ -4,10 +4,12 @@ Capability parity with /root/reference/python/paddle/fluid/contrib/trainer.py
 (Trainer:169, event classes :40-99, CheckpointConfig:100, save_checkpoint:663,
 load_checkpoint:763): same event-driven train loop (BeginEpoch/EndEpoch/
 BeginStep/EndStep), checkpoint cadence + max_num_checkpoints rotation, and
-resume-on-construct.  Distributed roles: instead of parsing
-PADDLE_TRAINING_ROLE to self-transpile into pserver/trainer programs
-(_dist_transpile_if_necessary), the TPU-native trainer passes a mesh to the
-Executor — data parallelism is a sharding, not a program rewrite.
+resume-on-construct.  Distributed roles keep the reference's env contract
+(_dist_transpile_if_necessary): PADDLE_TRAINING_ROLE=TRAINER with
+PADDLE_TRAINERS=N self-applies the DistributeTranspiler rewrite
+(c_allreduce per grad) over a data mesh; PSERVER raises with migration
+guidance — gradients aggregate via collectives, not parameter servers.
+An explicit mesh= argument still works without any env vars.
 """
 from __future__ import annotations
 
@@ -91,6 +93,7 @@ class Trainer:
             opt.minimize(self.loss, accumulate_steps=accumulate_steps)
 
         self.test_program = self.train_program.clone(for_test=True)
+        mesh = self._dist_transpile_if_necessary(mesh)
         self.exe = Executor(place, scope=self.scope, mesh=mesh)
         self.exe.run(self.startup_program)
 
@@ -101,6 +104,46 @@ class Trainer:
             serial = self._latest_serial()
             if serial >= 0:
                 self._load_checkpoint(serial)
+
+    def _dist_transpile_if_necessary(self, mesh):
+        """ref contrib/trainer.py _dist_transpile_if_necessary: the same
+        PADDLE_* env contract, mapped to the collective plane —
+        PADDLE_TRAINING_ROLE=TRAINER + PADDLE_TRAINERS=N applies the
+        DistributeTranspiler rewrite (c_allreduce per grad) and runs over
+        a data mesh; PSERVER has no TPU role (guidance error)."""
+        role = os.environ.get("PADDLE_TRAINING_ROLE")
+        if not role:
+            return mesh
+        if role == "PSERVER":
+            raise RuntimeError(
+                "PADDLE_TRAINING_ROLE=PSERVER: there are no parameter "
+                "servers on TPU — run every process as TRAINER; gradients "
+                "aggregate via collectives over the mesh (see "
+                "transpiler/distribute_transpiler.py)")
+        if role != "TRAINER":
+            raise RuntimeError(
+                f"unknown PADDLE_TRAINING_ROLE {role!r}: expected "
+                f"TRAINER or PSERVER (ref contrib/trainer.py "
+                f"_dist_transpile_if_necessary)")
+        trainers = int(os.environ.get("PADDLE_TRAINERS", "1"))
+        if trainers <= 1:
+            return mesh
+        trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        from .transpiler.distribute_transpiler import DistributeTranspiler
+        t = DistributeTranspiler()
+        t.transpile(trainer_id=trainer_id, program=self.train_program,
+                    trainers=trainers)
+        if mesh is None:
+            import jax
+            devices = jax.devices()
+            check_arg(
+                len(devices) >= trainers,
+                f"PADDLE_TRAINERS={trainers} needs >= that many devices "
+                f"(have {len(devices)}); pass mesh= explicitly for "
+                f"multi-host layouts")
+            from jax.sharding import Mesh
+            mesh = Mesh(np.array(devices[:trainers]), ("data",))
+        return mesh
 
     # -- checkpoint plumbing (ref save_checkpoint:663, rotation) ----------
     # Durable format: incubate/checkpoint.py — per-process shard files,

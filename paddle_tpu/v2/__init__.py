@@ -1,0 +1,48 @@
+"""paddle_tpu.v2 — the v2-era API surface (ref python/paddle/v2/) as a
+veneer over the modern Fluid-plane stack.
+
+The reference keeps two generations side by side: the v2 API
+(layer graph -> config proto -> legacy C++ trainer, ~25k LoC) and Fluid.
+Here the v2 surface builds the SAME Program/Executor path as everything
+else (config_base.build_topology), so v2 user code runs on TPU with zero
+legacy machinery — capability parity for SURVEY §2.2 row "v2 API":
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.data(name='y', type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1, act=paddle.activation.Linear())
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=paddle.optimizer.Momentum())
+    trainer.train(reader=..., num_passes=10, event_handler=...)
+    out = paddle.infer(output_layer=pred, parameters=params, input=[...])
+
+Unsupported v2 corners raise with guidance rather than silently
+diverging (e.g. recurrent_group -> use the Fluid-plane layers.rnn).
+"""
+from __future__ import annotations
+
+from .. import dataset, reader                       # shared data plane
+from . import (activation, attr, config_base, data_type, event, layer,
+               optimizer, parameters, pooling, trainer)
+from .inference import Inference, infer
+from .minibatch import batch
+
+__all__ = ["init", "infer", "batch", "layer", "activation", "optimizer",
+           "parameters", "trainer", "event", "data_type", "attr",
+           "pooling", "dataset", "reader", "Inference"]
+
+_initialized = False
+
+
+def init(use_gpu=False, trainer_count=1, seed=None, **_):
+    """ref paddle.v2.init: process bootstrap.  Device selection is
+    automatic here (TPU when present); trainer_count maps to the mesh
+    plane, not threads."""
+    global _initialized
+    _initialized = True
+    if seed is not None:
+        from paddle_tpu.core import flags
+        flags.set_flag("rng_seed", int(seed))

@@ -1,0 +1,35 @@
+"""v2 activation objects (ref python/paddle/v2/activation.py) — each
+maps to the Fluid-plane act string consumed by layers.fc etc."""
+
+
+class BaseActivation:
+    fluid_name: str = None
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class Linear(BaseActivation):
+    fluid_name = None
+
+
+class Relu(BaseActivation):
+    fluid_name = "relu"
+
+
+class Sigmoid(BaseActivation):
+    fluid_name = "sigmoid"
+
+
+class Tanh(BaseActivation):
+    fluid_name = "tanh"
+
+
+class Softmax(BaseActivation):
+    fluid_name = "softmax"
+
+
+def act_name(act):
+    if act is None:
+        return None
+    return act.fluid_name

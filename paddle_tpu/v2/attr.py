@@ -1,0 +1,38 @@
+"""ref python/paddle/v2/attr.py — parameter attribute shim mapping to
+the Fluid-plane ParamAttr."""
+from __future__ import annotations
+
+__all__ = ["Param", "ParamAttr"]
+
+
+class Param:
+    def __init__(self, name=None, initial_std=None, initial_mean=None,
+                 is_static=False, l2_rate=None, learning_rate=None, **_):
+        self.name = name
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.is_static = is_static
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+
+    def to_fluid(self):
+        import paddle_tpu as pt
+        from paddle_tpu.framework.initializer import NormalInitializer
+        from paddle_tpu.regularizer import L2DecayRegularizer
+        kw = {}
+        if self.name:
+            kw["name"] = self.name
+        if self.initial_std is not None:
+            kw["initializer"] = NormalInitializer(
+                loc=self.initial_mean or 0.0, scale=self.initial_std)
+        if self.is_static:
+            kw["trainable"] = False
+        if self.l2_rate is not None:
+            kw["regularizer"] = L2DecayRegularizer(
+                regularization_coeff=float(self.l2_rate))
+        if self.learning_rate is not None:
+            kw["learning_rate"] = self.learning_rate
+        return pt.ParamAttr(**kw)
+
+
+ParamAttr = Param

@@ -1,0 +1,48 @@
+"""v2 lazy layer graph (ref python/paddle/v2/config_base.py).
+
+The reference's v2 API builds a config-proto topology parsed by the
+legacy C++ trainer (python/paddle/trainer/config_parser.py).  Here a
+v2 `Layer` is a lazy node; `build_topology` walks the graph once and
+emits a Fluid-plane `Program` through the paddle_tpu layers DSL — the
+v2 surface becomes a thin, fully-supported veneer over the modern path
+(closing SURVEY §2.2 row "v2 API (legacy)" by capability, not by
+porting the 25k-LoC config-proto machinery)."""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+
+class Layer:
+    """A lazy node: `_build(ctx)` emits program vars on demand; ctx
+    memoizes by node identity so diamonds build once."""
+
+    def __init__(self, build: Callable, parents: Sequence["Layer"],
+                 name: str = None):
+        self._build = build
+        self.parents = list(parents)
+        self.name = name
+
+    def to_var(self, ctx: dict):
+        key = id(self)
+        if key not in ctx:
+            ctx[key] = self._build(ctx)
+        return ctx[key]
+
+
+def build_topology(outputs: Sequence[Layer]):
+    """Emit a (main, startup) Program pair for the given output layers.
+
+    Returns (main, startup, data_layers, out_vars); data_layers is the
+    ordered list of `layer.data` nodes encountered (feed order)."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import unique_name
+
+    main, startup = pt.Program(), pt.Program()
+    ctx: dict = {"__data__": []}
+    # fresh name namespace: parameters.create, trainer.SGD and infer each
+    # rebuild the topology in their own Program and must agree on the
+    # auto-generated parameter names
+    with unique_name.guard():
+        with pt.program_guard(main, startup):
+            out_vars = [o.to_var(ctx) for o in outputs]
+    return main, startup, list(ctx["__data__"]), out_vars

@@ -1,0 +1,75 @@
+"""v2 input type descriptors (ref python/paddle/v2/data_type.py /
+trainer/PyDataProvider2 types).  Each type knows its Fluid-plane shape,
+dtype, and how to batch a column of python values into an ndarray."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class InputType:
+    def __init__(self, shape, dtype, dim=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.dim = dim
+
+    def batch(self, column):
+        raise NotImplementedError
+
+
+class DenseVector(InputType):
+    def __init__(self, dim):
+        super().__init__([dim], "float32", dim)
+
+    def batch(self, column):
+        return np.asarray(column, dtype="float32").reshape(
+            len(column), self.dim)
+
+
+class IntegerValue(InputType):
+    """A single class id in [0, dim)."""
+
+    def __init__(self, dim):
+        super().__init__([1], "int64", dim)
+
+    def batch(self, column):
+        return np.asarray(column, dtype="int64").reshape(len(column), 1)
+
+
+class IntegerValueSequence(InputType):
+    """Variable-length id sequence; batches to padded [B, T] plus an
+    implicit mask column `<name>_mask` (the framework's dense+mask
+    replacement for LoD — SURVEY §7 hard part (a))."""
+
+    def __init__(self, dim):
+        super().__init__([-1], "int64", dim)
+
+    def batch(self, column):
+        # bucket T to the next power of two (min 8): per-batch exact max
+        # lengths would recompile the jitted program for nearly every
+        # batch on real data
+        T = max(1, max(len(s) for s in column))
+        Tb = 8
+        while Tb < T:
+            Tb *= 2
+        out = np.zeros((len(column), Tb), dtype="int64")
+        mask = np.zeros((len(column), Tb), dtype="float32")
+        for i, s in enumerate(column):
+            out[i, :len(s)] = s
+            mask[i, :len(s)] = 1.0
+        return out, mask
+
+
+def dense_vector(dim):
+    return DenseVector(dim)
+
+
+def integer_value(dim):
+    return IntegerValue(dim)
+
+
+def integer_value_sequence(dim):
+    return IntegerValueSequence(dim)
+
+
+# aliases the reference exposes
+dense_array = dense_vector

@@ -1,0 +1,42 @@
+"""v2 inference (ref python/paddle/v2/inference.py): paddle.infer(
+output_layer=..., parameters=..., input=[...])."""
+from __future__ import annotations
+
+import numpy as np
+
+from .config_base import build_topology
+from .trainer import _feed_from_batch
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters):
+        import paddle_tpu as pt
+
+        outputs = (output_layer if isinstance(output_layer, (list, tuple))
+                   else [output_layer])
+        main, _, data_layers, out_vars = build_topology(list(outputs))
+        self._prog = main.clone(for_test=True)
+        self._data_layers = data_layers
+        self._out_vars = out_vars
+        self._exe = pt.Executor(scope=parameters._scope)
+
+    def infer(self, input, feeding=None, field="value"):
+        if field not in ("value", "id"):
+            raise NotImplementedError(
+                f"v2 infer field={field!r}: only 'value' (raw layer "
+                f"output) and 'id' (argmax over the last axis) are "
+                f"supported")
+        feed = _feed_from_batch(input, self._data_layers, feeding)
+        outs = self._exe.run(self._prog, feed=feed,
+                             fetch_list=self._out_vars)
+        outs = [np.asarray(o) for o in outs]
+        if field == "id":
+            outs = [o.argmax(-1) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(input, feeding,
+                                                     field)

@@ -1,0 +1,168 @@
+"""v2 layer functions (ref python/paddle/v2/layer.py + the
+trainer_config_helpers layer DSL) as lazy nodes over the Fluid-plane
+layers (paddle_tpu/layers).  The supported subset covers the v2
+quick-start tier: regression, classification, embeddings, conv nets,
+sequence models via the dense+mask plane."""
+from __future__ import annotations
+
+from .activation import act_name
+from .config_base import Layer
+
+__all__ = ["data", "fc", "embedding", "concat", "dropout",
+           "classification_cost", "square_error_cost", "cross_entropy_cost",
+           "img_conv", "img_pool", "batch_norm", "max_id",
+           "sequence_pool"]
+
+
+def _fluid_layers():
+    from paddle_tpu import layers as fl
+    return fl
+
+
+def data(name, type, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        if type.__class__.__name__ == "IntegerValueSequence":
+            # dense+mask plane: the sequence feeds as [B, T] + mask
+            v = fl.data(name, [-1], dtype="int64")
+            m = fl.data(name + "_mask", [-1], dtype="float32")
+            ctx[("mask", name)] = m
+        else:
+            v = fl.data(name, type.shape, dtype=type.dtype)
+        ctx["__data__"].append(node)
+        return v
+
+    node = Layer(build, [], name=name)
+    node.type = type
+    return node
+
+
+def _mask_of(ctx, lay):
+    """The mask var of a sequence data layer, if any."""
+    return ctx.get(("mask", lay.name))
+
+
+def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
+       **_):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx):
+        fl = _fluid_layers()
+        vs = [i.to_var(ctx) for i in inputs]
+        return fl.fc(vs if len(vs) > 1 else vs[0], size=size,
+                     act=act_name(act), name=name,
+                     param_attr=getattr(param_attr, "to_fluid",
+                                        lambda: param_attr)(),
+                     bias_attr=bias_attr)
+
+    return Layer(build, inputs, name=name)
+
+
+def embedding(input, size, param_attr=None, name=None, **_):
+    """size = embedding dim; vocab comes from the input's integer type."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        vocab = input.type.dim
+        return fl.embedding(v, size=[vocab, size],
+                            param_attr=getattr(param_attr, "to_fluid",
+                                               lambda: param_attr)(),
+                            name=name)
+
+    return Layer(build, [input], name=name)
+
+
+def concat(input, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.concat([i.to_var(ctx) for i in input], axis=1)
+
+    return Layer(build, input, name=name)
+
+
+def dropout(input, dropout_rate, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.dropout(input.to_var(ctx), dropout_prob=dropout_rate)
+
+    return Layer(build, [input], name=name)
+
+
+def img_conv(input, filter_size, num_filters, num_channel=None, act=None,
+             padding=0, stride=1, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.conv2d(input.to_var(ctx), num_filters=num_filters,
+                         filter_size=filter_size, padding=padding,
+                         stride=stride, act=act_name(act))
+
+    return Layer(build, [input], name=name)
+
+
+def img_pool(input, pool_size, stride=None, pool_type=None, name=None,
+             **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        ptype = "max" if pool_type is None else pool_type.name
+        return fl.pool2d(input.to_var(ctx), pool_size=pool_size,
+                         pool_stride=stride or pool_size,
+                         pool_type=ptype)
+
+    return Layer(build, [input], name=name)
+
+
+def batch_norm(input, act=None, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.batch_norm(input.to_var(ctx), act=act_name(act))
+
+    return Layer(build, [input], name=name)
+
+
+def sequence_pool(input, pool_type=None, name=None, **_):
+    """Pool a [B, T, D] sequence (from embedding over an
+    integer_value_sequence) honouring its pad mask."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        src = input
+        while src.parents and getattr(src, "type", None) is None:
+            src = src.parents[0]
+        mask = _mask_of(ctx, src)
+        ptype = "sum" if pool_type is None else pool_type.name
+        return fl.sequence_pool(v, pool_type=ptype, mask=mask)
+
+    return Layer(build, [input], name=name)
+
+
+def max_id(input, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.argmax(input.to_var(ctx), axis=-1)
+
+    return Layer(build, [input], name=name)
+
+
+def classification_cost(input, label, name=None, **_):
+    """cross-entropy against a softmax output (ref v2 layer.py
+    classification_cost); reduces to the scalar mean cost the trainer
+    optimizes."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.mean(fl.cross_entropy(input.to_var(ctx),
+                                        label.to_var(ctx)))
+
+    return Layer(build, [input, label], name=name)
+
+
+def square_error_cost(input, label, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.mean(fl.square_error_cost(input.to_var(ctx),
+                                            label.to_var(ctx)))
+
+    return Layer(build, [input, label], name=name)
+
+
+def cross_entropy_cost(input, label, name=None, **_):
+    return classification_cost(input, label, name=name)

@@ -1,0 +1,7 @@
+"""ref python/paddle/v2/minibatch.py — group a sample reader into
+batches.  One implementation: the shared reader-decorator plane."""
+from __future__ import annotations
+
+from ..reader.decorator import batch
+
+__all__ = ["batch"]

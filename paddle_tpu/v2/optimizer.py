@@ -1,0 +1,96 @@
+"""v2 optimizer wrappers (ref python/paddle/v2/optimizer.py) over the
+Fluid-plane optimizer family."""
+from __future__ import annotations
+
+__all__ = ["Momentum", "Adam", "AdaGrad", "RMSProp", "SGD"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=1e-3, regularization=None,
+                 model_average=None, gradient_clipping_threshold=None,
+                 **_):
+        self.learning_rate = learning_rate
+        self.regularization = regularization
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        self.model_average = model_average
+
+    def _extra(self):
+        kw = {}
+        if self.regularization is not None:
+            kw["regularization"] = self.regularization
+        return kw
+
+    def _apply_side_config(self):
+        """Clipping/averaging the v2 surface carries outside the update
+        rule.  Called by to_fluid() inside the trainer's program guard,
+        so the default program is the one being built."""
+        if self.gradient_clipping_threshold is not None:
+            from paddle_tpu import clip
+            clip.set_gradient_clip(clip.GradientClipByGlobalNorm(
+                float(self.gradient_clipping_threshold)))
+        if self.model_average is not None:
+            raise NotImplementedError(
+                "v2 model_average: use the Fluid-plane "
+                "paddle_tpu.optimizer.ModelAverage directly (it wraps "
+                "the same average_accumulates capability)")
+
+    def to_fluid(self):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    def to_fluid(self):
+        import paddle_tpu as pt
+        self._apply_side_config()
+        return pt.optimizer.SGD(learning_rate=self.learning_rate,
+                                **self._extra())
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.0, sparse=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def to_fluid(self):
+        import paddle_tpu as pt
+        self._apply_side_config()
+        if self.momentum == 0.0:
+            return pt.optimizer.SGD(learning_rate=self.learning_rate,
+                                    **self._extra())
+        return pt.optimizer.Momentum(learning_rate=self.learning_rate,
+                                     momentum=self.momentum,
+                                     **self._extra())
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_fluid(self):
+        import paddle_tpu as pt
+        self._apply_side_config()
+        return pt.optimizer.Adam(learning_rate=self.learning_rate,
+                                 beta1=self.beta1, beta2=self.beta2,
+                                 epsilon=self.epsilon, **self._extra())
+
+
+class AdaGrad(Optimizer):
+    def to_fluid(self):
+        import paddle_tpu as pt
+        self._apply_side_config()
+        return pt.optimizer.Adagrad(learning_rate=self.learning_rate,
+                                    **self._extra())
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        import paddle_tpu as pt
+        self._apply_side_config()
+        return pt.optimizer.RMSProp(learning_rate=self.learning_rate,
+                                    rho=self.rho, epsilon=self.epsilon,
+                                    **self._extra())

@@ -1,0 +1,92 @@
+"""v2 Parameters (ref python/paddle/v2/parameters.py): a name-addressed
+view over the trained weights, with tar serialization kept API-shaped
+(numpy .npy members instead of the legacy binary format)."""
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from .config_base import build_topology
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters:
+    def __init__(self, scope, names):
+        self._scope = scope
+        self._names = list(names)
+
+    def names(self):
+        return list(self._names)
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, name):
+        return name in self._names
+
+    def get(self, name):
+        v = self._scope.find_var(name)
+        if v is None:
+            raise KeyError(name)
+        return np.asarray(v)
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        import jax
+        cur = self._scope.find_var(name)
+        arr = np.asarray(value)
+        if cur is not None:
+            arr = arr.reshape(np.asarray(cur).shape).astype(
+                np.asarray(cur).dtype)
+        self._scope.set_var(name, jax.device_put(arr))
+        if name not in self._names:
+            self._names.append(name)
+
+    __setitem__ = set
+
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self._names:
+                buf = io.BytesIO()
+                np.save(buf, self.get(name))
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name + ".npy")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    @classmethod
+    def from_tar(cls, f, scope=None):
+        from paddle_tpu import Scope
+        scope = scope or Scope()
+        names = []
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                name = member.name[:-len(".npy")]
+                arr = np.load(io.BytesIO(tar.extractfile(member).read()))
+                names.append(name)
+                import jax
+                scope.set_var(name, jax.device_put(arr))
+        return cls(scope, names)
+
+    def init_from_tar(self, f):
+        other = Parameters.from_tar(f)
+        for name in other.names():
+            if name in self._names:
+                self.set(name, other.get(name))
+
+
+def create(*outputs):
+    """Trace the topology, run its startup program once into a fresh
+    scope, return the Parameters view (ref parameters.create)."""
+    import paddle_tpu as pt
+
+    main, startup, _, _ = build_topology(list(outputs))
+    scope = pt.Scope()
+    exe = pt.Executor(scope=scope)
+    exe.run(startup)
+    names = [p.name for p in main.all_parameters()]
+    return Parameters(scope, names)

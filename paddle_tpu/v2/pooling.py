@@ -1,0 +1,17 @@
+"""ref python/paddle/v2/pooling.py — pooling type objects."""
+
+
+class BasePoolingType:
+    name = None
+
+
+class Max(BasePoolingType):
+    name = "max"
+
+
+class Avg(BasePoolingType):
+    name = "average"
+
+
+class Sum(BasePoolingType):
+    name = "sum"

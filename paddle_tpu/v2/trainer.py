@@ -1,0 +1,79 @@
+"""v2 SGD trainer (ref python/paddle/v2/trainer.py:37): combines a cost
+topology, Parameters and an update equation into the reader-driven
+train/test event loop — compiled through the Fluid-plane Executor."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import event as v2_event
+from .config_base import build_topology
+
+__all__ = ["SGD"]
+
+
+def _feed_from_batch(batch, data_layers, feeding):
+    """v2 readers yield per-sample tuples; `feeding` maps data-layer
+    name -> tuple index (default: declaration order)."""
+    if feeding is None:
+        feeding = {lay.name: i for i, lay in enumerate(data_layers)}
+    feed = {}
+    for lay in data_layers:
+        col = [sample[feeding[lay.name]] for sample in batch]
+        arrs = lay.type.batch(col)
+        if isinstance(arrs, tuple):          # sequence: (ids, mask)
+            feed[lay.name], feed[lay.name + "_mask"] = arrs
+        else:
+            feed[lay.name] = arrs
+    return feed
+
+
+class SGD:
+    """trainer = SGD(cost, parameters, update_equation); trainer.train(
+    reader=batch_reader, num_passes=N, event_handler=..., feeding=...)"""
+
+    def __init__(self, cost, parameters, update_equation,
+                 extra_layers=None, is_local=True, **_):
+        import paddle_tpu as pt
+
+        self._params = parameters
+        outputs = [cost] + list(extra_layers or [])
+        main, startup, data_layers, out_vars = build_topology(outputs)
+        self._cost_var = out_vars[0]
+        with pt.program_guard(main, startup):
+            update_equation.to_fluid().minimize(self._cost_var)
+        self._main, self._data_layers = main, data_layers
+        self._test_prog = main.clone(for_test=True)
+        # params are already initialized in the Parameters scope; run the
+        # trainer startup (optimizer accumulators, LR vars...) into a
+        # staging scope and merge only what's missing
+        stage = pt.Scope()
+        pt.Executor(scope=stage).run(startup)
+        scope = parameters._scope
+        for name in stage.var_names():
+            if not scope.has_var(name):
+                scope.set_var(name, stage.find_var(name))
+        self._exe = pt.Executor(scope=scope)
+
+    def train(self, reader, num_passes=1, event_handler=None,
+              feeding=None):
+        handler = event_handler or (lambda e: None)
+        for pass_id in range(num_passes):
+            handler(v2_event.BeginPass(pass_id))
+            for batch_id, batch in enumerate(reader()):
+                handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = _feed_from_batch(batch, self._data_layers, feeding)
+                cost, = self._exe.run(self._main, feed=feed,
+                                      fetch_list=[self._cost_var])
+                handler(v2_event.EndIteration(
+                    pass_id, batch_id, float(np.asarray(cost).ravel()[0])))
+            handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader, feeding=None):
+        costs, n = [], 0
+        for batch in reader():
+            feed = _feed_from_batch(batch, self._data_layers, feeding)
+            cost, = self._exe.run(self._test_prog, feed=feed,
+                                  fetch_list=[self._cost_var])
+            costs.append(float(np.asarray(cost).ravel()[0]) * len(batch))
+            n += len(batch)
+        return v2_event.TestResult(cost=sum(costs) / max(1, n))

@@ -102,3 +102,42 @@ def test_model_parallel_param_sharding(mesh8):
     w_val = scope.find_var("tp_w")
     # output-dim sharded over the 8 devices
     assert not w_val.sharding.is_fully_replicated
+
+
+def test_bert_pretrain_data_parallel_parity():
+    """BASELINE config 5: BERT pretraining under data-parallel
+    ParallelExecutor on the 8-device mesh, loss parity vs single device
+    (the reference's parallel_executor_test_base contract on the
+    dist_transformer-class model)."""
+    from paddle_tpu import models
+
+    def build():
+        pt.reset_default_programs()
+        pt.default_startup_program().random_seed = 7
+        pt.default_main_program().random_seed = 7
+        cfg = models.bert.BertConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_position=32)
+        feeds, total_loss, _ = models.bert.build_pretrain_net(
+            cfg, seq_len=16)
+        pt.optimizer.Adam(learning_rate=1e-3).minimize(total_loss)
+        feed = models.bert.make_fake_batch(cfg, 8, 16, max_preds=4, seed=0)
+        return total_loss, feed
+
+    loss, feed = build()
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe.run(pt.default_startup_program())
+    ref = [float(exe.run(pt.default_main_program(), feed=feed,
+                         fetch_list=[loss])[0]) for _ in range(4)]
+
+    loss2, feed2 = build()
+    scope = pt.Scope()
+    exe2 = pt.Executor(pt.CPUPlace(), scope=scope)
+    exe2.run(pt.default_startup_program())
+    pexe = pt.ParallelExecutor(use_cuda=False, loss_name=loss2.name,
+                               scope=scope, place=pt.CPUPlace())
+    par = [float(np.asarray(pexe.run(feed=feed2,
+                                     fetch_list=[loss2.name])[0]).mean())
+           for _ in range(4)]
+    np.testing.assert_allclose(par, ref, rtol=2e-4, atol=1e-5)
+    assert par[-1] < par[0]

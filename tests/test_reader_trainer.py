@@ -264,3 +264,49 @@ def test_trainer_test_is_side_effect_free():
         after = np.asarray(trainer.scope.find_var(n))
         assert np.array_equal(before, after), \
             f"test() mutated scope var {n}"
+
+
+def test_trainer_env_driven_dist_transpile(monkeypatch):
+    """ref contrib/trainer.py _dist_transpile_if_necessary: the PADDLE_*
+    env contract — TRAINER role with PADDLE_TRAINERS=8 self-transpiles
+    the program (c_allreduce per grad) onto the 8-device mesh with loss
+    parity vs the plain single-device Trainer."""
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4).astype("f4"),
+             rng.randn(1).astype("f4")) for _ in range(16)]
+
+    def train_func():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    def optimizer_func():
+        return pt.optimizer.SGD(learning_rate=0.1)
+
+    def run_losses():
+        losses = []
+
+        def handler(e):
+            if isinstance(e, pt.EndStepEvent) and e.metrics:
+                losses.append(float(np.asarray(e.metrics[0]).mean()))
+
+        r = reader.batch(lambda: iter(data), batch_size=16)
+        pt.reset_default_programs()
+        trainer = pt.Trainer(train_func, optimizer_func,
+                             place=pt.CPUPlace())
+        trainer.train(num_epochs=3, event_handler=handler, reader=r,
+                      feed_order=["x", "y"])
+        return losses
+
+    ref = run_losses()
+
+    monkeypatch.setenv("PADDLE_TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINERS", "8")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    dist = run_losses()
+    np.testing.assert_allclose(dist, ref, rtol=1e-4, atol=1e-6)
+
+    monkeypatch.setenv("PADDLE_TRAINING_ROLE", "PSERVER")
+    with pytest.raises(RuntimeError, match="no parameter servers"):
+        run_losses()

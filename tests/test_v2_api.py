@@ -1,0 +1,191 @@
+"""v2-era API surface (ref python/paddle/v2/ — SURVEY §2.2 "v2 API"):
+the canonical quick-start flows run end-to-end through the shim, which
+lowers to the same Program/Executor plane as everything else."""
+import io
+import itertools
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def _linreg_reader():
+    rng = np.random.RandomState(0)
+    w = np.array([1.0, -2.0, 0.5, 3.0], "f4")
+
+    def reader():
+        for _ in range(64):
+            x = rng.randn(4).astype("f4")
+            yield x, np.array([float(x @ w)], "f4")
+
+    return reader
+
+
+def test_fit_a_line_quickstart():
+    """The v2 'fit a line' flow: layer graph -> parameters.create ->
+    trainer.SGD -> infer."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1,
+                           act=paddle.activation.Linear())
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+
+    params = paddle.parameters.create(cost)
+    assert params.names()
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.0,
+                                                  learning_rate=0.05))
+    costs = []
+    trainer.train(
+        reader=paddle.batch(_linreg_reader(), batch_size=16),
+        num_passes=12,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.1, (costs[0], costs[-1])
+
+    test_result = trainer.test(
+        reader=paddle.batch(_linreg_reader(), batch_size=16))
+    assert test_result.cost < costs[0]
+
+    out = paddle.infer(output_layer=pred, parameters=params,
+                       input=[(np.ones(4, "f4"),)],
+                       feeding={"x": 0})
+    assert out.shape == (1, 1) and np.isfinite(out).all()
+
+
+def test_recognize_digits_mlp():
+    """v2 recognize_digits (MLP variant) on a synthetic separable task:
+    classification_cost + Adam + multi-pass training."""
+    rng = np.random.RandomState(1)
+    centers = rng.randn(3, 8).astype("f4") * 3
+
+    def reader():
+        for _ in range(96):
+            c = rng.randint(0, 3)
+            yield (centers[c] + 0.1 * rng.randn(8).astype("f4"), int(c))
+
+    img = paddle.layer.data(name="img",
+                            type=paddle.data_type.dense_vector(8))
+    lbl = paddle.layer.data(name="lbl",
+                            type=paddle.data_type.integer_value(3))
+    h = paddle.layer.fc(input=img, size=16, act=paddle.activation.Relu())
+    out = paddle.layer.fc(input=h, size=3,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+    costs = []
+    trainer.train(paddle.batch(reader, 32), num_passes=6,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.5
+
+    probs = paddle.infer(output_layer=out, parameters=params,
+                         input=[(centers[c],) for c in range(3)],
+                         feeding={"img": 0})
+    assert np.argmax(probs, 1).tolist() == [0, 1, 2]
+
+
+def test_word2vec_style_embedding_concat():
+    """v2 word2vec shape: N integer inputs -> shared-ish embeddings ->
+    concat -> fc softmax over vocab."""
+    V, E = 20, 8
+    rng = np.random.RandomState(2)
+    data = [(int(a), int(b), int(a)) for a, b in rng.randint(0, V, (64, 2))]
+
+    def reader():
+        yield from data
+
+    w1 = paddle.layer.data(name="w1",
+                           type=paddle.data_type.integer_value(V))
+    w2 = paddle.layer.data(name="w2",
+                           type=paddle.data_type.integer_value(V))
+    nxt = paddle.layer.data(name="nxt",
+                            type=paddle.data_type.integer_value(V))
+    e1 = paddle.layer.embedding(input=w1, size=E)
+    e2 = paddle.layer.embedding(input=w2, size=E)
+    ctx = paddle.layer.concat(input=[e1, e2])
+    hid = paddle.layer.fc(input=ctx, size=32,
+                          act=paddle.activation.Relu())
+    out = paddle.layer.fc(input=hid, size=V,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=nxt)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.02))
+    costs = []
+    trainer.train(paddle.batch(reader, 32), num_passes=8,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0]
+
+
+def test_sequence_embedding_pool_classifier():
+    """integer_value_sequence rides the dense+mask plane: embedding ->
+    masked sequence_pool -> classifier."""
+    V = 12
+    rng = np.random.RandomState(3)
+
+    def reader():
+        for _ in range(64):
+            n = rng.randint(2, 7)
+            cls = rng.randint(0, 2)
+            lo, hi = (0, V // 2) if cls == 0 else (V // 2, V)
+            yield [int(t) for t in rng.randint(lo, hi, n)], int(cls)
+
+    seq = paddle.layer.data(
+        name="seq", type=paddle.data_type.integer_value_sequence(V))
+    lbl = paddle.layer.data(name="lbl",
+                            type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=seq, size=8)
+    pooled = paddle.layer.sequence_pool(input=emb,
+                                        pool_type=paddle.pooling.Avg())
+    out = paddle.layer.fc(input=pooled, size=2,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    costs = []
+    trainer.train(paddle.batch(reader, 32), num_passes=6,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.7
+
+
+def test_parameters_tar_roundtrip_and_set():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    pred = paddle.layer.fc(input=x, size=2,
+                           act=paddle.activation.Linear())
+    params = paddle.parameters.create(pred)
+    name = params.names()[0]
+    params.set(name, np.full_like(params.get(name), 0.25))
+
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    restored = paddle.parameters.Parameters.from_tar(buf)
+    np.testing.assert_allclose(restored.get(name), params.get(name))
+    # init_from_tar merges into an existing Parameters
+    buf.seek(0)
+    params2 = paddle.parameters.create(pred)
+    params2.init_from_tar(buf)
+    np.testing.assert_allclose(params2.get(name), 0.25)
+
+
+def test_dataset_and_reader_are_shared_plane():
+    row = next(iter(
+        itertools.islice(paddle.dataset.uci_housing.train()(), 1)))
+    assert len(row) == 2 and len(row[0]) == 13
+    shuffled = paddle.reader.decorator.shuffle(
+        _linreg_reader(), buf_size=8)
+    assert len(list(shuffled())) == 64
