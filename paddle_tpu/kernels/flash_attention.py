@@ -113,8 +113,9 @@ def _pick_group(BH: int, block_q: int, block_k: int,
     into one step amortises per-step overhead (DMA issue + scalar
     prologue) while keeping the f32 score intermediates g*block_q*block_k
     under `cap` elements so everything stays in the 16M scoped VMEM
-    (fwd holds 2 score-sized arrays -> cap 1M; bwd holds ~4 -> cap 512K;
-    both caps sit just under limits measured to OOM on v5e).  g is
+    (fwd holds 2 score-sized arrays -> cap 1M; bwd holds ~4 -> cap 512K,
+    both halved again for f32 inputs whose blocks are twice the bytes;
+    the caps sit just under limits measured to OOM on v5e).  g is
     capped at 4: the v5e sweep (_drive_flash_tune.py) showed no gain
     beyond 4 and g=8+ OOMs scoped VMEM at common block sizes."""
     g = 1
@@ -129,14 +130,16 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
     """q,k,v: [BH, T, d] -> (o [BH, T, d], lse [BH, T]).  kv_len: actual
     key length when T includes tile padding (mask keys >= kv_len)."""
     BH, T, d = q.shape
-    # causal: smaller blocks let whole above-diagonal pairs skip compute
-    # (at T=512 a single 512 block IS the diagonal and nothing skips)
-    block_q = block_q or _pick_block(T, 256 if causal else 512)
-    block_k = block_k or _pick_block(T, 256 if causal else 1024)
+    # NOTE: 256-blocks "so causal pairs can skip" were measured SLOWER
+    # on v5e (skipped blocks still pay their DMA + grid-step cost);
+    # large blocks win
+    block_q = block_q or _pick_block(T, 512)
+    block_k = block_k or _pick_block(T, 1024)
     if T % block_q or T % block_k:
         raise ValueError(f"seq len {T} not divisible by blocks "
                          f"({block_q}, {block_k})")
-    g = block_bh or _pick_group(BH, block_q, block_k)
+    cap = 1024 * 1024 if q.dtype == jnp.bfloat16 else 512 * 1024
+    g = block_bh or _pick_group(BH, block_q, block_k, cap=cap)
     if BH % g:
         raise ValueError(f"block_bh {g} must divide batch*heads {BH}")
     nk = T // block_k
@@ -273,9 +276,10 @@ def _flash_bwd(scale, causal, kv_len, interpret, res, do,
     q, k, v, o, lse = res
     BH, T, d = q.shape
     block_q = block_q or _pick_block(T, 256)
-    block_k = block_k or _pick_block(T, 256 if causal else 512)
+    block_k = block_k or _pick_block(T, 512)
     nq, nk = T // block_q, T // block_k
-    g = block_bh or _pick_group(BH, block_q, block_k, cap=512 * 1024)
+    cap = 512 * 1024 if q.dtype == jnp.bfloat16 else 256 * 1024
+    g = block_bh or _pick_group(BH, block_q, block_k, cap=cap)
     if BH % g:
         raise ValueError(f"block_bh {g} must divide batch*heads {BH}")
     do = do.astype(q.dtype)
