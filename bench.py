@@ -46,8 +46,10 @@ _BASIS = {
 
 
 def _time_steps(exe, prog, feed, fetch, on_tpu):
+    # best-of-5x20: the axon tunnel adds +-10% dispatch jitter per rep
+    # (docs/profile_r03: device time is stable, wall reps are not)
     iters = 20 if on_tpu else 2
-    reps = 3 if on_tpu else 1
+    reps = 5 if on_tpu else 1
     dt = float("inf")
     out = None
     for _ in range(reps):             # best-of-reps: tunnel jitter guard

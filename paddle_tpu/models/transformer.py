@@ -43,15 +43,23 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     matmul+softmax composition; it supports causal masking but not an
     arbitrary attn_bias or attention-prob dropout, so it requires dense
     (pad-free) batches — the bench/long-context path."""
+    self_attn = keys is None and values is None
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
-    q = layers.fc(queries, size=d_key * n_head, num_flatten_dims=2,
-                  bias_attr=False)
-    k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
-                  bias_attr=False)
-    v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
-                  bias_attr=False)
+    if self_attn and d_key == d_value:
+        # one [B,T,D]@[D,3E] projection instead of three (bigger MXU
+        # tiles, one pass over the activations)
+        qkv = layers.fc(queries, size=(2 * d_key + d_value) * n_head,
+                        num_flatten_dims=2, bias_attr=False)
+        q, k, v = layers.split(qkv, num_or_sections=3, dim=-1)
+    else:
+        q = layers.fc(queries, size=d_key * n_head, num_flatten_dims=2,
+                      bias_attr=False)
+        k = layers.fc(keys, size=d_key * n_head, num_flatten_dims=2,
+                      bias_attr=False)
+        v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
+                      bias_attr=False)
 
     if fused:
         if attn_bias is not None:

@@ -35,6 +35,17 @@ def _binary(name, fn, out_slot="Out"):
     def _lower(ctx, ins, attrs, _fn=fn):
         x, y = ins["X"][0], ins["Y"][0]
         x, y = _align(x, y, attrs.get("axis", -1))
+        # AMP: a bf16 activation meeting an f32 operand (bias/residual
+        # master copy) computes in bf16 — numpy promotion would silently
+        # lift the whole activation plane back to f32, doubling the HBM
+        # traffic of every residual saved for backward (measured ~2ms of
+        # the flagship step in docs/profile_r03)
+        from ..core import flags
+        if (flags.get_flag("amp_bf16")
+                and {x.dtype, y.dtype} == {jnp.bfloat16,
+                                           jnp.dtype("float32")}):
+            x = x.astype(jnp.bfloat16)
+            y = y.astype(jnp.bfloat16)
         return {out_slot: [_fn(x, y)]}
     return _lower
 
