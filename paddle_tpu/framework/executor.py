@@ -208,10 +208,22 @@ class _CompiledProgram:
                     f"program was transpiled for {n_expect} trainers but "
                     f"mesh axis {spmd_axis!r} has {axis_size} devices")
             block = program.global_block()
+            # context-parallel programs shard feeds along the SEQUENCE
+            # dim (transpiler/context_parallel.py sets the marker)
+            feed_dim = int(getattr(program, "_dist_feed_shard_dim", 0))
 
             def feed_spec(name):
                 if block.has_var(name) and block.var(name).is_data:
-                    return P(spmd_axis)
+                    return P(*([None] * feed_dim + [spmd_axis]))
+                return P()
+
+            def state_spec(name):
+                # params annotated by the tp/cp transpilers shard over
+                # the mesh; everything else is replicated
+                if block.has_var(name):
+                    s = getattr(block.var(name), "sharding", None)
+                    if s is not None:
+                        return P(*s)
                 return P()
 
             inner = self._step
@@ -227,11 +239,12 @@ class _CompiledProgram:
 
             sm_kwargs = dict(
                 mesh=mesh,
-                in_specs=({n: P() for n in self.in_state_names},
+                in_specs=({n: state_spec(n) for n in self.in_state_names},
                           {n: feed_spec(n) for n in self.feed_names},
                           P()),
                 out_specs=([P(spmd_axis)] * len(self.fetch_names),
-                           {n: P() for n in self.out_state_names}))
+                           {n: state_spec(n)
+                            for n in self.out_state_names}))
             try:        # jax >= 0.8 renamed check_rep -> check_vma
                 sm = shard_map(spmd_step, check_vma=False, **sm_kwargs)
             except TypeError:
@@ -281,6 +294,9 @@ class _CompiledProgram:
         ctx.program = self.program
         ctx.env = env
         ctx.place = self.place
+        # context-parallel plane: sequence-aware ops (fused_attention)
+        # read this to run their ring variant with the axis in scope
+        ctx.cp_axis = getattr(self.program, "_dist_cp_axis", None)
 
         if self._ad_idx is None:
             env = run_ops_in_env(ctx, env, self._ops)

@@ -70,7 +70,7 @@ class Variable:
                 f"dtype={self.dtype}, persistable={self.persistable})")
 
     def to_dict(self):
-        return {
+        d = {
             "name": self.name, "shape": list(self.shape or ()),
             "dtype": self.dtype, "persistable": self.persistable,
             "stop_gradient": self.stop_gradient, "is_data": self.is_data,
@@ -78,6 +78,11 @@ class Variable:
             "is_parameter": isinstance(self, Parameter),
             "trainable": getattr(self, "trainable", None),
         }
+        if getattr(self, "sharding", None) is not None:
+            # PartitionSpec annotations (tensor/context-parallel
+            # transpilers) must survive clone/save/load
+            d["sharding"] = list(self.sharding)
+        return d
 
 
 class Parameter(Variable):
@@ -326,6 +331,10 @@ class Program:
         if getattr(self, "_dist_spmd_axis", None) is not None:
             d["dist_spmd_axis"] = self._dist_spmd_axis
             d["dist_trainers"] = getattr(self, "_dist_trainers", None)
+        if getattr(self, "_dist_feed_shard_dim", 0):
+            d["dist_feed_shard_dim"] = self._dist_feed_shard_dim
+        if getattr(self, "_dist_cp_axis", None) is not None:
+            d["dist_cp_axis"] = self._dist_cp_axis
         return d
 
     @staticmethod
@@ -335,6 +344,10 @@ class Program:
         if d.get("dist_spmd_axis") is not None:
             p._dist_spmd_axis = d["dist_spmd_axis"]
             p._dist_trainers = d.get("dist_trainers")
+        if d.get("dist_feed_shard_dim"):
+            p._dist_feed_shard_dim = d["dist_feed_shard_dim"]
+        if d.get("dist_cp_axis") is not None:
+            p._dist_cp_axis = d["dist_cp_axis"]
         # recreate blocks
         for bd in d["blocks"][1:]:
             b = Block(p, bd["idx"], bd["parent_idx"])
@@ -343,16 +356,19 @@ class Program:
             b = p.blocks[bd["idx"]]
             for vd in bd["vars"]:
                 if vd.get("is_parameter"):
-                    b.create_parameter(vd["name"], vd["shape"], vd["dtype"],
-                                       trainable=bool(vd.get("trainable", True)))
+                    v = b.create_parameter(
+                        vd["name"], vd["shape"], vd["dtype"],
+                        trainable=bool(vd.get("trainable", True)))
                 else:
-                    b.create_var(vd["name"],
-                                 shape=vd["shape"] or None,
-                                 dtype=vd["dtype"],
-                                 persistable=vd["persistable"],
-                                 stop_gradient=vd["stop_gradient"],
-                                 is_data=vd["is_data"],
-                                 lod_level=vd.get("lod_level", 0))
+                    v = b.create_var(vd["name"],
+                                     shape=vd["shape"] or None,
+                                     dtype=vd["dtype"],
+                                     persistable=vd["persistable"],
+                                     stop_gradient=vd["stop_gradient"],
+                                     is_data=vd["is_data"],
+                                     lod_level=vd.get("lod_level", 0))
+                if vd.get("sharding") is not None:
+                    v.sharding = tuple(vd["sharding"])
             for od in bd["ops"]:
                 b.append_op(od["type"], od["inputs"], od["outputs"],
                             _attrs_from_json(od["attrs"]))

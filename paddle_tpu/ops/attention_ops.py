@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import flags
+from ..core.enforce import EnforceNotMet
 from ..framework.registry import register_op, single_input
 
 
@@ -31,6 +32,22 @@ def _fused_attention(ctx, ins, attrs):
         return x.reshape(B, T, n_head, d).transpose(0, 2, 1, 3)
 
     scale = float(attrs.get("scale", 0.0)) or None
+    cp_axis = getattr(ctx, "cp_axis", None)
+    if cp_axis is not None:
+        # context-parallel plane (transpiler/context_parallel.py): this
+        # trace runs inside shard_map with the sequence sharded over
+        # cp_axis — T here is the LOCAL chunk; ring attention rotates
+        # K/V around the axis with exact cross-chunk causal masking
+        from ..parallel.ring_attention import ring_attention
+        if scale is not None and abs(scale - d ** -0.5) > 1e-9:
+            raise EnforceNotMet(
+                "fused_attention under context parallelism uses the "
+                "default 1/sqrt(d) scale")
+        o = ring_attention(q.reshape(B, T, n_head, d),
+                           k.reshape(B, T, n_head, d),
+                           v.reshape(B, T, n_head, d),
+                           cp_axis, causal=causal)
+        return {"Out": [o.reshape(B, T, E).astype(orig_dtype)]}
     if flags.get_flag("use_pallas_kernels"):
         from ..kernels.flash_attention import flash_attention
         o = flash_attention(split(q), split(k), split(v), causal=causal,

@@ -42,8 +42,8 @@ def _binary(name, fn, out_slot="Out"):
         # the flagship step in docs/profile_r03)
         from ..core import flags
         if (flags.get_flag("amp_bf16")
-                and {x.dtype, y.dtype} == {jnp.bfloat16,
-                                           jnp.dtype("float32")}):
+                and {jnp.dtype(x.dtype), jnp.dtype(y.dtype)}
+                == {jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32)}):
             x = x.astype(jnp.bfloat16)
             y = y.astype(jnp.bfloat16)
         return {out_slot: [_fn(x, y)]}

@@ -18,6 +18,7 @@ from .inference_transpiler import InferenceTranspiler
 from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
 from .tensor_parallel import TensorParallelTranspiler
+from .context_parallel import ContextParallelTranspiler
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,

@@ -1,0 +1,104 @@
+"""ContextParallelTranspiler — ring-attention context parallelism as a
+*program transformation* on the Program IR.
+
+The reference has no long-context strategy at all (SURVEY §5: 2018-era
+LoD + DynamicRNN); its distributed modes are program rewrites
+(distribute_transpiler.py:268).  This transpiler keeps that discipline
+for the TPU-native capability: after transpile, the SAME Program a user
+built for one device trains with its sequence dimension sharded over a
+mesh axis —
+
+  * data feeds shard along dim 1 (the sequence), not the batch
+    (`_dist_feed_shard_dim` marker, honored by the Executor's shard_map
+    plane);
+  * `fused_attention` ops lower to parallel/ring_attention.py inside the
+    shard_map — K/V blocks rotate around the axis via ppermute with
+    exact cross-chunk causal masking (`_dist_cp_axis` marker read from
+    the LowerContext);
+  * position-indexed parameters (e.g. the [T, D] sinusoid table —
+    anything whose leading dim equals the sequence length) get a
+    `(axis, None)` sharding so each device holds the slice matching its
+    global positions;
+  * per-gradient (c_allreduce_sum, 1/N scale) pairs are inserted after
+    the backward, exactly like the data-parallel rewrite — shard losses
+    are means over local tokens, so summed-and-scaled gradients equal
+    the global-batch gradient.
+
+Run with ``Executor(place, mesh=Mesh(devices, ("cp",)))``.  Composes
+with the fused attention path only (the unfused path would need its
+[T, T] bias sharded too — use fused_attention=True models for long
+context, which is the point of the exercise).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ..core.enforce import check_arg
+from ..framework.program import Parameter, Program
+from .distribute_transpiler import DistributeTranspiler
+
+
+class ContextParallelTranspiler:
+    def __init__(self, axis_name: str = "cp"):
+        self.axis_name = axis_name
+
+    def transpile(self, program: Program, cp_degree: int,
+                  seq_len: Optional[int] = None,
+                  seq_params: Optional[Sequence[str]] = None
+                  ) -> Dict[str, tuple]:
+        """Rewrite `program` for cp_degree-way sequence sharding.
+
+        seq_len: the global sequence length (defaults to dim 1 of the
+        first data var).  seq_params: names of position-indexed params
+        to shard; defaults to every Parameter whose leading dim ==
+        seq_len (the sinusoid-table pattern)."""
+        axis = self.axis_name
+        block = program.global_block()
+        check_arg(cp_degree >= 1, f"cp_degree must be >= 1, got "
+                                  f"{cp_degree}")
+        if cp_degree == 1:
+            return {}        # degenerate: leave the program untouched
+        # only the fused path is cp-aware; the unfused matmul+softmax
+        # attention would silently compute block-diagonal attention on
+        # each local chunk
+        check_arg(
+            any(op.type == "fused_attention" for op in block.ops),
+            "context-parallel transpile requires fused_attention ops "
+            "(build the model with fused_attention=True); the unfused "
+            "attention path cannot shard the sequence")
+        if seq_len is None:
+            data_vars = [v for v in block.vars.values() if v.is_data]
+            check_arg(data_vars, "program has no data vars")
+            cands = [v for v in data_vars
+                     if v.shape and len(v.shape) >= 2]
+            check_arg(cands, "cannot infer seq_len: pass it explicitly")
+            seq_len = int(cands[0].shape[1])
+        check_arg(seq_len % cp_degree == 0,
+                  f"sequence length {seq_len} not divisible by "
+                  f"cp degree {cp_degree}")
+
+        if seq_params is None:
+            # position tables are non-trainable [T, ...] constants; the
+            # trainable filter keeps coincidentally-T-sized weights
+            # (e.g. a bias of width == seq_len) replicated — pass
+            # seq_params explicitly for exotic position-indexed params
+            seq_params = [v.name for v in block.vars.values()
+                          if isinstance(v, Parameter) and v.shape
+                          and len(v.shape) >= 2
+                          and int(v.shape[0]) == seq_len
+                          and not getattr(v, "trainable", True)]
+        assigned: Dict[str, tuple] = {}
+        for name in seq_params:
+            v = block.var(name)
+            spec = (axis,) + (None,) * (len(v.shape) - 1)
+            v.sharding = spec
+            assigned[name] = spec
+
+        # the (c_allreduce_sum, 1/N) pairs + the shard_map markers —
+        # identical mechanics to the data-parallel rewrite
+        DistributeTranspiler().transpile(
+            trainer_id=0, program=program, trainers=cp_degree,
+            axis_name=axis)
+        program._dist_feed_shard_dim = 1
+        program._dist_cp_axis = axis
+        return assigned
