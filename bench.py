@@ -42,6 +42,9 @@ _BASIS = {
     "resnet50_train_imgs_per_sec_per_chip":
         "reference's published ResNet-50 train bs64: 81.69 img/s, "
         "2x Xeon 6148 MKL-DNN (benchmark/IntelOptimizedPaddle.md:45)",
+    "resnet50_infer_imgs_per_sec_per_chip":
+        "reference's published ResNet-50 infer bs16: 217.69 img/s, "
+        "2x Xeon 6148 MKL-DNN (benchmark/IntelOptimizedPaddle.md:87)",
 }
 
 
@@ -147,6 +150,43 @@ def bench_resnet50(on_tpu):
     }
 
 
+def bench_resnet50_infer(on_tpu):
+    """Inference parity row: the reference publishes ResNet-50 bs16
+    CPU inference at 217.69 img/s (IntelOptimizedPaddle.md:87); this
+    drives the AOT Predictor path (inference/predictor.py)."""
+    import tempfile
+
+    from paddle_tpu import inference, io, models
+    pt, exe = _fresh(on_tpu)
+    batch = 16
+    shape = (3, 224, 224) if on_tpu else (3, 32, 32)
+    feeds, avg_loss, acc, pred = models.resnet.build_train_net(
+        class_dim=1000, img_shape=shape, depth=50, is_test=True)
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch, *shape).astype("float32")
+    with tempfile.TemporaryDirectory() as td:
+        io.save_inference_model(td, ["img"], [pred], exe)
+        cfg = inference.NativeConfig(model_dir=td, use_tpu=on_tpu)
+        predictor = inference.Predictor(cfg)
+        feed = {"img": jax.device_put(img) if on_tpu else img}
+        predictor.run(feed)                      # AOT compile
+        iters = 30 if on_tpu else 2
+        dt = float("inf")
+        for _ in range(3 if on_tpu else 1):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = predictor.run(feed, return_numpy=False)
+            jax.block_until_ready(out)
+            dt = min(dt, (time.perf_counter() - t0) / iters)
+    return {
+        "metric": "resnet50_infer_imgs_per_sec_per_chip",
+        "value": round(batch / dt, 1), "unit": "img/s",
+        "vs_baseline": round(batch / dt / 217.69, 3),
+        "config": f"ResNet-50 {shape} bs{batch} predictor AOT path",
+    }
+
+
 def bench_nmt(on_tpu):
     from paddle_tpu import models
     pt, exe = _fresh(on_tpu)
@@ -184,7 +224,8 @@ def main():
     flags.set_flag("amp_bf16", True)
 
     rows, errors = [], {}
-    for fn in (bench_lm, bench_resnet50, bench_nmt):
+    for fn in (bench_lm, bench_resnet50, bench_nmt,
+               bench_resnet50_infer):
         try:
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide

@@ -124,7 +124,12 @@ class Predictor:
     # -- run ----------------------------------------------------------------
     def run(self, feeds: Dict[str, np.ndarray],
             return_numpy: bool = True) -> List[np.ndarray]:
-        feeds = {n: np.asarray(v) for n, v in feeds.items()}
+        # device-resident feeds pass through untouched: np.asarray on a
+        # jax.Array is a full device->host readback (then the call
+        # re-uploads), which on a tunneled chip costs more than the
+        # inference itself
+        feeds = {n: v if isinstance(v, jax.Array) else np.asarray(v)
+                 for n, v in feeds.items()}
         missing = set(self.feed_names) - set(feeds)
         check_arg(not missing, f"missing feeds: {sorted(missing)}")
         compiled = self._compiled.get(self._sig(feeds))

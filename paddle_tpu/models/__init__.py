@@ -11,4 +11,5 @@ from . import deepfm
 from . import bert
 from . import stacked_lstm
 from . import machine_translation
+from . import se_resnext
 from . import book

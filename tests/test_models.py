@@ -275,3 +275,17 @@ def test_googlenet_trains():
     losses = _train(feeds, avg_loss, feed, steps=4, lr=0.002)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_se_resnext_tiny_trains():
+    """SE-ResNeXt (ref dist_se_resnext.py): grouped convs + channel
+    gating train end-to-end (tiny config for the CPU loop)."""
+    feeds, avg_loss, acc, pred = models.se_resnext.build_train_net(
+        class_dim=10, img_shape=(3, 32, 32), depth=50,
+        stage_blocks=[1, 1])
+    feed = models.se_resnext.make_fake_batch(4, (3, 32, 32), 10)
+    losses = _train(feeds, avg_loss, feed, steps=3,
+                    opt=pt.optimizer.Momentum(learning_rate=0.05,
+                                              momentum=0.9))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
