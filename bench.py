@@ -97,7 +97,7 @@ def bench_lm(on_tpu):
     feed = _stage(models.transformer.make_fake_lm_batch(cfg, batch, T),
                   on_tpu)
     prog = pt.default_main_program()
-    for _ in range(3):
+    for _ in range(2):      # compile + layout-settling recompile
         exe.run(prog, feed=feed, fetch_list=[avg_cost])
     dt, loss = _time_steps(exe, prog, feed, avg_cost, on_tpu)
     toks = batch * T / dt
@@ -230,15 +230,18 @@ def main():
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide
             errors[fn.__name__] = repr(e)[:300]
-
-    out = dict(rows[0]) if rows else {"metric": "none", "value": 0.0,
-                                      "unit": "", "vs_baseline": 0.0}
-    out["workloads"] = rows
-    out["vs_baseline_basis"] = {r["metric"]: _BASIS[r["metric"]]
-                                for r in rows}
-    if errors:
-        out["errors"] = errors
-    print(json.dumps(out))
+        # re-print the cumulative result after EVERY workload: the whole
+        # run is ~9 min of mostly compile time, so if a harness timeout
+        # kills it the last printed line still carries every completed
+        # row (the driver parses the final JSON line of the tail)
+        out = dict(rows[0]) if rows else {"metric": "none", "value": 0.0,
+                                          "unit": "", "vs_baseline": 0.0}
+        out["workloads"] = rows
+        out["vs_baseline_basis"] = {r["metric"]: _BASIS[r["metric"]]
+                                    for r in rows}
+        if errors:
+            out["errors"] = errors
+        print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
