@@ -266,6 +266,40 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
+def _bwd_fused1_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                       dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                       block_q, block_k, nq, scale, causal, kv_len):
+    """One-pass backward for nk == 1 (the whole K/V fits one block, the
+    common short-T case): p is recomputed ONCE per q block and feeds all
+    three grads.  Valid only because each dq block is visited exactly
+    once (no output revisit across an inner K sweep), which the general
+    two-kernel path cannot assume."""
+    qi = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    qs = q_ref[...] * jnp.asarray(scale, q_ref.dtype)
+    do = do_ref[...]
+    k = k_ref[...]
+    p, ds = _recompute_p_ds(
+        qs, k, v_ref[...], do, lse_ref[...], delta_ref[...], qi, 0,
+        block_q, block_k, causal, kv_len)
+    dv_scr[...] = dv_scr[...] + _bmm(p.astype(do.dtype), do,
+                                     ((1,), (1,)))
+    dk_scr[...] = dk_scr[...] + _bmm(ds.astype(qs.dtype), qs,
+                                     ((1,), (1,)))
+    dq_ref[...] = (scale * _bmm(ds.astype(k.dtype), k,
+                                ((2,), (1,)))).astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
 def _flash_bwd(scale, causal, kv_len, interpret, res, do,
                block_q=None, block_k=None, block_bh=None):
     """Pallas backward: dk/dv kernel (Q innermost) + dq kernel (K
@@ -286,6 +320,35 @@ def _flash_bwd(scale, causal, kv_len, interpret, res, do,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)             # [BH, T, 1]
     lse3 = lse[..., None]                               # [BH, T, 1]
+
+    if nk == 1:
+        # single K/V block: the one-pass kernel recomputes p once and
+        # feeds all three grads (~45% less bwd VPU + DMA at short T)
+        def qspec1(w):
+            return pl.BlockSpec((g, block_q, w), lambda b, i: (b, i, 0),
+                                memory_space=pltpu.VMEM)
+
+        kv1 = pl.BlockSpec((g, block_k, d), lambda b, i: (b, 0, 0),
+                           memory_space=pltpu.VMEM)
+        fused1 = functools.partial(
+            _bwd_fused1_kernel, block_q=block_q, block_k=block_k, nq=nq,
+            scale=scale, causal=causal, kv_len=kv_len)
+        dq, dk, dv = pl.pallas_call(
+            fused1,
+            grid=(BH // g, nq),
+            in_specs=[qspec1(d), qspec1(d), qspec1(1), qspec1(1),
+                      kv1, kv1],
+            out_specs=[qspec1(d), kv1, kv1],
+            out_shape=[jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+                       jax.ShapeDtypeStruct((BH, T, d), k.dtype),
+                       jax.ShapeDtypeStruct((BH, T, d), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((g, block_k, d), jnp.float32),
+                            pltpu.VMEM((g, block_k, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(q, do, lse3, delta, k, v)
+        return dq, dk, dv
 
     def q_side(ix):         # q/do/lse/delta blocks, width w, q index = ix
         def spec(w):
