@@ -89,3 +89,56 @@ def test_recognize_digits_via_trainer_mnist_reader():
                         place=pt.CPUPlace(), param_path=d)
         m = t2.test(reader=reader, feed_order=["img", "label"])
         assert np.isfinite(np.asarray(m[0])).all()
+
+
+def test_image_classification_via_trainer_cifar_reader():
+    """ref book/image_classification (test_image_classification_train):
+    conv net + cifar-10 reader through the Trainer loop."""
+    from paddle_tpu import models
+
+    def train_func():
+        img = layers.data("img", [3, 32, 32])
+        label = layers.data("label", [1], dtype="int64")
+        pred = models.resnet.resnet_cifar10(img, class_dim=10, depth=20)
+        avg_loss = layers.mean(layers.cross_entropy(pred, label))
+        acc = layers.accuracy(pred, label)
+        return [avg_loss, acc]
+
+    def samples():
+        for img, lbl in itertools.islice(dataset.cifar.train10()(), 128):
+            yield (np.asarray(img, "float32").reshape(3, 32, 32) / 255.0,
+                   [int(lbl)])
+
+    reader = decorator.batch(samples, 32)
+    run_trainer(train_func, ["img", "label"], reader, epochs=3, lr=0.05)
+
+
+def test_understand_sentiment_via_trainer_imdb_reader():
+    """ref book/understand_sentiment: stacked LSTM + imdb reader
+    (dense+mask sequence plane)."""
+    from paddle_tpu import models
+
+    T = 64
+
+    def train_func():
+        feeds, avg_loss, acc, pred = \
+            models.stacked_lstm.build_train_net(
+                dict_dim=5000, seq_len=T, emb_dim=32, hidden_dim=32,
+                num_layers=2)
+        return [avg_loss, acc]
+
+    word_idx = dataset.imdb.word_dict()
+
+    def samples():
+        for sent, lbl in itertools.islice(
+                dataset.imdb.train(word_idx)(), 192):
+            ids = np.zeros(T, "int64")
+            mask = np.zeros(T, "float32")
+            n = min(len(sent), T)
+            ids[:n] = sent[:n]
+            mask[:n] = 1.0
+            yield (ids, mask, [int(lbl)])
+
+    reader = decorator.batch(samples, 32)
+    run_trainer(train_func, ["words", "mask", "label"], reader,
+                epochs=3, lr=0.05)
