@@ -26,11 +26,12 @@ from __future__ import annotations
 
 from .. import dataset, reader                       # shared data plane
 from . import (activation, attr, config_base, data_type, event, layer,
-               optimizer, parameters, pooling, trainer)
+               networks, optimizer, parameters, pooling, trainer)
 from .inference import Inference, infer
 from .minibatch import batch
 
 __all__ = ["init", "infer", "batch", "layer", "activation", "optimizer",
+           "networks",
            "parameters", "trainer", "event", "data_type", "attr",
            "pooling", "dataset", "reader", "Inference"]
 

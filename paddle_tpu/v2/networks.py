@@ -1,0 +1,40 @@
+"""ref python/paddle/v2/networks.py — composite layer helpers (the
+trainer_config_helpers networks) over the v2 layer nodes."""
+from __future__ import annotations
+
+from .activation import act_name
+from .config_base import Layer
+
+__all__ = ["simple_img_conv_pool", "img_conv_group"]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, pool_type=None,
+                         name=None, **_):
+    """conv + pool block (ref networks.py simple_img_conv_pool),
+    lowered through nets.simple_img_conv_pool."""
+    def build(ctx):
+        from paddle_tpu import nets
+        ptype = "max" if pool_type is None else pool_type.name
+        return nets.simple_img_conv_pool(
+            input.to_var(ctx), num_filters=num_filters,
+            filter_size=filter_size, pool_size=pool_size,
+            pool_stride=pool_stride, act=act_name(act), pool_type=ptype)
+
+    return Layer(build, [input], name=name)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, pool_type=None,
+                   name=None, **_):
+    """VGG-style conv group (ref networks.py img_conv_group)."""
+    def build(ctx):
+        from paddle_tpu import nets
+        ptype = "max" if pool_type is None else pool_type.name
+        return nets.img_conv_group(
+            input.to_var(ctx), conv_num_filter=list(conv_num_filter),
+            pool_size=pool_size, conv_padding=conv_padding,
+            conv_filter_size=conv_filter_size,
+            conv_act=act_name(conv_act), pool_type=ptype)
+
+    return Layer(build, [input], name=name)
