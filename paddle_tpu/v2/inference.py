@@ -28,7 +28,8 @@ class Inference:
                 f"v2 infer field={field!r}: only 'value' (raw layer "
                 f"output) and 'id' (argmax over the last axis) are "
                 f"supported")
-        feed = _feed_from_batch(input, self._data_layers, feeding)
+        feed = _feed_from_batch(input, self._data_layers, feeding,
+                                self._prog)
         outs = self._exe.run(self._prog, feed=feed,
                              fetch_list=self._out_vars)
         outs = [np.asarray(o) for o in outs]

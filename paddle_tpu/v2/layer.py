@@ -19,7 +19,12 @@ def _fluid_layers():
     return fl
 
 
-def data(name, type, **_):
+def data(name, type, height=None, width=None, **_):
+    """v2 data layer (ref v2/layer.py data / trainer_config_helpers
+    data_layer, which carries optional height/width for image inputs).
+    When height/width are given over a dense_vector, the program var is
+    declared conv-shaped [C, H, W] (C = dim // (H*W)); the trainer feed
+    plane reshapes flat dense batches to the declared var shape."""
     def build(ctx):
         fl = _fluid_layers()
         if type.__class__.__name__ == "IntegerValueSequence":
@@ -28,7 +33,19 @@ def data(name, type, **_):
             m = fl.data(name + "_mask", [-1], dtype="float32")
             ctx[("mask", name)] = m
         else:
-            v = fl.data(name, type.shape, dtype=type.dtype)
+            shape = list(type.shape)
+            if (height is None) != (width is None):
+                raise ValueError(
+                    f"data layer {name!r}: height and width must be "
+                    f"given together (got height={height}, width={width})")
+            if height and width:
+                channels = type.dim // (height * width)
+                if channels * height * width != type.dim:
+                    raise ValueError(
+                        f"data layer {name!r}: dim {type.dim} is not "
+                        f"divisible by height*width {height}x{width}")
+                shape = [channels, height, width]
+            v = fl.data(name, shape, dtype=type.dtype)
         ctx["__data__"].append(node)
         return v
 

@@ -11,9 +11,12 @@ from .config_base import build_topology
 __all__ = ["SGD"]
 
 
-def _feed_from_batch(batch, data_layers, feeding):
+def _feed_from_batch(batch, data_layers, feeding, program=None):
     """v2 readers yield per-sample tuples; `feeding` maps data-layer
-    name -> tuple index (default: declaration order)."""
+    name -> tuple index (default: declaration order).  Dense batches are
+    reshaped to the program data var's declared shape so conv nets can
+    be fed from flat dense_vector columns (the reference's v2 image
+    workflow, python/paddle/v2/tests/test_layer.py)."""
     if feeding is None:
         feeding = {lay.name: i for i, lay in enumerate(data_layers)}
     feed = {}
@@ -23,6 +26,13 @@ def _feed_from_batch(batch, data_layers, feeding):
         if isinstance(arrs, tuple):          # sequence: (ids, mask)
             feed[lay.name], feed[lay.name + "_mask"] = arrs
         else:
+            if program is not None and program.global_block().has_var(
+                    lay.name):
+                var_shape = [int(d) for d in
+                             program.global_block().var(lay.name).shape]
+                # leading -1 is the batch dim
+                if -1 not in var_shape[1:]:
+                    arrs = arrs.reshape([len(col)] + var_shape[1:])
             feed[lay.name] = arrs
     return feed
 
@@ -61,7 +71,8 @@ class SGD:
             handler(v2_event.BeginPass(pass_id))
             for batch_id, batch in enumerate(reader()):
                 handler(v2_event.BeginIteration(pass_id, batch_id))
-                feed = _feed_from_batch(batch, self._data_layers, feeding)
+                feed = _feed_from_batch(batch, self._data_layers, feeding,
+                                        self._main)
                 cost, = self._exe.run(self._main, feed=feed,
                                       fetch_list=[self._cost_var])
                 handler(v2_event.EndIteration(
@@ -71,7 +82,8 @@ class SGD:
     def test(self, reader, feeding=None):
         costs, n = [], 0
         for batch in reader():
-            feed = _feed_from_batch(batch, self._data_layers, feeding)
+            feed = _feed_from_batch(batch, self._data_layers, feeding,
+                                    self._test_prog)
             cost, = self._exe.run(self._test_prog, feed=feed,
                                   fetch_list=[self._cost_var])
             costs.append(float(np.asarray(cost).ravel()[0]) * len(batch))

@@ -207,18 +207,11 @@ def test_networks_simple_img_conv_pool():
             img += 0.05 * rng.randn(1, 8, 8).astype("f4")
             yield img, int(cls)
 
+    # v2 images feed flat (dense_vector) and the data layer's
+    # height/width declare the conv shape; the feed plane reshapes
     img = paddle.layer.data(name="img",
-                            type=paddle.data_type.dense_vector(64))
-
-    # v2 images feed flat and reshape inside the conv stack; shape the
-    # data layer through a conv-ready builder
-    def conv_build(ctx):
-        from paddle_tpu import layers as fl
-        v = fl.data("img", [1, 8, 8])
-        ctx["__data__"].append(img)
-        return v
-
-    img._build = conv_build
+                            type=paddle.data_type.dense_vector(64),
+                            height=8, width=8)
     conv = paddle.networks.simple_img_conv_pool(
         input=img, filter_size=3, num_filters=4, pool_size=2,
         pool_stride=2, act=paddle.activation.Relu())
