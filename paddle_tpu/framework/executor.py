@@ -41,6 +41,21 @@ from ..core.profiler import RecordEvent
 from .program import Program, Variable, default_main_program
 from .registry import LowerContext, get_op_def
 
+def _data_feed_spec(program, var, axis):
+    """PartitionSpec for a data-var feed on a transpiled program: shard
+    dim `_dist_feed_shard_dim` (0 = batch; context-parallel programs set
+    1 = sequence) over `axis`.  Feeds of lower rank (per-example aux
+    vars) stay replicated.  Single source of truth for the compiled
+    step's in_specs AND the multi-process feed globalization — the two
+    must agree or in_shardings mismatch."""
+    P = jax.sharding.PartitionSpec
+    feed_dim = int(getattr(program, "_dist_feed_shard_dim", 0))
+    rank = len(var.shape) if var.shape else 0
+    if feed_dim >= rank:
+        return P()
+    return P(*([None] * feed_dim + [axis]))
+
+
 # Ops that are pure bookkeeping at the program level; the executor itself
 # implements their semantics (or they have none at run time).
 _STRUCTURAL_OPS = ("feed", "fetch", "data")
@@ -208,13 +223,13 @@ class _CompiledProgram:
                     f"program was transpiled for {n_expect} trainers but "
                     f"mesh axis {spmd_axis!r} has {axis_size} devices")
             block = program.global_block()
-            # context-parallel programs shard feeds along the SEQUENCE
-            # dim (transpiler/context_parallel.py sets the marker)
-            feed_dim = int(getattr(program, "_dist_feed_shard_dim", 0))
 
             def feed_spec(name):
+                # context-parallel programs shard feeds along the
+                # SEQUENCE dim (transpiler/context_parallel.py marker)
                 if block.has_var(name) and block.var(name).is_data:
-                    return P(*([None] * feed_dim + [spmd_axis]))
+                    return _data_feed_spec(program, block.var(name),
+                                           spmd_axis)
                 return P()
 
             def state_spec(name):
@@ -476,7 +491,7 @@ class Executor:
             elif var.is_data:
                 axis = (getattr(program, "_dist_spmd_axis", None)
                         or self.batch_axis)
-                spec = P(axis)
+                spec = _data_feed_spec(program, var, axis)
         sharding = jax.sharding.NamedSharding(self.mesh, spec)
         return jax.make_array_from_callback(
             arr.shape, sharding, lambda idx: arr[idx])

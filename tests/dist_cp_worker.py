@@ -1,0 +1,89 @@
+"""Cross-process context-parallel worker: 2 localhost processes train
+the SAME fused-attention LM Program with its SEQUENCE dim sharded over
+the process mesh (ContextParallelTranspiler -> Executor(mesh)).  Feeds
+are globalized along dim 1 (`_dist_feed_shard_dim`), and batch B=1 <
+cp_degree=2 proves the feed is NOT batch-sharded (an uneven dim-0 shard
+would be unbuildable).
+
+Run:  python tests/dist_cp_worker.py <coordinator> <world> <rank> <out>
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+SEED = 77
+B, T, D, V, HEADS = 1, 32, 16, 64, 2
+
+
+def build_program(pt, models):
+    pt.reset_default_programs()
+    main = pt.default_main_program()
+    startup = pt.default_startup_program()
+    main.random_seed = SEED
+    startup.random_seed = SEED
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=V, tgt_vocab_size=V, max_length=T, n_layer=1,
+        n_head=HEADS, d_model=D, d_inner=32, dropout=0.0)
+    _, avg_cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=T, fused_attention=True, fused_head=False)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    return main, startup, avg_cost
+
+
+def make_feed():
+    rng = np.random.RandomState(5)
+    toks = rng.randint(0, V, (B, T)).astype("int64")
+    return {"tokens": toks, "labels": np.roll(toks, -1, 1)}
+
+
+def train_steps(exe, prog, loss, steps=4):
+    feed = make_feed()
+    losses = []
+    for _ in range(steps):
+        out, = exe.run(prog, feed=feed, fetch_list=[loss])
+        losses.append(float(np.mean(np.asarray(out))))
+    return losses
+
+
+def main():
+    coordinator, world, rank, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    from paddle_tpu.parallel import env as penv
+
+    ok = penv.init_distributed_env(coordinator_address=coordinator,
+                                   num_processes=world, process_id=rank)
+    assert ok, "init_distributed_env returned False"
+    assert jax.process_count() == world
+
+    main_p, startup, loss = build_program(pt, models)
+    t = pt.transpiler.ContextParallelTranspiler()
+    t.transpile(main_p, cp_degree=world)
+    assert main_p._dist_feed_shard_dim == 1
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("cp",))
+    exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe.run(startup)
+    losses = train_steps(exe, main_p, loss)
+
+    wname = main_p.all_parameters()[0].name
+    w = exe.scope.find_var(wname)
+    w_host = np.asarray(w.addressable_data(0))   # replicated param shard
+    result = {"rank": rank, "losses": losses,
+              "w_sum": float(np.abs(w_host).sum())}
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    print("WORKER_OK", rank)
+
+
+if __name__ == "__main__":
+    main()
