@@ -316,9 +316,12 @@ def _flash_bwd(scale, causal, kv_len, interpret, res, do,
     g = block_bh or _pick_group(BH, block_q, block_k, cap=cap)
     if BH % g:
         raise ValueError(f"block_bh {g} must divide batch*heads {BH}")
-    do = do.astype(q.dtype)
+    # delta from the UNconverted (f32) cotangent, then downcast do for
+    # the matmul operands — downcasting first would round the correction
+    # term delta = rowsum(do*o) under AMP
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)             # [BH, T, 1]
+    do = do.astype(q.dtype)
     lse3 = lse[..., None]                               # [BH, T, 1]
 
     if nk == 1:
@@ -466,3 +469,377 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
         out = out[:, :T]
     out = out.reshape(B, H, T, d)
     return out[:, 0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# HDT layout: transpose-free attention for the fused-projection op.
+#
+# q, k: [H, d, B*T]; v: [H, dv, B*T]; o: [H, dv, B*T].  This is the layout a
+# dot_general(W, x) projection produces NATURALLY (weights as lhs: output
+# dims = [heads*d_head, tokens]) — so the model runs attention with ZERO
+# XLA transposes, forward or backward (the [B,T,H,d]<->[B,H,T,d] layout
+# churn around the bhtd kernels cost ~24% of the flagship step,
+# docs/profile_r03).  In-kernel, scores are computed TRANSPOSED
+# (s_T [g, block_k, block_q] with k as the lhs) so the softmax running
+# stats are lane-major [g, 1, block_q] and broadcast over the [g, d,
+# block_q] accumulator without any sublane<->lane relayout.  Every matmul
+# is a Mosaic-supported rank-3 batch-0 dot_general, and every VMEM block
+# is fully packed (d=64 sits in sublanes: no half-empty 128-lane tiles,
+# unlike the [.., T, d] layout).  The three bwd kernels follow the same
+# FlashAttention-2 recurrence as the bhtd path.
+# ---------------------------------------------------------------------------
+
+
+def _mask_hdt(s, qi, ki, block_q, block_k, causal, kv_len):
+    """Mask transposed scores s [g, block_k, block_q]: keys in SUBLANES
+    (dim 1), queries in LANES (dim 2)."""
+    k_pos = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0)
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        s = jnp.where((q_pos >= k_pos)[None], s, NEG_INF)
+    if kv_len is not None:
+        s = jnp.where((k_pos < kv_len)[None], s, NEG_INF)
+    return s
+
+
+def _fwd_kernel_hdt(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr,
+                    l_scr, *, block_q, block_k, nk, scale, causal, kv_len):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[...] * jnp.asarray(scale, q_ref.dtype)   # [g, d, bq]
+        k = k_ref[...]                                     # [g, d, bk]
+        v = v_ref[...]                                     # [g, dv, bk]
+        s = _bmm(k, q, ((1,), (1,)))       # [g, bk, bq] transposed scores
+        s = _mask_hdt(s, qi, ki, block_q, block_k, causal, kv_len)
+        m_prev = m_scr[:, :1, :]                           # [g, 1, bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)                     # [g, 1, bq]
+        l_scr[:, :1, :] = l_scr[:, :1, :] * corr + jnp.sum(
+            p, axis=1, keepdims=True)
+        m_scr[:, :1, :] = m_new
+        acc_scr[...] = acc_scr[...] * corr + _bmm(
+            v, p.astype(v.dtype), ((2,), (1,)))            # [g, dv, bq]
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1, :], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = (m_scr[:, :1, :] + jnp.log(l)).astype(jnp.float32)
+
+
+def _recompute_p_ds_hdt(qs, k, v, do, lse, delta, qi, ki, block_q,
+                        block_k, causal, kv_len):
+    """Transposed-score bwd block math: p_T, ds_T [g, block_k, block_q]
+    from pre-scaled q' and (k, lse); do [g, dv, bq]."""
+    s = _bmm(k, qs, ((1,), (1,)))
+    s = _mask_hdt(s, qi, ki, block_q, block_k, causal, kv_len)
+    p = jnp.exp(s - lse)                   # lse [g, 1, bq] broadcasts
+    dp = _bmm(v, do, ((1,), (1,)))         # [g, bk, bq]
+    ds = p * (dp - delta)
+    return p, ds
+
+
+def _bwd_dkv_kernel_hdt(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                        dk_ref, dv_ref, dk_scr, dv_scr, *, block_q,
+                        block_k, nq, scale, causal, kv_len):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        qs = q_ref[...] * jnp.asarray(scale, q_ref.dtype)  # [g, d, bq]
+        do = do_ref[...]                                   # [g, dv, bq]
+        k = k_ref[...]
+        v = v_ref[...]
+        p, ds = _recompute_p_ds_hdt(
+            qs, k, v, do, lse_ref[...], delta_ref[...], qi, ki,
+            block_q, block_k, causal, kv_len)
+        dv_scr[...] = dv_scr[...] + _bmm(
+            do, p.astype(do.dtype), ((2,), (2,)))          # [g, dv, bk]
+        dk_scr[...] = dk_scr[...] + _bmm(
+            qs, ds.astype(qs.dtype), ((2,), (2,)))         # [g, d, bk]
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel_hdt(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                       dq_ref, dq_scr, *, block_q, block_k, nk, scale,
+                       causal, kv_len):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        qs = q_ref[...] * jnp.asarray(scale, q_ref.dtype)
+        k = k_ref[...]
+        _, ds = _recompute_p_ds_hdt(
+            qs, k, v_ref[...], do_ref[...], lse_ref[...], delta_ref[...],
+            qi, ki, block_q, block_k, causal, kv_len)
+        dq_scr[...] = dq_scr[...] + _bmm(k, ds.astype(k.dtype),
+                                         ((2,), (1,)))     # [g, d, bq]
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_fused1_kernel_hdt(q_ref, do_ref, lse_ref, delta_ref, k_ref,
+                           v_ref, dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                           *, block_q, block_k, nq, scale, causal,
+                           kv_len):
+    """One-pass backward for nk == 1: p/ds recomputed once feed all three
+    grads (each dq block visited exactly once)."""
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    qs = q_ref[...] * jnp.asarray(scale, q_ref.dtype)
+    do = do_ref[...]
+    k = k_ref[...]
+    p, ds = _recompute_p_ds_hdt(
+        qs, k, v_ref[...], do, lse_ref[...], delta_ref[...], qi, 0,
+        block_q, block_k, causal, kv_len)
+    dv_scr[...] = dv_scr[...] + _bmm(do, p.astype(do.dtype),
+                                     ((2,), (2,)))
+    dk_scr[...] = dk_scr[...] + _bmm(qs, ds.astype(qs.dtype),
+                                     ((2,), (2,)))
+    dq_ref[...] = (scale * _bmm(k, ds.astype(k.dtype),
+                                ((2,), (1,)))).astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_fwd_hdt(q, k, v, B, scale, causal, interpret, block_q,
+                   block_k, kv_len=None, block_g=None):
+    H, d, Nq = q.shape
+    dv = v.shape[1]
+    Tq, Tk = Nq // B, k.shape[2] // B
+    block_q = block_q or _pick_block(Tq, 512)
+    block_k = block_k or _pick_block(Tk, 1024)
+    if Tq % block_q or Tk % block_k:
+        raise ValueError(f"seq lens ({Tq}, {Tk}) not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    cap = 1024 * 1024 if q.dtype == jnp.bfloat16 else 512 * 1024
+    g = block_g or _pick_group(H, block_q, block_k, cap=cap)
+    if H % g:
+        raise ValueError(f"block_g {g} must divide heads {H}")
+    nq, nk = Tq // block_q, Tk // block_k
+    grid = (H // g, B, nq, nk)
+    kernel = functools.partial(_fwd_kernel_hdt, block_q=block_q,
+                               block_k=block_k, nk=nk, scale=scale,
+                               causal=causal, kv_len=kv_len)
+
+    def qsp(w):
+        return pl.BlockSpec((g, w, block_q),
+                            lambda h, b, i, j: (h, 0, b * nq + i),
+                            memory_space=pltpu.VMEM)
+
+    def ksp(w):
+        return pl.BlockSpec((g, w, block_k),
+                            lambda h, b, i, j: (h, 0, b * nk + j),
+                            memory_space=pltpu.VMEM)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qsp(d), ksp(d), ksp(dv)],
+        out_specs=[qsp(dv), qsp(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, dv, Nq), q.dtype),
+            jax.ShapeDtypeStruct((H, 1, Nq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, dv, block_q), jnp.float32),   # acc
+            pltpu.VMEM((g, 8, block_q), jnp.float32),    # running max
+            pltpu.VMEM((g, 8, block_q), jnp.float32),    # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res, do,
+                   block_q=None, block_k=None, block_g=None):
+    q, k, v, o, lse = res                   # lse [H, 1, Nq]
+    H, d, Nq = q.shape
+    dv = v.shape[1]
+    Tq, Tk = Nq // B, k.shape[2] // B
+    block_q = block_q or _pick_block(Tq, 256)
+    block_k = block_k or _pick_block(Tk, 512)
+    nq, nk = Tq // block_q, Tk // block_k
+    cap = 512 * 1024 if q.dtype == jnp.bfloat16 else 256 * 1024
+    g = block_g or _pick_group(H, block_q, block_k, cap=cap)
+    if H % g:
+        raise ValueError(f"block_g {g} must divide heads {H}")
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=1, keepdims=True)  # [H, 1, Nq] (f32 cotangent)
+    do = do.astype(q.dtype)
+    out_shapes = [jax.ShapeDtypeStruct((H, d, Nq), q.dtype),
+                  jax.ShapeDtypeStruct(k.shape, k.dtype),
+                  jax.ShapeDtypeStruct(v.shape, v.dtype)]
+
+    def qsp(w, ix):
+        return pl.BlockSpec((g, w, block_q),
+                            lambda h, b, i, j: (h, 0, b * nq + ix(i, j)),
+                            memory_space=pltpu.VMEM)
+
+    def ksp(w, ix):
+        return pl.BlockSpec((g, w, block_k),
+                            lambda h, b, i, j: (h, 0, b * nk + ix(i, j)),
+                            memory_space=pltpu.VMEM)
+
+    if nk == 1:
+        def qsp1(w):
+            return pl.BlockSpec((g, w, block_q),
+                                lambda h, b, i: (h, 0, b * nq + i),
+                                memory_space=pltpu.VMEM)
+
+        def ksp1(w):
+            return pl.BlockSpec((g, w, block_k),
+                                lambda h, b, i: (h, 0, b),
+                                memory_space=pltpu.VMEM)
+
+        fused1 = functools.partial(
+            _bwd_fused1_kernel_hdt, block_q=block_q, block_k=block_k,
+            nq=nq, scale=scale, causal=causal, kv_len=kv_len)
+        dq, dk, dv_ = pl.pallas_call(
+            fused1,
+            grid=(H // g, B, nq),
+            in_specs=[qsp1(d), qsp1(dv), qsp1(1), qsp1(1),
+                      ksp1(d), ksp1(dv)],
+            out_specs=[qsp1(d), ksp1(d), ksp1(dv)],
+            out_shape=out_shapes,
+            scratch_shapes=[pltpu.VMEM((g, d, block_k), jnp.float32),
+                            pltpu.VMEM((g, dv, block_k), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interpret,
+        )(q, do, lse, delta, k, v)
+        return dq, dk, dv_
+
+    iq, ik = lambda i, j: j, lambda i, j: i
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel_hdt, block_q=block_q, block_k=block_k, nq=nq,
+        scale=scale, causal=causal, kv_len=kv_len)
+    dk, dv_ = pl.pallas_call(
+        dkv_kernel,
+        grid=(H // g, B, nk, nq),
+        in_specs=[qsp(d, iq), qsp(dv, iq), qsp(1, iq), qsp(1, iq),
+                  ksp(d, ik), ksp(dv, ik)],
+        out_specs=[ksp(d, ik), ksp(dv, ik)],
+        out_shape=out_shapes[1:],
+        scratch_shapes=[pltpu.VMEM((g, d, block_k), jnp.float32),
+                        pltpu.VMEM((g, dv, block_k), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, do, lse, delta, k, v)
+
+    iq2, ik2 = lambda i, j: i, lambda i, j: j
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel_hdt, block_q=block_q, block_k=block_k, nk=nk,
+        scale=scale, causal=causal, kv_len=kv_len)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(H // g, B, nq, nk),
+        in_specs=[qsp(d, iq2), qsp(dv, iq2), qsp(1, iq2), qsp(1, iq2),
+                  ksp(d, ik2), ksp(dv, ik2)],
+        out_specs=qsp(d, iq2),
+        out_shape=out_shapes[0],
+        scratch_shapes=[pltpu.VMEM((g, d, block_q), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, do, lse, delta, k, v)
+    return dq, dk, dv_
+
+
+@functools.lru_cache(maxsize=64)
+def _make_flash_hdt(B, scale, causal, interpret, block_q, block_k,
+                    kv_len=None, block_g=None):
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _ = _flash_fwd_hdt(q, k, v, B, scale, causal, interpret,
+                              block_q, block_k, kv_len, block_g)
+        return o
+
+    def fwd(q, k, v):
+        o, lse = _flash_fwd_hdt(q, k, v, B, scale, causal, interpret,
+                                block_q, block_k, kv_len, block_g)
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, g):
+        return _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res,
+                              g, block_q, block_k, block_g)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_attention_hdt(q, k, v, batch, causal: bool = False,
+                        scale: float = None, interpret: bool = None,
+                        kv_len: int = None, block_q: int = None,
+                        block_k: int = None, block_g: int = None):
+    """Flash attention in the transpose-free head-major layout.
+
+    q, k: [H, d, batch*Tq] / [H, d, batch*Tk]; v: [H, dv, batch*Tk].
+    Returns o [H, dv, batch*Tq].  Tq/Tk must be multiples of 128 (the
+    caller pads tokens BEFORE the projections and passes kv_len to mask
+    the padded keys).  causal requires Tq == Tk.
+    """
+    H, d, Nq = q.shape
+    if Nq % batch or k.shape[2] % batch:
+        raise ValueError(f"token counts {Nq}/{k.shape[2]} not divisible "
+                         f"by batch {batch}")
+    if causal and Nq != k.shape[2]:
+        raise ValueError("causal attention requires Tq == Tk")
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    f = _make_flash_hdt(int(batch), float(scale), bool(causal),
+                        bool(interpret), block_q, block_k, kv_len,
+                        block_g)
+    return f(q, k, v)

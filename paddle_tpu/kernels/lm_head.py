@@ -42,10 +42,12 @@ _LANES = 128
 
 
 def _pick_block_v(V: int) -> int:
-    """Largest multiple of 128 that divides V, capped at 640 (keeps the
-    f32 logits tile [block_n, block_v] a few MB).  0 if none divides —
-    caller pads V."""
-    for bv in (640, 512, 384, 256, 128):
+    """Largest multiple of 128 that divides V, capped at 1280.  Bigger
+    vocab blocks amortise per-grid-step overhead in the forward (v5e
+    sweep: bv 640 -> 1280 took the flagship 0.427 -> 0.436 MFU; 2560+
+    OOMs scoped VMEM at block_n 1024).  0 if none divides — caller pads
+    V.  The backward shrinks bv separately to fit its chunk."""
+    for bv in (1280, 640, 512, 384, 256, 128):
         if V % bv == 0:
             return bv
     return 0
@@ -162,7 +164,15 @@ def _bwd(x, w, y2d, lse, g, interpret, chunk, block_v, valid_v):
     N, D = x.shape
     _, Vp = w.shape
     chunk = min(chunk, N)
-    while chunk > 2048 and chunk % 2 == 0:
+    # bigger chunks halve the dw HBM-accumulator churn (the [D, Vp] f32
+    # buffer is read+written once per chunk), but the kernel's resident
+    # set (x block + f32 dx accumulator + logits tile) must fit the 16M
+    # scoped VMEM.  Measured on v5e at D=512: chunk 4096 compiles and is
+    # faster on the f32 path but OOMs scoped VMEM (20.8M) with bf16
+    # operands — Mosaic's buffering differs by dtype — so cap bf16 at
+    # 2048
+    cap_chunk = 2048 if x.dtype == jnp.bfloat16 else 4096
+    while chunk > cap_chunk and chunk % 2 == 0:
         chunk //= 2        # [chunk, *] f32 tiles must fit scoped VMEM
     # the bwd kernel holds ~3 [chunk, bv] f32 intermediates plus the
     # [chunk, D] accumulator; shrink bv until the logits tile is <= 2MB

@@ -831,6 +831,41 @@ def fused_multihead_attention(queries, keys, values, n_head, causal=False,
     return out
 
 
+def fused_mha(x, n_head, causal=False, kv=None, size=None, out_size=None,
+              param_attr=None, name=None):
+    """Projection-fused multi-head attention: ONE op owning Wq/Wk/Wv
+    [D, E] and Wo [E, out_size], lowered transpose-free through the
+    head-major Pallas flash kernel (ops/attention_ops.py fused_mha).
+    x: [B, T, D]; kv: optional [B, Tk, Dk] for cross-attention (causal
+    must be False).  E = size or D; returns [B, T, out_size or D]."""
+    helper = LayerHelper("fused_mha", name=name)
+    D = int(x.shape[-1])
+    E = int(size or D)
+    d_out = int(out_size or D)
+    src = kv if kv is not None else x
+    Dk = int(src.shape[-1])
+
+    def attr(sfx):
+        a = ParamAttr._to_attr(param_attr)
+        if a is not None and a.name:
+            a = copy.copy(a)
+            a.name = f"{a.name}.{sfx}"
+        return a
+
+    wq = helper.create_parameter(attr("q"), shape=[D, E], dtype=x.dtype)
+    wk = helper.create_parameter(attr("k"), shape=[Dk, E], dtype=x.dtype)
+    wv = helper.create_parameter(attr("v"), shape=[Dk, E], dtype=x.dtype)
+    wo = helper.create_parameter(attr("o"), shape=[E, d_out],
+                                 dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Wq": [wq], "Wk": [wk], "Wv": [wv], "Wo": [wo]}
+    if kv is not None:
+        inputs["XKV"] = [kv]
+    helper.append_op("fused_mha", inputs, {"Out": [out]},
+                     {"n_head": n_head, "causal": causal})
+    return out
+
+
 def fused_attention_qkv(q, k, v, n_head, causal=False, name=None):
     """Flash attention on pre-projected q/k/v [B, T, n_head*d] (the
     projections live in the caller, e.g. models.transformer); one fused op

@@ -43,9 +43,32 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     matmul+softmax composition; it supports causal masking but not an
     arbitrary attn_bias or attention-prob dropout, so it requires dense
     (pad-free) batches — the bench/long-context path."""
-    self_attn = keys is None and values is None
+    self_attn = (keys is None or keys is queries) and (
+        values is None or values is keys or values is queries)
     keys = queries if keys is None else keys
     values = keys if values is None else values
+
+    if fused:
+        if values is not keys:
+            raise ValueError("fused attention path projects V from the "
+                             "same source as K (one kv input); pass "
+                             "values=keys or use fused=False")
+        if attn_bias is not None:
+            raise ValueError("fused attention path cannot apply an "
+                             "additive attn_bias; pass dense batches")
+        if dropout_rate:
+            raise ValueError("fused attention path has no attention-prob "
+                             "dropout (FlashAttention contract); use "
+                             "fused=False or dropout_rate=0")
+        if d_key != d_value:
+            raise ValueError("fused attention path requires "
+                             "d_key == d_value")
+        # projection-fused op: q/k/v/o projections live INSIDE the op so
+        # the whole sublayer lowers transpose-free (head-major Pallas
+        # kernel); replaces fc(3E) -> split -> fused_attention -> fc(D)
+        return layers.fused_mha(queries, n_head, causal=causal,
+                                kv=None if self_attn else keys,
+                                size=d_key * n_head, out_size=d_model)
 
     if self_attn and d_key == d_value:
         # one [B,T,D]@[D,3E] projection instead of three (bigger MXU
@@ -60,18 +83,6 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                       bias_attr=False)
         v = layers.fc(values, size=d_value * n_head, num_flatten_dims=2,
                       bias_attr=False)
-
-    if fused:
-        if attn_bias is not None:
-            raise ValueError("fused attention path cannot apply an "
-                             "additive attn_bias; pass dense batches")
-        if dropout_rate:
-            raise ValueError("fused attention path has no attention-prob "
-                             "dropout (FlashAttention contract); use "
-                             "fused=False or dropout_rate=0")
-        ctx = layers.fused_attention_qkv(q, k, v, n_head, causal=causal)
-        return layers.fc(ctx, size=d_model, num_flatten_dims=2,
-                         bias_attr=False)
 
     def split_heads(x, d):
         # [B,T,nh*d] -> [B,nh,T,d]

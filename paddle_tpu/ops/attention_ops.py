@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..core import flags
 from ..core.enforce import EnforceNotMet
@@ -65,6 +66,104 @@ def _fused_attention(ctx, ins, attrs):
         o = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
     out = o.transpose(0, 2, 1, 3).reshape(B, T, E).astype(orig_dtype)
     return {"Out": [out]}
+
+
+@register_op("fused_mha")
+def _fused_mha(ctx, ins, attrs):
+    """Projection-fused multi-head attention — ONE op owning the q/k/v
+    and output projection weights, lowered transpose-free.
+
+    X [B, T, D] (+ XKV [B, Tk, Dk] for cross-attention); Wq/Wk/Wv
+    [D, E], Wo [E, D_out]; attrs n_head, causal.  The projections run
+    with the WEIGHTS as the dot_general lhs, so q/k/v come out in the
+    head-major [H, d_head, B*T] layout the Pallas HDT kernel consumes
+    directly, and o's (h, d) dims are adjacent so the output projection
+    collapses to a plain matmul: the whole sublayer has ZERO XLA
+    transposes, forward and backward (the [B,T,H,d] <-> [B,H,T,d]
+    layout churn of the split-heads composition cost ~24% of the
+    flagship step, docs/profile_r03).  No reference equivalent (2018
+    codebase: unfused matmul+softmax, fluid/nets.py)."""
+    from .math_ops import amp_inputs, amp_result, _acc_type
+    x = ins["X"][0]
+    wq, wk, wv = ins["Wq"][0], ins["Wk"][0], ins["Wv"][0]
+    wo = ins["Wo"][0]
+    n_head = int(attrs["n_head"])
+    causal = bool(attrs.get("causal", False))
+    orig_dtype = x.dtype
+    B, T, D = x.shape
+    E = int(wo.shape[0])
+    dh = E // n_head
+    if causal and ins.get("XKV"):
+        raise EnforceNotMet(
+            "fused_mha: causal masking is only defined for "
+            "self-attention (positions of XKV and X differ)")
+    xkv = ins["XKV"][0] if ins.get("XKV") else x
+    Tk = xkv.shape[1]
+    xb, xkvb, wqb, wkb, wvb, wob = amp_inputs(x, xkv, wq, wk, wv, wo)
+
+    def pad_tokens(a, t, tp):
+        return jnp.pad(a, ((0, 0), (0, tp - t), (0, 0))) if tp != t else a
+
+    cp_axis = getattr(ctx, "cp_axis", None)
+    use_pallas = cp_axis is None and flags.get_flag("use_pallas_kernels")
+    if use_pallas:
+        # only the Pallas kernel needs tile-granule padding; the ring
+        # (cp) and unfused paths take any T
+        granule = 128
+        Tp = -(-T // granule) * granule
+        Tkp = -(-Tk // granule) * granule
+    else:
+        Tp, Tkp = T, Tk
+    if cp_axis is not None:
+        # context-parallel plane: q/k/v still project head-major, then
+        # ring attention rotates K/V around the axis (local T chunk)
+        from ..parallel.ring_attention import ring_attention
+    # project with weights as lhs: head-major [E, B*T], no transpose.
+    # NOTE a single stacked [3,D,E] qkv dot was measured SLOWER on v5e
+    # (0.445 -> 0.432 MFU) than q separate + stacked [2,D,E] k/v — the
+    # weight-stack copy sits on the critical path each step
+    xq2 = pad_tokens(xb, T, Tp).reshape(B * Tp, D)
+    xk2 = pad_tokens(xkvb, Tk, Tkp).reshape(B * Tkp, -1)
+    w2 = jnp.stack([wkb, wvb])                      # [2, Dk, E]
+    q = lax.dot_general(wqb, xq2, (((0,), (1,)), ((), ())))   # [E, BTp]
+    kv = lax.dot_general(w2, xk2, (((1,), (1,)), ((), ())))   # [2,E,BTkp]
+    q = q.reshape(n_head, dh, B * Tp)
+    k = kv[0].reshape(n_head, dh, B * Tkp)
+    v = kv[1].reshape(n_head, dh, B * Tkp)
+
+    if cp_axis is not None:
+        def to_bthd(a, t):
+            return a.reshape(n_head, dh, B, t).transpose(2, 3, 0, 1)
+        o = ring_attention(to_bthd(q, Tp), to_bthd(k, Tkp),
+                           to_bthd(v, Tkp), cp_axis,
+                           causal=causal)              # [B, T, H, dh]
+        o = o.transpose(2, 3, 0, 1).reshape(E, B * Tp)
+    elif flags.get_flag("use_pallas_kernels"):
+        from ..kernels.flash_attention import flash_attention_hdt
+        o = flash_attention_hdt(
+            q, k, v, batch=B, causal=causal,
+            kv_len=Tk if Tkp != Tk else None,
+            interpret=ctx.pallas_interpret())          # [H, dh, BTp]
+        o = o.reshape(E, B * Tp)
+    else:
+        # unfused composition from the same head-major tensors
+        # (correctness/debug path; layout cost irrelevant off-TPU)
+        q4 = q.reshape(n_head, dh, B, Tp)
+        k4 = k.reshape(n_head, dh, B, Tkp)
+        v4 = v.reshape(n_head, dh, B, Tkp)
+        s = jnp.einsum("hdbq,hdbk->bhqk", q4, k4) * (dh ** -0.5)
+        if causal:
+            mask = jnp.tril(jnp.ones((Tp, Tkp), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        w_att = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+        o = jnp.einsum("bhqk,hdbk->hdbq", w_att, v4).reshape(E, B * Tp)
+
+    out = lax.dot_general(o, wob, (((0,), (0,)), ((), ())),
+                          preferred_element_type=_acc_type(o))
+    out = out.reshape(B, Tp, -1)
+    if Tp != T:
+        out = out[:, :T]
+    return {"Out": [amp_result(out, orig_dtype)]}
 
 
 @register_op("fused_lm_head_loss")

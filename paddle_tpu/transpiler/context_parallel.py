@@ -62,10 +62,11 @@ class ContextParallelTranspiler:
         # attention would silently compute block-diagonal attention on
         # each local chunk
         check_arg(
-            any(op.type == "fused_attention" for op in block.ops),
-            "context-parallel transpile requires fused_attention ops "
-            "(build the model with fused_attention=True); the unfused "
-            "attention path cannot shard the sequence")
+            any(op.type in ("fused_attention", "fused_mha")
+                for op in block.ops),
+            "context-parallel transpile requires fused_attention/"
+            "fused_mha ops (build the model with fused_attention=True); "
+            "the unfused attention path cannot shard the sequence")
         if seq_len is None:
             data_vars = [v for v in block.vars.values() if v.is_data]
             check_arg(data_vars, "program has no data vars")

@@ -1,6 +1,7 @@
 """Model-zoo smoke tests: build each BASELINE config, run train steps, check
 the loss is finite and decreases on a fixed batch (the reference's book-test
 contract: tests/book/* assert loss decrease)."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -104,8 +105,11 @@ def test_lm_fused_attention_trains():
 
 
 def test_lm_fused_matches_unfused_loss():
-    """fused_attention=True/False compute the same math (same seed)."""
-    vals = []
+    """fused_attention=True/False compute the same MATH: the unfused
+    run's weights are mapped onto the fused program (its fused_mha op
+    owns Wq/Wk/Wv where the composition has one [D, 3E] qkv fc) and the
+    losses must agree."""
+    built = {}
     for fused in (True, False):
         pt.reset_default_programs()
         from paddle_tpu.framework import executor as em
@@ -115,12 +119,46 @@ def test_lm_fused_matches_unfused_loss():
             n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0)
         feeds, avg_cost, _ = models.transformer.build_lm_net(
             cfg, seq_len=8, fused_attention=fused)
-        exe = pt.Executor(pt.CPUPlace())
+        exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
         pt.default_startup_program().random_seed = 7
         exe.run(pt.default_startup_program())
-        feed = models.transformer.make_fake_lm_batch(cfg, 2, 8)
-        out, = exe.run(pt.default_main_program(), feed=feed,
-                       fetch_list=[avg_cost])
+        built[fused] = (pt.default_main_program(), exe, avg_cost, cfg)
+
+    # map unfused weights -> fused params in creation order; a [D, 3E]
+    # qkv weight fans out to the fused op's three [D, E] projections
+    uf_main, uf_exe, _, _ = built[False]
+    f_main, f_exe, _, _ = built[True]
+    uf_arrs = [np.asarray(uf_exe.scope.find_var(p.name))
+               for p in uf_main.all_parameters()]
+    f_params = f_main.all_parameters()
+    ui = 0
+    fi = 0
+    while fi < len(f_params):
+        fp = f_params[fi]
+        src = uf_arrs[ui]
+        if tuple(src.shape) == tuple(fp.shape):
+            f_exe.scope.set_var(fp.name, jnp.asarray(src))
+            fi += 1
+        elif (len(src.shape) == 2 and len(fp.shape) == 2
+              and src.shape[0] == fp.shape[0]
+              and src.shape[1] == 3 * fp.shape[1]):
+            E = fp.shape[1]
+            for j in range(3):
+                f_exe.scope.set_var(f_params[fi + j].name,
+                                    jnp.asarray(src[:, j*E:(j+1)*E]))
+            fi += 3
+        else:
+            raise AssertionError(
+                f"param mismatch: unfused {src.shape} vs fused "
+                f"{fp.shape}")
+        ui += 1
+    assert ui == len(uf_arrs)
+
+    feed = models.transformer.make_fake_lm_batch(built[True][3], 2, 8)
+    vals = []
+    for fused in (True, False):
+        main, exe, avg_cost, _ = built[fused]
+        out, = exe.run(main, feed=feed, fetch_list=[avg_cost])
         vals.append(float(out))
     np.testing.assert_allclose(vals[0], vals[1], rtol=1e-4)
 

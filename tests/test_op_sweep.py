@@ -918,6 +918,7 @@ EXEMPT = {
     "static_rnn_scan": "control flow — tests/test_control_flow.py",
     "delete_var": "documented no-op (XLA owns liveness)",
     "fused_attention": "tests/test_pallas_kernels.py",
+    "fused_mha": "tests/test_pallas_kernels.py fused_mha parity/cross/train",
     "fused_lm_head_loss": "tests/test_models.py fused-vs-unfused parity",
     "save": "io op — tests/test_reader_trainer.py save/load-as-ops",
     "load": "io op — dedicated test",
