@@ -41,6 +41,89 @@ from ..core.profiler import RecordEvent
 from .program import Program, Variable, default_main_program
 from .registry import LowerContext, get_op_def
 
+def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
+                data_names):
+    """GPipe schedule over the `axis` mesh axis (PipelineTranspiler
+    plane): M microbatches tick through a lax.scan; each device runs its
+    own stage (lax.switch on its axis index) over the forward sub-op
+    lists and ppermutes the boundary activation onward.  Bubble ticks
+    are masked from the loss.  Differentiating through the scan yields
+    the reversed-pipeline backward for free; the per-stage gradients
+    are disjoint and summed by the transpiler's c_allreduce_sum ops."""
+    Pn = jax.lax.axis_size(axis)
+    check_arg(len(stage_ops) == Pn,
+              f"program has {len(stage_ops)} pipeline stages but mesh "
+              f"axis {axis!r} has {Pn} devices")
+    micro = {}
+    for n in data_names:
+        a = env.pop(n)
+        check_arg(a.shape[0] % M == 0,
+                  f"feed {n!r} batch {a.shape[0]} not divisible by "
+                  f"n_microbatches {M}")
+        micro[n] = a.reshape((M, a.shape[0] // M) + a.shape[1:])
+
+    def branch(s):
+        def f(x_act, mfeeds, t):
+            # per-tick RNG root: without it every microbatch would reuse
+            # the single trace-time dropout mask (ops draw keys from a
+            # trace-side counter)
+            tctx = LowerContext(jax.random.fold_in(ctx._root_key, t),
+                                is_test=ctx.is_test, mesh=ctx.mesh)
+            tctx.place = ctx.place
+            tctx.program = getattr(ctx, "program", None)
+            tctx.cp_axis = getattr(ctx, "cp_axis", None)
+            senv = dict(env)
+            senv.update(mfeeds)
+            if s > 0:
+                senv[b_names[s - 1]] = x_act
+            senv = run_ops_in_env(tctx, senv, stage_ops[s])
+            if s < Pn - 1:
+                return senv[b_names[s]], jnp.zeros((), jnp.float32)
+            return (jnp.zeros_like(x_act),
+                    senv[loss_name].reshape(()).astype(jnp.float32))
+        # GPipe memory contract: per tick only the boundary activation
+        # is saved; stage internals rematerialize in the backward
+        return jax.checkpoint(f)
+
+    def probe(mfeeds):
+        senv = dict(env)
+        senv.update(mfeeds)
+        senv = run_ops_in_env(ctx, senv, stage_ops[0])
+        return senv[b_names[0]]
+
+    act = jax.eval_shape(probe, {n: micro[n][0] for n in micro})
+    branches = [branch(s) for s in range(Pn)]
+    pp_r = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+
+    def tick(carry, t):
+        state, loss_acc = carry
+        # stage s processes microbatch t - s at tick t
+        my_idx = jnp.clip(t - pp_r, 0, M - 1)
+        mfeeds = {n: jax.lax.dynamic_index_in_dim(micro[n], my_idx, 0,
+                                                  keepdims=False)
+                  for n in micro}
+        out, lval = jax.lax.switch(pp_r, branches, state, mfeeds, t)
+        o_idx = t - (Pn - 1)
+        valid = (pp_r == Pn - 1) & (o_idx >= 0) & (o_idx < M)
+        loss_acc = loss_acc + jnp.where(valid, lval, 0.0)
+        nxt = jax.lax.ppermute(out, axis, perm)
+        return (nxt, loss_acc), None
+
+    state0 = jnp.zeros(act.shape, act.dtype)
+    (_, loss_acc), _ = jax.lax.scan(
+        tick, (state0, jnp.zeros((), jnp.float32)),
+        jnp.arange(M + Pn - 1))
+    # LOCAL per-device loss (nonzero on the last stage only).  Keeping
+    # the psum OUT of the differentiated region matters: differentiating
+    # through psum under shard_map seeds every device's cotangent with
+    # the axis-summed value (Pn x too large); with a local loss the
+    # ppermute transposes alone carry the cotangents back along the
+    # ring, giving each stage exactly its own gradient.  The caller
+    # psums the returned value for the (replicated) fetch.
+    return loss_acc / M
+
+
 def _data_feed_spec(program, var, axis):
     """PartitionSpec for a data-var feed on a transpiled program: shard
     dim `_dist_feed_shard_dim` (0 = batch; context-parallel programs set
@@ -194,40 +277,58 @@ class _CompiledProgram:
         self._ad_idx = ad_idx[0] if ad_idx else None
         jit_kwargs = {"donate_argnums": (0,) if donate else ()}
         spmd_axis = getattr(program, "_dist_spmd_axis", None)
-        if spmd_axis is not None and mesh is None:
+        pp_axis = getattr(program, "_dist_pp_axis", None)
+        if (spmd_axis is not None or pp_axis is not None) and mesh is None:
             raise EnforceNotMet(
-                f"this program was rewritten by DistributeTranspiler "
-                f"(collectives over axis {spmd_axis!r}); run it with "
-                f"Executor(place, mesh=...) so the axis is in scope")
-        if mesh is not None and spmd_axis is not None:
-            # Explicit-collective SPMD (the DistributeTranspiler plane):
-            # the program carries its own c_allreduce/scale ops (the
-            # reference's nccl2-mode transformation), so run the step
-            # under shard_map with the axis in scope instead of leaving
-            # collective insertion to XLA sharding propagation.
+                f"this program was rewritten by DistributeTranspiler/"
+                f"PipelineTranspiler (collectives over axis "
+                f"{spmd_axis if spmd_axis is not None else pp_axis!r}); "
+                f"run it with Executor(place, mesh=...) so the axis is "
+                f"in scope")
+        if mesh is not None and (spmd_axis is not None
+                                 or pp_axis is not None):
+            # Explicit-collective SPMD (the DistributeTranspiler /
+            # PipelineTranspiler plane): the program carries its own
+            # c_allreduce/scale ops (the reference's nccl2-mode
+            # transformation), so run the step under shard_map with the
+            # axes in scope instead of leaving collective insertion to
+            # XLA sharding propagation.
             try:
                 from jax import shard_map        # jax >= 0.8
             except ImportError:
                 from jax.experimental.shard_map import shard_map
             P = jax.sharding.PartitionSpec
-            if spmd_axis not in mesh.shape:
-                raise EnforceNotMet(
-                    f"program was transpiled over axis {spmd_axis!r} but "
-                    f"the mesh axes are {tuple(mesh.shape)}; build the "
-                    f"mesh with that axis name (or transpile with "
-                    f"axis_name matching the mesh)")
+            for ax in (spmd_axis, pp_axis):
+                if ax is not None and ax not in mesh.shape:
+                    raise EnforceNotMet(
+                        f"program was transpiled over axis {ax!r} but "
+                        f"the mesh axes are {tuple(mesh.shape)}; build "
+                        f"the mesh with that axis name (or transpile "
+                        f"with axis_name matching the mesh)")
             n_expect = getattr(program, "_dist_trainers", None)
-            axis_size = int(mesh.shape[spmd_axis])
-            if n_expect is not None and n_expect != axis_size:
-                raise EnforceNotMet(
-                    f"program was transpiled for {n_expect} trainers but "
-                    f"mesh axis {spmd_axis!r} has {axis_size} devices")
+            if spmd_axis is not None:
+                axis_size = int(mesh.shape[spmd_axis])
+                if n_expect is not None and n_expect != axis_size:
+                    raise EnforceNotMet(
+                        f"program was transpiled for {n_expect} trainers "
+                        f"but mesh axis {spmd_axis!r} has {axis_size} "
+                        f"devices")
+            if pp_axis is not None:
+                deg = getattr(program, "_pp_degree", None)
+                if deg and deg != int(mesh.shape[pp_axis]):
+                    raise EnforceNotMet(
+                        f"program was pipelined for {deg} stages but "
+                        f"mesh axis {pp_axis!r} has "
+                        f"{int(mesh.shape[pp_axis])} devices")
             block = program.global_block()
 
             def feed_spec(name):
                 # context-parallel programs shard feeds along the
-                # SEQUENCE dim (transpiler/context_parallel.py marker)
-                if block.has_var(name) and block.var(name).is_data:
+                # SEQUENCE dim (transpiler/context_parallel.py marker);
+                # pipeline-only programs replicate feeds (every pipe
+                # rank micro-splits the full local batch itself)
+                if (spmd_axis is not None and block.has_var(name)
+                        and block.var(name).is_data):
                     return _data_feed_spec(program, block.var(name),
                                            spmd_axis)
                 return P()
@@ -246,18 +347,21 @@ class _CompiledProgram:
             def spmd_step(state, feeds, key):
                 # distinct randomness per shard (dropout etc.), like the
                 # single-trace path where each example draws its own mask
-                key = jax.random.fold_in(key,
-                                         jax.lax.axis_index(spmd_axis))
+                for ax in (spmd_axis, pp_axis):
+                    if ax is not None:
+                        key = jax.random.fold_in(
+                            key, jax.lax.axis_index(ax))
                 fetches, new_state = inner(state, feeds, key)
                 # per-shard fetches gain a leading shard axis on the host
                 return [jnp.asarray(f)[None] for f in fetches], new_state
 
+            fetch_axis = spmd_axis if spmd_axis is not None else pp_axis
             sm_kwargs = dict(
                 mesh=mesh,
                 in_specs=({n: state_spec(n) for n in self.in_state_names},
                           {n: feed_spec(n) for n in self.feed_names},
                           P()),
-                out_specs=([P(spmd_axis)] * len(self.fetch_names),
+                out_specs=([P(fetch_axis)] * len(self.fetch_names),
                            {n: state_spec(n)
                             for n in self.out_state_names}))
             try:        # jax >= 0.8 renamed check_rep -> check_vma
@@ -301,6 +405,59 @@ class _CompiledProgram:
                 None, {n: state_spec(n) for n in self.out_state_names})
         self._jitted = jax.jit(self._step, **jit_kwargs)
 
+    def _pp_partition(self):
+        """Split the forward op list at pipeline_boundary markers into
+        stage sub-programs; returns (stage_ops, boundary_var_names).
+
+        Vars consumed by a stage but produced OUTSIDE it (and not
+        arriving as its boundary activation) are rematerialized: the
+        transitive producer ops are prepended to the stage, in program
+        order — e.g. the shared causal-bias iota chain every layer
+        consumes.  A badly-placed cut degrades to recomputation, never
+        to wrong results."""
+        fw = self._ops[:self._ad_idx]
+        stages, cur, b_names = [], [], []
+        for op in fw:
+            cur.append(op)
+            if op.type == "pipeline_boundary":
+                b_names.append(op.outputs["Out"][0])
+                stages.append(cur)
+                cur = []
+        stages.append(cur)
+
+        produced_by = {}
+        for i, op in enumerate(fw):
+            for names in op.outputs.values():
+                for n in names:
+                    produced_by.setdefault(n, i)
+
+        out = []
+        for s, ops in enumerate(stages):
+            own = set(id(op) for op in ops)
+            incoming = b_names[s - 1] if s > 0 else None
+            extra: List[int] = []
+            seen = set()
+
+            def resolve(n):
+                if n in seen or n == incoming:
+                    return
+                seen.add(n)
+                i = produced_by.get(n)
+                if i is None or id(fw[i]) in own:
+                    return          # feed/param/state or stage-internal
+                for names in fw[i].inputs.values():
+                    for m in names:
+                        resolve(m)
+                extra.append(i)
+
+            for op in ops:
+                for names in op.inputs.values():
+                    for n in names:
+                        resolve(n)
+            prologue = [fw[i] for i in sorted(set(extra))]
+            out.append(prologue + ops)
+        return out, b_names
+
     # --- tracing ----------------------------------------------------------
     def _step(self, state: Dict[str, Any], feeds: Dict[str, Any], key):
         env: Dict[str, Any] = dict(state)
@@ -323,12 +480,33 @@ class _CompiledProgram:
             base_env = {k: v for k, v in env.items()
                         if k not in param_names}
             params = {k: env[k] for k in param_names}
+            pp_axis = getattr(self.program, "_dist_pp_axis", None)
 
-            def forward(p):
-                fenv = dict(base_env)
-                fenv.update(p)
-                fenv = run_ops_in_env(ctx, fenv, self._ops[:self._ad_idx])
-                return fenv[loss_name], fenv
+            if pp_axis is not None:
+                stage_ops, b_names = self._pp_partition()
+                M = int(getattr(self.program, "_pp_microbatches", 1))
+                block = self.program.global_block()
+                data_names = [n for n in self.feed_names
+                              if block.has_var(n) and block.var(n).is_data]
+
+                def forward(p):
+                    fenv = dict(base_env)
+                    fenv.update(p)
+                    loss = _pp_forward(ctx, fenv, stage_ops, b_names,
+                                       loss_name, pp_axis, M, data_names)
+                    # stage internals live inside the scan: only the
+                    # loss (plus params/state) is available downstream
+                    out_env = dict(base_env)
+                    out_env.update(p)
+                    out_env[loss_name] = loss
+                    return loss, out_env
+            else:
+                def forward(p):
+                    fenv = dict(base_env)
+                    fenv.update(p)
+                    fenv = run_ops_in_env(ctx, fenv,
+                                          self._ops[:self._ad_idx])
+                    return fenv[loss_name], fenv
 
             loss_val, vjp_fn, fwd_env = jax.vjp(forward, params,
                                                 has_aux=True)
@@ -337,6 +515,10 @@ class _CompiledProgram:
                       f"got shape {loss_val.shape}")
             grads = vjp_fn(jnp.ones_like(loss_val))[0]
             env = fwd_env
+            if pp_axis is not None:
+                # replicate the (stage-local) pipelined loss for fetch,
+                # OUTSIDE the differentiated region (see _pp_forward)
+                env[loss_name] = jax.lax.psum(loss_val, pp_axis)
             for pname, gname in zip(param_names, grad_names):
                 env[gname] = grads[pname]
             env = run_ops_in_env(ctx, env, self._ops[self._ad_idx + 1:])

@@ -335,6 +335,10 @@ class Program:
             d["dist_feed_shard_dim"] = self._dist_feed_shard_dim
         if getattr(self, "_dist_cp_axis", None) is not None:
             d["dist_cp_axis"] = self._dist_cp_axis
+        if getattr(self, "_dist_pp_axis", None) is not None:
+            d["dist_pp_axis"] = self._dist_pp_axis
+            d["pp_degree"] = getattr(self, "_pp_degree", None)
+            d["pp_microbatches"] = getattr(self, "_pp_microbatches", None)
         return d
 
     @staticmethod
@@ -348,6 +352,10 @@ class Program:
             p._dist_feed_shard_dim = d["dist_feed_shard_dim"]
         if d.get("dist_cp_axis") is not None:
             p._dist_cp_axis = d["dist_cp_axis"]
+        if d.get("dist_pp_axis") is not None:
+            p._dist_pp_axis = d["dist_pp_axis"]
+            p._pp_degree = d.get("pp_degree")
+            p._pp_microbatches = d.get("pp_microbatches")
         # recreate blocks
         for bd in d["blocks"][1:]:
             b = Block(p, bd["idx"], bd["parent_idx"])

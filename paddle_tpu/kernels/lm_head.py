@@ -168,10 +168,10 @@ def _bwd(x, w, y2d, lse, g, interpret, chunk, block_v, valid_v):
     # buffer is read+written once per chunk), but the kernel's resident
     # set (x block + f32 dx accumulator + logits tile) must fit the 16M
     # scoped VMEM.  Measured on v5e at D=512: chunk 4096 compiles and is
-    # faster on the f32 path but OOMs scoped VMEM (20.8M) with bf16
+    # faster on the f32 path but OOMs scoped VMEM (20.8M) with 2-byte
     # operands — Mosaic's buffering differs by dtype — so cap bf16 at
     # 2048
-    cap_chunk = 2048 if x.dtype == jnp.bfloat16 else 4096
+    cap_chunk = 2048 if jnp.dtype(x.dtype).itemsize == 2 else 4096
     while chunk > cap_chunk and chunk % 2 == 0:
         chunk //= 2        # [chunk, *] f32 tiles must fit scoped VMEM
     # the bwd kernel holds ~3 [chunk, bv] f32 intermediates plus the

@@ -804,13 +804,7 @@ def fused_multihead_attention(queries, keys, values, n_head, causal=False,
     d_model = int(queries.shape[-1])
 
     def proj_attr(suffix):
-        # a shared named param_attr would alias all four projections to one
-        # parameter; derive a unique name per projection instead
-        a = ParamAttr._to_attr(param_attr)
-        if a is not None and a.name:
-            a = copy.copy(a)
-            a.name = f"{a.name}.{suffix}"
-        return a
+        return _suffixed_param_attr(param_attr, suffix)
 
     projs = []
     for x, sfx in zip((queries, keys, values), ("q", "k", "v")):
@@ -831,6 +825,28 @@ def fused_multihead_attention(queries, keys, values, n_head, causal=False,
     return out
 
 
+def _suffixed_param_attr(param_attr, suffix):
+    """A shared named param_attr would alias all of a layer's projections
+    to one parameter; derive a unique name per projection instead."""
+    a = ParamAttr._to_attr(param_attr)
+    if a is not None and a.name:
+        a = copy.copy(a)
+        a.name = f"{a.name}.{suffix}"
+    return a
+
+
+def pipeline_boundary(x, name=None):
+    """Mark a pipeline-stage cut for PipelineTranspiler (the 2018
+    reference has no pipeline parallelism — SURVEY §2.2; its later
+    device_guard annotations play this role).  Identity op in
+    un-transpiled programs; with pp_degree = K the program needs K-1
+    markers at shape-homogeneous activation boundaries."""
+    helper = LayerHelper("pipeline_boundary", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pipeline_boundary", {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
 def fused_mha(x, n_head, causal=False, kv=None, size=None, out_size=None,
               param_attr=None, name=None):
     """Projection-fused multi-head attention: ONE op owning Wq/Wk/Wv
@@ -846,11 +862,7 @@ def fused_mha(x, n_head, causal=False, kv=None, size=None, out_size=None,
     Dk = int(src.shape[-1])
 
     def attr(sfx):
-        a = ParamAttr._to_attr(param_attr)
-        if a is not None and a.name:
-            a = copy.copy(a)
-            a.name = f"{a.name}.{sfx}"
-        return a
+        return _suffixed_param_attr(param_attr, sfx)
 
     wq = helper.create_parameter(attr("q"), shape=[D, E], dtype=x.dtype)
     wk = helper.create_parameter(attr("k"), shape=[Dk, E], dtype=x.dtype)

@@ -283,7 +283,8 @@ def build_train_net(cfg: TransformerConfig, src_len: int, tgt_len: int,
 
 
 def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
-                 fused_attention: bool = True, fused_head: bool = False):
+                 fused_attention: bool = True, fused_head: bool = False,
+                 pp_stages: int = 1):
     """Decoder-only causal LM on the encoder stack (the flagship bench
     config; the reference's closest analogue is the language-model rows of
     benchmark/fluid/).  Feeds: tokens [B,T] int64, labels [B,T] int64 —
@@ -310,10 +311,19 @@ def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
         future = layers.cast(layers.greater_than(col, row), "float32")
         attn_bias = layers.reshape(layers.scale(future, scale=-1e9),
                                    [1, 1, seq_len, seq_len])
-    for _ in range(cfg.n_layer):
+    if cfg.n_layer % pp_stages:
+        raise ValueError(f"n_layer {cfg.n_layer} not divisible by "
+                         f"pp_stages {pp_stages}")
+    per_stage = cfg.n_layer // pp_stages
+    for li in range(cfg.n_layer):
         x = encoder_layer(x, attn_bias, cfg.n_head, cfg.d_key, cfg.d_value,
                           cfg.d_model, cfg.d_inner, dropout,
                           causal=True, fused=fused_attention)
+        # pipeline-ready build: mark the stage cuts for
+        # transpiler/pipeline.py (identity ops otherwise)
+        if (pp_stages > 1 and (li + 1) % per_stage == 0
+                and li + 1 < cfg.n_layer):
+            x = layers.pipeline_boundary(x)
     x = pre_post_process(None, x, "n")
     if fused_head:
         # chunked remat head: no [N, V] logits in HBM (fwd or bwd)

@@ -120,6 +120,15 @@ def _assign(ctx, ins, attrs):
     return {"Out": [single_input(ins)]}
 
 
+@register_op("pipeline_boundary")
+def _pipeline_boundary(ctx, ins, attrs):
+    """Identity marker: layers.pipeline_boundary cuts go here.  Inert in
+    un-transpiled programs; transpiler/pipeline.py partitions the op
+    list at these markers and the executor's shard_map plane runs the
+    stages as a GPipe schedule over the pipe axis."""
+    return {"Out": [single_input(ins)]}
+
+
 @register_op("assign_value")
 def _assign_value(ctx, ins, attrs):
     dtype = to_jnp_dtype(attrs.get("dtype", "float32"))

@@ -19,6 +19,7 @@ from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
 from .tensor_parallel import TensorParallelTranspiler
 from .context_parallel import ContextParallelTranspiler
+from .pipeline import PipelineTranspiler
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,

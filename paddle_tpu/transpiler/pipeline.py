@@ -1,0 +1,105 @@
+"""PipelineTranspiler — GPipe pipeline parallelism as a *program
+transformation* on the Program IR.
+
+The 2018 reference has NO pipeline parallelism (SURVEY §2.2 parallelism
+table); its distributed modes are program rewrites
+(distribute_transpiler.py:268), and this transpiler keeps that
+discipline for the TPU-native capability: after transpile, the SAME
+Program a user built for one device trains GPipe-style over a mesh
+"pipe" axis —
+
+  * the user marks stage cuts with ``layers.pipeline_boundary(x)``
+    (identity ops in un-transpiled programs; the later reference
+    generations play this role with device_guard annotations);
+  * the executor's shard_map plane partitions the forward op list at
+    the markers into pp_degree stage sub-programs and runs the GPipe
+    schedule: M microbatches stream through a ``lax.scan`` of ticks,
+    each device runs its own stage (``lax.switch`` on the pipe axis
+    index) and hands its activation to the next stage with
+    ``lax.ppermute``; bubble ticks are masked out of the loss;
+  * the backward schedule comes from differentiating the scan —
+    jax.vjp reverses the ticks and the ppermutes, so each device
+    computes gradients exactly for its own stage's parameters;
+  * per-gradient ``c_allreduce_sum`` over the pipe axis is inserted
+    after the backward (stage gradients are disjoint, so a plain sum —
+    no 1/N — replicates the full gradient on every pipe rank), exactly
+    like the data-parallel rewrite's mechanics.
+
+Composes with DistributeTranspiler (data parallelism): transpile the
+program with both and run with ``Executor(place, mesh=Mesh(devices.
+reshape(dp, pp), ("data", "pipe")))``.  Under the pipeline plane only
+the loss (and persistable state) is fetchable — per-layer activations
+live inside the scan.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.enforce import check_arg
+from ..framework.program import Program
+
+
+class PipelineTranspiler:
+    def __init__(self, axis_name: str = "pipe"):
+        self.axis_name = axis_name
+
+    def transpile(self, program: Program, pp_degree: int,
+                  n_microbatches: Optional[int] = None) -> None:
+        """Rewrite `program` for pp_degree-way GPipe pipelining.
+
+        The program must contain exactly pp_degree - 1
+        ``pipeline_boundary`` marker ops (layers.pipeline_boundary) at
+        shape-homogeneous activation cuts, and a training section
+        (autodiff + optimizer ops from Optimizer.minimize).
+        n_microbatches defaults to pp_degree; the batch dim of every
+        feed must divide by it."""
+        check_arg(pp_degree >= 1,
+                  f"pp_degree must be >= 1, got {pp_degree}")
+        if pp_degree == 1:
+            return                      # degenerate: leave untouched
+        block = program.global_block()
+        markers = [op for op in block.ops
+                   if op.type == "pipeline_boundary"]
+        check_arg(
+            len(markers) == pp_degree - 1,
+            f"pp_degree={pp_degree} needs exactly {pp_degree - 1} "
+            f"pipeline_boundary markers in the program, found "
+            f"{len(markers)} (insert layers.pipeline_boundary at the "
+            f"stage cuts)")
+        # boundary activations are the pipe payload: one static shape
+        shapes = set()
+        for op in markers:
+            v = block.var(op.outputs["Out"][0])
+            if v.shape is not None:
+                shapes.add((tuple(v.shape), str(v.dtype)))
+        check_arg(
+            len(shapes) <= 1,
+            f"pipeline_boundary activations must share one shape/dtype "
+            f"(the ppermute payload); found {sorted(shapes)}")
+        ad_idx = [i for i, op in enumerate(block.ops)
+                  if op.type == "autodiff"]
+        check_arg(ad_idx, "pipeline transpile needs a training program "
+                          "(call Optimizer.minimize first)")
+        idx = ad_idx[0]
+        check_arg(all(block.ops.index(m) < idx for m in markers),
+                  "pipeline_boundary markers must be in the forward "
+                  "section (before the backward)")
+        M = int(n_microbatches or pp_degree)
+        # stage gradients are disjoint: sum over the pipe axis
+        # replicates the full gradient (no 1/N — cf. the dp rewrite,
+        # distribute_transpiler.py _insert_grad_allreduce)
+        grads = list(block.ops[idx].attrs.get("grads", []))
+        insert_at = idx + 1
+        for g in grads:
+            ar = g + "@PP_ALLREDUCE"
+            if not block.has_var(ar):
+                block.create_var(name=ar, dtype="float32")
+            block.append_op("c_allreduce_sum", {"X": [g]}, {"Out": [ar]},
+                            {"axis_name": self.axis_name},
+                            index=insert_at)
+            block.append_op("assign", {"X": [ar]}, {"Out": [g]}, {},
+                            index=insert_at + 1)
+            insert_at += 2
+        program._dist_pp_axis = self.axis_name
+        program._pp_degree = int(pp_degree)
+        program._pp_microbatches = M

@@ -919,6 +919,7 @@ EXEMPT = {
     "delete_var": "documented no-op (XLA owns liveness)",
     "fused_attention": "tests/test_pallas_kernels.py",
     "fused_mha": "tests/test_pallas_kernels.py fused_mha parity/cross/train",
+    "pipeline_boundary": "tests/test_pipeline_parallel.py (identity + GPipe plane)",
     "fused_lm_head_loss": "tests/test_models.py fused-vs-unfused parity",
     "save": "io op — tests/test_reader_trainer.py save/load-as-ops",
     "load": "io op — dedicated test",

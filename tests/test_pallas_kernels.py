@@ -364,3 +364,24 @@ def test_fused_mha_cross_attention_and_training():
                                    fetch_list=[loss])[0]))
           for _ in range(3)]
     assert np.isfinite(ls).all() and ls[-1] < ls[0], ls
+
+
+def test_fused_attention_qkv_layer():
+    """Pre-projected q/k/v surface (layers.fused_attention_qkv) stays
+    alive now that the transformer fused path routes to fused_mha."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("q", [16, 32], dtype="float32")
+        q = layers.fc(x, size=32, num_flatten_dims=2, bias_attr=False)
+        y = layers.fused_attention_qkv(q, q, q, n_head=4, causal=True)
+        loss = layers.mean(layers.square(y))
+        pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    feed = {"q": rng.randn(2, 16, 32).astype("f4")}
+    l1 = float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]))
+    l2 = float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]))
+    assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
