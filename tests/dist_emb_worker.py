@@ -1,16 +1,18 @@
-"""Cross-process sharded-embedding worker — the reference's distributed
-lookup table at PROCESS scope (parameter_prefetch.cc:1: trainers fetch
-rows from the pserver owning them; sparse grads pushed back).
+"""Cross-process sharded-embedding worker ON THE PROGRAM PLANE — the
+reference's distributed lookup table (parameter_prefetch.cc:1: trainers
+fetch rows from the pserver owning them; sparse grads pushed back) as a
+user-facing Program: DeepFM built with its embedding Parameter carrying
+``ParamAttr(sharding=("model", None))``, trained via
+``Executor(mesh=...)`` over a cross-process "model" axis.  XLA GSPMD
+serves the rows and routes the scatter-add gradients across processes —
+no direct shard_map/collective calls in user code.
 
 Run:  python tests/dist_emb_worker.py <coordinator> <world> <rank> <out>
 
-The [V, D] table is row-sharded over a cross-process "model" mesh axis
-(world processes x 1 CPU device).  Every step: masked-gather + psum
-lookup (rows served by their owning rank over the collective fabric, the
-RPC-prefetch equivalent), then a SelectedRows-style sparse scatter-add
-update of each rank's own shard.  The worker reports its LOCAL shard
-after 3 steps; the test reassembles the table and checks it against a
-host numpy reference.
+Each rank reports per-step losses and the |.|-sum of its LOCAL table
+shard; the test checks loss parity against a single-process run of the
+identical program and that the disjoint shard sums add up to the
+single-process table's total.
 """
 import json
 import os
@@ -21,82 +23,71 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 import numpy as np
 
-V, D, B, F = 16, 4, 4, 2
-LR, STEPS = 0.1, 3
+SEED = 11
+STEPS = 3
+BATCH = 8
 
 
-def make_ids(step):
-    rng = np.random.RandomState(100 + step)
-    return rng.randint(0, V, (B, F)).astype("int32")
+def build_program(pt, models):
+    pt.reset_default_programs()
+    main = pt.default_main_program()
+    startup = pt.default_startup_program()
+    main.random_seed = SEED
+    startup.random_seed = SEED
+    cfg = models.deepfm.DeepFMConfig(
+        num_field=6, vocab_size=80, embed_dim=4, fc_sizes=(16,),
+        sparse_shard_axis="model")
+    feeds, avg_cost, prob = models.deepfm.build_train_net(cfg)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    return main, startup, avg_cost, cfg
 
 
-def init_table():
-    rng = np.random.RandomState(7)
-    return rng.randn(V, D).astype("float32")
+def sharded_param_names(main):
+    """ALL row-sharded tables (DeepFM has two: the [V,1] first-order
+    weights fm_w1 and the [V,K] embedding fm_emb)."""
+    names = [p.name for p in main.all_parameters()
+             if getattr(p, "sharding", None)
+             and p.sharding[0] == "model"]
+    assert len(names) == 2, names
+    return names
 
 
-def reference():
-    """Host numpy ground truth of the training loop."""
-    table = init_table()
+def train_steps(models, exe, main, loss, cfg):
+    feed = models.deepfm.make_fake_batch(cfg, BATCH)
     losses = []
-    for s in range(STEPS):
-        ids = make_ids(s)
-        rows = table[ids]                        # [B, F, D]
-        losses.append(float(0.5 * np.sum(rows ** 2)))
-        np.add.at(table, ids.reshape(-1),
-                  -LR * rows.reshape(-1, D))     # duplicate ids accumulate
-    return table, losses
+    for _ in range(STEPS):
+        out, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.mean(np.asarray(out))))
+    return losses
 
 
 def main():
     coordinator, world, rank, out_path = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
     import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
 
+    import paddle_tpu as pt
+    from paddle_tpu import models
     from paddle_tpu.parallel import env as penv
-    from paddle_tpu.parallel.sharded_embedding import (
-        row_sharded_lookup, sparse_scatter_update)
 
     ok = penv.init_distributed_env(coordinator_address=coordinator,
                                    num_processes=world, process_id=rank)
     assert ok and jax.process_count() == world
+
+    main_p, startup, loss, cfg = build_program(pt, models)
     devices = np.array(jax.devices()[:world]).reshape(1, world)
     mesh = Mesh(devices, ("data", "model"))
+    exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe.run(startup)
+    losses = train_steps(models, exe, main_p, loss, cfg)
 
-    table_np = init_table()
-    table = jax.make_array_from_callback(
-        (V, D), NamedSharding(mesh, P("model", None)),
-        lambda idx: table_np[idx])
-
-    def device_step(local_table, ids):
-        rows = row_sharded_lookup(local_table, ids, "model")
-        loss = 0.5 * jnp.sum(rows ** 2)          # d(loss)/d(rows) = rows
-        new_table = sparse_scatter_update(
-            local_table, ids, rows, LR, axis_name="model",
-            data_axis="data")
-        return new_table, lax.psum(loss, "data")
-
-    step = jax.jit(jax.shard_map(
-        device_step, mesh=mesh,
-        in_specs=(P("model", None), P("data", None)),
-        out_specs=(P("model", None), P()), check_vma=False))
-
-    losses = []
-    for s in range(STEPS):
-        ids_np = make_ids(s)
-        ids = jax.make_array_from_callback(
-            ids_np.shape, NamedSharding(mesh, P("data", None)),
-            lambda idx: ids_np[idx])
-        table, loss = step(table, ids)
-        losses.append(float(jax.block_until_ready(loss)))
-
-    shard = np.asarray(table.addressable_data(0))
-    result = {"rank": rank, "losses": losses,
-              "shard": shard.tolist(),
-              "rows_per_rank": V // world}
+    shards = {}
+    for wname in sharded_param_names(main_p):
+        table = exe.scope.find_var(wname)
+        # THIS rank's rows — the test reassembles the full tables
+        shards[wname] = np.asarray(table.addressable_data(0)).tolist()
+    result = {"rank": rank, "losses": losses, "shards": shards}
     with open(out_path, "w") as f:
         json.dump(result, f)
     print("EMB_WORKER_OK", rank)

@@ -139,20 +139,37 @@ def test_embedding_is_sparse_attr_recorded():
 
 
 def test_sharded_table_across_two_processes(tmp_path):
-    """The distributed-lookup-table capability at PROCESS scope
-    (parameter_prefetch.cc:1): 2 spawned processes, table row-sharded
-    over a cross-process mesh axis, rows served by owner via psum and
-    sparse-updated from both — final table matches the numpy reference."""
+    """The distributed-lookup-table capability at PROCESS scope, on the
+    PROGRAM plane (parameter_prefetch.cc:1): 2 spawned processes build
+    the DeepFM Program with ParamAttr(sharding=("model", None)) and
+    train via Executor(mesh=...) — loss parity vs a single-process run
+    of the identical program, and the ranks' disjoint table shards add
+    up to the single-process table."""
     import dist_emb_worker
     from dist_harness import spawn_workers
 
     results = spawn_workers("dist_emb_worker.py", world=2,
                             tmp_path=tmp_path)
-    ref_table, ref_losses = dist_emb_worker.reference()
+
+    # single-process ground truth: the identical seeded program
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    main, startup, loss, cfg = dist_emb_worker.build_program(pt, models)
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe.run(startup)
+    ref_losses = dist_emb_worker.train_steps(models, exe, main, loss,
+                                             cfg)
+
     for r in results:
         np.testing.assert_allclose(r["losses"], ref_losses,
                                    rtol=1e-4, atol=1e-5)
-    rebuilt = np.concatenate(
-        [np.asarray(r["shard"], "f4") for r in results], axis=0)
-    assert rebuilt.shape == ref_table.shape
-    np.testing.assert_allclose(rebuilt, ref_table, rtol=1e-4, atol=1e-5)
+    # reassemble BOTH row-sharded tables (fm_w1 [V,1] and fm_emb [V,K])
+    # from the ranks' disjoint shards and compare elementwise
+    for wname in dist_emb_worker.sharded_param_names(main):
+        ref_table = np.asarray(exe.scope.find_var(wname))
+        rebuilt = np.concatenate(
+            [np.asarray(r["shards"][wname], "f4") for r in results],
+            axis=0)
+        assert rebuilt.shape == ref_table.shape
+        np.testing.assert_allclose(rebuilt, ref_table, rtol=1e-4,
+                                   atol=1e-5, err_msg=wname)
