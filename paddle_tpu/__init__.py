@@ -36,6 +36,8 @@ from . import nets
 from . import reader
 from . import dataset
 from . import transpiler
+from . import contrib
+from . import debugger
 from . import imperative
 from . import inference
 from . import distributed

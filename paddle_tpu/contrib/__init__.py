@@ -1,0 +1,6 @@
+"""Contrib utilities (the reference's python/paddle/fluid/contrib tier:
+memory_usage_calc.py, op_frequence.py)."""
+from .memory_usage_calc import memory_usage
+from .op_frequence import op_freq_statistic
+
+__all__ = ["memory_usage", "op_freq_statistic"]

@@ -1,0 +1,31 @@
+"""Op-frequency histogram over a Program (ref
+python/paddle/fluid/contrib/op_frequence.py:1)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..framework.program import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Counts of each op type and of each adjacent op pair, most
+    frequent first.  Returns (uni_op_freq, adj_2_op_freq) OrderedDicts
+    — the reference's contract."""
+    if not isinstance(program, Program):
+        raise TypeError(f"The input type should be Program, got "
+                        f"{type(program)}")
+    uni: "OrderedDict[str, int]" = OrderedDict()
+    adj: "OrderedDict[str, int]" = OrderedDict()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = f"{prev}->{op.type}"
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    uni = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni, adj

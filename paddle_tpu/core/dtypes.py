@@ -48,9 +48,22 @@ def convert_dtype(dtype) -> str:
     return _CANONICAL[name]
 
 
+# 64-bit names lowered on the x32 plane (TPUs have no i64/f64 compute)
+_X32_LOWER = {"int64": "int32", "float64": "float32"}
+
+
 def to_jnp_dtype(dtype):
-    """Framework/any dtype -> jnp dtype object."""
-    return _DTYPE_MAP[convert_dtype(dtype)]
+    """Framework/any dtype -> jnp dtype object, honoring the x32 plane:
+    when jax runs without 64-bit enabled (the default), 64-bit requests
+    lower to their 32-bit counterparts HERE rather than letting every
+    jnp call emit its "requested dtype int64 ... truncated to int32"
+    UserWarning — the end dtype is identical, the warning noise is not
+    (round-3 Weak #8)."""
+    name = convert_dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64:
+        name = _X32_LOWER.get(name, name)
+    return _DTYPE_MAP[name]
 
 
 def is_floating(dtype) -> bool:

@@ -1,0 +1,102 @@
+"""Program debugging / visualization helpers.
+
+Capability parity with the reference's
+python/paddle/fluid/debugger.py:118 (draw_block_graphviz via the
+graphviz.py DOT builder) and its pprint_program_codes program printer —
+re-designed for the Program IR here: plain DOT text emission (no
+external graphviz python package; render with `dot -Tpng`).
+"""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from .framework.program import Parameter, Program
+
+__all__ = ["draw_block_graphviz", "pprint_program_codes"]
+
+
+def _dot_escape(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def draw_block_graphviz(block, highlights: Optional[Set[str]] = None,
+                        path: str = "./temp.dot",
+                        show_backward: bool = False) -> str:
+    """Write the block's dataflow graph as a DOT file (ref
+    debugger.py:118).  Ops are boxes, vars are ellipses (Parameters
+    shaded), edges follow input/output names; names in `highlights`
+    are drawn red.  Returns the path."""
+    highlights = highlights or set()
+
+    def is_grad(name: str) -> bool:
+        return "@GRAD" in name
+
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids: dict = {}        # name -> stable sequential id
+
+    def var_node(name: str) -> str:
+        if name not in var_ids:
+            var_ids[name] = f"var_{len(var_ids)}"
+            nid = var_ids[name]
+            v = block.vars.get(name)
+            shape = getattr(v, "shape", None) if v is not None else None
+            label = _dot_escape(
+                f"{name}\\n{list(shape)}" if shape is not None else name)
+            style = []
+            if isinstance(v, Parameter):
+                style.append('style=filled fillcolor="lightgrey"')
+            if name in highlights:
+                style.append('color="red"')
+            lines.append(f'  {nid} [label="{label}" shape=ellipse '
+                         + " ".join(style) + "];")
+        return var_ids[name]
+
+    for i, op in enumerate(block.ops):
+        names = [n for ns in list(op.inputs.values())
+                 + list(op.outputs.values()) for n in ns]
+        if not show_backward and (op.type.endswith("_grad")
+                                  or any(is_grad(n) for n in names)):
+            continue
+        op_id = f"op_{i}"
+        color = ' color="red"' if op.type in highlights else ""
+        lines.append(f'  {op_id} [label="{_dot_escape(op.type)}" '
+                     f'shape=box style=rounded{color}];')
+        for ns in op.inputs.values():
+            for n in ns:
+                lines.append(f"  {var_node(n)} -> {op_id};")
+        for ns in op.outputs.values():
+            for n in ns:
+                if n:
+                    lines.append(f"  {op_id} -> {var_node(n)};")
+    lines.append("}")
+    dot = "\n".join(lines) + "\n"
+    with open(path, "w") as f:
+        f.write(dot)
+    return path
+
+
+def pprint_program_codes(program: Program,
+                         show_backward: bool = False) -> str:
+    """Human-readable pseudo-code of every block (ref debugger.py
+    pprint_program_codes): one `out = op_type(in, ...) {attrs}` line per
+    op."""
+    reprs = []
+    for block in program.blocks:
+        lines = [f"// block {block.idx} (parent {block.parent_idx})"]
+        for op in block.ops:
+            names = [n for ns in list(op.inputs.values())
+                     + list(op.outputs.values()) for n in ns]
+            if not show_backward and (op.type.endswith("_grad")
+                                      or any("@GRAD" in n
+                                             for n in names)):
+                continue
+            outs = ", ".join(n for ns in op.outputs.values()
+                             for n in ns if n) or "_"
+            ins = ", ".join(f"{slot}={list(ns)}"
+                            for slot, ns in op.inputs.items() if ns)
+            attrs = {k: v for k, v in op.attrs.items()
+                     if not k.startswith("_")}
+            lines.append(f"{outs} = {op.type}({ins})"
+                         + (f"  # {attrs}" if attrs else ""))
+        reprs.append("\n".join(lines))
+    return "\n\n".join(reprs)

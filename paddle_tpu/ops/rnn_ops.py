@@ -54,7 +54,10 @@ def _lstm(ctx, ins, attrs):
         xt_seq = xt_seq[::-1]
     mask_seq = None
     if mask is not None:
-        mask_seq = jnp.swapaxes(mask, 0, 1)[..., None]  # [T,B,1]
+        # cast to the activation dtype: a f32 mask would promote the
+        # bf16 blend under AMP and flip the scan carry dtype (a scan
+        # type error at trace time)
+        mask_seq = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
         if reverse:
             mask_seq = mask_seq[::-1]
 
@@ -109,7 +112,8 @@ def _gru(ctx, ins, attrs):
         xt_seq = xt_seq[::-1]
     mask_seq = None
     if mask is not None:
-        mask_seq = jnp.swapaxes(mask, 0, 1)[..., None]
+        # see dynamic_lstm: keep the mask in the activation dtype
+        mask_seq = jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
         if reverse:
             mask_seq = mask_seq[::-1]
 

@@ -41,9 +41,9 @@ def _sequence_mask(ctx, ins, attrs):
         raise ValueError("sequence_mask needs a static maxlen attr on TPU")
     out = (jnp.arange(maxlen)[None, :] <
            length.reshape(-1, 1)).astype(jnp.int32)
-    out_dtype = attrs.get("out_dtype", "int64")
+    # to_jnp_dtype lowers int64 on the x32 plane itself (core/dtypes.py)
     from ..core.dtypes import to_jnp_dtype
-    dt = index_dtype() if out_dtype == "int64" else to_jnp_dtype(out_dtype)
+    dt = to_jnp_dtype(attrs.get("out_dtype", "int64"))
     return {"Y": [out.astype(dt)]}
 
 

@@ -1,0 +1,89 @@
+"""Observability tail (ref debugger.py:118 draw_block_graphviz,
+contrib/memory_usage_calc.py, contrib/op_frequence.py) + the x32 plane
+staying warning-free."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _small_program():
+    pt.reset_default_programs()
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    h = layers.fc(x, size=8, act="relu")
+    p = layers.fc(h, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(p, y))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    return pt.default_main_program(), loss
+
+
+def test_draw_block_graphviz(tmp_path):
+    main, _ = _small_program()
+    path = str(tmp_path / "g.dot")
+    out = pt.debugger.draw_block_graphviz(main.global_block(), path=path)
+    dot = open(out).read()
+    assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+    assert "shape=box" in dot and "shape=ellipse" in dot
+    assert "cross_entropy" in dot
+    # parameters are shaded; backward hidden by default
+    assert "fillcolor" in dot
+    assert "@GRAD" not in dot
+    full = pt.debugger.draw_block_graphviz(
+        main.global_block(), path=str(tmp_path / "g2.dot"),
+        show_backward=True)
+    assert "autodiff" in open(full).read()
+
+
+def test_pprint_program_codes():
+    main, _ = _small_program()
+    txt = pt.debugger.pprint_program_codes(main)
+    assert "// block 0" in txt
+    assert "mul(" in txt and "cross_entropy(" in txt
+    assert "@GRAD" not in txt
+    assert "@GRAD" in pt.debugger.pprint_program_codes(
+        main, show_backward=True)
+
+
+def test_memory_usage():
+    main, _ = _small_program()
+    lo8, hi8, unit8 = pt.contrib.memory_usage(main, batch_size=8)
+    lo64, hi64, unit64 = pt.contrib.memory_usage(main, batch_size=64)
+    assert 0 < lo8 <= hi8
+    # persistable floor is batch-independent; activations grow with B
+    scale = {"B": 1, "KB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30}
+    assert lo8 * scale[unit8] == lo64 * scale[unit64]
+    assert hi64 * scale[unit64] > hi8 * scale[unit8]
+    with pytest.raises(ValueError):
+        pt.contrib.memory_usage(main, batch_size=0)
+
+
+def test_op_freq_statistic():
+    main, _ = _small_program()
+    uni, adj = pt.contrib.op_freq_statistic(main)
+    assert uni["mul"] >= 2 and uni["sgd"] >= 4
+    counts = list(uni.values())
+    assert counts == sorted(counts, reverse=True)
+    assert any("->" in k for k in adj)
+    with pytest.raises(TypeError):
+        pt.contrib.op_freq_statistic("not a program")
+
+
+def test_x32_plane_emits_no_truncation_warnings():
+    """int64 program dtypes lower to int32 at the dtype plane (x32);
+    jax must not warn on every op (round-3 Weak #8)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        pt.reset_default_programs()
+        ids = layers.data("ids", [4], dtype="int64")
+        emb = layers.embedding(ids, size=[16, 4])
+        loss = layers.mean(emb)
+        exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+        exe.run(pt.default_startup_program())
+        out, = exe.run(pt.default_main_program(),
+                       feed={"ids": np.zeros((2, 4), "int64")},
+                       fetch_list=[loss])
+        assert np.isfinite(float(out))

@@ -243,3 +243,34 @@ def test_stacked_lstm_model_trains():
         losses.append(float(out))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_dynamic_lstm_gru_under_amp():
+    """AMP regression: a f32 mask used to promote the bf16 scan carry
+    and break tracing (scan carry dtype mismatch); the mask is now cast
+    to the activation dtype."""
+    from paddle_tpu.core import flags
+    flags.set_flag("amp_bf16", True)
+    try:
+        pt.reset_default_programs()
+        words = layers.data("words", [8], dtype="int64")
+        mask = layers.data("mask", [8], dtype="float32")
+        emb = layers.embedding(words, size=[30, 8])
+        proj = layers.fc(emb, size=32, num_flatten_dims=2,
+                         bias_attr=False)
+        h, _ = layers.dynamic_lstm(proj, size=32, mask=mask)
+        assert h.shape is not None          # shape inference survived
+        proj2 = layers.fc(emb, size=24, num_flatten_dims=2,
+                          bias_attr=False)
+        g = layers.dynamic_gru(proj2, size=8, mask=mask)
+        loss = layers.mean(h) + layers.mean(g)
+        exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+        exe.run(pt.default_startup_program())
+        rng = np.random.RandomState(0)
+        out, = exe.run(pt.default_main_program(),
+                       feed={"words": rng.randint(0, 30, (2, 8)),
+                             "mask": np.ones((2, 8), "f4")},
+                       fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(out).ravel()[0]))
+    finally:
+        flags.set_flag("amp_bf16", False)
