@@ -111,13 +111,14 @@ static PyObject* bridge() {
   return PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
 }
 
-ptpu_predictor* ptpu_predictor_create(const char* model_dir,
-                                      const char* device) {
+static ptpu_predictor* create_with_method(const char* method,
+                                          const char* model_dir,
+                                          const char* device) {
   PyGILState_STATE gil = PyGILState_Ensure();
   ptpu_predictor* handle = nullptr;
   PyObject* mod = bridge();
   if (mod != nullptr) {
-    PyObject* pid = PyObject_CallMethod(mod, "create", "ss", model_dir,
+    PyObject* pid = PyObject_CallMethod(mod, method, "ss", model_dir,
                                         device ? device : "cpu");
     if (pid != nullptr) {
       handle = new ptpu_predictor{PyLong_AsLong(pid)};
@@ -133,12 +134,27 @@ ptpu_predictor* ptpu_predictor_create(const char* model_dir,
   return handle;
 }
 
+ptpu_predictor* ptpu_predictor_create(const char* model_dir,
+                                      const char* device) {
+  return create_with_method("create", model_dir, device);
+}
+
+// TRAINING entry: load a saved train program pair
+// (io.save_train_program: startup_program.json + main_program.json),
+// run the startup program — the reference's pure-C++ train path
+// (train/demo/demo_trainer.cc).  Step with ptpu_trainer_run.
+ptpu_predictor* ptpu_trainer_create(const char* model_dir,
+                                    const char* device) {
+  return create_with_method("create_trainer", model_dir, device);
+}
+
 // Returns the TRUE number of program outputs, or -1 on error.  Only the
 // first min(count, max_out) entries of `outs` are written, so a caller
 // seeing a return value > max_out knows outputs were dropped and can
 // retry with a larger array.  Iterate min(ret, max_out) entries.
-int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
-                       ptpu_out_tensor* outs, int max_out) {
+static int run_with_method(const char* method, ptpu_predictor* h,
+                           const ptpu_tensor* ins, int n_in,
+                           ptpu_out_tensor* outs, int max_out) {
   PyGILState_STATE gil = PyGILState_Ensure();
   g_last_error.clear();
   int n_out = -1;
@@ -165,8 +181,8 @@ int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
           PyBytes_FromStringAndSize(static_cast<const char*>(ins[i].data),
                                     static_cast<Py_ssize_t>(ins[i].nbytes)));
     }
-    result = PyObject_CallMethod(mod, "run", "lOOOO", h->pid, names, dtypes,
-                                 shapes, buffers);
+    result = PyObject_CallMethod(mod, method, "lOOOO", h->pid, names,
+                                 dtypes, shapes, buffers);
     if (result == nullptr) break;
     Py_ssize_t n_total = PyList_Size(result);
     Py_ssize_t n = n_total > max_out ? max_out : n_total;
@@ -218,6 +234,19 @@ int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
   return n_out;
 }
 
+int ptpu_predictor_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
+                       ptpu_out_tensor* outs, int max_out) {
+  return run_with_method("run", h, ins, n_in, outs, max_out);
+}
+
+// One TRAINING step: feed the batch, run forward+backward+optimizer,
+// fetch the loss (outs[0]).  Returns the output count like
+// ptpu_predictor_run.
+int ptpu_trainer_run(ptpu_predictor* h, const ptpu_tensor* ins, int n_in,
+                     ptpu_out_tensor* outs, int max_out) {
+  return run_with_method("train_run", h, ins, n_in, outs, max_out);
+}
+
 void ptpu_out_tensor_free(ptpu_out_tensor* t) {
   if (t != nullptr && t->data != nullptr) {
     std::free(t->data);
@@ -226,17 +255,25 @@ void ptpu_out_tensor_free(ptpu_out_tensor* t) {
   }
 }
 
-void ptpu_predictor_destroy(ptpu_predictor* h) {
+static void destroy_with_method(const char* method, ptpu_predictor* h) {
   if (h == nullptr) return;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* mod = bridge();
   if (mod != nullptr) {
-    PyObject* r = PyObject_CallMethod(mod, "destroy", "l", h->pid);
+    PyObject* r = PyObject_CallMethod(mod, method, "l", h->pid);
     Py_XDECREF(r);
     Py_DECREF(mod);
   }
   PyGILState_Release(gil);
   delete h;
+}
+
+void ptpu_predictor_destroy(ptpu_predictor* h) {
+  destroy_with_method("destroy", h);
+}
+
+void ptpu_trainer_destroy(ptpu_predictor* h) {
+  destroy_with_method("destroy_trainer", h);
 }
 
 }  // extern "C"

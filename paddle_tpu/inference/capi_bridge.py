@@ -31,18 +31,23 @@ def create(model_dir: str, device: str = "cpu") -> int:
     return pid
 
 
+def _decode_feeds(names, dtypes, shapes, buffers):
+    """Raw little-endian C buffers -> named numpy feeds (shared by the
+    inference and training entries so both parse the ABI identically)."""
+    feeds = {}
+    for name, dt, shape, buf in zip(names, dtypes, shapes, buffers):
+        feeds[name] = np.frombuffer(buf, dtype=_DTYPES[int(dt)]).reshape(
+            [int(s) for s in shape])
+    return feeds
+
+
 def run(pid: int, names: Sequence[str], dtypes: Sequence[int],
         shapes: Sequence[Sequence[int]], buffers: Sequence[bytes]
         ) -> List[Tuple[str, int, Tuple[int, ...], bytes]]:
     """One inference call.  Inputs as raw little-endian buffers; outputs
     the same way: [(name, dtype_code, shape, bytes), ...]."""
     pred = _predictors[pid]
-    feeds = {}
-    for name, dt, shape, buf in zip(names, dtypes, shapes, buffers):
-        arr = np.frombuffer(buf, dtype=_DTYPES[int(dt)]).reshape(
-            [int(s) for s in shape])
-        feeds[name] = arr
-    outs = pred.run(feeds)
+    outs = pred.run(_decode_feeds(names, dtypes, shapes, buffers))
     result = []
     for name, arr in zip(pred.fetch_names, outs):
         arr = np.ascontiguousarray(arr)
@@ -56,3 +61,58 @@ def run(pid: int, names: Sequence[str], dtypes: Sequence[int],
 
 def destroy(pid: int) -> None:
     _predictors.pop(pid, None)
+
+
+# ---------------------------------------------------------------------------
+# C TRAINING ABI (native train entry — the reference can train from pure
+# C++ via a saved program: train/demo/demo_trainer.cc:1 loads
+# startup/main ProgramDescs and steps the Executor.  Same capability
+# here over the Program JSON serde.)
+# ---------------------------------------------------------------------------
+
+_trainers: Dict[int, tuple] = {}
+
+
+def create_trainer(model_dir: str, device: str = "cpu") -> int:
+    """Load `<dir>/startup_program.json` + `<dir>/main_program.json`
+    (io.save_train_program), locate the loss like the reference demo
+    (first `mean` op's output), run the startup program; returns a
+    handle id."""
+    import json
+    import os
+
+    import paddle_tpu as pt
+    with open(os.path.join(model_dir, "startup_program.json")) as f:
+        startup = pt.Program.from_dict(json.load(f))
+    with open(os.path.join(model_dir, "main_program.json")) as f:
+        main = pt.Program.from_dict(json.load(f))
+    loss_name = None
+    for op in main.global_block().ops:
+        if op.type == "mean":
+            loss_name = op.outputs["Out"][0]
+            break
+    if loss_name is None:
+        raise ValueError("cannot locate the loss: no `mean` op in the "
+                         "main program (demo_trainer.cc contract)")
+    place = pt.TPUPlace(0) if device == "tpu" else pt.CPUPlace()
+    exe = pt.Executor(place, scope=pt.Scope())
+    exe.run(startup)
+    pid = next(_next_id)
+    _trainers[pid] = (exe, main, loss_name)
+    return pid
+
+
+def train_run(pid: int, names: Sequence[str], dtypes: Sequence[int],
+              shapes: Sequence[Sequence[int]], buffers: Sequence[bytes]
+              ) -> List[Tuple[str, int, Tuple[int, ...], bytes]]:
+    """One training step: feed the batch, run forward+backward+update,
+    return [(loss_name, dtype, shape, bytes)]."""
+    exe, main, loss_name = _trainers[pid]
+    feeds = _decode_feeds(names, dtypes, shapes, buffers)
+    out, = exe.run(main, feed=feeds, fetch_list=[loss_name])
+    arr = np.ascontiguousarray(np.asarray(out, dtype=np.float32))
+    return [(loss_name, 0, tuple(arr.shape), arr.tobytes())]
+
+
+def destroy_trainer(pid: int) -> None:
+    _trainers.pop(pid, None)

@@ -104,6 +104,25 @@ def load_persistables(executor, dirname, main_program=None,
 load_params = load_persistables
 
 
+def save_train_program(dirname: str,
+                       main_program: Optional[Program] = None,
+                       startup_program: Optional[Program] = None):
+    """Save the TRAIN programs (startup + main with backward/optimizer
+    ops) as JSON for the native C training entry — the reference's
+    saved-ProgramDesc train path (train/demo/demo_trainer.cc:1 loads
+    `startup_program` / `main_program` files and steps the Executor
+    from pure C++)."""
+    from .framework.program import default_startup_program
+    main = main_program or default_main_program()
+    startup = startup_program or default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "main_program.json"), "w") as f:
+        json.dump(main.to_dict(), f)
+    with open(os.path.join(dirname, "startup_program.json"), "w") as f:
+        json.dump(startup.to_dict(), f)
+    return dirname
+
+
 def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                          target_vars: Sequence[Variable],
                          executor: Executor,

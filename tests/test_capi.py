@@ -54,3 +54,42 @@ def test_c_abi_serves_saved_model(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     assert "C-ABI OK: 1 outputs" in r.stdout
     assert "shape=[2,1]" in r.stdout
+
+
+TRAIN_DEMO = os.path.join(REPO, "paddle_tpu", "fast", "train_demo")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None
+                    or shutil.which("python3-config") is None,
+                    reason="native toolchain unavailable")
+def test_c_abi_trains_saved_program(tmp_path):
+    """Pure-C++ TRAINING through the C ABI (the reference's
+    train/demo/demo_trainer.cc capability): save the fit_a_line TRAIN
+    program pair, the C++ demo loads it, steps 10 times, and its loss
+    decreases."""
+    r = subprocess.run(["make", "capi", "traindemo"],
+                       cwd=os.path.join(REPO, "native"),
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    pt.reset_default_programs()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        feeds, loss, pred = book.fit_a_line(x_dim=13)
+        pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    model_dir = str(tmp_path / "train_model")
+    pt.io.save_train_program(model_dir, main_program=main,
+                             startup_program=startup)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    site_pkgs = next(p for p in sys.path if p.endswith("site-packages"))
+    r = subprocess.run([TRAIN_DEMO, model_dir, f"{site_pkgs}:{REPO}"],
+                       capture_output=True, text=True, env=env, cwd=REPO,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-2000:])
+    assert "TRAIN_DEMO_OK" in r.stdout
+    lines = [l for l in r.stdout.splitlines() if l.startswith("step:")]
+    assert len(lines) == 10
+    losses = [float(l.split("loss:")[1]) for l in lines]
+    assert losses[-1] < losses[0]
