@@ -11,7 +11,8 @@ from .config_base import Layer
 __all__ = ["data", "fc", "embedding", "concat", "dropout",
            "classification_cost", "square_error_cost", "cross_entropy_cost",
            "img_conv", "img_pool", "batch_norm", "max_id",
-           "sequence_pool"]
+           "sequence_pool", "lstmemory", "memory", "recurrent_group",
+           "last_seq", "first_seq"]
 
 
 def _fluid_layers():
@@ -59,6 +60,24 @@ def _mask_of(ctx, lay):
     return ctx.get(("mask", lay.name))
 
 
+def _seq_mask(ctx, node):
+    """Resolve the pad mask of the sequence `node` descends from: BFS
+    over ALL parents to the originating sequence data layer (single
+    shared implementation — every sequence layer uses this)."""
+    seen, queue = set(), [node]
+    while queue:
+        n = queue.pop(0)
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if getattr(n, "type", None) is not None:
+            m = _mask_of(ctx, n)
+            if m is not None:
+                return m
+        queue.extend(n.parents)
+    return None
+
+
 def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
        **_):
     inputs = input if isinstance(input, (list, tuple)) else [input]
@@ -66,11 +85,10 @@ def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
     def build(ctx):
         fl = _fluid_layers()
         vs = [i.to_var(ctx) for i in inputs]
-        return fl.fc(vs if len(vs) > 1 else vs[0], size=size,
-                     act=act_name(act), name=name,
-                     param_attr=getattr(param_attr, "to_fluid",
-                                        lambda: param_attr)(),
-                     bias_attr=bias_attr)
+        return _rank_aware_fc(fl, vs, size, act_name(act), name,
+                              getattr(param_attr, "to_fluid",
+                                      lambda: param_attr)(),
+                              bias_attr)
 
     return Layer(build, inputs, name=name)
 
@@ -142,10 +160,7 @@ def sequence_pool(input, pool_type=None, name=None, **_):
     def build(ctx):
         fl = _fluid_layers()
         v = input.to_var(ctx)
-        src = input
-        while src.parents and getattr(src, "type", None) is None:
-            src = src.parents[0]
-        mask = _mask_of(ctx, src)
+        mask = _seq_mask(ctx, input)
         ptype = "sum" if pool_type is None else pool_type.name
         return fl.sequence_pool(v, pool_type=ptype, mask=mask)
 
@@ -183,3 +198,167 @@ def square_error_cost(input, label, name=None, **_):
 
 def cross_entropy_cost(input, label, name=None, **_):
     return classification_cost(input, label, name=name)
+
+
+def _rank_aware_fc(fl, vs, size, act, name, param_attr, bias_attr):
+    """v2 fc applies per-timestep on sequence ([B, T, D]) inputs.
+    Mixed-rank input lists are rejected: fl.fc shares one
+    num_flatten_dims across inputs, which would silently
+    mis-parameterize the lower-rank ones."""
+    ranks = {len(v.shape or ()) for v in vs}
+    if len(ranks) > 1:
+        raise ValueError(
+            f"v2 fc inputs must share rank, got shapes "
+            f"{[tuple(v.shape or ()) for v in vs]}; pool or expand the "
+            f"sequence inputs first")
+    flat = 2 if ranks == {3} else 1
+    return fl.fc(vs if len(vs) > 1 else vs[0], size=size,
+                 num_flatten_dims=flat, act=act, name=name,
+                 param_attr=param_attr, bias_attr=bias_attr)
+
+
+def lstmemory(input, size=None, reverse=False, act=None, gate_act=None,
+              state_act=None, name=None, **_):
+    """LSTM over a PRE-PROJECTED [B, T, 4H] sequence (ref
+    trainer_config_helpers/layers.py:1497 lstmemory: the x->4H matrix
+    projection lives in the caller, cf. simple_lstm).  Returns the
+    hidden sequence [B, T, H]; the pad mask rides the dense+mask
+    plane."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        width = int(v.shape[-1])
+        if width % 4:
+            raise ValueError(f"lstmemory input width {width} must be "
+                             f"4*H (pre-projected; cf. simple_lstm)")
+        if size is not None and width != 4 * size:
+            raise ValueError(f"lstmemory size={size} expects a "
+                             f"[B, T, {4*size}] pre-projected input, "
+                             f"got width {width}")
+        mask = _seq_mask(ctx, input)
+        h, _ = fl.dynamic_lstm(
+            v, size=width, mask=mask, is_reverse=reverse,
+            gate_activation=act_name(gate_act) or "sigmoid",
+            cell_activation=act_name(state_act) or "tanh",
+            candidate_activation=act_name(act) or "tanh")
+        return h
+
+    return Layer(build, [input], name=name)
+
+
+def memory(name, size, **_):
+    """Recurrent state inside a recurrent_group step (ref layers.py
+    memory): reads the previous step's output of the layer called
+    `name`.  Only valid inside recurrent_group."""
+    def build(ctx):
+        rnn = ctx.get("__rnn__")
+        if rnn is None:
+            raise ValueError("paddle.layer.memory is only valid inside "
+                             "a recurrent_group step")
+        key = ("rnn_mem", name)
+        if key not in ctx:
+            fl = _fluid_layers()
+            # the zero init is carry state: it must live in the PARENT
+            # block (the scan op reads it before stepping)
+            prog = rnn.program
+            cur = prog._current_block_idx
+            prog._current_block_idx = rnn._parent_idx
+            try:
+                init = fl.fill_constant_batch_size_like(
+                    ctx["__rnn_ref_outer__"], shape=[-1, size],
+                    dtype="float32", value=0.0)
+            finally:
+                prog._current_block_idx = cur
+            ctx[key] = rnn.memory(init=init)
+        return ctx[key]
+
+    node = Layer(build, [], name=name)
+    node._is_memory = True
+    node._mem_size = size
+    return node
+
+
+def recurrent_group(step, input, reverse=False, name=None, **_):
+    """Run `step` (a python fn over v2 layer nodes) once per timestep
+    (ref layers.py:4161 recurrent_group / StaticRNN).  `input` is a
+    sequence node ([B, T, D]); the step receives the per-timestep
+    [B, D] node.  A step layer whose name matches a `memory(name=...)`
+    node becomes the carried state.  Returns the [B, T, H] output
+    sequence."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx):
+        fl = _fluid_layers()
+        outer = [i.to_var(ctx) for i in inputs]
+        lengths = None
+        if reverse:
+            # length-aware reverse: a plain flip would put the PAD steps
+            # first and contaminate the carried state before the real
+            # tokens arrive
+            mask = _seq_mask(ctx, inputs[0])
+            if mask is not None:
+                lengths = fl.cast(fl.reduce_sum(mask, dim=1), "int32")
+            outer = [fl.sequence_reverse(v, length=lengths)
+                     for v in outer]
+        rnn = fl.StaticRNN()
+        with rnn.step():
+            sub = dict(ctx)
+            sub["__rnn__"] = rnn
+            sub["__rnn_ref_outer__"] = outer[0]
+            step_nodes = []
+            for v in outer:
+                n = Layer(lambda c, vv=v: None, [])
+                xt = rnn.step_input(v)
+                sub[id(n)] = xt
+                step_nodes.append(n)
+            out_node = step(*step_nodes)
+            out_var = out_node.to_var(sub)
+            # bind each memory to the like-named STEP layer (v1
+            # semantics: memory(name=X) carries layer X's output,
+            # whether or not X is the group output)
+            named = {}
+            stack, seen = [out_node], set()
+            while stack:
+                nd = stack.pop()
+                if id(nd) in seen:
+                    continue
+                seen.add(id(nd))
+                if nd.name and not getattr(nd, "_is_memory", False):
+                    named.setdefault(nd.name, nd)
+                stack.extend(nd.parents)
+            for key in list(sub):
+                if isinstance(key, tuple) and key[0] == "rnn_mem":
+                    src = named.get(key[1])
+                    if src is None:
+                        raise ValueError(
+                            f"recurrent_group: memory(name={key[1]!r}) "
+                            f"has no like-named step layer to carry")
+                    rnn.update_memory(sub[key], src.to_var(sub))
+            rnn.step_output(out_var)
+        seq = rnn()
+        if reverse:
+            seq = fl.sequence_reverse(seq, length=lengths)
+        return seq
+
+    return Layer(build, list(inputs), name=name)
+
+
+def last_seq(input, name=None, **_):
+    """Last UNPADDED timestep of a sequence (ref layers.py last_seq) —
+    honors the dense+mask plane."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        return fl.sequence_pool(v, pool_type="last",
+                                mask=_seq_mask(ctx, input))
+
+    return Layer(build, [input], name=name)
+
+
+def first_seq(input, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        return fl.sequence_pool(v, pool_type="first")
+
+    return Layer(build, [input], name=name)

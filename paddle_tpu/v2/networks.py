@@ -5,7 +5,8 @@ from __future__ import annotations
 from .activation import act_name
 from .config_base import Layer
 
-__all__ = ["simple_img_conv_pool", "img_conv_group"]
+__all__ = ["simple_img_conv_pool", "img_conv_group", "simple_lstm",
+           "bidirectional_lstm", "sequence_conv_pool", "simple_attention"]
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
@@ -38,3 +39,91 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
             conv_act=act_name(conv_act), pool_type=ptype)
 
     return Layer(build, [input], name=name)
+
+
+def simple_lstm(input, size, reverse=False, act=None, gate_act=None,
+                state_act=None, mat_param_attr=None, bias_param_attr=None,
+                name=None, **_):
+    """fc(4*size) projection + lstmemory (ref
+    trainer_config_helpers/networks.py:632 simple_lstm: a mixed layer
+    with full_matrix_projection feeding an lstmemory)."""
+    from . import layer as v2_layer
+    proj = v2_layer.fc(input, size=size * 4,
+                       param_attr=mat_param_attr,
+                       bias_attr=False if bias_param_attr is False
+                       else None)
+    return v2_layer.lstmemory(proj, size=size, reverse=reverse, act=act,
+                              gate_act=gate_act, state_act=state_act,
+                              name=name)
+
+
+def bidirectional_lstm(input, size, return_seq=False, name=None, **_):
+    """Forward + backward simple_lstm, concatenated (ref
+    networks.py:1310).  return_seq=False concatenates the final states
+    (last unpadded step of the forward pass, first step of the backward
+    pass); True returns the [B, T, 2*size] sequence."""
+    from . import layer as v2_layer
+    fwd = simple_lstm(input, size, reverse=False)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_seq:
+        def build(ctx):
+            from paddle_tpu import layers as fl
+            return fl.concat([fwd.to_var(ctx), bwd.to_var(ctx)], axis=2)
+        return Layer(build, [fwd, bwd], name=name)
+    return v2_layer.concat([v2_layer.last_seq(fwd),
+                            v2_layer.first_seq(bwd)], name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size,
+                       pool_type=None, fc_act=None, name=None, **_):
+    """Context-window conv over the sequence + pooling (ref
+    networks.py:40 sequence_conv_pool — the text-CNN block)."""
+    from . import layer as v2_layer
+
+    def build(ctx):
+        from paddle_tpu import layers as fl
+        from .layer import _seq_mask
+        v = input.to_var(ctx)
+        mask = _seq_mask(ctx, input)
+        if mask is not None:
+            # zero the pad positions so context windows reaching into
+            # the padding see zeros (the reference's out-of-boundary
+            # context), not the learned pad-id embedding
+            v = fl.elementwise_mul(v, fl.unsqueeze(mask, [2]))
+        conv = fl.sequence_conv(v, num_filters=hidden_size,
+                                filter_size=context_len,
+                                act=act_name(fc_act) or "tanh")
+        ptype = "max" if pool_type is None else pool_type.name
+        return fl.sequence_pool(conv, pool_type=ptype, mask=mask)
+
+    return Layer(build, [input], name=name)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     name=None, **_):
+    """Additive (Bahdanau) attention (ref networks.py:1400
+    simple_attention): score_t = v . tanh(enc_proj_t + W s); returns
+    the attention-weighted context over the encoded sequence."""
+    def build(ctx):
+        from paddle_tpu import layers as fl
+        enc = encoded_sequence.to_var(ctx)       # [B, T, D]
+        proj = encoded_proj.to_var(ctx)          # [B, T, A]
+        state = decoder_state.to_var(ctx)        # [B, H]
+        A = int(proj.shape[-1])
+        s_proj = fl.fc(state, size=A, bias_attr=False)     # [B, A]
+        s_exp = fl.unsqueeze(s_proj, [1])                  # [B, 1, A]
+        combined = fl.tanh(fl.elementwise_add(proj, s_exp))
+        scores = fl.fc(combined, size=1, num_flatten_dims=2,
+                       bias_attr=False)                    # [B, T, 1]
+        from .layer import _seq_mask
+        mask = _seq_mask(ctx, encoded_sequence)
+        if mask is not None:
+            neg = fl.scale(fl.scale(mask, scale=-1.0, bias=1.0),
+                           scale=-1e9)                     # -1e9 at pads
+            scores = fl.elementwise_add(scores, fl.unsqueeze(neg, [2]))
+        w = fl.softmax(scores, axis=1)                     # [B, T, 1]
+        ctxv = fl.reduce_sum(fl.elementwise_mul(enc, w), dim=1)
+        return ctxv                                        # [B, D]
+
+    return Layer(build, [encoded_sequence, encoded_proj, decoder_state],
+                 name=name)

@@ -229,3 +229,171 @@ def test_networks_simple_img_conv_pool():
                   event_handler=lambda e: costs.append(e.cost)
                   if isinstance(e, paddle.event.EndIteration) else None)
     assert costs[-1] < costs[0] * 0.8, (costs[0], costs[-1])
+
+
+def _seq_cls_reader(rng, vocab=60, n=64, classes=2):
+    """Separable task: the class decides which vocab half dominates."""
+    band = vocab // classes
+    for _ in range(n):
+        cls = rng.randint(0, classes)
+        length = rng.randint(4, 9)
+        words = (rng.randint(0, band, (length,)) + band * cls).tolist()
+        yield words, int(cls)
+
+
+def _train_seq_model(pred_fn, passes=6, lr=0.05):
+    rng = np.random.RandomState(9)
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(60))
+    feat = pred_fn(words)
+    out = paddle.layer.fc(input=feat, size=2,
+                          act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name="lbl",
+                            type=paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=out, label=lbl)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=lr))
+    costs = []
+    trainer.train(paddle.batch(lambda: _seq_cls_reader(rng), 32),
+                  num_passes=passes,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert np.isfinite(costs).all(), costs
+    assert costs[-1] < costs[0] * 0.8, (costs[0], costs[-1])
+    return costs
+
+
+def test_v2_simple_lstm_text_classifier():
+    """IMDB-style quick start: embedding -> simple_lstm -> last_seq ->
+    fc softmax (the understand_sentiment v2 recipe)."""
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=16)
+        lstm = paddle.networks.simple_lstm(input=emb, size=16)
+        return paddle.layer.last_seq(input=lstm)
+
+    _train_seq_model(pred)
+
+
+def test_v2_bidirectional_lstm_classifier():
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=12)
+        return paddle.networks.bidirectional_lstm(input=emb, size=8)
+
+    _train_seq_model(pred)
+
+
+def test_v2_bidirectional_lstm_return_seq_shape():
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(30))
+    emb = paddle.layer.embedding(input=words, size=10)
+    seq = paddle.networks.bidirectional_lstm(input=emb, size=6,
+                                             return_seq=True)
+    pooled = paddle.layer.sequence_pool(
+        input=seq, pool_type=paddle.pooling.Max())
+    out = paddle.layer.fc(input=pooled, size=2,
+                          act=paddle.activation.Softmax())
+    probs = paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[([1, 2, 3, 4],), ([5, 6],)])
+    assert np.asarray(probs).shape == (2, 2)
+
+
+def test_v2_sequence_conv_pool_classifier():
+    """Text-CNN quick start (ref networks.py sequence_conv_pool)."""
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=16)
+        return paddle.networks.sequence_conv_pool(
+            input=emb, context_len=3, hidden_size=24)
+
+    _train_seq_model(pred)
+
+
+def test_v2_recurrent_group_classifier():
+    """recurrent_group + memory: a hand-written simple RNN trains (ref
+    layers.py:4161 recurrent_group)."""
+    H = 16
+
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=16)
+
+        def step(y):
+            mem = paddle.layer.memory(name="rnn_state", size=H)
+            return paddle.layer.fc(input=[y, mem], size=H,
+                                   act=paddle.activation.Tanh(),
+                                   name="rnn_state")
+
+        rnn = paddle.layer.recurrent_group(step=step, input=emb)
+        return paddle.layer.last_seq(input=rnn)
+
+    _train_seq_model(pred)
+
+
+def test_v2_simple_attention():
+    """simple_attention returns a [B, D] context; masked pads get ~0
+    weight."""
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(30))
+    emb = paddle.layer.embedding(input=words, size=12)
+    proj = paddle.layer.fc(input=emb, size=10, bias_attr=False)
+    state = paddle.layer.fc(
+        input=paddle.layer.sequence_pool(
+            input=emb, pool_type=paddle.pooling.Avg()),
+        size=8)
+    ctxv = paddle.networks.simple_attention(
+        encoded_sequence=emb, encoded_proj=proj, decoder_state=state)
+    out = paddle.layer.fc(input=ctxv, size=2,
+                          act=paddle.activation.Softmax())
+    probs = paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[([1, 2, 3],), ([4, 5, 6, 7, 8],)])
+    assert np.asarray(probs).shape == (2, 2)
+    assert np.allclose(np.asarray(probs).sum(-1), 1.0, atol=1e-3)
+
+
+def test_v2_recurrent_group_inner_memory_and_reverse():
+    """memory(name=X) binds to the like-named step layer even when X is
+    NOT the group output; reverse=True is length-aware (pads stay at
+    the sequence end, the carry is not contaminated)."""
+    H = 8
+
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=8)
+
+        def step(y):
+            mem = paddle.layer.memory(name="state", size=H)
+            h = paddle.layer.fc(input=[y, mem], size=H,
+                                act=paddle.activation.Tanh(),
+                                name="state")
+            # group output is a PROJECTION of the state, not the state
+            return paddle.layer.fc(input=h, size=H,
+                                   act=paddle.activation.Relu())
+
+        rnn = paddle.layer.recurrent_group(step=step, input=emb,
+                                           reverse=True)
+        return paddle.layer.first_seq(input=rnn)
+
+    _train_seq_model(pred, passes=8)
+
+
+def test_v2_fc_mixed_rank_rejected():
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(30))
+    emb = paddle.layer.embedding(input=words, size=8)     # [B, T, 8]
+    pooled = paddle.layer.sequence_pool(
+        input=emb, pool_type=paddle.pooling.Avg())        # [B, 8]
+    bad = paddle.layer.fc(input=[emb, pooled], size=4)
+    import pytest
+    with pytest.raises(ValueError, match="share rank"):
+        paddle.parameters.create(bad)
+
+
+def test_v2_lstmemory_size_mismatch_rejected():
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(30))
+    emb = paddle.layer.embedding(input=words, size=12)
+    bad = paddle.layer.lstmemory(input=emb, size=64)      # 12 != 4*64
+    import pytest
+    with pytest.raises(ValueError, match="pre-projected"):
+        paddle.parameters.create(bad)
