@@ -186,10 +186,19 @@ def linear_chain_crf(input, label, mask=None, param_attr=None, name=None):
 
 def crf_decoding(input, param_attr, label=None, mask=None, name=None):
     """ref layers/nn.py:934: viterbi decode with the Transition param
-    created by linear_chain_crf (pass the same ParamAttr/name)."""
+    created by linear_chain_crf (pass the same ParamAttr/name).  In a
+    standalone decode program (the v2 infer pattern) the parameter is
+    created here under that name and its trained value arrives via the
+    scope."""
     helper = LayerHelper("crf_decoding", name=name)
     attr = ParamAttr._to_attr(param_attr)
-    trans = helper.main_program.global_block().var(attr.name)
+    block = helper.main_program.global_block()
+    if attr.name and block.has_var(attr.name):
+        trans = block.var(attr.name)
+    else:
+        n_tags = int(input.shape[-1])
+        trans = helper.create_parameter(
+            attr, shape=[n_tags + 2, n_tags], dtype=input.dtype)
     out = helper.create_variable_for_type_inference("int64")
     ins = {"Emission": [input], "Transition": [trans]}
     if label is not None:

@@ -12,7 +12,13 @@ __all__ = ["data", "fc", "embedding", "concat", "dropout",
            "classification_cost", "square_error_cost", "cross_entropy_cost",
            "img_conv", "img_pool", "batch_norm", "max_id",
            "sequence_pool", "lstmemory", "memory", "recurrent_group",
-           "last_seq", "first_seq"]
+           "last_seq", "first_seq", "grumemory", "addto", "cos_sim",
+           "dot_prod_layer", "l2_distance_layer", "interpolation_layer",
+           "scaling_layer", "slope_intercept_layer", "clip_layer",
+           "maxout_layer", "sum_to_one_norm_layer", "row_l2_norm_layer",
+           "expand_layer", "pooling_layer", "crf_layer",
+           "crf_decoding_layer", "huber_regression_cost", "rank_cost",
+           "smooth_l1_cost", "sum_cost", "mse_cost"]
 
 
 def _fluid_layers():
@@ -362,3 +368,214 @@ def first_seq(input, name=None, **_):
         return fl.sequence_pool(v, pool_type="first")
 
     return Layer(build, [input], name=name)
+
+
+# ---------------------------------------------------------------------------
+# breadth tier: the remaining high-use trainer_config_helpers layer fns
+# (ref trainer_config_helpers/layers.py), each a thin lazy node over the
+# Fluid plane
+# ---------------------------------------------------------------------------
+
+
+def _unary(fn, input, name=None):
+    def build(ctx):
+        return fn(_fluid_layers(), input.to_var(ctx), ctx)
+    return Layer(build, [input], name=name)
+
+
+def _binary(fn, a, b, name=None):
+    def build(ctx):
+        return fn(_fluid_layers(), a.to_var(ctx), b.to_var(ctx), ctx)
+    return Layer(build, [a, b], name=name)
+
+
+def grumemory(input, size=None, reverse=False, act=None, gate_act=None,
+              name=None, **_):
+    """GRU over a PRE-PROJECTED [B, T, 3H] sequence (ref layers.py
+    grumemory; cf. lstmemory)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        width = int(v.shape[-1])
+        if width % 3:
+            raise ValueError(f"grumemory input width {width} must be "
+                             f"3*H (pre-projected)")
+        if size is not None and width != 3 * size:
+            raise ValueError(f"grumemory size={size} expects width "
+                             f"{3*size}, got {width}")
+        return fl.dynamic_gru(
+            v, size=width // 3, mask=_seq_mask(ctx, input),
+            is_reverse=reverse,
+            gate_activation=act_name(gate_act) or "sigmoid",
+            candidate_activation=act_name(act) or "tanh")
+    return Layer(build, [input], name=name)
+
+
+def addto(input, act=None, name=None, **_):
+    """Elementwise sum of same-shaped inputs + activation (ref
+    layers.py addto_layer)."""
+    ins = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx):
+        fl = _fluid_layers()
+        vs = [i.to_var(ctx) for i in ins]
+        out = vs[0] if len(vs) == 1 else fl.sum(vs)
+        a = act_name(act)
+        return getattr(fl, a)(out) if a else out
+    return Layer(build, list(ins), name=name)
+
+
+def cos_sim(a, b, name=None, **_):
+    """ref layers.py cos_sim."""
+    return _binary(lambda fl, x, y, ctx: fl.cos_sim(x, y), a, b, name)
+
+
+def dot_prod_layer(a, b, name=None, **_):
+    """Rowwise dot product (ref layers.py dot_prod_layer) -> [B, 1]."""
+    return _binary(
+        lambda fl, x, y, ctx: fl.reduce_sum(
+            fl.elementwise_mul(x, y), dim=-1, keep_dim=True), a, b, name)
+
+
+def l2_distance_layer(a, b, name=None, **_):
+    return _binary(
+        lambda fl, x, y, ctx: fl.sqrt(fl.reduce_sum(
+            fl.square(fl.elementwise_sub(x, y)), dim=-1, keep_dim=True)),
+        a, b, name)
+
+
+def interpolation_layer(input, weight, name=None, **_):
+    """w*x + (1-w)*y with per-row weight [B, 1] (ref layers.py
+    interpolation_layer: input = [x, y])."""
+    x, y = input
+
+    def build(ctx):
+        fl = _fluid_layers()
+        # declared order (x, y, weight) must match the build order that
+        # defines default feeding
+        xv, yv = x.to_var(ctx), y.to_var(ctx)
+        w = weight.to_var(ctx)
+        return fl.elementwise_add(
+            fl.elementwise_mul(xv, w),
+            fl.elementwise_mul(yv, fl.scale(w, scale=-1.0, bias=1.0)))
+    return Layer(build, [x, y, weight], name=name)
+
+
+def scaling_layer(input, weight, name=None, **_):
+    """Per-row scalar scale (ref layers.py scaling_layer)."""
+    return _binary(lambda fl, x, w, ctx: fl.elementwise_mul(x, w),
+                   input, weight, name)
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None,
+                          **_):
+    return _unary(lambda fl, x, ctx: fl.scale(x, scale=float(slope),
+                                              bias=float(intercept)),
+                  input, name)
+
+
+def clip_layer(input, min, max, name=None, **_):
+    return _unary(lambda fl, x, ctx: fl.clip(x, float(min), float(max)),
+                  input, name)
+
+
+def maxout_layer(input, groups, name=None, **_):
+    return _unary(lambda fl, x, ctx: fl.maxout(x, groups=groups),
+                  input, name)
+
+
+def sum_to_one_norm_layer(input, name=None, **_):
+    def build(ctx):
+        fl = _fluid_layers()
+        x = input.to_var(ctx)
+        s = fl.reduce_sum(x, dim=-1, keep_dim=True)
+        return fl.elementwise_div(x, s)
+    return Layer(build, [input], name=name)
+
+
+def row_l2_norm_layer(input, name=None, **_):
+    return _unary(lambda fl, x, ctx: fl.l2_normalize(x, axis=-1),
+                  input, name)
+
+
+def expand_layer(input, expand_as, name=None, **_):
+    """Broadcast a [B, D] vector over the timesteps of `expand_as`
+    (ref layers.py expand_layer)."""
+    return _binary(lambda fl, x, y, ctx: fl.sequence_expand_as(x, y),
+                  input, expand_as, name)
+
+
+def pooling_layer(input, pooling_type=None, name=None, **_):
+    """ref layers.py pooling_layer — sequence pooling.  The reference
+    defaults to MaxPooling (sequence_pool's own v2 default stays
+    sum)."""
+    if pooling_type is None:
+        from . import pooling as v2_pooling
+        pooling_type = v2_pooling.Max()
+    return sequence_pool(input, pool_type=pooling_type, name=name)
+
+
+def crf_layer(input, label, size=None, param_attr=None, name=None, **_):
+    """Linear-chain CRF cost over a [B, T, n_tags] emission sequence
+    (ref layers.py crf_layer); returns the mean negative log
+    likelihood."""
+    def build(ctx):
+        fl = _fluid_layers()
+        emit = input.to_var(ctx)
+        lbl = label.to_var(ctx)
+        ll = fl.linear_chain_crf(
+            emit, lbl, mask=_seq_mask(ctx, input),
+            param_attr=getattr(param_attr, "to_fluid",
+                               lambda: param_attr)())
+        # the op returns the (positive) log likelihood; the cost is its
+        # negation (cf. models/book.py label_semantic_roles)
+        return fl.mean(fl.scale(ll, scale=-1.0))
+    return Layer(build, [input, label], name=name)
+
+
+def crf_decoding_layer(input, size=None, param_attr=None, name=None,
+                       **_):
+    """Viterbi decode (ref layers.py crf_decoding_layer) -> [B, T]
+    tag ids.  Uses the transition parameter by name, so pass the SAME
+    param_attr as the crf_layer it pairs with."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.crf_decoding(
+            input.to_var(ctx),
+            param_attr=getattr(param_attr, "to_fluid",
+                               lambda: param_attr)(),
+            mask=_seq_mask(ctx, input))
+    return Layer(build, [input], name=name)
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, **_):
+    return _binary(
+        lambda fl, x, y, ctx: fl.mean(fl.huber_loss(x, y,
+                                                    delta=float(delta))),
+        input, label, name)
+
+
+def rank_cost(left, right, label, name=None, **_):
+    """Pairwise ranking cost (ref layers.py rank_cost)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        # build left/right FIRST: default feeding order is first-build
+        # order, and the declared order is (left, right, label)
+        lv, rv = left.to_var(ctx), right.to_var(ctx)
+        return fl.mean(fl.rank_loss(label.to_var(ctx), lv, rv))
+    return Layer(build, [left, right, label], name=name)
+
+
+def smooth_l1_cost(input, label, name=None, **_):
+    return _binary(
+        lambda fl, x, y, ctx: fl.mean(fl.smooth_l1(x, y)), input, label,
+        name)
+
+
+def sum_cost(input, name=None, **_):
+    """Sum of all input elements as the cost (ref layers.py
+    sum_cost)."""
+    return _unary(lambda fl, x, ctx: fl.reduce_sum(x), input, name)
+
+
+mse_cost = square_error_cost

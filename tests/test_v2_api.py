@@ -397,3 +397,128 @@ def test_v2_lstmemory_size_mismatch_rejected():
     import pytest
     with pytest.raises(ValueError, match="pre-projected"):
         paddle.parameters.create(bad)
+
+
+def test_v2_breadth_tier_builds_and_runs():
+    """The breadth-tier layer fns (grumemory, addto, cos_sim, norms,
+    clip, maxout, expand, crf, costs) build and execute through the v2
+    plane."""
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(30))
+    emb = paddle.layer.embedding(input=words, size=12)
+    proj3 = paddle.layer.fc(input=emb, size=24, bias_attr=False)
+    gru = paddle.layer.grumemory(input=proj3, size=8)
+    pooled = paddle.layer.pooling_layer(input=gru,
+                                        pooling_type=paddle.pooling.Max())
+    a = paddle.layer.fc(input=pooled, size=6)
+    b = paddle.layer.fc(input=pooled, size=6)
+    feats = [
+        paddle.layer.addto(input=[a, b], act=paddle.activation.Relu()),
+        paddle.layer.cos_sim(a, b),
+        paddle.layer.dot_prod_layer(a, b),
+        paddle.layer.l2_distance_layer(a, b),
+        paddle.layer.scaling_layer(input=a,
+                                   weight=paddle.layer.dot_prod_layer(a, b)),
+        paddle.layer.slope_intercept_layer(input=a, slope=2.0,
+                                           intercept=1.0),
+        paddle.layer.clip_layer(input=a, min=-1.0, max=1.0),
+        paddle.layer.sum_to_one_norm_layer(
+            input=paddle.layer.clip_layer(input=a, min=0.1, max=1.0)),
+        paddle.layer.row_l2_norm_layer(input=a),
+        paddle.layer.maxout_layer(input=a, groups=2),
+    ]
+    out = paddle.layer.fc(input=paddle.layer.concat(input=feats), size=2,
+                          act=paddle.activation.Softmax())
+    probs = paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[([1, 2, 3],), ([4, 5, 6, 7],)])
+    assert np.asarray(probs).shape == (2, 2)
+    assert np.allclose(np.asarray(probs).sum(-1), 1.0, atol=1e-3)
+
+
+def test_v2_crf_tagger_trains():
+    """SRL-style tagger: emissions -> crf_layer cost; decode with
+    crf_decoding_layer sharing the transition param."""
+    N_TAGS = 4
+    rng = np.random.RandomState(11)
+
+    def reader():
+        for _ in range(128):
+            n = rng.randint(3, 7)
+            words = rng.randint(0, 20, (n,)).tolist()
+            tags = [w % N_TAGS for w in words]      # learnable mapping
+            yield words, tags
+
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(20))
+    tags = paddle.layer.data(
+        name="tags", type=paddle.data_type.integer_value_sequence(N_TAGS))
+    emb = paddle.layer.embedding(input=words, size=8)
+    emit = paddle.layer.fc(input=emb, size=N_TAGS)
+    crf_attr = paddle.attr.Param(name="crf_trans")
+    cost = paddle.layer.crf_layer(input=emit, label=tags,
+                                  param_attr=crf_attr)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    costs = []
+    trainer.train(paddle.batch(reader, 16), num_passes=8,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert np.isfinite(costs).all()
+    # NLL is positive and collapses on this learnable mapping (a sign
+    # bug on the likelihood would send it negative-and-decreasing)
+    assert costs[0] > 0 and costs[-1] > 0
+    assert costs[-1] < costs[0] * 0.2, (costs[0], costs[-1])
+
+    seq = [1, 2, 3, 4, 5, 6, 7]
+    decoded = np.asarray(paddle.infer(
+        output_layer=paddle.layer.crf_decoding_layer(
+            input=emit, param_attr=crf_attr),
+        parameters=params, input=[(seq,)]))
+    exp = [w % N_TAGS for w in seq]
+    assert (decoded.ravel()[:len(seq)] == exp).mean() >= 0.8, (
+        decoded.ravel()[:len(seq)], exp)
+
+
+def test_v2_cost_layers():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(4))
+    for cost in (paddle.layer.huber_regression_cost(input=x, label=y),
+                 paddle.layer.smooth_l1_cost(input=x, label=y),
+                 paddle.layer.sum_cost(input=x),
+                 paddle.layer.mse_cost(input=x, label=y)):
+        val = paddle.infer(output_layer=cost,
+                           parameters=paddle.parameters.create(cost),
+                           input=[(np.ones(4, "f4"), np.zeros(4, "f4"))])
+        assert np.isfinite(np.asarray(val)).all()
+
+
+def test_v2_rank_cost_and_interpolation_feed_order():
+    """Default feeding follows declared order: rank_cost(left, right,
+    label) and interpolation_layer([x, y], weight) consume reader
+    columns in signature order (regression: build order once differed)."""
+    left = paddle.layer.data(name="l", type=paddle.data_type.dense_vector(1))
+    right = paddle.layer.data(name="r", type=paddle.data_type.dense_vector(1))
+    lbl = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    cost = paddle.layer.rank_cost(left=left, right=right, label=lbl)
+    v = paddle.infer(
+        output_layer=cost, parameters=paddle.parameters.create(cost),
+        input=[(np.array([5.0], "f4"), np.array([0.0], "f4"),
+                np.array([1.0], "f4"))])
+    # left >> right with label=1 (left should rank higher): tiny cost
+    assert float(np.asarray(v).ravel()[0]) < 0.1
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(2))
+    y2 = paddle.layer.data(name="y2", type=paddle.data_type.dense_vector(2))
+    w = paddle.layer.data(name="w", type=paddle.data_type.dense_vector(1))
+    interp = paddle.layer.interpolation_layer(input=[x, y2], weight=w)
+    v = paddle.infer(
+        output_layer=interp,
+        parameters=paddle.parameters.create(interp),
+        input=[(np.array([1.0, 1.0], "f4"), np.array([3.0, 3.0], "f4"),
+                np.array([0.25], "f4"))])
+    # out = w*x + (1-w)*y = 0.25*1 + 0.75*3
+    np.testing.assert_allclose(np.asarray(v).ravel(), [2.5, 2.5],
+                               atol=1e-5)
