@@ -41,6 +41,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 _LANES = 128
+# one-pass multi-K-block HDT backward (vs the two-kernel fallback)
+_FUSED_BWD_MULTI_K = True
 
 
 def _pick_block(t: int, target: int) -> int:
@@ -697,6 +699,49 @@ def _flash_fwd_hdt(q, k, v, B, scale, causal, interpret, block_q,
     return o, lse
 
 
+def _bwd_fused_kernel_hdt(q_ref, do_ref, lse_ref, delta_ref, k_ref,
+                          v_ref, dq_part_ref, dk_ref, dv_ref, *,
+                          block_q, block_k, nq, scale, causal, kv_len):
+    """General one-pass backward (any nk): p/ds recomputed ONCE per
+    (q, k) block pair and feed all three grads — the nk == 1 fused
+    kernel's 5-matmul-unit plan extended past one K block (the
+    two-kernel path costs 7 units).
+
+    Grid is (h, b, ki, qi) with qi INNERMOST: dk/dv accumulate directly
+    in their (VMEM-resident, constant-index-across-the-sweep) OUTPUT
+    blocks — no HBM round trips, no aliasing; each (ki, qi) pair writes
+    its dq contribution to a DISTINCT slot of a [nk, ...] partials
+    array (never revisited), which the caller sums in XLA.  Fully
+    deterministic: an earlier HBM-aliased accumulator variant raced its
+    own write-back at small nk (2/10 trials corrupted at nk=2 on v5e).
+    Causal-skipped pairs write zero partials / keep the accumulators."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        qs = q_ref[...] * jnp.asarray(scale, q_ref.dtype)
+        do = do_ref[...]
+        k = k_ref[...]
+        p, ds = _recompute_p_ds_hdt(
+            qs, k, v_ref[...], do, lse_ref[...], delta_ref[...], qi, ki,
+            block_q, block_k, causal, kv_len)
+        dv_new = _bmm(do, p.astype(do.dtype), ((2,), (2,)))
+        dk_new = _bmm(qs, ds.astype(qs.dtype), ((2,), (2,)))
+        first = qi == 0 if not causal else qi == (ki * block_k) // block_q
+        dv_ref[...] = jnp.where(first, dv_new, dv_ref[...] + dv_new)
+        dk_ref[...] = jnp.where(first, dk_new, dk_ref[...] + dk_new)
+        dq_part_ref[...] = (scale * _bmm(k, ds.astype(k.dtype),
+                                         ((2,), (1,))))
+
+    @pl.when(jnp.logical_not(run))
+    def _skip():
+        # the partial slot is written exactly once per (ki, qi): zero it
+        dq_part_ref[...] = jnp.zeros_like(dq_part_ref)
+
+
 def _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res, do,
                    block_q=None, block_k=None, block_g=None):
     q, k, v, o, lse = res                   # lse [H, 1, Nq]
@@ -756,6 +801,45 @@ def _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res, do,
             interpret=interpret,
         )(q, do, lse, delta, k, v)
         return dq, dk, dv_
+
+    if _FUSED_BWD_MULTI_K and 1 < nk <= 16:
+        # general one-pass kernel: 5 matmul units vs the two-kernel
+        # path's 7 (kept below for A/B and for very long T, where the
+        # [nk, ...] dq-partials traffic overtakes the recompute savings
+        # — v5e: T=8k +15%, longer T loses).  dk/dv accumulate in their
+        # VMEM-resident out blocks; dq partials occupy distinct slots —
+        # no aliasing, bit-deterministic, works in interpret mode too.
+        H_, _, Nq_ = q.shape
+        Nk_ = k.shape[2]
+        # the shared qsp/ksp helpers: grid here is (h, b, ki, qi), so
+        # the q side indexes by the 4th grid dim and k/v by the 3rd
+        qsp4 = lambda w: qsp(w, lambda i, j: j)
+        ksp4 = lambda w: ksp(w, lambda i, j: i)
+
+        dq_part_spec = pl.BlockSpec(
+            (None, g, d, block_q),
+            lambda h, b, j, i: (j, h, 0, b * nq + i),
+            memory_space=pltpu.VMEM)
+        kern = functools.partial(
+            _bwd_fused_kernel_hdt, block_q=block_q, block_k=block_k,
+            nq=nq, scale=scale, causal=causal, kv_len=kv_len)
+        dq_parts, dkf, dvf = pl.pallas_call(
+            kern,
+            grid=(H_ // g, B, nk, nq),
+            in_specs=[qsp4(d), qsp4(dv), qsp4(1), qsp4(1), ksp4(d),
+                      ksp4(dv)],
+            out_specs=[dq_part_spec, ksp4(d), ksp4(dv)],
+            out_shape=[jax.ShapeDtypeStruct((nk, H_, d, Nq_),
+                                            jnp.float32),
+                       jax.ShapeDtypeStruct((H_, d, Nk_), jnp.float32),
+                       jax.ShapeDtypeStruct((H_, dv, Nk_), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(q, do, lse, delta, k, v)
+        return (dq_parts.sum(axis=0).astype(q.dtype),
+                dkf.astype(k.dtype), dvf.astype(v.dtype))
 
     iq, ik = lambda i, j: j, lambda i, j: i
     dkv_kernel = functools.partial(
