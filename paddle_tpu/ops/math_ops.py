@@ -32,9 +32,9 @@ def amp_inputs(*xs):
     preferred_element_type=f32 so accumulation is surfaced in f32 and
     cast back — params/activations remain f32 master copies.
     EXCEPTION: the conv family omits preferred_element_type (jax's conv
-    transpose rule feeds the f32 cotangent against the bf16 operand and
-    crashes), so conv outputs round through bf16 before the upcast; the
-    MXU still accumulates f32 internally."""
+    transpose rule needs matching operand dtypes), so convs compute in
+    bf16 and keep the amp_result bf16 output policy; the MXU still
+    accumulates f32 internally."""
     if flags.get_flag("amp_bf16"):
         xs = tuple(x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x
                    for x in xs)

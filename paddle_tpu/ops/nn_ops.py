@@ -12,9 +12,10 @@ TPU-first notes:
    dimension_numbers to lax.conv_general_dilated and XLA's TPU layout
    assignment picks the efficient internal layout — no manual transposes.
  * Matmuls surface f32 accumulation (preferred_element_type); convs
-   compute in the input dtype and upcast after (the MXU still
+   compute in the input dtype with the matmul-style AMP output policy
+   (bf16 activation plane — math_ops.amp_result); the MXU still
    accumulates f32 internally — see math_ops.amp_inputs for why convs
-   cannot use preferred_element_type).
+   cannot use preferred_element_type.
  * batch_norm's running-stat update is the reference's MeanOut/VarianceOut
    in-place contract: outputs write back to the same var names.
  * softmax/layer_norm have Pallas fast paths (kernels/) selected by flag.
@@ -57,20 +58,23 @@ def _conv2d(ctx, ins, attrs):
     groups = int(attrs.get("groups", 1))
     padding = _conv_padding(attrs.get("paddings", 0), w.shape[2:], strides,
                             dilations, x.shape[2:])
-    from .math_ops import amp_inputs
+    from .math_ops import amp_inputs, amp_result
     orig_dtype = x.dtype
     xc, wc = amp_inputs(x, w)
     # NOTE: no preferred_element_type here — jax's conv transpose rule
-    # feeds the f32 cotangent straight back into conv_general_dilated
-    # against the bf16 operand and crashes; the MXU accumulates bf16
-    # convs in f32 internally regardless, so compute in bf16 and upcast.
+    # feeds the cotangent straight back into conv_general_dilated, which
+    # requires matching operand dtypes; the MXU accumulates bf16 convs
+    # in f32 internally regardless, so compute (and stay) in bf16.
     out = jax.lax.conv_general_dilated(
         xc, wc, window_strides=strides, padding=padding,
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     if ins.get("Bias"):    # optional fused bias (inference transpiler fold)
         out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
-    return {"Output": [out.astype(orig_dtype)]}
+    # matmul-style AMP output policy (see math_ops.amp_result): staying
+    # bf16 also keeps cotangents in the dtype the conv transpose rule
+    # needs against bf16 operands
+    return {"Output": [amp_result(out, orig_dtype)]}
 
 
 @register_op("depthwise_conv2d")
@@ -90,11 +94,14 @@ def _conv3d(ctx, ins, attrs):
     groups = int(attrs.get("groups", 1))
     padding = _conv_padding(attrs.get("paddings", 0), w.shape[2:], strides,
                             dilations, x.shape[2:])
+    from .math_ops import amp_inputs, amp_result
+    orig_dtype = x.dtype
+    xc, wc = amp_inputs(x, w)
     out = jax.lax.conv_general_dilated(
-        x, w, strides, padding, rhs_dilation=dilations,
+        xc, wc, strides, padding, rhs_dilation=dilations,
         feature_group_count=groups,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [amp_result(out, orig_dtype)]}
 
 
 @register_op("conv2d_transpose")
@@ -117,12 +124,15 @@ def _conv2d_transpose(ctx, ins, attrs):
         wg = w_flip.reshape(groups, i // groups, o, *w.shape[2:])
         w_t = jnp.swapaxes(wg, 1, 2).reshape(groups * o, i // groups,
                                              *w.shape[2:])
+    from .math_ops import amp_inputs, amp_result
+    orig_dtype = x.dtype
+    xc, wc = amp_inputs(x, w_t)
     out = jax.lax.conv_general_dilated(
-        x, w_t, window_strides=(1, 1), padding=pad,
+        xc, wc, window_strides=(1, 1), padding=pad,
         lhs_dilation=strides, rhs_dilation=dilations,
         feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [amp_result(out, orig_dtype)]}
 
 
 def _pool_nd(x, attrs, nd):

@@ -286,9 +286,12 @@ def test_resnet_trains_under_amp_bf16():
         rng = np.random.RandomState(0)
         feed = {"img": rng.rand(4, 3, 32, 32).astype("float32"),
                 "label": rng.randint(0, 10, (4, 1)).astype("int64")}
-        losses = _train(feeds, avg_loss, feed, steps=3, lr=0.05)
+        # 8 steps: with bf16 conv activations the bs4 trajectory can
+        # bump non-monotonically while BN stats warm up, then collapses
+        # to ~0 (memorizes the batch) by step ~4
+        losses = _train(feeds, avg_loss, feed, steps=8, lr=0.05)
         assert np.isfinite(losses).all()
-        assert losses[-1] < losses[0]
+        assert losses[-1] < losses[0] * 0.1, losses
     finally:
         flags.set_flag("amp_bf16", False)
 
