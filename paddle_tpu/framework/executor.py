@@ -127,11 +127,17 @@ def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
 def _data_feed_spec(program, var, axis):
     """PartitionSpec for a data-var feed on a transpiled program: shard
     dim `_dist_feed_shard_dim` (0 = batch; context-parallel programs set
-    1 = sequence) over `axis`.  Feeds of lower rank (per-example aux
-    vars) stay replicated.  Single source of truth for the compiled
-    step's in_specs AND the multi-process feed globalization — the two
-    must agree or in_shardings mismatch."""
+    1 = sequence) over `axis`.  Pipeline-ONLY programs (pp axis, no
+    spmd axis) replicate feeds — each pipe rank micro-splits the full
+    batch itself.  Feeds of lower rank (per-example aux vars) stay
+    replicated.  Single source of truth for the compiled step's
+    in_specs AND the multi-process feed globalization — the two must
+    agree or in_shardings mismatch."""
     P = jax.sharding.PartitionSpec
+    if (axis is None
+            or (getattr(program, "_dist_spmd_axis", None) is None
+                and getattr(program, "_dist_pp_axis", None) is not None)):
+        return P()
     feed_dim = int(getattr(program, "_dist_feed_shard_dim", 0))
     rank = len(var.shape) if var.shape else 0
     if feed_dim >= rank:
@@ -324,11 +330,9 @@ class _CompiledProgram:
 
             def feed_spec(name):
                 # context-parallel programs shard feeds along the
-                # SEQUENCE dim (transpiler/context_parallel.py marker);
-                # pipeline-only programs replicate feeds (every pipe
-                # rank micro-splits the full local batch itself)
-                if (spmd_axis is not None and block.has_var(name)
-                        and block.var(name).is_data):
+                # SEQUENCE dim; pipeline-only programs replicate feeds
+                # (the shared rule lives in _data_feed_spec)
+                if block.has_var(name) and block.var(name).is_data:
                     return _data_feed_spec(program, block.var(name),
                                            spmd_axis)
                 return P()
@@ -671,9 +675,9 @@ class Executor:
             if getattr(var, "sharding", None) is not None:
                 spec = P(*var.sharding)
             elif var.is_data:
-                axis = (getattr(program, "_dist_spmd_axis", None)
-                        or self.batch_axis)
-                spec = _data_feed_spec(program, var, axis)
+                spmd_axis = getattr(program, "_dist_spmd_axis", None)
+                spec = _data_feed_spec(program, var,
+                                       spmd_axis or self.batch_axis)
         sharding = jax.sharding.NamedSharding(self.mesh, spec)
         return jax.make_array_from_callback(
             arr.shape, sharding, lambda idx: arr[idx])

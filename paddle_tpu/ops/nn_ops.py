@@ -70,7 +70,8 @@ def _conv2d(ctx, ins, attrs):
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     if ins.get("Bias"):    # optional fused bias (inference transpiler fold)
-        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+        bias, = amp_inputs(ins["Bias"][0])   # keep the bf16 plane intact
+        out = out + bias.reshape(1, -1, 1, 1)
     # matmul-style AMP output policy (see math_ops.amp_result): staying
     # bf16 also keeps cotangents in the dtype the conv transpose rule
     # needs against bf16 operands

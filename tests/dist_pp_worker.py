@@ -1,0 +1,87 @@
+"""Cross-process PIPELINE-parallel worker: 2 localhost processes each
+hold one stage of the SAME Program (PipelineTranspiler GPipe schedule)
+and exchange boundary activations via ppermute ACROSS the process
+boundary — the multi-host story for the Program-plane pipeline, like
+dist_worker.py for dp and dist_cp_worker.py for cp.
+
+Run:  python tests/dist_pp_worker.py <coordinator> <world> <rank> <out>
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+SEED = 21
+V, T, D, B, L = 64, 16, 16, 8, 2
+STEPS = 4
+
+
+def build_program(pt, models, pp_stages):
+    pt.reset_default_programs()
+    main = pt.default_main_program()
+    startup = pt.default_startup_program()
+    main.random_seed = SEED
+    startup.random_seed = SEED
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=V, tgt_vocab_size=V, max_length=T, n_layer=L,
+        n_head=2, d_model=D, d_inner=32, dropout=0.0)
+    feeds, avg_cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=T, fused_attention=False, pp_stages=pp_stages)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    return main, startup, avg_cost
+
+
+def make_feed():
+    rng = np.random.RandomState(2)
+    toks = rng.randint(0, V, (B, T)).astype("int64")
+    return {"tokens": toks, "labels": np.roll(toks, -1, 1)}
+
+
+def train_steps(exe, prog, loss):
+    feed = make_feed()
+    out = []
+    for _ in range(STEPS):
+        l, = exe.run(prog, feed=feed, fetch_list=[loss])
+        out.append(float(np.mean(np.asarray(l))))
+    return out
+
+
+def main():
+    coordinator, world, rank, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as pt
+    from paddle_tpu import models
+    from paddle_tpu.parallel import env as penv
+
+    ok = penv.init_distributed_env(coordinator_address=coordinator,
+                                   num_processes=world, process_id=rank)
+    assert ok and jax.process_count() == world
+
+    main_p, startup, loss = build_program(pt, models, pp_stages=world)
+    pt.transpiler.PipelineTranspiler().transpile(
+        main_p, pp_degree=world, n_microbatches=2)
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("pipe",))
+    exe = pt.Executor(pt.CPUPlace(), mesh=mesh)
+    exe.run(startup)
+    losses = train_steps(exe, main_p, loss)
+
+    wname = main_p.all_parameters()[0].name
+    w = exe.scope.find_var(wname)
+    w_host = np.asarray(w.addressable_data(0))
+    result = {"rank": rank, "losses": losses,
+              "w_sum": float(np.abs(w_host).sum())}
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    print("PP_WORKER_OK", rank)
+
+
+if __name__ == "__main__":
+    main()
