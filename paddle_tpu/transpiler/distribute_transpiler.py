@@ -34,6 +34,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from ..core.enforce import check_arg
 from ..framework.program import Program, default_main_program
 
 
@@ -81,6 +82,14 @@ class DistributeTranspiler:
         return self
 
     def _insert_grad_allreduce(self, axis_name: str = "data"):
+        prev = getattr(self.program, "_dist_spmd_axis", None)
+        check_arg(
+            prev is None,
+            f"program already carries collective rewrites over axis "
+            f"{prev!r} (DistributeTranspiler, or a transpiler that "
+            f"delegates to it such as ContextParallelTranspiler); "
+            f"stacking another pass would duplicate the gradient "
+            f"allreduces")
         block = self.program.global_block()
         ad_idx = [i for i, op in enumerate(block.ops)
                   if op.type == "autodiff"]

@@ -57,6 +57,10 @@ class PipelineTranspiler:
                   f"pp_degree must be >= 1, got {pp_degree}")
         if pp_degree == 1:
             return                      # degenerate: leave untouched
+        check_arg(
+            getattr(program, "_dist_pp_axis", None) is None,
+            "program is already pipeline-transpiled; a second pass "
+            "would stack duplicate gradient allreduces (P x grads)")
         block = program.global_block()
         markers = [op for op in block.ops
                    if op.type == "pipeline_boundary"]

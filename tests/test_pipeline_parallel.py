@@ -147,3 +147,17 @@ def test_pipeline_with_dropout_runs():
                                            fetch_list=[avg_cost])[0])))
           for _ in range(3)]
     assert all(np.isfinite(ls)) and ls[-1] != ls[0]
+
+
+def test_double_transpile_rejected():
+    """Re-transpiling would stack duplicate gradient allreduces (P x
+    grads, silently); both transpilers refuse."""
+    main, startup, loss = build(pp_stages=4)
+    pt.transpiler.PipelineTranspiler().transpile(main, pp_degree=4)
+    with pytest.raises(Exception, match="already pipeline-transpiled"):
+        pt.transpiler.PipelineTranspiler().transpile(main, pp_degree=4)
+    pt.transpiler.DistributeTranspiler().transpile(
+        trainer_id=0, program=main, trainers=2, axis_name="data")
+    with pytest.raises(Exception, match="already carries collective"):
+        pt.transpiler.DistributeTranspiler().transpile(
+            trainer_id=0, program=main, trainers=2, axis_name="data")
