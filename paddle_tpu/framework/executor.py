@@ -72,16 +72,19 @@ def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
             tctx.place = ctx.place
             tctx.program = getattr(ctx, "program", None)
             tctx.cp_axis = getattr(ctx, "cp_axis", None)
+            tctx.ep_axis = getattr(ctx, "ep_axis", None)
             senv = dict(env)
             senv.update(mfeeds)
             if s > 0:
-                senv[b_names[s - 1]] = x_act
+                for nm, a in zip(b_names[s - 1], x_act):
+                    senv[nm] = a
             senv = run_ops_in_env(tctx, senv, stage_ops[s])
             if s < Pn - 1:
-                return senv[b_names[s]], jnp.zeros((), jnp.float32)
-            return (jnp.zeros_like(x_act),
+                return (tuple(senv[nm] for nm in b_names[s]),
+                        jnp.zeros((), jnp.float32))
+            return (jax.tree.map(jnp.zeros_like, x_act),
                     senv[loss_name].reshape(()).astype(jnp.float32))
-        # GPipe memory contract: per tick only the boundary activation
+        # GPipe memory contract: per tick only the boundary payload
         # is saved; stage internals rematerialize in the backward
         return jax.checkpoint(f)
 
@@ -89,7 +92,7 @@ def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
         senv = dict(env)
         senv.update(mfeeds)
         senv = run_ops_in_env(ctx, senv, stage_ops[0])
-        return senv[b_names[0]]
+        return tuple(senv[nm] for nm in b_names[0])
 
     act = jax.eval_shape(probe, {n: micro[n][0] for n in micro})
     branches = [branch(s) for s in range(Pn)]
@@ -107,10 +110,11 @@ def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
         o_idx = t - (Pn - 1)
         valid = (pp_r == Pn - 1) & (o_idx >= 0) & (o_idx < M)
         loss_acc = loss_acc + jnp.where(valid, lval, 0.0)
-        nxt = jax.lax.ppermute(out, axis, perm)
+        nxt = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis, perm), out)
         return (nxt, loss_acc), None
 
-    state0 = jnp.zeros(act.shape, act.dtype)
+    state0 = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), act)
     (_, loss_acc), _ = jax.lax.scan(
         tick, (state0, jnp.zeros((), jnp.float32)),
         jnp.arange(M + Pn - 1))
@@ -281,7 +285,25 @@ class _CompiledProgram:
         check_arg(len(ad_idx) <= 1,
                   "at most one autodiff op per program is supported")
         self._ad_idx = ad_idx[0] if ad_idx else None
+        if getattr(program, "_dist_pp_axis", None) is not None \
+                and self._ad_idx is not None:
+            # pipeline plane: stage internals live INSIDE the microbatch
+            # scan — validate up front instead of a raw KeyError deep in
+            # tracing (only the loss and persistable state are visible
+            # downstream, transpiler/pipeline.py module docstring)
+            loss = self._ops[self._ad_idx].attrs["loss"]
+            persist = set(persist_names)
+            for n in self.fetch_names:
+                if n != loss and n not in persist:
+                    raise EnforceNotMet(
+                        f"fetch {n!r} is not available under the "
+                        f"pipeline plane: stage internals live inside "
+                        f"the microbatch scan; fetch the loss "
+                        f"({loss!r}) or persistable state instead")
         jit_kwargs = {"donate_argnums": (0,) if donate else ()}
+        self._multi_cache: Dict[tuple, Any] = {}
+        self._state_sharding_fn = None
+        self._feed_sharding_fn = None
         spmd_axis = getattr(program, "_dist_spmd_axis", None)
         pp_axis = getattr(program, "_dist_pp_axis", None)
         if (spmd_axis is not None or pp_axis is not None) and mesh is None:
@@ -372,6 +394,7 @@ class _CompiledProgram:
                 sm = shard_map(spmd_step, check_vma=False, **sm_kwargs)
             except TypeError:
                 sm = shard_map(spmd_step, check_rep=False, **sm_kwargs)
+            self._step_fn = sm
             self._jitted = jax.jit(sm, **jit_kwargs)
             return
         if mesh is not None:
@@ -407,7 +430,64 @@ class _CompiledProgram:
                 ns(P()))
             jit_kwargs["out_shardings"] = (
                 None, {n: state_spec(n) for n in self.out_state_names})
+            self._state_sharding_fn = state_spec
+            self._feed_sharding_fn = feed_spec
+        self._step_fn = self._step
         self._jitted = jax.jit(self._step, **jit_kwargs)
+
+    def jitted_steps(self, steps: int, seq_names: tuple):
+        """A device-side training loop: `steps` iterations of the
+        compiled step under ONE dispatch (lax.scan), the TPU analogue of
+        the reference's repeated-exe.run train loops with
+        num_iteration_per_drop_scope (parallel_executor.cc:191) / TF's
+        steps_per_run.  Feeds named in `seq_names` carry a leading
+        [steps] dim and are sliced per iteration; the rest are
+        broadcast.  RNG folds per-iteration so the result is bit-equal
+        to `steps` sequential Executor.run calls."""
+        key = (steps, seq_names)
+        fn = self._multi_cache.get(key)
+        if fn is not None:
+            return fn
+        step_fn = self._step_fn
+        fold = self.program.random_seed is None
+
+        def multi(state, const_feeds, seq_feeds, root, counter):
+            def body(st, x):
+                i, sf = x
+                feeds = dict(const_feeds)
+                feeds.update(sf)
+                k = jax.random.fold_in(root, counter + i) if fold else root
+                fetches, st2 = step_fn(st, feeds, k)
+                return st2, fetches
+
+            idx = jnp.arange(steps, dtype=jnp.int32)
+            st_out, ys = jax.lax.scan(body, state, (idx, seq_feeds))
+            return ys, st_out
+
+        jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
+        if self._state_sharding_fn is not None:
+            # implicit-SPMD mesh plane: reuse the per-name shardings;
+            # per-step feeds gain a replicated leading steps dim
+            P = jax.sharding.PartitionSpec
+            ns = lambda spec: jax.sharding.NamedSharding(self.mesh, spec)
+
+            def seq_spec(name):
+                base = self._feed_sharding_fn(name).spec
+                return ns(P(*((None,) + tuple(base))))
+
+            jit_kwargs["in_shardings"] = (
+                {n: self._state_sharding_fn(n)
+                 for n in self.in_state_names},
+                {n: self._feed_sharding_fn(n) for n in self.feed_names
+                 if n not in seq_names},
+                {n: seq_spec(n) for n in seq_names},
+                ns(P()), ns(P()))
+            jit_kwargs["out_shardings"] = (
+                None, {n: self._state_sharding_fn(n)
+                       for n in self.out_state_names})
+        fn = jax.jit(multi, **jit_kwargs)
+        self._multi_cache[key] = fn
+        return fn
 
     def _pp_partition(self):
         """Split the forward op list at pipeline_boundary markers into
@@ -424,7 +504,7 @@ class _CompiledProgram:
         for op in fw:
             cur.append(op)
             if op.type == "pipeline_boundary":
-                b_names.append(op.outputs["Out"][0])
+                b_names.append(list(op.outputs["Out"]))
                 stages.append(cur)
                 cur = []
         stages.append(cur)
@@ -438,12 +518,12 @@ class _CompiledProgram:
         out = []
         for s, ops in enumerate(stages):
             own = set(id(op) for op in ops)
-            incoming = b_names[s - 1] if s > 0 else None
+            incoming = set(b_names[s - 1]) if s > 0 else set()
             extra: List[int] = []
             seen = set()
 
             def resolve(n):
-                if n in seen or n == incoming:
+                if n in seen or n in incoming:
                     return
                 seen.add(n)
                 i = produced_by.get(n)
@@ -473,6 +553,9 @@ class _CompiledProgram:
         # context-parallel plane: sequence-aware ops (fused_attention)
         # read this to run their ring variant with the axis in scope
         ctx.cp_axis = getattr(self.program, "_dist_cp_axis", None)
+        # expert-parallel plane: moe_ffn dispatches via all_to_all when
+        # the expert axis is in scope
+        ctx.ep_axis = getattr(self.program, "_dist_ep_axis", None)
 
         if self._ad_idx is None:
             env = run_ops_in_env(ctx, env, self._ops)
@@ -558,9 +641,113 @@ class Executor:
             scope: Optional[Scope] = None,
             return_numpy: bool = True):
         program = program or default_main_program()
-        feed = feed or {}
-        fetch_list = list(fetch_list or [])
         scope = scope or self.scope
+        compiled, dev_feeds, state, fetch_names = self._prepare(
+            program, feed or {}, list(fetch_list or []), scope)
+
+        root, counter = self._root_and_counter(program, 1)
+        if program.random_seed is None:
+            root = jax.random.fold_in(root, counter)
+
+        with RecordEvent(f"executor.run#{len(compiled.fetch_names)}f"):
+            if flags.get_flag("check_nan_inf_per_op"):
+                # eager (un-jitted) run so every op's outputs are concrete
+                # and the first NaN/Inf source is named
+                fetches, new_state = compiled._step(state, dev_feeds, root)
+            else:
+                fetches, new_state = compiled._jitted(state, dev_feeds,
+                                                      root)
+
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+
+        if flags.get_flag("check_nan_inf"):
+            for n, v in zip(fetch_names, fetches):
+                a = self._fetch_numpy(v)
+                if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+                    raise EnforceNotMet(f"NaN/Inf detected in fetch {n!r}")
+
+        if return_numpy:
+            return [self._fetch_numpy(v) for v in fetches]
+        return fetches
+
+    def run_steps(self, program: Optional[Program] = None,
+                  feed: Optional[Dict[str, Any]] = None,
+                  fetch_list: Optional[Sequence] = None,
+                  steps: int = 1,
+                  per_step_feeds: Sequence[str] = (),
+                  scope: Optional[Scope] = None,
+                  return_numpy: bool = True):
+        """Run `steps` training iterations in ONE device dispatch.
+
+        The compiled step is wrapped in lax.scan, so host<->device
+        latency is paid once per `steps` iterations instead of per
+        iteration — the device-side train loop the reference approximates
+        with num_iteration_per_drop_scope (parallel_executor.cc:191).
+
+        Feeds named in `per_step_feeds` must carry a leading [steps]
+        dimension and are sliced one slab per iteration; all other feeds
+        are repeated every iteration.  Fetches come back stacked with a
+        leading [steps] axis.  Parameter state advances exactly as
+        `steps` sequential run() calls would (including per-step RNG
+        folding), and ends up written back to the scope once.
+        """
+        program = program or default_main_program()
+        scope = scope or self.scope
+        check_arg(steps >= 1, f"steps must be >= 1, got {steps}")
+        seq = frozenset(per_step_feeds)
+        feed = feed or {}
+        missing = seq - set(feed)
+        check_arg(not missing,
+                  f"per_step_feeds {sorted(missing)} not in feed")
+        for name in seq:
+            n0 = np.asarray(feed[name]).shape[0]
+            check_arg(n0 == steps,
+                      f"per-step feed {name!r} leading dim {n0} != "
+                      f"steps {steps}")
+        if flags.get_flag("check_nan_inf_per_op") or \
+                flags.get_flag("check_nan_inf") or \
+                (self.mesh is not None and jax.process_count() > 1):
+            # debug planes want per-step visibility, and the
+            # multi-process feed globalization is per-step shaped:
+            # degrade to the sequential path (same results)
+            outs = []
+            for i in range(steps):
+                f_i = {k: (v[i] if k in seq else v)
+                       for k, v in feed.items()}
+                outs.append(self.run(program, f_i, fetch_list, scope,
+                                     return_numpy=return_numpy))
+            stack = np.stack if return_numpy else jnp.stack
+            return [stack([o[j] for o in outs])
+                    for j in range(len(outs[0]))]
+        dev_feed = {k: v for k, v in feed.items() if k not in seq}
+        compiled, dev_feeds, state, fetch_names = self._prepare(
+            program, dev_feed, list(fetch_list or []), scope,
+            extra_feeds={k: feed[k] for k in seq})
+        const_feeds = {k: v for k, v in dev_feeds.items() if k not in seq}
+        seq_feeds = {k: v for k, v in dev_feeds.items() if k in seq}
+
+        root, counter = self._root_and_counter(program, steps)
+        fn = compiled.jitted_steps(int(steps), tuple(sorted(seq)))
+        with RecordEvent(f"executor.run_steps#{steps}"):
+            ys, new_state = fn(state, const_feeds, seq_feeds, root,
+                               jnp.int32(counter))
+
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            return [self._fetch_numpy(v) for v in ys]
+        return ys
+
+    def _prepare(self, program, feed, fetch_list, scope,
+                 extra_feeds=None):
+        """Shared run()/run_steps() prologue: materialise feeds, gather
+        persistable state, and fetch (or build) the compiled program.
+        `extra_feeds` are run_steps' per-step slabs (leading [steps]
+        dim); they go through the same materialisation as other feeds
+        and their names become part of the compiled feed set."""
+        if extra_feeds:
+            feed = {**feed, **extra_feeds}
         device = self.place.jax_device()
         block = program.global_block()
 
@@ -633,36 +820,21 @@ class Executor:
                 if not a.sharding.is_equivalent_to(want, a.ndim):
                     state[n] = jax.device_put(a, want)
 
+        return compiled, dev_feeds, state, fetch_names
+
+    def _root_and_counter(self, program, n):
+        """Root PRNG key (unfolded) plus the run-counter window
+        [counter, counter+n) this call consumes — run() folds on the
+        host, run_steps folds per-iteration inside the scan, both
+        producing the identical key sequence."""
         seed = (program.random_seed if program.random_seed is not None
                 else flags.get_flag("rng_seed"))
         root = self._root_keys.get(seed)
         if root is None:        # cache: PRNGKey is a device computation
             root = self._root_keys[seed] = jax.random.PRNGKey(seed)
-        if program.random_seed is None:
-            root = jax.random.fold_in(root, self._run_counter)
-        self._run_counter += 1
-
-        with RecordEvent(f"executor.run#{len(compiled.fetch_names)}f"):
-            if flags.get_flag("check_nan_inf_per_op"):
-                # eager (un-jitted) run so every op's outputs are concrete
-                # and the first NaN/Inf source is named
-                fetches, new_state = compiled._step(state, dev_feeds, root)
-            else:
-                fetches, new_state = compiled._jitted(state, dev_feeds,
-                                                      root)
-
-        for n, v in new_state.items():
-            scope.set_var(n, v)
-
-        if flags.get_flag("check_nan_inf"):
-            for n, v in zip(fetch_names, fetches):
-                a = self._fetch_numpy(v)
-                if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
-                    raise EnforceNotMet(f"NaN/Inf detected in fetch {n!r}")
-
-        if return_numpy:
-            return [self._fetch_numpy(v) for v in fetches]
-        return fetches
+        counter = self._run_counter
+        self._run_counter += n
+        return root, counter
 
     def _globalize_feed(self, program, name, var, arr):
         """Build a global jax.Array for `arr` (the full global batch,

@@ -919,6 +919,12 @@ def flash_attention_hdt(q, k, v, batch, causal: bool = False,
                          f"by batch {batch}")
     if causal and Nq != k.shape[2]:
         raise ValueError("causal attention requires Tq == Tk")
+    if kv_len is not None and not 1 <= kv_len <= k.shape[2] // batch:
+        # kv_len <= 0 would fully mask the first K block and the online
+        # softmax would silently return a uniform average
+        # (exp(NEG_INF - NEG_INF) = 1) instead of erroring
+        raise ValueError(f"kv_len={kv_len} out of range [1, "
+                         f"{k.shape[2] // batch}]")
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     if interpret is None:

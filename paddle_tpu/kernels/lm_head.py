@@ -40,6 +40,11 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _LANES = 128
 
+# overridable defaults (None = auto) — the tuning knobs the v5e sweeps
+# exercise; lm_head_xent args take precedence
+DEFAULT_BLOCK_N = None
+DEFAULT_BLOCK_V = None
+
 
 def _pick_block_v(V: int) -> int:
     """Largest multiple of 128 that divides V, capped at 1280.  Bigger
@@ -268,6 +273,10 @@ def lm_head_xent(x, w, labels, interpret: bool = None,
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    if block_n is None:
+        block_n = DEFAULT_BLOCK_N
+    if block_v is None:
+        block_v = DEFAULT_BLOCK_V
     N, D = x.shape
     V = w.shape[1]
     bv = block_v or _pick_block_v(V) or 512

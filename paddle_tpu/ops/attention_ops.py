@@ -158,8 +158,12 @@ def _fused_mha(ctx, ins, attrs):
         w_att = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
         o = jnp.einsum("bhqk,hdbk->hdbq", w_att, v4).reshape(E, B * Tp)
 
+    # 2-byte o -> 2-byte out directly (MXU still accumulates f32); an
+    # f32 surface + the amp_result cast below left an unfused
+    # convert_element_type pass over [B, T, D] (see math_ops.amp_matmul)
+    pet = None if jnp.dtype(o.dtype).itemsize == 2 else _acc_type(o)
     out = lax.dot_general(o, wob, (((0,), (0,)), ((), ())),
-                          preferred_element_type=_acc_type(o))
+                          preferred_element_type=pet)
     out = out.reshape(B, Tp, -1)
     if Tp != T:
         out = out[:, :T]

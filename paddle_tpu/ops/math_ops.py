@@ -53,6 +53,26 @@ def amp_result(out, orig_dtype):
     return out.astype(orig_dtype)
 
 
+def amp_matmul(x, y, orig_dtype=None):
+    """jnp.matmul with the AMP dtype policy applied in ONE step: when
+    the (possibly amp-cast) operands are 2-byte, ask XLA for the 2-byte
+    result DIRECTLY — the MXU still accumulates f32 internally, but an
+    f32 surface (preferred_element_type) followed by astype(bf16) left
+    an unfused convert_element_type pass over the [N, F] activations
+    (~1 ms/step on the flagship; docs/profile_r04 math_ops.py rows).
+    f32 operands keep the old path: f32 accumulation surfaced, then
+    amp_result decides the output plane."""
+    orig = x.dtype if orig_dtype is None else orig_dtype
+    x, y = amp_inputs(x, y)
+    if jnp.dtype(x.dtype).itemsize == 2:
+        out = jnp.matmul(x, y)          # 2-byte in -> 2-byte out
+        want = (jnp.bfloat16 if jnp.dtype(orig) == jnp.float32
+                else orig)              # amp_result's output policy
+        return out if out.dtype == jnp.dtype(want) else out.astype(want)
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    return amp_result(out, orig)
+
+
 def _flatten2(x, num_col_dims):
     lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims else 1
     return x.reshape(lead, -1)
@@ -67,10 +87,9 @@ def _mul(ctx, ins, attrs):
     yn = int(attrs.get("y_num_col_dims", 1))
     x2 = _flatten2(x, xn)
     y2 = _flatten2(y, yn)
-    x2, y2 = amp_inputs(x2, y2)
-    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x2))
+    out = amp_matmul(x2, y2, x.dtype)
     out_shape = x.shape[:xn] + y.shape[yn:]
-    return {"Out": [amp_result(out.reshape(out_shape), x.dtype)]}
+    return {"Out": [out.reshape(out_shape)]}
 
 
 @register_op("matmul")
@@ -90,10 +109,7 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    orig_dtype = x.dtype
-    x, y = amp_inputs(x, y)
-    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    out = amp_result(out, orig_dtype)
+    out = amp_matmul(x, y)
     for ax in squeeze_out:
         out = jnp.squeeze(out, axis=ax)
     if alpha != 1.0:
@@ -104,10 +120,7 @@ def _matmul(ctx, ins, attrs):
 @register_op("bmm")
 def _bmm(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
-    orig_dtype = x.dtype
-    x, y = amp_inputs(x, y)
-    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    return {"Out": [amp_result(out, orig_dtype)]}
+    return {"Out": [amp_matmul(x, y)]}
 
 
 @register_op("dot")
