@@ -60,6 +60,19 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     return helper.append_activation(out, act)
 
 
+def sequence_context(input, context_length, context_start=None,
+                     name=None):
+    """Sliding-window concat over time: [B, T, D] ->
+    [B, T, context_length*D], zero-padded at the edges (the v2
+    context_projection primitive, ref
+    trainer_config_helpers/layers.py:738)."""
+    attrs = {"context_length": int(context_length)}
+    if context_start is not None:
+        attrs["context_start"] = int(context_start)
+    return _simple("sequence_context", {"X": [input]}, attrs,
+                   input.dtype, name=name)
+
+
 def sequence_pool(input, pool_type, mask=None, is_test=False, name=None):
     """ref layers/nn.py sequence_pool: SUM/AVERAGE/MAX/SQRT/LAST/FIRST
     over the time axis of [B, T, D] (optional [B, T] mask)."""
@@ -196,6 +209,17 @@ def crf_decoding(input, param_attr, label=None, mask=None, name=None):
     if attr.name and block.has_var(attr.name):
         trans = block.var(attr.name)
     else:
+        if attr.name:
+            # standalone-decode builds legitimately create the param
+            # here, but in a train+decode program a mismatched name
+            # would silently decode with an UNTRAINED transition
+            import warnings
+            warnings.warn(
+                f"crf_decoding: no variable named {attr.name!r} in this "
+                f"program — creating a fresh Transition parameter.  If "
+                f"this program also has a linear_chain_crf, pass the "
+                f"SAME param name or the decode uses untrained "
+                f"transitions.", stacklevel=3)
         n_tags = int(input.shape[-1])
         trans = helper.create_parameter(
             attr, shape=[n_tags + 2, n_tags], dtype=input.dtype)
@@ -469,12 +493,16 @@ def psroi_pool(input, rois, output_channels, spatial_scale,
                    input.dtype, name=name)
 
 
-def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                per_example=False, name=None):
+    """per_example=False: LoD-style flat rows (N*oh*ow, C*kh*kw);
+    per_example=True keeps the batch dim -> (N, oh*ow, C*kh*kw)."""
     pads = (list(padding) if isinstance(padding, (list, tuple))
             else [padding] * 4)
     return _simple("im2sequence", {"X": [input]},
                    {"kernels": filter_size, "strides": stride,
-                    "paddings": pads}, input.dtype, name=name)
+                    "paddings": pads, "per_example": bool(per_example)},
+                   input.dtype, name=name)
 
 
 def grid_sampler(x, grid, name=None):

@@ -30,6 +30,33 @@ from ..core.dtypes import index_dtype
 from ..framework.registry import register_op, single_input
 
 
+def _context_columns(x, ctx_len, ctx_start):
+    """[B,T,D] -> [B,T,ctx_len*D]: timestep t's row concatenates
+    x[t+ctx_start .. t+ctx_start+ctx_len), zero beyond the ends (the
+    ContextProjection semantics, ref projections ContextProjection /
+    sequence_conv_op.cc's im2col)."""
+    B, T, D = x.shape
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        sh = jnp.roll(x, -off, axis=1)
+        idx = jnp.arange(T) + off
+        valid = ((idx >= 0) & (idx < T))[None, :, None]
+        cols.append(jnp.where(valid, sh, 0.0))
+    return jnp.concatenate(cols, axis=-1)
+
+
+@register_op("sequence_context")
+def _sequence_context(ctx, ins, attrs):
+    """The raw sliding-window concat (v2 context_projection without
+    weights — ref trainer_config_helpers/layers.py:738)."""
+    x = single_input(ins, "X")
+    ctx_len = int(attrs["context_length"])
+    # default matches the reference's Py2 floor: -(len-1)/2 -> -2 at len 4
+    ctx_start = int(attrs.get("context_start", (-(ctx_len - 1)) // 2))
+    return {"Out": [_context_columns(x, ctx_len, ctx_start)]}
+
+
 @register_op("sequence_conv")
 def _sequence_conv(ctx, ins, attrs):
     """Context-window conv over time (ref sequence_conv_op.cc):
@@ -39,15 +66,7 @@ def _sequence_conv(ctx, ins, attrs):
     ctx_len = int(attrs.get("contextLength", attrs.get("context_length", 3)))
     ctx_start = int(attrs.get("contextStart",
                               attrs.get("context_start", -(ctx_len // 2))))
-    B, T, D = x.shape
-    cols = []
-    for k in range(ctx_len):
-        off = ctx_start + k
-        sh = jnp.roll(x, -off, axis=1)
-        idx = jnp.arange(T) + off
-        valid = ((idx >= 0) & (idx < T))[None, :, None]
-        cols.append(jnp.where(valid, sh, 0.0))
-    col = jnp.concatenate(cols, axis=-1)            # [B,T,ctx_len*D]
+    col = _context_columns(x, ctx_len, ctx_start)   # [B,T,ctx_len*D]
     out = jnp.einsum("btk,km->btm", col, w.astype(col.dtype))
     return {"Out": [out.astype(x.dtype)]}
 

@@ -475,9 +475,13 @@ def _im2sequence(ctx, ins, attrs):
     patches = jax.lax.conv_general_dilated_patches(
         x, (kh, kw), (sh, sw), "VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    # patches: (N, C*kh*kw, oh, ow) -> (N*oh*ow, C*kh*kw)
-    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
-    return {"Out": [out]}
+    # patches: (N, C*kh*kw, oh, ow) -> (N*oh*ow, C*kh*kw), or keep the
+    # batch dim ((N, oh*ow, C*kh*kw)) when per_example is set — the
+    # dense-plane spelling of "one patch subsequence per image"
+    out = patches.transpose(0, 2, 3, 1)
+    if attrs.get("per_example"):
+        return {"Out": [out.reshape(n, oh * ow, c * kh * kw)]}
+    return {"Out": [out.reshape(n * oh * ow, c * kh * kw)]}
 
 
 @register_op("grid_sampler")

@@ -271,7 +271,10 @@ def _crop(ctx, ins, attrs):
     x = single_input(ins)
     offsets = attrs["offsets"]
     shape = attrs["shape"]
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # -1 in shape keeps the full dimension (the batch-dim idiom, ref
+    # crop_op.cc shape semantics)
+    idx = tuple(slice(o, None if s == -1 else o + s)
+                for o, s in zip(offsets, shape))
     return {"Out": [x[idx]]}
 
 

@@ -80,6 +80,10 @@ def _seq_mask(ctx, node):
             m = _mask_of(ctx, n)
             if m is not None:
                 return m
+        if getattr(n, "_mask_stop", False):
+            # time-axis-reshaping layers (seq_reshape/seq_concat/...)
+            # invalidate the upstream pad mask: stop the walk here
+            continue
         queue.extend(n.parents)
     return None
 
@@ -96,7 +100,9 @@ def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
                                       lambda: param_attr)(),
                               bias_attr)
 
-    return Layer(build, inputs, name=name)
+    node = Layer(build, inputs, name=name)
+    node._size = size
+    return node
 
 
 def embedding(input, size, param_attr=None, name=None, **_):
@@ -291,7 +297,15 @@ def recurrent_group(step, input, reverse=False, name=None, **_):
     [B, D] node.  A step layer whose name matches a `memory(name=...)`
     node becomes the carried state.  Returns the [B, T, H] output
     sequence."""
-    inputs = input if isinstance(input, (list, tuple)) else [input]
+    raw_inputs = input if isinstance(input, (list, tuple)) else [input]
+    # StaticInput wraps a non-sequence node that every step sees whole
+    inputs = [i.input if isinstance(i, StaticInput) else i
+              for i in raw_inputs]
+    is_static = [isinstance(i, StaticInput) for i in raw_inputs]
+    seq_nodes = [n for n, s in zip(inputs, is_static) if not s]
+    if not seq_nodes:
+        raise ValueError("recurrent_group needs at least one sequence "
+                         "input (all inputs are StaticInput)")
 
     def build(ctx):
         fl = _fluid_layers()
@@ -301,28 +315,35 @@ def recurrent_group(step, input, reverse=False, name=None, **_):
             # length-aware reverse: a plain flip would put the PAD steps
             # first and contaminate the carried state before the real
             # tokens arrive
-            mask = _seq_mask(ctx, inputs[0])
+            mask = _seq_mask(ctx, seq_nodes[0])
             if mask is not None:
                 lengths = fl.cast(fl.reduce_sum(mask, dim=1), "int32")
-            outer = [fl.sequence_reverse(v, length=lengths)
-                     for v in outer]
+            outer = [v if st else fl.sequence_reverse(v, length=lengths)
+                     for v, st in zip(outer, is_static)]
         rnn = fl.StaticRNN()
         with rnn.step():
             sub = dict(ctx)
             sub["__rnn__"] = rnn
-            sub["__rnn_ref_outer__"] = outer[0]
+            ref = [v for v, st in zip(outer, is_static) if not st][0]
+            sub["__rnn_ref_outer__"] = ref
             step_nodes = []
-            for v in outer:
+            for v, static in zip(outer, is_static):
                 n = Layer(lambda c, vv=v: None, [])
-                xt = rnn.step_input(v)
+                xt = v if static else rnn.step_input(v)
                 sub[id(n)] = xt
                 step_nodes.append(n)
-            out_node = step(*step_nodes)
-            out_var = out_node.to_var(sub)
+            global _STEP_NAMED
+            prev_named, _STEP_NAMED = _STEP_NAMED, []
+            try:
+                out_node = step(*step_nodes)
+                out_var = out_node.to_var(sub)
+                extra_named = _STEP_NAMED
+            finally:
+                _STEP_NAMED = prev_named
             # bind each memory to the like-named STEP layer (v1
             # semantics: memory(name=X) carries layer X's output,
             # whether or not X is the group output)
-            named = {}
+            named = {n.name: n for n in extra_named if n.name}
             stack, seen = [out_node], set()
             while stack:
                 nd = stack.pop()
@@ -579,3 +600,958 @@ def sum_cost(input, name=None, **_):
 
 
 mse_cost = square_error_cost
+
+
+# ---------------------------------------------------------------------------
+# mixed_layer / projection plane (ref trainer_config_helpers/layers.py:869
+# mixed_layer, :430 full_matrix_projection, :738 context_projection ...).
+# A projection is a lazy node with its OWN parameters producing one summand;
+# mixed() sums them (+ optional bias) and applies the activation.  In the
+# reference projections are config-proto fragments only legal inside
+# mixed_layer; here they are ordinary nodes that mixed() sums, enforced by
+# the same "projections only inside mixed" rule for API fidelity.
+# ---------------------------------------------------------------------------
+
+
+class Projection(Layer):
+    """Marker base: a summand of mixed() carrying its own weights."""
+    _is_projection = True
+
+
+def _proj(build, parents, name=None):
+    p = Projection(build, parents, name=name)
+    return p
+
+
+def _to_attr(param_attr):
+    return getattr(param_attr, "to_fluid", lambda: param_attr)()
+
+
+def full_matrix_projection(input, size=0, param_attr=None, **_):
+    """out = x W, W [in_dim, size] owned by the projection (ref
+    layers.py:430)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        flat = 2 if len(v.shape or ()) == 3 else 1
+        return fl.fc(v, size=size, num_flatten_dims=flat, act=None,
+                     bias_attr=False, param_attr=_to_attr(param_attr))
+    return _proj(build, [input])
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None, **_):
+    """out = x W^T, W [size, in_dim] (ref layers.py
+    trans_full_matrix_projection) — the stored parameter is the
+    TRANSPOSE of full_matrix_projection's, so the two can share one
+    weight by name (the reference's tied-embedding idiom)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        v = input.to_var(ctx)
+        in_dim = int(v.shape[-1])
+        helper = LayerHelper("trans_full_matrix_projection")
+        w = helper.create_parameter(_to_attr(param_attr),
+                                    shape=[size, in_dim], dtype=v.dtype)
+        return fl.matmul(v, w, transpose_y=True)
+    return _proj(build, [input])
+
+
+def identity_projection(input, offset=None, size=None, **_):
+    """Identity, or a column slice [offset, offset+size) (ref
+    layers.py identity_projection)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        if offset is None:
+            return v
+        width = size if size is not None else int(v.shape[-1]) - offset
+        ax = len(v.shape or ()) - 1
+        return fl.slice(v, axes=[ax], starts=[offset],
+                        ends=[offset + width])
+    return _proj(build, [input])
+
+
+def slice_projection(input, slices, **_):
+    """Concat of column slices [(start, end), ...] (ref layers.py
+    slice_projection)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        ax = len(v.shape or ()) - 1
+        parts = [fl.slice(v, axes=[ax], starts=[s], ends=[e])
+                 for s, e in slices]
+        return parts[0] if len(parts) == 1 else fl.concat(parts, axis=ax)
+    return _proj(build, [input])
+
+
+def table_projection(input, size=0, param_attr=None, **_):
+    """Embedding-table lookup of integer ids (ref layers.py
+    table_projection); vocab comes from the input's integer type."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        vocab = input.type.dim
+        return fl.embedding(v, size=[vocab, size],
+                            param_attr=_to_attr(param_attr))
+    return _proj(build, [input])
+
+
+def dotmul_projection(input, param_attr=None, **_):
+    """out = x . w with a trainable per-feature weight [D] (ref
+    layers.py dotmul_projection)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        v = input.to_var(ctx)
+        helper = LayerHelper("dotmul_projection")
+        w = helper.create_parameter(_to_attr(param_attr),
+                                    shape=[int(v.shape[-1])],
+                                    dtype=v.dtype)
+        return fl.elementwise_mul(v, w)
+    return _proj(build, [input])
+
+
+def scaling_projection(input, param_attr=None, **_):
+    """out = w * x with ONE trainable scalar (ref layers.py
+    scaling_projection)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        v = input.to_var(ctx)
+        helper = LayerHelper("scaling_projection")
+        w = helper.create_parameter(_to_attr(param_attr),
+                                    shape=[1], dtype=v.dtype)
+        return fl.elementwise_mul(v, w)
+    return _proj(build, [input])
+
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False, **_):
+    """Sliding-window concat over the time axis: [A B C] with len 3 ->
+    [0AB ABC BC0] (ref layers.py:738).  Zero padding; a trainable
+    padding (padding_attr=ParamAttr) is not supported on the dense
+    plane — pass bias through the enclosing mixed() instead."""
+    if padding_attr not in (False, None):
+        raise NotImplementedError(
+            "context_projection: trainable padding is not supported; "
+            "use zero padding (padding_attr=False)")
+
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)          # [B, T, D]
+        mask = _seq_mask(ctx, input)
+        if mask is not None:
+            # zero the PAD rows first: the window beyond the real
+            # sequence end must read 0, not the pad token's embedding
+            v = fl.elementwise_mul(v, fl.unsqueeze(mask, [2]))
+        # the reference computes -(len-1)/2 under Py2 FLOOR division
+        # (layers.py:770): len 4 -> -2, not -1
+        start = ((-(context_len - 1)) // 2 if context_start is None
+                 else context_start)
+        return fl.sequence_context(v, context_length=context_len,
+                                   context_start=start)
+    return _proj(build, [input])
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, param_attr=None, **_):
+    """2-D conv as a mixed() summand with its own filter (ref
+    layers.py conv_projection)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.conv2d(input.to_var(ctx), num_filters=num_filters,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, bias_attr=False,
+                         param_attr=_to_attr(param_attr))
+    return _proj(build, [input])
+
+
+def dotmul_operator(a=None, b=None, scale=1.0, x=None, y=None, **_):
+    """out = scale * (a . b), elementwise over two LAYER outputs (ref
+    layers.py dotmul_operator; an Operator has no parameters)."""
+    a = a if a is not None else x
+    b = b if b is not None else y
+
+    def build(ctx):
+        fl = _fluid_layers()
+        out = fl.elementwise_mul(a.to_var(ctx), b.to_var(ctx))
+        return fl.scale(out, scale=float(scale)) if scale != 1.0 else out
+    return _proj(build, [a, b])
+
+
+class _MixedLayer(Layer):
+    """mixed() node: functional form (input=[...projections...]) or the
+    reference's context-manager/`+=` form:
+
+        with mixed(size=H) as m:
+            m += full_matrix_projection(x, size=H)
+    """
+
+    def __init__(self, size, act, bias_attr, name):
+        super().__init__(self._build_mixed, [], name=name)
+        self._size = size
+        self._act = act
+        self._bias_attr = bias_attr
+        self._sealed = False
+
+    def __iadd__(self, proj):
+        if self._sealed:
+            raise ValueError("mixed(): cannot add projections after the "
+                             "layer is finalized")
+        if not getattr(proj, "_is_projection", False):
+            raise ValueError("mixed(): only projections/operators can "
+                             "be added (got a plain layer; wrap it in "
+                             "identity_projection)")
+        self.parents.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._sealed = True
+        return False
+
+    def _build_mixed(self, ctx):
+        if not self.parents:
+            raise ValueError("mixed(): no projections were added")
+        fl = _fluid_layers()
+        vs = [p.to_var(ctx) for p in self.parents]
+        out = vs[0] if len(vs) == 1 else fl.sum(vs)
+        if self._bias_attr not in (None, False):
+            from paddle_tpu.framework.layer_helper import LayerHelper
+            helper = LayerHelper("mixed")
+            battr = _to_attr(None if self._bias_attr is True
+                             else self._bias_attr)
+            bias = helper.create_parameter(
+                battr, shape=[int(out.shape[-1])], dtype=out.dtype,
+                is_bias=True)
+            out = fl.elementwise_add(out, bias)
+        a = act_name(self._act)
+        return getattr(fl, a)(out) if a else out
+
+
+def mixed(size=0, input=None, act=None, bias_attr=None, name=None, **_):
+    """ref layers.py:869 mixed_layer — sum of projections/operators."""
+    node = _MixedLayer(size, act, bias_attr, name)
+    node._size = size or None
+    if input is not None:
+        for p in (input if isinstance(input, (list, tuple)) else [input]):
+            node += p
+        node._sealed = True
+    return node
+
+
+mixed_layer = mixed
+
+
+# ---------------------------------------------------------------------------
+# step-layer tier (the units recurrent_group composes — ref layers.py
+# lstm_step_layer:3164, gru_step_layer:3233, get_output_layer:3323,
+# recurrent_layer:3405) + StaticInput
+# ---------------------------------------------------------------------------
+
+
+# active recurrent_group step registry: get_output(name=...) nodes
+# created inside a step record themselves here for memory binding
+_STEP_NAMED = None
+
+
+class StaticInput:
+    """A non-sequence input visible unchanged at every step of a
+    recurrent_group (ref layers.py StaticInput)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        if is_seq:
+            raise NotImplementedError(
+                "StaticInput(is_seq=True) is the legacy sub-sequence "
+                "plane; pass the sequence itself to recurrent_group")
+        self.input = input
+        self.size = size
+
+
+def _check_default_acts(layer, **acts):
+    for nm, (val, dflt) in acts.items():
+        got = act_name(val)
+        if got and got != dflt:
+            raise NotImplementedError(
+                f"{layer}: only the default {nm}={dflt!r} is supported "
+                f"(got {got!r})")
+
+
+def lstm_step(input, state, size=None, act=None, gate_act=None,
+              state_act=None, bias_attr=None, name=None, **_):
+    """Weight-free LSTM step (ref layers.py:3164 lstm_step_layer): the
+    [B, 4H] `input` carries W_x x_t + W_h h_prev (built by the caller's
+    mixed/full_matrix_projection, cf. lstmemory_unit); `state` is the
+    previous cell.  Returns the hidden node; the new cell rides
+    get_output(..., arg_name="state")."""
+    _check_default_acts("lstm_step", act=(act, "tanh"),
+                        gate_act=(gate_act, "sigmoid"),
+                        state_act=(state_act, "tanh"))
+
+    def build_pair(ctx):
+        fl = _fluid_layers()
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        x = input.to_var(ctx)
+        c_prev = state.to_var(ctx)
+        helper = LayerHelper("lstm_step")
+        if bias_attr not in (None, False):
+            b = helper.create_parameter(
+                _to_attr(None if bias_attr is True else bias_attr),
+                shape=[int(x.shape[-1])], dtype=x.dtype, is_bias=True)
+            x = fl.elementwise_add(x, b)
+        c = helper.create_variable_for_type_inference(x.dtype)
+        h = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op("lstm_unit", {"X": [x], "C_prev": [c_prev]},
+                         {"C": [c], "H": [h]}, {})
+        return h, c
+
+    def build(ctx):
+        key = (id(node), "hc")
+        if key not in ctx:
+            ctx[key] = build_pair(ctx)
+        return ctx[key][0]
+
+    node = Layer(build, [input, state], name=name)
+
+    def build_state(ctx):
+        node.to_var(ctx)
+        return ctx[(id(node), "hc")][1]
+
+    state_node = Layer(build_state, [node])
+    node.outputs = {"state": state_node}
+    return node
+
+
+def gru_step(input, output_mem, size=None, act=None, gate_act=None,
+             param_attr=None, bias_attr=None, name=None, **_):
+    """GRU step (ref layers.py:3233 gru_step_layer): input [B, 3H] is
+    the pre-projected x contribution; the recurrent weight [H, 3H]
+    lives inside this step (gru_unit op)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        x = input.to_var(ctx)
+        h_prev = output_mem.to_var(ctx)
+        H3 = int(x.shape[-1])
+        out, _, _ = fl.gru_unit(
+            x, h_prev, size=H3, param_attr=_to_attr(param_attr),
+            bias_attr=_to_attr(bias_attr),
+            activation=act_name(act) or "tanh",
+            gate_activation=act_name(gate_act) or "sigmoid")
+        return out
+
+    return Layer(build, [input, output_mem], name=name)
+
+
+def gru_step_naive(input, output_mem, size=None, act=None,
+                   gate_act=None, param_attr=None, bias_attr=None,
+                   name=None, **_):
+    """ref layers.py gru_step_naive_layer — same math as gru_step (the
+    reference splits them only for GPU-kernel reasons)."""
+    return gru_step(input, output_mem, size=size, act=act,
+                    gate_act=gate_act, param_attr=param_attr,
+                    bias_attr=bias_attr, name=name)
+
+
+def get_output(input, arg_name, name=None, **_):
+    """Fetch a secondary output of a multi-output step layer (ref
+    layers.py:3323 get_output_layer), e.g. lstm_step's "state"."""
+    outs = getattr(input, "outputs", None)
+    if not outs or arg_name not in outs:
+        raise ValueError(
+            f"get_output: layer has no output {arg_name!r} "
+            f"(available: {sorted(outs) if outs else []})")
+    src = outs[arg_name]
+    node = Layer(lambda ctx: src.to_var(ctx), [src], name=name)
+    if _STEP_NAMED is not None and name:
+        # inside a recurrent_group step: register so a like-named
+        # memory() can carry this secondary output (the lstmemory_unit
+        # cell-state idiom) even though the node is not an ancestor of
+        # the step's return value
+        _STEP_NAMED.append(node)
+    return node
+
+
+def recurrent(input, act=None, bias_attr=None, param_attr=None,
+              reverse=False, name=None, **_):
+    """Simple full-matrix recurrent layer h_t = act(x_t + W h_prev + b)
+    (ref layers.py:3405 recurrent_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)           # [B, T, D]
+        D = int(v.shape[-1])
+        mask = _seq_mask(ctx, input)
+        lengths = None
+        seq = v
+        if reverse:
+            if mask is not None:
+                lengths = fl.cast(fl.reduce_sum(mask, dim=1), "int32")
+            seq = fl.sequence_reverse(seq, length=lengths)
+        # carry init lives in the PARENT block (the scan reads it
+        # before stepping — cf. memory() above)
+        init = fl.fill_constant_batch_size_like(
+            v, shape=[-1, D], dtype="float32", value=0.0)
+        rnn = fl.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(seq)
+            h_prev = rnn.memory(init=init)
+            wh = fl.fc(h_prev, size=D, bias_attr=False,
+                       param_attr=_to_attr(param_attr))
+            pre = fl.elementwise_add(x_t, wh)
+            if bias_attr not in (None, False):
+                from paddle_tpu.framework.layer_helper import LayerHelper
+                helper = LayerHelper("recurrent")
+                b = helper.create_parameter(
+                    _to_attr(None if bias_attr is True else bias_attr),
+                    shape=[D], dtype=v.dtype, is_bias=True)
+                pre = fl.elementwise_add(pre, b)
+            a = act_name(act) or "tanh"
+            h = getattr(fl, a)(pre)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        if reverse:
+            out = fl.sequence_reverse(out, length=lengths)
+        return out
+
+    return Layer(build, [input], name=name)
+
+
+# ---------------------------------------------------------------------------
+# breadth tier 2: elementwise/shape/cost veneers (each cites its ref
+# trainer_config_helpers/layers.py origin; v2 names strip the _layer
+# suffix, ref python/paddle/v2/layer.py __convert_name__)
+# ---------------------------------------------------------------------------
+
+
+def power(input, weight, name=None, **_):
+    """y = x^w with per-row scalar weight (ref power_layer)."""
+    return _binary(lambda fl, x, w, ctx: fl.elementwise_pow(x, w),
+                   input, weight, name)
+
+
+def repeat(input, num_repeats, as_row_vector=True, act=None, name=None,
+           **_):
+    """Tile features num_repeats times (ref repeat_layer):
+    as_row_vector=True -> [a b a b a b]; False -> [a a a b b b]."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        rank = len(v.shape or ())
+        if as_row_vector:
+            out = fl.expand(v, [1] * (rank - 1) + [num_repeats])
+        else:
+            u = fl.unsqueeze(v, [rank])
+            u = fl.expand(u, [1] * rank + [num_repeats])
+            out = fl.reshape(u, list(v.shape[:-1])
+                             + [int(v.shape[-1]) * num_repeats])
+        a = act_name(act)
+        return getattr(fl, a)(out) if a else out
+    return Layer(build, [input], name=name)
+
+
+def seq_reshape(input, reshape_size, name=None, **_):
+    """Re-chunk a [B, T, D] sequence to width reshape_size (ref
+    seq_reshape_layer)."""
+    node = _unary(lambda fl, x, ctx: fl.sequence_reshape(
+        x, new_dim=reshape_size), input, name)
+    node._mask_stop = True       # T changed: upstream mask is invalid
+    return node
+
+
+def seq_concat(a, b, name=None, **_):
+    """Concat two sequences along TIME (ref seq_concat_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.sequence_concat([a.to_var(ctx), b.to_var(ctx)])
+    node = Layer(build, [a, b], name=name)
+    node._mask_stop = True       # T changed: upstream mask is invalid
+    return node
+
+
+def seq_slice(input, starts=None, ends=None, name=None, **_):
+    """Per-sequence time slice (ref seq_slice_layer); starts/ends are
+    python ints on the dense plane."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        T = int(v.shape[1])
+        s = 0 if starts is None else int(starts)
+        e = T if ends is None else int(ends)
+        return fl.sequence_slice(v, offset=s, length=e - s)
+    node = Layer(build, [input], name=name)
+    node._mask_stop = True       # T changed: upstream mask is invalid
+    return node
+
+
+def sub_seq(input, offsets, sizes, name=None, **_):
+    """ref sub_seq_layer — time-axis sub-sequence by (offset, size)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.sequence_slice(input.to_var(ctx), offset=int(offsets),
+                                 length=int(sizes))
+    node = Layer(build, [input], name=name)
+    node._mask_stop = True       # T changed: upstream mask is invalid
+    return node
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None, **_):
+    """Zero-pad [B, C, H, W] along C/H/W (ref pad_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        p = []
+        for spec in (pad_c, pad_h, pad_w):
+            lo, hi = (spec if spec else (0, 0))
+            p += [int(lo), int(hi)]
+        return fl.pad(input.to_var(ctx), [0, 0] + p)
+    return Layer(build, [input], name=name)
+
+
+def crop_layer(input, axis, offset, shape=None, name=None, **_):
+    """ref crop_layer — crop to `shape` starting at `offset` along the
+    trailing axes from `axis`."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        full = list(v.shape)
+        offs = [0] * len(full)
+        tgt = list(full)
+        for i, (o, s) in enumerate(zip(offset, shape)):
+            offs[axis + i] = int(o)
+            tgt[axis + i] = int(s)
+        tgt[0] = -1          # batch dim passes through whole
+        return fl.crop(v, shape=tgt, offsets=offs)
+    return Layer(build, [input], name=name)
+
+
+def multiplex_layer(input, name=None, **_):
+    """input[0] is the [B, 1] int selector; rows are gathered from
+    input[1:] (ref multiplex_layer)."""
+    index, *rest = input
+
+    def build(ctx):
+        fl = _fluid_layers()
+        idx = index.to_var(ctx)
+        return fl.multiplex([r.to_var(ctx) for r in rest], idx)
+    return Layer(build, list(input), name=name)
+
+
+def prelu_layer(input, partial_sum=1, param_attr=None, name=None, **_):
+    """ref prelu_layer; partial_sum=1 -> per-channel slopes."""
+    mode = "all" if partial_sum != 1 else "channel"
+
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        m = mode if len(v.shape or ()) >= 3 else "all"
+        return fl.prelu(v, mode=m, param_attr=_to_attr(param_attr))
+    return Layer(build, [input], name=name)
+
+
+def gated_unit(input, size, act=None, gate_attr=None, gate_bias_attr=None,
+               gate_param_attr=None, inproj_attr=None,
+               inproj_param_attr=None, inproj_bias_attr=None, name=None,
+               **_):
+    """y = fc(x, size, act) * sigmoid(fc(x, size)) (ref
+    gated_unit_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        proj = fl.fc(v, size=size, act=act_name(act),
+                     param_attr=_to_attr(inproj_param_attr),
+                     bias_attr=_to_attr(inproj_bias_attr))
+        gate = fl.fc(v, size=size, act="sigmoid",
+                     param_attr=_to_attr(gate_param_attr),
+                     bias_attr=_to_attr(gate_bias_attr))
+        return fl.elementwise_mul(proj, gate)
+    return Layer(build, [input], name=name)
+
+
+def scale_shift(input, param_attr=None, bias_attr=None, name=None, **_):
+    """y = w*x + b with scalar w, b (ref scale_shift_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        v = input.to_var(ctx)
+        helper = LayerHelper("scale_shift")
+        w = helper.create_parameter(_to_attr(param_attr),
+                                    shape=[1], dtype=v.dtype)
+        out = fl.elementwise_mul(v, w)
+        if bias_attr is not False:
+            b = helper.create_parameter(
+                _to_attr(None if bias_attr is True else bias_attr),
+                shape=[1], dtype=v.dtype, is_bias=True)
+            out = fl.elementwise_add(out, b)
+        return out
+    return Layer(build, [input], name=name)
+
+
+def bilinear_interp(input, out_size_x, out_size_y, name=None, **_):
+    """ref bilinear_interp_layer over [B, C, H, W]."""
+    return _unary(lambda fl, x, ctx: fl.resize_bilinear(
+        x, out_shape=[out_size_y, out_size_x]), input, name)
+
+
+def upsample(input, scale=None, upsample_size=None, name=None, **_):
+    """Nearest-neighbour upsample (ref upsample_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        if upsample_size is not None:
+            return fl.resize_nearest(v, out_shape=list(upsample_size))
+        return fl.resize_nearest(v, scale=scale)
+    return Layer(build, [input], name=name)
+
+
+def img_cmrnorm(input, size=5, scale=0.0128, power=0.75, name=None, **_):
+    """Cross-map response norm = LRN (ref img_cmrnorm_layer; cf.
+    operators lrn_op.cc)."""
+    return _unary(lambda fl, x, ctx: fl.lrn(
+        x, n=size, alpha=float(scale), beta=float(power)), input, name)
+
+
+def cross_channel_norm(input, param_attr=None, name=None, **_):
+    """L2-normalize across channels with a trainable per-channel scale
+    (ref cross_channel_norm_layer, the SSD norm)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        v = input.to_var(ctx)
+        C = int(v.shape[1])
+        helper = LayerHelper("cross_channel_norm")
+        w = helper.create_parameter(_to_attr(param_attr),
+                                    shape=[C, 1, 1], dtype=v.dtype)
+        return fl.elementwise_mul(fl.l2_normalize(v, axis=1), w)
+    return Layer(build, [input], name=name)
+
+
+def row_conv_layer(input, context_len, act=None, param_attr=None,
+                   name=None, **_):
+    """Lookahead row convolution (ref row_conv_layer)."""
+    return _unary(lambda fl, x, ctx: fl.row_conv(
+        x, future_context_size=context_len, act=act_name(act),
+        param_attr=_to_attr(param_attr)), input, name)
+
+
+def sampling_id_layer(input, name=None, **_):
+    """Sample an id from a [B, V] distribution (ref
+    sampling_id_layer)."""
+    return _unary(lambda fl, x, ctx: fl.sampling_id(x), input, name)
+
+
+def linear_comb(weights, vectors, size, name=None, **_):
+    """out[b] = sum_k w[b,k] * vec[b, k*size:(k+1)*size] (ref
+    linear_comb_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        w = weights.to_var(ctx)              # [B, K]
+        v = vectors.to_var(ctx)              # [B, K*size]
+        K = int(w.shape[-1])
+        v3 = fl.reshape(v, [-1, K, size])
+        w3 = fl.unsqueeze(w, [2])
+        return fl.reduce_sum(fl.elementwise_mul(v3, w3), dim=1)
+    return Layer(build, [weights, vectors], name=name)
+
+
+def convex_comb(weights, vectors, size, name=None, **_):
+    """Deprecated reference alias of linear_comb."""
+    return linear_comb(weights, vectors, size, name=name)
+
+
+def out_prod(a, b, name=None, **_):
+    """Rowwise outer product -> [B, M*N] (ref out_prod_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        x, y = a.to_var(ctx), b.to_var(ctx)
+        M, N = int(x.shape[-1]), int(y.shape[-1])
+        o = fl.elementwise_mul(fl.unsqueeze(x, [2]),
+                               fl.unsqueeze(y, [1]))
+        return fl.reshape(o, [-1, M * N])
+    return Layer(build, [a, b], name=name)
+
+
+def tensor(a, b, size, param_attr=None, bias_attr=None, act=None,
+           name=None, **_):
+    """Bilinear tensor product x W_k y^T (ref tensor_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        out = fl.bilinear_tensor_product(
+            a.to_var(ctx), b.to_var(ctx), size=size,
+            param_attr=_to_attr(param_attr),
+            bias_attr=_to_attr(bias_attr))
+        nm = act_name(act)
+        return getattr(fl, nm)(out) if nm else out
+    return Layer(build, [a, b], name=name)
+
+
+def conv_shift(a, b, name=None, **_):
+    """Circular 1-D correlation of [B, M] with an odd-width [B, N]
+    kernel (ref conv_shift_layer / conv_shift_op.cc)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        x, k = a.to_var(ctx), b.to_var(ctx)
+        M, N = int(x.shape[-1]), int(k.shape[-1])
+        if N % 2 == 0:
+            raise ValueError(f"conv_shift kernel width must be odd, "
+                             f"got {N}")
+        half = N // 2
+        acc = None
+        for j in range(N):
+            shift = (j - half) % M
+            rolled = (x if shift == 0 else fl.concat(
+                [fl.slice(x, axes=[1], starts=[shift], ends=[M]),
+                 fl.slice(x, axes=[1], starts=[0], ends=[shift])],
+                axis=1))
+            kj = fl.slice(k, axes=[1], starts=[j], ends=[j + 1])
+            term = fl.elementwise_mul(rolled, kj)
+            acc = term if acc is None else fl.elementwise_add(acc, term)
+        return acc
+    return Layer(build, [a, b], name=name)
+
+
+def block_expand(input, block_x, block_y, stride_x=1, stride_y=1,
+                 padding_x=0, padding_y=0, num_channels=None, name=None,
+                 **_):
+    """im2col over [B, C, H, W] -> per-image patch sequence
+    [B, n_blocks, C*bh*bw] (ref block_expand_layer / im2sequence op;
+    the op's flat LoD rows are re-chunked per image on the dense
+    plane)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        return fl.im2sequence(
+            v, filter_size=[block_y, block_x],
+            stride=[stride_y, stride_x],
+            padding=[padding_y, padding_x, padding_y, padding_x],
+            per_example=True)
+    node = Layer(build, [input], name=name)
+    node._mask_stop = True       # patch sequence: no upstream pad mask
+    return node
+
+
+def spp(input, pyramid_height, pool_type=None, name=None, **_):
+    """Spatial pyramid pooling: adaptive pools at 1,2,..,2^(h-1) bins
+    concatenated (ref spp_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        ptype = "max" if pool_type is None else pool_type.name
+        parts = []
+        for lvl in range(pyramid_height):
+            bins = 2 ** lvl
+            p = fl.adaptive_pool2d(v, pool_size=bins, pool_type=ptype)
+            parts.append(fl.flatten(p, axis=1))
+        return parts[0] if len(parts) == 1 else fl.concat(parts, axis=1)
+    return Layer(build, [input], name=name)
+
+
+def ctc(input, label, size=None, blank=None, norm_by_times=False,
+        name=None, **_):
+    """CTC cost (ref ctc_layer; lowered onto the warpctc op — the
+    reference's two CTC layers differ only in kernel provider)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        logits = input.to_var(ctx)
+        lbl = label.to_var(ctx)
+
+        def lengths(node):
+            m = _seq_mask(ctx, node)
+            return (fl.cast(fl.reduce_sum(m, dim=1), "int32")
+                    if m is not None else None)
+
+        cost = fl.warpctc(logits, lbl,
+                          blank=(int(blank) if blank is not None
+                                 else int(logits.shape[-1]) - 1),
+                          norm_by_times=norm_by_times,
+                          input_length=lengths(input),
+                          label_length=lengths(label))
+        return fl.mean(cost)
+    return Layer(build, [input, label], name=name)
+
+
+def warp_ctc(input, label, size=None, blank=0, norm_by_times=False,
+             name=None, **_):
+    """ref warp_ctc_layer — same lowering as ctc()."""
+    return ctc(input, label, size=size, blank=blank,
+               norm_by_times=norm_by_times, name=name)
+
+
+def nce_layer(input, label, num_classes=None, num_neg_samples=10,
+              param_attr=None, bias_attr=None, name=None, **_):
+    """Noise-contrastive estimation cost (ref nce_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.mean(fl.nce(
+            input.to_var(ctx), label.to_var(ctx),
+            num_total_classes=num_classes,
+            num_neg_samples=num_neg_samples,
+            param_attr=_to_attr(param_attr),
+            bias_attr=_to_attr(bias_attr)))
+    return Layer(build, [input, label], name=name)
+
+
+def hsigmoid_layer(input, label, num_classes=None, param_attr=None,
+                   bias_attr=None, name=None, **_):
+    """Hierarchical sigmoid cost (ref hsigmoid)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.mean(fl.hsigmoid(
+            input.to_var(ctx), label.to_var(ctx),
+            num_classes=num_classes, param_attr=_to_attr(param_attr),
+            bias_attr=_to_attr(bias_attr)))
+    return Layer(build, [input, label], name=name)
+
+
+def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha=0.1,
+                                name=None, **_):
+    """CE + alpha * (log Z)^2 keeping the row sum near 1 (ref
+    cross_entropy_with_selfnorm)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        p = input.to_var(ctx)
+        ce = fl.mean(fl.cross_entropy(p, label.to_var(ctx)))
+        logz = fl.log(fl.reduce_sum(p, dim=-1, keep_dim=False))
+        return fl.elementwise_add(
+            ce, fl.scale(fl.mean(fl.square(logz)),
+                         scale=float(softmax_selfnorm_alpha)))
+    return Layer(build, [input, label], name=name)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **_):
+    """Sum of per-class binary CE on sigmoid outputs (ref
+    multi_binary_label_cross_entropy)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        p = fl.clip(input.to_var(ctx), 1e-7, 1.0 - 1e-7)
+        y = label.to_var(ctx)
+        pos = fl.elementwise_mul(y, fl.log(p))
+        neg = fl.elementwise_mul(
+            fl.scale(y, scale=-1.0, bias=1.0),
+            fl.log(fl.scale(p, scale=-1.0, bias=1.0)))
+        return fl.scale(fl.mean(fl.elementwise_add(pos, neg)),
+                        scale=-1.0)
+    return Layer(build, [input, label], name=name)
+
+
+def huber_classification_cost(input, label, name=None, **_):
+    """Huberized hinge on {0,1} labels mapped to +-1 (ref
+    huber_classification_cost)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        x = input.to_var(ctx)
+        y01 = label.to_var(ctx)
+        y = fl.scale(fl.cast(y01, "float32"), scale=2.0, bias=-1.0)
+        a = fl.elementwise_mul(y, x)
+        neg1 = fl.scale(fl.zeros_like(a), scale=0.0, bias=-1.0)
+        quad = fl.square(fl.relu(fl.scale(a, scale=-1.0, bias=1.0)))
+        lin = fl.scale(a, scale=-4.0)
+        return fl.mean(fl.where(fl.less_than(a, neg1), lin, quad))
+    return Layer(build, [input, label], name=name)
+
+
+def switch_order(input, reshape_axis=3, name=None, **_):
+    """[B, C, H, W] -> [B, H, W, C] (ref switch_order_layer)."""
+    return _unary(lambda fl, x, ctx: fl.transpose(x, [0, 2, 3, 1]),
+                  input, name)
+
+
+def rotate(input, height, width, name=None, **_):
+    """Rotate each [H, W] map 90deg counter-clockwise (ref
+    rotate_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)
+        rank = len(v.shape or ())
+        if rank == 2:
+            C = int(v.shape[-1]) // (height * width)
+            v = fl.reshape(v, [-1, C, height, width])
+        t = fl.transpose(v, [0, 1, 3, 2])
+        out = fl.reverse(t, axis=2)
+        return fl.reshape(out, [-1, int(np_prod(out.shape[1:]))]) \
+            if rank == 2 else out
+    return Layer(build, [input], name=name)
+
+
+def np_prod(xs):
+    import numpy as _np
+    return int(_np.prod([int(s) for s in xs]))
+
+
+def resize(input, size, name=None, **_):
+    """Reinterpret row width to `size` (ref resize_layer)."""
+    return _unary(lambda fl, x, ctx: fl.reshape(x, [-1, int(size)]),
+                  input, name)
+
+
+def factorization_machine(input, factor_size, param_attr=None, name=None,
+                          **_):
+    """Second-order FM term 0.5*sum((xV)^2 - x^2 V^2) (ref
+    factorization_machine layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        x = input.to_var(ctx)
+        D = int(x.shape[-1])
+        helper = LayerHelper("factorization_machine")
+        v = helper.create_parameter(_to_attr(param_attr),
+                                    shape=[D, factor_size],
+                                    dtype=x.dtype)
+        xv = fl.matmul(x, v)                       # [B, k]
+        x2v2 = fl.matmul(fl.square(x), fl.square(v))
+        return fl.scale(fl.reduce_sum(
+            fl.elementwise_sub(fl.square(xv), x2v2), dim=-1,
+            keep_dim=True), scale=0.5)
+    return Layer(build, [input], name=name)
+
+
+# reference-name aliases (v2 strips the `_layer` suffix — ref
+# python/paddle/v2/layer.py __convert_name__)
+dot_prod = dot_prod_layer
+l2_distance = l2_distance_layer
+interpolation = interpolation_layer
+scaling = scaling_layer
+slope_intercept = slope_intercept_layer
+clip = clip_layer
+maxout = maxout_layer
+sum_to_one_norm = sum_to_one_norm_layer
+row_l2_norm = row_l2_norm_layer
+expand = expand_layer
+pooling = pooling_layer
+crf = crf_layer
+crf_decoding = crf_decoding_layer
+regression_cost = square_error_cost
+cross_entropy = cross_entropy_cost
+pad = pad_layer
+crop = crop_layer
+multiplex = multiplex_layer
+prelu = prelu_layer
+row_conv = row_conv_layer
+sampling_id = sampling_id_layer
+nce = nce_layer
+hsigmoid = hsigmoid_layer
+
+__all__ += [
+    "mixed", "mixed_layer", "full_matrix_projection",
+    "trans_full_matrix_projection", "identity_projection",
+    "slice_projection", "table_projection", "dotmul_projection",
+    "scaling_projection", "context_projection", "conv_projection",
+    "dotmul_operator", "Projection", "StaticInput",
+    "lstm_step", "gru_step", "gru_step_naive", "get_output",
+    "recurrent",
+    "power", "repeat", "seq_reshape", "seq_concat", "seq_slice",
+    "sub_seq", "pad_layer", "pad", "crop_layer", "crop",
+    "multiplex_layer", "multiplex", "prelu_layer", "prelu",
+    "gated_unit", "scale_shift", "bilinear_interp", "upsample",
+    "img_cmrnorm", "cross_channel_norm", "row_conv_layer", "row_conv",
+    "sampling_id_layer", "sampling_id", "linear_comb", "convex_comb",
+    "out_prod", "tensor", "conv_shift", "block_expand", "spp", "ctc",
+    "warp_ctc", "nce_layer", "nce", "hsigmoid_layer", "hsigmoid",
+    "cross_entropy_with_selfnorm", "multi_binary_label_cross_entropy",
+    "huber_classification_cost", "switch_order", "rotate", "resize",
+    "factorization_machine",
+    "dot_prod", "l2_distance", "interpolation", "scaling",
+    "slope_intercept", "clip", "maxout", "sum_to_one_norm",
+    "row_l2_norm", "expand", "pooling", "crf", "crf_decoding",
+    "regression_cost", "cross_entropy",
+]

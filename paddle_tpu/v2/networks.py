@@ -127,3 +127,284 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
 
     return Layer(build, [encoded_sequence, encoded_proj, decoder_state],
                  name=name)
+
+
+# ---------------------------------------------------------------------------
+# recurrent-unit/group tier + image tier (ref
+# trainer_config_helpers/networks.py:547 vgg_16_network, :836
+# lstmemory_group, :940 gru_unit, :1002 gru_group, :1076 simple_gru,
+# :1163 simple_gru2, :1226 bidirectional_gru, :1498 dot_product_attention)
+# ---------------------------------------------------------------------------
+
+
+def lstmemory_unit(input, size=None, name=None, out_memory=None,
+                   param_attr=None, act=None, gate_act=None,
+                   state_act=None, input_proj_bias_attr=None,
+                   lstm_bias_attr=None, **_):
+    """One LSTM step built from mixed/projections + lstm_step (ref
+    networks.py lstmemory_unit): `input` is the [B, 4H] pre-projected x
+    contribution; h_prev rides memory(name), the cell rides
+    memory(name_state) carried by get_output(..., "state").  Only
+    meaningful inside a recurrent_group step."""
+    from . import layer as L
+    name = name or "lstmemory_unit"
+    if size is None:
+        size = int(_node_width(input)) // 4
+    out_mem = (L.memory(name=name, size=size)
+               if out_memory is None else out_memory)
+    state_mem = L.memory(name=f"{name}_state", size=size)
+    m = L.mixed(size=size * 4,
+                input=[L.identity_projection(input),
+                       L.full_matrix_projection(out_mem, size=size * 4,
+                                                param_attr=param_attr)],
+                bias_attr=input_proj_bias_attr,
+                name=f"{name}_input_recurrent")
+    lstm_out = L.lstm_step(m, state_mem, size=size, act=act,
+                           gate_act=gate_act, state_act=state_act,
+                           bias_attr=lstm_bias_attr, name=name)
+    L.get_output(lstm_out, "state", name=f"{name}_state")
+    return lstm_out
+
+
+def _node_width(node):
+    """Static feature width of a v2 node, when derivable (fc/mixed
+    carry explicit sizes; data carries type.dim)."""
+    sz = getattr(node, "_size", None) or getattr(
+        getattr(node, "type", None), "dim", None)
+    if sz:
+        return sz
+    raise ValueError("pass size= explicitly (input width is not "
+                     "statically known on this node)")
+
+
+def lstmemory_group(input, size=None, name=None, reverse=False,
+                    param_attr=None, act=None, gate_act=None,
+                    state_act=None, input_proj_bias_attr=None,
+                    lstm_bias_attr=None, **_):
+    """recurrent_group formulation of lstmemory (ref networks.py:836):
+    identical math, but every step's hidden/cell is addressable —
+    the attention-decoder idiom.  `input` is the [B, T, 4H]
+    pre-projected sequence (cf. simple_lstm)."""
+    from . import layer as L
+    name = name or "lstm_group"
+
+    def _step(ipt):
+        return lstmemory_unit(
+            input=ipt, size=size, name=name, act=act,
+            gate_act=gate_act, state_act=state_act,
+            param_attr=param_attr,
+            input_proj_bias_attr=input_proj_bias_attr,
+            lstm_bias_attr=lstm_bias_attr)
+
+    return L.recurrent_group(step=_step, input=input, reverse=reverse,
+                             name=f"{name}_recurrent_group")
+
+
+def gru_unit(input, size=None, name=None, gru_param_attr=None,
+             act=None, gate_act=None, gru_bias_attr=None, **_):
+    """One GRU step over the [B, 3H] pre-projected input (ref
+    networks.py:940 gru_unit); h_prev rides memory(name).  Only
+    meaningful inside a recurrent_group step."""
+    from . import layer as L
+    name = name or "gru_unit"
+    if size is None:
+        size = int(_node_width(input)) // 3
+    out_mem = L.memory(name=name, size=size)
+    out = L.gru_step(input, out_mem, size=size * 3, act=act,
+                     gate_act=gate_act, param_attr=gru_param_attr,
+                     bias_attr=gru_bias_attr, name=name)
+    return out
+
+
+def gru_group(input, size=None, name=None, reverse=False,
+              gru_param_attr=None, act=None, gate_act=None,
+              gru_bias_attr=None, **_):
+    """recurrent_group formulation of grumemory (ref
+    networks.py:1002)."""
+    from . import layer as L
+    name = name or "gru_group"
+
+    def _step(ipt):
+        return gru_unit(input=ipt, size=size, name=name, act=act,
+                        gate_act=gate_act,
+                        gru_param_attr=gru_param_attr,
+                        gru_bias_attr=gru_bias_attr)
+
+    return L.recurrent_group(step=_step, input=input, reverse=reverse,
+                             name=f"{name}_recurrent_group")
+
+
+def simple_gru(input, size, name=None, reverse=False,
+               mixed_param_attr=None, mixed_bias_param_attr=None,
+               gru_param_attr=None, gru_bias_attr=None, act=None,
+               gate_act=None, **_):
+    """mixed(full_matrix -> 3H) + gru_group (ref networks.py:1076)."""
+    from . import layer as L
+    name = name or "simple_gru"
+    m = L.mixed(size=size * 3,
+                input=[L.full_matrix_projection(
+                    input, size=size * 3, param_attr=mixed_param_attr)],
+                bias_attr=mixed_bias_param_attr,
+                name=f"{name}_transform")
+    g = gru_group(input=m, size=size, name=name, reverse=reverse,
+                  gru_param_attr=gru_param_attr,
+                  gru_bias_attr=gru_bias_attr, act=act,
+                  gate_act=gate_act)
+    g._size = size
+    return g
+
+
+def simple_gru2(input, size, name=None, reverse=False,
+                mixed_param_attr=None, mixed_bias_attr=None,
+                gru_param_attr=None, gru_bias_attr=None, act=None,
+                gate_act=None, **_):
+    """fc(3H) + fused grumemory (ref networks.py:1163 — same math as
+    simple_gru through the faster fused recurrence)."""
+    from . import layer as L
+    name = name or "simple_gru2"
+    proj = L.fc(input, size=size * 3, param_attr=mixed_param_attr,
+                bias_attr=mixed_bias_attr, name=f"{name}_transform")
+    g = L.grumemory(proj, size=size, reverse=reverse, act=act,
+                    gate_act=gate_act, name=name)
+    g._size = size
+    return g
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False, **_):
+    """Forward + backward simple_gru2, concat (ref networks.py:1226):
+    last/first steps when return_seq=False, full sequences otherwise."""
+    from . import layer as L
+    name = name or "bidirectional_gru"
+    fwd = simple_gru2(input, size, name=f"{name}_fwd")
+    bwd = simple_gru2(input, size, name=f"{name}_bwd", reverse=True)
+    if return_seq:
+        out = _concat_seq(fwd, bwd, name)
+    else:
+        out = L.concat([L.last_seq(fwd), L.first_seq(bwd)], name=name)
+    out._size = 2 * size
+    return out
+
+
+def _concat_seq(a, b, name):
+    from .config_base import Layer as Node
+
+    def build(ctx):
+        from paddle_tpu import layers as fl
+        return fl.concat([a.to_var(ctx), b.to_var(ctx)], axis=2)
+    return Node(build, [a, b], name=name)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     pool_stride, act=None, conv_padding=0,
+                     conv_stride=1, pool_type=None, name=None, **_):
+    """conv -> batch_norm(act) -> pool (ref networks.py:231)."""
+    from . import layer as L
+    c = L.img_conv(input, filter_size=filter_size,
+                   num_filters=num_filters, padding=conv_padding,
+                   stride=conv_stride, act=None,
+                   name=f"{name}_conv" if name else None)
+    bn = L.batch_norm(c, act=act, name=f"{name}_bn" if name else None)
+    return L.img_pool(bn, pool_size=pool_size, stride=pool_stride,
+                      pool_type=pool_type,
+                      name=f"{name}_pool" if name else None)
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000, **_):
+    """The 5 img_conv_groups + 2 dropout-fc(4096) + softmax head of
+    VGG-16 (ref networks.py:547)."""
+    from . import layer as L
+    from .activation import Relu, Softmax
+    tmp = input_image
+    for filters in ([64, 64], [128, 128], [256, 256, 256],
+                    [512, 512, 512], [512, 512, 512]):
+        tmp = img_conv_group(tmp, conv_num_filter=filters,
+                             conv_padding=1, conv_filter_size=3,
+                             conv_act=Relu(), pool_size=2,
+                             pool_type=None)
+    for _i in range(2):
+        tmp = L.fc(tmp, size=4096, act=Relu())
+        tmp = L.dropout(tmp, dropout_rate=0.5)
+    return L.fc(tmp, size=num_classes, act=Softmax())
+
+
+def text_conv_pool(input, context_len, hidden_size, name=None, **_):
+    """Alias tier of sequence_conv_pool (ref networks.py
+    text_conv_pool)."""
+    return sequence_conv_pool(input, context_len=context_len,
+                              hidden_size=hidden_size, name=name)
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, softmax_param_attr=None,
+                          name=None, **_):
+    """Dot-product attention (ref networks.py:1498): expand the query
+    over time, dot with the encoded sequence, masked softmax over
+    time, scale the attended sequence, sum-pool the context."""
+    from .config_base import Layer as Node
+
+    def build(ctx):
+        from paddle_tpu import layers as fl
+        from .layer import _seq_mask
+        enc = encoded_sequence.to_var(ctx)       # [B, T, D]
+        att = attended_sequence.to_var(ctx)      # [B, T, A]
+        q = transformed_state.to_var(ctx)        # [B, D]
+        scores = fl.reduce_sum(
+            fl.elementwise_mul(enc, fl.unsqueeze(q, [1])),
+            dim=2, keep_dim=True)                # [B, T, 1]
+        mask = _seq_mask(ctx, encoded_sequence)
+        if mask is not None:
+            neg = fl.scale(fl.scale(mask, scale=-1.0, bias=1.0),
+                           scale=-1e9)
+            scores = fl.elementwise_add(scores, fl.unsqueeze(neg, [2]))
+        w = fl.softmax(scores, axis=1)
+        return fl.reduce_sum(fl.elementwise_mul(att, w), dim=1)
+
+    return Node(build, [encoded_sequence, attended_sequence,
+                        transformed_state], name=name)
+
+
+def img_separable_conv(input, num_channels, num_out_channels,
+                       filter_size, stride=1, padding=None, act=None,
+                       name=None, **_):
+    """Depthwise + pointwise conv (ref networks.py
+    img_separable_conv)."""
+    from .config_base import Layer as Node
+
+    def build(ctx):
+        from paddle_tpu import layers as fl
+        from .activation import act_name
+        v = input.to_var(ctx)
+        pad = (filter_size // 2) if padding is None else padding
+        dw = fl.conv2d(v, num_filters=num_channels,
+                       filter_size=filter_size, stride=stride,
+                       padding=pad, groups=num_channels, act=None)
+        return fl.conv2d(dw, num_filters=num_out_channels,
+                         filter_size=1, act=act_name(act))
+    return Node(build, [input], name=name)
+
+
+def small_vgg(input_image, num_channels, num_classes=1000, **_):
+    """The cifar-scale VGG the reference book examples use (ref
+    networks.py small_vgg: 4 conv groups then fc head)."""
+    from . import layer as L
+    from .activation import Relu, Softmax
+    tmp = input_image
+    for filters, drop in (([64, 64], 0.3), ([128, 128], 0.4),
+                          ([256, 256, 256], 0.4),
+                          ([512, 512, 512], 0.4)):
+        tmp = img_conv_group(tmp, conv_num_filter=filters,
+                             conv_padding=1, conv_filter_size=3,
+                             conv_act=Relu(), pool_size=2,
+                             pool_type=None)
+    tmp = L.dropout(tmp, dropout_rate=0.5)
+    tmp = L.fc(tmp, size=512, act=None)
+    tmp = L.batch_norm(tmp, act=Relu())
+    tmp = L.dropout(tmp, dropout_rate=0.5)
+    return L.fc(tmp, size=num_classes, act=Softmax())
+
+
+__all__ += ["lstmemory_unit", "lstmemory_group", "gru_unit",
+            "gru_group", "simple_gru", "simple_gru2",
+            "bidirectional_gru", "img_conv_bn_pool", "vgg_16_network",
+            "text_conv_pool", "dot_product_attention",
+            "img_separable_conv", "small_vgg"]

@@ -1,0 +1,622 @@
+"""v2 mixed_layer/projection plane + recurrent-unit tier + breadth
+tier 2 (ref trainer_config_helpers/layers.py:869 mixed_layer, :430
+full_matrix_projection; networks.py:836 lstmemory_group, :940 gru_unit,
+:547 vgg_16_network, :1498 dot_product_attention)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+
+
+# ---------------------------------------------------------------- mixed
+
+
+def test_mixed_identity_projection_is_identity():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    m = paddle.layer.mixed(size=6,
+                           input=[paddle.layer.identity_projection(x)])
+    arr = np.arange(6, dtype="f4")
+    out = paddle.infer(output_layer=m,
+                       parameters=paddle.parameters.create(m),
+                       input=[(arr,)])
+    np.testing.assert_allclose(np.asarray(out)[0], arr)
+
+
+def test_mixed_identity_offset_slices_columns():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    m = paddle.layer.mixed(
+        size=2, input=[paddle.layer.identity_projection(x, offset=2,
+                                                        size=2)])
+    arr = np.arange(6, dtype="f4")
+    out = paddle.infer(output_layer=m,
+                       parameters=paddle.parameters.create(m),
+                       input=[(arr,)])
+    np.testing.assert_allclose(np.asarray(out)[0], arr[2:4])
+
+
+def test_mixed_sums_projections_and_applies_bias_act():
+    """two identity projections + bias + relu: out = relu(2x + b)."""
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    m = paddle.layer.mixed(
+        size=4,
+        input=[paddle.layer.identity_projection(x),
+               paddle.layer.identity_projection(x)],
+        bias_attr=True, act=paddle.activation.Relu())
+    params = paddle.parameters.create(m)
+    arr = np.array([1.0, -1.0, 2.0, -2.0], "f4")
+    out = np.asarray(paddle.infer(output_layer=m, parameters=params,
+                                  input=[(arr,)]))[0]
+    np.testing.assert_allclose(out, np.maximum(2 * arr, 0), atol=1e-6)
+
+
+def test_mixed_context_manager_iadd_form():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    with paddle.layer.mixed(size=8) as m:
+        m += paddle.layer.full_matrix_projection(x, size=8)
+        m += paddle.layer.full_matrix_projection(x, size=8)
+    out = paddle.infer(output_layer=m,
+                       parameters=paddle.parameters.create(m),
+                       input=[(np.ones(4, "f4"),)])
+    assert np.asarray(out).shape == (1, 8)
+
+
+def test_mixed_rejects_plain_layer_and_empty():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    with pytest.raises(ValueError, match="projection"):
+        paddle.layer.mixed(size=4, input=[x])
+    m = paddle.layer.mixed(size=4)
+    with pytest.raises(ValueError, match="no projections"):
+        paddle.parameters.create(m)
+
+
+def test_trans_full_matrix_projection_shares_transposed_param():
+    """W [size, in] with matmul(x, W^T): check shape via param names."""
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    m = paddle.layer.mixed(
+        size=3, input=[paddle.layer.trans_full_matrix_projection(
+            x, size=3, param_attr=paddle.attr.Param(name="wt"))])
+    params = paddle.parameters.create(m)
+    assert params.get("wt").shape == (3, 4)
+    out = paddle.infer(output_layer=m, parameters=params,
+                       input=[(np.ones(4, "f4"),)])
+    w = params.get("wt")
+    np.testing.assert_allclose(np.asarray(out)[0], w.sum(1), rtol=1e-5)
+
+
+def test_table_projection_is_embedding_lookup():
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(11))
+    m = paddle.layer.mixed(
+        size=5, input=[paddle.layer.table_projection(
+            words, size=5, param_attr=paddle.attr.Param(name="tbl"))])
+    pooled = paddle.layer.pooling_layer(
+        input=m, pooling_type=paddle.pooling.Sum())
+    params = paddle.parameters.create(pooled)
+    out = np.asarray(paddle.infer(output_layer=pooled, parameters=params,
+                                  input=[([3, 7],)]))
+    tbl = params.get("tbl")
+    np.testing.assert_allclose(out[0], tbl[3] + tbl[7], rtol=1e-5)
+
+
+def test_dotmul_scaling_slice_context_projections_build_and_run():
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(9))
+    emb = paddle.layer.embedding(input=words, size=6)
+    ctxp = paddle.layer.mixed(
+        size=18, input=[paddle.layer.context_projection(
+            emb, context_len=3)])
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    dm = paddle.layer.mixed(size=6,
+                            input=[paddle.layer.dotmul_projection(x)])
+    sc = paddle.layer.mixed(size=6,
+                            input=[paddle.layer.scaling_projection(x)])
+    sl = paddle.layer.mixed(
+        size=4, input=[paddle.layer.slice_projection(
+            x, slices=[(0, 2), (4, 6)])])
+    head = paddle.layer.fc(
+        input=[paddle.layer.pooling_layer(
+            input=ctxp, pooling_type=paddle.pooling.Max()), dm, sc, sl],
+        size=3, act=paddle.activation.Softmax())
+    out = paddle.infer(output_layer=head,
+                       parameters=paddle.parameters.create(head),
+                       input=[([1, 2, 3], np.ones(6, "f4"))])
+    assert np.asarray(out).shape == (1, 3)
+    assert np.allclose(np.asarray(out).sum(-1), 1.0, atol=1e-4)
+
+
+def test_context_projection_zero_pads_edges():
+    """identity check: with context_len=3, the first timestep's left
+    block is zeros and its centre block equals emb[t=0]."""
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(7))
+    emb = paddle.layer.embedding(
+        input=words, size=4,
+        param_attr=paddle.attr.Param(name="emb_tbl"))
+    ctxp = paddle.layer.mixed(
+        size=12, input=[paddle.layer.context_projection(
+            emb, context_len=3)])
+    params = paddle.parameters.create(ctxp)
+    out = np.asarray(paddle.infer(output_layer=ctxp, parameters=params,
+                                  input=[([2, 5],)]))
+    tbl = params.get("emb_tbl")
+    np.testing.assert_allclose(out[0, 0, :4], np.zeros(4), atol=0)
+    np.testing.assert_allclose(out[0, 0, 4:8], tbl[2], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 8:], tbl[5], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1, 8:], np.zeros(4), atol=0)
+
+
+def test_dotmul_operator_multiplies_two_layers():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(4))
+    m = paddle.layer.mixed(
+        size=4, input=[paddle.layer.dotmul_operator(a=x, b=y, scale=2.0)])
+    xa = np.array([1, 2, 3, 4], "f4")
+    ya = np.array([2, 2, 0.5, 1], "f4")
+    out = paddle.infer(output_layer=m,
+                       parameters=paddle.parameters.create(m),
+                       input=[(xa, ya)])
+    np.testing.assert_allclose(np.asarray(out)[0], 2 * xa * ya)
+
+
+# ------------------------------------------------- recurrent unit tier
+
+
+def _train_seq_model(pred_fn, n_cls=2, vocab=30):
+    """mirror of test_v2_api._train_seq_model: tiny synthetic
+    sequence-classification run asserting the loss decreases."""
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(48):
+            n = rng.randint(2, 8)
+            cls = rng.randint(n_cls)
+            lo, hi = (1, vocab // 2) if cls == 0 else (vocab // 2, vocab)
+            yield [int(w) for w in rng.randint(lo, hi, n)], cls
+
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(n_cls))
+    feat = pred_fn(words)
+    out = paddle.layer.fc(input=feat, size=n_cls,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, batch_size=16), num_passes=8,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+def test_lstmemory_group_classifier_trains():
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=12)
+        proj = paddle.layer.mixed(
+            size=32, input=[paddle.layer.full_matrix_projection(
+                emb, size=32)])
+        lstm = paddle.networks.lstmemory_group(input=proj, size=8)
+        return paddle.layer.last_seq(input=lstm)
+
+    _train_seq_model(pred)
+
+
+def test_gru_group_and_simple_gru_train():
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=12)
+        return paddle.layer.last_seq(
+            input=paddle.networks.simple_gru(input=emb, size=8))
+
+    _train_seq_model(pred)
+
+
+def test_simple_gru2_and_bidirectional_gru_train():
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=12)
+        return paddle.networks.bidirectional_gru(input=emb, size=6)
+
+    _train_seq_model(pred)
+
+
+def test_recurrent_layer_classifier_trains():
+    def pred(words):
+        emb = paddle.layer.embedding(input=words, size=10)
+        rec = paddle.layer.recurrent(input=emb)
+        return paddle.layer.last_seq(input=rec)
+
+    _train_seq_model(pred)
+
+
+def test_static_input_visible_every_step():
+    """recurrent_group with a StaticInput: step output = x_t + static
+    query; verify the static vector is added at EVERY timestep."""
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(9))
+    q = paddle.layer.data(name="q", type=paddle.data_type.dense_vector(4))
+    emb = paddle.layer.embedding(
+        input=words, size=4, param_attr=paddle.attr.Param(name="etbl"))
+
+    def step(x_t, q_t):
+        return paddle.layer.addto(input=[x_t, q_t], name="st_out")
+
+    grp = paddle.layer.recurrent_group(
+        step=step, input=[emb, paddle.layer.StaticInput(q)])
+    params = paddle.parameters.create(grp)
+    qa = np.array([1.0, 2.0, 3.0, 4.0], "f4")
+    out = np.asarray(paddle.infer(output_layer=grp, parameters=params,
+                                  input=[([3, 6], qa)]))
+    tbl = params.get("etbl")
+    np.testing.assert_allclose(out[0, 0], tbl[3] + qa, rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], tbl[6] + qa, rtol=1e-6)
+
+
+def test_dot_product_attention_decoder():
+    """dot_product_attention inside a decoder recurrent_group."""
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(20))
+    emb = paddle.layer.embedding(input=words, size=8)
+    enc = paddle.networks.simple_gru(input=emb, size=8)
+
+    def step(trg, enc_seq):
+        state = paddle.layer.memory(name="dec", size=8)
+        ctxv = paddle.networks.dot_product_attention(
+            encoded_sequence=enc_seq, attended_sequence=enc_seq,
+            transformed_state=state)
+        return paddle.layer.fc(input=[trg, ctxv], size=8,
+                               act=paddle.activation.Tanh(), name="dec")
+
+    dec = paddle.layer.recurrent_group(
+        step=step, input=[emb, paddle.layer.StaticInput(enc)])
+    out = paddle.layer.fc(input=paddle.layer.last_seq(input=dec), size=2,
+                          act=paddle.activation.Softmax())
+    probs = paddle.infer(output_layer=out,
+                         parameters=paddle.parameters.create(out),
+                         input=[([1, 2, 3],), ([4, 5],)])
+    assert np.asarray(probs).shape == (2, 2)
+
+
+# --------------------------------------------------- breadth tier 2
+
+
+def test_breadth2_vector_ops_numeric():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(4))
+    w = paddle.layer.data(name="wt", type=paddle.data_type.dense_vector(1))
+    outs = {
+        "power": paddle.layer.power(input=x, weight=w),
+        "repeat_row": paddle.layer.repeat(input=x, num_repeats=2),
+        "out_prod": paddle.layer.out_prod(x, y),
+        "scale_shift": paddle.layer.scale_shift(input=x),
+        "linear_comb": paddle.layer.linear_comb(weights=w, vectors=x,
+                                                size=4),
+    }
+    # one infer per op keeps failures attributable
+    xa = np.array([1.0, 2.0, 3.0, 4.0], "f4")
+    ya = np.array([2.0, 1.0, 0.5, 1.0], "f4")
+    wa = np.array([2.0], "f4")
+    feed = [(xa, ya, wa)]
+    feeding = {"x": 0, "y": 1, "wt": 2}
+
+    got = np.asarray(paddle.infer(
+        output_layer=outs["power"],
+        parameters=paddle.parameters.create(outs["power"]),
+        input=[(xa, wa)], feeding={"x": 0, "wt": 1}))
+    np.testing.assert_allclose(got[0], xa ** 2, rtol=1e-5)
+
+    got = np.asarray(paddle.infer(
+        output_layer=outs["repeat_row"],
+        parameters=paddle.parameters.create(outs["repeat_row"]),
+        input=[(xa,)]))
+    np.testing.assert_allclose(got[0], np.tile(xa, 2))
+
+    got = np.asarray(paddle.infer(
+        output_layer=outs["out_prod"],
+        parameters=paddle.parameters.create(outs["out_prod"]),
+        input=[(xa, ya)], feeding={"x": 0, "y": 1}))
+    np.testing.assert_allclose(got[0], np.outer(xa, ya).ravel(),
+                               rtol=1e-6)
+
+    got = np.asarray(paddle.infer(
+        output_layer=outs["linear_comb"],
+        parameters=paddle.parameters.create(outs["linear_comb"]),
+        input=[(xa, wa)], feeding={"x": 0, "wt": 1}))
+    np.testing.assert_allclose(got[0], 2.0 * xa, rtol=1e-6)
+
+
+def test_breadth2_conv_shift_circular():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(5))
+    k = paddle.layer.data(name="k", type=paddle.data_type.dense_vector(3))
+    out = paddle.layer.conv_shift(x, k)
+    xa = np.array([1, 2, 3, 4, 5], "f4")
+    ka = np.array([1, 0, 0], "f4")   # kernel peaked at j=0 => shift -1
+    got = np.asarray(paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[(xa, ka)], feeding={"x": 0, "k": 1}))
+    np.testing.assert_allclose(got[0], np.roll(xa, 1), rtol=1e-6)
+
+
+def test_breadth2_feature_layers_build_and_train():
+    """tensor/gated_unit/fm/dotmul heads train end-to-end."""
+    rng = np.random.RandomState(1)
+
+    def reader():
+        for _ in range(32):
+            x = rng.randn(6).astype("f4")
+            yield x, int(x.sum() > 0)
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(2))
+    feats = [
+        paddle.layer.tensor(x, x, size=4),
+        paddle.layer.gated_unit(input=x, size=4),
+        paddle.layer.factorization_machine(input=x, factor_size=3),
+        paddle.layer.mixed(size=6,
+                           input=[paddle.layer.dotmul_projection(x)]),
+    ]
+    out = paddle.layer.fc(input=paddle.layer.concat(input=feats), size=2,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.02))
+    costs = []
+    trainer.train(reader=paddle.batch(reader, batch_size=16),
+                  num_passes=6,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0]
+
+
+def test_breadth2_image_tier_builds():
+    """pad/crop/spp/img_cmrnorm/cross_channel_norm/bilinear/upsample/
+    block_expand/switch_order/rotate over a [2, 8, 8] image."""
+    img = paddle.layer.data(
+        name="img", type=paddle.data_type.dense_vector(2 * 8 * 8),
+        height=8, width=8)
+    padded = paddle.layer.pad(input=img, pad_c=(1, 1), pad_h=(0, 0),
+                              pad_w=(0, 0))
+    cropped = paddle.layer.crop(input=padded, axis=1, offset=[1, 0, 0],
+                                shape=[2, 8, 8])
+    feats = [
+        paddle.layer.spp(input=cropped, pyramid_height=2),
+        paddle.layer.img_cmrnorm(input=cropped, size=3),
+        paddle.layer.cross_channel_norm(input=cropped),
+        paddle.layer.bilinear_interp(input=cropped, out_size_x=4,
+                                     out_size_y=4),
+        paddle.layer.upsample(input=cropped, scale=2),
+        paddle.layer.switch_order(input=cropped),
+        paddle.layer.rotate(input=cropped, height=8, width=8),
+        paddle.layer.pooling_layer(
+            input=paddle.layer.block_expand(
+                input=cropped, block_x=4, block_y=4, stride_x=4,
+                stride_y=4),
+            pooling_type=paddle.pooling.Max()),
+    ]
+    pooled = [paddle.layer.fc(input=f, size=3) for f in feats]
+    out = paddle.layer.fc(input=paddle.layer.concat(input=pooled),
+                          size=2, act=paddle.activation.Softmax())
+    got = paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[(np.random.RandomState(0).rand(128).astype("f4"),)])
+    assert np.asarray(got).shape == (1, 2)
+
+
+def test_breadth2_sequence_tier_builds():
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(12))
+    emb = paddle.layer.embedding(input=words, size=6)
+    feats = [
+        paddle.layer.seq_reshape(input=emb, reshape_size=3),
+        paddle.layer.seq_concat(emb, emb),
+        paddle.layer.seq_slice(input=emb, starts=0, ends=2),
+        paddle.layer.sub_seq(input=emb, offsets=1, sizes=1),
+        paddle.layer.row_conv(input=emb, context_len=2),
+    ]
+    pooled = [paddle.layer.pooling_layer(
+        input=f, pooling_type=paddle.pooling.Max()) for f in feats]
+    out = paddle.layer.fc(input=paddle.layer.concat(input=pooled),
+                          size=2, act=paddle.activation.Softmax())
+    got = paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[([1, 2, 3, 4],)])
+    assert np.asarray(got).shape == (1, 2)
+
+
+def test_breadth2_cost_layers_train():
+    rng = np.random.RandomState(3)
+    data = [(rng.randn(5).astype("f4"),) for _ in range(32)]
+    data = [(x, int(x.sum() > 0)) for (x,) in data]
+
+    def reader():
+        yield from data
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(5))
+    ilabel = paddle.layer.data(name="label",
+                               type=paddle.data_type.integer_value(2))
+    probs = paddle.layer.fc(input=x, size=2,
+                            act=paddle.activation.Softmax())
+    score = paddle.layer.fc(input=x, size=1)
+    binlab = paddle.layer.mixed(
+        size=1, input=[paddle.layer.identity_projection(
+            paddle.layer.data(name="ylab",
+                              type=paddle.data_type.dense_vector(1)))])
+    costs = [
+        paddle.layer.cross_entropy(input=probs, label=ilabel),
+        paddle.layer.cross_entropy_with_selfnorm(input=probs,
+                                                 label=ilabel),
+        paddle.layer.nce(input=x, label=ilabel, num_classes=2,
+                         num_neg_samples=1),
+        paddle.layer.hsigmoid(input=x, label=ilabel, num_classes=2),
+        paddle.layer.huber_classification_cost(input=score,
+                                               label=ilabel),
+        paddle.layer.multi_binary_label_cross_entropy(
+            input=paddle.layer.fc(input=x, size=1,
+                                  act=paddle.activation.Sigmoid()),
+            label=binlab),
+    ]
+    total = paddle.layer.addto(input=costs)
+    params = paddle.parameters.create(total)
+    trainer = paddle.trainer.SGD(
+        cost=total, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.02))
+    seen = []
+
+    def rd():
+        for xv, c in reader():
+            yield xv, c, np.array([float(c)], "f4")
+
+    trainer.train(reader=paddle.batch(rd, batch_size=16), num_passes=10,
+                  event_handler=lambda e: seen.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None,
+                  feeding={"x": 0, "label": 1, "ylab": 2})
+    # nce resamples noise each step: compare pass means, not endpoints
+    assert np.mean(seen[-2:]) < np.mean(seen[:2]), seen
+
+
+def test_breadth2_ctc_cost_trains():
+    rng = np.random.RandomState(4)
+    V = 5          # classes incl. blank at index 4
+
+    def reader():
+        for _ in range(24):
+            n = rng.randint(3, 6)
+            lab = [int(v) for v in rng.randint(0, V - 1, 2)]
+            yield [int(w) for w in rng.randint(0, 9, n)], lab
+
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(9))
+    lab = paddle.layer.data(
+        name="lab", type=paddle.data_type.integer_value_sequence(V))
+    emb = paddle.layer.embedding(input=words, size=8)
+    logits = paddle.layer.fc(input=emb, size=V)
+    cost = paddle.layer.ctc(input=logits, label=lab, blank=V - 1)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.02))
+    seen = []
+    trainer.train(reader=paddle.batch(reader, batch_size=8),
+                  num_passes=4,
+                  event_handler=lambda e: seen.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert np.isfinite(seen).all() and seen[-1] < seen[0]
+
+
+def test_breadth2_misc_infer_layers():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    probs = paddle.layer.fc(input=x, size=4,
+                            act=paddle.activation.Softmax())
+    sid = paddle.layer.sampling_id(input=probs)
+    got = np.asarray(paddle.infer(
+        output_layer=sid, parameters=paddle.parameters.create(sid),
+        input=[(np.ones(6, "f4"),)]))
+    assert got.shape[0] == 1 and 0 <= int(got.ravel()[0]) < 4
+
+    res = paddle.layer.resize(input=x, size=3)
+    got = np.asarray(paddle.infer(
+        output_layer=res, parameters=paddle.parameters.create(res),
+        input=[(np.arange(6).astype("f4"),)]))
+    assert got.shape == (2, 3)
+
+    sel = paddle.layer.data(name="sel",
+                            type=paddle.data_type.integer_value(2))
+    a = paddle.layer.fc(input=x, size=3)
+    b = paddle.layer.fc(input=x, size=3)
+    mux = paddle.layer.multiplex(input=[sel, a, b])
+    got = np.asarray(paddle.infer(
+        output_layer=mux, parameters=paddle.parameters.create(mux),
+        input=[(np.ones(6, "f4"), 1)], feeding={"x": 0, "sel": 1}))
+    assert got.shape == (1, 3)
+
+    pr = paddle.layer.prelu(input=x)
+    got = np.asarray(paddle.infer(
+        output_layer=pr, parameters=paddle.parameters.create(pr),
+        input=[(np.arange(-3, 3).astype("f4"),)]))
+    assert got.shape == (1, 6)
+
+
+def test_vgg_16_network_builds_and_infers():
+    img = paddle.layer.data(
+        name="img", type=paddle.data_type.dense_vector(3 * 32 * 32),
+        height=32, width=32)
+    out = paddle.networks.vgg_16_network(img, num_channels=3,
+                                         num_classes=4)
+    got = np.asarray(paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[(np.random.RandomState(0).rand(3072).astype("f4"),)]))
+    assert got.shape == (1, 4)
+    assert np.allclose(got.sum(-1), 1.0, atol=1e-3)
+
+
+def test_remaining_aliases_and_conv_projection():
+    """conv_projection in mixed; gru_step_naive group; warp_ctc and
+    convex_comb delegate correctly."""
+    img = paddle.layer.data(
+        name="img", type=paddle.data_type.dense_vector(1 * 6 * 6),
+        height=6, width=6)
+    m = paddle.layer.mixed(
+        size=0, input=[
+            paddle.layer.conv_projection(img, filter_size=3,
+                                         num_filters=2, padding=1),
+            paddle.layer.conv_projection(img, filter_size=3,
+                                         num_filters=2, padding=1)])
+    out = paddle.layer.fc(input=m, size=2,
+                          act=paddle.activation.Softmax())
+    got = np.asarray(paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[(np.ones(36, "f4"),)]))
+    assert got.shape == (1, 2)
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    w = paddle.layer.data(name="wt", type=paddle.data_type.dense_vector(1))
+    cc = paddle.layer.convex_comb(weights=w, vectors=x, size=4)
+    got = np.asarray(paddle.infer(
+        output_layer=cc, parameters=paddle.parameters.create(cc),
+        input=[(np.arange(4).astype("f4"), np.array([3.0], "f4"))],
+        feeding={"x": 0, "wt": 1}))
+    np.testing.assert_allclose(got[0], 3.0 * np.arange(4), rtol=1e-6)
+
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(9))
+    lab = paddle.layer.data(
+        name="lab", type=paddle.data_type.integer_value_sequence(4))
+    emb = paddle.layer.embedding(input=words, size=6)
+    logits = paddle.layer.fc(input=emb, size=4)
+    wc = paddle.layer.warp_ctc(input=logits, label=lab, blank=3)
+
+    def _step(ipt):
+        return paddle.layer.gru_step_naive(
+            ipt, paddle.layer.memory(name="gn", size=2), name="gn")
+
+    proj = paddle.layer.fc(input=emb, size=6, bias_attr=False)
+    gn = paddle.layer.recurrent_group(step=_step, input=proj)
+    pooled = paddle.layer.pooling_layer(input=gn,
+                                        pooling_type=paddle.pooling.Max())
+    head = paddle.layer.fc(input=pooled, size=2,
+                           act=paddle.activation.Softmax())
+    total = paddle.layer.addto(
+        input=[wc, paddle.layer.sum_cost(input=head)])
+    params = paddle.parameters.create(total)
+    trainer = paddle.trainer.SGD(
+        cost=total, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+    seen = []
+    rng = np.random.RandomState(5)
+
+    def reader():
+        for _ in range(16):
+            n = rng.randint(3, 6)
+            yield ([int(v) for v in rng.randint(0, 9, n)],
+                   [int(v) for v in rng.randint(0, 3, 2)])
+
+    trainer.train(reader=paddle.batch(reader, batch_size=8),
+                  num_passes=3,
+                  event_handler=lambda e: seen.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert np.isfinite(seen).all()
