@@ -12,6 +12,7 @@ from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..core.enforce import check_arg
 from ..framework.layer_helper import LayerHelper, ParamAttr
 from ..framework.initializer import ConstantInitializer, NormalInitializer
 from ..framework.program import Variable, default_main_program
@@ -840,11 +841,16 @@ def pipeline_boundary(x, name=None):
     reference has no pipeline parallelism — SURVEY §2.2; its later
     device_guard annotations play this role).  Identity op in
     un-transpiled programs; with pp_degree = K the program needs K-1
-    markers at shape-homogeneous activation boundaries."""
+    markers.  `x` may be one Variable or a list/tuple — a PYTREE
+    boundary payload (e.g. hidden + a residual branch); every marker in
+    a program must carry the same tuple of shapes/dtypes (the ppermute
+    ring payload)."""
     helper = LayerHelper("pipeline_boundary", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op("pipeline_boundary", {"X": [x]}, {"Out": [out]}, {})
-    return out
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = [helper.create_variable_for_type_inference(v.dtype)
+            for v in xs]
+    helper.append_op("pipeline_boundary", {"X": xs}, {"Out": outs}, {})
+    return outs if isinstance(x, (list, tuple)) else outs[0]
 
 
 def fused_mha(x, n_head, causal=False, kv=None, size=None, out_size=None,
@@ -857,6 +863,9 @@ def fused_mha(x, n_head, causal=False, kv=None, size=None, out_size=None,
     helper = LayerHelper("fused_mha", name=name)
     D = int(x.shape[-1])
     E = int(size or D)
+    check_arg(E % n_head == 0,
+              f"fused_mha: model width {E} is not divisible by "
+              f"n_head={n_head}")
     d_out = int(out_size or D)
     src = kv if kv is not None else x
     Dk = int(src.shape[-1])
@@ -876,6 +885,43 @@ def fused_mha(x, n_head, causal=False, kv=None, size=None, out_size=None,
     helper.append_op("fused_mha", inputs, {"Out": [out]},
                      {"n_head": n_head, "causal": causal})
     return out
+
+
+def moe(input, num_experts, d_hidden, capacity_factor=1.25,
+        aux_weight=1e-2, param_attr=None, name=None):
+    """Switch (top-1) mixture-of-experts FFN: ONE op owning the gate
+    [D, E] and the expert stacks W1 [E, D, F] / W2 [E, F, D]
+    (ops/fused_ops.py moe_ffn; TPU-native capability — the 2018
+    reference has no MoE).  input: [B, T, D] or [N, D].
+
+    Returns (out, aux_loss): out has input's shape; aux_loss [1] is the
+    Switch load-balance loss already scaled by aux_weight — ADD it to
+    the training cost.  `ExpertParallelTranspiler` shards the expert
+    stacks over a mesh axis and the op dispatches via all_to_all.
+    """
+    helper = LayerHelper("moe", name=name)
+    D = int(input.shape[-1])
+    E, F = int(num_experts), int(d_hidden)
+    check_arg(E >= 1, f"moe: num_experts must be >= 1, got {E}")
+
+    def attr(sfx):
+        return _suffixed_param_attr(param_attr, sfx)
+
+    gate = helper.create_parameter(attr("gate"), shape=[D, E],
+                                   dtype=input.dtype)
+    w1 = helper.create_parameter(attr("w1"), shape=[E, D, F],
+                                 dtype=input.dtype)
+    w2 = helper.create_parameter(attr("w2"), shape=[E, F, D],
+                                 dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "moe_ffn",
+        {"X": [input], "Gate": [gate], "W1": [w1], "W2": [w2]},
+        {"Out": [out], "AuxLoss": [aux]},
+        {"capacity_factor": float(capacity_factor),
+         "aux_weight": float(aux_weight)})
+    return out, aux
 
 
 def fused_attention_qkv(q, k, v, n_head, causal=False, name=None):

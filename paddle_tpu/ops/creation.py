@@ -125,8 +125,9 @@ def _pipeline_boundary(ctx, ins, attrs):
     """Identity marker: layers.pipeline_boundary cuts go here.  Inert in
     un-transpiled programs; transpiler/pipeline.py partitions the op
     list at these markers and the executor's shard_map plane runs the
-    stages as a GPipe schedule over the pipe axis."""
-    return {"Out": [single_input(ins)]}
+    stages as a GPipe schedule over the pipe axis.  The payload may be
+    a tuple of tensors (pytree boundary)."""
+    return {"Out": list(ins["X"])}
 
 
 @register_op("assign_value")

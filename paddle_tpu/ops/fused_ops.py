@@ -429,3 +429,33 @@ def _get_places(ctx, ins, attrs):
     if cap:
         n = min(n, cap)
     return {"Out": [jnp.asarray([n], jnp.int32)]}
+
+
+@register_op("moe_ffn")
+def _moe_ffn(ctx, ins, attrs):
+    """Switch (top-1) mixture-of-experts FFN (TPU-native capability;
+    the 2018 reference has no MoE).  X [B, T, D] or [N, D];
+    Gate [D, E]; W1 [E(l), D, F]; W2 [E(l), F, D].  Outputs Out (X's
+    shape) and AuxLoss [1] (load-balance loss, ALREADY scaled by
+    aux_weight — add it to the training cost).
+
+    Under ExpertParallelTranspiler the executor runs this inside
+    shard_map with `ctx.ep_axis` in scope and W1/W2 sharded over the
+    expert axis; dispatch/combine then ride all_to_all
+    (parallel/moe.py).
+    """
+    from ..parallel.moe import switch_moe
+    x = single_input(ins, "X")
+    gate_w = single_input(ins, "Gate")
+    w1 = single_input(ins, "W1")
+    w2 = single_input(ins, "W2")
+    cf = float(attrs.get("capacity_factor", 1.25))
+    aw = float(attrs.get("aux_weight", 1e-2))
+    shape = x.shape
+    xf = x.reshape(-1, shape[-1])
+    from .math_ops import amp_inputs
+    xf, gate_w, w1, w2 = amp_inputs(xf, gate_w, w1, w2)
+    out, aux = switch_moe(xf, gate_w, w1, w2, cf,
+                          ep_axis=getattr(ctx, "ep_axis", None))
+    return {"Out": [out.reshape(shape).astype(x.dtype)],
+            "AuxLoss": [(aux * aw).reshape(1).astype(jnp.float32)]}

@@ -1,0 +1,74 @@
+"""Switch (top-1) mixture-of-experts FFN — the shared compute used by
+the Program-plane `moe_ffn` op and testable standalone.
+
+The 2018 reference has no MoE; this is the TPU-native expert-parallel
+capability (scaling-book recipe): tokens pick one expert by gating,
+dispatch rides an all_to_all over the expert mesh axis, experts apply
+their FFN slice, a second all_to_all combines.  jax.grad differentiates
+straight through both collectives, which is what makes the expert-
+sharded parameter gradients complete WITHOUT an allreduce (the a2a vjp
+routes every rank's cotangents back to the owning expert shard).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def switch_moe(x, gate_w, w1, w2, capacity_factor: float,
+               ep_axis: str = None):
+    """x [S, D] local tokens; gate_w [D, E] (E = GLOBAL experts,
+    replicated); w1 [El, D, F], w2 [El, F, D] (the LOCAL expert slice —
+    El == E without expert parallelism).  Returns (out [S, D], aux
+    load-balance loss scalar, Switch Transformer eq. 4).
+
+    With ep_axis set (inside shard_map), experts are sharded over the
+    axis and the dispatch/combine each ride one all_to_all.
+    """
+    S, D = x.shape
+    E = gate_w.shape[-1]
+    El = w1.shape[0]
+    if E % El:
+        raise ValueError(f"global experts {E} not divisible by local "
+                         f"slice {El}")
+    ep = E // El
+    if ep > 1 and ep_axis is None:
+        raise ValueError(
+            f"w1 carries {El} of {E} experts but no expert axis is in "
+            f"scope — run through ExpertParallelTranspiler + "
+            f"Executor(mesh=...)")
+    dtype = x.dtype
+    C = max(1, int(capacity_factor * S / E))
+
+    logits = jnp.einsum("sd,de->se", x, gate_w.astype(dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    expert = jnp.argmax(probs, -1)                       # [S]
+    gate = jnp.max(probs, -1)                            # [S]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+    density = jnp.mean(onehot, 0)
+    density_proxy = jnp.mean(probs, 0)
+    aux = E * jnp.sum(density * density_proxy)
+    # position of each token within its expert; drop beyond capacity
+    pos = (jnp.cumsum(onehot, 0) - 1.0) * onehot         # [S, E]
+    keep = (pos < C) & (onehot > 0)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                            dtype=jnp.float32) * keep[..., None]  # [S,E,C]
+    combine = pos_oh * gate[:, None, None]
+    xd = jnp.einsum("sec,sd->ecd", pos_oh,
+                    x.astype(jnp.float32)).astype(dtype)          # [E,C,D]
+    if ep > 1:
+        # rows of E -> owning rank; gather my experts' token slabs
+        xd = lax.all_to_all(xd, ep_axis, split_axis=0, concat_axis=0,
+                            tiled=True)
+        xd = xd.reshape(ep, El, C, D).transpose(1, 0, 2, 3)
+        xd = xd.reshape(El, ep * C, D)                   # [El, ep*C, D]
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", xd, w1.astype(dtype)))
+    o = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype))
+    if ep > 1:
+        o = o.reshape(El, ep, C, D).transpose(1, 0, 2, 3).reshape(E, C, D)
+        o = lax.all_to_all(o, ep_axis, split_axis=0, concat_axis=0,
+                           tiled=True)
+    out = jnp.einsum("sec,ecd->sd", combine,
+                     o.astype(jnp.float32)).astype(dtype)
+    return out, aux

@@ -19,6 +19,7 @@ from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
 from .tensor_parallel import TensorParallelTranspiler
 from .context_parallel import ContextParallelTranspiler
+from .expert_parallel import ExpertParallelTranspiler
 from .pipeline import PipelineTranspiler
 
 

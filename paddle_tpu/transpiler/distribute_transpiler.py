@@ -97,8 +97,27 @@ class DistributeTranspiler:
             return                      # inference program: nothing to do
         idx = ad_idx[0]
         grads = list(block.ops[idx].attrs.get("grads", []))
+        params = list(block.ops[idx].attrs.get("params", []))
+        p_of_g = dict(zip(grads, params))
         insert_at = idx + 1
         for g in grads:
+            # a parameter SHARDED over this axis (expert stacks) gets a
+            # complete local-slice gradient already — the collective
+            # vjps (all_to_all) routed every rank's cotangents to the
+            # owning shard.  Allreducing would mix unrelated expert
+            # slices; only the 1/N (loss is a local mean) applies.
+            pvar = (block.var(p_of_g[g])
+                    if p_of_g.get(g) and block.has_var(p_of_g[g])
+                    else None)
+            sharded = (pvar is not None and
+                       axis_name in (getattr(pvar, "sharding", None)
+                                     or ()))
+            if sharded:
+                block.append_op("scale", {"X": [g]}, {"Out": [g]},
+                                {"scale": 1.0 / self.trainer_num},
+                                index=insert_at)
+                insert_at += 1
+                continue
             ar = g + "@ALLREDUCE"
             if not block.has_var(ar):
                 block.create_var(name=ar, dtype="float32")

@@ -8,9 +8,11 @@ discipline for the TPU-native capability: after transpile, the SAME
 Program a user built for one device trains GPipe-style over a mesh
 "pipe" axis —
 
-  * the user marks stage cuts with ``layers.pipeline_boundary(x)``
-    (identity ops in un-transpiled programs; the later reference
-    generations play this role with device_guard annotations);
+  * the user marks stage cuts with ``layers.pipeline_boundary(x)`` —
+    x may be a LIST of activations (pytree payload, e.g. hidden +
+    residual); identity ops in un-transpiled programs (the later
+    reference generations play this role with device_guard
+    annotations);
   * the executor's shard_map plane partitions the forward op list at
     the markers into pp_degree stage sub-programs and runs the GPipe
     schedule: M microbatches stream through a ``lax.scan`` of ticks,
@@ -29,7 +31,18 @@ Composes with DistributeTranspiler (data parallelism): transpile the
 program with both and run with ``Executor(place, mesh=Mesh(devices.
 reshape(dp, pp), ("data", "pipe")))``.  Under the pipeline plane only
 the loss (and persistable state) is fetchable — per-layer activations
-live inside the scan.
+live inside the scan (the executor validates fetches up front).
+
+Schedule note: this is GPipe (all-forward-then-all-backward via the
+scan's vjp).  Non-interleaved 1F1B has the SAME bubble fraction,
+(P-1)/(M+P-1) — its advantage is peak memory, bounding in-flight
+microbatch state to P instead of M; here the per-tick jax.checkpoint
+already bounds the per-tick stash to the boundary payload, so the
+residual gap vs 1F1B is the M-tick carry history the scan vjp saves
+(M x payload vs 1F1B's P x full-stage activations — which of the two
+is smaller depends on the cut).  True 1F1B in this design needs
+manual vjp-residual ring buffers in the scan carry; recorded as the
+known next step rather than approximated.
 """
 from __future__ import annotations
 
@@ -70,16 +83,20 @@ class PipelineTranspiler:
             f"pipeline_boundary markers in the program, found "
             f"{len(markers)} (insert layers.pipeline_boundary at the "
             f"stage cuts)")
-        # boundary activations are the pipe payload: one static shape
+        # boundary payloads (pytrees of activations) ride the ppermute
+        # ring as the scan carry: every marker must carry the same
+        # TUPLE of shapes/dtypes
         shapes = set()
         for op in markers:
-            v = block.var(op.outputs["Out"][0])
-            if v.shape is not None:
-                shapes.add((tuple(v.shape), str(v.dtype)))
+            sig = tuple(
+                (tuple(block.var(n).shape or ()), str(block.var(n).dtype))
+                for n in op.outputs["Out"])
+            shapes.add(sig)
         check_arg(
             len(shapes) <= 1,
-            f"pipeline_boundary activations must share one shape/dtype "
-            f"(the ppermute payload); found {sorted(shapes)}")
+            f"pipeline_boundary payloads must share one tuple of "
+            f"shapes/dtypes (the ppermute ring payload); found "
+            f"{sorted(shapes)}")
         ad_idx = [i for i, op in enumerate(block.ops)
                   if op.type == "autodiff"]
         check_arg(ad_idx, "pipeline transpile needs a training program "

@@ -23,8 +23,8 @@ def test_while_loop_accumulates():
     exe = pt.Executor(pt.CPUPlace())
     out_acc, out_i = exe.run(pt.default_main_program(),
                              fetch_list=[acc, i])
-    assert float(out_acc) == 10.0
-    assert float(out_i) == 5.0
+    assert float(np.asarray(out_acc).ravel()[0]) == 10.0
+    assert float(np.asarray(out_i).ravel()[0]) == 5.0
 
 
 def test_switch_picks_branch():
@@ -41,7 +41,7 @@ def test_switch_picks_branch():
             layers.assign(v, lr)
     exe = pt.Executor(pt.CPUPlace())
     out, = exe.run(pt.default_main_program(), fetch_list=[lr])
-    assert abs(float(out) - 0.01) < 1e-7
+    assert abs(float(np.asarray(out).ravel()[0]) - 0.01) < 1e-7
 
 
 def test_switch_first_case():
@@ -57,7 +57,7 @@ def test_switch_first_case():
             layers.assign(v, lr)
     exe = pt.Executor(pt.CPUPlace())
     out, = exe.run(pt.default_main_program(), fetch_list=[lr])
-    assert abs(float(out) - 0.1) < 1e-7
+    assert abs(float(np.asarray(out).ravel()[0]) - 0.1) < 1e-7
 
 
 def test_static_rnn_matches_numpy():

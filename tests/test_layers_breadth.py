@@ -146,7 +146,7 @@ def test_edit_distance_layer():
         return d, n
 
     d, n = run_net(build, {"h": h, "r": r})
-    assert float(d[0]) == 1.0
+    assert float(np.asarray(d[0]).ravel()[0]) == 1.0
 
 
 def test_nce_hsigmoid_sampling():

@@ -18,7 +18,7 @@ def _run_schedule(build_fn, steps):
     vals = []
     for _ in range(steps):
         out, = exe.run(pt.default_main_program(), fetch_list=[lr])
-        vals.append(float(out))
+        vals.append(float(np.asarray(out).ravel()[0]))
     return vals
 
 
@@ -95,6 +95,6 @@ def test_scheduler_drives_optimizer():
         lo, lv = exe.run(pt.default_main_program(), feed=feed,
                          fetch_list=[loss, lr])
         losses.append(float(lo))
-        lrs.append(float(lv))
+        lrs.append(float(np.asarray(lv).ravel()[0]))
     assert losses[-1] < losses[0]
     assert lrs[0] != lrs[-1]          # schedule actually advanced

@@ -920,6 +920,8 @@ EXEMPT = {
     "fused_attention": "tests/test_pallas_kernels.py",
     "fused_mha": "tests/test_pallas_kernels.py fused_mha parity/cross/train",
     "pipeline_boundary": "tests/test_pipeline_parallel.py (identity + GPipe plane)",
+    "moe_ffn": "tests/test_expert_parallel.py (dense-equivalence + ep mesh)",
+    "sequence_context": "tests/test_v2_mixed_tier.py context_projection identity checks",
     "fused_lm_head_loss": "tests/test_models.py fused-vs-unfused parity",
     "save": "io op — tests/test_reader_trainer.py save/load-as-ops",
     "load": "io op — dedicated test",
