@@ -41,15 +41,9 @@ from ..core.profiler import RecordEvent
 from .program import Program, Variable, default_main_program
 from .registry import LowerContext, get_op_def
 
-def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
-                data_names):
-    """GPipe schedule over the `axis` mesh axis (PipelineTranspiler
-    plane): M microbatches tick through a lax.scan; each device runs its
-    own stage (lax.switch on its axis index) over the forward sub-op
-    lists and ppermutes the boundary activation onward.  Bubble ticks
-    are masked from the loss.  Differentiating through the scan yields
-    the reversed-pipeline backward for free; the per-stage gradients
-    are disjoint and summed by the transpiler's c_allreduce_sum ops."""
+def _pp_micro_split(env, data_names, M, stage_ops, axis):
+    """Shared pipeline prologue: stage-count check + reshape every data
+    feed to [M, B/M, ...] microbatch slabs (popped out of env)."""
     Pn = jax.lax.axis_size(axis)
     check_arg(len(stage_ops) == Pn,
               f"program has {len(stage_ops)} pipeline stages but mesh "
@@ -61,40 +55,75 @@ def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
                   f"feed {n!r} batch {a.shape[0]} not divisible by "
                   f"n_microbatches {M}")
         micro[n] = a.reshape((M, a.shape[0] // M) + a.shape[1:])
+    return Pn, micro
+
+
+def _pp_stage_fn(ctx, env, stage_ops, b_names, loss_name, Pn, s):
+    """The per-stage forward both pipeline schedules share:
+    g(x_act, extra_env, mfeeds, fold_idx) -> (payload_out, loss).
+    fold_idx keys the per-(stage, microbatch) RNG root — without it
+    every microbatch would reuse the single trace-time dropout mask
+    (ops draw keys from a trace-side counter).  Outputs DEPEND on
+    traced values even when dummy (constant zeros give cond branches
+    different known/unknown partitions and jax's partial-eval asserts,
+    seen with dropout active on the gpipe plane)."""
+    def g(x_act, extra_env, mfeeds, fold_idx):
+        tctx = LowerContext(jax.random.fold_in(ctx._root_key, fold_idx),
+                            is_test=ctx.is_test, mesh=ctx.mesh)
+        tctx.place = ctx.place
+        tctx.program = getattr(ctx, "program", None)
+        tctx.cp_axis = getattr(ctx, "cp_axis", None)
+        tctx.ep_axis = getattr(ctx, "ep_axis", None)
+        senv = dict(env)
+        senv.update(extra_env)
+        senv.update(mfeeds)
+        if s > 0:
+            for nm, a in zip(b_names[s - 1], x_act):
+                senv[nm] = a
+        senv = run_ops_in_env(tctx, senv, stage_ops[s])
+        if s < Pn - 1:
+            out = tuple(senv[nm] for nm in b_names[s])
+            zloss = (out[0].ravel()[0] * 0.0).astype(jnp.float32)
+            return out, zloss
+        loss = senv[loss_name].reshape(()).astype(jnp.float32)
+        return (jax.tree.map(
+            lambda a: a * jnp.zeros((), a.dtype), x_act), loss)
+    return g
+
+
+def _pp_probe_act(ctx, env, stage_ops, b_names, micro, extra_env=None):
+    """Payload shape/dtype structure of the boundary, via eval_shape of
+    stage 0."""
+    def probe(mfeeds):
+        senv = dict(env)
+        senv.update(extra_env or {})
+        senv.update(mfeeds)
+        senv = run_ops_in_env(ctx, senv, stage_ops[0])
+        return tuple(senv[nm] for nm in b_names[0])
+    return jax.eval_shape(probe, {n: micro[n][0] for n in micro})
+
+
+def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
+                data_names):
+    """GPipe schedule over the `axis` mesh axis (PipelineTranspiler
+    plane): M microbatches tick through a lax.scan; each device runs its
+    own stage (lax.switch on its axis index) over the forward sub-op
+    lists and ppermutes the boundary activation onward.  Bubble ticks
+    are masked from the loss.  Differentiating through the scan yields
+    the reversed-pipeline backward for free; the per-stage gradients
+    are disjoint and summed by the transpiler's c_allreduce_sum ops."""
+    Pn, micro = _pp_micro_split(env, data_names, M, stage_ops, axis)
 
     def branch(s):
+        g = _pp_stage_fn(ctx, env, stage_ops, b_names, loss_name, Pn, s)
+
         def f(x_act, mfeeds, t):
-            # per-tick RNG root: without it every microbatch would reuse
-            # the single trace-time dropout mask (ops draw keys from a
-            # trace-side counter)
-            tctx = LowerContext(jax.random.fold_in(ctx._root_key, t),
-                                is_test=ctx.is_test, mesh=ctx.mesh)
-            tctx.place = ctx.place
-            tctx.program = getattr(ctx, "program", None)
-            tctx.cp_axis = getattr(ctx, "cp_axis", None)
-            tctx.ep_axis = getattr(ctx, "ep_axis", None)
-            senv = dict(env)
-            senv.update(mfeeds)
-            if s > 0:
-                for nm, a in zip(b_names[s - 1], x_act):
-                    senv[nm] = a
-            senv = run_ops_in_env(tctx, senv, stage_ops[s])
-            if s < Pn - 1:
-                return (tuple(senv[nm] for nm in b_names[s]),
-                        jnp.zeros((), jnp.float32))
-            return (jax.tree.map(jnp.zeros_like, x_act),
-                    senv[loss_name].reshape(()).astype(jnp.float32))
+            return g(x_act, {}, mfeeds, t)
         # GPipe memory contract: per tick only the boundary payload
         # is saved; stage internals rematerialize in the backward
         return jax.checkpoint(f)
 
-    def probe(mfeeds):
-        senv = dict(env)
-        senv.update(mfeeds)
-        senv = run_ops_in_env(ctx, senv, stage_ops[0])
-        return tuple(senv[nm] for nm in b_names[0])
-
-    act = jax.eval_shape(probe, {n: micro[n][0] for n in micro})
+    act = _pp_probe_act(ctx, env, stage_ops, b_names, micro)
     branches = [branch(s) for s in range(Pn)]
     pp_r = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % Pn) for i in range(Pn)]
@@ -126,6 +155,137 @@ def _pp_forward(ctx, env, stage_ops, b_names, loss_name, axis, M,
     # ring, giving each stage exactly its own gradient.  The caller
     # psums the returned value for the (replicated) fetch.
     return loss_acc / M
+
+
+def _pp_1f1b(ctx, env, stage_ops, b_names, loss_name, axis, M,
+             data_names, params):
+    """Non-interleaved 1F1B (PipeDream-Flush) schedule: same math as
+    _pp_forward's GPipe, but the backward of microbatch m runs at tick
+    2P-1-s+m — right behind its forward — so each device buffers at
+    most ~2P boundary INPUTS instead of the scan-vjp's M-tick carry
+    history.  The backward is explicit: each tick's B-phase re-runs the
+    stage under jax.vjp from the buffered input (stages rematerialize
+    anyway) with the cotangent that just arrived on the reverse ring;
+    masking the vjp SEED by schedule validity makes inactive ticks
+    contribute exact zeros (cotangent-linearity), so no buffer-wide
+    masking of gradients is needed.
+
+    Returns (local mean loss, {param: grad}) — grads are the stage's
+    own contributions; the transpiler's pipe-axis allreduce assembles
+    the full gradient exactly as in the GPipe plane."""
+    Pn, micro = _pp_micro_split(env, data_names, M, stage_ops, axis)
+    param_names = set(params)
+    stage_pnames = []
+    for ops in stage_ops:
+        used = {n for op in ops for ns in op.inputs.values() for n in ns}
+        stage_pnames.append(sorted(used & param_names))
+
+    act = _pp_probe_act(ctx, env, stage_ops, b_names, micro,
+                        extra_env={n: params[n]
+                                   for n in stage_pnames[0]})
+    zeros_of = lambda tree: jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype), tree)
+    # integer/bool payload leaves (e.g. token ids riding the cut) are
+    # not differentiable: their vjp cotangents are float0 (which cannot
+    # ride the scan carry or ppermute) — seed them with float0 zeros
+    # and carry plain int zeros in their ct slots
+    act_leaves = jax.tree.leaves(act)
+    _inexact = [jnp.issubdtype(a.dtype, jnp.inexact) for a in act_leaves]
+
+    def ct_seed(ct_tree, scale):
+        return jax.tree.unflatten(
+            jax.tree.structure(act),
+            [c * scale.astype(c.dtype) if ok
+             else np.zeros(a.shape, jax.dtypes.float0)
+             for c, a, ok in zip(jax.tree.leaves(ct_tree), act_leaves,
+                                 _inexact)])
+
+    def ct_carryable(ct_tree):
+        return jax.tree.unflatten(
+            jax.tree.structure(act),
+            [c if ok else jnp.zeros(a.shape, a.dtype)
+             for c, a, ok in zip(jax.tree.leaves(ct_tree), act_leaves,
+                                 _inexact)])
+    BUF = 2 * Pn
+    pp_r = jax.lax.axis_index(axis)
+    fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+    bwd_perm = [(i, (i - 1) % Pn) for i in range(Pn)]
+    grads0 = {n: jnp.zeros(jnp.shape(params[n]),
+                           jax.dtypes.result_type(params[n]))
+              for n in params}
+
+    def branch(s):
+        g = _pp_stage_fn(ctx, env, stage_ops, b_names, loss_name, Pn, s)
+        pn_s = stage_pnames[s]
+
+        def tickwork(fwd_state, ct_state, buf, grads, loss_acc, t):
+            p_sub = {n: params[n] for n in pn_s}
+            # ---- F phase: microbatch m_f = t - s -------------------
+            m_f = t - s
+            f_valid = (m_f >= 0) & (m_f < M)
+            m_fc = jnp.clip(m_f, 0, M - 1)
+            feeds_f = {n: jax.lax.dynamic_index_in_dim(
+                micro[n], m_fc, 0, keepdims=False) for n in micro}
+            y, loss = g(fwd_state, p_sub, feeds_f, s + m_fc)
+            loss_acc = loss_acc + jnp.where(
+                f_valid & (s == Pn - 1), loss, 0.0)
+            # buffer this microbatch's stage INPUT for its backward
+            slot = m_fc % BUF
+            buf = jax.tree.map(
+                lambda b, x: jnp.where(
+                    f_valid,
+                    jax.lax.dynamic_update_index_in_dim(b, x, slot, 0),
+                    b),
+                buf, fwd_state)
+            # ---- B phase: microbatch m_b = t - (2P-1-s) ------------
+            m_b = t - (2 * Pn - 1 - s)
+            b_valid = (m_b >= 0) & (m_b < M)
+            m_bc = jnp.clip(m_b, 0, M - 1)
+            feeds_b = {n: jax.lax.dynamic_index_in_dim(
+                micro[n], m_bc, 0, keepdims=False) for n in micro}
+            x_in = jax.tree.map(
+                lambda b: jax.lax.dynamic_index_in_dim(
+                    b, m_bc % BUF, 0, keepdims=False), buf)
+            _, vjp_fn = jax.vjp(
+                lambda x, p: g(x, p, feeds_b, s + m_bc), x_in, p_sub)
+            scale = b_valid.astype(jnp.float32)
+            if s == Pn - 1:
+                seed = (ct_seed(zeros_of(act), scale), scale / M)
+            else:
+                seed = (ct_seed(ct_state, scale),
+                        jnp.zeros((), jnp.float32))
+            ct_x, g_sub = vjp_fn(seed)
+            ct_x = ct_carryable(ct_x)
+            gd = dict(grads)
+            for n in pn_s:
+                gd[n] = gd[n] + g_sub[n].astype(gd[n].dtype)
+            return y, ct_x, buf, gd, loss_acc
+
+        return tickwork
+
+    branches = [branch(s) for s in range(Pn)]
+
+    def tick(carry, t):
+        fwd_state, ct_state, buf, grads, loss_acc = carry
+        y, ct_x, buf, grads, loss_acc = jax.lax.switch(
+            pp_r, branches, fwd_state, ct_state, buf, grads, loss_acc,
+            t)
+        nxt_f = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis, fwd_perm), y)
+        nxt_b = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis, bwd_perm), ct_x)
+        return (nxt_f, nxt_b, buf, grads, loss_acc), None
+
+    state0 = zeros_of(act)
+    buf0 = jax.tree.map(
+        lambda a: jnp.zeros((BUF,) + a.shape, a.dtype), act)
+    (_, _, _, grads, loss_acc), _ = jax.lax.scan(
+        tick, (state0, zeros_of(act), buf0, grads0,
+               jnp.zeros((), jnp.float32)),
+        jnp.arange(M + 2 * Pn - 1))
+    # LOCAL loss (nonzero on the last stage) — the caller psums, same
+    # contract as _pp_forward
+    return loss_acc / M, grads
 
 
 def _data_feed_spec(program, var, axis):
@@ -568,7 +728,6 @@ class _CompiledProgram:
                         if k not in param_names}
             params = {k: env[k] for k in param_names}
             pp_axis = getattr(self.program, "_dist_pp_axis", None)
-
             if pp_axis is not None:
                 stage_ops, b_names = self._pp_partition()
                 M = int(getattr(self.program, "_pp_microbatches", 1))
@@ -576,6 +735,25 @@ class _CompiledProgram:
                 data_names = [n for n in self.feed_names
                               if block.has_var(n) and block.var(n).is_data]
 
+            if pp_axis is not None and getattr(
+                    self.program, "_pp_schedule", "gpipe") == "1f1b":
+                # explicit-backward 1F1B plane: grads come from the
+                # per-tick vjp, not from differentiating a forward
+                loss_val, grads = _pp_1f1b(
+                    ctx, dict(base_env), stage_ops, b_names, loss_name,
+                    pp_axis, M, data_names, params)
+                env = dict(base_env)
+                env.update(params)
+                env[loss_name] = jax.lax.psum(loss_val, pp_axis)
+                for pname, gname in zip(param_names, grad_names):
+                    env[gname] = grads[pname]
+                env = run_ops_in_env(ctx, env,
+                                     self._ops[self._ad_idx + 1:])
+                new_state = {n: env[n] for n in self.out_state_names}
+                fetches = [env[n] for n in self.fetch_names]
+                return fetches, new_state
+
+            if pp_axis is not None:
                 def forward(p):
                     fenv = dict(base_env)
                     fenv.update(p)

@@ -339,6 +339,7 @@ class Program:
             d["dist_pp_axis"] = self._dist_pp_axis
             d["pp_degree"] = getattr(self, "_pp_degree", None)
             d["pp_microbatches"] = getattr(self, "_pp_microbatches", None)
+            d["pp_schedule"] = getattr(self, "_pp_schedule", "gpipe")
         return d
 
     @staticmethod
@@ -356,6 +357,7 @@ class Program:
             p._dist_pp_axis = d["dist_pp_axis"]
             p._pp_degree = d.get("pp_degree")
             p._pp_microbatches = d.get("pp_microbatches")
+            p._pp_schedule = d.get("pp_schedule", "gpipe")
         # recreate blocks
         for bd in d["blocks"][1:]:
             b = Block(p, bd["idx"], bd["parent_idx"])

@@ -202,23 +202,29 @@ def crf_decoding(input, param_attr, label=None, mask=None, name=None):
     created by linear_chain_crf (pass the same ParamAttr/name).  In a
     standalone decode program (the v2 infer pattern) the parameter is
     created here under that name and its trained value arrives via the
-    scope."""
+    scope.  A name with no matching var in a program that already
+    contains linear_chain_crf warns (likely typo -> untrained
+    transitions); note the check runs at THIS layer's build time, so
+    build the crf cost before the decode to get the protection."""
     helper = LayerHelper("crf_decoding", name=name)
     attr = ParamAttr._to_attr(param_attr)
     block = helper.main_program.global_block()
     if attr.name and block.has_var(attr.name):
         trans = block.var(attr.name)
     else:
-        if attr.name:
+        has_crf = any(op.type == "linear_chain_crf"
+                      for op in block.ops)
+        if attr.name and has_crf:
             # standalone-decode builds legitimately create the param
-            # here, but in a train+decode program a mismatched name
-            # would silently decode with an UNTRAINED transition
+            # here (trained values arrive via the scope); but when THIS
+            # program also trains a linear_chain_crf, a name typo means
+            # the decode silently runs an UNTRAINED transition
             import warnings
             warnings.warn(
-                f"crf_decoding: no variable named {attr.name!r} in this "
-                f"program — creating a fresh Transition parameter.  If "
-                f"this program also has a linear_chain_crf, pass the "
-                f"SAME param name or the decode uses untrained "
+                f"crf_decoding: no variable named {attr.name!r} in a "
+                f"program that contains linear_chain_crf — creating a "
+                f"fresh Transition parameter.  Pass the SAME param "
+                f"name as the crf layer or the decode uses untrained "
                 f"transitions.", stacklevel=3)
         n_tags = int(input.shape[-1])
         trans = helper.create_parameter(

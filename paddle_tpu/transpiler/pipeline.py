@@ -33,16 +33,20 @@ reshape(dp, pp), ("data", "pipe")))``.  Under the pipeline plane only
 the loss (and persistable state) is fetchable — per-layer activations
 live inside the scan (the executor validates fetches up front).
 
-Schedule note: this is GPipe (all-forward-then-all-backward via the
-scan's vjp).  Non-interleaved 1F1B has the SAME bubble fraction,
-(P-1)/(M+P-1) — its advantage is peak memory, bounding in-flight
-microbatch state to P instead of M; here the per-tick jax.checkpoint
-already bounds the per-tick stash to the boundary payload, so the
-residual gap vs 1F1B is the M-tick carry history the scan vjp saves
-(M x payload vs 1F1B's P x full-stage activations — which of the two
-is smaller depends on the cut).  True 1F1B in this design needs
-manual vjp-residual ring buffers in the scan carry; recorded as the
-known next step rather than approximated.
+Schedules: ``schedule="gpipe"`` (default) differentiates the forward
+scan — the backward is the time-reversed pipeline, and the scan vjp
+saves the M-tick boundary-payload carry history.  ``schedule="1f1b"``
+(non-interleaved 1F1B / PipeDream-Flush) runs an EXPLICIT per-tick
+backward: microbatch m's backward at stage s fires at tick 2P-1-s+m —
+one tick behind its forward on the last stage — recomputing the stage
+under jax.vjp from a ring buffer of boundary INPUTS bounded at 2P
+slots (stages rematerialize anyway, so inputs are the only residuals).
+Both schedules share the bubble fraction (P-1)/(M+P-1); 1F1B bounds
+the in-flight buffer by P instead of M, and because its vjp lives
+INSIDE each stage branch it also supports RNG ops (dropout) in stages,
+which jax's cond partial-eval cannot differentiate across branches on
+the gpipe plane.  Parity + dropout-determinism tests:
+tests/test_pipeline_parallel.py.
 """
 from __future__ import annotations
 
@@ -57,17 +61,24 @@ class PipelineTranspiler:
         self.axis_name = axis_name
 
     def transpile(self, program: Program, pp_degree: int,
-                  n_microbatches: Optional[int] = None) -> None:
-        """Rewrite `program` for pp_degree-way GPipe pipelining.
+                  n_microbatches: Optional[int] = None,
+                  schedule: str = "gpipe") -> None:
+        """Rewrite `program` for pp_degree-way pipelining.
 
         The program must contain exactly pp_degree - 1
         ``pipeline_boundary`` marker ops (layers.pipeline_boundary) at
-        shape-homogeneous activation cuts, and a training section
+        payload-homogeneous activation cuts, and a training section
         (autodiff + optimizer ops from Optimizer.minimize).
         n_microbatches defaults to pp_degree; the batch dim of every
-        feed must divide by it."""
+        feed must divide by it.  schedule: "gpipe" (scan + its vjp) or
+        "1f1b" (explicit per-tick backward; bounds the in-flight
+        boundary buffer to ~2*pp_degree microbatches instead of the
+        scan carry's n_microbatches — same math, same bubble)."""
         check_arg(pp_degree >= 1,
                   f"pp_degree must be >= 1, got {pp_degree}")
+        check_arg(schedule in ("gpipe", "1f1b"),
+                  f"unknown pipeline schedule {schedule!r} "
+                  f"(expected 'gpipe' or '1f1b')")
         if pp_degree == 1:
             return                      # degenerate: leave untouched
         check_arg(
@@ -124,3 +135,4 @@ class PipelineTranspiler:
         program._dist_pp_axis = self.axis_name
         program._pp_degree = int(pp_degree)
         program._pp_microbatches = M
+        program._pp_schedule = schedule
