@@ -255,10 +255,18 @@ def _pp_1f1b(ctx, env, stage_ops, b_names, loss_name, axis, M,
                 seed = (ct_seed(ct_state, scale),
                         jnp.zeros((), jnp.float32))
             ct_x, g_sub = vjp_fn(seed)
-            ct_x = ct_carryable(ct_x)
+            # the zero seed gives zero cotangents only for FINITE
+            # Jacobians; an op like log/rsqrt evaluated on the zero
+            # warm-up buffer yields 0 * inf = NaN, so mask the results
+            # by validity too (0-cost: select fuses)
+            ct_x = jax.tree.map(
+                lambda c: jnp.where(b_valid, c, jnp.zeros_like(c)),
+                ct_carryable(ct_x))
             gd = dict(grads)
             for n in pn_s:
-                gd[n] = gd[n] + g_sub[n].astype(gd[n].dtype)
+                gd[n] = gd[n] + jnp.where(
+                    b_valid, g_sub[n], jnp.zeros_like(g_sub[n])
+                ).astype(gd[n].dtype)
             return y, ct_x, buf, gd, loss_acc
 
         return tickwork

@@ -1555,3 +1555,46 @@ __all__ += [
     "row_l2_norm", "expand", "pooling", "crf", "crf_decoding",
     "regression_cost", "cross_entropy",
 ]
+
+
+def img_conv3d(input, filter_size, num_filters, num_channels=None,
+               act=None, padding=0, stride=1, param_attr=None,
+               name=None, **_):
+    """3-D convolution over [B, C, D, H, W] (ref img_conv3d_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.conv3d(input.to_var(ctx), num_filters=num_filters,
+                         filter_size=filter_size, padding=padding,
+                         stride=stride, act=act_name(act),
+                         param_attr=_to_attr(param_attr))
+    return Layer(build, [input], name=name)
+
+
+def img_pool3d(input, pool_size, stride=None, padding=0,
+               pool_type=None, name=None, **_):
+    """3-D pooling (ref img_pool3d_layer)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        ptype = "max" if pool_type is None else pool_type.name
+        return fl.pool3d(input.to_var(ctx), pool_size=pool_size,
+                         pool_stride=stride or pool_size,
+                         pool_padding=padding, pool_type=ptype)
+    return Layer(build, [input], name=name)
+
+
+def roi_pool(input, rois, pooled_width=1, pooled_height=1,
+             spatial_scale=1.0, num_channels=None, name=None, **_):
+    """Region-of-interest max pooling (ref roi_pool_layer): `rois` is
+    a [N, 4] dense data layer of (x1, y1, x2, y2) boxes in input-image
+    coordinates; every roi row pools from batch image 0 unless a
+    rois_batch_id is threaded through the Fluid plane directly."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.roi_pool(input.to_var(ctx), rois.to_var(ctx),
+                           pooled_height=pooled_height,
+                           pooled_width=pooled_width,
+                           spatial_scale=spatial_scale)
+    return Layer(build, [input, rois], name=name)
+
+
+__all__ += ["img_conv3d", "img_pool3d", "roi_pool"]

@@ -376,3 +376,35 @@ def test_1f1b_integer_payload_leaf():
                                      fetch_list=[loss])[0]).ravel()[0])
             for _ in range(3)]
     assert np.isfinite(seen).all() and seen[-1] < seen[0]
+
+
+def test_1f1b_nonfinite_jacobian_at_zero_warmup():
+    """A stage that opens with sqrt(payload): its Jacobian is inf at
+    the zero warm-up buffer, so unmasked 0*inf seeds would poison every
+    gradient with NaN — the validity mask on cotangents/grads must keep
+    training finite."""
+    pt.reset_default_programs()
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    main.random_seed = startup.random_seed = 21
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[D], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.square(layers.fc(x, size=D))     # >= 0 payload
+        h = layers.pipeline_boundary(h)
+        h2 = layers.fc(layers.sqrt(h), size=D, act="relu")
+        pred = layers.fc(h2, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+    pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    pt.transpiler.PipelineTranspiler().transpile(
+        main, pp_degree=2, n_microbatches=2, schedule="1f1b")
+    mesh = make_mesh((2,), ("pipe",))
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope(), mesh=mesh)
+    exe.run(startup)
+    rng = np.random.RandomState(4)
+    x_np = rng.rand(B, D).astype("f4") + 0.5
+    feed = {"x": x_np, "y": x_np.sum(-1, keepdims=True) * 0.1}
+    seen = [float(np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0]).ravel()[0])
+            for _ in range(4)]
+    assert np.isfinite(seen).all(), seen
+    assert seen[-1] < seen[0]

@@ -620,3 +620,42 @@ def test_remaining_aliases_and_conv_projection():
                   event_handler=lambda e: seen.append(e.cost)
                   if isinstance(e, paddle.event.EndIteration) else None)
     assert np.isfinite(seen).all()
+
+
+def test_3d_and_roi_tier_builds():
+    from paddle_tpu.v2.config_base import Layer as Node
+
+    flat = paddle.layer.data(
+        name="vol", type=paddle.data_type.dense_vector(1 * 4 * 8 * 8))
+
+    def to_vol(ctx):
+        from paddle_tpu import layers as fl
+        return fl.reshape(flat.to_var(ctx), [-1, 1, 4, 8, 8])
+
+    vol = Node(to_vol, [flat])
+    c3 = paddle.layer.img_conv3d(input=vol, filter_size=3,
+                                 num_filters=2, padding=1)
+    p3 = paddle.layer.img_pool3d(input=c3, pool_size=2)
+    head3 = paddle.layer.fc(input=p3, size=2,
+                            act=paddle.activation.Softmax())
+    got3 = np.asarray(paddle.infer(
+        output_layer=head3, parameters=paddle.parameters.create(head3),
+        input=[(np.random.RandomState(1).rand(256).astype("f4"),)]))
+    assert got3.shape == (1, 2)
+    assert np.allclose(got3.sum(-1), 1.0, atol=1e-3)
+
+    x = paddle.layer.data(
+        name="img", type=paddle.data_type.dense_vector(2 * 8 * 8),
+        height=8, width=8)
+    rois = paddle.layer.data(name="rois",
+                             type=paddle.data_type.dense_vector(4))
+    rp = paddle.layer.roi_pool(input=x, rois=rois, pooled_width=2,
+                               pooled_height=2)
+    out = paddle.layer.fc(input=rp, size=3,
+                          act=paddle.activation.Softmax())
+    got = np.asarray(paddle.infer(
+        output_layer=out, parameters=paddle.parameters.create(out),
+        input=[(np.random.RandomState(0).rand(128).astype("f4"),
+                np.array([0, 0, 7, 7], "f4"))],
+        feeding={"img": 0, "rois": 1}))
+    assert got.shape == (1, 3)
