@@ -35,6 +35,15 @@ model-sharded" through shape/elementwise ops — enough to reproduce the
 qkv->out_proj / ffn1->ffn2 column->row pairing on transformer blocks.
 The annotations are *advisory* for XLA: any consistent assignment is
 correct; pairing only controls where the collectives land.
+
+Fused-attention note: GSPMD cannot partition through the fused_mha /
+fused_attention pallas_call, so those ops (and their weights) run
+REPLICATED under this transpiler — numerically identical, with tp
+speedup only on the FFN/embedding side
+(tests/test_tensor_parallel.py::test_tp_with_fused_mha_...).  Fully
+tensor-parallel attention is served by the unfused path (plain
+mul/matmul ops shard normally) or the explicit shard_map plane
+(parallel/hybrid.py tp+sp attention).
 """
 from __future__ import annotations
 
