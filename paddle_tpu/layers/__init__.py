@@ -7,7 +7,8 @@ from . import learning_rate_scheduler
 from . import sequence
 from .nn import *  # noqa: F401,F403
 from .sequence import (  # noqa: F401
-    sequence_conv, sequence_context, sequence_pool, sequence_first_step,
+    sequence_conv, sequence_context, sequence_pool, scale_sub_region,
+    sequence_first_step,
     sequence_last_step,
     sequence_softmax, sequence_concat, sequence_slice, sequence_expand,
     sequence_expand_as, sequence_pad, sequence_unpad, sequence_reshape,

@@ -813,3 +813,11 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                       "LastH": [last_h], "LastC": [last_c]}, {})
     # reference dynamic_lstmp returns (projection, per-step cell sequence)
     return proj, cell
+
+
+def scale_sub_region(x, indices, value=1.0, name=None):
+    """Scale a per-instance CHW sub-box of [B, C, H, W] by `value`
+    (ref scale_sub_region_op); indices [B, 6] 1-based inclusive
+    (C0, C1, H0, H1, W0, W1)."""
+    return _simple("scale_sub_region", {"X": [x], "Indices": [indices]},
+                   {"value": float(value)}, x.dtype, name=name)

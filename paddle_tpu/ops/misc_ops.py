@@ -584,3 +584,23 @@ def _shrink_rnn_memory(ctx, ins, attrs):
     step = single_input(ins, "I").reshape(()).astype(jnp.int32)
     active = (lens > step).astype(x.dtype)
     return {"Out": [x * active[(slice(None),) + (None,) * (x.ndim - 1)]]}
+
+
+@register_op("scale_sub_region")
+def _scale_sub_region(ctx, ins, attrs):
+    """Multiply `value` into a per-instance CHW sub-box (ref
+    scale_sub_region_layer / scale_sub_region_op): X [B, C, H, W],
+    Indices [B, 6] = 1-based inclusive (C0, C1, H0, H1, W0, W1)."""
+    x = single_input(ins, "X")
+    idx = single_input(ins, "Indices").astype(jnp.int32)
+    value = float(attrs.get("value", 1.0))
+    B, C, H, W = x.shape
+
+    def dim_mask(lo, hi, n):            # [B] 1-based inclusive -> [B, n]
+        r = jnp.arange(n)[None, :]
+        return (r >= lo[:, None] - 1) & (r <= hi[:, None] - 1)
+
+    m = (dim_mask(idx[:, 0], idx[:, 1], C)[:, :, None, None]
+         & dim_mask(idx[:, 2], idx[:, 3], H)[:, None, :, None]
+         & dim_mask(idx[:, 4], idx[:, 5], W)[:, None, None, :])
+    return {"Out": [jnp.where(m, x * jnp.asarray(value, x.dtype), x)]}

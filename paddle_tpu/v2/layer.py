@@ -1598,3 +1598,36 @@ def roi_pool(input, rois, pooled_width=1, pooled_height=1,
 
 
 __all__ += ["img_conv3d", "img_pool3d", "roi_pool"]
+
+
+def kmax_seq_score(input, beam_size=1, name=None, **_):
+    """Indices of the beam_size highest-scoring timesteps of a
+    [B, T, 1] score sequence (ref kmax_seq_score_layer); pad positions
+    are excluded via the sequence mask."""
+    def build(ctx):
+        fl = _fluid_layers()
+        v = input.to_var(ctx)           # [B, T, 1]
+        scores = fl.squeeze(v, [2])
+        mask = _seq_mask(ctx, input)
+        if mask is not None:
+            # -1e9 * (1 - mask) in one op
+            scores = fl.elementwise_add(
+                scores, fl.scale(mask, scale=1e9, bias=-1e9))
+        _, ids = fl.topk(scores, k=beam_size)
+        return ids
+    return Layer(build, [input], name=name)
+
+
+def scale_sub_region(input, indices, value, name=None, **_):
+    """Scale a per-instance CHW sub-box by `value` (ref
+    scale_sub_region_layer): indices is a [B, 6] dense data layer of
+    1-based inclusive (C0, C1, H0, H1, W0, W1)."""
+    def build(ctx):
+        fl = _fluid_layers()
+        return fl.scale_sub_region(input.to_var(ctx),
+                                   indices.to_var(ctx),
+                                   value=float(value))
+    return Layer(build, [input, indices], name=name)
+
+
+__all__ += ["kmax_seq_score", "scale_sub_region"]

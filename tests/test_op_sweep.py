@@ -921,6 +921,7 @@ EXEMPT = {
     "fused_mha": "tests/test_pallas_kernels.py fused_mha parity/cross/train",
     "pipeline_boundary": "tests/test_pipeline_parallel.py (identity + GPipe plane)",
     "moe_ffn": "tests/test_expert_parallel.py (dense-equivalence + ep mesh)",
+    "scale_sub_region": "tests/test_v2_mixed_tier.py numeric box check",
     "sequence_context": "tests/test_v2_mixed_tier.py context_projection identity checks",
     "fused_lm_head_loss": "tests/test_models.py fused-vs-unfused parity",
     "save": "io op — tests/test_reader_trainer.py save/load-as-ops",

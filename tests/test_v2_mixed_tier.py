@@ -659,3 +659,33 @@ def test_3d_and_roi_tier_builds():
                 np.array([0, 0, 7, 7], "f4"))],
         feeding={"img": 0, "rois": 1}))
     assert got.shape == (1, 3)
+
+
+def test_kmax_seq_score_and_scale_sub_region():
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(9))
+    emb = paddle.layer.embedding(input=words, size=4)
+    scores = paddle.layer.fc(input=emb, size=1, bias_attr=False)
+    kmax = paddle.layer.kmax_seq_score(input=scores, beam_size=2)
+    got = np.asarray(paddle.infer(
+        output_layer=kmax, parameters=paddle.parameters.create(kmax),
+        input=[([1, 2, 3, 4],)]))
+    assert got.shape == (1, 2)
+    assert set(got.ravel().tolist()) <= set(range(8))  # padded T
+
+    img = paddle.layer.data(
+        name="img", type=paddle.data_type.dense_vector(2 * 4 * 4),
+        height=4, width=4)
+    idx = paddle.layer.data(name="idx",
+                            type=paddle.data_type.dense_vector(6))
+    ssr = paddle.layer.scale_sub_region(input=img, indices=idx,
+                                        value=3.0)
+    x = np.ones(32, "f4")
+    box = np.array([1, 1, 1, 2, 1, 2], "f4")   # C=1, H=1..2, W=1..2
+    got = np.asarray(paddle.infer(
+        output_layer=ssr, parameters=paddle.parameters.create(ssr),
+        input=[(x, box)], feeding={"img": 0, "idx": 1}))
+    assert got.shape == (1, 2, 4, 4)
+    assert got[0, 0, :2, :2].ravel().tolist() == [3.0] * 4
+    assert got[0, 1].sum() == 16.0              # channel 2 untouched
+    assert got[0, 0, 2:, :].sum() == 8.0        # rows 3-4 untouched
