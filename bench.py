@@ -33,6 +33,7 @@ what each bar IS:
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -330,10 +331,35 @@ def bench_lstm(on_tpu):
                    f"executor path", lval)
 
 
+def _record_row_metrics(row):
+    """Publish one workload row through the observability registry, so
+    BENCH_r*.json rows and a live process's /metrics share one schema
+    (the registry JSON dumped by main() alongside stdout)."""
+    from paddle_tpu.observability import metrics as obs
+    obs.gauge("bench_value",
+              "Per-workload bench result; its unit rides the label.",
+              ("metric", "unit")).labels(
+        metric=row["metric"], unit=row["unit"]).set(row["value"])
+    obs.gauge("bench_vs_baseline",
+              "Bench result vs its published-baseline bar "
+              "(see vs_baseline_basis in the stdout JSON).",
+              ("metric",)).labels(metric=row["metric"]).set(
+        row["vs_baseline"])
+    for field, help_str in (("mfu", "Model FLOPs utilization."),
+                            ("tflops", "Achieved model TFLOP/s."),
+                            ("loss", "Final training loss of the row.")):
+        if row.get(field) is not None:
+            obs.gauge(f"bench_{field}", help_str, ("metric",)).labels(
+                metric=row["metric"]).set(row[field])
+
+
 def main():
     from paddle_tpu.core import flags
+    from paddle_tpu.observability import metrics as obs
     on_tpu = jax.devices()[0].platform == "tpu"
     flags.set_flag("amp_bf16", True)
+    metrics_path = os.environ.get("PTPU_BENCH_METRICS_PATH",
+                                  "bench_metrics.json")
 
     rows, errors = [], {}
     for fn in (bench_lm, bench_resnet50, bench_nmt,
@@ -343,6 +369,11 @@ def main():
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide
             errors[fn.__name__] = repr(e)[:300]
+        else:
+            try:
+                _record_row_metrics(rows[-1])
+            except Exception as e:      # telemetry must not fail the row
+                errors.setdefault("record_metrics", repr(e)[:300])
         # re-print the cumulative result after EVERY workload (full
         # detail, for humans reading the whole log), then a COMPACT
         # summary line LAST: the driver parses the final JSON line of a
@@ -354,6 +385,13 @@ def main():
         out["workloads"] = rows
         out["vs_baseline_basis"] = {r["metric"]: _BASIS[r["metric"]]
                                     for r in rows}
+        # registry dump rides beside stdout: executor compile/cache
+        # counters + the bench_* gauges, one file per run (refreshed
+        # after every workload so a crashed run keeps partial results)
+        try:
+            obs.REGISTRY.dump_json(metrics_path)
+        except OSError as e:
+            errors.setdefault("metrics_dump", repr(e)[:300])
         if errors:
             out["errors"] = errors
         print(json.dumps(out), flush=True)

@@ -38,6 +38,7 @@ from . import dataset
 from . import transpiler
 from . import contrib
 from . import debugger
+from . import observability
 from . import imperative
 from . import inference
 from . import distributed

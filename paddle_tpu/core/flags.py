@@ -61,6 +61,17 @@ define_flag("matmul_precision", "default",
             "jax matmul precision: default|high|highest.")
 define_flag("executor_log_compiles", False,
             "Log every program (re)compilation in the executor.")
+define_flag("profile_ops", False,
+            "Run programs eagerly (un-jitted) and record per-op wall "
+            "timings into the executor_op_seconds histogram and the "
+            "trace buffer (observability/trace.py).  Slow; the "
+            "interpreted-mode analogue of the reference's per-op "
+            "profiler (platform/profiler.h RecordEvent per kernel).")
+define_flag("recompile_warn_threshold", 5,
+            "Warn once when the same (program, fetch-list) key has "
+            "compiled more than this many distinct executables — a "
+            "recompile storm, usually drifting feed shapes/dtypes. "
+            "0 disables the check.")
 define_flag("rng_seed", 0, "Global RNG seed used when a program has no seed.")
 define_flag("amp_bf16", False,
             "Mixed precision: f32 matmul/conv/attention inputs enter the "

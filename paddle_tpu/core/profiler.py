@@ -7,22 +7,21 @@ tools/timeline.py).
 
 TPU-native: host-side scoping uses jax.profiler.TraceAnnotation (shows up in
 XPlane/TensorBoard and perfetto, the chrome://tracing successor); whole-profile
-capture uses jax.profiler.start_trace/stop_trace.  A lightweight host-event
-recorder is kept for environments without the profiler plugin so
-`profiler.profiler()` always yields usable per-scope wall timings.
+capture uses jax.profiler.start_trace/stop_trace.  Host-event recording rides
+the unified trace buffer (observability/trace.py), so `export_chrome_trace`
+emits ONE merged timeline: these RecordEvent scopes plus executor op/step
+spans and trainer markers.
 """
 from __future__ import annotations
 
 import contextlib
-import json
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
 import jax
 
-_events: List[dict] = []
-_enabled = False
+from ..observability import trace as _trace
 
 
 class RecordEvent:
@@ -41,12 +40,9 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
-        if _enabled:
-            _events.append({
-                "name": self.name,
-                "ts": self._t0,
-                "dur": time.perf_counter() - self._t0,
-            })
+        _trace.add_span(self.name, self._t0,
+                        time.perf_counter() - self._t0,
+                        tid=_trace.HOST_TID, cat="host")
         return False
 
 
@@ -54,19 +50,17 @@ RecordBlock = RecordEvent  # ref profiler.h:117 — same capability on host side
 
 
 def reset_profiler():
-    _events.clear()
+    _trace.reset()
 
 
 def enable_profiler(trace_dir: Optional[str] = None):
-    global _enabled
-    _enabled = True
+    _trace.enable()
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
 
 
 def disable_profiler(sorted_key: str = "total", trace_dir_used: bool = False):
-    global _enabled
-    _enabled = False
+    _trace.disable()
     if trace_dir_used:
         jax.profiler.stop_trace()
 
@@ -85,8 +79,9 @@ def profiler(trace_dir: Optional[str] = None, print_summary: bool = True):
 
 def summary() -> str:
     agg: Dict[str, List[float]] = defaultdict(list)
-    for e in _events:
-        agg[e["name"]].append(e["dur"])
+    for e in _trace.events():
+        if e["ph"] == "X":
+            agg[e["name"]].append(e["dur"])
     lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
     for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
         lines.append(f"{name:<40}{len(durs):>8}{sum(durs)*1e3:>12.3f}"
@@ -95,10 +90,6 @@ def summary() -> str:
 
 
 def export_chrome_trace(path: str):
-    """Dump host events as chrome://tracing JSON (ref tools/timeline.py)."""
-    trace = {"traceEvents": [
-        {"name": e["name"], "ph": "X", "pid": 0, "tid": 0,
-         "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6}
-        for e in _events]}
-    with open(path, "w") as f:
-        json.dump(trace, f)
+    """Dump the UNIFIED timeline — host scopes, executor op/step spans,
+    trainer markers — as chrome://tracing JSON (ref tools/timeline.py)."""
+    return _trace.export_chrome_trace(path)

@@ -16,7 +16,27 @@ __all__ = ["draw_block_graphviz", "pprint_program_codes"]
 
 
 def _dot_escape(s: str) -> str:
-    return s.replace('"', '\\"')
+    """Escape a name for use inside a double-quoted DOT label.
+
+    Backslash must go first (else it re-escapes the escapes we add);
+    quotes would end the label string; angle brackets / braces / pipe
+    are record- and HTML-label metacharacters that several graphviz
+    versions mis-lex even in plain labels (e.g. `fetch<0>`-style var
+    names), so they are backslash-escaped too; literal newlines become
+    the DOT `\\n` line break."""
+    out = []
+    for ch in s:
+        if ch == "\\":
+            out.append("\\\\")
+        elif ch == '"':
+            out.append('\\"')
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch in "<>{}|":
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
 
 
 def draw_block_graphviz(block, highlights: Optional[Set[str]] = None,
@@ -40,8 +60,11 @@ def draw_block_graphviz(block, highlights: Optional[Set[str]] = None,
             nid = var_ids[name]
             v = block.vars.get(name)
             shape = getattr(v, "shape", None) if v is not None else None
-            label = _dot_escape(
-                f"{name}\\n{list(shape)}" if shape is not None else name)
+            # escape the name BEFORE appending the intentional \n line
+            # break (escaping after would turn it into a literal
+            # backslash-n)
+            label = _dot_escape(name) + (
+                f"\\n{list(shape)}" if shape is not None else "")
             style = []
             if isinstance(v, Parameter):
                 style.append('style=filled fillcolor="lightgrey"')

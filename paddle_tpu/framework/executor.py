@@ -26,7 +26,9 @@ like the reference's in-program optimizer ops (operators/optimizers/).
 """
 from __future__ import annotations
 
+import threading
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -38,8 +40,55 @@ from ..core.dtypes import to_jnp_dtype
 from ..core.enforce import EnforceNotMet, check_arg
 from ..core.place import Place, default_place
 from ..core.profiler import RecordEvent
+from ..observability import metrics as obs_metrics
+from ..observability import trace as obs_trace
 from .program import Program, Variable, default_main_program
 from .registry import LowerContext, get_op_def
+
+# --- telemetry (observability/metrics.py): the executor is the hottest ---
+# --- producer; every perf PR regresses against these series             ---
+_m_compile = obs_metrics.counter(
+    "executor_compile_total",
+    "Program compilations (jit cache misses) in the executor.", ("kind",))
+_m_cache_hit = obs_metrics.counter(
+    "executor_cache_hit_total",
+    "Executor compiled-program cache hits.")
+_m_cache_miss = obs_metrics.counter(
+    "executor_cache_miss_total",
+    "Executor compiled-program cache misses (each one compiles).")
+_m_multi_hit = obs_metrics.counter(
+    "executor_multi_cache_hit_total",
+    "run_steps device-loop (_multi_cache) hits.")
+_m_multi_miss = obs_metrics.counter(
+    "executor_multi_cache_miss_total",
+    "run_steps device-loop (_multi_cache) misses (each one compiles).")
+_m_recompile_storm = obs_metrics.counter(
+    "executor_recompile_storm_total",
+    "Times a (program, fetch-list) key crossed the recompile-warn "
+    "threshold (PTPU_RECOMPILE_WARN_THRESHOLD).")
+_m_step_seconds = obs_metrics.histogram(
+    "executor_step_seconds",
+    "Host wall time of one executor step dispatch (async: excludes "
+    "device completion; first call per cache key includes compile).",
+    ("mode",))
+_m_op_seconds = obs_metrics.histogram(
+    "executor_op_seconds",
+    "Per-op wall time in interpreted (eager) mode; enable with "
+    "PTPU_PROFILE_OPS=1.", ("op",))
+_m_cached_programs = obs_metrics.gauge(
+    "executor_cached_programs",
+    "Compiled programs resident across this process's executor caches.")
+
+# True only inside an eager (un-jitted) _step with PTPU_PROFILE_OPS on —
+# per-op wall timings are meaningful only there (traced values have no
+# runtime; the jitted path is one fused XLA computation).  Thread-local:
+# AsyncExecutor feeder threads run concurrently and must not see another
+# thread's profiling window.
+_profile_state = threading.local()
+
+
+def _profiling_ops() -> bool:
+    return getattr(_profile_state, "active", False)
 
 def _pp_micro_split(env, data_names, M, stage_ops, axis):
     """Shared pipeline prologue: stage-count check + reshape every data
@@ -393,7 +442,16 @@ def run_ops_in_env(ctx, env: Dict[str, Any], ops) -> Dict[str, Any]:
             ins[slot] = vals
         prev_env = getattr(ctx, "env", None)
         ctx.env = env
-        outs = opdef.lower(ctx, ins, op.attrs)
+        if _profiling_ops():
+            t_op = time.perf_counter()
+            outs = opdef.lower(ctx, ins, op.attrs)
+            jax.block_until_ready(outs)
+            dt = time.perf_counter() - t_op
+            _m_op_seconds.labels(op=op.type).observe(dt)
+            obs_trace.add_span(f"op:{op.type}", t_op, dt,
+                               tid=obs_trace.OP_TID, cat="op")
+        else:
+            outs = opdef.lower(ctx, ins, op.attrs)
         ctx.env = prev_env
         for slot, names in op.outputs.items():
             produced = outs.get(slot, [])
@@ -615,7 +673,10 @@ class _CompiledProgram:
         key = (steps, seq_names)
         fn = self._multi_cache.get(key)
         if fn is not None:
+            _m_multi_hit.inc()
             return fn
+        _m_multi_miss.inc()
+        _m_compile.labels(kind="multi_step").inc()
         step_fn = self._step_fn
         fold = self.program.random_seed is None
 
@@ -819,6 +880,28 @@ class Executor:
         self._cache: Dict[tuple, _CompiledProgram] = {}
         self._root_keys: Dict[int, Any] = {}
         self._run_counter = 0
+        # recompile-storm detection: compiles per (program, fetch-list)
+        self._compiles_by_fetch_key: Dict[tuple, int] = {}
+        self._storm_warned: set = set()
+
+    def _note_compile(self, program, fetch_names):
+        """Recompile-storm detector: the same (program, fetch-list) key
+        compiling many distinct executables means the jit cache is being
+        defeated — drifting feed shapes/dtypes, scope-state signature
+        churn, or per-step program mutation.  Warns once per key."""
+        n = int(flags.get_flag("recompile_warn_threshold"))
+        fkey = (program._uid, tuple(fetch_names))
+        count = self._compiles_by_fetch_key.get(fkey, 0) + 1
+        self._compiles_by_fetch_key[fkey] = count
+        if n > 0 and count > n and fkey not in self._storm_warned:
+            self._storm_warned.add(fkey)
+            _m_recompile_storm.inc()
+            warnings.warn(
+                f"executor recompile storm: program v{program._version} "
+                f"fetches {list(fetch_names)} compiled {count} distinct "
+                f"executables (> threshold {n}); check for drifting feed "
+                f"shapes/dtypes or per-step program mutation",
+                RuntimeWarning, stacklevel=3)
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -835,14 +918,30 @@ class Executor:
         if program.random_seed is None:
             root = jax.random.fold_in(root, counter)
 
+        profile_ops = bool(flags.get_flag("profile_ops"))
         with RecordEvent(f"executor.run#{len(compiled.fetch_names)}f"):
-            if flags.get_flag("check_nan_inf_per_op"):
+            t0 = time.perf_counter()
+            if flags.get_flag("check_nan_inf_per_op") or profile_ops:
                 # eager (un-jitted) run so every op's outputs are concrete
-                # and the first NaN/Inf source is named
-                fetches, new_state = compiled._step(state, dev_feeds, root)
+                # — the first NaN/Inf source is named, and per-op wall
+                # timings are real
+                _profile_state.active = profile_ops
+                try:
+                    fetches, new_state = compiled._step(state, dev_feeds,
+                                                        root)
+                finally:
+                    _profile_state.active = False
+                mode = "eager"
             else:
                 fetches, new_state = compiled._jitted(state, dev_feeds,
                                                       root)
+                mode = "jit"
+            dt = time.perf_counter() - t0
+        _m_step_seconds.labels(mode=mode).observe(dt)
+        obs_trace.add_span("executor.step", t0, dt,
+                           tid=obs_trace.EXECUTOR_TID, cat="executor",
+                           args={"mode": mode,
+                                 "fetches": len(fetch_names)})
 
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -893,8 +992,9 @@ class Executor:
                       f"steps {steps}")
         if flags.get_flag("check_nan_inf_per_op") or \
                 flags.get_flag("check_nan_inf") or \
+                flags.get_flag("profile_ops") or \
                 (self.mesh is not None and jax.process_count() > 1):
-            # debug planes want per-step visibility, and the
+            # debug/profiling planes want per-step visibility, and the
             # multi-process feed globalization is per-step shaped:
             # degrade to the sequential path (same results)
             outs = []
@@ -916,8 +1016,14 @@ class Executor:
         root, counter = self._root_and_counter(program, steps)
         fn = compiled.jitted_steps(int(steps), tuple(sorted(seq)))
         with RecordEvent(f"executor.run_steps#{steps}"):
+            t0 = time.perf_counter()
             ys, new_state = fn(state, const_feeds, seq_feeds, root,
                                jnp.int32(counter))
+            dt = time.perf_counter() - t0
+        _m_step_seconds.labels(mode="multi").observe(dt)
+        obs_trace.add_span("executor.step", t0, dt,
+                           tid=obs_trace.EXECUTOR_TID, cat="executor",
+                           args={"mode": "multi", "steps": int(steps)})
 
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -982,11 +1088,17 @@ class Executor:
             if flags.get_flag("executor_log_compiles"):
                 print(f"[executor] compiling program v{program._version} "
                       f"feeds={sorted(dev_feeds)} fetches={fetch_names}")
+            _m_cache_miss.inc()
+            _m_compile.labels(kind="step").inc()
+            self._note_compile(program, fetch_names)
             compiled = _CompiledProgram(
                 program, sorted(dev_feeds), fetch_names, sorted(state),
                 persist, self.place, donate=True, mesh=self.mesh,
                 batch_axis=self.batch_axis)
             self._cache[key] = compiled
+            _m_cached_programs.set(len(self._cache))
+        else:
+            _m_cache_hit.inc()
 
         if self.mesh is not None:
             # committed arrays must match in_shardings exactly (strict in

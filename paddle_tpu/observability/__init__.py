@@ -1,0 +1,66 @@
+"""Observability plane: metrics registry + unified trace export.
+
+Two submodules:
+  * :mod:`.metrics` — Counter/Gauge/Histogram registry with labeled
+    series and Prometheus-text / JSON exposition.  The measurement
+    substrate every perf PR regress-tests against.
+  * :mod:`.trace` — one host-span buffer (RecordEvent scopes, executor
+    op/step spans, trainer markers) exported as a single perfetto-
+    loadable chrome-trace JSON.
+
+The instrumented call sites live where the work happens:
+framework/executor.py (compile/cache counters, step latency, per-op
+timings), trainer.py (throughput, loss EMA, memory watermark),
+parallel/parallel_executor.py, bench.py.  docs/OBSERVABILITY.md has the
+metrics catalog.
+"""
+from __future__ import annotations
+
+from . import metrics, trace                                  # noqa: F401
+from .metrics import (REGISTRY, Counter, Gauge, Histogram,    # noqa: F401
+                      MetricsRegistry, counter, gauge, histogram)
+from .trace import export_chrome_trace                        # noqa: F401
+
+_mem_live = metrics.gauge(
+    "device_memory_live_bytes",
+    "Bytes held by live jax.Arrays on this process's devices.")
+_mem_peak = metrics.gauge(
+    "device_memory_peak_bytes",
+    "High-watermark of device_memory_live_bytes within this process.")
+_mem_stats = metrics.gauge(
+    "device_memory_stats_bytes",
+    "Allocator stats per device (when the backend reports them).",
+    ("device", "stat"))
+
+
+def record_device_memory() -> int:
+    """Sample device-memory occupancy into the registry; returns the
+    live-bytes figure.  Uses jax.live_arrays() (always available) plus
+    Device.memory_stats() where the backend provides it (TPU does;
+    CPU returns None)."""
+    import jax
+
+    if not metrics.enabled():
+        return 0
+    live = 0
+    for a in jax.live_arrays():
+        try:
+            live += a.nbytes
+        except Exception:       # deleted/donated arrays race the walk
+            pass
+    _mem_live.set(live)
+    if live > _mem_peak.value:
+        _mem_peak.set(live)
+    for d in jax.local_devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        if not stats:
+            continue
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                _mem_stats.labels(device=str(d.id), stat=key).set(
+                    stats[key])
+    return live

@@ -1,0 +1,376 @@
+"""Metrics registry: Counter / Gauge / Histogram with labeled series.
+
+The measurement substrate for the framework (ISSUE 1): executor compile/
+cache counters, step-latency histograms, trainer throughput gauges and
+bench rows all land here, so one exposition (Prometheus text or JSON)
+describes a live process and a BENCH_r*.json alike.
+
+Design notes
+  * Prometheus data model (metric name + sorted label tuple -> series),
+    but in-process only — exposition is pull-by-call, no HTTP server.
+  * `counter()/gauge()/histogram()` are get-or-create and idempotent, so
+    every module can declare its metrics at import time without an
+    ordering contract.
+  * Recording is gated by the ``metrics`` flag (core/flags.py,
+    ``PTPU_METRICS=0`` env): when off, inc/set/observe are no-ops and the
+    hot paths pay one dict lookup.
+  * Thread-safe: AsyncExecutor's feeder threads and reader processes may
+    record concurrently; one registry lock covers series creation, and
+    per-sample float ops ride the GIL.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core import flags
+
+flags.define_flag("metrics", True,
+                  "Enable the observability metrics registry; when off "
+                  "every inc/set/observe is a no-op.")
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("metrics"))
+
+
+# Latency-oriented default buckets (seconds): 50us .. 60s.
+DEFAULT_BUCKETS = (
+    5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _Series:
+    """State of one (metric, label-values) time series."""
+
+    __slots__ = ("value", "sum", "count", "bucket_counts")
+
+    def __init__(self, buckets: Optional[Tuple[float, ...]] = None):
+        self.value = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.bucket_counts = [0] * (len(buckets) + 1) if buckets else None
+
+
+class Metric:
+    """Base: a named family of labeled series."""
+
+    type = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        self._series: Dict[Tuple[str, ...], _Series] = {}
+        self._lock = threading.Lock()
+        if not self.labelnames:
+            self._series[()] = _Series(self.buckets)
+
+    # -- series addressing -------------------------------------------------
+    def labels(self, **labelvalues) -> "_Child":
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} has labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        s = self._series.get(key)
+        if s is None:
+            with self._lock:
+                s = self._series.setdefault(key, _Series(self.buckets))
+        return _Child(self, s)
+
+    def _default(self) -> _Series:
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name!r} is labeled {self.labelnames}; "
+                f"use .labels(...)")
+        return self._series[()]
+
+    # -- aggregate readers (tests / dashboards) ----------------------------
+    def total(self) -> float:
+        """Sum of all series values (histograms: sum of observations)."""
+        if self.buckets is not None:
+            return sum(s.sum for s in self._series.values())
+        return sum(s.value for s in self._series.values())
+
+    def total_count(self) -> int:
+        """Histogram only: total observation count across series."""
+        return sum(s.count for s in self._series.values())
+
+    def series(self) -> Dict[Tuple[str, ...], _Series]:
+        return dict(self._series)
+
+    def reset(self):
+        with self._lock:
+            for key in list(self._series):
+                self._series[key] = _Series(self.buckets)
+
+
+class _Child:
+    """One addressed series; exposes the metric-type verbs."""
+
+    __slots__ = ("_metric", "_s")
+
+    def __init__(self, metric: Metric, series: _Series):
+        self._metric = metric
+        self._s = series
+
+    def inc(self, amount: float = 1.0):
+        self._metric._inc(self._s, amount)
+
+    def dec(self, amount: float = 1.0):
+        self._metric._inc(self._s, -amount)
+
+    def set(self, value: float):
+        self._metric._set(self._s, value)
+
+    def observe(self, value: float):
+        self._metric._observe(self._s, value)
+
+    @property
+    def value(self) -> float:
+        return self._s.value
+
+    @property
+    def count(self) -> int:
+        return self._s.count
+
+    @property
+    def sum(self) -> float:
+        return self._s.sum
+
+
+class Counter(Metric):
+    """Monotonically increasing count (compiles, cache hits, steps)."""
+
+    type = "counter"
+
+    def inc(self, amount: float = 1.0):
+        self._inc(self._default(), amount)
+
+    def _inc(self, s: _Series, amount: float):
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        if enabled():
+            s.value += amount
+
+    def _set(self, s, value):
+        raise TypeError(f"counter {self.name!r} does not support set()")
+
+    _observe = _set
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class Gauge(Metric):
+    """Point-in-time value (throughput, loss EMA, memory watermark)."""
+
+    type = "gauge"
+
+    def set(self, value: float):
+        self._set(self._default(), value)
+
+    def inc(self, amount: float = 1.0):
+        self._inc(self._default(), amount)
+
+    def dec(self, amount: float = 1.0):
+        self._inc(self._default(), -amount)
+
+    def _set(self, s: _Series, value: float):
+        if enabled():
+            s.value = float(value)
+
+    def _inc(self, s: _Series, amount: float):
+        if enabled():
+            s.value += amount
+
+    def _observe(self, s, value):
+        raise TypeError(f"gauge {self.name!r} does not support observe()")
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class Histogram(Metric):
+    """Distribution with cumulative buckets (latencies)."""
+
+    type = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=None):
+        super().__init__(name, help, labelnames,
+                         buckets=tuple(buckets or DEFAULT_BUCKETS))
+
+    def observe(self, value: float):
+        self._observe(self._default(), value)
+
+    def time(self):
+        """``with hist.time(): ...`` observes the block's wall time."""
+        return _Timer(self._default_child())
+
+    def _default_child(self) -> _Child:
+        return _Child(self, self._default())
+
+    def _observe(self, s: _Series, value: float):
+        if not enabled():
+            return
+        value = float(value)
+        s.sum += value
+        s.count += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                s.bucket_counts[i] += 1
+                return
+        s.bucket_counts[-1] += 1
+
+    def _set(self, s, value):
+        raise TypeError(f"histogram {self.name!r} does not support set()")
+
+    _inc = _set
+
+    @property
+    def count(self) -> int:
+        return self._default().count
+
+    @property
+    def sum(self) -> float:
+        return self._default().sum
+
+
+class _Timer:
+    __slots__ = ("_child", "_t0")
+
+    def __init__(self, child: _Child):
+        self._child = child
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._child.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    """Name -> Metric store with Prometheus-text and JSON exposition."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if (type(existing) is not type(metric)
+                        or existing.labelnames != metric.labelnames):
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{existing.type}{existing.labelnames}")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def metrics(self) -> List[Metric]:
+        return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def reset(self):
+        """Zero every series (keep registrations) — tests and bench."""
+        for m in self.metrics():
+            m.reset()
+
+    # -- exposition --------------------------------------------------------
+    def prometheus_text(self) -> str:
+        """Prometheus text format v0.0.4 exposition."""
+        lines: List[str] = []
+        for m in self.metrics():
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.type}")
+            for key, s in sorted(m.series().items()):
+                base = dict(zip(m.labelnames, key))
+                if m.type == "histogram":
+                    cum = 0
+                    for b, c in zip(m.buckets, s.bucket_counts):
+                        cum += c
+                        lines.append(_sample(f"{m.name}_bucket",
+                                             {**base, "le": _fmt(b)}, cum))
+                    cum += s.bucket_counts[-1]
+                    lines.append(_sample(f"{m.name}_bucket",
+                                         {**base, "le": "+Inf"}, cum))
+                    lines.append(_sample(f"{m.name}_sum", base, s.sum))
+                    lines.append(_sample(f"{m.name}_count", base, s.count))
+                else:
+                    suffix = "_total" if (m.type == "counter" and
+                                          not m.name.endswith("_total")) \
+                        else ""
+                    lines.append(_sample(m.name + suffix, base, s.value))
+        return "\n".join(lines) + "\n"
+
+    def to_json(self) -> dict:
+        """One JSON document for the whole registry — the schema shared
+        with bench.py's metrics dump."""
+        out = {}
+        for m in self.metrics():
+            series = []
+            for key, s in sorted(m.series().items()):
+                row: dict = {"labels": dict(zip(m.labelnames, key))}
+                if m.type == "histogram":
+                    row.update(sum=s.sum, count=s.count,
+                               buckets={_fmt(b): c for b, c in
+                                        zip(m.buckets, s.bucket_counts)},
+                               overflow=s.bucket_counts[-1])
+                else:
+                    row["value"] = s.value
+                series.append(row)
+            out[m.name] = {"type": m.type, "help": m.help,
+                           "series": series}
+        return {"schema": "paddle_tpu.metrics.v1", "metrics": out}
+
+    def dump_json(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+
+
+def _fmt(v: float) -> str:
+    return repr(float(v))
+
+
+def _sample(name: str, labels: Dict[str, str], value) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_escape(str(v))}"'
+                        for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {value}"
+    return f"{name} {value}"
+
+
+def _escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "",
+            labelnames: Sequence[str] = ()) -> Counter:
+    return REGISTRY.register(Counter(name, help, labelnames))
+
+
+def gauge(name: str, help: str = "",
+          labelnames: Sequence[str] = ()) -> Gauge:
+    return REGISTRY.register(Gauge(name, help, labelnames))
+
+
+def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
+              buckets: Optional[Iterable[float]] = None) -> Histogram:
+    return REGISTRY.register(Histogram(name, help, labelnames, buckets))

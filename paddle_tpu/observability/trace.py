@@ -1,0 +1,129 @@
+"""Unified trace plane: one buffer, one chrome-trace export.
+
+Merges every span source — host ``RecordEvent`` scopes (core/profiler.py),
+executor per-op timings and step spans (framework/executor.py), trainer
+step markers (trainer.py) — into a single perfetto-loadable
+chrome://tracing JSON, replacing the reference's two-file story
+(host profile protobuf + CUPTI device trace stitched by tools/timeline.py).
+
+Lanes: every source records under a stable ``tid`` so the timeline groups
+host scopes, executor steps, per-op work and trainer markers as separate
+tracks of one process.  Device-side work still comes from
+``jax.profiler.start_trace`` (XPlane); this file owns the host story.
+
+All timestamps are ``time.perf_counter()`` seconds; export converts to
+the microseconds chrome tracing expects and emits events sorted by ts.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+# Stable lane ids (thread_name metadata is emitted per lane on export).
+HOST_TID = 0        # RecordEvent / RecordBlock host scopes
+EXECUTOR_TID = 1    # executor step dispatches
+OP_TID = 2          # per-op eager timings (PTPU_PROFILE_OPS=1)
+TRAINER_TID = 3     # trainer step/epoch markers
+_LANE_NAMES = {HOST_TID: "host scopes", EXECUTOR_TID: "executor steps",
+               OP_TID: "ops (interpreted)", TRAINER_TID: "trainer"}
+
+_MAX_EVENTS = 1_000_000     # hard cap; beyond it events drop (counted)
+
+_events: List[dict] = []
+_dropped = 0
+_enabled = False
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def reset():
+    global _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+
+
+def dropped() -> int:
+    return _dropped
+
+
+def add_span(name: str, ts: float, dur: float, tid: int = HOST_TID,
+             cat: str = "host", args: Optional[Dict[str, Any]] = None):
+    """Record one complete ('X') event; ts/dur in perf_counter seconds."""
+    _append({"name": name, "ph": "X", "ts": ts, "dur": dur, "tid": tid,
+             "cat": cat, "args": args})
+
+
+def add_instant(name: str, ts: float, tid: int = TRAINER_TID,
+                cat: str = "marker",
+                args: Optional[Dict[str, Any]] = None):
+    """Record one instant ('i') marker event."""
+    _append({"name": name, "ph": "i", "ts": ts, "tid": tid, "cat": cat,
+             "args": args})
+
+
+def _append(e: dict):
+    global _dropped
+    if not _enabled:
+        return
+    with _lock:
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return
+        _events.append(e)
+
+
+def events(cat: Optional[str] = None) -> List[dict]:
+    with _lock:
+        evs = list(_events)
+    if cat is not None:
+        evs = [e for e in evs if e.get("cat") == cat]
+    return evs
+
+
+def to_chrome_trace() -> dict:
+    """The merged trace as a chrome://tracing / perfetto JSON object."""
+    with _lock:
+        evs = sorted(_events, key=lambda e: e["ts"])
+    out: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "paddle_tpu host"}}]
+    for tid, lane in sorted(_LANE_NAMES.items()):
+        out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                    "tid": tid, "args": {"name": lane}})
+    for e in evs:
+        ev = {"name": e["name"], "ph": e["ph"], "pid": 0,
+              "tid": e["tid"], "ts": e["ts"] * 1e6,
+              "cat": e.get("cat", "host")}
+        if e["ph"] == "X":
+            ev["dur"] = e["dur"] * 1e6
+        if e["ph"] == "i":
+            ev["s"] = "t"           # instant scope: thread
+        if e.get("args"):
+            ev["args"] = e["args"]
+        out.append(ev)
+    trace = {"traceEvents": out, "displayTimeUnit": "ms"}
+    if _dropped:
+        trace["metadata"] = {"dropped_events": _dropped}
+    return trace
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the merged trace JSON to `path`; returns the path."""
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(), f)
+    return path

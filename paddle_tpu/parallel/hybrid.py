@@ -190,13 +190,17 @@ def _moe_ffn(x_s, gate_w, w1e, w2e, cfg: HybridConfig, dp_size, dtype):
     xd = jnp.einsum("sec,sd->ecd", dispatch,
                     x_s.astype(jnp.float32)).astype(dtype)       # [E,C,D]
     # all_to_all over dp: rows of E -> owning rank; gather my experts' tokens
-    xd = lax.all_to_all(xd, "dp", split_axis=0, concat_axis=0, tiled=True)
+    with jax.named_scope("collective:ep_all_to_all"):
+        xd = lax.all_to_all(xd, "dp", split_axis=0, concat_axis=0,
+                            tiled=True)
     xd = xd.reshape(dp_size, El, C, D).transpose(1, 0, 2, 3)
     xd = xd.reshape(El, dp_size * C, D)                   # [El, dp*C, D]
     h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", xd, w1e.astype(dtype)))
     o = jnp.einsum("ecf,efd->ecd", h, w2e.astype(dtype))  # [El, dp*C, D]
     o = o.reshape(El, dp_size, C, D).transpose(1, 0, 2, 3).reshape(E, C, D)
-    o = lax.all_to_all(o, "dp", split_axis=0, concat_axis=0, tiled=True)
+    with jax.named_scope("collective:ep_all_to_all"):
+        o = lax.all_to_all(o, "dp", split_axis=0, concat_axis=0,
+                           tiled=True)
     out = jnp.einsum("sec,ecd->sd", combine,
                      o.astype(jnp.float32)).astype(dtype)
     return out, aux
@@ -213,9 +217,16 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
     Lp = cfg.n_layers // Pp
     specs = _specs(mesh, cfg)
 
+    # Per-collective timing scopes: jax.named_scope threads the label into
+    # the XLA HLO metadata, so device traces (jax.profiler.start_trace ->
+    # perfetto) attribute ICI time to the individual collective — the
+    # observability plane's answer to "which collective is the bottleneck"
     def grad_reduce(g, spec):
         axes = grad_reduce_axes(mesh.axis_names, spec)
-        return lax.psum(g, axes) if axes else g
+        if not axes:
+            return g
+        with jax.named_scope("collective:grad_psum"):
+            return lax.psum(g, axes)
 
     # ---- per-device code -------------------------------------------------
     def embed_micro(p, ids):                  # ids [mb, T] -> [mb, Ts, D]
@@ -227,23 +238,29 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
         part = jnp.take(p["embed"], jnp.clip(idx, 0, Vl - 1), axis=0)
         part = jnp.where(valid[..., None], part, 0.0)
         part = part + p["pos"][None, :, :] / Tp   # pos added once after psum
-        emb = lax.psum_scatter(part, "tp", scatter_dimension=1, tiled=True)
+        with jax.named_scope("collective:vocab_psum_scatter"):
+            emb = lax.psum_scatter(part, "tp", scatter_dimension=1,
+                                   tiled=True)
         return emb.astype(dtype)               # [mb, Ts, D]
 
     def block(x_s, lp):                        # one dense block, sp resident
         h = _ln(x_s.astype(jnp.float32), lp["ln1"][0]).astype(dtype)
-        h_full = lax.all_gather(h, "tp", axis=1, tiled=True)   # sp gather
+        with jax.named_scope("collective:sp_all_gather"):
+            h_full = lax.all_gather(h, "tp", axis=1, tiled=True)  # sp gather
         a = _attention(h_full, lp["wqkv"], lp["wo"], dtype)
-        a_s = lax.psum_scatter(a.astype(jnp.float32), "tp",
-                               scatter_dimension=1, tiled=True)
+        with jax.named_scope("collective:tp_psum_scatter"):
+            a_s = lax.psum_scatter(a.astype(jnp.float32), "tp",
+                                   scatter_dimension=1, tiled=True)
         x_s = x_s + a_s.astype(dtype)
         h = _ln(x_s.astype(jnp.float32), lp["ln2"][0]).astype(dtype)
-        h_full = lax.all_gather(h, "tp", axis=1, tiled=True)
+        with jax.named_scope("collective:sp_all_gather"):
+            h_full = lax.all_gather(h, "tp", axis=1, tiled=True)
         f = jax.nn.relu(jnp.einsum("btd,df->btf", h_full,
                                    lp["w1"].astype(dtype)))
         f = jnp.einsum("btf,fd->btd", f, lp["w2"].astype(dtype))
-        f_s = lax.psum_scatter(f.astype(jnp.float32), "tp",
-                               scatter_dimension=1, tiled=True)
+        with jax.named_scope("collective:tp_psum_scatter"):
+            f_s = lax.psum_scatter(f.astype(jnp.float32), "tp",
+                                   scatter_dimension=1, tiled=True)
         return x_s + f_s.astype(dtype)
 
     def stage(p, x_s):                          # Lp blocks (+ optional MoE)
@@ -276,7 +293,8 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
         # shift cancels in lse - label_logit anyway)
         m = lax.pmax(lax.stop_gradient(jnp.max(logits, -1)), "tp")
         se = jnp.sum(jnp.exp(logits - m[..., None]), -1)
-        lse = jnp.log(lax.psum(se, "tp")) + m                   # [N, T]
+        with jax.named_scope("collective:vocab_psum"):
+            lse = jnp.log(lax.psum(se, "tp")) + m               # [N, T]
         tp_r = lax.axis_index("tp")
         Vl = logits.shape[-1]
         idx = labels - tp_r * Vl
@@ -309,8 +327,9 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
             slot = jnp.clip(o_idx, 0, M - 1)
             outs = lax.dynamic_update_index_in_dim(
                 outs, jnp.where(write, out, outs[slot]), slot, 0)
-            nxt = lax.ppermute(out, "pp",
-                               [(i, (i + 1) % Pp) for i in range(Pp)])
+            with jax.named_scope("collective:pp_ppermute"):
+                nxt = lax.ppermute(out, "pp",
+                                   [(i, (i + 1) % Pp) for i in range(Pp)])
             return (nxt, outs, aux_acc), None
 
         (state, outs, aux_acc), _ = lax.scan(

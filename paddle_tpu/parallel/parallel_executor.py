@@ -24,14 +24,29 @@ coordinator rendezvous.
 """
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 
 from ..core.place import Place, default_place, data_parallel_mesh
+from ..core.profiler import RecordEvent
 from ..framework.executor import Executor, Scope, global_scope
 from ..framework.program import Program, default_main_program
+from ..observability import metrics as obs_metrics
+
+# --- telemetry: the data-parallel plane -----------------------------------
+_m_runs = obs_metrics.counter(
+    "parallel_executor_runs_total", "ParallelExecutor.run invocations.")
+_m_run_seconds = obs_metrics.histogram(
+    "parallel_executor_run_seconds",
+    "Wall time of one ParallelExecutor.run (global batch across the "
+    "mesh, fetch conversion included).")
+_m_global_examples_per_sec = obs_metrics.gauge(
+    "parallel_executor_examples_per_sec",
+    "Global-batch throughput of the last ParallelExecutor.run "
+    "(leading dim of the first feed / wall time).")
 
 
 class ExecutionStrategy:
@@ -106,6 +121,21 @@ class ParallelExecutor:
     def run(self, fetch_list: Sequence, feed=None, feed_dict=None,
             return_numpy: bool = True):
         feed = feed if feed is not None else (feed_dict or {})
-        return self._exe.run(self.program, feed=feed,
-                             fetch_list=list(fetch_list),
-                             return_numpy=return_numpy)
+        t0 = time.perf_counter()
+        with RecordEvent("parallel_executor.run"):
+            out = self._exe.run(self.program, feed=feed,
+                                fetch_list=list(fetch_list),
+                                return_numpy=return_numpy)
+        dt = time.perf_counter() - t0
+        _m_runs.inc()
+        _m_run_seconds.observe(dt)
+        if feed and dt > 0:
+            # read the batch dim without np.asarray: that would force a
+            # device->host copy of the feed on the hot path
+            first = next(iter(feed.values()))
+            shape = getattr(first, "shape", None)
+            if shape is None:
+                shape = (len(first),) if hasattr(first, "__len__") else ()
+            if shape:
+                _m_global_examples_per_sec.set(shape[0] / dt)
+        return out

@@ -14,15 +14,42 @@ An explicit mesh= argument still works without any env vars.
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from . import io as pio
 from . import optimizer as optim
+from . import observability
 from .core.enforce import check_arg
 from .framework.executor import Executor, Scope
 from .framework.program import Program, program_guard
+from .observability import metrics as obs_metrics
+from .observability import trace as obs_trace
+
+# --- telemetry: the training-loop view (throughput, loss health) --------
+_m_steps = obs_metrics.counter(
+    "trainer_steps_total", "Optimizer steps taken by Trainer.train.")
+_m_epochs = obs_metrics.counter(
+    "trainer_epochs_total", "Epochs completed by Trainer.train.")
+_m_step_seconds = obs_metrics.histogram(
+    "trainer_step_seconds",
+    "Wall time of one Trainer train step (feed build + device step + "
+    "metric fetch).")
+_m_examples_per_sec = obs_metrics.gauge(
+    "trainer_examples_per_sec",
+    "Smoothed training throughput in examples/s (tokens/s = this x "
+    "sequence length; imgs/s for vision batches).")
+_m_loss = obs_metrics.gauge(
+    "trainer_loss", "Last fetched training loss.")
+_m_loss_ema = obs_metrics.gauge(
+    "trainer_loss_ema",
+    "Exponential moving average (decay 0.9) of the training loss.")
+_EMA_DECAY = 0.9
+# device-memory sampling cadence: the live_arrays()/memory_stats() walk
+# is O(resident arrays), too heavy for every step of a big model
+_MEM_SAMPLE_EVERY = 8
 
 
 class BeginEpochEvent:
@@ -196,11 +223,13 @@ class Trainer:
         feeder = DataFeeder(feed_vars)
         fetch = [self.loss] + self.metrics
         step_in_total = 0
+        loss_ema = None
         for epoch_id in range(self.epoch_offset, num_epochs):
             event_handler(BeginEpochEvent(epoch_id))
             for step_id, batch in enumerate(reader()):
                 begin = BeginStepEvent(epoch_id, step_id)
                 event_handler(begin)
+                t0 = time.perf_counter()
                 feed = feeder.feed(batch)
                 if begin.fetch_metrics:
                     metrics = self.exe.run(self.train_program, feed=feed,
@@ -209,11 +238,29 @@ class Trainer:
                     self.exe.run(self.train_program, feed=feed,
                                  fetch_list=[])
                     metrics = []
+                dt = time.perf_counter() - t0
+                _m_steps.inc()
+                _m_step_seconds.observe(dt)
+                if dt > 0:
+                    _m_examples_per_sec.set(len(batch) / dt)
+                if metrics:
+                    loss_val = float(np.mean(np.asarray(metrics[0])))
+                    _m_loss.set(loss_val)
+                    loss_ema = loss_val if loss_ema is None else (
+                        _EMA_DECAY * loss_ema
+                        + (1 - _EMA_DECAY) * loss_val)
+                    _m_loss_ema.set(loss_ema)
+                if step_in_total % _MEM_SAMPLE_EVERY == 0:
+                    observability.record_device_memory()
+                obs_trace.add_instant(
+                    "trainer.step", t0, tid=obs_trace.TRAINER_TID,
+                    args={"epoch": epoch_id, "step": step_id})
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 step_in_total += 1
                 if (self.checkpoint_cfg and step_in_total %
                         self.checkpoint_cfg.step_interval == 0):
                     self._save_checkpoint(epoch_id, step_id)
+            _m_epochs.inc()
             event_handler(EndEpochEvent(epoch_id))
             if (self.checkpoint_cfg and (epoch_id + 1) %
                     self.checkpoint_cfg.epoch_interval == 0):

@@ -1,13 +1,19 @@
-"""Observability tail (ref debugger.py:118 draw_block_graphviz,
-contrib/memory_usage_calc.py, contrib/op_frequence.py) + the x32 plane
-staying warning-free."""
+"""Observability plane: the metrics registry (counter/gauge/histogram
+semantics, exposition), executor compile/cache-hit counters, the unified
+chrome-trace export, plus the debug tail (ref debugger.py:118
+draw_block_graphviz, contrib/memory_usage_calc.py, contrib/op_frequence.py)
+and the x32 plane staying warning-free."""
+import json
 import warnings
 
 import numpy as np
 import pytest
 
 import paddle_tpu as pt
-from paddle_tpu import layers
+from paddle_tpu import layers, observability
+from paddle_tpu.core import flags, profiler
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import trace as obs_trace
 
 
 def _small_program():
@@ -87,3 +93,281 @@ def test_x32_plane_emits_no_truncation_warnings():
                        feed={"ids": np.zeros((2, 4), "int64")},
                        fetch_list=[loss])
         assert np.isfinite(float(out))
+
+
+# --- metrics registry semantics ------------------------------------------
+
+def test_counter_semantics():
+    c = obs_metrics.counter("t_counter_total", "test counter")
+    v0 = c.value
+    c.inc()
+    c.inc(2.5)
+    assert c.value == pytest.approx(v0 + 3.5)
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # idempotent get-or-create returns the SAME metric
+    assert obs_metrics.counter("t_counter_total") is c
+    # re-registration with a different shape is an error
+    with pytest.raises(ValueError):
+        obs_metrics.gauge("t_counter_total")
+    with pytest.raises(ValueError):
+        obs_metrics.counter("t_counter_total", labelnames=("x",))
+
+
+def test_gauge_and_labels():
+    g = obs_metrics.gauge("t_gauge", "test gauge", ("shard",))
+    g.labels(shard="a").set(4.0)
+    g.labels(shard="b").inc(2.0)
+    g.labels(shard="b").dec(0.5)
+    assert g.labels(shard="a").value == 4.0
+    assert g.labels(shard="b").value == 1.5
+    assert g.total() == pytest.approx(5.5)
+    with pytest.raises(ValueError):
+        g.labels(wrong="a")
+    with pytest.raises(ValueError):
+        g.set(1.0)          # labeled metric needs .labels(...)
+
+
+def test_histogram_semantics():
+    h = obs_metrics.histogram("t_hist_seconds", "test hist",
+                              buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(55.55)
+    s = h.series()[()]
+    assert s.bucket_counts == [1, 1, 1, 1]   # one obs past the last edge
+    with h.time():
+        pass
+    assert h.count == 5
+
+
+def test_metrics_disabled_flag_noops():
+    c = obs_metrics.counter("t_gated_total", "gated")
+    v0 = c.value
+    flags.set_flag("metrics", False)
+    try:
+        c.inc()
+        assert c.value == v0
+    finally:
+        flags.set_flag("metrics", True)
+    c.inc()
+    assert c.value == v0 + 1
+
+
+def test_prometheus_text_and_json_exposition():
+    c = obs_metrics.counter("t_expo_total", "expo test", ("kind",))
+    c.labels(kind="x").inc(2)
+    h = obs_metrics.histogram("t_expo_seconds", "expo hist",
+                              buckets=(1.0, 2.0))
+    h.observe(1.5)
+    text = obs_metrics.REGISTRY.prometheus_text()
+    assert '# TYPE t_expo_total counter' in text
+    assert 't_expo_total{kind="x"} 2.0' in text
+    assert '# TYPE t_expo_seconds histogram' in text
+    assert 't_expo_seconds_bucket' in text and 'le="+Inf"' in text
+    assert 't_expo_seconds_count 1' in text
+    doc = obs_metrics.REGISTRY.to_json()
+    assert doc["schema"] == "paddle_tpu.metrics.v1"
+    row = doc["metrics"]["t_expo_total"]
+    assert row["type"] == "counter"
+    assert row["series"][0] == {"labels": {"kind": "x"}, "value": 2.0}
+    json.dumps(doc)      # whole document must be JSON-serializable
+
+
+# --- executor instrumentation --------------------------------------------
+
+def _compile_counters():
+    reg = obs_metrics.REGISTRY
+    return (reg.get("executor_compile_total").labels(kind="step").value,
+            reg.get("executor_cache_hit_total").value)
+
+
+def test_executor_cache_hit_and_compile_counters():
+    """Acceptance: two identical Executor.run calls -> exactly one
+    compile and at least one cache hit, visible via the registry."""
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "int64")}
+    c0, h0 = _compile_counters()
+    exe.run(main, feed=feed, fetch_list=[loss])
+    exe.run(main, feed=feed, fetch_list=[loss])
+    c1, h1 = _compile_counters()
+    assert c1 - c0 == 1, "identical runs must compile exactly once"
+    assert h1 - h0 >= 1, "second identical run must hit the jit cache"
+    assert obs_metrics.REGISTRY.get("executor_step_seconds").total_count() > 0
+
+
+def test_recompile_storm_warning():
+    """Feeding a new batch size every run defeats the jit cache; past the
+    threshold the executor warns once (and counts the storm)."""
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    old = flags.get_flag("recompile_warn_threshold")
+    flags.set_flag("recompile_warn_threshold", 2)
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for b in range(1, 6):       # 5 distinct feed shapes
+                exe.run(main,
+                        feed={"x": np.ones((b, 4), "float32"),
+                              "y": np.zeros((b, 1), "int64")},
+                        fetch_list=[loss])
+        storms = [x for x in w if "recompile storm" in str(x.message)]
+        assert len(storms) == 1, "must warn exactly once per fetch key"
+    finally:
+        flags.set_flag("recompile_warn_threshold", old)
+
+
+def test_profile_ops_records_per_op_timings():
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    h = obs_metrics.REGISTRY.get("executor_op_seconds")
+    n0 = h.total_count()
+    flags.set_flag("profile_ops", True)
+    profiler.reset_profiler()
+    profiler.enable_profiler()
+    try:
+        exe.run(main, feed={"x": np.ones((2, 4), "float32"),
+                            "y": np.zeros((2, 1), "int64")},
+                fetch_list=[loss])
+    finally:
+        flags.set_flag("profile_ops", False)
+        profiler.disable_profiler()
+    assert h.total_count() > n0
+    op_names = {k[0] for k in h.series()}
+    assert "mul" in op_names and "cross_entropy" in op_names
+    spans = obs_trace.events(cat="op")
+    assert any(e["name"] == "op:mul" for e in spans)
+
+
+# --- unified chrome-trace export -----------------------------------------
+
+def test_unified_chrome_trace_export(tmp_path):
+    """Acceptance: a profiled 3-step run exports ONE chrome-trace JSON
+    holding both host RecordEvent scopes and executor step spans, with
+    schema-valid ph/ts/dur/pid/tid fields sorted by ts."""
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "int64")}
+    profiler.reset_profiler()
+    profiler.enable_profiler()
+    try:
+        with profiler.RecordEvent("my_host_scope"):
+            pass
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+    finally:
+        profiler.disable_profiler()
+    path = str(tmp_path / "trace.json")
+    profiler.export_chrome_trace(path)
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert spans, "trace must contain complete events"
+    for e in spans:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["name"], str) and e["name"]
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts), "events must be sorted by ts"
+    names = {e["name"] for e in events}
+    assert "my_host_scope" in names              # host RecordEvent scope
+    assert any(n.startswith("executor.run") for n in names)
+    assert sum(1 for e in spans if e["name"] == "executor.step") == 3
+    # lane metadata makes perfetto group the tracks
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in events)
+
+
+def test_trace_disabled_records_nothing():
+    obs_trace.reset()
+    obs_trace.disable()
+    obs_trace.add_span("ghost", 0.0, 1.0)
+    assert obs_trace.events() == []
+
+
+# --- trainer / memory telemetry ------------------------------------------
+
+def test_telemetry_smoke_train_loop():
+    """CI smoke (tier-1, not slow): a 3-step profiled training loop must
+    produce zero warnings and a non-empty metrics exposition."""
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            yield [(rng.rand(4).astype("float32"),
+                    np.array([1], "int64")) for _ in range(4)]
+
+    def train_func():
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        p = layers.fc(layers.fc(x, size=8, act="relu"), size=3,
+                      act="softmax")
+        return layers.mean(layers.cross_entropy(p, y))
+
+    steps0 = obs_metrics.REGISTRY.get("trainer_steps_total").value
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        profiler.reset_profiler()
+        profiler.enable_profiler()
+        try:
+            trainer = pt.Trainer(
+                train_func=train_func,
+                optimizer_func=lambda: pt.optimizer.SGD(0.1),
+                place=pt.CPUPlace())
+            trainer.train(num_epochs=1, event_handler=lambda e: None,
+                          reader=reader, feed_order=["x", "y"])
+            trainer.stop()
+        finally:
+            profiler.disable_profiler()
+    assert caught == [], [str(w.message) for w in caught]
+    reg = obs_metrics.REGISTRY
+    assert reg.get("trainer_steps_total").value - steps0 == 3
+    assert reg.get("trainer_loss_ema").value > 0
+    assert reg.get("trainer_examples_per_sec").value > 0
+    assert reg.get("device_memory_live_bytes").value > 0
+    assert reg.get("device_memory_peak_bytes").value >= \
+        reg.get("device_memory_live_bytes").value
+    expo = reg.prometheus_text()
+    assert expo.strip(), "metrics exposition must be non-empty"
+    assert "executor_step_seconds" in expo
+    assert "trainer_steps_total" in expo
+    markers = [e for e in obs_trace.events()
+               if e["name"] == "trainer.step"]
+    assert len(markers) == 3
+
+
+# --- graphviz escaping regression ----------------------------------------
+
+def test_draw_block_graphviz_escapes_special_names(tmp_path):
+    """Regression: op/var names with quotes or <> (e.g. `fetch<0>`) must
+    not break the emitted DOT syntax."""
+    main, _ = _small_program()
+    block = main.global_block()
+    block.create_var(name='fetch<0>', shape=[1], dtype="float32")
+    block.create_var(name='evil"name', shape=[1], dtype="float32")
+    block.append_op(type="scale", inputs={"X": ['fetch<0>']},
+                    outputs={"Out": ['evil"name']},
+                    attrs={"scale": 1.0})
+    path = str(tmp_path / "esc.dot")
+    dot = open(pt.debugger.draw_block_graphviz(block, path=path)).read()
+    assert '\\<' in dot and '\\>' in dot     # angle brackets escaped
+    assert '\\"' in dot                      # quote escaped
+    assert 'fetch<0>' not in dot             # no raw metacharacters leak
+    # every label stays a single balanced quoted string: unescaped-quote
+    # count must be even
+    unescaped = 0
+    prev = ""
+    for ch in dot:
+        if ch == '"' and prev != "\\":
+            unescaped += 1
+        prev = ch if not (prev == "\\" and ch == "\\") else ""
+    assert unescaped % 2 == 0
