@@ -354,6 +354,10 @@ def _record_row_metrics(row):
 
 
 def main():
+    import paddle_tpu.resilience  # noqa: F401 — registers resilience_*,
+    # trainer_rollbacks/bad_steps and retry_* counters so every
+    # registry dump below carries the recovery-overhead series next to
+    # the bench_* gauges (BENCH rounds regress recovery cost too)
     from paddle_tpu.core import flags
     from paddle_tpu.observability import metrics as obs
     on_tpu = jax.devices()[0].platform == "tpu"

@@ -39,6 +39,7 @@ from . import transpiler
 from . import contrib
 from . import debugger
 from . import observability
+from . import resilience
 from . import imperative
 from . import inference
 from . import distributed

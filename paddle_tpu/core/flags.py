@@ -14,17 +14,26 @@ _FLAGS: Dict[str, Any] = {}
 
 
 def define_flag(name: str, default: Any, help_str: str = ""):
-    env = os.environ.get("PTPU_" + name.upper())
+    env_name = "PTPU_" + name.upper()
+    env = os.environ.get(env_name)
     value = default
     if env is not None:
-        if isinstance(default, bool):
-            value = env.lower() in ("1", "true", "yes")
-        elif isinstance(default, int):
-            value = int(env)
-        elif isinstance(default, float):
-            value = float(env)
-        else:
-            value = env
+        try:
+            if isinstance(default, bool):
+                value = env.lower() in ("1", "true", "yes")
+            elif isinstance(default, int):
+                value = int(env)
+            elif isinstance(default, float):
+                value = float(env)
+            else:
+                value = env
+        except ValueError as e:
+            # a bare ValueError at import time names neither the flag nor
+            # the environment variable; wrap it so the operator can find
+            # the offending setting
+            raise ValueError(
+                f"malformed value for flag {name!r}: {env_name}={env!r} "
+                f"is not a valid {type(default).__name__} ({e})") from e
     _FLAGS[name] = value
 
 
@@ -78,3 +87,26 @@ define_flag("amp_bf16", False,
             "MXU as bfloat16 (f32 accumulation, f32 master params) — the "
             "capability of the reference's float16 transpiler "
             "(contrib/float16), applied at lowering time.")
+
+# --- resilience plane (resilience/: chaos, guard, retry) -------------------
+define_flag("chaos_spec", "",
+            "Deterministic fault-injection spec, "
+            "'site=kind[:prob[:arg]][;...]' — e.g. "
+            "'trainer.step=nan:0.1;task_queue.rpc=raise:0.2'. Empty "
+            "disables every fault point (zero-overhead no-ops). Grammar "
+            "and site catalog: docs/RESILIENCE.md.")
+define_flag("chaos_seed", 0,
+            "Seed for the fault-injection schedule; the same (spec, seed) "
+            "reproduces the identical fault sequence.")
+define_flag("nan_policy", "raise",
+            "Numeric-guard policy for a NaN/Inf or loss-spike step in "
+            "Trainer.train: raise | skip_step | rollback (restore the "
+            "newest valid checkpoint and continue).")
+define_flag("bad_step_limit", 5,
+            "Circuit breaker: consecutive bad (NaN/Inf/spike) steps "
+            "tolerated before Trainer.train raises regardless of "
+            "nan_policy. 0 disables the breaker.")
+define_flag("retry_max_attempts", 3,
+            "Default attempt budget for resilience.retry policies "
+            "(task-queue RPC reconnects, transient checkpoint-save "
+            "OSErrors).")

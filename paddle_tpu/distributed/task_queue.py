@@ -223,17 +223,54 @@ def serve_master(master: TaskMaster, host: str = "127.0.0.1",
 
 
 class TaskMasterClient:
-    """Trainer-side client (ref python/paddle/v2/master/client.py:29)."""
+    """Trainer-side client (ref python/paddle/v2/master/client.py:29).
+
+    Resilience (resilience/retry.py): every call passes the
+    ``task_queue.rpc`` chaos fault point and retries with exponential
+    backoff on socket errors, re-dialing the master between attempts —
+    the Go client's re-dial loop.  Retried RPCs are at-least-once: a
+    reply lost on the wire re-leases (get_task) or re-acks; the orphaned
+    lease is reclaimed by the master's lease timeout, the same recovery
+    the reference relies on (service.go:341).  Usable as a context
+    manager, and ``with client.processing(task):`` auto-reports
+    ``task_failed`` when the body raises, so a crashing trainer returns
+    its lease immediately instead of waiting out the lease timeout (ref
+    TaskFailed:455)."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0):
-        self._sock = socket.create_connection((host, port), timeout)
+        from ..resilience import chaos as _chaos, retry as _retry
+        self._chaos, self._retry_mod = _chaos, _retry
+        self.host, self.port, self.timeout = host, port, timeout
+        self._policy = _retry.RetryPolicy(
+            name="task_master_rpc",
+            retry_on=(ConnectionError, socket.timeout, OSError))
+        self._sock = None
+        self._f = None
+        self._connect()
+
+    def _connect(self):
+        self.close()
+        self._sock = socket.create_connection((self.host, self.port),
+                                              self.timeout)
         self._f = self._sock.makefile("rwb")
 
     def _call(self, **req) -> dict:
-        self._f.write((json.dumps(req) + "\n").encode())
-        self._f.flush()
-        resp = json.loads(self._f.readline())
+        def attempt():
+            self._chaos.trigger("task_queue.rpc", exc=ConnectionError)
+            if self._f is None:
+                self._connect()
+            self._f.write((json.dumps(req) + "\n").encode())
+            self._f.flush()
+            line = self._f.readline()
+            if not line:
+                raise ConnectionError("master closed the connection")
+            return json.loads(line)
+
+        resp = self._retry_mod.call_with_retry(
+            attempt, self._policy, on_retry=lambda e: self._connect())
         if not resp.get("ok") and "error" in resp:
+            # an application-level error from a live master is NOT
+            # transient; it propagates without burning retry budget
             raise RuntimeError(f"master error: {resp['error']}")
         return resp
 
@@ -254,6 +291,46 @@ class TaskMasterClient:
     def stats(self) -> dict:
         return self._call(method="stats")["stats"]
 
+    def processing(self, task: Task):
+        """``with client.processing(task): <work>`` — task_finished on
+        success, task_failed (lease returned for immediate requeue) when
+        the body raises."""
+        return _LeaseGuard(self, task)
+
+    def __enter__(self) -> "TaskMasterClient":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def close(self):
-        self._f.close()
-        self._sock.close()
+        for attr in ("_f", "_sock"):
+            obj = getattr(self, attr, None)
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+        self._f = self._sock = None
+
+
+class _LeaseGuard:
+    """Context manager pairing one leased task with its completion
+    report (see TaskMasterClient.processing)."""
+
+    def __init__(self, client: TaskMasterClient, task: Task):
+        self.client, self.task = client, task
+
+    def __enter__(self) -> Task:
+        return self.task
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.client.task_finished(self.task.task_id)
+        else:
+            try:
+                self.client.task_failed(self.task.task_id)
+            except Exception:
+                pass    # master unreachable: the lease timeout covers it
+        return False

@@ -42,6 +42,7 @@ from ..core.place import Place, default_place
 from ..core.profiler import RecordEvent
 from ..observability import metrics as obs_metrics
 from ..observability import trace as obs_trace
+from ..resilience import chaos
 from .program import Program, Variable, default_main_program
 from .registry import LowerContext, get_op_def
 
@@ -911,6 +912,9 @@ class Executor:
             return_numpy: bool = True):
         program = program or default_main_program()
         scope = scope or self.scope
+        # chaos site: a raise/delay here models a failed/slow device
+        # dispatch before any state mutates (docs/RESILIENCE.md catalog)
+        chaos.trigger("executor.run")
         compiled, dev_feeds, state, fetch_names = self._prepare(
             program, feed or {}, list(fetch_list or []), scope)
 
@@ -1091,6 +1095,7 @@ class Executor:
             _m_cache_miss.inc()
             _m_compile.labels(kind="step").inc()
             self._note_compile(program, fetch_names)
+            chaos.trigger("executor.compile")   # chaos site: OOM/XLA-crash
             compiled = _CompiledProgram(
                 program, sorted(dev_feeds), fetch_names, sorted(state),
                 persist, self.place, donate=True, mesh=self.mesh,

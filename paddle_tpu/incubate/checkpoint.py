@@ -29,7 +29,13 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import chaos
+
 MANIFEST = "manifest.json"
+
+# process-0 wait for the other processes' sidecars (monkeypatchable in
+# crash-consistency tests)
+SIDECAR_TIMEOUT = 300.0
 
 
 class CheckpointCorrupt(Exception):
@@ -80,6 +86,9 @@ def save_state(dirname: str, state: Dict[str, Any],
 
     Single-process callers can ignore process arguments."""
     import jax
+    # transient-failure site: a raise-kind fault here models the flaky
+    # filesystem the retry policy in Trainer._save_checkpoint absorbs
+    chaos.trigger("checkpoint.save", exc=OSError)
     p = jax.process_index() if process_index is None else process_index
     n = jax.process_count() if num_processes is None else num_processes
     os.makedirs(dirname, exist_ok=True)
@@ -102,7 +111,12 @@ def save_state(dirname: str, state: Dict[str, Any],
         np.savez(f, **arrays)
     with open(tmp, "rb") as f:
         crc = zlib.crc32(f.read())
-    os.replace(tmp, os.path.join(dirname, shard_name))  # atomic (ref :346)
+    shard_path = os.path.join(dirname, shard_name)
+    os.replace(tmp, shard_path)               # atomic (ref :346)
+    # torn-write site: truncates the committed shard so it no longer
+    # matches the CRC the manifest is about to record — the exact state
+    # a crash mid-flush leaves behind; load/is_valid must skip the serial
+    chaos.corrupt_file("checkpoint.shard_write", shard_path)
 
     # every process contributes a sidecar; process 0 merges them into the
     # manifest, which is written last as the commit point
@@ -115,21 +129,30 @@ def save_state(dirname: str, state: Dict[str, Any],
     if p == 0:
         # barrier via the shared filesystem: every process writes its
         # sidecar atomically; process 0 waits for all of them before
-        # merging (multi-host saves share the checkpoint dir)
+        # merging (multi-host saves share the checkpoint dir).  A reused
+        # checkpoint dir may hold a sidecar from a PREVIOUS save (e.g. a
+        # crash between shard write and manifest commit): merging it
+        # would stamp stale CRCs into this manifest, so a sidecar only
+        # counts once it is consistent with the current save — it names
+        # this save's exact shard layout and is no older than the shard
+        # file it describes (each process writes shard first, sidecar
+        # second; a leftover sidecar predates a rewritten shard).
         import time
-        deadline = time.time() + 300.0
+        deadline = time.time() + SIDECAR_TIMEOUT
         merged_entries: Dict[str, dict] = {}
         crcs: Dict[str, int] = {}
         for q in range(n):
             qp = os.path.join(dirname, f".side_{q:05d}.json")
-            while not os.path.exists(qp):
+            want_shard = f"shard_{q:05d}-of-{n:05d}.npz"
+            while True:
+                s = _load_sidecar_if_current(dirname, qp, want_shard)
+                if s is not None:
+                    break
                 if time.time() > deadline:
                     raise CheckpointCorrupt(
                         f"timed out waiting for process {q}'s shard "
-                        f"sidecar {qp}")
+                        f"sidecar {qp} (missing or stale)")
                 time.sleep(0.05)
-            with open(qp) as f:
-                s = json.load(f)
             crcs.update(s["crc"])
             for name, e in s["entries"].items():
                 if name in merged_entries:
@@ -142,6 +165,33 @@ def save_state(dirname: str, state: Dict[str, Any],
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
         os.replace(mtmp, os.path.join(dirname, MANIFEST))
+        # the manifest is the commit point; consumed sidecars must not
+        # outlive it, or the next save into a reused dir could merge them
+        for q in range(n):
+            try:
+                os.remove(os.path.join(dirname, f".side_{q:05d}.json"))
+            except OSError:
+                pass
+
+
+def _load_sidecar_if_current(dirname: str, side_path: str,
+                             want_shard: str) -> Optional[dict]:
+    """Load a per-process sidecar iff it belongs to the save in
+    progress: it must describe exactly `want_shard` (a sidecar from a
+    run with a different process count names a different file) and be
+    at least as new as that shard file on disk.  Returns None (keep
+    waiting) otherwise."""
+    try:
+        with open(side_path) as f:
+            s = json.load(f)
+        if set(s.get("crc", {})) != {want_shard}:
+            return None
+        shard_path = os.path.join(dirname, want_shard)
+        if os.path.getmtime(side_path) < os.path.getmtime(shard_path):
+            return None         # shard rewritten after this sidecar: stale
+        return s
+    except (OSError, ValueError):
+        return None             # not there yet / torn mid-write
 
 
 def is_valid(dirname: str) -> bool:

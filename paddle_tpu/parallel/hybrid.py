@@ -378,7 +378,19 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
         out_specs=(pspecs, ospecs, P()),
         check_vma=False)
 
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    jitted = jax.jit(sharded, donate_argnums=(0, 1))
+
+    def step(params, opt_state, tokens, labels):
+        # chaos site: the host-side collective-dispatch boundary — a
+        # raise here models an ICI/launch failure surfacing before the
+        # program runs (inside the jitted computation nothing is
+        # injectable; the host boundary is where recovery logic lives)
+        from ..resilience import chaos
+        chaos.trigger("hybrid.collective_dispatch")
+        return jitted(params, opt_state, tokens, labels)
+
+    step.jitted = jitted        # AOT users (lower/compile) reach through
+    return step
 
 
 def make_fake_lm_batch(cfg: HybridConfig, global_batch: int, seed: int = 0):

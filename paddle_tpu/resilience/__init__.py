@@ -1,0 +1,32 @@
+"""Resilience plane: the runtime half of the reference's fault story.
+
+The storage half of the reference's fault tolerance was replicated
+earlier (incubate/checkpoint.py CRC-and-rename, distributed/
+task_queue.py lease/requeue); this package adds the machinery that
+*recovers at runtime* and the machinery that *proves it*:
+
+  * :mod:`.chaos` — deterministic, seeded fault injection.  Named fault
+    points on the executor, checkpoint writer, collective dispatch,
+    task-queue RPC, and trainer step; armed via ``PTPU_CHAOS_SPEC``,
+    replayable exactly from (spec, seed).
+  * :mod:`.guard` — NaN/Inf + EMA loss-spike detection with a
+    raise / skip_step / rollback policy and a consecutive-bad-step
+    circuit breaker.
+  * :mod:`.retry` — exponential-backoff-with-jitter retry applied to
+    ``TaskMasterClient`` calls (reconnect on socket error) and
+    transient checkpoint-save OSErrors.
+
+Preemption tolerance (SIGTERM/SIGINT -> stop at step boundary ->
+emergency checkpoint -> clean exit, plus step-accurate resume) lives in
+``trainer.py``.  Recovery actions emit ``resilience_*`` / ``trainer_*``
+/ ``retry_*`` counters through the observability registry.  Catalog and
+semantics: docs/RESILIENCE.md.
+"""
+from __future__ import annotations
+
+from . import chaos, guard, retry                              # noqa: F401
+from .chaos import InjectedFault, fault_point                  # noqa: F401
+from .guard import (BadStepError, CircuitBreakerOpen,          # noqa: F401
+                    NumericGuard)
+from .retry import RetryPolicy, call_with_retry                # noqa: F401
+from .retry import retry as retry_call                         # noqa: F401

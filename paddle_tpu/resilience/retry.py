@@ -1,0 +1,98 @@
+"""Exponential backoff with jitter for transient failures.
+
+The reference's Go client retries master RPCs until the lease plane
+recovers (go/master/client.go re-dials on error; the pserver client
+retries checkpoint RPCs); here one policy object serves every transient
+boundary: ``TaskMasterClient`` socket errors (reconnect between
+attempts) and checkpoint-save ``OSError``s.  Jitter draws from the same
+crc32 hash the chaos plane uses — keyed on (chaos_seed, policy name,
+attempt) — so a chaos run's full timeline, faults AND backoff sleeps,
+replays exactly.
+"""
+from __future__ import annotations
+
+import functools
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+from ..core import flags
+from ..observability import metrics as obs_metrics
+
+_m_attempts = obs_metrics.counter(
+    "retry_attempts_total",
+    "Retries performed (attempts beyond the first), by policy name.",
+    ("name",))
+_m_exhausted = obs_metrics.counter(
+    "retry_exhausted_total",
+    "Retry budgets exhausted (the last error propagated), by policy "
+    "name.", ("name",))
+
+
+@dataclass
+class RetryPolicy:
+    """max_attempts=None reads the ``retry_max_attempts`` flag at call
+    time, so one env knob tunes every boundary at once."""
+
+    name: str = "default"
+    max_attempts: Optional[int] = None
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.5          # fraction of the delay added, in [0, j)
+    retry_on: Tuple[Type[BaseException], ...] = (ConnectionError, OSError)
+
+    def attempts(self) -> int:
+        n = self.max_attempts
+        if n is None:
+            n = int(flags.get_flag("retry_max_attempts"))
+        return max(1, n)
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry `attempt` (1-based): exponential, capped,
+        plus deterministic jitter."""
+        d = min(self.max_delay, self.base_delay * (2.0 ** (attempt - 1)))
+        if self.jitter > 0:
+            seed = flags.get_flag("chaos_seed")
+            h = zlib.crc32(
+                f"{seed}:retry:{self.name}:{attempt}".encode()) / 0xFFFFFFFF
+            d *= 1.0 + self.jitter * h
+        return d
+
+
+def call_with_retry(fn: Callable, policy: RetryPolicy, *args,
+                    on_retry: Optional[Callable[[BaseException], None]] = None,
+                    **kwargs):
+    """Run fn(*args, **kwargs) under `policy`; `on_retry(exc)` runs
+    between attempts (the reconnect hook).  The final failure re-raises
+    the underlying exception — callers keep their native error types."""
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.attempts() + 1):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            last = e
+            if attempt >= policy.attempts():
+                break
+            _m_attempts.labels(name=policy.name).inc()
+            time.sleep(policy.delay(attempt))
+            if on_retry is not None:
+                try:
+                    on_retry(e)
+                except policy.retry_on:
+                    pass    # a failed reconnect: let the next attempt try
+    _m_exhausted.labels(name=policy.name).inc()
+    assert last is not None
+    raise last
+
+
+def retry(policy: RetryPolicy,
+          on_retry: Optional[Callable[[BaseException], None]] = None):
+    """Decorator form of :func:`call_with_retry`."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return call_with_retry(fn, policy, *args,
+                                   on_retry=on_retry, **kwargs)
+        return wrapped
+    return deco

@@ -14,6 +14,7 @@ An explicit mesh= argument still works without any env vars.
 from __future__ import annotations
 
 import os
+import signal
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -27,6 +28,7 @@ from .framework.executor import Executor, Scope
 from .framework.program import Program, program_guard
 from .observability import metrics as obs_metrics
 from .observability import trace as obs_trace
+from .resilience import chaos, guard as rguard, retry as rretry
 
 # --- telemetry: the training-loop view (throughput, loss health) --------
 _m_steps = obs_metrics.counter(
@@ -46,10 +48,26 @@ _m_loss = obs_metrics.gauge(
 _m_loss_ema = obs_metrics.gauge(
     "trainer_loss_ema",
     "Exponential moving average (decay 0.9) of the training loss.")
+_m_rollbacks = obs_metrics.counter(
+    "trainer_rollbacks_total",
+    "Bad steps recovered by restoring the newest valid checkpoint "
+    "(nan_policy=rollback).")
+_m_skipped = obs_metrics.counter(
+    "trainer_skipped_steps_total",
+    "Bad steps dropped from the health statistics "
+    "(nan_policy=skip_step).")
+_m_preemptions = obs_metrics.counter(
+    "trainer_preemptions_total",
+    "SIGTERM/SIGINT preemptions honored at a step boundary (emergency "
+    "checkpoint + clean exit).")
 _EMA_DECAY = 0.9
 # device-memory sampling cadence: the live_arrays()/memory_stats() walk
 # is O(resident arrays), too heavy for every step of a big model
 _MEM_SAMPLE_EVERY = 8
+# transient-save retry: absorbs flaky-filesystem OSErrors (and the
+# checkpoint.save chaos site) without losing the training step
+_SAVE_RETRY = rretry.RetryPolicy(name="checkpoint_save",
+                                 retry_on=(OSError,))
 
 
 class BeginEpochEvent:
@@ -101,6 +119,12 @@ class Trainer:
         self.startup_program = Program()
         self.train_program = Program()
         self.epoch_offset = 0
+        # steps already completed in the resuming epoch (mid-epoch
+        # checkpoints): train() fast-forwards the reader past them
+        # instead of silently replaying the epoch from the top
+        self.step_offset = 0
+        # set when train() stopped at a step boundary for SIGTERM/SIGINT
+        self.preempted = False
 
         from .framework import unique_name
         # fresh name namespace so a re-constructed Trainer reproduces the
@@ -191,13 +215,16 @@ class Trainer:
     def _save_checkpoint(self, epoch_id: int, step_id: int,
                          epoch_complete: bool = False):
         from .incubate import checkpoint as ckpt
-        # epoch-boundary checkpoints resume at epoch_id+1; mid-epoch
-        # (step-interval) checkpoints restart their epoch — without data
-        # iterator state that epoch's earlier steps are replayed, which is
-        # the reference Trainer's semantic too (contrib/trainer.py:663)
+        # epoch-boundary checkpoints resume at epoch_id+1 / step 0;
+        # mid-epoch (step-interval) checkpoints record the number of
+        # COMPLETED steps in their epoch so resume fast-forwards the
+        # reader to the step boundary instead of replaying the epoch
+        # (the reference replays, contrib/trainer.py:663 — a correctness
+        # hazard once the guard can roll back mid-epoch)
         meta = {"epoch": epoch_id + 1 if epoch_complete else epoch_id,
-                "step": step_id}
-        ckpt.save_checkpoint(
+                "step": 0 if epoch_complete else step_id + 1}
+        rretry.call_with_retry(
+            ckpt.save_checkpoint, _SAVE_RETRY,
             self.checkpoint_cfg.checkpoint_dir, self._persist_state(),
             meta, max_keep=self.checkpoint_cfg.max_num_checkpoints)
 
@@ -213,6 +240,26 @@ class Trainer:
                 arr = jax.device_put(arr, device)
             self.scope.set_var(name, arr)
         self.epoch_offset = int(meta.get("epoch", 0))
+        self.step_offset = int(meta.get("step", 0))
+
+    def _rollback(self) -> bool:
+        """Restore the newest valid checkpoint (params + optimizer
+        state) after a bad step; False when there is nothing to restore."""
+        if not self.checkpoint_cfg:
+            return False
+        serial = self._latest_serial()
+        if serial < 0:
+            return False
+        epoch_b, step_b = self.epoch_offset, self.step_offset
+        self._load_checkpoint(serial)
+        # mid-train rollback restores state only; the loop keeps its
+        # position (the offsets matter to a FUTURE resume, not this one)
+        self.epoch_offset, self.step_offset = epoch_b, step_b
+        _m_rollbacks.inc()
+        obs_trace.add_instant("trainer.rollback", time.perf_counter(),
+                              tid=obs_trace.TRAINER_TID,
+                              args={"serial": serial})
+        return True
 
     # -- loops -------------------------------------------------------------
     def train(self, num_epochs: int, event_handler: Callable,
@@ -223,48 +270,139 @@ class Trainer:
         feeder = DataFeeder(feed_vars)
         fetch = [self.loss] + self.metrics
         step_in_total = 0
-        loss_ema = None
-        for epoch_id in range(self.epoch_offset, num_epochs):
-            event_handler(BeginEpochEvent(epoch_id))
-            for step_id, batch in enumerate(reader()):
-                begin = BeginStepEvent(epoch_id, step_id)
-                event_handler(begin)
-                t0 = time.perf_counter()
-                feed = feeder.feed(batch)
-                if begin.fetch_metrics:
-                    metrics = self.exe.run(self.train_program, feed=feed,
-                                           fetch_list=fetch)
-                else:
-                    self.exe.run(self.train_program, feed=feed,
-                                 fetch_list=[])
-                    metrics = []
-                dt = time.perf_counter() - t0
-                _m_steps.inc()
-                _m_step_seconds.observe(dt)
-                if dt > 0:
-                    _m_examples_per_sec.set(len(batch) / dt)
-                if metrics:
-                    loss_val = float(np.mean(np.asarray(metrics[0])))
-                    _m_loss.set(loss_val)
-                    loss_ema = loss_val if loss_ema is None else (
-                        _EMA_DECAY * loss_ema
-                        + (1 - _EMA_DECAY) * loss_val)
-                    _m_loss_ema.set(loss_ema)
-                if step_in_total % _MEM_SAMPLE_EVERY == 0:
-                    observability.record_device_memory()
-                obs_trace.add_instant(
-                    "trainer.step", t0, tid=obs_trace.TRAINER_TID,
-                    args={"epoch": epoch_id, "step": step_id})
-                event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                step_in_total += 1
-                if (self.checkpoint_cfg and step_in_total %
-                        self.checkpoint_cfg.step_interval == 0):
-                    self._save_checkpoint(epoch_id, step_id)
-            _m_epochs.inc()
-            event_handler(EndEpochEvent(epoch_id))
-            if (self.checkpoint_cfg and (epoch_id + 1) %
-                    self.checkpoint_cfg.epoch_interval == 0):
-                self._save_checkpoint(epoch_id, 0, epoch_complete=True)
+        self.preempted = False
+        health = rguard.NumericGuard(ema_decay=_EMA_DECAY)
+        stop = self._install_preemption_handlers()
+        try:
+            for epoch_id in range(self.epoch_offset, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                batches = iter(reader())
+                start_step = 0
+                if epoch_id == self.epoch_offset and self.step_offset > 0:
+                    # mid-epoch resume: fast-forward past the steps the
+                    # checkpoint already covers instead of replaying them
+                    for _ in range(self.step_offset):
+                        if next(batches, None) is None:
+                            break
+                    start_step = self.step_offset
+                for step_id, batch in enumerate(batches, start=start_step):
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    t0 = time.perf_counter()
+                    feed = feeder.feed(batch)
+                    with chaos.fault_point("trainer.step"):
+                        if begin.fetch_metrics:
+                            metrics = self.exe.run(self.train_program,
+                                                   feed=feed,
+                                                   fetch_list=fetch)
+                        else:
+                            self.exe.run(self.train_program, feed=feed,
+                                         fetch_list=[])
+                            metrics = []
+                    metrics = chaos.poison("trainer.step", metrics)
+                    dt = time.perf_counter() - t0
+                    _m_steps.inc()
+                    _m_step_seconds.observe(dt)
+                    if dt > 0:
+                        _m_examples_per_sec.set(len(batch) / dt)
+                    if metrics:
+                        loss_val = float(np.mean(np.asarray(metrics[0])))
+                        if not self._guard_step(health, loss_val):
+                            metrics = []    # unhealthy: keep it out of
+                            loss_val = None  # EMA/gauges and the event
+                    if metrics:
+                        _m_loss.set(loss_val)
+                        # the guard's EMA (healthy steps only, decay
+                        # _EMA_DECAY) is the single "expected loss"
+                        _m_loss_ema.set(health.ema)
+                    if step_in_total % _MEM_SAMPLE_EVERY == 0:
+                        observability.record_device_memory()
+                    obs_trace.add_instant(
+                        "trainer.step", t0, tid=obs_trace.TRAINER_TID,
+                        args={"epoch": epoch_id, "step": step_id})
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    step_in_total += 1
+                    saved = (self.checkpoint_cfg and step_in_total %
+                             self.checkpoint_cfg.step_interval == 0)
+                    if saved:
+                        self._save_checkpoint(epoch_id, step_id)
+                    if stop["signum"] is not None:
+                        # step boundary: durable state, clean exit — the
+                        # preemption contract (SIGTERM from the scheduler)
+                        self._emergency_stop(epoch_id, step_id, stop,
+                                             already_saved=saved)
+                        return
+                _m_epochs.inc()
+                event_handler(EndEpochEvent(epoch_id))
+                saved = (self.checkpoint_cfg and (epoch_id + 1) %
+                         self.checkpoint_cfg.epoch_interval == 0)
+                if saved:
+                    self._save_checkpoint(epoch_id, 0, epoch_complete=True)
+                if stop["signum"] is not None:
+                    self._emergency_stop(epoch_id + 1, -1, stop,
+                                         already_saved=saved)
+                    return
+        finally:
+            self._restore_preemption_handlers(stop)
+
+    # -- resilience plumbing (resilience/, docs/RESILIENCE.md) -------------
+    def _guard_step(self, health: "rguard.NumericGuard",
+                    loss_val: float) -> bool:
+        """Apply the numeric-guard policy to one fetched loss.  True =
+        healthy; False = bad step absorbed (skip/rollback).  Raises on
+        policy 'raise' and always on an open circuit breaker."""
+        verdict = health.observe(loss_val)   # raises CircuitBreakerOpen
+        if verdict == rguard.OK:
+            return True
+        if health.policy == "raise":
+            raise rguard.BadStepError(
+                f"numeric guard: {verdict} loss {loss_val!r} "
+                f"(nan_policy=raise)")
+        if health.policy == "rollback":
+            if not self._rollback():
+                raise rguard.BadStepError(
+                    f"numeric guard: {verdict} loss {loss_val!r} and no "
+                    f"valid checkpoint to roll back to")
+        else:
+            _m_skipped.inc()
+        return False
+
+    def _install_preemption_handlers(self) -> Dict:
+        """SIGTERM/SIGINT set a flag honored at the next step boundary
+        (emergency checkpoint + clean exit) — the preemption-notice
+        contract of every TPU/Borg-style scheduler.  Returns the stop
+        token; signal handlers only exist in the main thread, so
+        elsewhere this degrades to no preemption handling."""
+        stop: Dict = {"signum": None, "old": {}}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                stop["old"][sig] = signal.signal(
+                    sig, lambda signum, frame: stop.update(signum=signum))
+            except ValueError:      # not the main thread
+                break
+        return stop
+
+    def _restore_preemption_handlers(self, stop: Dict):
+        for sig, old in stop["old"].items():
+            signal.signal(sig, old)
+
+    def _emergency_stop(self, epoch_id: int, step_id: int, stop: Dict,
+                        already_saved: bool = False):
+        _m_preemptions.inc()
+        self.preempted = True
+        # the boundary just checkpointed this exact state: a duplicate
+        # save would only evict an older serial from the rotation window
+        if self.checkpoint_cfg and not already_saved:
+            if step_id < 0:
+                self._save_checkpoint(epoch_id - 1, 0,
+                                      epoch_complete=True)
+            else:
+                self._save_checkpoint(epoch_id, step_id)
+        obs_trace.add_instant(
+            "trainer.preempted", time.perf_counter(),
+            tid=obs_trace.TRAINER_TID,
+            args={"signum": stop["signum"], "epoch": epoch_id,
+                  "step": step_id})
 
     def test(self, reader: Callable, feed_order: Sequence[str]):
         from .data_feeder import DataFeeder

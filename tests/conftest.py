@@ -25,14 +25,29 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long soak/perf tests, excluded from tier-1 "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests "
+        "(seeded, tier-1-safe)")
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
-    """Each test gets fresh default programs + a fresh scope."""
+    """Each test gets fresh default programs + a fresh scope, and no
+    armed chaos spec leaking across tests."""
     import paddle_tpu as pt
     from paddle_tpu.framework import executor as executor_mod
+    from paddle_tpu.resilience import chaos
     pt.reset_default_programs()
     executor_mod._global_scope = executor_mod.Scope()
+    pt.core.flags.set_flag("chaos_spec", "")
+    chaos.reset()
     yield
+    pt.core.flags.set_flag("chaos_spec", "")
+    chaos.reset()
 
 
 @pytest.fixture
