@@ -43,7 +43,7 @@ V100_TOKENS_PER_SEC = 50_000.0          # documented assumption, see above
 _NONE_ROW = {"metric": "none", "value": 0.0, "unit": "",
              "vs_baseline": 0.0}
 REF_RESNET50_IMGS_PER_SEC = 81.69       # IntelOptimizedPaddle.md:45
-V5E_BF16_PEAK = 197e12
+V5E_BF16_PEAK = 197e12          # the TPU default in costmodel.device_peak_flops()
 
 _BASIS = {
     "transformer_lm_train_tokens_per_sec_per_chip":
@@ -113,6 +113,42 @@ def _stage(feed, on_tpu):
     return {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
 
 
+def _attach_cost(row, exe, prog, feed, fetch, dt, analytic=None):
+    """Fill flops_per_step / tflops / mfu from the XLA cost model
+    (Executor.explain; observability/costmodel.py) — model-agnostic, so
+    EVERY row gets them, not just the transformers.  `analytic` is the
+    old hand-rolled FLOPs formula where one exists: kept as the
+    cross-check (flops_vs_analytic, asserted within 10% by
+    tests/test_observability.py) and as the fallback when the cost
+    model is off or unavailable."""
+    flops = None
+    try:
+        rep = exe.explain(prog, feed=feed, fetch_list=[fetch])
+        c = rep.get("cost") or {}
+        f = float(c.get("flops") or 0.0)
+        if f > 0:
+            flops = f
+            row["cost_source"] = c.get("source")
+    except Exception:
+        pass
+    if flops is None and analytic:
+        flops = float(analytic)
+        row["cost_source"] = "analytic_formula"
+    if not flops:
+        return row
+    if analytic:
+        row["flops_vs_analytic"] = round(flops / float(analytic), 3)
+    row["flops_per_step"] = flops
+    tflops = flops / dt / 1e12
+    row["tflops"] = round(tflops, 3)
+    # same peak source as trainer_mfu: the device_peak_flops flag, else
+    # the per-platform table (197e12 on TPU; no peak -> no mfu)
+    from paddle_tpu.observability import costmodel
+    peak = costmodel.device_peak_flops()
+    row["mfu"] = round(flops / dt / peak, 3) if peak > 0 else None
+    return row
+
+
 def bench_lm(on_tpu):
     return _bench_lm_cfg(
         on_tpu, metric="transformer_lm_train_tokens_per_sec_per_chip",
@@ -147,22 +183,22 @@ def _bench_lm_cfg(on_tpu, metric, D, F, L, V, T, batch):
         exe.run(prog, feed=feed, fetch_list=[avg_cost])
     dt, loss = _time_steps(exe, prog, feed, avg_cost, on_tpu)
     toks = batch * T / dt
-    # train FLOPs/token = 3x fwd: qkvo+ffn matmuls, CAUSAL attention
-    # (~T/2 keys per query -> 2*T*D per layer), logits
+    # the OLD hand-rolled train-FLOPs formula (3x fwd: qkvo+ffn matmuls,
+    # causal attention ~T/2 keys/query, logits) survives only as the
+    # cost model's cross-check and fallback (_attach_cost)
     flops_tok = 3 * (L * (8 * D * D + 4 * D * F) + L * 2 * T * D
                      + 2 * D * V)
-    tflops = toks * flops_tok / 1e12
-    return {
+    row = {
         "metric": metric,
         "value": round(toks, 1), "unit": "tokens/s",
         "vs_baseline": round(toks / V100_TOKENS_PER_SEC, 3),
-        "tflops": round(tflops, 1),
-        "mfu": round(tflops * 1e12 / V5E_BF16_PEAK, 3) if on_tpu else None,
         "config": (f"d{D} L{L} T{T} B{batch} V{V} flash-attn + "
                    + ("pallas streamed LM head + " if on_tpu else "")
                    + "amp, executor path"),
         "loss": round(loss, 4),
     }
+    return _attach_cost(row, exe, prog, feed, avg_cost, dt,
+                        analytic=flops_tok * batch * T)
 
 
 def bench_resnet50(on_tpu):
@@ -186,7 +222,7 @@ def bench_resnet50(on_tpu):
         exe.run(prog, feed=feed, fetch_list=[avg_loss])
     dt, loss = _time_steps(exe, prog, feed, avg_loss, on_tpu)
     imgs = batch / dt
-    return {
+    row = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(imgs, 1), "unit": "img/s",
         "vs_baseline": round(imgs / REF_RESNET50_IMGS_PER_SEC, 3),
@@ -194,6 +230,7 @@ def bench_resnet50(on_tpu):
                   f"executor path",
         "loss": round(loss, 4),
     }
+    return _attach_cost(row, exe, prog, feed, avg_loss, dt)
 
 
 def bench_resnet50_infer(on_tpu):
@@ -254,7 +291,7 @@ def bench_nmt(on_tpu):
         exe.run(prog, feed=feed, fetch_list=[avg_cost])
     dt, loss = _time_steps(exe, prog, feed, avg_cost, on_tpu)
     toks = batch * 2 * S / dt           # src+tgt tokens, r01 convention
-    return {
+    row = {
         "metric": "transformer_base_train_tokens_per_sec_per_chip",
         "value": round(toks, 1), "unit": "tokens/s",
         "vs_baseline": round(toks / V100_TOKENS_PER_SEC, 3),
@@ -262,6 +299,7 @@ def bench_nmt(on_tpu):
                   f"amp, executor path",
         "loss": round(loss, 4),
     }
+    return _attach_cost(row, exe, prog, feed, avg_cost, dt)
 
 
 def _img_feed(batch, shape=(3, 224, 224)):
@@ -289,9 +327,10 @@ def _bench_conv_train(on_tpu, model_module, metric, ref_ms, label):
     for _ in range(2):
         exe.run(prog, feed=feed, fetch_list=[loss])
     dt, lval = _time_steps(exe, prog, feed, loss, on_tpu)
-    return _ms_row(metric, dt * 1e3, ref_ms,
-                   f"{label} {shape} bs{batch} momentum + amp, "
-                   f"executor path", lval)
+    row = _ms_row(metric, dt * 1e3, ref_ms,
+                  f"{label} {shape} bs{batch} momentum + amp, "
+                  f"executor path", lval)
+    return _attach_cost(row, exe, prog, feed, loss, dt)
 
 
 def bench_alexnet(on_tpu):
@@ -326,9 +365,10 @@ def bench_lstm(on_tpu):
     for _ in range(2):
         exe.run(prog, feed=feed, fetch_list=[loss])
     dt, lval = _time_steps(exe, prog, feed, loss, on_tpu)
-    return _ms_row("lstm_train_ms_per_batch", dt * 1e3, 184.0,
-                   f"stacked-LSTM h512 T{T} bs{batch} V{V} adam + amp, "
-                   f"executor path", lval)
+    row = _ms_row("lstm_train_ms_per_batch", dt * 1e3, 184.0,
+                  f"stacked-LSTM h512 T{T} bs{batch} V{V} adam + amp, "
+                  f"executor path", lval)
+    return _attach_cost(row, exe, prog, feed, loss, dt)
 
 
 def _record_row_metrics(row):
@@ -347,6 +387,9 @@ def _record_row_metrics(row):
         row["vs_baseline"])
     for field, help_str in (("mfu", "Model FLOPs utilization."),
                             ("tflops", "Achieved model TFLOP/s."),
+                            ("flops_per_step",
+                             "Cost-model FLOPs of one train step "
+                             "(observability/costmodel.py)."),
                             ("loss", "Final training loss of the row.")):
         if row.get(field) is not None:
             obs.gauge(f"bench_{field}", help_str, ("metric",)).labels(

@@ -88,6 +88,28 @@ define_flag("amp_bf16", False,
             "capability of the reference's float16 transpiler "
             "(contrib/float16), applied at lowering time.")
 
+# --- compiled-program introspection (observability/: costmodel, flight) ----
+define_flag("cost_model", True,
+            "Allow the XLA cost model (observability/costmodel.py) to "
+            "analyze compiled programs: per-program FLOPs / bytes / "
+            "peak-HBM gauges, Executor.explain reports and the trainer "
+            "MFU gauge.  Analysis is lazy (first request per program) "
+            "and costs one extra AOT lower+compile of that program.")
+define_flag("device_peak_flops", 0.0,
+            "Per-device peak FLOP/s used for MFU gauges.  0 = "
+            "auto-detect (TPU: 197e12 bf16 v5e peak; other backends "
+            "have no peak and MFU is not exported).")
+define_flag("flight_recorder_path", "",
+            "Where the flight recorder (observability/flight.py) writes "
+            "its JSON diagnostic bundle on NumericGuard trips, retry "
+            "exhaustion, preemption and uncaught trainer exceptions. "
+            "Empty: the bundle is still built and kept in memory "
+            "(flight.last_bundle()), but no file is written.")
+define_flag("flight_recorder_events", 256,
+            "Ring-buffer capacity of the always-on flight recorder "
+            "(recent spans, compile/chaos/guard/retry events). "
+            "0 disables event recording entirely.")
+
 # --- resilience plane (resilience/: chaos, guard, retry) -------------------
 define_flag("chaos_spec", "",
             "Deterministic fault-injection spec, "

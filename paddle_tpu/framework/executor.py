@@ -40,6 +40,9 @@ from ..core.dtypes import to_jnp_dtype
 from ..core.enforce import EnforceNotMet, check_arg
 from ..core.place import Place, default_place
 from ..core.profiler import RecordEvent
+from ..observability import costmodel as obs_cost
+from ..observability import flight as obs_flight
+from ..observability import forensics as obs_forensics
 from ..observability import metrics as obs_metrics
 from ..observability import trace as obs_trace
 from ..resilience import chaos
@@ -66,7 +69,8 @@ _m_multi_miss = obs_metrics.counter(
 _m_recompile_storm = obs_metrics.counter(
     "executor_recompile_storm_total",
     "Times a (program, fetch-list) key crossed the recompile-warn "
-    "threshold (PTPU_RECOMPILE_WARN_THRESHOLD).")
+    "threshold (PTPU_RECOMPILE_WARN_THRESHOLD), by the dominant "
+    "diagnosed drift cause (observability/forensics.py).", ("cause",))
 _m_step_seconds = obs_metrics.histogram(
     "executor_step_seconds",
     "Host wall time of one executor step dispatch (async: excludes "
@@ -529,6 +533,15 @@ class _CompiledProgram:
                         f"({loss!r}) or persistable state instead")
         jit_kwargs = {"donate_argnums": (0,) if donate else ()}
         self._multi_cache: Dict[tuple, Any] = {}
+        # cost-model plane (observability/costmodel.py): abstract args
+        # are noted at first dispatch (ShapeDtypeStructs — no device
+        # buffers pinned), analysis is lazy and cached
+        self._abs_args: Optional[tuple] = None
+        self._cost = None
+        self._tried_analytic = False
+        self._tried_xla = False
+        self._multi_abs: Dict[tuple, tuple] = {}
+        self._multi_cost: Dict[tuple, Any] = {}
         self._state_sharding_fn = None
         self._feed_sharding_fn = None
         spmd_axis = getattr(program, "_dist_spmd_axis", None)
@@ -719,6 +732,71 @@ class _CompiledProgram:
         self._multi_cache[key] = fn
         return fn
 
+    # --- cost model (observability/costmodel.py) ----------------------
+    def note_abs_args(self, state, feeds, key):
+        """Remember the abstract (shape/dtype) argument skeleton of the
+        step — called once, just before the first dispatch, while the
+        (soon-donated) buffers are still valid."""
+        if self._abs_args is None:
+            self._abs_args = (obs_cost.abstractify(state),
+                              obs_cost.abstractify(feeds),
+                              obs_cost.abstractify(key))
+
+    def note_multi_abs_args(self, mkey, args):
+        if mkey not in self._multi_abs:
+            self._multi_abs[mkey] = obs_cost.abstractify(args)
+
+    def _cost_label(self, kind: str, abs_args) -> str:
+        return obs_cost.args_label(self.program._uid,
+                                   self.program._version, abs_args, kind)
+
+    def cost(self, prefer_analytic: bool = False):
+        """Lazy, cached cost/memory analysis of the compiled step.  The
+        XLA path costs one extra AOT lower+compile on first call;
+        ``prefer_analytic=True`` settles for the (cheap) jaxpr walk.
+        A cached XLA result is always reused; a cached analytic result
+        is upgraded when a caller later asks for the XLA view.  None
+        when the cost_model flag is off, the program never ran, or
+        analysis failed."""
+        if self._abs_args is None or not obs_cost.enabled():
+            return self._cost
+        have = self._cost
+        if have is not None and (have.source == "xla" or prefer_analytic):
+            return have
+        # each path gets ONE attempt (callers like the trainer may ask
+        # every step, so a failing trace must not be retried per step);
+        # a failed analytic try never blocks a later XLA request
+        if self._tried_analytic if prefer_analytic else self._tried_xla:
+            return have
+        got = obs_cost.analyze_jitted(
+            self._jitted, self._abs_args,
+            self._cost_label("step", self._abs_args),
+            prefer_analytic=prefer_analytic)
+        if prefer_analytic:
+            self._tried_analytic = True
+        else:
+            # the XLA path internally falls back to the jaxpr walk, so
+            # a full attempt exhausts both
+            self._tried_xla = self._tried_analytic = True
+        if got is not None:
+            self._cost = got
+        return self._cost
+
+    def multi_cost(self, mkey):
+        """Cost analysis of one run_steps device loop (a _multi_cache
+        entry), keyed like the cache: (steps, seq_names)."""
+        if mkey in self._multi_cost:
+            return self._multi_cost[mkey]
+        abs_args = self._multi_abs.get(mkey)
+        fn = self._multi_cache.get(mkey)
+        if abs_args is None or fn is None or not obs_cost.enabled():
+            return None
+        steps = mkey[0]
+        cost = obs_cost.analyze_jitted(
+            fn, abs_args, self._cost_label(f"multi{steps}", abs_args))
+        self._multi_cost[mkey] = cost
+        return cost
+
     def _pp_partition(self):
         """Split the forward op list at pipeline_boundary markers into
         stage sub-programs; returns (stage_ops, boundary_var_names).
@@ -884,24 +962,37 @@ class Executor:
         # recompile-storm detection: compiles per (program, fetch-list)
         self._compiles_by_fetch_key: Dict[tuple, int] = {}
         self._storm_warned: set = set()
+        self._last_compiled: Optional[_CompiledProgram] = None
+        # forensics scope: this executor's jit cache (NOT id(self) —
+        # ids are reused after GC and would inherit dead keys)
+        self._forensics_owner = obs_forensics.new_owner()
 
-    def _note_compile(self, program, fetch_names):
-        """Recompile-storm detector: the same (program, fetch-list) key
-        compiling many distinct executables means the jit cache is being
-        defeated — drifting feed shapes/dtypes, scope-state signature
-        churn, or per-step program mutation.  Warns once per key."""
+    def _note_compile(self, program, fetch_names, key_parts):
+        """Recompile-storm detector + forensics: every miss is diffed
+        against the retained key for its (program, fetch-list), so the
+        warning names WHICH component churned (feed shapes vs dtypes vs
+        scope-state signature vs program version vs flags) instead of
+        guessing.  Warns once per key."""
+        rec = obs_forensics.note_compile(key_parts)
         n = int(flags.get_flag("recompile_warn_threshold"))
         fkey = (program._uid, tuple(fetch_names))
         count = self._compiles_by_fetch_key.get(fkey, 0) + 1
         self._compiles_by_fetch_key[fkey] = count
         if n > 0 and count > n and fkey not in self._storm_warned:
             self._storm_warned.add(fkey)
-            _m_recompile_storm.inc()
+            cause = obs_forensics.dominant_cause(
+                program._uid, tuple(fetch_names),
+                owner=self._forensics_owner)
+            _m_recompile_storm.labels(cause=cause).inc()
+            detail = "; ".join(rec.details[:3]) or "no drift recorded"
+            hist = obs_forensics.describe_causes(
+                program._uid, tuple(fetch_names),
+                owner=self._forensics_owner)
             warnings.warn(
                 f"executor recompile storm: program v{program._version} "
                 f"fetches {list(fetch_names)} compiled {count} distinct "
-                f"executables (> threshold {n}); check for drifting feed "
-                f"shapes/dtypes or per-step program mutation",
+                f"executables (> threshold {n}); drifting component(s): "
+                f"{hist} — latest: {detail}",
                 RuntimeWarning, stacklevel=3)
 
     # ------------------------------------------------------------------
@@ -921,6 +1012,7 @@ class Executor:
         root, counter = self._root_and_counter(program, 1)
         if program.random_seed is None:
             root = jax.random.fold_in(root, counter)
+        compiled.note_abs_args(state, dev_feeds, root)
 
         profile_ops = bool(flags.get_flag("profile_ops"))
         with RecordEvent(f"executor.run#{len(compiled.fetch_names)}f"):
@@ -946,6 +1038,7 @@ class Executor:
                            tid=obs_trace.EXECUTOR_TID, cat="executor",
                            args={"mode": mode,
                                  "fetches": len(fetch_names)})
+        obs_flight.record("span", "executor.step", mode=mode, dur=dt)
 
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -1018,16 +1111,22 @@ class Executor:
         seq_feeds = {k: v for k, v in dev_feeds.items() if k in seq}
 
         root, counter = self._root_and_counter(program, steps)
+        mkey = (int(steps), tuple(sorted(seq)))
         fn = compiled.jitted_steps(int(steps), tuple(sorted(seq)))
+        counter_arr = jnp.int32(counter)
+        compiled.note_multi_abs_args(
+            mkey, (state, const_feeds, seq_feeds, root, counter_arr))
         with RecordEvent(f"executor.run_steps#{steps}"):
             t0 = time.perf_counter()
             ys, new_state = fn(state, const_feeds, seq_feeds, root,
-                               jnp.int32(counter))
+                               counter_arr)
             dt = time.perf_counter() - t0
         _m_step_seconds.labels(mode="multi").observe(dt)
         obs_trace.add_span("executor.step", t0, dt,
                            tid=obs_trace.EXECUTOR_TID, cat="executor",
                            args={"mode": "multi", "steps": int(steps)})
+        obs_flight.record("span", "executor.run_steps", steps=int(steps),
+                          dur=dt)
 
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -1077,16 +1176,18 @@ class Executor:
         persist = sorted({v.name for v in program.list_vars() if v.persistable})
         state = {n: scope.find_var(n) for n in persist if scope.has_var(n)}
 
-        key = (program._uid, program._version,
-               tuple(sorted((n, a.shape, str(a.dtype))
-                            for n, a in dev_feeds.items())),
-               tuple(fetch_names),
-               tuple(sorted((n, tuple(a.shape), str(a.dtype))
-                            for n, a in state.items())),
-               # numerics-affecting flags are baked in at trace time, so a
-               # runtime toggle must compile a fresh executable
-               bool(flags.get_flag("amp_bf16")),
-               bool(flags.get_flag("use_pallas_kernels")))
+        feeds_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                                 for n, a in dev_feeds.items()))
+        state_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                                 for n, a in state.items()))
+        # numerics-affecting flags are baked in at trace time, so a
+        # runtime toggle must compile a fresh executable
+        flags_sig = (("amp_bf16", bool(flags.get_flag("amp_bf16"))),
+                     ("use_pallas_kernels",
+                      bool(flags.get_flag("use_pallas_kernels"))))
+        key = (program._uid, program._version, feeds_sig,
+               tuple(fetch_names), state_sig,
+               flags_sig[0][1], flags_sig[1][1])
         compiled = self._cache.get(key)
         if compiled is None:
             if flags.get_flag("executor_log_compiles"):
@@ -1094,7 +1195,14 @@ class Executor:
                       f"feeds={sorted(dev_feeds)} fetches={fetch_names}")
             _m_cache_miss.inc()
             _m_compile.labels(kind="step").inc()
-            self._note_compile(program, fetch_names)
+            self._note_compile(program, fetch_names,
+                               obs_forensics.KeyParts(
+                                   program_uid=program._uid,
+                                   program_version=program._version,
+                                   feeds=feeds_sig,
+                                   fetch_names=tuple(fetch_names),
+                                   state=state_sig, flags=flags_sig,
+                                   owner=self._forensics_owner))
             chaos.trigger("executor.compile")   # chaos site: OOM/XLA-crash
             compiled = _CompiledProgram(
                 program, sorted(dev_feeds), fetch_names, sorted(state),
@@ -1123,6 +1231,7 @@ class Executor:
                 if not a.sharding.is_equivalent_to(want, a.ndim):
                     state[n] = jax.device_put(a, want)
 
+        self._last_compiled = compiled
         return compiled, dev_feeds, state, fetch_names
 
     def _root_and_counter(self, program, n):
@@ -1130,14 +1239,91 @@ class Executor:
         [counter, counter+n) this call consumes — run() folds on the
         host, run_steps folds per-iteration inside the scan, both
         producing the identical key sequence."""
+        root = self._peek_root(program)
+        counter = self._run_counter
+        self._run_counter += n
+        return root, counter
+
+    def _peek_root(self, program):
+        """The root key WITHOUT consuming a run-counter slot (explain()
+        must not perturb the RNG sequence of subsequent runs)."""
         seed = (program.random_seed if program.random_seed is not None
                 else flags.get_flag("rng_seed"))
         root = self._root_keys.get(seed)
         if root is None:        # cache: PRNGKey is a device computation
             root = self._root_keys[seed] = jax.random.PRNGKey(seed)
-        counter = self._run_counter
-        self._run_counter += n
-        return root, counter
+        return root
+
+    # --- compiled-program introspection (observability plane) ---------
+    def explain(self, program: Optional[Program] = None,
+                feed: Optional[Dict[str, Any]] = None,
+                fetch_list: Optional[Sequence] = None,
+                scope: Optional[Scope] = None) -> dict:
+        """Cost/memory report for the compiled program this
+        (program, feed, fetch_list) resolves to — compiling it if
+        needed, WITHOUT running it or consuming RNG state.
+
+        Returns per-program FLOPs, bytes accessed, peak HBM and the
+        argument-vs-temp footprint split (XLA cost model, or the jaxpr
+        analytic fallback — see ``cost.source``), plus the program's op
+        histogram and the executor's cache view of the key."""
+        program = program or default_main_program()
+        scope = scope or self.scope
+        compiled, dev_feeds, state, fetch_names = self._prepare(
+            program, feed or {}, list(fetch_list or []), scope)
+        compiled.note_abs_args(state, dev_feeds,
+                               self._peek_root(program))
+        cost = compiled.cost()
+        op_hist: Dict[str, int] = {}
+        for op in compiled._ops:
+            op_hist[op.type] = op_hist.get(op.type, 0) + 1
+        fkey = (program._uid, tuple(fetch_names))
+        return {
+            "schema": "paddle_tpu.explain.v1",
+            "program": {"uid": program._uid,
+                        "version": program._version,
+                        "ops": len(compiled._ops),
+                        "op_histogram": op_hist},
+            "feeds": {n: {"shape": list(a.shape),
+                          "dtype": str(a.dtype)}
+                      for n, a in sorted(dev_feeds.items())},
+            "fetches": list(fetch_names),
+            "state": {"vars": len(state),
+                      "bytes": int(sum(
+                          getattr(a, "nbytes", 0) for a in
+                          state.values()))},
+            "cost": cost.to_dict() if cost else None,
+            "cache": {
+                "cached_programs": len(self._cache),
+                "compiles_for_key":
+                    self._compiles_by_fetch_key.get(fkey, 0),
+                "recent_causes": obs_forensics.cause_histogram(
+                    program._uid, tuple(fetch_names),
+                    owner=self._forensics_owner),
+            },
+            "flags": {k: flags.get_flag(k) for k in
+                      ("amp_bf16", "use_pallas_kernels", "cost_model")},
+        }
+
+    def last_run_cost(self, prefer_analytic: bool = False):
+        """ProgramCost of the most recently prepared/run program (lazy
+        analysis on first call) — the trainer's MFU source.
+        ``prefer_analytic=True`` avoids the extra AOT compile (the
+        trainer's default: one cheap abstract trace instead)."""
+        c = self._last_compiled
+        return c.cost(prefer_analytic=prefer_analytic) \
+            if c is not None else None
+
+    def compile_log(self, program: Optional[Program] = None):
+        """The forensics compile log (diagnosed causes per compile),
+        optionally filtered to one program."""
+        return obs_forensics.compile_log(
+            program._uid if program is not None else None)
+
+    def cache_report(self, compute_costs: bool = True) -> dict:
+        """Compile-cache explorer: every cached executable (step and
+        run_steps device loops) with its cost/memory summary."""
+        return obs_forensics.cache_report(self, compute_costs)
 
     def _globalize_feed(self, program, name, var, arr):
         """Build a global jax.Array for `arr` (the full global batch,

@@ -1,22 +1,36 @@
-"""Observability plane: metrics registry + unified trace export.
+"""Observability plane: metrics, traces, and compiled-program
+introspection.
 
-Two submodules:
+Submodules:
   * :mod:`.metrics` — Counter/Gauge/Histogram registry with labeled
     series and Prometheus-text / JSON exposition.  The measurement
     substrate every perf PR regress-tests against.
   * :mod:`.trace` — one host-span buffer (RecordEvent scopes, executor
     op/step spans, trainer markers) exported as a single perfetto-
     loadable chrome-trace JSON.
+  * :mod:`.costmodel` — XLA ``cost_analysis``/``memory_analysis`` (plus
+    a jaxpr-walking analytic fallback) for every compiled program:
+    per-program FLOPs / bytes / peak-HBM gauges, ``Executor.explain``
+    and the trainer's model-agnostic MFU gauge.
+  * :mod:`.forensics` — recompile-cause diagnosis (which cache-key
+    component churned), the bounded compile log and the compile-cache
+    explorer.
+  * :mod:`.flight` — always-on bounded flight recorder; dumps one JSON
+    diagnostic bundle on guard trips / retry exhaustion / preemption /
+    uncaught trainer exceptions.
+  * :mod:`.bench_gate` — ``python -m paddle_tpu.observability.bench_gate``
+    compares a bench_metrics.json against a committed BENCH_r*.json
+    baseline and exits nonzero on regression.
 
 The instrumented call sites live where the work happens:
 framework/executor.py (compile/cache counters, step latency, per-op
-timings), trainer.py (throughput, loss EMA, memory watermark),
-parallel/parallel_executor.py, bench.py.  docs/OBSERVABILITY.md has the
-metrics catalog.
+timings, cost-model wiring), trainer.py (throughput, loss EMA, memory
+watermark, MFU), parallel/parallel_executor.py, bench.py.
+docs/OBSERVABILITY.md has the metrics catalog.
 """
 from __future__ import annotations
 
-from . import metrics, trace                                  # noqa: F401
+from . import costmodel, flight, forensics, metrics, trace   # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,    # noqa: F401
                       MetricsRegistry, counter, gauge, histogram)
 from .trace import export_chrome_trace                        # noqa: F401

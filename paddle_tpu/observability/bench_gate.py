@@ -1,0 +1,137 @@
+"""Bench regression gate: compare a bench_metrics.json against a
+committed BENCH_r*.json baseline and exit nonzero on regression.
+
+    python -m paddle_tpu.observability.bench_gate \
+        --baseline BENCH_r05.json --candidate bench_metrics.json \
+        --tolerance 0.15
+
+Accepted input formats (both sides, auto-detected):
+
+* driver records — ``{"parsed": {"summary": {metric: {"value": v}}}}``
+  (the committed BENCH_r*.json files);
+* registry dumps — ``{"schema": "paddle_tpu.metrics.v1", ...}`` with
+  ``bench_value{metric=...}`` series (what bench.py writes to
+  ``PTPU_BENCH_METRICS_PATH``);
+* plain ``{metric: value}`` maps (synthetic/test inputs).
+
+Direction is inferred from the metric name: ``*_ms_per_batch`` rows are
+lower-is-better, everything else (tokens/s, img/s) higher-is-better.  A
+candidate more than ``tolerance`` (fractional) WORSE than baseline is a
+regression; a baseline metric missing from the candidate is a failure
+unless ``--allow-missing``.  Candidate-only metrics are reported as
+``new`` and never fail the gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_metric_values(doc: dict) -> Dict[str, float]:
+    """Extract {metric: value} from any accepted input format."""
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"expected a JSON object, got {type(doc).__name__}")
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]
+    if "summary" in doc and isinstance(doc["summary"], dict):
+        out = {}
+        for m, row in doc["summary"].items():
+            out[m] = float(row["value"]) if isinstance(row, dict) \
+                else float(row)
+        return out
+    if str(doc.get("schema", "")).startswith("paddle_tpu.metrics"):
+        out = {}
+        fam = doc.get("metrics", {}).get("bench_value", {})
+        for row in fam.get("series", []):
+            m = row.get("labels", {}).get("metric")
+            if m is not None:
+                out[m] = float(row["value"])
+        return out
+    return {m: float(v) for m, v in doc.items()
+            if isinstance(v, (int, float))}
+
+
+def lower_is_better(metric: str) -> bool:
+    return metric.endswith("_ms_per_batch") or metric.endswith("_seconds")
+
+
+def compare(baseline: Dict[str, float], candidate: Dict[str, float],
+            tolerance: float) -> List[dict]:
+    """Per-metric verdict rows: status ok | regression | missing | new."""
+    rows = []
+    for metric in sorted(set(baseline) | set(candidate)):
+        base = baseline.get(metric)
+        cand = candidate.get(metric)
+        if base is None:
+            rows.append({"metric": metric, "candidate": cand,
+                         "status": "new"})
+            continue
+        if cand is None:
+            rows.append({"metric": metric, "baseline": base,
+                         "status": "missing"})
+            continue
+        if base == 0:
+            ratio = float("inf") if cand else 1.0
+        else:
+            ratio = cand / base
+        if lower_is_better(metric):
+            regressed = cand > base * (1.0 + tolerance)
+        else:
+            regressed = cand < base * (1.0 - tolerance)
+        rows.append({"metric": metric, "baseline": base,
+                     "candidate": cand, "ratio": round(ratio, 4),
+                     "status": "regression" if regressed else "ok"})
+    return rows
+
+
+def gate(baseline: Dict[str, float], candidate: Dict[str, float],
+         tolerance: float = 0.15, allow_missing: bool = False) -> dict:
+    rows = compare(baseline, candidate, tolerance)
+    bad = [r for r in rows if r["status"] == "regression"
+           or (r["status"] == "missing" and not allow_missing)]
+    return {"schema": "paddle_tpu.bench_gate.v1",
+            "tolerance": tolerance, "rows": rows,
+            "regressions": [r["metric"] for r in bad], "ok": not bad}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.bench_gate",
+        description="Compare bench metrics against a committed baseline; "
+                    "exit 1 on regression.")
+    p.add_argument("--baseline", default="BENCH_r05.json")
+    p.add_argument("--candidate", default="bench_metrics.json")
+    p.add_argument("--tolerance", type=float, default=0.15,
+                   help="fractional slowdown tolerated (default 0.15)")
+    p.add_argument("--allow-missing", action="store_true",
+                   help="baseline metrics absent from the candidate "
+                        "do not fail the gate")
+    args = p.parse_args(argv)
+    try:
+        with open(args.baseline) as f:
+            base = load_metric_values(json.load(f))
+        with open(args.candidate) as f:
+            cand = load_metric_values(json.load(f))
+    except (OSError, ValueError, KeyError) as e:
+        print(f"bench_gate: cannot load inputs: {e!r}", file=sys.stderr)
+        return 2
+    if not base:
+        print(f"bench_gate: no metrics found in baseline "
+              f"{args.baseline}", file=sys.stderr)
+        return 2
+    result = gate(base, cand, args.tolerance, args.allow_missing)
+    for r in result["rows"]:
+        mark = {"ok": "  ok", "new": " new",
+                "missing": "MISS", "regression": "FAIL"}[r["status"]]
+        ratio = f" ({r['ratio']:.3f}x)" if "ratio" in r else ""
+        print(f"[{mark}] {r['metric']}{ratio}")
+    print(json.dumps({k: result[k] for k in
+                      ("tolerance", "regressions", "ok")}))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,346 @@
+"""XLA cost model: per-compiled-program FLOPs / bytes / peak-HBM.
+
+The compiler-facing half of the observability plane (ISSUE 3).  PR 1
+counts *how often* the executor compiles and how long steps take; this
+module says *how well* the hardware is used: every compiled program
+(``_CompiledProgram``'s jitted step, ``run_steps``' ``_multi_cache``
+device loops, the parallel executor's pjit programs — they all funnel
+through the same ``jax.jit`` objects) can be lowered ahead-of-time and
+asked for XLA's own accounting::
+
+    lowered = jitted.lower(*abstract_args)
+    compiled = lowered.compile()
+    compiled.cost_analysis()      # {'flops': ..., 'bytes accessed': ...}
+    compiled.memory_analysis()    # argument/output/temp/alias bytes
+
+which is the JAX equivalent of the reference's per-op profiler +
+memory-usage analysis (platform/profiler.h, contrib/memory_usage_calc),
+and the accounting PaLM-style MFU reporting standardized.
+
+When the XLA path is unavailable (backend without cost analysis, a
+lowering that cannot be re-traced abstractly), a jaxpr-walking
+*analytical* fallback estimates FLOPs (dot_general / conv counted
+exactly from shapes, everything else as one flop per output element)
+and bytes (operand + result footprints).  Reports carry a ``source``
+field ("xla" | "analytic") so dashboards know which accounting they are
+reading.
+
+Analysis is LAZY and cached per compiled program: the first request
+(``Executor.explain``, the trainer's MFU gauge, ``bench.py``,
+``forensics.cache_report``) pays one extra AOT trace+compile; steady
+state pays nothing.  The ``cost_model`` flag gates the whole plane.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from ..core import flags
+from . import metrics as obs_metrics
+
+# --- registry metrics: one gauge family per cost dimension ---------------
+_m_flops = obs_metrics.gauge(
+    "program_cost_flops",
+    "XLA/analytic FLOPs of one execution of a compiled program.",
+    ("program",))
+_m_bytes = obs_metrics.gauge(
+    "program_cost_bytes_accessed",
+    "Bytes accessed (HBM traffic) of one execution of a compiled "
+    "program.", ("program",))
+_m_peak = obs_metrics.gauge(
+    "program_cost_peak_hbm_bytes",
+    "Peak device-memory footprint of a compiled program "
+    "(arguments + outputs + XLA temps - aliased/donated).", ("program",))
+_m_mem = obs_metrics.gauge(
+    "program_cost_memory_bytes",
+    "Memory footprint of a compiled program by component "
+    "(argument/output/temp/alias).", ("program", "component"))
+
+# v5e bf16 peak — the bar bench.py has always used for TPU MFU.
+_TPU_PEAK_FLOPS = 197e12
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("cost_model"))
+
+
+def device_peak_flops() -> float:
+    """Per-device peak FLOP/s for MFU: the ``device_peak_flops`` flag
+    when set, else a per-platform table (TPU only).  0.0 = unknown."""
+    v = float(flags.get_flag("device_peak_flops"))
+    if v > 0:
+        return v
+    import jax
+    try:
+        if jax.devices()[0].platform == "tpu":
+            return _TPU_PEAK_FLOPS
+    except Exception:
+        pass
+    return 0.0
+
+
+@dataclass
+class ProgramCost:
+    """One compiled program's cost/memory accounting."""
+
+    label: str
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    alias_bytes: int = 0
+    generated_code_bytes: int = 0
+    source: str = "xla"          # "xla" | "analytic"
+    raw: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def peak_hbm_bytes(self) -> int:
+        return max(0, self.argument_bytes + self.output_bytes
+                   + self.temp_bytes - self.alias_bytes)
+
+    def to_dict(self) -> dict:
+        return {
+            "label": self.label,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "source": self.source,
+        }
+
+
+# computed costs by label — the flight recorder's per-program summary
+_lock = threading.Lock()
+_COSTS: Dict[str, ProgramCost] = {}
+
+
+def summaries() -> Dict[str, dict]:
+    """Every cost computed so far, keyed by program label (flight.py
+    folds this into the diagnostic bundle)."""
+    with _lock:
+        return {k: v.to_dict() for k, v in _COSTS.items()}
+
+
+def reset():
+    with _lock:
+        _COSTS.clear()
+
+
+def _publish(cost: ProgramCost):
+    with _lock:
+        _COSTS[cost.label] = cost
+    _m_flops.labels(program=cost.label).set(cost.flops)
+    _m_bytes.labels(program=cost.label).set(cost.bytes_accessed)
+    _m_peak.labels(program=cost.label).set(cost.peak_hbm_bytes)
+    for comp, v in (("argument", cost.argument_bytes),
+                    ("output", cost.output_bytes),
+                    ("temp", cost.temp_bytes),
+                    ("alias", cost.alias_bytes)):
+        _m_mem.labels(program=cost.label, component=comp).set(v)
+
+
+def abstractify(tree):
+    """Shape/dtype skeleton of an argument pytree — what ``lower()``
+    needs, without pinning the (possibly donated) device buffers."""
+    import jax
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def args_label(uid: int, version: int, abs_args, kind: str = "step") -> str:
+    """Stable short label for a compiled variant: program uid.version
+    plus a hash of the abstract argument signature (distinguishes e.g.
+    two batch sizes of the same program)."""
+    import jax
+    sig = ",".join(
+        f"{a.shape}{a.dtype}" for a in jax.tree.leaves(abs_args))
+    h = zlib.crc32(sig.encode()) & 0xFFFF
+    return f"p{uid}.v{version}.{h:04x}.{kind}"
+
+
+def analyze_jitted(jitted, abs_args: Tuple, label: str,
+                   prefer_analytic: bool = False) -> Optional[ProgramCost]:
+    """Cost/memory analysis of a ``jax.jit`` object against abstract
+    args: XLA's own analysis when the backend provides it, the jaxpr
+    walker otherwise.  ``prefer_analytic=True`` skips the XLA path (one
+    abstract trace instead of a full AOT compile — what the trainer's
+    per-step MFU gauge uses; matmul/conv FLOPs are exact either way).
+    Returns None when the plane is off or both paths fail.  Results are
+    published to the registry."""
+    if not enabled():
+        return None
+    cost = None if prefer_analytic else _xla_analyze(jitted, abs_args,
+                                                     label)
+    if cost is None:
+        cost = _jaxpr_analyze(jitted, abs_args, label)
+    if cost is not None:
+        _publish(cost)
+    return cost
+
+
+def _xla_analyze(jitted, abs_args, label) -> Optional[ProgramCost]:
+    try:
+        compiled = jitted.lower(*abs_args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = dict(ca or {})
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        if flops <= 0:
+            return None             # backend has no real cost model
+        ma = compiled.memory_analysis()
+        return ProgramCost(
+            label=label, flops=flops,
+            bytes_accessed=float(ca.get("bytes accessed", 0.0) or 0.0),
+            argument_bytes=int(getattr(ma, "argument_size_in_bytes", 0)),
+            output_bytes=int(getattr(ma, "output_size_in_bytes", 0)),
+            temp_bytes=int(getattr(ma, "temp_size_in_bytes", 0)),
+            alias_bytes=int(getattr(ma, "alias_size_in_bytes", 0)),
+            generated_code_bytes=int(
+                getattr(ma, "generated_code_size_in_bytes", 0)),
+            source="xla",
+            raw={k: v for k, v in ca.items()
+                 if isinstance(v, (int, float)) and "{" not in k})
+    except Exception:
+        return None
+
+
+def _jaxpr_analyze(fn, abs_args, label) -> Optional[ProgramCost]:
+    """Analytical fallback: trace to a jaxpr and walk it."""
+    import jax
+    try:
+        closed = jax.make_jaxpr(fn)(*abs_args)
+        flops, traffic = _walk_jaxpr(closed.jaxpr)
+        arg_bytes = sum(_aval_bytes(a) for a in closed.in_avals)
+        out_bytes = sum(_aval_bytes(a) for a in closed.out_avals)
+        return ProgramCost(
+            label=label, flops=float(flops),
+            bytes_accessed=float(traffic),
+            argument_bytes=int(arg_bytes), output_bytes=int(out_bytes),
+            temp_bytes=0, alias_bytes=0, source="analytic")
+    except Exception:
+        return None
+
+
+# --- the jaxpr walker ----------------------------------------------------
+
+def _aval_bytes(aval) -> int:
+    import numpy as np
+    shape = getattr(aval, "shape", ())
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:
+        item = 4                    # extended dtypes (PRNG keys)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * item
+
+
+def _aval_size(aval) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n
+
+
+def _dot_flops(eqn) -> float:
+    (lhs_c, _rhs_c), _batch = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    k = 1
+    for d in lhs_c:
+        k *= int(lhs.shape[d])
+    out = _aval_size(eqn.outvars[0].aval)
+    return 2.0 * k * out
+
+
+def _conv_flops(eqn) -> float:
+    dn = eqn.params["dimension_numbers"]
+    rhs = eqn.invars[1].aval
+    out = _aval_size(eqn.outvars[0].aval)
+    rhs_total = _aval_size(rhs)
+    out_feat = int(rhs.shape[dn.rhs_spec[0]])
+    # per output element: 2 * (in_c / groups) * prod(kernel_spatial)
+    return 2.0 * out * (rhs_total / max(1, out_feat))
+
+
+def _walk_jaxpr(jaxpr) -> Tuple[float, float]:
+    """(flops, bytes_moved) of one jaxpr, recursing into sub-jaxprs
+    (pjit/scan/cond/while/custom_* closures).  scan multiplies its body
+    by the trip count; while counts cond+body once (trip count is data-
+    dependent — a lower bound, stated as such by source='analytic')."""
+    flops = 0.0
+    traffic = 0.0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "cond":
+            # data-dependent branch: charge the most expensive one
+            # (walk each branch ONCE — re-walking the winner would go
+            # exponential on nested conds)
+            costs = [_walk_jaxpr(b) for b in
+                     (_as_jaxpr(x) for x in eqn.params.get(
+                         "branches", ()))
+                     if b is not None]
+            if costs:
+                f, b = max(costs, key=lambda c: c[0])
+                flops += f
+                traffic += b
+                continue
+        subs = _sub_jaxprs(eqn)
+        if subs:
+            for sub, mult in subs:
+                f, b = _walk_jaxpr(sub)
+                flops += f * mult
+                traffic += b * mult
+            continue
+        if name == "dot_general":
+            flops += _dot_flops(eqn)
+        elif name == "conv_general_dilated":
+            flops += _conv_flops(eqn)
+        else:
+            # elementwise estimate: one flop per output element
+            flops += max((_aval_size(v.aval) for v in eqn.outvars),
+                         default=0)
+        traffic += sum(_aval_bytes(v.aval) for v in eqn.invars
+                       if hasattr(v, "aval"))
+        traffic += sum(_aval_bytes(v.aval) for v in eqn.outvars)
+    return flops, traffic
+
+
+def _as_jaxpr(obj):
+    jaxpr = getattr(obj, "jaxpr", None)
+    return jaxpr if jaxpr is not None and hasattr(jaxpr, "eqns") else (
+        obj if hasattr(obj, "eqns") else None)
+
+
+def _sub_jaxprs(eqn):
+    """[(sub_jaxpr, multiplier), ...] for call-like primitives; [] for
+    leaf primitives."""
+    name = eqn.primitive.name
+    params = eqn.params
+    out = []
+    if name == "scan":
+        sub = _as_jaxpr(params.get("jaxpr"))
+        if sub is not None:
+            return [(sub, int(params.get("length", 1)))]
+    if name == "while":
+        for k in ("cond_jaxpr", "body_jaxpr"):
+            sub = _as_jaxpr(params.get(k))
+            if sub is not None:
+                out.append((sub, 1))
+        return out
+    for v in params.values():
+        sub = _as_jaxpr(v)
+        if sub is not None:
+            out.append((sub, 1))
+    return out

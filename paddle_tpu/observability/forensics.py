@@ -1,0 +1,275 @@
+"""Recompile forensics: WHY did the executor compile again?
+
+PR 1's recompile-storm warning (framework/executor.py) can only say
+*that* a (program, fetch-list) key keeps compiling; it guesses at the
+cause ("shapes/dtypes or mutation").  This module retains the last cache
+key per (program, fetch-list) and, on every miss, diffs the new key
+component-wise — program version vs feed shapes vs feed dtypes vs
+scope-state signature vs fetch names vs numerics flags — so the warning
+and the ``compile_log()`` report name the component that actually
+churned.  The reference's closest analogue is the Dapper-style habit of
+attaching a *cause* to every expensive event; XLA itself logs "hit the
+compilation cache miss" with no reason at all.
+
+Causes (the vocabulary of ``executor_recompile_cause_total``):
+
+* ``first_compile``   — no prior key for this (program, fetch-list)
+* ``fetch_names``     — same program compiled before, new fetch set
+* ``program_version`` — the Program mutated (ops appended/removed)
+* ``feed_set``        — feed names added/removed
+* ``feed_shapes``     — same feed names, a shape drifted
+* ``feed_dtypes``     — same feed names, a dtype drifted
+* ``state_signature`` — persistable scope state changed shape/dtype/set
+* ``flags``           — a numerics flag (amp_bf16 / pallas) toggled
+* ``identical``       — defensive fallback: the jit key changed in a
+  component this vocabulary does not model (should not happen)
+
+Retention is scoped per executor (``KeyParts.owner``): two Executors
+compiling the same program each get honest ``first_compile`` records
+instead of phantom drifts against each other's keys.
+
+Also here: the compile-cache explorer (:func:`cache_report`) listing
+every cached executable with its cost/memory summary (costmodel.py).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import metrics as obs_metrics
+
+_m_cause = obs_metrics.counter(
+    "executor_recompile_cause_total",
+    "Executor compilations by diagnosed cache-key drift cause "
+    "(observability/forensics.py vocabulary).", ("cause",))
+
+_MAX_LOG = 256          # bounded compile log (newest kept)
+_MAX_KEYS = 4096        # bounded key retention (oldest-inserted evicted)
+
+# Monotonic executor ids: id(self) would be reused after GC and make a
+# fresh executor inherit a dead one's retained keys (phantom drifts).
+_owner_counter = itertools.count(1)
+
+
+def new_owner() -> int:
+    """A process-unique owner id for one executor's jit cache."""
+    return next(_owner_counter)
+
+
+@dataclass
+class KeyParts:
+    """The cache-key components the executor hands us on every miss.
+    ``owner`` scopes retention to ONE executor's jit cache: a second
+    Executor compiling the same program is a first compile in ITS
+    cache, not a drift against another executor's key."""
+
+    program_uid: int
+    program_version: int
+    feeds: Tuple[Tuple[str, Tuple[int, ...], str], ...]   # (name, shape, dtype)
+    fetch_names: Tuple[str, ...]
+    state: Tuple[Tuple[str, Tuple[int, ...], str], ...]
+    flags: Tuple[Tuple[str, Any], ...]
+    owner: int = 0
+
+
+@dataclass
+class CompileRecord:
+    """One diagnosed compilation."""
+
+    ts: float
+    program_uid: int
+    program_version: int
+    fetch_names: Tuple[str, ...]
+    causes: List[str]
+    details: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"ts": self.ts, "program": self.program_uid,
+                "version": self.program_version,
+                "fetches": list(self.fetch_names),
+                "causes": list(self.causes),
+                "details": list(self.details)}
+
+
+_lock = threading.Lock()
+_last_key: Dict[Tuple[int, Tuple[str, ...]], KeyParts] = {}
+_cause_counts: Dict[Tuple[int, Tuple[str, ...]], Dict[str, int]] = {}
+_log: List[CompileRecord] = []
+
+
+def reset():
+    with _lock:
+        _last_key.clear()
+        _cause_counts.clear()
+        _log.clear()
+
+
+def _sig_diff(old, new, shape_cause: str, dtype_cause: str,
+              set_cause: str) -> List[Tuple[str, str]]:
+    """Diff two (name, shape, dtype) signature tuples into
+    (cause, detail) pairs."""
+    out: List[Tuple[str, str]] = []
+    o = {n: (s, d) for n, s, d in old}
+    n_ = {n: (s, d) for n, s, d in new}
+    added = sorted(set(n_) - set(o))
+    removed = sorted(set(o) - set(n_))
+    if added or removed:
+        out.append((set_cause,
+                    f"+{added} -{removed}" if added and removed
+                    else (f"+{added}" if added else f"-{removed}")))
+    for name in sorted(set(o) & set(n_)):
+        (os_, od), (ns, nd) = o[name], n_[name]
+        if os_ != ns:
+            out.append((shape_cause, f"{name}: {os_}->{ns}"))
+        if od != nd:
+            out.append((dtype_cause, f"{name}: {od}->{nd}"))
+    return out
+
+
+def diff_keys(old: KeyParts, new: KeyParts) -> List[Tuple[str, str]]:
+    """Component-wise diff of two cache keys -> ordered
+    (cause, detail) pairs; empty when the keys are identical."""
+    out: List[Tuple[str, str]] = []
+    if old.program_version != new.program_version:
+        out.append(("program_version",
+                    f"v{old.program_version}->v{new.program_version}"))
+    out += _sig_diff(old.feeds, new.feeds,
+                     "feed_shapes", "feed_dtypes", "feed_set")
+    if old.fetch_names != new.fetch_names:
+        out.append(("fetch_names",
+                    f"{list(old.fetch_names)}->{list(new.fetch_names)}"))
+    out += _sig_diff(old.state, new.state, "state_signature",
+                     "state_signature", "state_signature")
+    if old.flags != new.flags:
+        drifted = [f"{k}: {dict(old.flags).get(k)}->{v}"
+                   for k, v in new.flags
+                   if dict(old.flags).get(k) != v]
+        out.append(("flags", "; ".join(drifted)))
+    return out
+
+
+def note_compile(parts: KeyParts) -> CompileRecord:
+    """Called by the executor on every compiled-program cache miss.
+    Diagnoses the drift cause vs the retained key, updates the per-key
+    cause histogram, the cause counter, the bounded compile log and the
+    flight recorder; returns the record."""
+    fkey = (parts.owner, parts.program_uid, parts.fetch_names)
+    with _lock:
+        prev = _last_key.pop(fkey, None)
+        _last_key[fkey] = parts         # re-insert: LRU-ish ordering
+        while len(_last_key) > _MAX_KEYS:
+            _last_key.pop(next(iter(_last_key)))
+        siblings = any(
+            k[0] == parts.owner and k[1] == parts.program_uid
+            and k != fkey for k in _last_key)
+    if prev is not None:
+        pairs = diff_keys(prev, parts)
+        # identical: defensive fallback — the jit key changed in a
+        # component the forensics vocabulary does not model (should not
+        # happen; keeps the record honest if key/KeyParts ever diverge)
+        causes = list(dict.fromkeys(c for c, _ in pairs)) or ["identical"]
+        details = [f"{c}: {d}" for c, d in pairs]
+    elif siblings:
+        # same executor compiled this program before, under a different
+        # fetch set
+        causes, details = ["fetch_names"], [
+            f"new fetch set {list(parts.fetch_names)}"]
+    else:
+        causes, details = ["first_compile"], []
+    rec = CompileRecord(ts=time.time(), program_uid=parts.program_uid,
+                        program_version=parts.program_version,
+                        fetch_names=parts.fetch_names, causes=causes,
+                        details=details)
+    with _lock:
+        hist = _cause_counts.setdefault(fkey, {})
+        for c in causes:
+            hist[c] = hist.get(c, 0) + 1
+        while len(_cause_counts) > _MAX_KEYS:
+            _cause_counts.pop(next(iter(_cause_counts)))
+        _log.append(rec)
+        del _log[:-_MAX_LOG]
+    _m_cause.labels(cause=causes[0]).inc()
+    from . import flight
+    flight.record("compile", f"p{parts.program_uid}",
+                  version=parts.program_version, causes=causes,
+                  detail="; ".join(details)[:200])
+    return rec
+
+
+def cause_histogram(program_uid: int, fetch_names: Tuple[str, ...],
+                    owner: Optional[int] = None) -> Dict[str, int]:
+    """Cause -> count for one (program, fetch-list) key — what the
+    recompile-storm warning names.  ``owner`` restricts to one
+    executor's cache (what the executor itself passes); None aggregates
+    across executors."""
+    out: Dict[str, int] = {}
+    with _lock:
+        for (own, uid, fetches), hist in _cause_counts.items():
+            if uid != program_uid or fetches != fetch_names:
+                continue
+            if owner is not None and own != owner:
+                continue
+            for c, n in hist.items():
+                out[c] = out.get(c, 0) + n
+    return out
+
+
+def dominant_cause(program_uid: int, fetch_names: Tuple[str, ...],
+                   owner: Optional[int] = None) -> str:
+    """The most frequent non-first-compile cause for one
+    (program, fetch-list) key — what the storm counter's label carries."""
+    hist = cause_histogram(program_uid, fetch_names, owner)
+    drifting = {c: n for c, n in hist.items() if c != "first_compile"}
+    if not drifting:
+        return "first_compile"
+    return max(sorted(drifting), key=lambda c: drifting[c])
+
+
+def describe_causes(program_uid: int, fetch_names: Tuple[str, ...],
+                    owner: Optional[int] = None) -> str:
+    hist = cause_histogram(program_uid, fetch_names, owner)
+    drifting = {c: n for c, n in hist.items() if c != "first_compile"}
+    if not drifting:
+        return "first compiles only"
+    return ", ".join(f"{c} x{n}" for c, n in
+                     sorted(drifting.items(), key=lambda kv: -kv[1]))
+
+
+def compile_log(program_uid: Optional[int] = None) -> List[dict]:
+    """The bounded log of diagnosed compilations, newest last."""
+    with _lock:
+        recs = list(_log)
+    if program_uid is not None:
+        recs = [r for r in recs if r.program_uid == program_uid]
+    return [r.to_dict() for r in recs]
+
+
+def cache_report(executor, compute_costs: bool = True) -> dict:
+    """Compile-cache explorer: every executable cached by `executor`
+    (step programs AND run_steps device loops) with its cost/memory
+    summary.  ``compute_costs=True`` triggers the lazy cost analysis
+    for entries whose abstract args are known."""
+    programs = []
+    for cp in executor._cache.values():
+        cost = cp.cost() if compute_costs else cp._cost
+        multi = []
+        for mkey in cp._multi_cache:
+            steps, seq_names = mkey
+            mcost = (cp.multi_cost(mkey) if compute_costs
+                     else cp._multi_cost.get(mkey))
+            multi.append({"steps": steps, "seq_feeds": list(seq_names),
+                          "cost": mcost.to_dict() if mcost else None})
+        programs.append({
+            "program": cp.program._uid,
+            "version": cp.program._version,
+            "feeds": list(cp.feed_names),
+            "fetches": list(cp.fetch_names),
+            "state_vars": len(cp.in_state_names),
+            "cost": cost.to_dict() if cost else None,
+            "multi": multi,
+        })
+    return {"schema": "paddle_tpu.cache_report.v1",
+            "cached_programs": len(programs), "programs": programs}

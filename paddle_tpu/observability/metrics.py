@@ -295,7 +295,7 @@ class MetricsRegistry:
         """Prometheus text format v0.0.4 exposition."""
         lines: List[str] = []
         for m in self.metrics():
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.type}")
             for key, s in sorted(m.series().items()):
                 base = dict(zip(m.labelnames, key))
@@ -355,7 +355,15 @@ def _sample(name: str, labels: Dict[str, str], value) -> str:
 
 
 def _escape(s: str) -> str:
+    """Label-value escaping per the Prometheus text exposition format:
+    backslash, double-quote and line-feed."""
     return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(s: str) -> str:
+    """HELP-line escaping: backslash and line-feed only (a raw newline
+    would terminate the comment mid-text and corrupt the scrape)."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 REGISTRY = MetricsRegistry()

@@ -139,3 +139,15 @@ class ParallelExecutor:
             if shape:
                 _m_global_examples_per_sec.set(shape[0] / dt)
         return out
+
+    def explain(self, fetch_list: Sequence, feed=None) -> dict:
+        """Cost/memory report for the pjit program this fetch set
+        resolves to (Executor.explain over the shared mesh executor):
+        per-program FLOPs / bytes accessed / peak HBM plus the cache
+        view — the sharded-program face of observability/costmodel.py."""
+        return self._exe.explain(self.program, feed=feed or {},
+                                 fetch_list=list(fetch_list))
+
+    def cache_report(self, compute_costs: bool = True) -> dict:
+        """Compile-cache explorer for this mesh executor's programs."""
+        return self._exe.cache_report(compute_costs)

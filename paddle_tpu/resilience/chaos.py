@@ -42,6 +42,7 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import flags
+from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
 
 _m_injected = obs_metrics.counter(
@@ -154,6 +155,7 @@ def _decide(fault: Fault) -> Optional[int]:
     with _lock:
         _fired.append((fault.site, n, fault.kind))
     _m_injected.labels(site=fault.site, kind=fault.kind).inc()
+    obs_flight.record("chaos", fault.site, fault_kind=fault.kind, n=n)
     return n
 
 

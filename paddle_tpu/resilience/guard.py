@@ -23,6 +23,7 @@ import math
 from typing import Optional
 
 from ..core import flags
+from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
 
 _m_bad_steps = obs_metrics.counter(
@@ -91,7 +92,14 @@ class NumericGuard:
             return verdict
         self.consecutive_bad += 1
         _m_bad_steps.labels(reason=verdict).inc()
+        obs_flight.record("guard", verdict, loss=loss,
+                          consecutive_bad=self.consecutive_bad,
+                          policy=self.policy)
         if 0 < self.bad_step_limit <= self.consecutive_bad:
+            obs_flight.dump("circuit_breaker",
+                            extra={"verdict": verdict, "loss": loss,
+                                   "consecutive_bad": self.consecutive_bad,
+                                   "bad_step_limit": self.bad_step_limit})
             raise CircuitBreakerOpen(
                 f"{self.consecutive_bad} consecutive bad steps (last: "
                 f"{verdict}, loss={loss!r}) >= bad_step_limit "

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
 
 from ..core import flags
+from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
 
 _m_attempts = obs_metrics.counter(
@@ -75,6 +76,8 @@ def call_with_retry(fn: Callable, policy: RetryPolicy, *args,
             if attempt >= policy.attempts():
                 break
             _m_attempts.labels(name=policy.name).inc()
+            obs_flight.record("retry", policy.name, attempt=attempt,
+                              error=repr(e)[:200])
             time.sleep(policy.delay(attempt))
             if on_retry is not None:
                 try:
@@ -83,6 +86,10 @@ def call_with_retry(fn: Callable, policy: RetryPolicy, *args,
                     pass    # a failed reconnect: let the next attempt try
     _m_exhausted.labels(name=policy.name).inc()
     assert last is not None
+    obs_flight.dump("retry_exhausted",
+                    extra={"policy": policy.name,
+                           "attempts": policy.attempts(),
+                           "error": repr(last)[:500]})
     raise last
 
 

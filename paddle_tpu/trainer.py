@@ -26,6 +26,8 @@ from . import observability
 from .core.enforce import check_arg
 from .framework.executor import Executor, Scope
 from .framework.program import Program, program_guard
+from .observability import costmodel as obs_cost
+from .observability import flight as obs_flight
 from .observability import metrics as obs_metrics
 from .observability import trace as obs_trace
 from .resilience import chaos, guard as rguard, retry as rretry
@@ -60,6 +62,22 @@ _m_preemptions = obs_metrics.counter(
     "trainer_preemptions_total",
     "SIGTERM/SIGINT preemptions honored at a step boundary (emergency "
     "checkpoint + clean exit).")
+# model-agnostic cost-model gauges (observability/costmodel.py): FLOPs
+# come from XLA's accounting of the compiled train step, not from any
+# per-architecture formula
+_m_flops_per_step = obs_metrics.gauge(
+    "trainer_flops_per_step",
+    "Cost-model FLOPs of one compiled train step (XLA cost_analysis, "
+    "or the jaxpr analytic fallback).")
+_m_tflops = obs_metrics.gauge(
+    "trainer_tflops",
+    "Achieved TFLOP/s of the last train step "
+    "(trainer_flops_per_step / step wall time).")
+_m_mfu = obs_metrics.gauge(
+    "trainer_mfu",
+    "Model FLOPs utilization of the last train step vs the device peak "
+    "(device_peak_flops flag, or the per-platform table; unset peak = "
+    "gauge not exported).")
 _EMA_DECAY = 0.9
 # device-memory sampling cadence: the live_arrays()/memory_stats() walk
 # is O(resident arrays), too heavy for every step of a big model
@@ -259,6 +277,7 @@ class Trainer:
         obs_trace.add_instant("trainer.rollback", time.perf_counter(),
                               tid=obs_trace.TRAINER_TID,
                               args={"serial": serial})
+        obs_flight.record("trainer", "rollback", serial=serial)
         return True
 
     # -- loops -------------------------------------------------------------
@@ -305,6 +324,7 @@ class Trainer:
                     _m_step_seconds.observe(dt)
                     if dt > 0:
                         _m_examples_per_sec.set(len(batch) / dt)
+                        self._record_mfu(dt)
                     if metrics:
                         loss_val = float(np.mean(np.asarray(metrics[0])))
                         if not self._guard_step(health, loss_val):
@@ -342,10 +362,37 @@ class Trainer:
                     self._emergency_stop(epoch_id + 1, -1, stop,
                                          already_saved=saved)
                     return
+        except (rguard.BadStepError, rguard.CircuitBreakerOpen):
+            raise               # flight bundle already dumped at the trip
+        except Exception as e:
+            # post-mortem artifact for ANY uncaught training failure:
+            # recent events + metrics + cost summaries, one JSON bundle
+            obs_flight.dump("trainer_exception",
+                            extra={"error": repr(e)[:500]})
+            raise
         finally:
             self._restore_preemption_handlers(stop)
 
     # -- resilience plumbing (resilience/, docs/RESILIENCE.md) -------------
+    def _record_mfu(self, dt: float):
+        """Export the cost-model MFU/TFLOPs gauges for one step.  FLOPs
+        come from the cost of the program the step ACTUALLY ran (the
+        executor's last compiled program — correct across mid-train
+        recompiles, e.g. a final partial batch), computed lazily once
+        per compiled program (cost_model flag; prefer_analytic = one
+        cheap abstract trace, not a second XLA compile; dot/conv FLOPs
+        are exact either way).  Model-agnostic — no per-architecture
+        formula."""
+        cost = self.exe.last_run_cost(prefer_analytic=True)
+        flops = float(cost.flops) if cost else 0.0
+        if flops <= 0:
+            return
+        _m_flops_per_step.set(flops)
+        fps = flops / dt
+        _m_tflops.set(fps / 1e12)
+        peak = obs_cost.device_peak_flops()
+        if peak > 0:
+            _m_mfu.set(fps / peak)
     def _guard_step(self, health: "rguard.NumericGuard",
                     loss_val: float) -> bool:
         """Apply the numeric-guard policy to one fetched loss.  True =
@@ -355,11 +402,17 @@ class Trainer:
         if verdict == rguard.OK:
             return True
         if health.policy == "raise":
+            obs_flight.dump("numeric_guard",
+                            extra={"verdict": verdict, "loss": loss_val})
             raise rguard.BadStepError(
                 f"numeric guard: {verdict} loss {loss_val!r} "
                 f"(nan_policy=raise)")
         if health.policy == "rollback":
             if not self._rollback():
+                obs_flight.dump("numeric_guard",
+                                extra={"verdict": verdict,
+                                       "loss": loss_val,
+                                       "rollback": "no valid checkpoint"})
                 raise rguard.BadStepError(
                     f"numeric guard: {verdict} loss {loss_val!r} and no "
                     f"valid checkpoint to roll back to")
@@ -403,6 +456,12 @@ class Trainer:
             tid=obs_trace.TRAINER_TID,
             args={"signum": stop["signum"], "epoch": epoch_id,
                   "step": step_id})
+        obs_flight.record("trainer", "preempted",
+                          signum=stop["signum"], epoch=epoch_id,
+                          step=step_id)
+        obs_flight.dump("preemption",
+                        extra={"signum": stop["signum"],
+                               "epoch": epoch_id, "step": step_id})
 
     def test(self, reader: Callable, feed_order: Sequence[str]):
         from .data_feeder import DataFeeder

@@ -40,11 +40,15 @@ def fresh_programs():
     armed chaos spec leaking across tests."""
     import paddle_tpu as pt
     from paddle_tpu.framework import executor as executor_mod
+    from paddle_tpu.observability import costmodel, flight, forensics
     from paddle_tpu.resilience import chaos
     pt.reset_default_programs()
     executor_mod._global_scope = executor_mod.Scope()
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
+    costmodel.reset()
+    forensics.reset()
+    flight.reset()
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()

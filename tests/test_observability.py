@@ -371,3 +371,535 @@ def test_draw_block_graphviz_escapes_special_names(tmp_path):
             unescaped += 1
         prev = ch if not (prev == "\\" and ch == "\\") else ""
     assert unescaped % 2 == 0
+
+
+# =========================================================================
+# ISSUE 3: compiled-program introspection plane — cost model, recompile
+# forensics, flight recorder, bench gate, exposition escaping.
+# =========================================================================
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+from paddle_tpu.observability import bench_gate, costmodel, flight, forensics
+from paddle_tpu.resilience import guard as rguard
+from paddle_tpu.resilience import retry as rretry
+
+
+# --- prometheus exposition escaping (satellite) ---------------------------
+
+def test_prometheus_escaping_help_and_label_values():
+    """HELP must escape backslash/newline; label values must escape
+    backslash/newline/double-quote — raw, they corrupt the scrape."""
+    c = obs_metrics.counter(
+        "t_esc_total", 'help with "quotes", a \\ and a\nnewline',
+        ("path",))
+    c.labels(path='C:\\tmp\n"quoted"').inc()
+    text = obs_metrics.REGISTRY.prometheus_text()
+    assert ('# HELP t_esc_total help with "quotes", a \\\\ '
+            'and a\\nnewline') in text
+    assert 'path="C:\\\\tmp\\n\\"quoted\\""' in text
+    # the escaped forms must be the ONLY occurrences: no raw newline may
+    # survive inside a HELP line or a label value
+    for line in text.splitlines():
+        if "t_esc_total" in line:
+            assert "\n" not in line
+
+
+# --- cost model (tentpole part 1) -----------------------------------------
+
+def _run_small(exe=None):
+    main, loss = _small_program()
+    exe = exe or pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "int64")}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    return exe, main, loss, feed
+
+
+def test_explain_reports_cost_on_cpu_backend():
+    """Acceptance: Executor.explain() returns per-program FLOPs / bytes
+    accessed / peak HBM on the CPU backend, plus the schema the docs
+    promise."""
+    exe, main, loss, feed = _run_small()
+    rep = exe.explain(main, feed=feed, fetch_list=[loss])
+    assert rep["schema"] == "paddle_tpu.explain.v1"
+    assert set(rep) >= {"program", "feeds", "fetches", "state", "cost",
+                        "cache", "flags"}
+    cost = rep["cost"]
+    assert cost is not None and cost["source"] in ("xla", "analytic")
+    assert cost["flops"] > 0
+    assert cost["bytes_accessed"] > 0
+    assert cost["peak_hbm_bytes"] > 0
+    assert cost["argument_bytes"] > 0
+    assert rep["program"]["op_histogram"].get("mul", 0) >= 2
+    assert rep["feeds"]["x"] == {"shape": [4, 4], "dtype": "float32"}
+    assert rep["fetches"] == [loss.name]
+    assert rep["cache"]["compiles_for_key"] >= 1
+    json.dumps(rep)          # the whole report must be JSON-clean
+    # the registry carries the same numbers as gauges
+    g = obs_metrics.REGISTRY.get("program_cost_flops")
+    assert any(s.value == cost["flops"] for s in g.series().values())
+    assert obs_metrics.REGISTRY.get(
+        "program_cost_peak_hbm_bytes").total() > 0
+
+
+def test_explain_does_not_consume_rng_or_recompile():
+    """explain() must be a pure observer: same executable cache, same
+    RNG sequence for subsequent runs."""
+    exe, main, loss, feed = _run_small()
+    before = exe._run_counter
+    c0 = obs_metrics.REGISTRY.get("executor_compile_total").labels(
+        kind="step").value
+    exe.explain(main, feed=feed, fetch_list=[loss])
+    assert exe._run_counter == before
+    c1 = obs_metrics.REGISTRY.get("executor_compile_total").labels(
+        kind="step").value
+    assert c1 == c0, "explain on a cached key must not compile a new step"
+
+
+def test_cost_model_covers_run_steps_device_loop():
+    """A run_steps _multi_cache entry gets its own cost row in the
+    cache explorer."""
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((3, 4, 4), "float32"),
+            "y": np.zeros((3, 4, 1), "int64")}
+    exe.run_steps(main, feed=feed, fetch_list=[loss], steps=3,
+                  per_step_feeds=["x", "y"])
+    rep = exe.cache_report()
+    assert rep["schema"] == "paddle_tpu.cache_report.v1"
+    multi = [m for p in rep["programs"] for m in p["multi"]]
+    assert multi, "run_steps must appear in the cache explorer"
+    assert multi[0]["steps"] == 3
+    assert multi[0]["cost"] is not None
+    assert multi[0]["cost"]["flops"] > 0
+    json.dumps(rep)
+    # and the registry gained a multi-labelled program cost series
+    g = obs_metrics.REGISTRY.get("program_cost_flops")
+    assert any("multi3" in key[0] for key in g.series())
+
+
+def test_cost_model_flag_gates_analysis():
+    exe, main, loss, feed = _run_small()
+    flags.set_flag("cost_model", False)
+    try:
+        rep = exe.explain(main, feed=feed, fetch_list=[loss])
+        assert rep["cost"] is None
+    finally:
+        flags.set_flag("cost_model", True)
+
+
+def test_cost_model_matches_analytic_transformer_within_10pct():
+    """Acceptance (the bench.py cross-check): XLA's FLOPs for the
+    transformer-LM train step agree with the old hand-rolled analytic
+    formula within 10% — the contract that let bench.py drop the
+    formula."""
+    from paddle_tpu import models
+    D, F, L, V, T, B = 128, 512, 2, 2000, 64, 2
+    pt.reset_default_programs()
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=V, tgt_vocab_size=V, max_length=T,
+        n_layer=L, n_head=8, d_model=D, d_inner=F, dropout=0.0)
+    _, avg_cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=T, fused_attention=False, fused_head=False)
+    pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = models.transformer.make_fake_lm_batch(cfg, B, T)
+    rep = exe.explain(pt.default_main_program(), feed=feed,
+                      fetch_list=[avg_cost])
+    assert rep["cost"] is not None and rep["cost"]["source"] == "xla"
+    flops = rep["cost"]["flops"]
+    analytic = 3 * (L * (8 * D * D + 4 * D * F) + L * 2 * T * D
+                    + 2 * D * V) * B * T
+    assert 0.9 < flops / analytic < 1.1, (flops, analytic)
+
+
+def test_trainer_exports_cost_model_mfu_gauge():
+    """Acceptance: the trainer's MFU/TFLOPs gauges are cost-model
+    derived (model-agnostic) and agree with the analytic transformer
+    number within 10%."""
+    from paddle_tpu import models
+    D, F, L, V, T = 128, 512, 2, 2000, 64
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=V, tgt_vocab_size=V, max_length=T,
+        n_layer=L, n_head=8, d_model=D, d_inner=F, dropout=0.0)
+
+    def train_func():
+        _, avg_cost, _ = models.transformer.build_lm_net(
+            cfg, seq_len=T, fused_attention=False, fused_head=False)
+        return avg_cost
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(2):
+            batch = []
+            for _ in range(2):
+                toks = rng.randint(1, V, (T,)).astype("int64")
+                batch.append((toks, np.roll(toks, -1)))
+            yield batch
+
+    flags.set_flag("device_peak_flops", 1e12)
+    try:
+        trainer = pt.Trainer(train_func,
+                             lambda: pt.optimizer.SGD(0.1),
+                             place=pt.CPUPlace())
+        trainer.train(num_epochs=1, event_handler=lambda e: None,
+                      reader=reader, feed_order=["tokens", "labels"])
+        trainer.stop()
+    finally:
+        flags.set_flag("device_peak_flops", 0.0)
+    reg = obs_metrics.REGISTRY
+    flops = reg.get("trainer_flops_per_step").value
+    analytic = 3 * (L * (8 * D * D + 4 * D * F) + L * 2 * T * D
+                    + 2 * D * V) * 2 * T
+    assert flops > 0
+    assert 0.9 < flops / analytic < 1.1, (flops, analytic)
+    assert reg.get("trainer_tflops").value > 0
+    # mfu = (flops/dt) / peak with the peak pinned by the flag
+    assert reg.get("trainer_mfu").value > 0
+
+
+# --- recompile forensics (tentpole part 2) --------------------------------
+
+def test_recompile_cause_feed_shape_drift():
+    exe, main, loss, feed = _run_small()
+    exe.run(main, feed={"x": np.ones((2, 4), "float32"),
+                        "y": np.zeros((2, 1), "int64")},
+            fetch_list=[loss])
+    rec = exe.compile_log(main)[-1]
+    assert rec["causes"] == ["feed_shapes"]
+    assert any("x: (4, 4)->(2, 4)" in d for d in rec["details"])
+
+
+def test_recompile_cause_fetch_program_and_flags_drift():
+    pt.reset_default_programs()
+    x = layers.data("x", [4], dtype="float32")
+    h = layers.fc(x, size=8, act="relu")
+    out = layers.mean(h)
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((4, 4), "float32")}
+    exe.run(main, feed=feed, fetch_list=[out])
+    # 1. new fetch set on a known program -> fetch_names
+    exe.run(main, feed=feed, fetch_list=[out, h])
+    assert exe.compile_log(main)[-1]["causes"] == ["fetch_names"]
+    # 2. program mutation -> program_version
+    block = main.global_block()
+    block.create_var(name="t_extra", shape=[1], dtype="float32")
+    block.append_op(type="scale", inputs={"X": [out.name]},
+                    outputs={"Out": ["t_extra"]}, attrs={"scale": 2.0})
+    exe.run(main, feed=feed, fetch_list=[out])
+    assert "program_version" in exe.compile_log(main)[-1]["causes"]
+    # 3. numerics flag toggle -> flags
+    flags.set_flag("amp_bf16", True)
+    try:
+        exe.run(main, feed=feed, fetch_list=[out])
+    finally:
+        flags.set_flag("amp_bf16", False)
+    rec = exe.compile_log(main)[-1]
+    assert "flags" in rec["causes"]
+    assert any("amp_bf16" in d for d in rec["details"])
+
+
+def test_forensics_scopes_retention_per_executor():
+    """A second Executor compiling the same (program, fetch-list) with
+    identical feeds is a first compile in ITS cache — not a phantom
+    drift against the first executor's retained key."""
+    main, loss = _small_program()
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "int64")}
+    exe1 = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe1.run(pt.default_startup_program())
+    exe1.run(main, feed=feed, fetch_list=[loss])
+    exe2 = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe2.run(pt.default_startup_program())
+    exe2.run(main, feed=feed, fetch_list=[loss])
+    recs = [r for r in exe1.compile_log(main)
+            if r["fetches"] == [loss.name]]
+    assert [r["causes"] for r in recs[-2:]] == \
+        [["first_compile"], ["first_compile"]]
+
+
+def test_forensics_diff_keys_unit():
+    """Component-wise diff vocabulary: drift each component of a
+    synthetic cache key and assert the named cause."""
+    base = forensics.KeyParts(
+        program_uid=7, program_version=3,
+        feeds=(("x", (4, 4), "float32"),),
+        fetch_names=("loss",),
+        state=(("w", (4, 8), "float32"),),
+        flags=(("amp_bf16", False),))
+
+    def causes(**kw):
+        return [c for c, _ in forensics.diff_keys(
+            base, dataclasses.replace(base, **kw))]
+
+    assert causes() == []
+    assert causes(feeds=(("x", (8, 4), "float32"),)) == ["feed_shapes"]
+    assert causes(feeds=(("x", (4, 4), "float64"),)) == ["feed_dtypes"]
+    assert causes(feeds=(("x", (4, 4), "float32"),
+                         ("z", (1,), "int64"))) == ["feed_set"]
+    assert causes(state=(("w", (4, 16), "float32"),)) == \
+        ["state_signature"]
+    assert causes(state=(("w", (4, 8), "bfloat16"),)) == \
+        ["state_signature"]
+    assert causes(program_version=4) == ["program_version"]
+    assert causes(fetch_names=("loss", "acc")) == ["fetch_names"]
+    assert causes(flags=(("amp_bf16", True),)) == ["flags"]
+    # compound drift names every component, shapes first
+    got = causes(feeds=(("x", (8, 4), "float64"),), program_version=9)
+    assert set(got) == {"feed_shapes", "feed_dtypes", "program_version"}
+
+
+def test_recompile_storm_warning_names_cause():
+    """The storm warning (satellite): names the drifting component and
+    the cause-labelled counter increments."""
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    old = flags.get_flag("recompile_warn_threshold")
+    flags.set_flag("recompile_warn_threshold", 2)
+    storm = obs_metrics.REGISTRY.get("executor_recompile_storm_total")
+    s0 = storm.total()
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for b in range(1, 5):
+                exe.run(main,
+                        feed={"x": np.ones((b, 4), "float32"),
+                              "y": np.zeros((b, 1), "int64")},
+                        fetch_list=[loss])
+    finally:
+        flags.set_flag("recompile_warn_threshold", old)
+    storms = [str(x.message) for x in w
+              if "recompile storm" in str(x.message)]
+    assert len(storms) == 1
+    assert "feed_shapes" in storms[0], storms[0]
+    assert "x:" in storms[0]          # the latest drift detail is named
+    assert storm.total() - s0 == 1
+    assert ("feed_shapes",) in storm.series()
+    assert obs_metrics.REGISTRY.get(
+        "executor_recompile_cause_total").labels(
+            cause="feed_shapes").value >= 3
+
+
+# --- flight recorder (tentpole part 3) ------------------------------------
+
+def _flight_trainer():
+    def train_func():
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    return pt.Trainer(train_func, lambda: pt.optimizer.SGD(0.05),
+                      place=pt.CPUPlace())
+
+
+def _flight_batches(n, bs=4):
+    rng = np.random.RandomState(0)
+    return [[(rng.randn(4).astype("float32"),
+              rng.randn(1).astype("float32")) for _ in range(bs)]
+            for _ in range(n)]
+
+
+def test_flight_recorder_bundle_on_numeric_guard_trip(tmp_path):
+    """Acceptance: a forced NumericGuard trip emits one JSON diagnostic
+    bundle — bounded, valid, carrying the event ring + metrics +
+    cost summaries + flag state."""
+    path = str(tmp_path / "flight.json")
+    flags.set_flag("flight_recorder_path", path)
+    flags.set_flag("chaos_seed", 0)
+    flags.set_flag("chaos_spec", "trainer.step=nan:1.0")
+    try:
+        t = _flight_trainer()
+        with pytest.raises(rguard.BadStepError):
+            t.train(num_epochs=1, event_handler=lambda e: None,
+                    reader=lambda: iter(_flight_batches(4)),
+                    feed_order=["x", "y"])
+    finally:
+        flags.set_flag("flight_recorder_path", "")
+        flags.set_flag("chaos_spec", "")
+    with open(path) as f:
+        doc = json.load(f)                 # must be valid JSON
+    # STRICT json: the trigger is a NaN loss, and a bare NaN token
+    # would corrupt the bundle for every non-Python consumer
+    json.dumps(doc, allow_nan=False)
+    assert doc["schema"] == "paddle_tpu.flight.v1"
+    assert doc["reason"] == "numeric_guard"
+    assert doc["extra"]["verdict"] == "nan"
+    assert doc["extra"]["loss"] == "nan"   # stringified, not NaN
+    kinds = {e["kind"] for e in doc["events"]}
+    assert {"guard", "chaos", "span", "compile"} <= kinds
+    cap = int(flags.get_flag("flight_recorder_events"))
+    assert len(doc["events"]) <= cap
+    assert len(json.dumps(doc)) < (1 << 20)     # bounded bundle
+    assert doc["counter_deltas"].get("trainer_bad_steps_total", 0) >= 1
+    assert "flags" in doc and "program_costs" in doc \
+        and "compile_log" in doc and "metrics" in doc
+    assert flight.last_bundle()["reason"] == "numeric_guard"
+    assert flight.dump_count() >= 1
+
+
+def test_flight_recorder_bundle_on_retry_exhaustion(tmp_path):
+    path = str(tmp_path / "flight_retry.json")
+    flags.set_flag("flight_recorder_path", path)
+    pol = rretry.RetryPolicy(name="t_flight", max_attempts=2,
+                             base_delay=0.001, jitter=0.0,
+                             retry_on=(OSError,))
+    try:
+        with pytest.raises(OSError):
+            rretry.call_with_retry(
+                lambda: (_ for _ in ()).throw(OSError("disk gone")), pol)
+    finally:
+        flags.set_flag("flight_recorder_path", "")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "retry_exhausted"
+    assert doc["extra"]["policy"] == "t_flight"
+    assert doc["extra"]["attempts"] == 2
+    assert any(e["kind"] == "retry" and e["name"] == "t_flight"
+               for e in doc["events"])
+
+
+def test_flight_recorder_ring_is_bounded_and_gateable():
+    flight.reset()
+    old = flags.get_flag("flight_recorder_events")
+    flags.set_flag("flight_recorder_events", 8)
+    try:
+        for i in range(50):
+            flight.record("span", f"e{i}", i=i)
+        evs = flight.events()
+        assert len(evs) == 8
+        assert evs[-1]["name"] == "e49"     # newest kept, oldest dropped
+        flags.set_flag("flight_recorder_events", 0)
+        flight.record("span", "ghost")
+        assert len(flight.events()) == 8    # capacity 0: recording off
+    finally:
+        flags.set_flag("flight_recorder_events", old)
+    # in-memory dump works without a configured path (no file side
+    # effect) and never raises
+    assert flight.dump("unit_test") is None
+    assert flight.last_bundle()["reason"] == "unit_test"
+
+
+# --- bench gate (satellite) -----------------------------------------------
+
+def _gate_inputs():
+    base = {"parsed": {"summary": {
+        "a_tokens_per_sec": {"value": 100.0, "vs_baseline": 2.0},
+        "b_ms_per_batch": {"value": 10.0},
+        "c_gone_metric": {"value": 5.0}}}}
+    cand = {"schema": "paddle_tpu.metrics.v1", "metrics": {
+        "bench_value": {"type": "gauge", "help": "", "series": [
+            {"labels": {"metric": "a_tokens_per_sec",
+                        "unit": "tokens/s"}, "value": 90.0},
+            {"labels": {"metric": "b_ms_per_batch",
+                        "unit": "ms/batch"}, "value": 10.5},
+            {"labels": {"metric": "d_new_metric", "unit": "x"},
+             "value": 1.0}]}}}
+    return base, cand
+
+
+def test_bench_gate_formats_directions_and_verdicts():
+    base, cand = _gate_inputs()
+    bvals = bench_gate.load_metric_values(base)
+    cvals = bench_gate.load_metric_values(cand)
+    assert bvals == {"a_tokens_per_sec": 100.0, "b_ms_per_batch": 10.0,
+                     "c_gone_metric": 5.0}
+    assert cvals["a_tokens_per_sec"] == 90.0
+    res = bench_gate.gate(bvals, cvals, tolerance=0.15)
+    statuses = {r["metric"]: r["status"] for r in res["rows"]}
+    assert statuses == {"a_tokens_per_sec": "ok",
+                        "b_ms_per_batch": "ok",
+                        "c_gone_metric": "missing",
+                        "d_new_metric": "new"}
+    assert not res["ok"]                        # missing fails by default
+    assert bench_gate.gate(bvals, cvals, 0.15, allow_missing=True)["ok"]
+    # higher-is-better regression: tokens/s drop past tolerance
+    r2 = bench_gate.gate(bvals, dict(cvals, a_tokens_per_sec=80.0),
+                         0.15, allow_missing=True)
+    assert r2["regressions"] == ["a_tokens_per_sec"]
+    # lower-is-better regression: ms/batch INCREASE past tolerance
+    r3 = bench_gate.gate(bvals, dict(cvals, b_ms_per_batch=20.0),
+                         0.15, allow_missing=True)
+    assert r3["regressions"] == ["b_ms_per_batch"]
+    # improvement in a lower-is-better metric is never a regression
+    r4 = bench_gate.gate(bvals, dict(cvals, b_ms_per_batch=1.0),
+                         0.15, allow_missing=True)
+    assert r4["ok"]
+
+
+def test_bench_gate_cli_exit_codes(tmp_path, capsys):
+    base, cand = _gate_inputs()
+    bp, cp = str(tmp_path / "base.json"), str(tmp_path / "cand.json")
+    with open(bp, "w") as f:
+        json.dump(base, f)
+    with open(cp, "w") as f:
+        json.dump(cand, f)
+    assert bench_gate.main(["--baseline", bp, "--candidate", cp,
+                            "--allow-missing"]) == 0
+    assert bench_gate.main(["--baseline", bp, "--candidate", cp]) == 1
+    out = capsys.readouterr().out
+    assert "[MISS] c_gone_metric" in out
+    assert "[  ok] a_tokens_per_sec" in out
+    assert bench_gate.main(["--baseline", str(tmp_path / "nope.json"),
+                            "--candidate", cp]) == 2
+    # a JSON file whose top level is not an object is bad input (rc 2),
+    # not a traceback
+    lp = str(tmp_path / "list.json")
+    with open(lp, "w") as f:
+        json.dump([1, 2], f)
+    assert bench_gate.main(["--baseline", lp, "--candidate", cp]) == 2
+
+
+@pytest.mark.slow
+def test_bench_metrics_feed_the_gate_end_to_end(tmp_path):
+    """Full pipeline: bench.py -> bench_metrics.json -> bench_gate
+    self-compare (rc 0).  Slow: runs the real benchmarks on CPU."""
+    mpath = str(tmp_path / "bench_metrics.json")
+    env = dict(os.environ, PTPU_BENCH_METRICS_PATH=mpath,
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "bench.py"], env=env,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))),
+                          capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(mpath) as f:
+        doc = json.load(f)
+    vals = bench_gate.load_metric_values(doc)
+    assert vals, "bench must publish bench_value rows"
+    # per-benchmark flops_per_step rides the same dump
+    assert "bench_flops_per_step" in doc["metrics"]
+    assert bench_gate.main(["--baseline", mpath,
+                            "--candidate", mpath]) == 0
+
+
+def test_parallel_executor_explain_covers_pjit_program():
+    """The tentpole covers the parallel plane too: the mesh executor's
+    pjit program yields a cost report through ParallelExecutor.explain."""
+    pt.reset_default_programs()
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    p = layers.fc(layers.fc(x, size=8, act="relu"), size=3,
+                  act="softmax")
+    loss = layers.mean(layers.cross_entropy(p, y))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    pexe = pt.ParallelExecutor(loss_name=loss.name)
+    pexe._exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((8, 4), "float32"),
+            "y": np.zeros((8, 1), "int64")}
+    pexe.run(fetch_list=[loss], feed=feed)
+    rep = pexe.explain([loss], feed=feed)
+    assert rep["schema"] == "paddle_tpu.explain.v1"
+    assert rep["cost"] is not None
+    assert rep["cost"]["flops"] > 0
+    assert rep["cost"]["peak_hbm_bytes"] > 0
+    assert pexe.cache_report()["cached_programs"] >= 1
