@@ -110,6 +110,32 @@ define_flag("flight_recorder_events", 256,
             "(recent spans, compile/chaos/guard/retry events). "
             "0 disables event recording entirely.")
 
+# --- fleet telemetry (observability/: server, fleet) -----------------------
+define_flag("obs_http_port", 0,
+            "Port for the live observability HTTP endpoint "
+            "(observability/server.py): /metrics (Prometheus text), "
+            "/metrics.json, /healthz, /flight.  0 disables the server; "
+            "the Trainer starts it on first train() when set.")
+define_flag("obs_http_host", "127.0.0.1",
+            "Bind address for the observability HTTP endpoint.  The "
+            "loopback default keeps metrics host-private; set 0.0.0.0 "
+            "(or a NIC address) so remote operators / a Prometheus "
+            "scraper can reach the port.")
+define_flag("fleet_report_interval", 2.0,
+            "Seconds between FleetReporter pushes of this worker's "
+            "metric snapshot (and new trace spans / flight bundles) to "
+            "the coordinator's FleetAggregator.  A worker is considered "
+            "stale after 3x this interval without a report.")
+define_flag("straggler_factor", 2.0,
+            "FleetAggregator straggler threshold: warn when a rank's "
+            "completed-step count falls behind the fleet median by more "
+            "than this factor (median / factor).  <= 1 disables the "
+            "check.")
+define_flag("input_bound_warn_fraction", 0.5,
+            "Trainer input-bound warning: warn once per train() when "
+            "the cumulative data-wait time (reader next + feed build) "
+            "exceeds this fraction of total step time.  0 disables.")
+
 # --- resilience plane (resilience/: chaos, guard, retry) -------------------
 define_flag("chaos_spec", "",
             "Deterministic fault-injection spec, "

@@ -22,11 +22,39 @@ import socket
 import socketserver
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..observability import metrics as obs_metrics
+
 MAX_FAILURES = 3          # ref service.go failureMax
 DEFAULT_TIMEOUT = 60.0    # lease seconds (ref chunkTimeout)
+
+# queue-state telemetry: the /metrics endpoint (observability/server.py)
+# shows dataset-task progress without an RPC.  Gauges describe the most
+# recently mutated TaskMaster in this process (one master per
+# coordinator in practice).
+_m_tasks = obs_metrics.gauge(
+    "taskmaster_tasks",
+    "Dataset tasks by queue state in this process's TaskMaster.",
+    ("state",))
+_m_lease_expired = obs_metrics.counter(
+    "taskmaster_lease_expired_total",
+    "Task leases that expired and were requeued (or moved to "
+    "failed_forever at the retry limit).")
+
+# live masters in this process, for scrape-time refresh: queue gauges
+# otherwise only move on RPC mutations, and a fleet whose workers all
+# crashed (no RPCs!) is exactly when the operator scrapes them
+_MASTERS: "weakref.WeakSet[TaskMaster]" = weakref.WeakSet()
+
+
+def refresh_metrics():
+    """Re-publish queue gauges (running lease expiry) for every live
+    TaskMaster — called by the /metrics endpoint before rendering."""
+    for m in list(_MASTERS):
+        m.stats()
 
 
 @dataclass
@@ -58,6 +86,7 @@ class TaskMaster:
         self._next_id = 0
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
+        _MASTERS.add(self)
 
     # -- dataset ----------------------------------------------------------
     def set_dataset(self, shard_paths: List[str], shards_per_task: int = 1):
@@ -70,6 +99,7 @@ class TaskMaster:
                                       shard_paths[i:i + shards_per_task]))
                 self._next_id += 1
             self._snapshot(force=True)
+            self._publish_gauges()
 
     # -- trainer API ------------------------------------------------------
     def get_task(self) -> Optional[Task]:
@@ -77,11 +107,13 @@ class TaskMaster:
         with self._lock:
             self._requeue_expired()
             if not self.todo:
+                self._publish_gauges()
                 return None
             t = self.todo.pop(0)
             self.pending[t.task_id] = {
                 "task": t, "deadline": time.time() + self.lease_timeout}
             self._snapshot()
+            self._publish_gauges()
             return t
 
     def task_finished(self, task_id: int) -> bool:
@@ -93,6 +125,7 @@ class TaskMaster:
             self.done.append(ent["task"])
             self._maybe_rollover()
             self._snapshot()
+            self._publish_gauges()
             return True
 
     def _maybe_rollover(self):
@@ -121,16 +154,25 @@ class TaskMaster:
                 self.todo.append(t)
             self._maybe_rollover()
             self._snapshot()
+            self._publish_gauges()
             return True
 
     def stats(self) -> dict:
         with self._lock:
             self._requeue_expired()
+            self._publish_gauges()
             return {"todo": len(self.todo), "pending": len(self.pending),
                     "done": len(self.done),
                     "failed_forever": len(self.failed_forever)}
 
     # -- internals --------------------------------------------------------
+    def _publish_gauges(self):
+        """Queue-state gauges (call under the lock)."""
+        for state, q in (("todo", self.todo), ("done", self.done),
+                         ("failed_forever", self.failed_forever)):
+            _m_tasks.labels(state=state).set(len(q))
+        _m_tasks.labels(state="pending").set(len(self.pending))
+
     def _requeue_expired(self):
         """Lease timeout -> back on the queue (ref checkTimeoutFunc:341)."""
         now = time.time()
@@ -144,7 +186,9 @@ class TaskMaster:
             else:
                 self.todo.append(t)
         if expired:
+            _m_lease_expired.inc(len(expired))
             self._maybe_rollover()
+            self._publish_gauges()
 
     def _snapshot(self, force: bool = False):
         if not self.snapshot_path:
@@ -198,6 +242,19 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"ok": True}
                 elif method == "stats":
                     resp = {"ok": True, "stats": master.stats()}
+                elif method in ("report_metrics", "report_events"):
+                    # fleet telemetry verbs (observability/fleet.py):
+                    # workers push snapshots/spans to the aggregator
+                    # attached via serve_master(aggregator=...)
+                    agg = getattr(self.server, "aggregator", None)
+                    if agg is None:
+                        resp = {"ok": False,
+                                "error": "no FleetAggregator attached "
+                                         "to this master"}
+                    else:
+                        ack = agg.ingest(method,
+                                         req.get("payload") or {})
+                        resp = {"ok": True, **(ack or {})}
                 else:
                     resp = {"ok": False, "error": f"bad method {method}"}
             except Exception as e:   # keep the server alive
@@ -207,17 +264,38 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class _Server(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
+    allow_reuse_address = True      # rebind a TIME_WAIT port (dist tests)
     daemon_threads = True
+    _serve_thread: Optional[threading.Thread] = None
+
+    def shutdown(self):
+        """Stop serving, close the listening socket and JOIN the serve
+        thread, so back-to-back test cases can't leak sockets."""
+        super().shutdown()
+        self.server_close()
+        t = self._serve_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
 
 
 def serve_master(master: TaskMaster, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, aggregator=None):
     """Start the TCP front end; returns (server, (host, port)).  Call
-    server.shutdown() to stop."""
-    srv = _Server((host, port), _Handler)
+    server.shutdown() to stop (joins the server thread).  Pass a
+    FleetAggregator to accept report_metrics/report_events pushes."""
+    try:
+        srv = _Server((host, port), _Handler)
+    except OSError as e:
+        raise OSError(
+            f"task master failed to bind {host}:{port}: {e}") from e
     srv.master = master   # type: ignore
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    srv.aggregator = aggregator   # type: ignore
+    # poll_interval: shutdown() blocks one poll tick; the 0.5s default
+    # costs half a second per master in every dist/resilience test case
+    t = threading.Thread(
+        target=lambda: srv.serve_forever(poll_interval=0.05),
+        daemon=True, name="task-master")
+    srv._serve_thread = t
     t.start()
     return srv, srv.server_address
 
@@ -290,6 +368,14 @@ class TaskMasterClient:
 
     def stats(self) -> dict:
         return self._call(method="stats")["stats"]
+
+    # fleet telemetry (observability/fleet.py): push this worker's
+    # snapshot / trace spans to the master's FleetAggregator
+    def report_metrics(self, payload: dict) -> dict:
+        return self._call(method="report_metrics", payload=payload)
+
+    def report_events(self, payload: dict) -> dict:
+        return self._call(method="report_events", payload=payload)
 
     def processing(self, task: Task):
         """``with client.processing(task): <work>`` — task_finished on

@@ -1343,15 +1343,20 @@ class Executor:
         return jax.make_array_from_callback(
             arr.shape, sharding, lambda idx: arr[idx])
 
-    def _fetch_numpy(self, v):
+    def fetch_numpy(self, v):
         """np.asarray, gathering shards first when the fetch is not fully
         addressable (multi-process mesh) — a collective, so every process
-        must fetch in lockstep (they run the same program loop)."""
+        must fetch in lockstep (they run the same program loop).  Public:
+        the trainer and ParallelExecutor use it to convert fetches they
+        obtained via run(return_numpy=False) after timing the device
+        block separately (step anatomy)."""
         if isinstance(v, jax.Array) and not v.is_fully_addressable:
             from jax.experimental import multihost_utils
             return np.asarray(multihost_utils.process_allgather(
                 v, tiled=True))
         return np.asarray(v)
+
+    _fetch_numpy = fetch_numpy      # internal call sites / back-compat
 
     def close(self):
         self._cache.clear()

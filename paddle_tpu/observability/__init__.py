@@ -21,15 +21,29 @@ Submodules:
   * :mod:`.bench_gate` — ``python -m paddle_tpu.observability.bench_gate``
     compares a bench_metrics.json against a committed BENCH_r*.json
     baseline and exits nonzero on regression.
+  * :mod:`.fleet` — the distributed half: worker-side
+    ``FleetReporter`` pushes snapshots/spans over the task-queue TCP
+    transport; coordinator-side ``FleetAggregator`` merges per-worker
+    series (counters sum, histograms merge, gauges keep a ``worker``
+    label), tracks liveness/stragglers and merges traces into one
+    chrome timeline (pid = rank).  Also the offline
+    ``python -m paddle_tpu.observability.fleet --merge-traces`` CLI.
+  * :mod:`.server` — live HTTP endpoint (``obs_http_port`` flag):
+    ``/metrics`` ``/metrics.json`` ``/healthz`` ``/flight``.
 
 The instrumented call sites live where the work happens:
 framework/executor.py (compile/cache counters, step latency, per-op
 timings, cost-model wiring), trainer.py (throughput, loss EMA, memory
-watermark, MFU), parallel/parallel_executor.py, bench.py.
-docs/OBSERVABILITY.md has the metrics catalog.
+watermark, MFU, step anatomy), parallel/parallel_executor.py, bench.py,
+reader/decorator.py (buffer depth), distributed/task_queue.py (queue
+gauges + fleet RPC verbs).  docs/OBSERVABILITY.md has the catalog.
 """
 from __future__ import annotations
 
+# fleet is NOT imported eagerly: it doubles as `python -m
+# paddle_tpu.observability.fleet` and runpy warns when the module is
+# already in sys.modules (the bench_gate precedent).  server rides with
+# it — both load on first use (trainer.py, serve_master callers, tests).
 from . import costmodel, flight, forensics, metrics, trace   # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,    # noqa: F401
                       MetricsRegistry, counter, gauge, histogram)

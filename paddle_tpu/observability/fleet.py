@@ -1,0 +1,722 @@
+"""Fleet telemetry: cross-worker metric aggregation and trace merge.
+
+The reference's cloud era ran a coordinator (go/master + pserver) that
+could see the whole fleet; PRs 1/3 built a strictly in-process
+observability plane.  This module is the distributed half:
+
+* :class:`FleetReporter` — worker side.  Periodically pushes this
+  process's metric snapshot (``metrics.REGISTRY.to_json()``), any new
+  trace spans and the latest flight-recorder bundle to the coordinator
+  over the existing task-queue JSON-lines TCP transport
+  (``distributed/task_queue.py`` RPC verbs ``report_metrics`` /
+  ``report_events``, payload schema ``paddle_tpu.fleet.v1``).
+* :class:`FleetAggregator` — master side.  Merges per-worker series
+  into one fleet view (counters sum, histogram buckets merge, gauges
+  keep a ``worker`` label), tracks per-worker liveness and step rate,
+  warns when a rank straggles behind the fleet median
+  (``straggler_factor`` flag), and merges per-worker trace spans into
+  ONE perfetto-valid chrome trace (pid = rank, clocks normalized via
+  the report-time offset handshake below).
+
+Clock normalization: every payload carries a paired
+(``time_unix``, ``perf_counter``) sample taken at send time, and the
+master records its own receive time.  A worker's span timestamps (all
+``perf_counter`` seconds) map onto the master's wall clock as
+``ts + (time_unix - perf_counter) + (recv_unix - time_unix)`` — the
+last term absorbs inter-host clock skew (bounded by one RPC transit).
+
+Offline: ``python -m paddle_tpu.observability.fleet --merge-traces
+<dir> -o fleet_trace.json`` merges per-rank chrome-trace dumps using
+the same normalization (via the ``clock_sync`` metadata
+``trace.to_chrome_trace()`` embeds).
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+import socket
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import flags
+from . import flight as obs_flight
+from . import metrics as obs_metrics
+from . import trace as obs_trace
+
+SCHEMA = "paddle_tpu.fleet.v1"
+
+# cap on retained normalized spans per rank (newest win): a fleet trace
+# is a debugging artifact, not an unbounded log
+_MAX_SPANS_PER_RANK = 100_000
+
+_m_reports = obs_metrics.counter(
+    "fleet_reports_total",
+    "Fleet reports ingested by the coordinator's FleetAggregator.",
+    ("verb",))
+_m_report_failures = obs_metrics.counter(
+    "fleet_report_failures_total",
+    "FleetReporter pushes that failed (coordinator unreachable or "
+    "rejected the payload); reporting continues on the next tick.")
+_m_stragglers = obs_metrics.counter(
+    "fleet_straggler_warnings_total",
+    "Straggler warnings emitted by the FleetAggregator (a rank fell "
+    "behind the fleet-median step count by > straggler_factor).",
+    ("worker",))
+
+
+# -- worker side -----------------------------------------------------------
+
+def snapshot_payload(rank: int, closing: bool = False) -> dict:
+    """This process's metric snapshot as one versioned fleet payload.
+    ``closing=True`` marks a clean departure: the aggregator keeps the
+    rank's counters in the fleet sums but stops expecting reports from
+    it (no stale/straggler alarms for a worker that finished)."""
+    steps = obs_metrics.REGISTRY.get("trainer_steps_total")
+    return {
+        "schema": SCHEMA,
+        "rank": int(rank),
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "time_unix": time.time(),
+        "perf_counter": time.perf_counter(),
+        "steps_total": float(steps.total()) if steps is not None else 0.0,
+        "closing": bool(closing),
+        "metrics": obs_metrics.REGISTRY.to_json(),
+    }
+
+
+def events_payload(rank: int, spans: List[dict],
+                   flight_bundle: Optional[dict] = None) -> dict:
+    """Trace spans (+ optional flight bundle) as one fleet payload.
+    Span timestamps stay in this process's perf_counter seconds; the
+    aggregator normalizes them with the clock pair below."""
+    return {
+        "schema": SCHEMA,
+        "rank": int(rank),
+        "time_unix": time.time(),
+        "perf_counter": time.perf_counter(),
+        "spans": spans,
+        "flight": flight_bundle,
+    }
+
+
+class FleetReporter:
+    """Worker-side push loop: metric snapshots, new trace spans and
+    fresh flight bundles go to the coordinator every
+    ``fleet_report_interval`` seconds (flag).  Failures are counted and
+    absorbed — telemetry must never take the training loop down."""
+
+    def __init__(self, host: str, port: int, rank: int,
+                 interval: Optional[float] = None, client=None):
+        self.rank = int(rank)
+        self.interval = float(interval if interval is not None
+                              else flags.get_flag("fleet_report_interval"))
+        self._host, self._port = host, int(port)
+        self._own_client = client is None
+        # dial LAZILY on first flush: workers and coordinator start
+        # concurrently, and a constructor that raises ConnectionRefused
+        # before the master binds would take the training process down
+        # on an observability-only error
+        self._client = client
+        self._span_cursor = 0
+        self._trace_gen = obs_trace.generation()
+        self._flight_dumps = obs_flight.dump_count()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serializes flushes: stop()'s closing flush must not interleave
+        # frames with a loop flush still stuck in connect/retry on the
+        # same (non-thread-safe) client socket
+        self._flush_lock = threading.Lock()
+
+    def start(self) -> "FleetReporter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"fleet-reporter-r{self.rank}")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.flush()
+            except Exception:
+                _m_report_failures.inc()
+
+    def flush(self, closing: bool = False):
+        """One synchronous report: metrics always; events only when new
+        spans or a new flight bundle exist since the last flush.  The
+        span cursor / flight watermark advance only AFTER a successful
+        push, so an unreachable coordinator delays the window instead of
+        dropping it (re-sends are at-least-once, like the task-queue
+        RPCs; a snapshot is idempotent and duplicate spans are merely
+        duplicate trace events)."""
+        with self._flush_lock:
+            self._flush_locked(closing)
+
+    def _dial(self):
+        if self._client is None:
+            from ..distributed.task_queue import TaskMasterClient
+            self._client = TaskMasterClient(self._host, self._port)
+        return self._client
+
+    def _flush_locked(self, closing: bool = False):
+        client = self._dial()
+        client.report_metrics(snapshot_payload(self.rank,
+                                               closing=closing))
+        # a generation mismatch means trace.reset() wiped the buffer:
+        # everything in it is new (a length heuristic would miss a
+        # reset the buffer regrew past before this tick); events_since
+        # copies only the tail, not the whole ring, per tick
+        gen, total, new_spans = obs_trace.events_since(
+            self._span_cursor, self._trace_gen)
+        bundle = None
+        dumps = obs_flight.dump_count()
+        if dumps != self._flight_dumps:
+            bundle = obs_flight.last_bundle()
+        if new_spans or bundle is not None:
+            self._client.report_events(
+                events_payload(self.rank, new_spans, bundle))
+        self._span_cursor = total
+        self._trace_gen = gen
+        self._flight_dumps = dumps
+
+    def stop(self, flush: bool = True):
+        """Stop the loop; the final flush (when requested) carries the
+        ``closing`` mark so the coordinator retires this rank from
+        liveness/straggler tracking instead of alarming on it.
+
+        Bounded: when a loop flush is still stuck retrying against a
+        dead coordinator (it holds the flush lock through connect
+        timeouts), the closing flush is SKIPPED after one interval of
+        waiting rather than stacking a second multi-retry cycle on the
+        shutdown path — the lease/stale machinery covers an unreported
+        departure."""
+        self._stop.set()
+        loop_alive = False
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval + 5.0)
+            loop_alive = self._thread.is_alive()
+            self._thread = None
+        if flush:
+            if self._flush_lock.acquire(timeout=self.interval + 1.0):
+                try:
+                    self._flush_locked(closing=True)
+                except Exception:
+                    _m_report_failures.inc()
+                finally:
+                    self._flush_lock.release()
+            else:
+                _m_report_failures.inc()
+        # never yank the socket from under a loop flush still stuck in
+        # connect/retry: the daemon thread (and its socket) die with the
+        # process — a leaked fd beats a corrupted in-flight RPC
+        if self._own_client and not loop_alive \
+                and self._client is not None:
+            self._client.close()
+
+    def __enter__(self) -> "FleetReporter":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+# -- merge semantics -------------------------------------------------------
+
+def _series_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def merge_metric_docs(docs: Dict[int, dict]) -> Dict[str, dict]:
+    """Merge per-worker ``paddle_tpu.metrics.v1`` documents into one
+    fleet family map (name -> {type, help, series}).  Semantics:
+
+    * counters: summed across workers per label set (the fleet total);
+    * histograms: bucket counts / sum / count added per label set;
+    * gauges (and untyped): kept per-worker under a ``worker`` label —
+      a throughput or watermark summed across ranks would lie.
+    """
+    out: Dict[str, dict] = {}
+    for rank in sorted(docs):
+        doc = docs[rank] or {}
+        for name, m in (doc.get("metrics") or {}).items():
+            mtype = m.get("type", "untyped")
+            fam = out.setdefault(name, {"type": mtype,
+                                        "help": m.get("help", ""),
+                                        "series": {}})
+            for row in m.get("series", []):
+                labels = dict(row.get("labels") or {})
+                if mtype == "counter":
+                    key = _series_key(labels)
+                    ent = fam["series"].setdefault(
+                        key, {"labels": labels, "value": 0.0})
+                    ent["value"] += float(row.get("value", 0.0))
+                elif mtype == "histogram":
+                    key = _series_key(labels)
+                    ent = fam["series"].setdefault(
+                        key, {"labels": labels, "sum": 0.0, "count": 0,
+                              "buckets": {}, "overflow": 0})
+                    ent["sum"] += float(row.get("sum", 0.0))
+                    ent["count"] += int(row.get("count", 0))
+                    ent["overflow"] += int(row.get("overflow", 0))
+                    for b, c in (row.get("buckets") or {}).items():
+                        ent["buckets"][b] = ent["buckets"].get(b, 0) + c
+                else:   # gauge / untyped: per-worker series
+                    labels["worker"] = str(rank)
+                    fam["series"][_series_key(labels)] = {
+                        "labels": labels,
+                        "value": float(row.get("value", 0.0))}
+    return out
+
+
+def _has_signal(fam: dict) -> bool:
+    """True when a merged counter/histogram family carries any actual
+    recording (nonzero value / observation count)."""
+    for row in fam["series"].values():
+        if row.get("value") or row.get("count") or row.get("sum"):
+            return True
+    return False
+
+
+def render_prometheus(families: Dict[str, dict]) -> str:
+    """Prometheus text (v0.0.4) for a merged family map — delegates to
+    the registry's single exposition renderer so the fleet view can
+    never diverge from the local one."""
+    return obs_metrics.render_prometheus(families_to_json(families))
+
+
+def families_to_json(families: Dict[str, dict]) -> dict:
+    """The merged family map in the registry's JSON schema (series maps
+    back to a list)."""
+    out = {}
+    for name, fam in families.items():
+        out[name] = {"type": fam["type"], "help": fam["help"],
+                     "series": [fam["series"][k]
+                                for k in sorted(fam["series"])]}
+    return {"schema": "paddle_tpu.metrics.v1", "metrics": out}
+
+
+# -- master side -----------------------------------------------------------
+
+class FleetAggregator:
+    """Coordinator-side fleet state: the latest metric snapshot, the
+    normalized span stream and liveness/step-rate per reporting rank.
+    Attach to a task-queue server via ``serve_master(aggregator=...)``
+    and to the HTTP endpoint via ``server.start_http_server``."""
+
+    def __init__(self, stale_after: Optional[float] = None,
+                 straggler_factor: Optional[float] = None,
+                 straggler_min_steps: int = 3):
+        self._lock = threading.Lock()
+        self.stale_after = float(
+            stale_after if stale_after is not None
+            else 3.0 * float(flags.get_flag("fleet_report_interval")))
+        self.straggler_factor = float(
+            straggler_factor if straggler_factor is not None
+            else flags.get_flag("straggler_factor"))
+        self.straggler_min_steps = int(straggler_min_steps)
+        self._workers: Dict[int, dict] = {}
+        self._spans: Dict[int, List[dict]] = {}
+        self._flights: Dict[int, dict] = {}
+        self._straggler_warned: set = set()
+
+    # -- ingest (called from the task-queue RPC handler) ---------------
+    def ingest(self, verb: str, payload: dict) -> dict:
+        if not isinstance(payload, dict) or payload.get("schema") != SCHEMA:
+            raise ValueError(
+                f"fleet payload schema "
+                f"{payload.get('schema') if isinstance(payload, dict) else payload!r} "
+                f"!= {SCHEMA}")
+        recv = time.time()
+        if verb == "report_metrics":
+            self.ingest_metrics(payload, recv_unix=recv)
+        elif verb == "report_events":
+            self.ingest_events(payload, recv_unix=recv)
+        else:
+            raise ValueError(f"unknown fleet verb {verb!r}")
+        _m_reports.labels(verb=verb).inc()
+        return {"server_time_unix": recv}
+
+    def _worker(self, payload: dict, recv_unix: float) -> dict:
+        rank = int(payload["rank"])
+        sent = float(payload["time_unix"])
+        w = self._workers.setdefault(rank, {
+            "rank": rank, "steps_total": 0.0, "step_rate": 0.0,
+            "metrics": None, "host": None, "pid": None,
+            "prev_steps": None, "prev_time": None, "departed": False})
+        w["last_seen_unix"] = recv_unix
+        # offset handshake: worker perf seconds -> master wall clock
+        w["offset"] = (sent - float(payload["perf_counter"])
+                       + (recv_unix - sent))
+        w["skew"] = recv_unix - sent
+        return w
+
+    def ingest_metrics(self, payload: dict,
+                       recv_unix: Optional[float] = None):
+        recv = time.time() if recv_unix is None else recv_unix
+        with self._lock:
+            w = self._worker(payload, recv)
+            steps = float(payload.get("steps_total", 0.0))
+            if w["prev_steps"] is not None and recv > w["prev_time"]:
+                if steps < w["prev_steps"]:
+                    # restarted process: fresh registry, counter went
+                    # backwards — a negative rate would be a lie
+                    w["step_rate"] = 0.0
+                else:
+                    w["step_rate"] = ((steps - w["prev_steps"])
+                                      / (recv - w["prev_time"]))
+            w["prev_steps"], w["prev_time"] = steps, recv
+            w["steps_total"] = steps
+            w["metrics"] = payload.get("metrics")
+            w["host"] = payload.get("host")
+            w["pid"] = payload.get("pid")
+            # a closing report retires the rank from liveness/straggler
+            # tracking (its counters stay in the fleet sums); a later
+            # non-closing report (restart) re-enrolls it
+            w["departed"] = bool(payload.get("closing"))
+            if w["departed"]:
+                self._straggler_warned.discard(w["rank"])
+            stragglers = self._find_stragglers()
+        for rank, steps, median in stragglers:
+            _m_stragglers.labels(worker=str(rank)).inc()
+            warnings.warn(
+                f"fleet straggler: rank {rank} at {steps:.0f} steps is "
+                f"> {self.straggler_factor:g}x behind the fleet median "
+                f"{median:.0f}", RuntimeWarning, stacklevel=2)
+
+    def ingest_local(self, rank: int):
+        """Enroll THIS process as a reporting rank without TCP — for a
+        coordinator that also trains.  Its steps then land in the fleet
+        sums with proper per-worker attribution (the local overlay in
+        :meth:`merged_families` deliberately does NOT add local series
+        into fleet sums: that would double-count any process that also
+        reports).  Call once per report interval, e.g. from an epoch
+        handler, or just before a scrape."""
+        self.ingest_metrics(snapshot_payload(rank))
+
+    def ingest_events(self, payload: dict,
+                      recv_unix: Optional[float] = None):
+        recv = time.time() if recv_unix is None else recv_unix
+        with self._lock:
+            w = self._worker(payload, recv)
+            offset = w["offset"]
+            rank = int(payload["rank"])
+            spans = self._spans.setdefault(rank, [])
+            for e in payload.get("spans") or []:
+                ev = dict(e)
+                ev["ts"] = float(ev["ts"]) + offset   # unix seconds now
+                spans.append(ev)
+            if len(spans) > _MAX_SPANS_PER_RANK:
+                del spans[:len(spans) - _MAX_SPANS_PER_RANK]
+            if payload.get("flight") is not None:
+                self._flights[rank] = payload["flight"]
+
+    def _find_stragglers(self) -> List[Tuple[int, float, float]]:
+        """Ranks newly fallen behind median/straggler_factor (call under
+        the lock; warning emission happens outside it).  A diagnosed
+        rank that catches back up is cleared — /healthz must recover,
+        not latch at 503 forever — and warns again on a fresh lapse."""
+        live = {r: w for r, w in self._workers.items()
+                if not w["departed"]}
+        if self.straggler_factor <= 1.0 or len(live) < 2:
+            # no basis for a diagnosis — and a prior one must not
+            # latch /healthz at 503 after the fleet shrinks around it
+            self._straggler_warned.clear()
+            return []
+        counts = sorted(w["steps_total"] for w in live.values())
+        n = len(counts)
+        median = (counts[n // 2] if n % 2 else
+                  0.5 * (counts[n // 2 - 1] + counts[n // 2]))
+        if median < self.straggler_min_steps:
+            self._straggler_warned.clear()
+            return []
+        out = []
+        for rank, w in live.items():
+            behind = w["steps_total"] * self.straggler_factor < median
+            if behind and rank not in self._straggler_warned:
+                self._straggler_warned.add(rank)
+                out.append((rank, w["steps_total"], median))
+            elif not behind:
+                self._straggler_warned.discard(rank)
+        return out
+
+    # -- fleet views ---------------------------------------------------
+    def workers(self) -> Dict[int, dict]:
+        with self._lock:
+            return {r: {k: v for k, v in w.items() if k != "metrics"}
+                    for r, w in self._workers.items()}
+
+    def health(self) -> dict:
+        """Liveness summary for /healthz: per-worker report age, stale
+        set, straggler set, and the fleet degraded verdict."""
+        now = time.time()
+        with self._lock:
+            per = {}
+            stale = []
+            for rank, w in sorted(self._workers.items()):
+                age = now - w.get("last_seen_unix", 0.0)
+                # a cleanly-departed rank stops aging out: it said
+                # goodbye, silence from it is expected, not degradation
+                is_stale = age > self.stale_after and not w["departed"]
+                if is_stale:
+                    stale.append(rank)
+                per[str(rank)] = {
+                    "host": w.get("host"), "pid": w.get("pid"),
+                    "steps_total": w.get("steps_total", 0.0),
+                    "step_rate": round(w.get("step_rate", 0.0), 3),
+                    "last_report_age_s": round(age, 3),
+                    "stale": is_stale,
+                    "departed": w["departed"],
+                }
+            stragglers = sorted(self._straggler_warned)
+        return {"workers": len(per), "per_worker": per, "stale": stale,
+                "stragglers": stragglers,
+                "stale_after_s": self.stale_after,
+                "degraded": bool(stale or stragglers)}
+
+    def merged_families(self, local: Optional[dict] = None
+                        ) -> Dict[str, dict]:
+        """Fleet-merged family map, optionally overlaid on a local
+        registry document, plus synthesized ``fleet_worker_*`` gauges.
+
+        Overlay semantics per family: gauges UNION (fleet series carry
+        a ``worker`` label, local ones don't); counters/histograms with
+        fleet signal REPLACE the local series (the coordinator's
+        zero-valued trainer counters must not shadow the fleet's), and
+        all-zero fleet families yield to populated local ones (workers
+        eagerly declare unlabeled metrics at 0).  Local counters are
+        never ADDED into fleet sums — a coordinator that also trains
+        should enroll itself via :meth:`ingest_local` so its counts
+        carry per-worker attribution instead."""
+        with self._lock:
+            docs = {r: w["metrics"] for r, w in self._workers.items()
+                    if w.get("metrics")}
+        fleet = merge_metric_docs(docs)
+        out: Dict[str, dict] = {}
+        if local:
+            for name, m in (local.get("metrics") or {}).items():
+                fam = {"type": m.get("type", "untyped"),
+                       "help": m.get("help", ""), "series": {}}
+                for row in m.get("series", []):
+                    labels = dict(row.get("labels") or {})
+                    ent = dict(row)
+                    ent["labels"] = labels
+                    fam["series"][_series_key(labels)] = ent
+                out[name] = fam
+        for name, fam in fleet.items():
+            local_fam = out.get(name)
+            if local_fam is None:
+                out[name] = fam
+            elif fam["type"] in ("gauge", "untyped"):
+                # gauges coexist: fleet series carry a worker label,
+                # local ones don't — one family, disjoint label sets
+                merged = dict(local_fam["series"])
+                merged.update(fam["series"])
+                out[name] = {**fam, "series": merged}
+            elif _has_signal(fam):
+                out[name] = fam
+            # else: an all-zero fleet counter/histogram family (workers
+            # declare unlabeled metrics eagerly at value 0, e.g. every
+            # worker's taskmaster_lease_expired_total) carries no
+            # information — keep the coordinator's local series
+        h = self.health()
+        out["fleet_workers"] = {
+            "type": "gauge",
+            "help": "Workers that have reported to the FleetAggregator.",
+            "series": {(): {"labels": {}, "value": float(h["workers"])}}}
+        up = {"type": "gauge",
+              "help": "1 when the rank reported within stale_after "
+                      "seconds, else 0.", "series": {}}
+        age = {"type": "gauge",
+               "help": "Seconds since the rank's last fleet report.",
+               "series": {}}
+        rate = {"type": "gauge",
+                "help": "Rank step rate (steps/s) between its last two "
+                        "reports.", "series": {}}
+        for rank, w in h["per_worker"].items():
+            labels = {"worker": rank}
+            key = _series_key(labels)
+            up["series"][key] = {
+                "labels": labels,
+                "value": 0.0 if (w["stale"] or w["departed"]) else 1.0}
+            age["series"][key] = {"labels": labels,
+                                  "value": w["last_report_age_s"]}
+            rate["series"][key] = {"labels": labels,
+                                   "value": w["step_rate"]}
+        out["fleet_worker_up"] = up
+        out["fleet_worker_report_age_seconds"] = age
+        out["fleet_worker_step_rate"] = rate
+        return out
+
+    def prometheus_text(self, local: Optional[dict] = None) -> str:
+        return render_prometheus(self.merged_families(local))
+
+    def flight_bundles(self) -> Dict[int, dict]:
+        with self._lock:
+            return dict(self._flights)
+
+    def merged_trace(self) -> dict:
+        """ONE chrome trace for the fleet: pid = rank, per-rank process
+        metadata, every span on the master's wall clock (µs since the
+        earliest fleet event)."""
+        with self._lock:
+            per_rank = {r: list(evs) for r, evs in self._spans.items()}
+            hosts = {r: (w.get("host"), w.get("pid"))
+                     for r, w in self._workers.items()}
+        return _compose_trace(
+            {r: (evs, hosts.get(r, (None, None))[0])
+             for r, evs in per_rank.items()})
+
+
+def _compose_trace(per_rank: Dict[int, Tuple[List[dict], Optional[str]]]
+                   ) -> dict:
+    """Build the merged chrome trace from {rank: (normalized-seconds
+    events, host)}.  Shared by the live aggregator and the offline CLI."""
+    all_ts = [e["ts"] for evs, _ in per_rank.values() for e in evs]
+    t0 = min(all_ts) if all_ts else 0.0
+    out: List[dict] = []
+    body: List[dict] = []
+    for rank in sorted(per_rank):
+        evs, host = per_rank[rank]
+        pname = f"rank {rank}" + (f" ({host})" if host else "")
+        out.append({"name": "process_name", "ph": "M", "pid": rank,
+                    "tid": 0, "args": {"name": pname}})
+        tids = sorted({int(e.get("tid", 0)) for e in evs})
+        for tid in tids:
+            lane = obs_trace._LANE_NAMES.get(tid, f"tid {tid}")
+            out.append({"name": "thread_name", "ph": "M", "pid": rank,
+                        "tid": tid, "args": {"name": lane}})
+        for e in evs:
+            ev = {"name": e["name"], "ph": e["ph"], "pid": rank,
+                  "tid": int(e.get("tid", 0)),
+                  "ts": (e["ts"] - t0) * 1e6,
+                  "cat": e.get("cat", "host")}
+            if e["ph"] == "X":
+                ev["dur"] = float(e.get("dur", 0.0)) * 1e6
+            if e["ph"] == "i":
+                ev["s"] = "t"
+            if e.get("args"):
+                ev["args"] = e["args"]
+            body.append(ev)
+    body.sort(key=lambda e: e["ts"])
+    return {"traceEvents": out + body, "displayTimeUnit": "ms",
+            "metadata": {"fleet_ranks": sorted(per_rank),
+                         "t0_unix": t0}}
+
+
+# -- offline trace merge ---------------------------------------------------
+
+def _load_trace_file(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def _rank_of(path: str, fallback: int) -> int:
+    """Rank from the filename's last integer group (trace.0.json,
+    rank1_trace.json, ...), else the file's sort position."""
+    groups = re.findall(r"\d+", os.path.basename(path))
+    return int(groups[-1]) if groups else fallback
+
+
+def merge_trace_files(paths: List[str],
+                      out_path: Optional[str] = None) -> dict:
+    """Merge per-rank chrome-trace dumps into one fleet trace — the
+    offline twin of :meth:`FleetAggregator.merged_trace`.  Files whose
+    ``metadata.clock_sync`` is present (every dump from
+    ``trace.export_chrome_trace``) normalize exactly like live reports:
+    event µs -> that process's wall clock.  Files without it fall back
+    to aligning their earliest event at the fleet origin."""
+    loaded = []      # (rank, raw events, clock offset-or-None)
+    for i, path in enumerate(sorted(paths)):
+        doc = _load_trace_file(path)
+        if "traceEvents" not in doc:
+            continue             # not a chrome trace (e.g. a result
+                                 # json in the same dir)
+        if "fleet_ranks" in (doc.get("metadata") or {}):
+            continue             # OUR OWN merged output from a prior
+                                 # run — re-ingesting it would duplicate
+                                 # every event under a bogus rank
+        events = [e for e in doc["traceEvents"]
+                  if e.get("ph") != "M"]
+        sync = (doc.get("metadata") or {}).get("clock_sync") or {}
+        if "time_unix" in sync and "perf_counter" in sync:
+            # exported ts are perf_counter µs; shift onto the wall clock
+            offset = (float(sync["time_unix"])
+                      - float(sync["perf_counter"]))
+        else:
+            offset = None
+        loaded.append((_rank_of(path, i), events, offset, path))
+    # files WITHOUT clock_sync (pre-fleet or foreign dumps) can't be
+    # cross-correlated; align their earliest event at the fleet origin
+    # — the earliest clock-synced timestamp when one exists (NOT unix
+    # zero, which would strand the synced ranks ~epoch-seconds away)
+    synced_start = min(
+        (e["ts"] / 1e6 + off for _, evs, off, _p in loaded
+         if off is not None for e in evs), default=0.0)
+    per_rank: Dict[int, Tuple[List[dict], Optional[str]]] = {}
+    for rank, events, offset, path in loaded:
+        if offset is None:
+            offset = synced_start - min(
+                (e["ts"] for e in events), default=0.0) / 1e6
+        norm = []
+        for e in events:
+            ev = dict(e)
+            ev["ts"] = e["ts"] / 1e6 + offset         # unix seconds
+            if "dur" in ev:
+                ev["dur"] = ev["dur"] / 1e6           # seconds
+            norm.append(ev)
+        orig = rank
+        while rank in per_rank:     # duplicate filename ranks: next slot
+            rank += 1
+        if rank != orig:
+            # silent remapping would mislead whoever is debugging a
+            # specific rank's timeline — name the file and the new pid
+            warnings.warn(
+                f"merge-traces: rank {orig} already taken; events from "
+                f"{os.path.basename(path)} appear under pid {rank}",
+                RuntimeWarning, stacklevel=2)
+        per_rank[rank] = (norm, None)
+    merged = _compose_trace(per_rank)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return merged
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.fleet",
+        description="Merge per-rank chrome-trace dumps into one "
+                    "perfetto-valid fleet trace (pid = rank).")
+    ap.add_argument("--merge-traces", metavar="DIR", required=True,
+                    help="directory of per-rank trace .json/.json.gz "
+                         "dumps")
+    ap.add_argument("-o", "--output", default="fleet_trace.json",
+                    help="merged trace path (default fleet_trace.json)")
+    args = ap.parse_args(argv)
+    out_abs = os.path.abspath(args.output)
+    paths = sorted(
+        p for p in (os.path.join(args.merge_traces, n)
+                    for n in os.listdir(args.merge_traces))
+        if (p.endswith(".json") or p.endswith(".json.gz"))
+        and os.path.abspath(p) != out_abs)   # -o inside the input dir
+    if not paths:
+        ap.error(f"no .json/.json.gz traces under {args.merge_traces}")
+    merged = merge_trace_files(paths, out_path=args.output)
+    spans = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+    print(f"merged {len(paths)} trace(s), "
+          f"{len(merged['metadata']['fleet_ranks'])} rank(s), "
+          f"{spans} events -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -128,16 +128,21 @@ def _counter_totals() -> Dict[str, float]:
     return out
 
 
-def bundle(reason: str, extra: Optional[dict] = None) -> dict:
+def bundle(reason: str, extra: Optional[dict] = None,
+           advance_baseline: bool = True) -> dict:
     """Build the diagnostic bundle (no file I/O).  The counter-delta
     baseline advances here, under the lock: concurrent dumps each get a
     consistent window, and even when a later file write fails the
-    window's deltas survive in :func:`last_bundle`."""
+    window's deltas survive in :func:`last_bundle`.
+    ``advance_baseline=False`` is for pure observers (the /flight HTTP
+    route): they must not shrink the delta window of the next REAL
+    crash dump."""
     global _last_counter_snapshot
     totals = _counter_totals()
     with _lock:
         prev = _last_counter_snapshot
-        _last_counter_snapshot = totals
+        if advance_baseline:
+            _last_counter_snapshot = totals
     deltas = {k: v - prev.get(k, 0.0) for k, v in totals.items()
               if v - prev.get(k, 0.0) != 0.0}
     from . import costmodel, forensics

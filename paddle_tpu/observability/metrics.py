@@ -6,8 +6,10 @@ bench rows all land here, so one exposition (Prometheus text or JSON)
 describes a live process and a BENCH_r*.json alike.
 
 Design notes
-  * Prometheus data model (metric name + sorted label tuple -> series),
-    but in-process only — exposition is pull-by-call, no HTTP server.
+  * Prometheus data model (metric name + sorted label tuple -> series);
+    exposition is pull-by-call here, served live by the HTTP endpoint
+    in server.py (obs_http_port flag) and fleet-aggregated across
+    workers by fleet.py.
   * `counter()/gauge()/histogram()` are get-or-create and idempotent, so
     every module can declare its metrics at import time without an
     ordering contract.
@@ -292,30 +294,10 @@ class MetricsRegistry:
 
     # -- exposition --------------------------------------------------------
     def prometheus_text(self) -> str:
-        """Prometheus text format v0.0.4 exposition."""
-        lines: List[str] = []
-        for m in self.metrics():
-            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
-            lines.append(f"# TYPE {m.name} {m.type}")
-            for key, s in sorted(m.series().items()):
-                base = dict(zip(m.labelnames, key))
-                if m.type == "histogram":
-                    cum = 0
-                    for b, c in zip(m.buckets, s.bucket_counts):
-                        cum += c
-                        lines.append(_sample(f"{m.name}_bucket",
-                                             {**base, "le": _fmt(b)}, cum))
-                    cum += s.bucket_counts[-1]
-                    lines.append(_sample(f"{m.name}_bucket",
-                                         {**base, "le": "+Inf"}, cum))
-                    lines.append(_sample(f"{m.name}_sum", base, s.sum))
-                    lines.append(_sample(f"{m.name}_count", base, s.count))
-                else:
-                    suffix = "_total" if (m.type == "counter" and
-                                          not m.name.endswith("_total")) \
-                        else ""
-                    lines.append(_sample(m.name + suffix, base, s.value))
-        return "\n".join(lines) + "\n"
+        """Prometheus text format v0.0.4 exposition (rendered from the
+        same JSON document to_json() emits, by the ONE renderer the
+        fleet-merged exposition also uses — see render_prometheus)."""
+        return render_prometheus(self.to_json())
 
     def to_json(self) -> dict:
         """One JSON document for the whole registry — the schema shared
@@ -340,6 +322,44 @@ class MetricsRegistry:
     def dump_json(self, path: str):
         with open(path, "w") as f:
             json.dump(self.to_json(), f, indent=1, sort_keys=True)
+
+
+def render_prometheus(doc: dict) -> str:
+    """Prometheus text (v0.0.4) for a ``paddle_tpu.metrics.v1`` JSON
+    document — the single exposition renderer.  Both the live registry
+    (:meth:`MetricsRegistry.prometheus_text`) and the fleet-merged view
+    (observability/fleet.py) delegate here, so an exposition fix (e.g.
+    escaping) can never diverge the two."""
+    lines: List[str] = []
+    metrics_map = doc.get("metrics", {})
+    for name in sorted(metrics_map):
+        m = metrics_map[name]
+        mtype = m.get("type", "untyped")
+        lines.append(f"# HELP {name} {_escape_help(m.get('help', ''))}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for row in m.get("series", []):
+            labels = row.get("labels") or {}
+            if mtype == "histogram":
+                cum = 0
+                buckets = row.get("buckets") or {}
+                for b in sorted(buckets, key=float):
+                    cum += buckets[b]
+                    lines.append(_sample(f"{name}_bucket",
+                                         {**labels, "le": _fmt(float(b))},
+                                         cum))
+                cum += row.get("overflow", 0)
+                lines.append(_sample(f"{name}_bucket",
+                                     {**labels, "le": "+Inf"}, cum))
+                lines.append(_sample(f"{name}_sum", labels,
+                                     row.get("sum", 0.0)))
+                lines.append(_sample(f"{name}_count", labels,
+                                     row.get("count", 0)))
+            else:
+                suffix = "_total" if (mtype == "counter" and
+                                      not name.endswith("_total")) else ""
+                lines.append(_sample(name + suffix, labels,
+                                     row.get("value", 0.0)))
+    return "\n".join(lines) + "\n"
 
 
 def _fmt(v: float) -> str:

@@ -1,0 +1,296 @@
+"""Live observability HTTP endpoint (stdlib http.server, threaded).
+
+Routes (flag ``obs_http_port``, 0 = off; Trainer starts the server on
+first ``train()`` when the flag is set, or call
+:func:`start_http_server` directly — e.g. on the coordinator next to
+``serve_master``):
+
+* ``/metrics`` — Prometheus text exposition (v0.0.4).  With a
+  :class:`~.fleet.FleetAggregator` attached this is the FLEET view:
+  counters summed across workers, histogram buckets merged, gauges
+  per-worker under a ``worker`` label, overlaid on this process's own
+  registry (taskmaster queue gauges etc.).
+* ``/metrics.json`` — the same document in the registry JSON schema.
+* ``/healthz`` — JSON liveness: trainer last-step age, fleet stale /
+  straggler state.  HTTP 200 when healthy, 503 when the fleet is
+  degraded (a stale worker or a diagnosed straggler).
+* ``/flight`` — the latest flight-recorder bundle (built on demand
+  when nothing has tripped yet); with an aggregator, per-worker
+  bundles ride along under ``workers``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from ..core import flags
+from . import flight as obs_flight
+from . import metrics as obs_metrics
+
+# NOTE: .fleet is imported lazily (only when an aggregator is actually
+# attached) so `python -m paddle_tpu.observability.fleet` doesn't trip
+# runpy's already-imported warning via trainer.py -> server -> fleet.
+
+_PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_lock = threading.Lock()
+_server: Optional["ObservabilityServer"] = None
+
+# trainer liveness for /healthz: updated by Trainer.train at every step
+_liveness = {"steps": 0, "last_step_unix": None, "running": False}
+# a RUNNING trainer with no step for this long reads as hung on
+# /healthz (degraded); a finished or never-started trainer does not
+_TRAINER_STALE_S = 60.0
+
+
+def note_trainer_step():
+    _liveness["steps"] += 1
+    _liveness["last_step_unix"] = time.time()
+
+
+def note_trainer_running(running: bool):
+    """Trainer.train marks entry/exit so /healthz can tell 'hung
+    mid-train' (degraded) from 'finished' / 'never trained' (not)."""
+    _liveness["running"] = bool(running)
+    if running:
+        # entering train() restarts the staleness clock: compile of the
+        # first step may legitimately take minutes on a cold cache
+        _liveness["last_step_unix"] = time.time()
+
+
+def trainer_liveness() -> dict:
+    last = _liveness["last_step_unix"]
+    age = None if last is None else time.time() - last
+    return {"steps": _liveness["steps"],
+            "last_step_unix": last,
+            "last_step_age_s": None if age is None else round(age, 3),
+            "running": _liveness["running"],
+            "alive": age is not None and age < _TRAINER_STALE_S,
+            "hung": (_liveness["running"] and age is not None
+                     and age > _TRAINER_STALE_S)}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_obs/1"
+
+    def log_message(self, *a):       # keep test output quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, doc) -> None:
+        # gauges may legitimately hold NaN/Inf (a poisoned loss is
+        # exactly when people scrape) — stringify them like flight.py
+        # does so the body stays strict JSON for jq/JSON.parse
+        body = json.dumps(obs_flight._strict_json(doc),
+                          allow_nan=False).encode()
+        self._send(code, body, "application/json")
+
+    def do_GET(self):
+        obs: "ObservabilityServer" = self.server.obs   # type: ignore
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, obs.prometheus_text().encode(),
+                           _PROM_CTYPE)
+            elif path == "/metrics.json":
+                self._send_json(200, obs.metrics_json())
+            elif path == "/healthz":
+                doc = obs.healthz()
+                self._send_json(200 if doc["status"] == "ok" else 503,
+                                doc)
+            elif path == "/flight":
+                self._send_json(200, obs.flight())
+            elif path == "/":
+                self._send(200, b"paddle_tpu observability: /metrics "
+                                b"/metrics.json /healthz /flight\n",
+                           "text/plain; charset=utf-8")
+            else:
+                self._send_json(404, {"error": f"no route {path}"})
+        except Exception as e:       # the endpoint must not take the
+            try:                     # process down with it
+                self._send_json(500, {"error": repr(e)[:500]})
+            except OSError:
+                pass
+
+
+class ObservabilityServer:
+    """One threaded stdlib HTTP server bound to (host, port); request
+    handlers pull live registry / aggregator state at scrape time."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 aggregator: Optional["obs_fleet.FleetAggregator"] = None):
+        self.aggregator = aggregator
+        try:
+            self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        except OSError as e:
+            raise OSError(
+                f"observability server failed to bind {host}:{port}: "
+                f"{e}") from e
+        self._httpd.daemon_threads = True
+        self._httpd.obs = self        # type: ignore[attr-defined]
+        # poll_interval: shutdown() blocks one poll tick; keep it short
+        # so stop()/test teardown doesn't pay the 0.5s default
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name="obs-http-server")
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        """Shut down and JOIN the server thread (no socket leaks
+        between test cases)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+
+    # -- route bodies --------------------------------------------------
+    @staticmethod
+    def _refresh_sampled_state():
+        """Re-publish gauges that only move on their owner's activity
+        (taskmaster queue state): a scrape must see NOW, not the last
+        RPC — a stalled fleet sends no RPCs at all."""
+        try:
+            from ..distributed import task_queue
+            task_queue.refresh_metrics()
+        except Exception:
+            pass                 # scraping must never 500 on refresh
+
+    def prometheus_text(self) -> str:
+        self._refresh_sampled_state()
+        if self.aggregator is not None:
+            return self.aggregator.prometheus_text(
+                local=obs_metrics.REGISTRY.to_json())
+        return obs_metrics.REGISTRY.prometheus_text()
+
+    def metrics_json(self) -> dict:
+        self._refresh_sampled_state()
+        if self.aggregator is not None:
+            from . import fleet as obs_fleet
+            return obs_fleet.families_to_json(
+                self.aggregator.merged_families(
+                    local=obs_metrics.REGISTRY.to_json()))
+        return obs_metrics.REGISTRY.to_json()
+
+    def healthz(self) -> dict:
+        fleet = (self.aggregator.health()
+                 if self.aggregator is not None else None)
+        trainer = trainer_liveness()
+        # degraded when the fleet says so OR this process's own trainer
+        # is hung mid-train — a k8s probe keyed on the status must
+        # restart a deadlocked worker, not 200 it forever
+        degraded = bool(fleet and fleet["degraded"]) or trainer["hung"]
+        return {"status": "degraded" if degraded else "ok",
+                "time_unix": time.time(),
+                "trainer": trainer,
+                "fleet": fleet}
+
+    def flight(self) -> dict:
+        # a scrape is a pure observer: never advance the counter-delta
+        # baseline a real crash dump would otherwise report against
+        doc = obs_flight.last_bundle() or obs_flight.bundle(
+            "http_on_demand", advance_baseline=False)
+        if self.aggregator is not None:
+            workers = self.aggregator.flight_bundles()
+            if workers:
+                doc = dict(doc)
+                doc["workers"] = {str(r): b
+                                  for r, b in sorted(workers.items())}
+        return doc
+
+
+def start_http_server(port: Optional[int] = None,
+                      host: Optional[str] = None,
+                      aggregator: Optional[
+                          "obs_fleet.FleetAggregator"] = None
+                      ) -> Optional[ObservabilityServer]:
+    """Start (or return) the process-wide endpoint.  ``port=None`` reads
+    the ``obs_http_port`` flag and is a no-op at its 0 default; an
+    explicit port always binds (0 = ephemeral, for tests).
+
+    If a server is already running, an ``aggregator`` is attached to it
+    when it has none (the coordinator-also-trains case: the Trainer's
+    flag-gated ensure_started() may win the race); a CONFLICTING
+    explicit port or aggregator raises instead of being silently
+    ignored."""
+    global _server
+    with _lock:
+        if _server is not None:
+            # validate BEFORE mutating: a raising call must not leave
+            # its aggregator attached to the running server
+            if port not in (None, 0) and port != _server.address[1]:
+                raise RuntimeError(
+                    f"observability server already bound to "
+                    f"{_server.url}; requested port {port} — "
+                    f"stop_http_server() first")
+            if aggregator is not None:
+                if _server.aggregator is None:
+                    _server.aggregator = aggregator
+                elif _server.aggregator is not aggregator:
+                    raise RuntimeError(
+                        "observability server already running with a "
+                        "different FleetAggregator; stop_http_server() "
+                        "first")
+            return _server
+        if port is None:
+            port = int(flags.get_flag("obs_http_port"))
+            if port <= 0:
+                return None
+        if host is None:
+            # loopback default; obs_http_host=0.0.0.0 opts into remote
+            # scrapes (a Prometheus target / the operator's curl)
+            host = str(flags.get_flag("obs_http_host"))
+        _server = ObservabilityServer(host, port, aggregator=aggregator)
+        return _server
+
+
+def ensure_started() -> Optional[ObservabilityServer]:
+    """Flag-gated idempotent start — the Trainer's entry point.  Unlike
+    an explicit start_http_server(), a bind failure here WARNS instead
+    of raising: obs_http_port is typically set fleet-wide via env, and
+    a colocated second worker losing the port race must not lose its
+    training run to an observability-only error."""
+    import warnings
+    try:
+        return start_http_server(port=None)
+    except (OSError, RuntimeError) as e:
+        warnings.warn(f"observability endpoint not started: {e}",
+                      RuntimeWarning, stacklevel=2)
+        return None
+
+
+def get_server() -> Optional[ObservabilityServer]:
+    return _server
+
+
+def stop_http_server():
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def reset():
+    """Test hook: stop any running server and zero trainer liveness."""
+    stop_http_server()
+    _liveness["steps"] = 0
+    _liveness["last_step_unix"] = None
+    _liveness["running"] = False

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 # Stable lane ids (thread_name metadata is emitted per lane on export).
@@ -33,7 +34,8 @@ _MAX_EVENTS = 1_000_000     # hard cap; beyond it events drop (counted)
 _events: List[dict] = []
 _dropped = 0
 _enabled = False
-_lock = threading.Lock()
+_generation = 0     # bumped by reset(); consumers with a cursor into
+_lock = threading.Lock()        # the buffer use it to detect the wipe
 
 
 def enabled() -> bool:
@@ -51,10 +53,17 @@ def disable():
 
 
 def reset():
-    global _dropped
+    global _dropped, _generation
     with _lock:
         _events.clear()
         _dropped = 0
+        _generation += 1
+
+
+def generation() -> int:
+    """Bumped on every reset(); lets cursor-based consumers (the fleet
+    reporter) tell 'buffer wiped and refilled' from 'buffer grew'."""
+    return _generation
 
 
 def dropped() -> int:
@@ -95,6 +104,18 @@ def events(cat: Optional[str] = None) -> List[dict]:
     return evs
 
 
+def events_since(cursor: int, generation: Optional[int] = None):
+    """Atomic (generation, length, tail-from-cursor) read for
+    cursor-based consumers (the fleet reporter).  A mismatched
+    `generation` means reset() wiped the buffer since the cursor was
+    taken: the whole buffer returns.  Copies only the tail — a full
+    events() copy is O(buffer) per report tick."""
+    with _lock:
+        gen = _generation
+        start = cursor if generation == gen else 0
+        return gen, len(_events), _events[min(start, len(_events)):]
+
+
 def to_chrome_trace() -> dict:
     """The merged trace as a chrome://tracing / perfetto JSON object."""
     with _lock:
@@ -116,9 +137,15 @@ def to_chrome_trace() -> dict:
         if e.get("args"):
             ev["args"] = e["args"]
         out.append(ev)
-    trace = {"traceEvents": out, "displayTimeUnit": "ms"}
+    # clock_sync pairs one wall-clock sample with one perf_counter sample
+    # so fleet.py can map this process's perf timeline onto the shared
+    # wall clock (same normalization live FleetReporter payloads use)
+    trace = {"traceEvents": out, "displayTimeUnit": "ms",
+             "metadata": {"clock_sync": {"time_unix": time.time(),
+                                         "perf_counter":
+                                             time.perf_counter()}}}
     if _dropped:
-        trace["metadata"] = {"dropped_events": _dropped}
+        trace["metadata"]["dropped_events"] = _dropped
     return trace
 
 

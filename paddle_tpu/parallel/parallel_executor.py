@@ -47,6 +47,14 @@ _m_global_examples_per_sec = obs_metrics.gauge(
     "parallel_executor_examples_per_sec",
     "Global-batch throughput of the last ParallelExecutor.run "
     "(leading dim of the first feed / wall time).")
+_m_host_seconds = obs_metrics.histogram(
+    "parallel_executor_host_seconds",
+    "Host-side dispatch time of one ParallelExecutor.run (excludes "
+    "device completion; first run per compiled key includes compile).")
+_m_device_seconds = obs_metrics.histogram(
+    "parallel_executor_device_seconds",
+    "Device time of one ParallelExecutor.run: block-until-ready plus "
+    "the device->host copy of the fetches (return_numpy runs only).")
 
 
 class ExecutionStrategy:
@@ -123,12 +131,24 @@ class ParallelExecutor:
         feed = feed if feed is not None else (feed_dict or {})
         t0 = time.perf_counter()
         with RecordEvent("parallel_executor.run"):
+            # host/device anatomy (the trainer's step split, data-
+            # parallel face): dispatch without blocking, then account
+            # the block-until-ready + D2H copy as device time
             out = self._exe.run(self.program, feed=feed,
                                 fetch_list=list(fetch_list),
-                                return_numpy=return_numpy)
+                                return_numpy=False)
+            host_s = time.perf_counter() - t0
+            td = time.perf_counter()
+            if return_numpy and out:
+                jax.block_until_ready(out)
+                out = [self._exe.fetch_numpy(v) for v in out]
+            device_s = time.perf_counter() - td
         dt = time.perf_counter() - t0
         _m_runs.inc()
         _m_run_seconds.observe(dt)
+        _m_host_seconds.observe(host_s)
+        if return_numpy:
+            _m_device_seconds.observe(device_s)
         if feed and dt > 0:
             # read the batch dim without np.asarray: that would force a
             # device->host copy of the feed on the hot path

@@ -19,6 +19,27 @@ import subprocess
 import threading
 from typing import Callable, Iterable, List, Sequence
 
+from ..observability import metrics as obs_metrics
+
+# input-pipeline headroom: sampled at every buffered() consume.  Depth
+# pinned at 0 while the device waits = the producer can't keep up
+# (pairs with the trainer's trainer_data_wait_seconds anatomy).
+# Labeled per buffered() so composed pipelines — e.g.
+# buffered(batch(buffered(raw, 64)), 8) — stay attributable instead of
+# two queues racing one series.
+_m_buffer_depth = obs_metrics.gauge(
+    "reader_buffer_depth",
+    "Items queued in a reader.buffered() prefetch queue at its last "
+    "consume, labeled per buffered() decorator (name= arg, or "
+    "buffered<N> in creation order).",
+    ("reader",))
+_buffered_seq = itertools.count()
+# anonymous buffered() labels recycle modulo this bound: a pipeline
+# rebuilt every epoch must not grow one permanent gauge series per
+# epoch (registry series are never reclaimed).  Pass name= for stable
+# attribution.
+_MAX_ANON_BUFFERED_LABELS = 64
+
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
            "firstn", "xmap_readers", "multiprocess_reader", "batch",
            "cache", "PipeReader"]
@@ -72,10 +93,16 @@ def compose(*readers, check_alignment: bool = True):
     return reader
 
 
-def buffered(reader, size: int):
+def buffered(reader, size: int, name: str = None):
     """Background-thread prefetch into a bounded queue (ref :172) —
     overlaps host input work with device steps.  Producer exceptions are
-    re-raised in the consumer (not swallowed as end-of-data)."""
+    re-raised in the consumer (not swallowed as end-of-data).  `name`
+    labels this queue's reader_buffer_depth gauge series (auto
+    buffered<N> otherwise)."""
+    depth_gauge = _m_buffer_depth.labels(
+        reader=name or "buffered%d" % (
+            next(_buffered_seq) % _MAX_ANON_BUFFERED_LABELS))
+
     class _End:
         pass
 
@@ -99,6 +126,7 @@ def buffered(reader, size: int):
         t.start()
         while True:
             e = q.get()
+            depth_gauge.set(q.qsize())
             if e is _End:
                 break
             if isinstance(e, _Error):

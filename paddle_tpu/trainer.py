@@ -16,19 +16,23 @@ from __future__ import annotations
 import os
 import signal
 import time
+import warnings
 from typing import Callable, Dict, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 from . import io as pio
 from . import optimizer as optim
 from . import observability
+from .core import flags
 from .core.enforce import check_arg
 from .framework.executor import Executor, Scope
 from .framework.program import Program, program_guard
 from .observability import costmodel as obs_cost
 from .observability import flight as obs_flight
 from .observability import metrics as obs_metrics
+from .observability import server as obs_server
 from .observability import trace as obs_trace
 from .resilience import chaos, guard as rguard, retry as rretry
 
@@ -39,8 +43,24 @@ _m_epochs = obs_metrics.counter(
     "trainer_epochs_total", "Epochs completed by Trainer.train.")
 _m_step_seconds = obs_metrics.histogram(
     "trainer_step_seconds",
-    "Wall time of one Trainer train step (feed build + device step + "
-    "metric fetch).")
+    "Wall time of one Trainer train step (reader next + feed build + "
+    "device step + metric fetch) — the sum the anatomy histograms "
+    "below decompose.")
+# step-time anatomy: input-bound vs compute-bound at a glance —
+# data_wait + host + device ~= trainer_step_seconds
+_m_data_wait_seconds = obs_metrics.histogram(
+    "trainer_data_wait_seconds",
+    "Input-pipeline wait per step: reader next() + feed build.  "
+    "data_wait >> host+device = input-bound; grow reader.buffered / "
+    "xmap_readers.")
+_m_host_seconds = obs_metrics.histogram(
+    "trainer_host_seconds",
+    "Host-side dispatch time of one step (executor run, excluding "
+    "device completion; first step per compiled key includes compile).")
+_m_device_seconds = obs_metrics.histogram(
+    "trainer_device_seconds",
+    "Device time of one step: block-until-ready on the fetches plus "
+    "the device->host copy of the fetched metrics.")
 _m_examples_per_sec = obs_metrics.gauge(
     "trainer_examples_per_sec",
     "Smoothed training throughput in examples/s (tokens/s = this x "
@@ -82,6 +102,15 @@ _EMA_DECAY = 0.9
 # device-memory sampling cadence: the live_arrays()/memory_stats() walk
 # is O(resident arrays), too heavy for every step of a big model
 _MEM_SAMPLE_EVERY = 8
+# input-bound warning needs a few steps of evidence: the first step's
+# compile dwarfs everything and short smoke runs must stay warning-free
+_INPUT_BOUND_MIN_STEPS = 8
+# exhaustion sentinel for the anatomy loop: a buggy reader yielding
+# None must reach the feeder and fail loudly, not end the epoch early
+_END_OF_DATA = object()
+# ... and an absolute floor: micro-programs whose whole step is sub-ms
+# have data-wait "fractions" that are all noise, not a pipeline problem
+_INPUT_BOUND_MIN_WAIT_S = 0.002
 # transient-save retry: absorbs flaky-filesystem OSErrors (and the
 # checkpoint.save chaos site) without losing the training step
 _SAVE_RETRY = rretry.RetryPolicy(name="checkpoint_save",
@@ -292,6 +321,10 @@ class Trainer:
         self.preempted = False
         health = rguard.NumericGuard(ema_decay=_EMA_DECAY)
         stop = self._install_preemption_handlers()
+        obs_server.ensure_started()     # obs_http_port flag, 0 = off
+        obs_server.note_trainer_running(True)
+        # step anatomy accumulators for the input-bound diagnosis
+        anatomy = {"data_wait": 0.0, "step": 0.0, "n": 0, "warned": False}
         try:
             for epoch_id in range(self.epoch_offset, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
@@ -304,24 +337,69 @@ class Trainer:
                         if next(batches, None) is None:
                             break
                     start_step = self.step_offset
-                for step_id, batch in enumerate(batches, start=start_step):
-                    begin = BeginStepEvent(epoch_id, step_id)
-                    event_handler(begin)
+                step_id = start_step - 1
+                while True:
+                    # --- data wait: reader next + feed build ----------
                     t0 = time.perf_counter()
+                    batch = next(batches, _END_OF_DATA)
+                    data_wait = time.perf_counter() - t0
+                    if batch is _END_OF_DATA:
+                        break
+                    step_id += 1
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    # user handler time is neither data wait nor
+                    # host/device: excluded from the step clock so the
+                    # anatomy sum ~= trainer_step_seconds stays true
+                    th0 = time.perf_counter()
+                    event_handler(begin)
+                    handler_s = time.perf_counter() - th0
+                    tf = time.perf_counter()
                     feed = feeder.feed(batch)
+                    data_wait += time.perf_counter() - tf
                     with chaos.fault_point("trainer.step"):
+                        # --- host: dispatch without blocking ----------
+                        th = time.perf_counter()
                         if begin.fetch_metrics:
-                            metrics = self.exe.run(self.train_program,
+                            fetched = self.exe.run(self.train_program,
                                                    feed=feed,
-                                                   fetch_list=fetch)
+                                                   fetch_list=fetch,
+                                                   return_numpy=False)
                         else:
                             self.exe.run(self.train_program, feed=feed,
                                          fetch_list=[])
+                            fetched = []
+                        host_s = time.perf_counter() - th
+                        # --- device: block-until-ready + D2H copy ----
+                        td = time.perf_counter()
+                        if fetched:
+                            jax.block_until_ready(fetched)
+                            metrics = [self.exe.fetch_numpy(v)
+                                       for v in fetched]
+                        else:
                             metrics = []
+                        device_s = time.perf_counter() - td
                     metrics = chaos.poison("trainer.step", metrics)
-                    dt = time.perf_counter() - t0
+                    dt = time.perf_counter() - t0 - handler_s
                     _m_steps.inc()
                     _m_step_seconds.observe(dt)
+                    _m_data_wait_seconds.observe(data_wait)
+                    _m_host_seconds.observe(host_s)
+                    if fetched:
+                        # no-fetch steps (begin.fetch_metrics=False)
+                        # never block on the device; recording their ~0
+                        # would drown the real device distribution
+                        _m_device_seconds.observe(device_s)
+                    obs_trace.add_span("trainer.data_wait", t0, data_wait,
+                                       tid=obs_trace.TRAINER_TID,
+                                       cat="trainer")
+                    obs_trace.add_span("trainer.host", th, host_s,
+                                       tid=obs_trace.TRAINER_TID,
+                                       cat="trainer")
+                    obs_trace.add_span("trainer.device", td, device_s,
+                                       tid=obs_trace.TRAINER_TID,
+                                       cat="trainer")
+                    obs_server.note_trainer_step()
+                    self._note_anatomy(anatomy, data_wait, dt)
                     if dt > 0:
                         _m_examples_per_sec.set(len(batch) / dt)
                         self._record_mfu(dt)
@@ -371,7 +449,33 @@ class Trainer:
                             extra={"error": repr(e)[:500]})
             raise
         finally:
+            obs_server.note_trainer_running(False)
             self._restore_preemption_handlers(stop)
+
+    def _note_anatomy(self, anatomy: Dict, data_wait: float, dt: float):
+        """Accumulate the step anatomy and warn ONCE per train() when
+        the input pipeline dominates: cumulative data-wait above
+        ``input_bound_warn_fraction`` of cumulative step time after
+        enough steps for the evidence to mean something."""
+        anatomy["data_wait"] += data_wait
+        anatomy["step"] += dt
+        anatomy["n"] += 1
+        frac = float(flags.get_flag("input_bound_warn_fraction"))
+        if (frac > 0 and not anatomy["warned"]
+                and anatomy["n"] >= _INPUT_BOUND_MIN_STEPS
+                and anatomy["step"] > 0
+                and anatomy["data_wait"]
+                > _INPUT_BOUND_MIN_WAIT_S * anatomy["n"]
+                and anatomy["data_wait"] > frac * anatomy["step"]):
+            anatomy["warned"] = True
+            pct = 100.0 * anatomy["data_wait"] / anatomy["step"]
+            warnings.warn(
+                f"trainer is input-bound: data wait (reader next + feed "
+                f"build) is {pct:.0f}% of step time over {anatomy['n']} "
+                f"steps (threshold {100 * frac:.0f}%) — grow "
+                f"reader.buffered()/xmap_readers parallelism or move "
+                f"decode off the training host", RuntimeWarning,
+                stacklevel=3)
 
     # -- resilience plumbing (resilience/, docs/RESILIENCE.md) -------------
     def _record_mfu(self, dt: float):

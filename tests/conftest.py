@@ -36,11 +36,13 @@ def pytest_configure(config):
 
 @pytest.fixture(autouse=True)
 def fresh_programs():
-    """Each test gets fresh default programs + a fresh scope, and no
-    armed chaos spec leaking across tests."""
+    """Each test gets fresh default programs + a fresh scope, no armed
+    chaos spec leaking across tests, and no observability HTTP server
+    or trainer-liveness state surviving a case."""
     import paddle_tpu as pt
     from paddle_tpu.framework import executor as executor_mod
     from paddle_tpu.observability import costmodel, flight, forensics
+    from paddle_tpu.observability import server as obs_server
     from paddle_tpu.resilience import chaos
     pt.reset_default_programs()
     executor_mod._global_scope = executor_mod.Scope()
@@ -49,9 +51,11 @@ def fresh_programs():
     costmodel.reset()
     forensics.reset()
     flight.reset()
+    obs_server.reset()
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
+    obs_server.reset()
 
 
 @pytest.fixture

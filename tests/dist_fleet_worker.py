@@ -1,0 +1,90 @@
+"""Fleet-telemetry worker — one rank of the 2-rank end-to-end test in
+tests/test_fleet.py.
+
+Run:  python tests/dist_fleet_worker.py <master_host:port> <world> <rank> <out.json>
+
+Each process trains a tiny seeded classifier for 3 steps through the
+REAL Trainer loop (so trainer_steps_total, the step-anatomy histograms
+and trace spans are all produced by the instrumented path, not faked),
+then pushes one FleetReporter flush to the coordinator the TEST process
+owns (TaskMaster + FleetAggregator + HTTP endpoint), dumps its own
+per-rank chrome trace for the offline-merge check, and exits.  The test
+then makes ONE urllib scrape of the coordinator's /metrics and asserts
+the fleet-summed counters.
+"""
+import json
+import os
+import sys
+
+# repo root on sys.path (PYTHONPATH must stay unset — axon plugin quirk,
+# tests/conftest.py)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+STEPS = 3
+N, D_IN, CLS = 8, 6, 3
+
+
+def main():
+    master, world, rank, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    host, port = master.rsplit(":", 1)
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core import profiler
+    from paddle_tpu.observability import fleet, metrics as obs_metrics
+
+    def train_func():
+        x = layers.data("x", [D_IN], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        p = layers.fc(layers.fc(x, size=8, act="relu"), size=CLS,
+                      act="softmax")
+        return layers.mean(layers.cross_entropy(p, y))
+
+    def reader():
+        rng = np.random.RandomState(rank)
+        for _ in range(STEPS):
+            yield [(rng.rand(D_IN).astype("float32"),
+                    np.array([rng.randint(CLS)], "int64"))
+                   for _ in range(N)]
+
+    profiler.reset_profiler()
+    profiler.enable_profiler()
+    trainer = pt.Trainer(train_func=train_func,
+                         optimizer_func=lambda: pt.optimizer.SGD(0.1),
+                         place=pt.CPUPlace())
+    trainer.train(num_epochs=1, event_handler=lambda e: None,
+                  reader=reader, feed_order=["x", "y"])
+    trainer.stop()
+    profiler.disable_profiler()
+
+    # per-rank chrome dump for the offline --merge-traces path (same
+    # files a profiled dist run would leave behind)
+    trace_path = os.path.join(os.path.dirname(out_path),
+                              f"trace_rank{rank}.json")
+    profiler.export_chrome_trace(trace_path)
+
+    # one synchronous report (metrics snapshot + every recorded span),
+    # then the closing report stop() sends so the coordinator retires
+    # this rank instead of flagging it stale after we exit
+    reporter = fleet.FleetReporter(host, int(port), rank=rank)
+    reporter.flush()
+    reporter.stop()
+
+    steps = obs_metrics.REGISTRY.get("trainer_steps_total").value
+    anatomy = {
+        name: {"sum": obs_metrics.REGISTRY.get(name).sum,
+               "count": obs_metrics.REGISTRY.get(name).count}
+        for name in ("trainer_step_seconds", "trainer_data_wait_seconds",
+                     "trainer_host_seconds", "trainer_device_seconds")}
+    with open(out_path, "w") as f:
+        json.dump({"rank": rank, "steps": steps, "anatomy": anatomy,
+                   "trace_path": trace_path}, f)
+    print("FLEET_WORKER_OK", rank)
+
+
+if __name__ == "__main__":
+    main()

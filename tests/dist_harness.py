@@ -18,11 +18,16 @@ def free_port() -> int:
     return port
 
 
-def spawn_workers(script: str, world: int, tmp_path, timeout: int = 300):
+def spawn_workers(script: str, world: int, tmp_path, timeout: int = 300,
+                  coordinator: str = None):
     """Run `tests/<script>` in `world` rank processes sharing a fresh
     coordinator port; each rank writes JSON to its own out file.  Returns
-    the parsed results sorted by rank.  Asserts every worker exits 0."""
-    coordinator = f"127.0.0.1:{free_port()}"
+    the parsed results sorted by rank.  Asserts every worker exits 0.
+    Pass `coordinator` ("host:port") to point workers at a service the
+    TEST process owns (e.g. a task master / fleet aggregator) instead of
+    a fresh jax.distributed rendezvous port."""
+    if coordinator is None:
+        coordinator = f"127.0.0.1:{free_port()}"
     procs, outs = [], []
     for rank in range(world):
         out = str(tmp_path / f"{script}.{rank}.json")
