@@ -158,3 +158,19 @@ define_flag("retry_max_attempts", 3,
             "Default attempt budget for resilience.retry policies "
             "(task-queue RPC reconnects, transient checkpoint-save "
             "OSErrors).")
+
+# --- elastic fleet (distributed/: task_queue membership, supervisor) -------
+define_flag("worker_timeout", 6.0,
+            "Master-side heartbeat lease: a registered worker silent "
+            "for this many seconds is declared dead and every task "
+            "lease it holds is requeued immediately (no waiting out "
+            "per-task lease timeouts).")
+define_flag("worker_heartbeat_interval", 2.0,
+            "Seconds between a worker's membership heartbeats "
+            "(task_queue.Heartbeater).  Keep well under worker_timeout "
+            "(3x margin) so one dropped RPC doesn't read as death.")
+define_flag("max_worker_restarts", 3,
+            "Supervisor restart budget PER RANK: a worker crashing "
+            "more than this many times is declared failed for good "
+            "(distributed/supervisor.py; restarts back off "
+            "exponentially with deterministic jitter).")

@@ -1,3 +1,5 @@
 """Distributed coordination utilities (ref go/ layer of the reference)."""
 from .async_update import AsyncParameterServer, run_async_workers
-from .task_queue import Task, TaskMaster, TaskMasterClient, serve_master
+from .supervisor import Supervisor
+from .task_queue import (Heartbeater, Task, TaskMaster, TaskMasterClient,
+                         serve_master)

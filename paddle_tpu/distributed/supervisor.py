@@ -1,0 +1,235 @@
+"""Crash-restarting worker supervisor (the EDL controller capability).
+
+The reference's cloud era relied on the cluster (EDL/Kubernetes) to
+reschedule a trainer pod that died; this module is that loop for a
+single-host fleet: spawn one process per rank, watch them, and restart
+a crashed rank with capped exponential backoff — deterministic jitter
+via the same ``resilience/retry.py`` delay math the RPC layer uses, so
+a chaos run's full timeline (faults, backoff sleeps, restarts) replays
+from (spec, seed).
+
+A restarted worker gets the SAME argv (same rank): resuming from the
+newest valid checkpoint and re-registering its membership under that
+rank is the worker's job (see ``resilience/elastic_worker.py`` and the
+``task_queue.Heartbeater`` re-register loop).  The restart environment
+drops ``PTPU_CHAOS_SPEC`` by default: the chaos schedule is
+deterministic, so rerunning the incarnation that just died under the
+same spec would die at the same step forever — a restarted worker runs
+clean unless ``restart_env`` says otherwise.  Each incarnation sees its
+restart ordinal in ``PTPU_WORKER_RESTART_COUNT``.
+
+Metrics: ``worker_restarts_total{rank}``; per-rank terminal states via
+:meth:`Supervisor.status`.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core import flags
+from ..observability import flight as obs_flight
+from ..observability import metrics as obs_metrics
+from ..resilience import retry as rretry
+
+_m_restarts = obs_metrics.counter(
+    "worker_restarts_total",
+    "Workers restarted by the supervisor after a crash, by rank.",
+    ("rank",))
+
+_POLL = 0.05
+
+
+class Supervisor:
+    """Spawn + babysit one subprocess per rank.
+
+    ``cmds[rank]`` is the argv for that rank; ``envs[rank]`` (optional)
+    overlays the base ``env``.  A rank exiting 0 is done; nonzero (or a
+    signal) schedules a restart after ``backoff.delay(attempt)`` —
+    until ``max_restarts`` (``max_worker_restarts`` flag) is spent, at
+    which point the rank is failed for good.  ``wait()`` returns True
+    only when EVERY rank finished cleanly."""
+
+    def __init__(self, cmds: List[List[str]],
+                 env: Optional[Dict[str, str]] = None,
+                 envs: Optional[List[Optional[Dict[str, str]]]] = None,
+                 cwd: Optional[str] = None,
+                 max_restarts: Optional[int] = None,
+                 backoff: Optional[rretry.RetryPolicy] = None,
+                 restart_env: Optional[Dict[str, str]] = None,
+                 log_dir: Optional[str] = None):
+        self.cmds = [list(c) for c in cmds]
+        self.env = dict(os.environ if env is None else env)
+        self.envs = list(envs) if envs is not None \
+            else [None] * len(cmds)
+        self.cwd = cwd
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else flags.get_flag("max_worker_restarts"))
+        self.backoff = backoff or rretry.RetryPolicy(
+            name="supervisor_restart", max_attempts=1,
+            base_delay=0.1, max_delay=5.0)
+        # default: a restarted incarnation runs with chaos DISARMED —
+        # deterministic schedules mean the same spec kills it at the
+        # same step again, turning every injected death into a crash
+        # loop that burns the whole restart budget
+        self.restart_env = {"PTPU_CHAOS_SPEC": ""} \
+            if restart_env is None else dict(restart_env)
+        self.log_dir = log_dir
+        self.restarts: Dict[int, int] = {r: 0 for r in range(len(cmds))}
+        self._procs: Dict[int, Optional[subprocess.Popen]] = {}
+        self._logs: Dict[int, object] = {}
+        # rank -> "running" | "restarting" | "done" | "failed"
+        self._state: Dict[int, str] = {}
+        self._rc: Dict[int, Optional[int]] = {}
+        self._restart_at: Dict[int, float] = {}
+        self._stop = threading.Event()
+        self._all_done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- spawning ---------------------------------------------------------
+    def _env_for(self, rank: int, incarnation: int) -> Dict[str, str]:
+        env = dict(self.env)
+        if self.envs[rank]:
+            env.update(self.envs[rank])
+        if incarnation > 0:
+            env.update(self.restart_env)
+        env["PTPU_WORKER_RESTART_COUNT"] = str(incarnation)
+        return env
+
+    def _spawn(self, rank: int):
+        incarnation = self.restarts[rank]
+        out = subprocess.DEVNULL
+        if self.log_dir:
+            # one append-mode log per rank, incarnations concatenated —
+            # the crash line and the restart's first line sit together
+            if rank not in self._logs:
+                self._logs[rank] = open(
+                    os.path.join(self.log_dir, f"worker_r{rank}.log"),
+                    "ab")
+            out = self._logs[rank]
+        self._procs[rank] = subprocess.Popen(
+            self.cmds[rank], env=self._env_for(rank, incarnation),
+            cwd=self.cwd, stdout=out, stderr=subprocess.STDOUT)
+        self._state[rank] = "running"
+
+    def start(self) -> "Supervisor":
+        if self._thread is not None:
+            return self
+        for rank in range(len(self.cmds)):
+            self._spawn(rank)
+        self._thread = threading.Thread(target=self._monitor,
+                                        daemon=True, name="supervisor")
+        self._thread.start()
+        return self
+
+    # -- monitor loop -----------------------------------------------------
+    def _monitor(self):
+        while not self._stop.is_set():
+            try:
+                with self._lock:
+                    self._scan()
+                    states = set(self._state.values())
+            except Exception as e:
+                # the monitor thread must never die silently: a dead
+                # monitor means crashes go unrestarted and wait() hangs
+                # for its full timeout with no diagnosis
+                obs_flight.record("supervisor", "monitor_error",
+                                  error=repr(e)[:200])
+                self._stop.wait(_POLL)
+                continue
+            if states <= {"done", "failed"}:
+                self._all_done.set()
+                return
+            self._stop.wait(_POLL)
+
+    def _scan(self):
+        now = time.time()
+        for rank, proc in self._procs.items():
+            state = self._state[rank]
+            if state == "restarting":
+                if now >= self._restart_at[rank]:
+                    try:
+                        self._spawn(rank)
+                    except OSError as e:
+                        # a failed respawn (exec/fd error) is terminal
+                        # for the rank, not for the supervisor
+                        self._state[rank] = "failed"
+                        obs_flight.record("supervisor", "spawn_failed",
+                                          rank=rank,
+                                          error=repr(e)[:200])
+                continue
+            if state != "running" or proc is None:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            self._rc[rank] = rc
+            if rc == 0:
+                self._state[rank] = "done"
+                continue
+            if self.restarts[rank] >= self.max_restarts:
+                self._state[rank] = "failed"
+                obs_flight.record("supervisor", "worker_failed",
+                                  rank=rank, rc=rc,
+                                  restarts=self.restarts[rank])
+                continue
+            self.restarts[rank] += 1
+            attempt = self.restarts[rank]
+            delay = self.backoff.delay(attempt)
+            self._restart_at[rank] = now + delay
+            self._state[rank] = "restarting"
+            _m_restarts.labels(rank=str(rank)).inc()
+            obs_flight.record("supervisor", "worker_restart",
+                              rank=rank, rc=rc, attempt=attempt,
+                              delay=round(delay, 4))
+
+    # -- public surface ---------------------------------------------------
+    def status(self) -> Dict[int, dict]:
+        with self._lock:
+            return {rank: {"state": self._state.get(rank, "pending"),
+                           "restarts": self.restarts[rank],
+                           "rc": self._rc.get(rank)}
+                    for rank in range(len(self.cmds))}
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every rank is terminal (done/failed); True only
+        when ALL exited 0."""
+        finished = self._all_done.wait(timeout)
+        if not finished:
+            return False
+        st = self.status()
+        return all(s["state"] == "done" for s in st.values())
+
+    def stop(self, kill: bool = True):
+        """Stop monitoring; kill whatever is still running."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if kill:
+            for proc in self._procs.values():
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+            for proc in self._procs.values():
+                if proc is not None:
+                    try:
+                        proc.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        pass
+        for f in self._logs.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._logs.clear()
+
+    def __enter__(self) -> "Supervisor":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

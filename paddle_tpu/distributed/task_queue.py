@@ -8,11 +8,38 @@ retries), marks completions (`TaskFinished:411`), and persists queue state
 so a restarted master resumes where it left off (etcd snapshot `:207`,
 recover `:166`).
 
-TPU-native redesign: no etcd — state snapshots to a JSON file with atomic
-rename (the same CRC-and-rename discipline as go/pserver/service.go:346);
-transport is a thread-per-connection JSON-lines TCP server (the Go RPC
-layer's role), so trainers on any host of the pod can lease work.  For
-preemption-tolerant TPU training the master runs on the coordinator host.
+TPU-native redesign: no etcd — state snapshots to a CRC-framed JSON file
+with atomic rename (the same CRC-and-rename discipline as
+go/pserver/service.go:346); transport is a thread-per-connection
+JSON-lines TCP server (the Go RPC layer's role), so trainers on any host
+of the pod can lease work.  For preemption-tolerant TPU training the
+master runs on the coordinator host.
+
+Elastic-fleet semantics (the etcd lease half of the reference's EDL era):
+
+* **Fenced leases** — ``get_task`` mints a lease token carried on the
+  returned :class:`Task`; ``task_finished``/``task_failed`` must present
+  it.  An ack whose lease is no longer CURRENT (expired and re-leased,
+  requeued after the holder died, or minted under a previous master
+  generation) is rejected with status ``"fenced"`` — a zombie worker can
+  no longer complete a task another worker now owns (the etcd
+  lease-fencing discipline).
+* **Master generations** — every restart/recovery bumps a persisted
+  generation number (``master_generation`` gauge); all RPC replies carry
+  it, so a pre-restart client *detects* the new world (its leases are
+  void) instead of acking into it.
+* **Worker membership** — ``register_worker``/``heartbeat``/``goodbye``.
+  A worker whose heartbeat lease expires is declared dead and ALL its
+  outstanding task leases requeue immediately — no waiting out per-task
+  timeouts.  Membership transitions notify listeners (the
+  FleetAggregator, wired by ``serve_master(aggregator=...)``) and drive
+  the ``fleet_workers{state}`` gauges.
+* **Completion ledger** — accepted completions append to a persisted
+  ledger of (task_id, epoch, worker, lease); with fencing this is the
+  exactly-once-per-epoch record the elastic e2e/soak lanes verify.
+* **Failover** — :class:`TaskMasterClient` accepts a list of endpoints
+  and rotates on connect failure; ``serve_master`` restart recovers from
+  the snapshot (leases void, generation bumped) and the fleet continues.
 """
 from __future__ import annotations
 
@@ -22,10 +49,14 @@ import socket
 import socketserver
 import threading
 import time
+import warnings
 import weakref
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+from ..core import flags
+from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
 
 MAX_FAILURES = 3          # ref service.go failureMax
@@ -43,6 +74,30 @@ _m_lease_expired = obs_metrics.counter(
     "taskmaster_lease_expired_total",
     "Task leases that expired and were requeued (or moved to "
     "failed_forever at the retry limit).")
+_m_fenced = obs_metrics.counter(
+    "fenced_rpcs_total",
+    "RPCs rejected because their lease was no longer current (expired "
+    "and re-leased, requeued after worker death, or minted under a "
+    "previous master generation), by verb.", ("verb",))
+_m_generation = obs_metrics.gauge(
+    "master_generation",
+    "Persisted generation of this process's TaskMaster — bumped on "
+    "every restart/recovery; leases minted under an older generation "
+    "are fenced.")
+_m_snapshot_corrupt = obs_metrics.counter(
+    "taskmaster_snapshot_corrupt_total",
+    "Master snapshots that failed CRC/parse at recovery; the master "
+    "fell back to a fresh state instead of bricking the restart.")
+_m_fleet_workers = obs_metrics.gauge(
+    "fleet_workers",
+    "Task-master worker membership by state (live/dead/departed).",
+    ("state",))
+_m_workers_dead = obs_metrics.counter(
+    "taskmaster_workers_dead_total",
+    "Workers declared dead after heartbeat-lease expiry; their "
+    "outstanding task leases were requeued immediately.")
+
+_WORKER_STATES = ("live", "dead", "departed")
 
 # live masters in this process, for scrape-time refresh: queue gauges
 # otherwise only move on RPC mutations, and a fleet whose workers all
@@ -51,10 +106,25 @@ _MASTERS: "weakref.WeakSet[TaskMaster]" = weakref.WeakSet()
 
 
 def refresh_metrics():
-    """Re-publish queue gauges (running lease expiry) for every live
-    TaskMaster — called by the /metrics endpoint before rendering."""
-    for m in list(_MASTERS):
+    """Re-publish queue gauges (running lease/heartbeat expiry) for
+    every live TaskMaster — called by the /metrics endpoint before
+    rendering.  Oldest generation first: when a superseded master
+    object is still referenced in-process (the restart-in-tests case),
+    the LIVE master's gauges must land last and win — WeakSet iteration
+    order would otherwise pick the winner at random."""
+    for m in sorted(list(_MASTERS), key=lambda m: m.generation):
         m.stats()
+
+
+def reset_state():
+    """Test hook (tests/conftest.py): forget every master registered in
+    this process and zero the membership/queue gauges, so a dead test's
+    master can't re-publish stale series into the next test's scrape."""
+    for m in list(_MASTERS):
+        _MASTERS.discard(m)
+    _m_tasks.reset()
+    _m_fleet_workers.reset()
+    _m_generation.reset()
 
 
 @dataclass
@@ -63,6 +133,10 @@ class Task:
     shards: List[str]
     epoch: int = 0
     failures: int = 0
+    # current lease token while the task is pending (rides the RPC so
+    # the holder can present it at task_finished/task_failed); None
+    # whenever the task sits in a queue
+    lease: Optional[str] = None
 
 
 class TaskMaster:
@@ -70,23 +144,70 @@ class TaskMaster:
 
     def __init__(self, snapshot_path: Optional[str] = None,
                  lease_timeout: float = DEFAULT_TIMEOUT,
-                 snapshot_interval: float = 0.5):
+                 snapshot_interval: float = 0.5,
+                 worker_timeout: Optional[float] = None,
+                 num_epochs: int = 0,
+                 max_failures: int = MAX_FAILURES):
         self._lock = threading.Lock()
         self.snapshot_path = snapshot_path
         self.lease_timeout = lease_timeout
         # throttle: snapshots are recovery hints (pending leases are void
         # on restart anyway), so per-op durability buys nothing — write at
-        # most every snapshot_interval seconds
+        # most every snapshot_interval seconds.  0 = durable (every
+        # mutation), which the exactly-once ledger guarantees assume
+        # across master restarts.
         self.snapshot_interval = snapshot_interval
         self._last_snapshot = 0.0
+        # heartbeat lease: a worker silent past this is dead and its
+        # task leases requeue immediately
+        self.worker_timeout = float(
+            worker_timeout if worker_timeout is not None
+            else flags.get_flag("worker_timeout"))
+        # 0 = endless epoch rollover (legacy); N > 0 = the job completes
+        # once every task has been finished in epochs 0..N-1
+        self.num_epochs = int(num_epochs)
+        self.max_failures = int(max_failures)
         self.todo: List[Task] = []
-        self.pending: Dict[int, dict] = {}   # task_id -> {task, deadline}
+        self.pending: Dict[int, dict] = {}   # id -> {task, deadline,
+        #                                            lease, worker}
         self.done: List[Task] = []
         self.failed_forever: List[Task] = []
         self._next_id = 0
-        if snapshot_path and os.path.exists(snapshot_path):
+        self._lease_seq = 0
+        self.generation = 1
+        # rank -> {lease, deadline, state, host, pid}
+        self.workers: Dict[int, dict] = {}
+        # accepted completions: the exactly-once record
+        self.ledger: List[dict] = []
+        self._listeners: List[Callable[[int, str, dict], None]] = []
+        if snapshot_path and (os.path.exists(snapshot_path)
+                              or os.path.exists(snapshot_path + ".gen")):
             self._recover()
+            self._snapshot(force=True)
+        if snapshot_path:
+            # even a FRESH master persists its generation: the sidecar
+            # must exist before the first restart, or a restart whose
+            # snapshot is corrupt would restart the fence epoch at 1
+            self._persist_generation()
         _MASTERS.add(self)
+        _m_generation.set(self.generation)
+
+    # -- membership listeners ---------------------------------------------
+    def add_membership_listener(self,
+                                fn: Callable[[int, str, dict], None]):
+        """fn(rank, state, info) fires on live/dead/departed transitions
+        (outside the master lock)."""
+        self._listeners.append(fn)
+
+    def _emit(self, events: List[Tuple[int, str, dict]]):
+        """Deliver membership events collected under the lock — called
+        AFTER releasing it (listeners take their own locks)."""
+        for rank, state, info in events:
+            for fn in self._listeners:
+                try:
+                    fn(rank, state, **info)
+                except Exception:
+                    pass     # telemetry must not take the master down
 
     # -- dataset ----------------------------------------------------------
     def set_dataset(self, shard_paths: List[str], shards_per_task: int = 1):
@@ -102,76 +223,293 @@ class TaskMaster:
             self._publish_gauges()
 
     # -- trainer API ------------------------------------------------------
-    def get_task(self) -> Optional[Task]:
-        """Lease a task (ref GetTask:368); None => drained or all leased."""
+    def _mint_lease(self) -> str:
+        self._lease_seq += 1
+        return f"{self.generation}-{self._lease_seq}"
+
+    def get_task(self, worker: Optional[int] = None) -> Optional[Task]:
+        """Lease a task (ref GetTask:368); None => drained or all
+        leased.  The returned task carries its lease token; ``worker``
+        ties the lease to a registered rank so worker death requeues it
+        immediately."""
         with self._lock:
-            self._requeue_expired()
+            events = self._reap()
             if not self.todo:
                 self._publish_gauges()
-                return None
-            t = self.todo.pop(0)
-            self.pending[t.task_id] = {
-                "task": t, "deadline": time.time() + self.lease_timeout}
-            self._snapshot()
-            self._publish_gauges()
-            return t
+                t = None
+            else:
+                t = self.todo.pop(0)
+                t.lease = self._mint_lease()
+                self.pending[t.task_id] = {
+                    "task": t, "lease": t.lease,
+                    "worker": None if worker is None else int(worker),
+                    "deadline": time.time() + self.lease_timeout}
+                self._snapshot()
+                self._publish_gauges()
+                # hand back a COPY: the queue's Task mutates when the
+                # lease expires and the task re-leases, and an aliased
+                # caller would see its (stale) lease token silently
+                # replaced by the new owner's — defeating the fence
+                t = Task(t.task_id, list(t.shards), t.epoch,
+                         t.failures, t.lease)
+        self._emit(events)
+        return t
 
-    def task_finished(self, task_id: int) -> bool:
-        """ref TaskFinished:411."""
+    def _complete(self) -> bool:
+        """Call under the lock — see :attr:`complete`."""
+        if self.num_epochs <= 0 or self.todo or self.pending:
+            return False
+        if not self.done and not self.failed_forever:
+            return False
+        return all(t.epoch >= self.num_epochs - 1 for t in self.done)
+
+    @property
+    def complete(self) -> bool:
+        """True when a bounded job (num_epochs > 0) has drained: nothing
+        queued or leased and every surviving task finished its final
+        epoch (tasks parked in failed_forever no longer block — the
+        ledger check downstream flags the gap).  Takes the lock: a
+        lock-free read could catch a mutation mid-flight (task popped
+        from pending, not yet back on todo) and tell a worker the job
+        is done while work remains."""
         with self._lock:
-            ent = self.pending.pop(task_id, None)
-            if ent is None:
-                return False
-            self.done.append(ent["task"])
-            self._maybe_rollover()
-            self._snapshot()
-            self._publish_gauges()
-            return True
+            return self._complete()
+
+    def _fence(self, verb: str, lease, task_id=None, rank=None) -> str:
+        _m_fenced.labels(verb=verb).inc()
+        obs_flight.record("task_queue", "fenced", verb=verb,
+                          task_id=task_id, rank=rank, lease=lease,
+                          gen=self.generation)
+        return "fenced"
+
+    def _ack(self, verb: str, task_id: int,
+             lease: Optional[str]) -> Tuple[str, Optional[dict]]:
+        """Shared fencing gate for task_finished/task_failed (call under
+        the lock): returns (status, pending-entry-or-None).  The entry is
+        popped only on "ok"."""
+        ent = self.pending.get(task_id)
+        if ent is None:
+            if lease is not None:
+                # at-least-once delivery: a completion the master
+                # accepted whose REPLY was lost is re-sent with the same
+                # lease — the ledger proves it landed, so re-ack "ok"
+                # instead of fencing (a fence would make the worker
+                # treat recorded work as lost)
+                if verb == "task_finished" and any(
+                        e["task_id"] == task_id and e["lease"] == lease
+                        for e in self.ledger):
+                    return "ok", None
+                # otherwise a stale ack from a voided lease (expired +
+                # requeued, worker declared dead, or a previous master
+                # generation) — fence it; the legacy lease-less form
+                # keeps its old "unknown" contract
+                return self._fence(verb, lease, task_id=task_id), None
+            return "unknown", None
+        if lease is not None and lease != ent["lease"]:
+            # the task was re-leased to someone else: the new owner is
+            # still working it — the zombie's ack must not complete it
+            return self._fence(verb, lease, task_id=task_id), None
+        return "ok", self.pending.pop(task_id)
+
+    def task_finished(self, task_id: int, lease: Optional[str] = None,
+                      worker: Optional[int] = None) -> str:
+        """ref TaskFinished:411, fenced: returns "ok" | "fenced" |
+        "unknown".  Only the CURRENT lease holder can complete a task;
+        an accepted completion lands in the persisted ledger.
+        Idempotent under retry: a duplicate delivery of an accepted
+        completion (same task, same lease) re-acks "ok" without a
+        second ledger entry."""
+        with self._lock:
+            status, ent = self._ack("task_finished", task_id, lease)
+            if status == "ok" and ent is not None:
+                t = ent["task"]
+                self.ledger.append({
+                    "task_id": t.task_id, "epoch": t.epoch,
+                    "worker": ent["worker"] if worker is None else worker,
+                    "lease": ent["lease"], "time_unix": time.time()})
+                t.lease = None
+                self.done.append(t)
+                self._maybe_rollover()
+                self._snapshot()
+                self._publish_gauges()
+        return status
 
     def _maybe_rollover(self):
         """Epoch rollover: when no work is outstanding, recycle done tasks
         for the next pass (ref master re-queues).  Shared by every path
         that can drain the queue — finish, failure, and lease expiry —
-        so a final failed task can't strand the done list forever."""
+        so a final failed task can't strand the done list forever.
+        Bounded jobs (num_epochs > 0) stop recycling after the final
+        epoch; the done list becomes the job's terminal state."""
         if not self.todo and not self.pending and self.done:
+            if self.num_epochs > 0 and \
+                    min(t.epoch for t in self.done) + 1 >= self.num_epochs:
+                return
             for t in self.done:
                 t.epoch += 1
                 t.failures = 0
+                t.lease = None
             self.todo = self.done
             self.done = []
 
-    def task_failed(self, task_id: int) -> bool:
-        """ref TaskFailed:455 — requeue up to MAX_FAILURES."""
+    def task_failed(self, task_id: int, lease: Optional[str] = None) -> str:
+        """ref TaskFailed:455 — requeue up to max_failures; fenced like
+        task_finished."""
         with self._lock:
-            ent = self.pending.pop(task_id, None)
-            if ent is None:
-                return False
-            t = ent["task"]
-            t.failures += 1
-            if t.failures >= MAX_FAILURES:
+            status, ent = self._ack("task_failed", task_id, lease)
+            if status == "ok":
+                t = ent["task"]
+                t.lease = None
+                t.failures += 1
+                if t.failures >= self.max_failures:
+                    self.failed_forever.append(t)
+                else:
+                    self.todo.append(t)
+                self._maybe_rollover()
+                self._snapshot()
+                self._publish_gauges()
+        return status
+
+    # -- worker membership -------------------------------------------------
+    def register_worker(self, rank: int, host: Optional[str] = None,
+                        pid: Optional[int] = None) -> dict:
+        """Enroll (or re-enroll) a rank.  A re-registration supersedes
+        any previous incarnation: its heartbeat lease is replaced and
+        task leases it still held are requeued (the old incarnation is
+        presumed dead; if it is merely slow, its acks fence)."""
+        rank = int(rank)
+        with self._lock:
+            events = self._reap()
+            prev = self.workers.get(rank)
+            if prev is not None and prev["state"] == "live":
+                self._requeue_worker_tasks(rank)
+            lease = self._mint_lease()
+            self.workers[rank] = {
+                "lease": lease, "state": "live",
+                "deadline": time.time() + self.worker_timeout,
+                "host": host, "pid": pid}
+            events.append((rank, "live", {"host": host, "pid": pid}))
+            self._snapshot()
+            self._publish_gauges()
+        self._emit(events)
+        return {"lease": lease, "worker_timeout": self.worker_timeout}
+
+    def heartbeat(self, rank: int, lease: Optional[str]) -> str:
+        """Extend a rank's heartbeat lease; "fenced" when the rank is
+        unknown, declared dead, or presents a stale lease — the worker
+        must re-register (the post-master-restart / zombie path)."""
+        rank = int(rank)
+        with self._lock:
+            events = self._reap()
+            w = self.workers.get(rank)
+            if w is None or w["state"] != "live" or w["lease"] != lease:
+                status = self._fence("heartbeat", lease, rank=rank)
+            else:
+                w["deadline"] = time.time() + self.worker_timeout
+                status = "ok"
+        self._emit(events)
+        return status
+
+    def goodbye(self, rank: int, lease: Optional[str]) -> str:
+        """Clean departure: the rank is retired (no death alarm) and any
+        leftover task leases return to the queue without a failure
+        mark."""
+        rank = int(rank)
+        with self._lock:
+            events = self._reap()
+            w = self.workers.get(rank)
+            if w is None or w["lease"] != lease:
+                status = self._fence("goodbye", lease, rank=rank)
+            else:
+                w["state"] = "departed"
+                self._requeue_worker_tasks(rank, count_failure=False)
+                events.append((rank, "departed", {}))
+                self._snapshot()
+                self._publish_gauges()
+                status = "ok"
+        self._emit(events)
+        return status
+
+    def tick(self):
+        """Run lease/heartbeat expiry — the reaper thread's body (also
+        piggybacked on every queue RPC and metrics scrape)."""
+        with self._lock:
+            events = self._reap()
+            self._publish_gauges()
+        self._emit(events)
+
+    def _reap(self) -> List[Tuple[int, str, dict]]:
+        """Expire task leases AND heartbeat leases (call under the
+        lock); returns membership events to emit after release."""
+        self._requeue_expired()
+        now = time.time()
+        events: List[Tuple[int, str, dict]] = []
+        for rank, w in self.workers.items():
+            if w["state"] == "live" and w["deadline"] < now:
+                # heartbeat lease expired: the worker is dead — every
+                # task lease it holds requeues NOW, not when each
+                # per-task timeout eventually fires
+                w["state"] = "dead"
+                _m_workers_dead.inc()
+                obs_flight.record("task_queue", "worker_dead", rank=rank)
+                self._requeue_worker_tasks(rank)
+                events.append((rank, "dead",
+                               {"host": w.get("host"),
+                                "pid": w.get("pid")}))
+        if events:
+            self._snapshot()
+            self._publish_gauges()
+        return events
+
+    def _requeue_worker_tasks(self, rank: int, count_failure: bool = True):
+        """Return every pending lease held by `rank` to the queue (call
+        under the lock)."""
+        held = [tid for tid, e in self.pending.items()
+                if e["worker"] == rank]
+        for tid in held:
+            t = self.pending.pop(tid)["task"]
+            t.lease = None
+            if count_failure:
+                t.failures += 1
+            if t.failures >= self.max_failures:
                 self.failed_forever.append(t)
             else:
                 self.todo.append(t)
+        if held:
             self._maybe_rollover()
-            self._snapshot()
-            self._publish_gauges()
-            return True
 
     def stats(self) -> dict:
         with self._lock:
-            self._requeue_expired()
+            events = self._reap()
             self._publish_gauges()
-            return {"todo": len(self.todo), "pending": len(self.pending),
-                    "done": len(self.done),
-                    "failed_forever": len(self.failed_forever)}
+            out = {"todo": len(self.todo), "pending": len(self.pending),
+                   "done": len(self.done),
+                   "failed_forever": len(self.failed_forever),
+                   "generation": self.generation,
+                   "complete": self._complete(),
+                   "ledger": len(self.ledger),
+                   "workers": {str(r): w["state"]
+                               for r, w in sorted(self.workers.items())}}
+        self._emit(events)
+        return out
+
+    def ledger_entries(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self.ledger]
 
     # -- internals --------------------------------------------------------
     def _publish_gauges(self):
-        """Queue-state gauges (call under the lock)."""
+        """Queue-state + membership gauges (call under the lock)."""
         for state, q in (("todo", self.todo), ("done", self.done),
                          ("failed_forever", self.failed_forever)):
             _m_tasks.labels(state=state).set(len(q))
         _m_tasks.labels(state="pending").set(len(self.pending))
+        counts = {s: 0 for s in _WORKER_STATES}
+        for w in self.workers.values():
+            counts[w["state"]] = counts.get(w["state"], 0) + 1
+        for state, n in counts.items():
+            _m_fleet_workers.labels(state=state).set(n)
+        _m_generation.set(self.generation)
 
     def _requeue_expired(self):
         """Lease timeout -> back on the queue (ref checkTimeoutFunc:341)."""
@@ -180,8 +518,9 @@ class TaskMaster:
                    if e["deadline"] < now]
         for tid in expired:
             t = self.pending.pop(tid)["task"]
+            t.lease = None
             t.failures += 1
-            if t.failures >= MAX_FAILURES:
+            if t.failures >= self.max_failures:
                 self.failed_forever.append(t)
             else:
                 self.todo.append(t)
@@ -190,34 +529,118 @@ class TaskMaster:
             self._maybe_rollover()
             self._publish_gauges()
 
-    def _snapshot(self, force: bool = False):
-        if not self.snapshot_path:
-            return
-        now = time.time()
-        if not force and now - self._last_snapshot < self.snapshot_interval:
-            return
-        self._last_snapshot = now
-        state = {
+    def _state_doc(self) -> dict:
+        return {
             "next_id": self._next_id,
+            "generation": self.generation,
+            "num_epochs": self.num_epochs,
             "todo": [t.__dict__ for t in self.todo],
             # pending tasks snapshot back into todo: on master restart
             # their leases are void anyway (ref recover semantics)
             "pending": [e["task"].__dict__ for e in self.pending.values()],
             "done": [t.__dict__ for t in self.done],
             "failed_forever": [t.__dict__ for t in self.failed_forever],
+            "ledger": self.ledger,
         }
+
+    def _snapshot(self, force: bool = False):
+        if not self.snapshot_path:
+            return
+        now = time.time()
+        if not force and self.snapshot_interval > 0 \
+                and now - self._last_snapshot < self.snapshot_interval:
+            return
+        self._last_snapshot = now
+        # CRC-framed (go/pserver/service.go:346): the state dict is
+        # serialized once, CRC'd as bytes, and wrapped — a bit flip (not
+        # just a truncation) is detected at recovery
+        payload = json.dumps(self._state_doc())
+        doc = {"v": 2, "crc": zlib.crc32(payload.encode()),
+               "state": payload}
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(state, f)
+            json.dump(doc, f)
         os.replace(tmp, self.snapshot_path)   # atomic (ref service.go:346)
 
-    def _recover(self):
+    def _persist_generation(self):
+        """The generation survives OUTSIDE the snapshot (tiny sidecar,
+        atomic rename): a corrupt snapshot must not also reset the fence
+        epoch — stale-lease detection matters MOST on an ugly restart."""
+        if not self.snapshot_path:
+            return
+        tmp = self.snapshot_path + ".gen.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self.generation))
+        os.replace(tmp, self.snapshot_path + ".gen")
+
+    def _read_snapshot_state(self) -> Optional[dict]:
+        """Parse + CRC-verify the snapshot; None when absent.  Raises on
+        corruption (caught by _recover)."""
+        if not os.path.exists(self.snapshot_path):
+            return None
         with open(self.snapshot_path) as f:
-            state = json.load(f)
-        self._next_id = state["next_id"]
-        self.todo = [Task(**d) for d in state["todo"] + state["pending"]]
-        self.done = [Task(**d) for d in state["done"]]
-        self.failed_forever = [Task(**d) for d in state["failed_forever"]]
+            doc = json.load(f)
+        if isinstance(doc, dict) and "crc" in doc:
+            payload = doc["state"]
+            if zlib.crc32(payload.encode()) != doc["crc"]:
+                raise ValueError("snapshot CRC mismatch (torn or "
+                                 "bit-flipped write)")
+            return json.loads(payload)
+        if isinstance(doc, dict) and "next_id" in doc:
+            return doc           # pre-generation legacy snapshot
+        raise ValueError("snapshot has neither CRC framing nor legacy "
+                         "queue fields")
+
+    def _recover(self):
+        """Restore queue state and bump the generation.  A truncated /
+        bit-flipped snapshot falls back to a FRESH state with a loud
+        warning instead of bricking the restart — recovery failing at
+        exactly the moment recovery matters is the one unacceptable
+        outcome (satellite: taskmaster_snapshot_corrupt_total)."""
+        prev_gen = 0
+        gen_path = self.snapshot_path + ".gen"
+        try:
+            with open(gen_path) as f:
+                prev_gen = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            pass
+        state = None
+        try:
+            state = self._read_snapshot_state()
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            _m_snapshot_corrupt.inc()
+            obs_flight.record("task_queue", "snapshot_corrupt",
+                              error=repr(e)[:200])
+            warnings.warn(
+                f"task master snapshot {self.snapshot_path!r} is corrupt "
+                f"({e}); recovering with a FRESH queue state — dataset "
+                f"must be re-set and completed work this snapshot "
+                f"recorded will re-run", RuntimeWarning, stacklevel=3)
+        if state is not None:
+            try:
+                self._next_id = state["next_id"]
+                self.todo = [Task(**d)
+                             for d in state["todo"] + state["pending"]]
+                for t in self.todo:
+                    t.lease = None       # pre-restart leases are void
+                self.done = [Task(**d) for d in state["done"]]
+                self.failed_forever = [Task(**d)
+                                       for d in state["failed_forever"]]
+                self.ledger = list(state.get("ledger", []))
+                if self.num_epochs == 0:
+                    self.num_epochs = int(state.get("num_epochs", 0))
+                prev_gen = max(prev_gen, int(state.get("generation", 0)))
+            except (KeyError, TypeError, ValueError) as e:
+                _m_snapshot_corrupt.inc()
+                warnings.warn(
+                    f"task master snapshot {self.snapshot_path!r} parsed "
+                    f"but has invalid fields ({e}); recovering with a "
+                    f"FRESH queue state", RuntimeWarning, stacklevel=3)
+                self.todo, self.done, self.failed_forever = [], [], []
+                self.ledger, self._next_id = [], 0
+        # the fence epoch: anything minted before this restart is stale
+        self.generation = prev_gen + 1
 
 
 # -- TCP transport (JSON lines) -------------------------------------------
@@ -230,18 +653,39 @@ class _Handler(socketserver.StreamRequestHandler):
                 req = json.loads(line)
                 method = req["method"]
                 if method == "get_task":
-                    t = master.get_task()
-                    resp = {"ok": True, "task": t.__dict__ if t else None}
+                    t = master.get_task(worker=req.get("worker"))
+                    resp = {"ok": True,
+                            "task": t.__dict__ if t else None,
+                            "complete": master.complete}
                 elif method == "task_finished":
-                    resp = {"ok": master.task_finished(req["task_id"])}
+                    st = master.task_finished(req["task_id"],
+                                              lease=req.get("lease"),
+                                              worker=req.get("worker"))
+                    resp = {"ok": st == "ok", "status": st}
                 elif method == "task_failed":
-                    resp = {"ok": master.task_failed(req["task_id"])}
+                    st = master.task_failed(req["task_id"],
+                                            lease=req.get("lease"))
+                    resp = {"ok": st == "ok", "status": st}
+                elif method == "register_worker":
+                    resp = {"ok": True,
+                            **master.register_worker(
+                                req["rank"], host=req.get("host"),
+                                pid=req.get("pid"))}
+                elif method == "heartbeat":
+                    st = master.heartbeat(req["rank"], req.get("lease"))
+                    resp = {"ok": st == "ok", "status": st}
+                elif method == "goodbye":
+                    st = master.goodbye(req["rank"], req.get("lease"))
+                    resp = {"ok": st == "ok", "status": st}
                 elif method == "set_dataset":
                     master.set_dataset(req["shards"],
                                        req.get("shards_per_task", 1))
                     resp = {"ok": True}
                 elif method == "stats":
                     resp = {"ok": True, "stats": master.stats()}
+                elif method == "ledger":
+                    resp = {"ok": True,
+                            "ledger": master.ledger_entries()}
                 elif method in ("report_metrics", "report_events"):
                     # fleet telemetry verbs (observability/fleet.py):
                     # workers push snapshots/spans to the aggregator
@@ -257,6 +701,10 @@ class _Handler(socketserver.StreamRequestHandler):
                         resp = {"ok": True, **(ack or {})}
                 else:
                     resp = {"ok": False, "error": f"bad method {method}"}
+                # every reply names the master generation: a client that
+                # sees it change KNOWS its leases are void and re-fetches
+                # instead of acking into the new world
+                resp.setdefault("gen", master.generation)
             except Exception as e:   # keep the server alive
                 resp = {"ok": False, "error": str(e)}
             self.wfile.write((json.dumps(resp) + "\n").encode())
@@ -267,22 +715,67 @@ class _Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True      # rebind a TIME_WAIT port (dist tests)
     daemon_threads = True
     _serve_thread: Optional[threading.Thread] = None
+    _reaper_thread: Optional[threading.Thread] = None
+    _reaper_stop: Optional[threading.Event] = None
+
+    def __init__(self, *a, **kw):
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*a, **kw)
+
+    # track live per-connection sockets: shutdown() must sever them too
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
 
     def shutdown(self):
-        """Stop serving, close the listening socket and JOIN the serve
-        thread, so back-to-back test cases can't leak sockets."""
+        """Stop serving, close the listening socket AND every live
+        client connection, and JOIN the serve (and reaper) threads.
+        Severing open connections matters beyond test hygiene: a master
+        "restart" that leaves old handler threads serving pre-shutdown
+        sockets would let clients keep acking into the DEAD master's
+        state (which shares the snapshot file with its successor) —
+        exactly the split-brain the generation fence exists to
+        prevent.  A real master death drops its TCP connections; this
+        simulated one must as well."""
+        if self._reaper_stop is not None:
+            self._reaper_stop.set()
         super().shutdown()
         self.server_close()
-        t = self._serve_thread
-        if t is not None and t is not threading.current_thread():
-            t.join(timeout=5.0)
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in (self._serve_thread, self._reaper_thread):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=5.0)
 
 
 def serve_master(master: TaskMaster, host: str = "127.0.0.1",
                  port: int = 0, aggregator=None):
     """Start the TCP front end; returns (server, (host, port)).  Call
     server.shutdown() to stop (joins the server thread).  Pass a
-    FleetAggregator to accept report_metrics/report_events pushes."""
+    FleetAggregator to accept report_metrics/report_events pushes — it
+    is also wired as a membership listener, so /healthz keys on the
+    master's heartbeat truth, not on metric-report staleness.
+
+    A reaper thread ticks lease/heartbeat expiry so a silent fleet (the
+    exact failure membership exists to catch) is still declared dead on
+    time, without waiting for the next RPC."""
     try:
         srv = _Server((host, port), _Handler)
     except OSError as e:
@@ -290,6 +783,8 @@ def serve_master(master: TaskMaster, host: str = "127.0.0.1",
             f"task master failed to bind {host}:{port}: {e}") from e
     srv.master = master   # type: ignore
     srv.aggregator = aggregator   # type: ignore
+    if aggregator is not None and hasattr(aggregator, "note_worker"):
+        master.add_membership_listener(aggregator.note_worker)
     # poll_interval: shutdown() blocks one poll tick; the 0.5s default
     # costs half a second per master in every dist/resilience test case
     t = threading.Thread(
@@ -297,7 +792,39 @@ def serve_master(master: TaskMaster, host: str = "127.0.0.1",
         daemon=True, name="task-master")
     srv._serve_thread = t
     t.start()
+    stop = threading.Event()
+    tick = max(0.02, min(0.25, master.worker_timeout / 4.0))
+
+    def _reap_loop():
+        while not stop.wait(tick):
+            try:
+                master.tick()
+            except Exception:
+                pass
+
+    rt = threading.Thread(target=_reap_loop, daemon=True,
+                          name="task-master-reaper")
+    srv._reaper_stop = stop
+    srv._reaper_thread = rt
+    rt.start()
     return srv, srv.server_address
+
+
+def _parse_endpoints(endpoints) -> List[Tuple[str, int]]:
+    """Accept "h:p", "h:p,h:p", (h, p), or a list of either form."""
+    if isinstance(endpoints, str):
+        endpoints = [e for e in endpoints.split(",") if e.strip()]
+    out: List[Tuple[str, int]] = []
+    for ep in endpoints:
+        if isinstance(ep, str):
+            h, p = ep.rsplit(":", 1)
+            out.append((h.strip(), int(p)))
+        else:
+            h, p = ep
+            out.append((str(h), int(p)))
+    if not out:
+        raise ValueError("TaskMasterClient needs at least one endpoint")
+    return out
 
 
 class TaskMasterClient:
@@ -313,12 +840,32 @@ class TaskMasterClient:
     manager, and ``with client.processing(task):`` auto-reports
     ``task_failed`` when the body raises, so a crashing trainer returns
     its lease immediately instead of waiting out the lease timeout (ref
-    TaskFailed:455)."""
+    TaskFailed:455).
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    Failover: construct with ``endpoints=[(h, p), ...]`` (or a
+    comma-separated ``"h:p,h:p"`` string) and the client rotates to the
+    next endpoint whenever a connect fails — the reference client's
+    etcd-rediscovery loop, minus etcd.  Every reply carries the master
+    generation; a bump (``master_generation`` / ``generation_changes``)
+    means the master restarted and every lease this client holds is
+    void — acks for them return ``"fenced"`` and the caller re-fetches
+    work instead of assuming completion."""
+
+    def __init__(self, host: Optional[str] = None,
+                 port: Optional[int] = None, timeout: float = 10.0,
+                 endpoints: Optional[Union[str, Sequence]] = None):
         from ..resilience import chaos as _chaos, retry as _retry
         self._chaos, self._retry_mod = _chaos, _retry
-        self.host, self.port, self.timeout = host, port, timeout
+        if endpoints is None:
+            if host is None or port is None:
+                raise ValueError("pass host+port or endpoints=")
+            endpoints = [(host, int(port))]
+        self.endpoints = _parse_endpoints(endpoints)
+        self._ep_idx = 0
+        self.timeout = timeout
+        self.master_generation: Optional[int] = None
+        self.generation_changes = 0
+        self.job_complete = False
         self._policy = _retry.RetryPolicy(
             name="task_master_rpc",
             retry_on=(ConnectionError, socket.timeout, OSError))
@@ -326,11 +873,45 @@ class TaskMasterClient:
         self._f = None
         self._connect()
 
+    @property
+    def host(self) -> str:
+        return self.endpoints[self._ep_idx][0]
+
+    @property
+    def port(self) -> int:
+        return self.endpoints[self._ep_idx][1]
+
     def _connect(self):
+        """Dial the current endpoint; on failure rotate through the
+        rest, raising the last error only when EVERY endpoint refused —
+        the failover half of the re-dial loop."""
         self.close()
-        self._sock = socket.create_connection((self.host, self.port),
-                                              self.timeout)
-        self._f = self._sock.makefile("rwb")
+        last: Optional[BaseException] = None
+        for i in range(len(self.endpoints)):
+            idx = (self._ep_idx + i) % len(self.endpoints)
+            try:
+                self._sock = socket.create_connection(
+                    self.endpoints[idx], self.timeout)
+                self._f = self._sock.makefile("rwb")
+                self._ep_idx = idx
+                return
+            except OSError as e:
+                last = e
+        assert last is not None
+        raise last
+
+    def _note_generation(self, resp: dict):
+        gen = resp.get("gen")
+        if gen is None:
+            return
+        if self.master_generation is not None \
+                and gen != self.master_generation:
+            # the master restarted: every lease minted before this
+            # moment is void — callers see "fenced" acks and re-fetch
+            self.generation_changes += 1
+            obs_flight.record("task_queue", "generation_change",
+                              old=self.master_generation, new=gen)
+        self.master_generation = gen
 
     def _call(self, **req) -> dict:
         def attempt():
@@ -350,24 +931,54 @@ class TaskMasterClient:
             # an application-level error from a live master is NOT
             # transient; it propagates without burning retry budget
             raise RuntimeError(f"master error: {resp['error']}")
+        self._note_generation(resp)
         return resp
 
     def set_dataset(self, shards: List[str], shards_per_task: int = 1):
         self._call(method="set_dataset", shards=shards,
                    shards_per_task=shards_per_task)
 
-    def get_task(self) -> Optional[Task]:
-        resp = self._call(method="get_task")
+    def _status_call(self, **req) -> str:
+        """One RPC whose reply is a fencing status: "ok" | "fenced" |
+        "unknown" (legacy masters reply with just ``ok``)."""
+        resp = self._call(**req)
+        return resp.get("status", "ok" if resp.get("ok") else "unknown")
+
+    def get_task(self, worker: Optional[int] = None) -> Optional[Task]:
+        resp = self._call(method="get_task", worker=worker)
+        self.job_complete = bool(resp.get("complete"))
         return Task(**resp["task"]) if resp.get("task") else None
 
-    def task_finished(self, task_id: int):
-        self._call(method="task_finished", task_id=task_id)
+    def task_finished(self, task_id: int,
+                      lease: Optional[str] = None,
+                      worker: Optional[int] = None) -> str:
+        return self._status_call(method="task_finished", task_id=task_id,
+                                 lease=lease, worker=worker)
 
-    def task_failed(self, task_id: int):
-        self._call(method="task_failed", task_id=task_id)
+    def task_failed(self, task_id: int,
+                    lease: Optional[str] = None) -> str:
+        return self._status_call(method="task_failed", task_id=task_id,
+                                 lease=lease)
+
+    def register_worker(self, rank: int, host: Optional[str] = None,
+                        pid: Optional[int] = None) -> dict:
+        return self._call(method="register_worker", rank=rank,
+                          host=host or socket.gethostname(),
+                          pid=pid if pid is not None else os.getpid())
+
+    def heartbeat(self, rank: int, lease: str) -> str:
+        return self._status_call(method="heartbeat", rank=rank,
+                                 lease=lease)
+
+    def goodbye(self, rank: int, lease: str) -> str:
+        return self._status_call(method="goodbye", rank=rank,
+                                 lease=lease)
 
     def stats(self) -> dict:
         return self._call(method="stats")["stats"]
+
+    def ledger(self) -> List[dict]:
+        return self._call(method="ledger")["ledger"]
 
     # fleet telemetry (observability/fleet.py): push this worker's
     # snapshot / trace spans to the master's FleetAggregator
@@ -380,7 +991,10 @@ class TaskMasterClient:
     def processing(self, task: Task):
         """``with client.processing(task): <work>`` — task_finished on
         success, task_failed (lease returned for immediate requeue) when
-        the body raises."""
+        the body raises.  Both acks present the task's lease token; a
+        ``fenced`` reply means the lease was already void (another
+        worker owns the task now) and is absorbed — the new owner's
+        completion is the one that counts."""
         return _LeaseGuard(self, task)
 
     def __enter__(self) -> "TaskMasterClient":
@@ -407,16 +1021,93 @@ class _LeaseGuard:
 
     def __init__(self, client: TaskMasterClient, task: Task):
         self.client, self.task = client, task
+        # "ok" | "fenced" | "unknown" after __exit__ — callers that
+        # need exactly-once accounting read it
+        self.status: Optional[str] = None
 
     def __enter__(self) -> Task:
         return self.task
 
     def __exit__(self, exc_type, exc, tb):
         if exc_type is None:
-            self.client.task_finished(self.task.task_id)
+            self.status = self.client.task_finished(
+                self.task.task_id, lease=self.task.lease)
         else:
             try:
-                self.client.task_failed(self.task.task_id)
+                self.status = self.client.task_failed(
+                    self.task.task_id, lease=self.task.lease)
             except Exception:
                 pass    # master unreachable: the lease timeout covers it
+        return False
+
+
+class Heartbeater:
+    """Worker-side membership loop: register under ``rank``, then renew
+    the heartbeat lease every ``interval`` seconds on a dedicated
+    client/socket (the RPC socket is not thread-safe).  A ``fenced``
+    heartbeat — master restarted (generation bumped, membership wiped)
+    or this process was superseded/declared dead — triggers an automatic
+    re-registration under the SAME rank, which is how a
+    supervisor-restarted worker rejoins the fleet."""
+
+    def __init__(self, endpoints, rank: int,
+                 interval: Optional[float] = None, timeout: float = 10.0):
+        self.rank = int(rank)
+        self.interval = float(
+            interval if interval is not None
+            else flags.get_flag("worker_heartbeat_interval"))
+        self._client = TaskMasterClient(endpoints=endpoints,
+                                        timeout=timeout)
+        self.lease: Optional[str] = None
+        self.re_registrations = 0
+        self.missed = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _register(self):
+        self.lease = self._client.register_worker(self.rank)["lease"]
+
+    def start(self) -> "Heartbeater":
+        self._register()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"heartbeat-r{self.rank}")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                if self._client.heartbeat(self.rank, self.lease) != "ok":
+                    # new master generation or superseded lease:
+                    # re-enroll under the same rank
+                    self.re_registrations += 1
+                    self._register()
+            except Exception:
+                # master unreachable this tick; the next tick retries
+                # (and the master's worker_timeout is the backstop)
+                self.missed += 1
+
+    @property
+    def master_generation(self) -> Optional[int]:
+        return self._client.master_generation
+
+    def stop(self, goodbye: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval + 5.0)
+            self._thread = None
+        if goodbye and self.lease is not None:
+            try:
+                self._client.goodbye(self.rank, self.lease)
+            except Exception:
+                pass     # worker_timeout retires us eventually
+        self._client.close()
+
+    def __enter__(self) -> "Heartbeater":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
         return False

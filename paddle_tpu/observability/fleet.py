@@ -325,6 +325,11 @@ class FleetAggregator:
         self._spans: Dict[int, List[dict]] = {}
         self._flights: Dict[int, dict] = {}
         self._straggler_warned: set = set()
+        # membership truth pushed by the TaskMaster (register / death /
+        # goodbye transitions, wired via serve_master(aggregator=...)):
+        # rank -> {"state": live|dead|departed, ...}.  When present it
+        # outranks metric-report staleness in health()/straggler logic.
+        self._membership: Dict[int, dict] = {}
 
     # -- ingest (called from the task-queue RPC handler) ---------------
     def ingest(self, verb: str, payload: dict) -> dict:
@@ -390,6 +395,25 @@ class FleetAggregator:
                 f"> {self.straggler_factor:g}x behind the fleet median "
                 f"{median:.0f}", RuntimeWarning, stacklevel=2)
 
+    def note_worker(self, rank: int, state: str, host=None, pid=None,
+                    **info):
+        """Membership transition from the TaskMaster's heartbeat plane
+        (register -> "live", heartbeat-lease expiry -> "dead", goodbye
+        -> "departed").  This is ground truth: a rank the master
+        declared dead is degraded NOW, not after 3 missed report
+        intervals, and a live-heartbeating rank is not "stale" just
+        because its metric reporter is quiet."""
+        with self._lock:
+            self._membership[int(rank)] = {
+                "state": str(state), "host": host, "pid": pid,
+                "time_unix": time.time()}
+            if state in ("dead", "departed"):
+                self._straggler_warned.discard(int(rank))
+
+    def membership(self) -> Dict[int, str]:
+        with self._lock:
+            return {r: m["state"] for r, m in self._membership.items()}
+
     def ingest_local(self, rank: int):
         """Enroll THIS process as a reporting rank without TCP — for a
         coordinator that also trains.  Its steps then land in the fleet
@@ -423,7 +447,9 @@ class FleetAggregator:
         rank that catches back up is cleared — /healthz must recover,
         not latch at 503 forever — and warns again on a fresh lapse."""
         live = {r: w for r, w in self._workers.items()
-                if not w["departed"]}
+                if not w["departed"]
+                and self._membership.get(r, {}).get("state")
+                not in ("dead", "departed")}
         if self.straggler_factor <= 1.0 or len(live) < 2:
             # no basis for a diagnosis — and a prior one must not
             # latch /healthz at 503 after the fleet shrinks around it
@@ -459,26 +485,47 @@ class FleetAggregator:
         with self._lock:
             per = {}
             stale = []
-            for rank, w in sorted(self._workers.items()):
-                age = now - w.get("last_seen_unix", 0.0)
-                # a cleanly-departed rank stops aging out: it said
-                # goodbye, silence from it is expected, not degradation
-                is_stale = age > self.stale_after and not w["departed"]
+            dead = []
+            ranks = sorted(set(self._workers) | set(self._membership))
+            for rank in ranks:
+                w = self._workers.get(rank, {})
+                mem = self._membership.get(rank, {}).get("state")
+                age = now - w.get("last_seen_unix", 0.0) \
+                    if w else float("inf")
+                departed = bool(w.get("departed")) or mem == "departed"
+                # membership outranks report-age inference: a rank the
+                # master's heartbeat plane declares dead is degraded
+                # immediately; a live-heartbeating rank is not stale no
+                # matter how quiet its metric reporter is; a
+                # cleanly-departed rank stops aging out entirely
+                if mem == "dead":
+                    is_stale = False
+                    dead.append(rank)
+                elif mem == "live":
+                    is_stale = False
+                else:
+                    is_stale = (bool(w) and age > self.stale_after
+                                and not departed)
                 if is_stale:
                     stale.append(rank)
                 per[str(rank)] = {
-                    "host": w.get("host"), "pid": w.get("pid"),
+                    "host": w.get("host")
+                    or self._membership.get(rank, {}).get("host"),
+                    "pid": w.get("pid")
+                    or self._membership.get(rank, {}).get("pid"),
                     "steps_total": w.get("steps_total", 0.0),
                     "step_rate": round(w.get("step_rate", 0.0), 3),
-                    "last_report_age_s": round(age, 3),
+                    "last_report_age_s":
+                        round(age, 3) if w else None,
                     "stale": is_stale,
-                    "departed": w["departed"],
+                    "departed": departed,
+                    "membership": mem,
                 }
             stragglers = sorted(self._straggler_warned)
         return {"workers": len(per), "per_worker": per, "stale": stale,
-                "stragglers": stragglers,
+                "dead": dead, "stragglers": stragglers,
                 "stale_after_s": self.stale_after,
-                "degraded": bool(stale or stragglers)}
+                "degraded": bool(stale or stragglers or dead)}
 
     def merged_families(self, local: Optional[dict] = None
                         ) -> Dict[str, dict]:
@@ -526,10 +573,16 @@ class FleetAggregator:
             # worker's taskmaster_lease_expired_total) carries no
             # information — keep the coordinator's local series
         h = self.health()
-        out["fleet_workers"] = {
+        # merge, don't clobber: the coordinator's local registry carries
+        # the TaskMaster's fleet_workers{state} membership gauges in the
+        # same family; the label sets are disjoint (unlabeled count vs
+        # state=...), so both coexist
+        fw = out.setdefault("fleet_workers", {
             "type": "gauge",
-            "help": "Workers that have reported to the FleetAggregator.",
-            "series": {(): {"labels": {}, "value": float(h["workers"])}}}
+            "help": "Workers that have reported to the FleetAggregator "
+                    "(unlabeled) / task-master membership by state.",
+            "series": {}})
+        fw["series"][()] = {"labels": {}, "value": float(h["workers"])}
         up = {"type": "gauge",
               "help": "1 when the rank reported within stale_after "
                       "seconds, else 0.", "series": {}}
@@ -544,9 +597,13 @@ class FleetAggregator:
             key = _series_key(labels)
             up["series"][key] = {
                 "labels": labels,
-                "value": 0.0 if (w["stale"] or w["departed"]) else 1.0}
-            age["series"][key] = {"labels": labels,
-                                  "value": w["last_report_age_s"]}
+                "value": 0.0 if (w["stale"] or w["departed"]
+                                 or w.get("membership") == "dead")
+                else 1.0}
+            age["series"][key] = {
+                "labels": labels,
+                "value": w["last_report_age_s"]
+                if w["last_report_age_s"] is not None else -1.0}
             rate["series"][key] = {"labels": labels,
                                    "value": w["step_rate"]}
         out["fleet_worker_up"] = up

@@ -18,9 +18,14 @@ task_queue.py lease/requeue); this package adds the machinery that
 
 Preemption tolerance (SIGTERM/SIGINT -> stop at step boundary ->
 emergency checkpoint -> clean exit, plus step-accurate resume) lives in
-``trainer.py``.  Recovery actions emit ``resilience_*`` / ``trainer_*``
-/ ``retry_*`` counters through the observability registry.  Catalog and
-semantics: docs/RESILIENCE.md.
+``trainer.py``.  The elastic-fleet plane (fenced leases, membership,
+master generations/failover, the crash-restarting supervisor) lives in
+``distributed/``; this package carries its worker body
+(:mod:`.elastic_worker`, run via ``python -m``) and the chaos-matrix
+soak lane (:mod:`.soak` — ``python -m paddle_tpu.resilience.soak``),
+both imported lazily.  Recovery actions emit ``resilience_*`` /
+``trainer_*`` / ``retry_*`` / ``fenced_*`` counters through the
+observability registry.  Catalog and semantics: docs/RESILIENCE.md.
 """
 from __future__ import annotations
 

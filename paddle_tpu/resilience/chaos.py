@@ -22,7 +22,13 @@ Spec grammar (one directive per site, ';'-separated)::
 Kinds: ``raise`` (the planted site's exception class — ConnectionError
 at RPC sites, OSError at filesystem sites), ``nan`` / ``inf`` (poison a
 value), ``delay`` (sleep ``arg`` seconds, default 0.01), ``truncate``
-(cut a file to ``arg`` fraction of its bytes, default 0.5).
+(cut a file to ``arg`` fraction of its bytes, default 0.5), ``exit``
+(hard process death via ``os._exit(arg)`` — the ``kill -9`` a
+supervisor must survive; default code 9), and ``refuse`` (a
+connection-refused WINDOW: the first firing opens ``arg`` seconds —
+default 0.25 — during which every pass of the site raises
+``ConnectionRefusedError``, modelling a master that is down for a
+stretch, driving client re-dial/failover).
 
 Determinism: every fault point keeps a per-site invocation counter, and
 the fire/skip decision hashes (seed, site, counter) through crc32 — no
@@ -36,6 +42,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import threading
 import time
 import zlib
@@ -58,7 +65,12 @@ class InjectedFault(Exception):
 class Fault:
     __slots__ = ("site", "kind", "prob", "arg")
 
-    KINDS = ("raise", "nan", "inf", "delay", "truncate")
+    KINDS = ("raise", "nan", "inf", "delay", "truncate", "exit",
+             "refuse")
+
+    # per-kind default for the optional third field
+    DEFAULT_ARGS = {"delay": 0.01, "truncate": 0.5, "exit": 9.0,
+                    "refuse": 0.25}
 
     def __init__(self, site: str, kind: str, prob: float, arg: float):
         self.site = site
@@ -88,8 +100,8 @@ def parse_spec(spec: str) -> Dict[str, Fault]:
                 f"(expected one of {Fault.KINDS})")
         try:
             prob = float(fields[1]) if len(fields) > 1 else 1.0
-            arg = float(fields[2]) if len(fields) > 2 else (
-                0.01 if kind == "delay" else 0.5)
+            arg = float(fields[2]) if len(fields) > 2 else \
+                Fault.DEFAULT_ARGS.get(kind, 0.5)
         except ValueError:
             raise ValueError(
                 f"chaos_spec site {site!r}: non-numeric prob/arg in "
@@ -109,6 +121,9 @@ _EMPTY: Dict[str, Fault] = {}
 _parsed: Tuple[str, Dict[str, Fault]] = ("", {})
 _counters: Dict[str, int] = {}
 _fired: List[Tuple[str, int, str]] = []
+# open connection-refused windows per site (kind "refuse"): passes of
+# the site inside the window raise without burning schedule slots
+_refuse_until: Dict[str, float] = {}
 
 
 def _active() -> Dict[str, Fault]:
@@ -133,6 +148,7 @@ def reset():
         _parsed = ("", {})
         _counters = {}
         _fired = []
+        _refuse_until.clear()
 
 
 def schedule() -> List[Tuple[str, int, str]]:
@@ -160,17 +176,44 @@ def _decide(fault: Fault) -> Optional[int]:
 
 
 def trigger(site: str, exc: type = InjectedFault):
-    """Fire side-effect faults (raise/delay) armed at `site`.  The
-    unarmed path is one flag read + dict miss."""
+    """Fire side-effect faults (raise/delay/exit/refuse) armed at
+    `site`.  The unarmed path is one flag read + dict miss."""
     fault = _active().get(site)
     if fault is None:
         return
-    if fault.kind in ("raise", "delay"):
+    if fault.kind == "refuse":
+        now = time.time()
+        with _lock:
+            until = _refuse_until.get(site, 0.0)
+        if until > now:
+            # inside an open window: refuse without consuming a new
+            # schedule slot (one decision opened the whole window)
+            raise ConnectionRefusedError(
+                f"chaos: refuse window at {site} "
+                f"({until - now:.2f}s left)")
+        n = _decide(fault)
+        if n is None:
+            return
+        with _lock:
+            _refuse_until[site] = now + fault.arg
+        raise ConnectionRefusedError(
+            f"chaos: injected refuse window at {site}#{n} "
+            f"for {fault.arg}s")
+    if fault.kind in ("raise", "delay", "exit"):
         n = _decide(fault)
         if n is None:
             return
         if fault.kind == "delay":
             time.sleep(fault.arg)
+        elif fault.kind == "exit":
+            # kill -9 semantics: no atexit, no finally, no flushes —
+            # the process is simply gone mid-step (the supervisor's
+            # problem now).  One stderr line so the operator can tell
+            # an injected death from a real one.
+            print(f"chaos: injected hard exit at {site}#{n} "
+                  f"(code {int(fault.arg)})", file=sys.stderr,
+                  flush=True)
+            os._exit(int(fault.arg))
         else:
             raise exc(f"chaos: injected fault at {site}#{n}")
 

@@ -1,0 +1,187 @@
+"""One rank of an elastic training fleet — the supervised worker body.
+
+Run:  python -m paddle_tpu.resilience.elastic_worker \\
+          <endpoints> <world> <rank> <out.json> <ckpt_dir>
+
+``endpoints`` is a comma-separated ``host:port[,host:port]`` list of
+master endpoints (failover order).  The worker
+
+* registers under its rank and heartbeats (``task_queue.Heartbeater``
+  re-registers automatically after a master restart or a declared
+  death — the supervisor-restarted incarnation rejoins under the SAME
+  rank);
+* leases dataset tasks and applies one deterministic parameter update
+  per shard (a stand-in training step whose final value is a pure
+  function of the multiset of (shard, epoch) pairs applied — so tests
+  can verify exactly-once end state, not just the ledger);
+* checkpoints after every task through ``incubate/checkpoint.py`` (CRC
+  + atomic rename, PR 2 machinery) — a ``kill -9`` mid-task costs at
+  most that task, and the restarted incarnation resumes from the
+  newest VALID serial and fast-forwards (already-applied work is in
+  the checkpoint, the half-done task's lease is fenced/requeued);
+* passes the ``trainer.step`` chaos fault point once per leased task,
+  which is where a ``PTPU_CHAOS_SPEC=trainer.step=exit:...`` schedule
+  hard-kills it;
+* presents its lease on every ack: a ``fenced`` reply (the task was
+  re-leased while we were dead/slow) is counted, never treated as a
+  completion.
+
+Exit code 0 = this rank saw the job through to ``complete``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _apply(w, shard: str, epoch: int):
+    """One deterministic 'training' update; commutative (pure sum of
+    per-(shard, epoch) contributions) so any interleaving of the fleet
+    reaches the same global end state when each pair is applied exactly
+    once."""
+    import zlib
+    h = zlib.crc32(f"{shard}:{epoch}".encode()) % 1000
+    w[h % w.size] += 1.0 + h / 1000.0
+    return w
+
+
+def _unapply(w, shards, epoch: int):
+    import numpy as np
+    for sh in shards:
+        w -= _apply(np.zeros_like(w), sh, epoch)
+    return w
+
+
+def reconcile_in_flight(w, applied: int, meta: dict, ledger_entries):
+    """Resolve a resumed checkpoint's applied-but-not-yet-acked task
+    against the master's ledger (the exactly-once source of truth):
+
+    * the completion LANDED (crash fell between the ack and the next
+      checkpoint) — keep the update;
+    * the lease never committed (crash fell between the checkpoint and
+      the ack; the task was requeued and re-runs elsewhere) — subtract
+      it, or the fleet-summed end state counts the pair twice.
+
+    Returns (w, applied)."""
+    inf = meta.get("in_flight")
+    if not inf:
+        return w, applied
+    landed = any(e.get("task_id") == inf["task_id"]
+                 and e.get("lease") == inf["lease"]
+                 for e in ledger_entries)
+    if not landed:
+        w = _unapply(w, inf["shards"], inf["epoch"])
+        applied -= len(inf["shards"])
+    return w, applied
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 5:
+        print(__doc__, file=sys.stderr)
+        return 2
+    endpoints, world, rank, out_path, ckpt_dir = argv
+    world, rank = int(world), int(rank)
+    restart_count = int(os.environ.get("PTPU_WORKER_RESTART_COUNT", "0"))
+
+    import numpy as np
+
+    from paddle_tpu.core import flags
+    from paddle_tpu.distributed.task_queue import (Heartbeater,
+                                                   TaskMasterClient)
+    from paddle_tpu.incubate import checkpoint as ckpt
+    from paddle_tpu.resilience import chaos
+
+    hb = Heartbeater(endpoints, rank)
+    hb.start()
+    client = TaskMasterClient(endpoints=endpoints)
+
+    # resume (PR 2 machinery): the newest VALID serial wins; a torn
+    # write from the previous incarnation's death fails CRC and is
+    # skipped by latest_checkpoint.  An in-flight (applied-but-unacked)
+    # task recorded in the meta reconciles against the master's ledger.
+    w = np.zeros(16, dtype="float64")
+    applied = 0
+    resumed = False
+    serial = ckpt.latest_checkpoint(ckpt_dir) if os.path.isdir(ckpt_dir) \
+        else -1
+    if serial >= 0:
+        state, meta, _ = ckpt.load_checkpoint(ckpt_dir, serial)
+        w = np.asarray(state["w"], dtype="float64")
+        applied = int(meta.get("applied", 0))
+        w, applied = reconcile_in_flight(w, applied, meta,
+                                         client.ledger())
+        resumed = True
+    completed, fenced_acks, failed_acks = [], 0, 0
+    generations = set()
+    try:
+        while True:
+            t = client.get_task(worker=rank)
+            if client.master_generation is not None:
+                generations.add(client.master_generation)
+            if t is None:
+                if client.job_complete:
+                    break
+                time.sleep(0.05)     # all work leased elsewhere: spin
+                continue
+            # the hard-death fault point: an armed exit schedule kills
+            # this process HERE, mid-task, lease held — the master's
+            # membership reaper requeues it and the supervisor respawns
+            # this rank
+            chaos.trigger("trainer.step")
+            for sh in t.shards:
+                w = _apply(w, sh, t.epoch)
+            applied += len(t.shards)
+            # the meta carries the not-yet-acked task: a crash between
+            # this save and the ack is resolved at resume by
+            # reconcile_in_flight (ledger truth), never double-applied
+            ckpt.save_checkpoint(ckpt_dir, {"w": w},
+                                 {"applied": applied, "rank": rank,
+                                  "in_flight": {
+                                      "task_id": t.task_id,
+                                      "epoch": t.epoch,
+                                      "lease": t.lease,
+                                      "shards": list(t.shards)}},
+                                 max_keep=2)
+            status = client.task_finished(t.task_id, lease=t.lease,
+                                          worker=rank)
+            if status == "ok":
+                completed.append([t.task_id, t.epoch])
+            elif status == "fenced":
+                # our lease was voided while we worked (declared dead /
+                # master restart): the task belongs to someone else now
+                # — roll the local update back so the fleet-sum end
+                # state still counts each (shard, epoch) exactly once
+                fenced_acks += 1
+                w = _unapply(w, t.shards, t.epoch)
+                applied -= len(t.shards)
+                # the pre-rollback state is already on disk: overwrite
+                # it so a later resume can't resurrect the fenced update
+                ckpt.save_checkpoint(ckpt_dir, {"w": w},
+                                     {"applied": applied,
+                                      "rank": rank}, max_keep=2)
+            else:
+                failed_acks += 1
+    finally:
+        hb.stop(goodbye=True)
+        client.close()
+
+    with open(out_path, "w") as f:
+        json.dump({"rank": rank, "restart_count": restart_count,
+                   "resumed": resumed,
+                   "completed": completed,
+                   "fenced_acks": fenced_acks,
+                   "failed_acks": failed_acks,
+                   "hb_re_registrations": hb.re_registrations,
+                   "generations": sorted(generations),
+                   "w_sum": float(w.sum()),
+                   "chaos_spec": flags.get_flag("chaos_spec")}, f)
+    print(f"ELASTIC_WORKER_OK rank={rank} completed={len(completed)} "
+          f"fenced={fenced_acks} restarts={restart_count}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

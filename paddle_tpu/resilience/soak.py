@@ -1,0 +1,283 @@
+"""Elastic-fleet chaos-matrix soak runner.
+
+``python -m paddle_tpu.resilience.soak`` drives the full supervisor
+end-to-end — master with snapshot + membership reaper, N supervised
+``elastic_worker`` processes — under seeded fault schedules, and exits
+nonzero when any schedule hangs or the completion ledger is not
+exactly-once:
+
+* ``worker_kill``     — chaos ``exit`` hard-kills rank 0 mid-task; the
+  supervisor restarts it and it resumes from checkpoint.
+* ``master_restart``  — the master is shut down mid-run and restarted
+  on the same port from its snapshot (generation bump, leases void);
+  clients re-dial and the fleet drains the queue.
+* ``rpc_refuse``      — chaos ``refuse`` opens connection-refused
+  windows at the RPC site; clients back off / re-dial through them.
+* ``combined``        — all of the above in one run.
+
+Every schedule asserts: all workers exit 0 inside the deadline, every
+(task, epoch) pair completes EXACTLY once in the master's persisted
+ledger, fenced acks were rejected (never recorded), and — per
+schedule — the dead worker was restarted within its backoff budget /
+the generation bumped.  The same :func:`run_schedule` body backs the
+tier-1 e2e test (tests/test_elastic.py) and the ``slow``-marked soak
+lane.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+import zlib
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+SCHEDULES = ("worker_kill", "master_restart", "rpc_refuse", "combined")
+
+# master timing: the heartbeat reaper (worker death -> immediate
+# requeue) must be what recovers leases, not the per-task timeout —
+# keep the task lease LONG so a hung run proves membership worked
+_LEASE_TIMEOUT = 60.0
+_WORKER_TIMEOUT = 1.0
+_HEARTBEAT_INTERVAL = 0.2
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _seed_where_exit_fires(prob: float, lo: int, hi: int,
+                           site: str = "trainer.step") -> int:
+    """Smallest chaos seed whose first ``exit`` firing at `site` lands
+    in invocation window [lo, hi) — pure crc32 math (the chaos plane's
+    own decision function), so the kill point is chosen deterministically
+    without running anything."""
+    for seed in range(10_000):
+        fires = [n for n in range(hi)
+                 if zlib.crc32(f"{seed}:{site}:{n}".encode())
+                 / 0xFFFFFFFF < prob]
+        if fires and lo <= fires[0] < hi:
+            return seed
+    raise RuntimeError("no seed found (unreachable for sane prob)")
+
+
+def worker_cmd(endpoints: str, world: int, rank: int, out: str,
+               ckpt_dir: str) -> List[str]:
+    return [sys.executable, "-m", "paddle_tpu.resilience.elastic_worker",
+            endpoints, str(world), str(rank), out, ckpt_dir]
+
+
+def worker_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)    # one CPU device per process
+    env.pop("PYTHONPATH", None)   # axon plugin quirk (tests/conftest.py)
+    env["PTPU_WORKER_HEARTBEAT_INTERVAL"] = str(_HEARTBEAT_INTERVAL)
+    # ride through the master-restart gap without exhausting the RPC
+    # retry budget (downtime is short but nonzero)
+    env["PTPU_RETRY_MAX_ATTEMPTS"] = "8"
+    if extra:
+        env.update(extra)
+    return env
+
+
+def check_ledger(ledger: List[dict], n_tasks: int,
+                 epochs: int) -> List[str]:
+    """Exactly-once: every (task, epoch) pair completed once, none
+    twice, none missing.  Returns human-readable problems (empty =
+    pass)."""
+    problems = []
+    seen: Dict[tuple, int] = {}
+    for e in ledger:
+        seen[(e["task_id"], e["epoch"])] = \
+            seen.get((e["task_id"], e["epoch"]), 0) + 1
+    dups = sorted(k for k, v in seen.items() if v > 1)
+    if dups:
+        problems.append(f"duplicate completions (fenced ack accepted?): "
+                        f"{dups}")
+    want = {(t, ep) for t in range(n_tasks) for ep in range(epochs)}
+    missing = sorted(want - set(seen))
+    if missing:
+        problems.append(f"missing completions: {missing}")
+    extra = sorted(set(seen) - want)
+    if extra:
+        problems.append(f"unexpected completions: {extra}")
+    return problems
+
+
+def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
+                 n_tasks: int = 6, epochs: int = 2,
+                 timeout: float = 120.0) -> dict:
+    """One schedule end-to-end; returns a report dict with ``ok`` and
+    ``problems`` (see module docstring for the assertions)."""
+    from paddle_tpu.distributed.supervisor import Supervisor
+    from paddle_tpu.distributed.task_queue import (TaskMaster,
+                                                   serve_master)
+
+    if name not in SCHEDULES:
+        raise ValueError(f"unknown schedule {name!r} "
+                         f"(expected one of {SCHEDULES})")
+    os.makedirs(workdir, exist_ok=True)
+    t_start = time.time()
+    port = _free_port()
+    endpoints = f"127.0.0.1:{port}"
+    snap = os.path.join(workdir, "master.json")
+
+    def _master() -> "TaskMaster":
+        # snapshot_interval=0: every mutation durable BEFORE the RPC
+        # reply — the exactly-once-across-master-restart guarantee
+        # assumes the ledger survives the restart
+        return TaskMaster(snapshot_path=snap,
+                          lease_timeout=_LEASE_TIMEOUT,
+                          snapshot_interval=0.0,
+                          worker_timeout=_WORKER_TIMEOUT,
+                          num_epochs=epochs)
+
+    master = _master()
+    master.set_dataset([f"shard-{i:03d}" for i in range(n_tasks)])
+    srv, _ = serve_master(master, port=port)
+
+    kill_rank0 = name in ("worker_kill", "combined")
+    restart_master = name in ("master_restart", "combined")
+    refuse = name in ("rpc_refuse", "combined")
+
+    envs: List[Optional[Dict[str, str]]] = [None] * world
+    if kill_rank0:
+        # die on the 2nd or 3rd leased task (mid-epoch, at least one
+        # task completed first), at a deterministically pre-computed
+        # invocation — late enough to be mid-epoch, early enough that
+        # rank 0 is guaranteed to reach it before the queue drains
+        kseed = _seed_where_exit_fires(0.4, 1, 3)
+        envs[0] = {"PTPU_CHAOS_SPEC": "trainer.step=exit:0.4:9",
+                   "PTPU_CHAOS_SEED": str(kseed)}
+    if refuse:
+        rank = 1 if world > 1 else 0
+        spec = "task_queue.rpc=refuse:0.05:0.2"
+        cur = dict(envs[rank] or {})
+        # refuse composes with an existing spec via ';'
+        prev = cur.get("PTPU_CHAOS_SPEC", "")
+        cur["PTPU_CHAOS_SPEC"] = (prev + ";" if prev else "") + spec
+        cur.setdefault("PTPU_CHAOS_SEED", str(seed))
+        envs[rank] = cur
+
+    outs = [os.path.join(workdir, f"worker_{r}.json")
+            for r in range(world)]
+    sup = Supervisor(
+        cmds=[worker_cmd(endpoints, world, r, outs[r],
+                         os.path.join(workdir, f"ckpt_r{r}"))
+              for r in range(world)],
+        env=worker_env(), envs=envs, cwd=REPO_ROOT,
+        log_dir=workdir)
+    sup.start()
+
+    generation_after = master.generation
+    try:
+        if restart_master:
+            # wait for real progress, then bounce the coordinator on
+            # the SAME port: leases void, generation bumps, clients
+            # re-dial and the fleet keeps going
+            deadline = time.time() + timeout / 2
+            while len(master.ledger_entries()) < world \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            srv.shutdown()
+            master = _master()       # recovers from the snapshot
+            srv, _ = serve_master(master, port=port)
+        finished = sup.wait(timeout=timeout)
+        generation_after = master.generation
+        ledger = master.ledger_entries()
+        stats = master.stats()
+    finally:
+        sup.stop()
+        srv.shutdown()
+
+    problems = []
+    status = sup.status()
+    if not finished:
+        problems.append(f"fleet did not finish within {timeout}s: "
+                        f"{status}")
+    problems += check_ledger(ledger, n_tasks, epochs)
+    if kill_rank0 and sup.restarts[0] < 1:
+        problems.append("rank 0 was never restarted (chaos exit did "
+                        "not fire or the supervisor missed the crash)")
+    if restart_master and generation_after < 2:
+        problems.append(f"master generation did not bump "
+                        f"(still {generation_after})")
+    workers = []
+    for out in outs:
+        if os.path.exists(out):
+            with open(out) as f:
+                workers.append(json.load(f))
+        else:
+            problems.append(f"missing worker report {out}")
+    return {"schedule": name, "ok": not problems, "problems": problems,
+            "seed": seed, "world": world, "n_tasks": n_tasks,
+            "epochs": epochs, "ledger_entries": len(ledger),
+            "restarts": dict(sup.restarts),
+            "generation": generation_after,
+            "stats": stats, "workers": workers,
+            "duration_s": round(time.time() - t_start, 2)}
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.resilience.soak",
+        description="Elastic-fleet chaos-matrix soak: supervisor e2e "
+                    "under seeded fault schedules; nonzero exit on any "
+                    "hang or exactly-once ledger violation.")
+    ap.add_argument("--schedules", default=",".join(SCHEDULES),
+                    help=f"comma list from {SCHEDULES} "
+                         f"(default: all)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--tasks", type=int, default=6)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch root (default: a fresh tempdir)")
+    ap.add_argument("--out", default=None,
+                    help="write the full JSON report here")
+    args = ap.parse_args(argv)
+    names = [s.strip() for s in args.schedules.split(",") if s.strip()]
+    bad = [n for n in names if n not in SCHEDULES]
+    if bad:
+        ap.error(f"unknown schedule(s) {bad}; pick from {SCHEDULES}")
+    root = args.workdir
+    if root is None:
+        import tempfile
+        root = tempfile.mkdtemp(prefix="ptpu_soak_")
+    reports = []
+    for name in names:
+        rep = run_schedule(os.path.join(root, name), name,
+                           seed=args.seed, world=args.world,
+                           n_tasks=args.tasks, epochs=args.epochs,
+                           timeout=args.timeout)
+        reports.append(rep)
+        verdict = "PASS" if rep["ok"] else "FAIL"
+        print(f"[{verdict}] {name:<16} ledger={rep['ledger_entries']} "
+              f"restarts={rep['restarts']} gen={rep['generation']} "
+              f"{rep['duration_s']}s")
+        for p in rep["problems"]:
+            print(f"         - {p}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"reports": reports}, f, indent=2)
+    failed = [r["schedule"] for r in reports if not r["ok"]]
+    if failed:
+        print(f"soak FAILED: {failed}")
+        return 1
+    print(f"soak OK: {len(reports)} schedule(s) clean under seed "
+          f"{args.seed}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

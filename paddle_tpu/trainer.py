@@ -82,6 +82,11 @@ _m_preemptions = obs_metrics.counter(
     "trainer_preemptions_total",
     "SIGTERM/SIGINT preemptions honored at a step boundary (emergency "
     "checkpoint + clean exit).")
+_m_resumes = obs_metrics.counter(
+    "trainer_resumes_total",
+    "Trainer constructions that resumed from a checkpoint (the "
+    "supervisor-restarted-worker path): params restored from the "
+    "newest valid serial and the reader fast-forwarded.")
 # model-agnostic cost-model gauges (observability/costmodel.py): FLOPs
 # come from XLA's accounting of the compiled train step, not from any
 # per-architecture formula
@@ -202,6 +207,13 @@ class Trainer:
             serial = self._latest_serial()
             if serial >= 0:
                 self._load_checkpoint(serial)
+                # a restarted worker (supervisor / scheduler respawn)
+                # lands here: make the resume observable — which serial
+                # revived it and where training will pick up
+                _m_resumes.inc()
+                obs_flight.record("trainer", "resumed", serial=serial,
+                                  epoch=self.epoch_offset,
+                                  step=self.step_offset)
 
     def _dist_transpile_if_necessary(self, mesh):
         """ref contrib/trainer.py _dist_transpile_if_necessary: the same

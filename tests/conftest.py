@@ -40,6 +40,7 @@ def fresh_programs():
     chaos spec leaking across tests, and no observability HTTP server
     or trainer-liveness state surviving a case."""
     import paddle_tpu as pt
+    from paddle_tpu.distributed import task_queue
     from paddle_tpu.framework import executor as executor_mod
     from paddle_tpu.observability import costmodel, flight, forensics
     from paddle_tpu.observability import server as obs_server
@@ -52,10 +53,15 @@ def fresh_programs():
     forensics.reset()
     flight.reset()
     obs_server.reset()
+    # forget the previous test's masters (weakset) and zero the
+    # queue/membership gauges: a scrape-time refresh_metrics() must not
+    # re-publish a dead master's fleet_workers / taskmaster_tasks series
+    task_queue.reset_state()
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
     obs_server.reset()
+    task_queue.reset_state()
 
 
 @pytest.fixture
