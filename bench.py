@@ -49,6 +49,21 @@ _BASIS = {
     "transformer_lm_train_tokens_per_sec_per_chip":
         "assumed 50k tok/s V100 fp16 transformer-base anchor "
         "(BASELINE.json north star; reference publishes no number)",
+    "transformer_lm_int8_train_tokens_per_sec_per_chip":
+        "same assumed 50k tok/s anchor as the bf16 LM row; the "
+        "reference only ever SIMULATED int8 "
+        "(quantize_transpiler fake ops) — this row executes it "
+        "(quantize_dtype=int8: int8 x int8 -> int32 dot_general, STE "
+        "bf16 backward)",
+    "transformer_lm_fused_block_train_tokens_per_sec_per_chip":
+        "same assumed 50k tok/s anchor as the bf16 LM row; "
+        "fuse_block=1 collapses every transformer block into one "
+        "VMEM-resident Pallas kernel (kernels/fused_block.py)",
+    "resnet50_infer_int8_imgs_per_sec_per_chip":
+        "reference's published ResNet-50 infer bs16: 217.69 img/s, "
+        "2x Xeon 6148 MKL-DNN (benchmark/IntelOptimizedPaddle.md:87); "
+        "this row runs the QuantizeTranspiler-frozen REAL int8 program "
+        "(quantized_conv2d/quantized_matmul)",
     "transformer_base_train_tokens_per_sec_per_chip":
         "assumed 50k tok/s V100 fp16 transformer-base anchor "
         "(BASELINE.json north star; reference publishes no number)",
@@ -155,6 +170,46 @@ def bench_lm(on_tpu):
         D=512, F=2048, L=6, V=32000, T=512, batch=32)
 
 
+def bench_lm_int8(on_tpu):
+    """Flagship config on the REAL int8 path: every mul/matmul runs
+    int8 x int8 -> int32 on the MXU with dynamic scales and an STE bf16
+    backward (ops/quantize_ops.py low_precision_matmul), regression-
+    gated from day one (ISSUE 6).  The acceptance bar: beats the bf16
+    row's tokens/s on TPU."""
+    from paddle_tpu.core import flags
+    old = flags.get_flag("quantize_dtype")
+    flags.set_flag("quantize_dtype", "int8")
+    try:
+        row = _bench_lm_cfg(
+            on_tpu,
+            metric="transformer_lm_int8_train_tokens_per_sec_per_chip",
+            D=512, F=2048, L=6, V=32000, T=512, batch=32)
+    finally:
+        flags.set_flag("quantize_dtype", old)
+    row["config"] += " + quantize_dtype=int8"
+    return row
+
+
+def bench_lm_fused_block(on_tpu):
+    """Flagship config with whole-block fusion: FuseBlockTranspiler
+    collapses each LN->attention->residual->LN->MLP->residual layer
+    into ONE fused_transformer_block op -> the VMEM-resident Pallas
+    block kernel.  A separate metric (not the r05 row) so the gate
+    tracks it independently."""
+    from paddle_tpu.core import flags
+    old = flags.get_flag("fuse_block")
+    flags.set_flag("fuse_block", True)
+    try:
+        row = _bench_lm_cfg(
+            on_tpu, metric="transformer_lm_fused_block_train_tokens_"
+                           "per_sec_per_chip",
+            D=512, F=2048, L=6, V=32000, T=512, batch=32)
+    finally:
+        flags.set_flag("fuse_block", old)
+    row["config"] += " + fuse_block"
+    return row
+
+
 def bench_lm_8k(on_tpu):
     """Long-context row (SURVEY §5): the streaming flash kernels keep
     O(block) VMEM, so an 8k-token context trains on one chip where the
@@ -174,6 +229,8 @@ def _bench_lm_cfg(on_tpu, metric, D, F, L, V, T, batch):
         n_layer=L, n_head=8, d_model=D, d_inner=F, dropout=0.0)
     feeds, avg_cost, _ = models.transformer.build_lm_net(
         cfg, seq_len=T, fused_attention=True, fused_head=on_tpu)
+    from paddle_tpu.transpiler.fused_block import maybe_fuse
+    maybe_fuse(pt.default_main_program())   # FLAGS_fuse_block-gated
     pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     exe.run(pt.default_startup_program())
     feed = _stage(models.transformer.make_fake_lm_batch(cfg, batch, T),
@@ -268,6 +325,47 @@ def bench_resnet50_infer(on_tpu):
         "vs_baseline": round(batch / dt / 217.69, 3),
         "config": f"ResNet-50 {shape} bs{batch} predictor AOT path",
     }
+
+
+def bench_resnet50_infer_int8(on_tpu):
+    """ResNet-50 inference on the REAL int8 program: QAT transpile
+    (dynamic abs_max activations, channel-wise weights) + freeze_program
+    -> quantized_conv2d / quantized_matmul ops, int8 x int8 -> int32
+    accumulation on the MXU, per-channel scales post-accumulation."""
+    from paddle_tpu import models
+    from paddle_tpu.transpiler import QuantizeTranspiler
+    pt, exe = _fresh(on_tpu)
+    batch = 16
+    shape = (3, 224, 224) if on_tpu else (3, 32, 32)
+    feeds, avg_loss, acc, pred = models.resnet.build_train_net(
+        class_dim=1000, img_shape=shape, depth=50, is_test=True)
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program().prune(("img",), [pred.name])
+    QuantizeTranspiler().training_transpile(
+        prog, pt.default_startup_program())
+    prog = QuantizeTranspiler().freeze_program(prog, scope=exe.scope,
+                                               quantize_dtype="int8")
+    rng = np.random.RandomState(0)
+    feed = _stage({"img": rng.rand(batch, *shape).astype("float32")},
+                  on_tpu)
+    exe.run(prog, feed=feed, fetch_list=[pred.name])       # compile
+    iters = 30 if on_tpu else 2
+    dt = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = exe.run(prog, feed=feed, fetch_list=[pred.name],
+                          return_numpy=False)
+        jax.block_until_ready(out)
+        dt = min(dt, (time.perf_counter() - t0) / iters)
+    row = {
+        "metric": "resnet50_infer_int8_imgs_per_sec_per_chip",
+        "value": round(batch / dt, 1), "unit": "img/s",
+        "vs_baseline": round(batch / dt / 217.69, 3),
+        "config": f"ResNet-50 {shape} bs{batch} frozen int8 "
+                  f"(quantized_conv2d), executor path",
+    }
+    return _attach_cost(row, exe, prog, feed, pred.name, dt)
 
 
 def bench_nmt(on_tpu):
@@ -409,9 +507,10 @@ def main():
                                   "bench_metrics.json")
 
     rows, errors = [], {}
-    for fn in (bench_lm, bench_resnet50, bench_nmt,
-               bench_resnet50_infer, bench_alexnet, bench_googlenet,
-               bench_lstm, bench_lm_8k):
+    for fn in (bench_lm, bench_lm_int8, bench_lm_fused_block,
+               bench_resnet50, bench_nmt, bench_resnet50_infer,
+               bench_resnet50_infer_int8, bench_alexnet,
+               bench_googlenet, bench_lstm, bench_lm_8k):
         try:
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide

@@ -24,6 +24,11 @@ _DTYPE_MAP = {
     "float32": jnp.float32,
     "float64": jnp.float64,
 }
+# fp8 storage types (quantized-execution plane); availability depends on
+# the jax/ml_dtypes build, so register only what exists
+for _f8 in ("float8_e4m3fn", "float8_e5m2"):
+    if hasattr(jnp, _f8):
+        _DTYPE_MAP[_f8] = getattr(jnp, _f8)
 
 _CANONICAL = {np.dtype(v).name: k for k, v in _DTYPE_MAP.items()}
 _CANONICAL["bfloat16"] = "bfloat16"

@@ -87,6 +87,30 @@ define_flag("amp_bf16", False,
             "MXU as bfloat16 (f32 accumulation, f32 master params) — the "
             "capability of the reference's float16 transpiler "
             "(contrib/float16), applied at lowering time.")
+define_flag("quantize_dtype", "",
+            "Real low-precision matmul execution (ops/quantize_ops.py): "
+            "'' = off; 'int8' = dynamic-scale int8 x int8 -> int32 "
+            "dot_general; 'e4m3'/'e5m2' = fp8 matmul with f32 "
+            "accumulation.  Applies to the mul/matmul/bmm op family at "
+            "lowering time with straight-through (bf16) gradients — the "
+            "training-side twin of QuantizeTranspiler.freeze_program, "
+            "which emits genuinely quantized programs regardless of "
+            "this flag.  Part of the executor's compile key: toggling "
+            "it recompiles instead of aliasing executables.")
+define_flag("fuse_block", False,
+            "Fuse whole transformer blocks (LN -> attention -> residual "
+            "-> LN -> MLP -> residual) into single fused_transformer_"
+            "block ops via transpiler/fused_block.py pattern matching; "
+            "the op lowers to the Pallas VMEM-resident block kernel "
+            "(kernels/fused_block.py) on TPU and to an equivalent XLA "
+            "composition elsewhere.  Part of the executor's compile "
+            "key.")
+define_flag("prefetch_depth", 0,
+            "Trainer input pipeline: number of feed batches the "
+            "device-prefetch wrapper (reader.device_prefetch) stages on "
+            "device AHEAD of the training step (double buffering = 2). "
+            "0 disables; feed build + host->device copy then happen "
+            "synchronously inside the step's data wait.")
 
 # --- compiled-program introspection (observability/: costmodel, flight) ----
 define_flag("cost_model", True,

@@ -532,6 +532,10 @@ class _CompiledProgram:
                         f"the microbatch scan; fetch the loss "
                         f"({loss!r}) or persistable state instead")
         jit_kwargs = {"donate_argnums": (0,) if donate else ()}
+        # donate-feeds twin executable (trainer prefetch path: fresh
+        # device feed buffers every step are safe to donate) — built
+        # lazily from the same step fn + jit kwargs
+        self._jitted_donate = None
         self._multi_cache: Dict[tuple, Any] = {}
         # cost-model plane (observability/costmodel.py): abstract args
         # are noted at first dispatch (ShapeDtypeStructs — no device
@@ -635,6 +639,7 @@ class _CompiledProgram:
             except TypeError:
                 sm = shard_map(spmd_step, check_rep=False, **sm_kwargs)
             self._step_fn = sm
+            self._jit_kwargs = jit_kwargs
             self._jitted = jax.jit(sm, **jit_kwargs)
             return
         if mesh is not None:
@@ -673,7 +678,22 @@ class _CompiledProgram:
             self._state_sharding_fn = state_spec
             self._feed_sharding_fn = feed_spec
         self._step_fn = self._step
+        self._jit_kwargs = jit_kwargs
         self._jitted = jax.jit(self._step, **jit_kwargs)
+
+    def jitted(self, donate_feeds: bool = False):
+        """The compiled step; with donate_feeds=True a twin executable
+        that ALSO donates the feed dict (argnum 1) — callers must hand
+        over fresh per-step device buffers (the reader.device_prefetch
+        path), never a staged batch they intend to re-feed."""
+        if not donate_feeds:
+            return self._jitted
+        if self._jitted_donate is None:
+            kwargs = dict(self._jit_kwargs)
+            kwargs["donate_argnums"] = tuple(
+                sorted(set(kwargs.get("donate_argnums", ())) | {0, 1}))
+            self._jitted_donate = jax.jit(self._step_fn, **kwargs)
+        return self._jitted_donate
 
     def jitted_steps(self, steps: int, seq_names: tuple):
         """A device-side training loop: `steps` iterations of the
@@ -1000,7 +1020,8 @@ class Executor:
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None,
             scope: Optional[Scope] = None,
-            return_numpy: bool = True):
+            return_numpy: bool = True,
+            donate_feeds: bool = False):
         program = program or default_main_program()
         scope = scope or self.scope
         # chaos site: a raise/delay here models a failed/slow device
@@ -1029,8 +1050,19 @@ class Executor:
                     _profile_state.active = False
                 mode = "eager"
             else:
-                fetches, new_state = compiled._jitted(state, dev_feeds,
-                                                      root)
+                fn = compiled.jitted(donate_feeds)
+                if donate_feeds:
+                    # feed buffers rarely alias an output shape; jax
+                    # warns per unusable donation — the donation is
+                    # intentional (frees the prefetch buffers early),
+                    # the per-step warning is noise
+                    with warnings.catch_warnings():
+                        warnings.filterwarnings(
+                            "ignore",
+                            message=".*donated buffers were not usable.*")
+                        fetches, new_state = fn(state, dev_feeds, root)
+                else:
+                    fetches, new_state = fn(state, dev_feeds, root)
                 mode = "jit"
             dt = time.perf_counter() - t0
         _m_step_seconds.labels(mode=mode).observe(dt)
@@ -1181,13 +1213,19 @@ class Executor:
         state_sig = tuple(sorted((n, tuple(a.shape), str(a.dtype))
                                  for n, a in state.items()))
         # numerics-affecting flags are baked in at trace time, so a
-        # runtime toggle must compile a fresh executable
+        # runtime toggle must compile a fresh executable — and because
+        # they are part of the key (and of forensics' KeyParts), a
+        # quantize_dtype/fuse_block flip is diagnosed as "flags" drift
+        # instead of reading as a recompile storm
         flags_sig = (("amp_bf16", bool(flags.get_flag("amp_bf16"))),
                      ("use_pallas_kernels",
-                      bool(flags.get_flag("use_pallas_kernels"))))
+                      bool(flags.get_flag("use_pallas_kernels"))),
+                     ("quantize_dtype",
+                      str(flags.get_flag("quantize_dtype"))),
+                     ("fuse_block", bool(flags.get_flag("fuse_block"))))
         key = (program._uid, program._version, feeds_sig,
-               tuple(fetch_names), state_sig,
-               flags_sig[0][1], flags_sig[1][1])
+               tuple(fetch_names), state_sig) \
+            + tuple(v for _, v in flags_sig)
         compiled = self._cache.get(key)
         if compiled is None:
             if flags.get_flag("executor_log_compiles"):
@@ -1302,7 +1340,8 @@ class Executor:
                     owner=self._forensics_owner),
             },
             "flags": {k: flags.get_flag(k) for k in
-                      ("amp_bf16", "use_pallas_kernels", "cost_model")},
+                      ("amp_bf16", "use_pallas_kernels", "cost_model",
+                       "quantize_dtype", "fuse_block")},
         }
 
     def last_run_cost(self, prefer_analytic: bool = False):

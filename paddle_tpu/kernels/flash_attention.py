@@ -39,6 +39,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 spells CompilerParams TPUCompilerParams; the alias keeps
+# the kernels importable (and interpret-mode runnable) on older builds
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 NEG_INF = -1e30
 _LANES = 128
 # one-pass multi-K-block HDT backward (vs the two-kernel fallback)
@@ -175,7 +180,7 @@ def _flash_fwd(q, k, v, scale, causal, interpret, block_q, block_k,
             pltpu.VMEM((g, block_q, _LANES), jnp.float32),  # running max
             pltpu.VMEM((g, block_q, _LANES), jnp.float32),  # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -349,7 +354,7 @@ def _flash_bwd(scale, causal, kv_len, interpret, res, do,
                        jax.ShapeDtypeStruct((BH, T, d), v.dtype)],
             scratch_shapes=[pltpu.VMEM((g, block_k, d), jnp.float32),
                             pltpu.VMEM((g, block_k, d), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interpret,
         )(q, do, lse3, delta, k, v)
@@ -380,7 +385,7 @@ def _flash_bwd(scale, causal, kv_len, interpret, res, do,
                    jax.ShapeDtypeStruct((BH, T, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((g, block_k, d), jnp.float32),
                         pltpu.VMEM((g, block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, do, lse3, delta, k, v)
@@ -397,7 +402,7 @@ def _flash_bwd(scale, causal, kv_len, interpret, res, do,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((g, block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, do, lse3, delta, k, v)
@@ -691,7 +696,7 @@ def _flash_fwd_hdt(q, k, v, B, scale, causal, interpret, block_q,
             pltpu.VMEM((g, 8, block_q), jnp.float32),    # running max
             pltpu.VMEM((g, 8, block_q), jnp.float32),    # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -795,7 +800,7 @@ def _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res, do,
             out_shape=out_shapes,
             scratch_shapes=[pltpu.VMEM((g, d, block_k), jnp.float32),
                             pltpu.VMEM((g, dv, block_k), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel",
                                      "arbitrary")),
             interpret=interpret,
@@ -833,7 +838,7 @@ def _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res, do,
                                             jnp.float32),
                        jax.ShapeDtypeStruct((H_, d, Nk_), jnp.float32),
                        jax.ShapeDtypeStruct((H_, dv, Nk_), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel",
                                      "arbitrary", "arbitrary")),
             interpret=interpret,
@@ -854,7 +859,7 @@ def _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res, do,
         out_shape=out_shapes[1:],
         scratch_shapes=[pltpu.VMEM((g, d, block_k), jnp.float32),
                         pltpu.VMEM((g, dv, block_k), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -872,7 +877,7 @@ def _flash_bwd_hdt(B, scale, causal, kv_len, interpret, res, do,
         out_specs=qsp(d, iq2),
         out_shape=out_shapes[0],
         scratch_shapes=[pltpu.VMEM((g, d, block_q), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

@@ -1,0 +1,308 @@
+"""Pallas fused transformer block: LN -> attention -> residual -> LN ->
+MLP -> residual in ONE kernel.
+
+The unfused pre-norm block (models/transformer.py encoder_layer) writes
+every intermediate — LN output, q/k/v, attention context, residual sum,
+second LN, the [N, F] MLP hidden — to HBM and reads it back.  This
+kernel keeps ALL of them in VMEM for a block of queries: the only HBM
+traffic is the input x block (read twice: as queries and as keys), the
+weights (VMEM-resident across the K sweep) and the output block.
+
+Design (pallas_guide.md):
+
+  * grid = (B, Tq/block_q, Tk/block_k), K innermost ("arbitrary"): the
+    flash-attention online-softmax recurrence runs per head over the K
+    sweep while q, the softmax stats and the attention accumulator stay
+    in VMEM scratch.
+  * LN1 of the KEY block is recomputed per (q, k) pair — O(T^2/block)
+    extra VPU work, which is what buys zero HBM round trips for the LN
+    output (the flash-style remat trade).
+  * at the last K step the epilogue runs entirely in VMEM: output
+    projection + residual + LN2 + MLP + residual, then ONE output
+    store.
+  * matmuls take input-dtype operands (bf16 under AMP) with f32
+    accumulation via preferred_element_type; LN statistics, softmax
+    stats and the MLP hidden stay f32.
+  * causal blocks strictly above the diagonal skip compute (pl.when);
+    ragged sequence tails are padded to the 128 granule and the padded
+    KEYS masked via kv_len (padded query rows are sliced off outside).
+
+Backward: custom VJP — the block recomputes from (x, params) through
+`block_reference`, the numerically-matching XLA composition (which
+itself routes attention through the Pallas flash kernel on TPU), so
+training memory stays O(block) for the fused forward while gradients
+are exact for the reference math.  Off-TPU the op lowering uses
+`block_reference` directly (no Pallas), keeping CPU tier-1 green; the
+kernel itself also runs under interpret=True for numerics tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _pick_block
+
+NEG_INF = -1e30
+_LANES = 128
+_SEQ_GRANULE = 128
+
+# jax < 0.5 spells CompilerParams TPUCompilerParams; the alias keeps
+# interpret-mode tests runnable on older builds
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+
+def _ln_affine(x, g, b, eps):
+    """f32 layer norm over the last axis with affine params."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    return (xc * lax.rsqrt(var + eps) * g.astype(jnp.float32)
+            + b.astype(jnp.float32))
+
+
+def block_reference(x, p, n_head, causal, eps1=1e-5, eps2=1e-5,
+                    use_flash=False, interpret=None):
+    """The XLA composition the kernel must match (and the source of its
+    gradients): pre-norm attention + MLP with residuals, input-dtype
+    matmul operands, f32 accumulation/statistics.  use_flash routes the
+    attention through kernels/flash_attention.py (the TPU backward
+    path)."""
+    ln1g, ln1b, wq, wk, wv, wo, ln2g, ln2b, w1, b1, w2, b2 = p
+    cd = x.dtype
+    B, T, D = x.shape
+    E = wq.shape[1]
+    dh = E // n_head
+
+    def mm(a, b_):
+        return lax.dot_general(a, b_, (((a.ndim - 1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+    a = _ln_affine(x, ln1g, ln1b, eps1).astype(cd)
+    q = mm(a, wq).astype(cd)
+    k = mm(a, wk).astype(cd)
+    v = mm(a, wv).astype(cd)
+
+    def split(t):
+        return t.reshape(B, T, n_head, dh).transpose(0, 2, 1, 3)
+
+    if use_flash:
+        from .flash_attention import flash_attention
+        o = flash_attention(split(q), split(k), split(v), causal=causal,
+                            interpret=interpret)
+    else:
+        qh, kh, vh = split(q), split(k), split(v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       preferred_element_type=jnp.float32)
+        s = s * (dh ** -0.5)
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        w_att = jax.nn.softmax(s, -1).astype(cd)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w_att, vh)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, E)
+    h = (x.astype(jnp.float32) + mm(o.astype(cd), wo)).astype(cd)
+    f = _ln_affine(h, ln2g, ln2b, eps2).astype(cd)
+    u = jnp.maximum(mm(f, w1) + b1.astype(jnp.float32), 0.0).astype(cd)
+    y = mm(u, w2) + b2.astype(jnp.float32)
+    return (h.astype(jnp.float32) + y).astype(cd)
+
+
+def _block_kernel(xq_ref, xk_ref, ln1g_ref, ln1b_ref, wq_ref, wk_ref,
+                  wv_ref, wo_ref, ln2g_ref, ln2b_ref, w1_ref, b1_ref,
+                  w2_ref, b2_ref, out_ref, q_scr, acc_scr, m_scr, l_scr,
+                  *, block_q, block_k, nk, n_head, dh, scale, causal,
+                  kv_len, eps1, eps2):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    cd = xq_ref.dtype
+
+    def ln(xb, g_ref, b_ref, eps):
+        return _ln_affine(xb, g_ref[...], b_ref[...], eps)
+
+    @pl.when(ki == 0)
+    def _init():
+        a_q = ln(xq_ref[0], ln1g_ref, ln1b_ref, eps1).astype(cd)
+        q = lax.dot_general(a_q, wq_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        # scale folded into the stored q: one [bq, E] multiply instead
+        # of a per-(head, k-block) one on the scores
+        q_scr[...] = q * scale
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _attend():
+        a_k = ln(xk_ref[0], ln1g_ref, ln1b_ref, eps1).astype(cd)
+        k = lax.dot_general(a_k, wk_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        v = lax.dot_general(a_k, wv_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        k_pos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = None
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = q_pos >= k_pos
+        if kv_len is not None:
+            live = k_pos < kv_len
+            mask = live if mask is None else (mask & live)
+        for h in range(n_head):
+            sl = slice(h * dh, (h + 1) * dh)
+            s = lax.dot_general(
+                q_scr[:, sl].astype(cd), k[:, sl].astype(cd),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_scr[:, h:h + 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[:, h:h + 1] = (l_scr[:, h:h + 1] * corr
+                                 + jnp.sum(p, axis=1, keepdims=True))
+            m_scr[:, h:h + 1] = m_new
+            acc_scr[:, sl] = acc_scr[:, sl] * corr + lax.dot_general(
+                p.astype(cd), v[:, sl].astype(cd),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _epilogue():
+        o = jnp.concatenate(
+            [acc_scr[:, h * dh:(h + 1) * dh]
+             / jnp.maximum(l_scr[:, h:h + 1], 1e-30)
+             for h in range(n_head)], axis=1).astype(cd)
+        attn = lax.dot_general(o, wo_ref[...], (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        hres = (xq_ref[0].astype(jnp.float32) + attn).astype(cd)
+        f = ln(hres, ln2g_ref, ln2b_ref, eps2).astype(cd)
+        u = jnp.maximum(
+            lax.dot_general(f, w1_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+            + b1_ref[...].astype(jnp.float32), 0.0).astype(cd)
+        y = lax.dot_general(u, w2_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) \
+            + b2_ref[...].astype(jnp.float32)
+        out_ref[0] = (hres.astype(jnp.float32) + y).astype(out_ref.dtype)
+
+
+def _block_fwd_pallas(x, p, n_head, causal, eps1, eps2, interpret,
+                      block_q, block_k):
+    """Pad the token dim to the 128 granule, run the fused kernel, slice
+    the pad back off.  x: [B, T, D]."""
+    ln1g, ln1b, wq, wk, wv, wo, ln2g, ln2b, w1, b1, w2, b2 = p
+    B, T, D = x.shape
+    E = wq.shape[1]
+    F = w1.shape[1]
+    if E % n_head:
+        raise ValueError(f"model width {E} not divisible by "
+                         f"n_head {n_head}")
+    if n_head > _LANES:
+        raise ValueError(f"fused block kernel tracks per-head softmax "
+                         f"stats in one {_LANES}-lane row; n_head "
+                         f"{n_head} > {_LANES}")
+    dh = E // n_head
+    Tp = -(-T // _SEQ_GRANULE) * _SEQ_GRANULE
+    kv_len = T if Tp != T else None
+    xp = jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0))) if kv_len else x
+    bq = block_q or _pick_block(Tp, 256)
+    bk = block_k or _pick_block(Tp, 256)
+    nq, nk = Tp // bq, Tp // bk
+    kernel = functools.partial(
+        _block_kernel, block_q=bq, block_k=bk, nk=nk, n_head=n_head,
+        dh=dh, scale=float(dh) ** -0.5, causal=causal, kv_len=kv_len,
+        eps1=eps1, eps2=eps2)
+
+    def vec(n):
+        return pl.BlockSpec((n,), lambda b, i, j: (0,),
+                            memory_space=pltpu.VMEM)
+
+    def mat(r, c):
+        return pl.BlockSpec((r, c), lambda b, i, j: (0, 0),
+                            memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            vec(D), vec(D), mat(D, E), mat(D, E), mat(D, E), mat(E, D),
+            vec(D), vec(D), mat(D, F), vec(F), mat(F, D), vec(D),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Tp, D), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, E), jnp.float32),       # scaled q
+            pltpu.VMEM((bq, E), jnp.float32),       # attention acc
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running max / head
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running sum / head
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(xp, xp, ln1g, ln1b, wq, wk, wv, wo, ln2g, ln2b, w1, b1, w2, b2)
+    return out[:, :T] if kv_len else out
+
+
+@functools.lru_cache(maxsize=32)
+def _make_block(n_head, causal, eps1, eps2, interpret, block_q, block_k):
+    @jax.custom_vjp
+    def f(x, *p):
+        return _block_fwd_pallas(x, p, n_head, causal, eps1, eps2,
+                                 interpret, block_q, block_k)
+
+    def fwd(x, *p):
+        return f(x, *p), (x, p)
+
+    def bwd(res, g):
+        x, p = res
+        # exact gradients of the matching composition, rematerialized
+        # from (x, params); attention goes through the flash kernels on
+        # TPU (interpret mode keeps the pure-XLA path)
+        _, vjp_fn = jax.vjp(
+            lambda x_, *p_: block_reference(
+                x_, p_, n_head, causal, eps1, eps2,
+                use_flash=not interpret, interpret=interpret),
+            x, *p)
+        return vjp_fn(g.astype(x.dtype))
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def transformer_block(x, params, n_head, causal=False, eps1=1e-5,
+                      eps2=1e-5, interpret=None, use_pallas=None,
+                      block_q=None, block_k=None):
+    """One fused pre-norm transformer block.
+
+    x [B, T, D]; params = (ln1_scale, ln1_bias, wq, wk, wv, wo,
+    ln2_scale, ln2_bias, w1, b1, w2, b2) with wq/wk/wv [D, E], wo
+    [E, D], w1 [D, F], w2 [F, D].  Any T works (ragged tails are padded
+    to the 128 granule and the padded keys masked).  Differentiable wrt
+    x and every param.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if use_pallas is None:
+        use_pallas = not interpret
+    if not use_pallas:
+        return block_reference(x, tuple(params), n_head, causal,
+                               eps1, eps2)
+    f = _make_block(int(n_head), bool(causal), float(eps1), float(eps2),
+                    bool(interpret), block_q, block_k)
+    return f(x, *params)

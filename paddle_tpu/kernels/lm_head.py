@@ -37,6 +37,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 spells CompilerParams TPUCompilerParams; the alias keeps
+# the kernels importable (and interpret-mode runnable) on older builds
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 NEG_INF = -1e30
 _LANES = 128
 
@@ -158,7 +163,7 @@ def _fwd(x, w, y2d, interpret, block_n, block_v, valid_v):
             jax.ShapeDtypeStruct((N, 1), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((block_n, _LANES), jnp.float32)] * 3,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x, w, y2d)
@@ -231,7 +236,7 @@ def _bwd(x, w, y2d, lse, g, interpret, chunk, block_v, valid_v):
                 jax.ShapeDtypeStruct((D, Vp), jnp.float32),
             ],
             input_output_aliases={3: 1},   # dw accumulates in place
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("arbitrary",)),
             interpret=interpret,
         )(x[sl], w, stats[sl], dw)

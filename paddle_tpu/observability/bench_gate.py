@@ -97,6 +97,101 @@ def gate(baseline: Dict[str, float], candidate: Dict[str, float],
             "regressions": [r["metric"] for r in bad], "ok": not bad}
 
 
+def smoke() -> int:
+    """Fast perf-path sanity lane (tiny shapes, any backend; tier-1
+    runs it on CPU): asserts the gate plumbing end to end AND that the
+    quantized-execution path still compiles and matches its fake-quant
+    reference — so a broken int8/fuse path fails tests the same day,
+    not the nightly bench."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core import flags as ptflags
+    from paddle_tpu.transpiler import QuantizeTranspiler
+
+    failures: List[str] = []
+
+    def check(name, ok, detail=""):
+        print(f"[{'  ok' if ok else 'FAIL'}] smoke:{name}"
+              f"{' ' + detail if detail else ''}")
+        if not ok:
+            failures.append(name)
+
+    # 1. gate plumbing: ok / regression / missing / new verdicts and
+    #    the lower-is-better direction
+    r = gate({"a_tokens_per_sec": 100.0, "b_ms_per_batch": 10.0,
+              "gone": 1.0},
+             {"a_tokens_per_sec": 95.0, "b_ms_per_batch": 20.0,
+              "fresh": 2.0}, tolerance=0.15, allow_missing=True)
+    by = {row["metric"]: row["status"] for row in r["rows"]}
+    check("gate_verdicts",
+          by == {"a_tokens_per_sec": "ok", "b_ms_per_batch": "regression",
+                 "gone": "missing", "fresh": "new"}
+          and not r["ok"], str(by))
+
+    # 2. QAT -> freeze -> REAL int8 program compiles and matches the
+    #    fake-quant reference
+    def build():
+        x = layers.data("x", [8], dtype="float32")
+        h = layers.fc(x, size=8, act="relu")
+        return layers.fc(h, size=4)
+
+    try:
+        pt.reset_default_programs()
+        main_p, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup):
+            pred = build()
+        qt = QuantizeTranspiler(
+            activation_quantize_type="moving_average_abs_max")
+        qt.training_transpile(main_p, startup)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(16, 8).astype("float32")}
+        for _ in range(3):      # advance the moving-average scales
+            exe.run(main_p, feed=feed, fetch_list=[pred])
+        ref, = exe.run(main_p, feed=feed, fetch_list=[pred])
+        frozen = qt.freeze_program(main_p, scope=exe.scope,
+                                   quantize_dtype="int8")
+        got, = exe.run(frozen, feed=feed, fetch_list=[pred])
+        kinds = {op.type for op in frozen.global_block().ops}
+        err = float(np.max(np.abs(got - ref)))
+        tol = 0.05 * max(1.0, float(np.max(np.abs(ref))))
+        check("int8_freeze_compiles",
+              "quantized_matmul" in kinds and err <= tol,
+              f"maxdiff={err:.4g} tol={tol:.4g}")
+    except Exception as e:      # noqa: BLE001 — smoke must report, not die
+        check("int8_freeze_compiles", False, repr(e)[:200])
+
+    # 3. training-side quantize_dtype=int8 path compiles and steps
+    try:
+        pt.reset_default_programs()
+        y = layers.data("y", [4], dtype="float32")
+        pred = layers.fc(layers.fc(y, size=8, act="relu"), size=1)
+        loss = layers.mean(pred)
+        pt.optimizer.SGD(0.1).minimize(loss)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        old = ptflags.get_flag("quantize_dtype")
+        ptflags.set_flag("quantize_dtype", "int8")
+        try:
+            rng = np.random.RandomState(0)
+            lv, = exe.run(pt.default_main_program(),
+                          feed={"y": rng.randn(8, 4).astype("float32")},
+                          fetch_list=[loss])
+        finally:
+            ptflags.set_flag("quantize_dtype", old)
+        check("int8_train_step", bool(np.isfinite(lv).all()),
+              f"loss={float(np.asarray(lv).ravel()[0]):.4g}")
+    except Exception as e:      # noqa: BLE001
+        check("int8_train_step", False, repr(e)[:200])
+
+    print(json.dumps({"smoke": "ok" if not failures else "fail",
+                      "failures": failures}))
+    return 0 if not failures else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability.bench_gate",
@@ -109,7 +204,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--allow-missing", action="store_true",
                    help="baseline metrics absent from the candidate "
                         "do not fail the gate")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the fast perf-path sanity lane instead of "
+                        "a baseline comparison: gate plumbing + the "
+                        "quantized-execution path on tiny CPU shapes")
     args = p.parse_args(argv)
+    if args.smoke:
+        return smoke()
     try:
         with open(args.baseline) as f:
             base = load_metric_values(json.load(f))

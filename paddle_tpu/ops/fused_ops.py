@@ -459,3 +459,43 @@ def _moe_ffn(ctx, ins, attrs):
                           ep_axis=getattr(ctx, "ep_axis", None))
     return {"Out": [out.reshape(shape).astype(x.dtype)],
             "AuxLoss": [(aux * aw).reshape(1).astype(jnp.float32)]}
+
+
+@register_op("fused_transformer_block")
+def _fused_transformer_block(ctx, ins, attrs):
+    """One whole pre-norm transformer block (LN -> MHA -> residual ->
+    LN -> MLP -> residual) as a single op, emitted by
+    transpiler/fused_block.py pattern matching (FLAGS_fuse_block).
+
+    X [B, T, D]; Wq/Wk/Wv [D, E], Wo [E, D], W1 [D, F], W2 [F, D],
+    LN scales/biases [D], B1 [F], B2 [D].  attrs: n_head, causal,
+    eps1, eps2.  Lowers to the VMEM-resident Pallas block kernel
+    (kernels/fused_block.py) on TPU; elsewhere to the numerically
+    matching XLA composition, so CPU tests and the interpret path stay
+    green.  No reference equivalent (2018 codebase has no fusion past
+    single ops)."""
+    from ..core import flags
+    from ..kernels.fused_block import transformer_block
+    from .math_ops import amp_inputs, amp_result
+    x = ins["X"][0]
+    ln1g, ln1b = ins["Ln1Scale"][0], ins["Ln1Bias"][0]
+    ln2g, ln2b = ins["Ln2Scale"][0], ins["Ln2Bias"][0]
+    b1, b2 = ins["B1"][0], ins["B2"][0]
+    orig = x.dtype
+    # amp casts the MATMUL operands only; LN affine params and biases
+    # stay f32 (matching the unfused program, where LN math is f32 and
+    # bias adds promote)
+    xb, wq, wk, wv, wo, w1, w2 = amp_inputs(
+        x, ins["Wq"][0], ins["Wk"][0], ins["Wv"][0], ins["Wo"][0],
+        ins["W1"][0], ins["W2"][0])
+    interpret = ctx.pallas_interpret()
+    use_pallas = bool(flags.get_flag("use_pallas_kernels")) \
+        and not interpret
+    out = transformer_block(
+        xb, (ln1g, ln1b, wq, wk, wv, wo, ln2g, ln2b, w1, b1, w2, b2),
+        n_head=int(attrs["n_head"]),
+        causal=bool(attrs.get("causal", False)),
+        eps1=float(attrs.get("eps1", 1e-5)),
+        eps2=float(attrs.get("eps2", 1e-5)),
+        interpret=interpret, use_pallas=use_pallas)
+    return {"Out": [amp_result(out, orig)]}

@@ -61,8 +61,18 @@ def amp_matmul(x, y, orig_dtype=None):
     an unfused convert_element_type pass over the [N, F] activations
     (~1 ms/step on the flagship; docs/profile_r04 math_ops.py rows).
     f32 operands keep the old path: f32 accumulation surfaced, then
-    amp_result decides the output plane."""
+    amp_result decides the output plane.
+
+    Under FLAGS_quantize_dtype the matmul leaves the bf16 plane
+    entirely: real int8/fp8 operands with dynamic scales and a
+    straight-through bf16 backward (ops/quantize_ops.py
+    low_precision_matmul)."""
     orig = x.dtype if orig_dtype is None else orig_dtype
+    qd = flags.get_flag("quantize_dtype")
+    if (qd and jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.issubdtype(y.dtype, jnp.floating)):
+        from .quantize_ops import low_precision_matmul
+        return low_precision_matmul(x, y, str(qd), orig)
     x, y = amp_inputs(x, y)
     if jnp.dtype(x.dtype).itemsize == 2:
         out = jnp.matmul(x, y)          # 2-byte in -> 2-byte out

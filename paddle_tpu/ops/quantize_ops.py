@@ -1,16 +1,36 @@
-"""Fake quantization ops for QAT.
+"""Fake quantization ops for QAT — and REAL low-precision execution.
 
 Parity with /root/reference/paddle/fluid/operators/fake_quantize_op.cc
 (abs-max and moving-average-abs-max variants) and fake_dequantize_op.cc.
 Quantize-dequantize in one op (straight-through estimator): rounding is
 a zero-gradient op, so the executor's whole-program vjp sees identity —
 exactly the reference's QAT training semantics.
+
+The reference only ever SIMULATED int8 (its quantize_transpiler folds
+scales at freeze time and hopes a downstream engine has an int8 kernel).
+On TPU we execute it: the second half of this module is the real thing —
+
+  * ``low_precision_matmul``: dynamic-scale int8 x int8 -> int32 (or fp8
+    -> f32) dot_general with straight-through bf16 gradients, routed
+    under every mul/matmul/bmm by the ``quantize_dtype`` flag
+    (training-side path);
+  * ``quantized_matmul`` / ``quantized_conv2d`` ops: consume weights
+    ALREADY quantized at freeze time (int8/fp8 values + per-channel f32
+    scales in the scope), quantize the activation on the fly (frozen
+    moving-average scale when recorded, dynamic abs-max otherwise) and
+    contract on the low-precision units — what
+    QuantizeTranspiler.freeze_program emits.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..core import flags
+from ..core.enforce import EnforceNotMet
 from ..framework.registry import register_op, single_input
 
 
@@ -74,3 +94,195 @@ def _fake_dequantize(ctx, ins, attrs):
     scale = ins["Scale"][0]
     max_range = float(attrs.get("max_range", 127.0))
     return {"Out": [(x * scale / max_range).astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# Real low-precision execution.
+# ---------------------------------------------------------------------------
+
+# storage dtype and representable max per quantize_dtype spelling
+_QSPECS = {
+    "int8": (jnp.int8, 127.0),
+    "e4m3": ("float8_e4m3fn", 448.0),
+    "e5m2": ("float8_e5m2", 57344.0),
+}
+
+
+def qspec(quantize_dtype: str):
+    """(storage jnp dtype, qmax) for a quantize_dtype spelling; raises
+    with the valid vocabulary on an unknown one."""
+    if quantize_dtype not in _QSPECS:
+        raise EnforceNotMet(
+            f"unknown quantize_dtype {quantize_dtype!r}: expected one of "
+            f"{sorted(_QSPECS)} (or '' = disabled)")
+    dt, qmax = _QSPECS[quantize_dtype]
+    if isinstance(dt, str):
+        dt = getattr(jnp, dt, None)
+        if dt is None:
+            raise EnforceNotMet(
+                f"quantize_dtype {quantize_dtype!r} needs jax fp8 dtype "
+                f"support, which this jax build lacks; use 'int8'")
+    return dt, qmax
+
+
+def quantize_array(x, scale, quantize_dtype: str):
+    """x / scale mapped onto the storage grid: int8 rounds+clips, fp8
+    casts (the cast saturates).  `scale` is the absmax the grid's qmax
+    should land on; broadcastable against x."""
+    dt, qmax = qspec(quantize_dtype)
+    s = jnp.maximum(scale.astype(jnp.float32), 1e-8)
+    # clip BOTH grids: int8 rounds, fp8 saturates — but a frozen scale
+    # smaller than the live absmax would otherwise overflow fp8 to inf
+    y = jnp.clip(x.astype(jnp.float32) / s * qmax, -qmax, qmax)
+    if quantize_dtype == "int8":
+        return jnp.round(y).astype(dt)
+    return y.astype(dt)
+
+
+def channel_scales(w: np.ndarray, axis: int) -> np.ndarray:
+    """Per-channel absmax scales along `axis` (host-side, freeze time)."""
+    axes = tuple(a for a in range(w.ndim) if a != axis)
+    return np.maximum(np.abs(w).max(axis=axes), 1e-8).astype("float32")
+
+
+def _dequant_spec(scale, quantize_dtype):
+    _, qmax = qspec(quantize_dtype)
+    return jnp.maximum(scale.astype(jnp.float32), 1e-8) / qmax
+
+
+def _acc_dtype(quantize_dtype):
+    return jnp.int32 if quantize_dtype == "int8" else jnp.float32
+
+
+@functools.lru_cache(maxsize=8)
+def _make_lp_matmul(quantize_dtype: str, out_dtype_name: str):
+    """Low-precision matmul with straight-through gradients: forward
+    quantizes BOTH operands with dynamic scales (per-tensor x, last-axis
+    per-channel y — the weight layout of mul/fc) and contracts on the
+    int8/fp8 units; backward treats quantization as identity and runs
+    the plain (amp-policy) matmul vjp — the STE contract of the fake
+    ops, now with a real low-precision forward."""
+    out_dtype = jnp.dtype(out_dtype_name)
+
+    def lp_forward(x, y):
+        _, qmax = qspec(quantize_dtype)
+        sx = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        # per-channel over y's LAST axis (output features); reduce all
+        # other axes so batched matmuls get one scale row
+        red = tuple(range(y.ndim - 1))
+        sy = jnp.max(jnp.abs(y), axis=red).astype(jnp.float32)
+        xq = quantize_array(x, sx, quantize_dtype)
+        yq = quantize_array(y, sy.reshape((1,) * (y.ndim - 1) + (-1,)),
+                            quantize_dtype)
+        acc = jnp.matmul(xq, yq,
+                         preferred_element_type=_acc_dtype(quantize_dtype))
+        out = (acc.astype(jnp.float32)
+               * _dequant_spec(sx, quantize_dtype)
+               * _dequant_spec(sy, quantize_dtype))
+        return out.astype(out_dtype)
+
+    def surrogate(x, y):
+        # the identity the STE backward differentiates: the amp-policy
+        # matmul (bf16 operands under FLAGS_amp_bf16, f32 accumulation)
+        from .math_ops import _acc_type, amp_inputs
+        xa, ya = amp_inputs(x, y)
+        out = jnp.matmul(xa, ya, preferred_element_type=_acc_type(xa))
+        return out.astype(out_dtype)
+
+    @jax.custom_vjp
+    def f(x, y):
+        return lp_forward(x, y)
+
+    def fwd(x, y):
+        return lp_forward(x, y), (x, y)
+
+    def bwd(res, g):
+        x, y = res
+        _, vjp_fn = jax.vjp(surrogate, x, y)
+        return vjp_fn(g.astype(out_dtype))
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def low_precision_matmul(x, y, quantize_dtype: str, orig_dtype):
+    """The quantize_dtype-flag path used by math_ops.amp_matmul: real
+    int8/fp8 forward, STE backward.  Output dtype follows the amp
+    policy (bf16 surface under FLAGS_amp_bf16, else orig)."""
+    want = (jnp.bfloat16
+            if (flags.get_flag("amp_bf16")
+                and jnp.dtype(orig_dtype) == jnp.float32)
+            else jnp.dtype(orig_dtype))
+    return _make_lp_matmul(quantize_dtype, jnp.dtype(want).name)(x, y)
+
+
+@register_op("quantized_matmul")
+def _quantized_matmul(ctx, ins, attrs):
+    """Frozen-program matmul on genuinely quantized weights (what
+    QuantizeTranspiler.freeze_program emits in place of fc's mul).
+
+    X [..., K] float activation; W int8/fp8 [K, N] quantized at freeze
+    time; WScale [N] f32 per-channel absmax of the original weight;
+    optional InScale [] f32 = the trained moving-average activation
+    scale (absent -> dynamic abs-max quantization per dispatch).
+    attrs: quantize_dtype, x_num_col_dims (mul flattening contract).
+
+    int8 x int8 contracts to int32 via preferred_element_type (the MXU
+    int path); scales apply POST-accumulation:
+        out = acc * (sx/qmax) * (sw[N]/qmax)
+    """
+    x = single_input(ins, "X")
+    w = ins["W"][0]
+    w_scale = ins["WScale"][0]
+    qd = str(attrs.get("quantize_dtype", "int8"))
+    xn = int(attrs.get("x_num_col_dims", 1))
+    lead = int(np.prod(x.shape[:xn])) if xn else 1
+    x2 = x.reshape(lead, -1)
+    if ins.get("InScale"):
+        sx = ins["InScale"][0].astype(jnp.float32).reshape(())
+    else:
+        sx = jnp.max(jnp.abs(x2)).astype(jnp.float32)
+    xq = quantize_array(x2, sx, qd)
+    acc = jax.lax.dot_general(xq, w, (((1,), (0,)), ((), ())),
+                              preferred_element_type=_acc_dtype(qd))
+    out = (acc.astype(jnp.float32)
+           * _dequant_spec(sx, qd)
+           * _dequant_spec(w_scale, qd))
+    out_shape = x.shape[:xn] + (w.shape[1],)
+    want = (jnp.bfloat16 if (flags.get_flag("amp_bf16")
+                             and jnp.dtype(x.dtype) == jnp.float32)
+            else x.dtype)
+    return {"Out": [out.reshape(out_shape).astype(want)]}
+
+
+@register_op("quantized_conv2d")
+def _quantized_conv2d(ctx, ins, attrs):
+    """Frozen int8 conv2d (NCHW x OIHW): Filter quantized per output
+    channel at freeze time, activation quantized per-tensor on the fly,
+    int8 x int8 -> int32 accumulation, scales applied post-accumulation
+    over the output-channel dim."""
+    x = ins["Input"][0]
+    w = ins["Filter"][0]
+    f_scale = ins["FilterScale"][0]
+    qd = str(attrs.get("quantize_dtype", "int8"))
+    strides = tuple(attrs.get("strides", (1, 1)))
+    pads = [(int(p), int(p)) for p in attrs.get("paddings", (0, 0))]
+    dils = tuple(attrs.get("dilations", (1, 1)))
+    groups = int(attrs.get("groups", 1))
+    if ins.get("InScale"):
+        sx = ins["InScale"][0].astype(jnp.float32).reshape(())
+    else:
+        sx = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    xq = quantize_array(x, sx, qd)
+    acc = jax.lax.conv_general_dilated(
+        xq, w, window_strides=strides, padding=pads,
+        rhs_dilation=dils, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=_acc_dtype(qd))
+    out = (acc.astype(jnp.float32)
+           * _dequant_spec(sx, qd)
+           * _dequant_spec(f_scale, qd).reshape(1, -1, 1, 1))
+    want = (jnp.bfloat16 if (flags.get_flag("amp_bf16")
+                             and jnp.dtype(x.dtype) == jnp.float32)
+            else x.dtype)
+    return {"Output": [out.astype(want)]}

@@ -1,4 +1,5 @@
 """Reader pipeline (ref python/paddle/reader/)."""
-from .decorator import (PipeReader, batch, buffered, cache, chain, compose,
-                        firstn, map_readers, multiprocess_reader, shuffle,
+from .decorator import (DeviceBatch, PipeReader, batch, buffered, cache,
+                        chain, compose, device_prefetch, firstn,
+                        map_readers, multiprocess_reader, shuffle,
                         xmap_readers)

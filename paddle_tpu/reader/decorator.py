@@ -42,7 +42,84 @@ _MAX_ANON_BUFFERED_LABELS = 64
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
            "firstn", "xmap_readers", "multiprocess_reader", "batch",
-           "cache", "PipeReader"]
+           "cache", "PipeReader", "DeviceBatch", "device_prefetch"]
+
+
+class DeviceBatch:
+    """One prefetched step's feed, already staged on DEVICE by
+    ``device_prefetch``: ``feed`` is a {name: jax.Array} dict ready to
+    hand to Executor.run, ``size`` the raw batch size (the trainer's
+    examples/s denominator).  The consumer must treat the buffers as
+    single-use — the trainer donates them to the step."""
+
+    __slots__ = ("feed", "size")
+
+    def __init__(self, feed, size):
+        self.feed = feed
+        self.size = size
+
+
+def device_prefetch(reader, size: int = 2, feeder=None, device=None,
+                    name: str = "device_prefetch"):
+    """Async DEVICE prefetch: a background thread builds each step's
+    feed (via ``feeder.feed`` when given, else the reader must yield
+    {name: array} dicts) and stages it on device with jax.device_put
+    while the consumer's CURRENT step runs on the accelerator — the
+    double-buffered input pipeline (size=2) that takes the reader wait
+    AND the host->device copy out of the training step entirely.  Yields
+    DeviceBatch items; queue depth rides the ``reader_buffer_depth``
+    gauge under `name`.  Producer exceptions re-raise in the consumer.
+    """
+    import jax
+
+    depth_gauge = _m_buffer_depth.labels(reader=name)
+
+    class _End:
+        pass
+
+    class _Error:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def _stage(batch):
+        if feeder is not None:
+            feed = feeder.feed(batch)
+            n = len(batch)
+        else:
+            if not isinstance(batch, dict):
+                raise TypeError(
+                    "device_prefetch without a feeder needs the reader "
+                    "to yield {name: array} feed dicts; got "
+                    f"{type(batch).__name__}")
+            feed = batch
+            first = next(iter(batch.values()))
+            n = int(getattr(first, "shape", (1,))[0] or 1)
+        feed = {k: jax.device_put(v, device) for k, v in feed.items()}
+        return DeviceBatch(feed, n)
+
+    def data_reader():
+        q: queue.Queue = queue.Queue(maxsize=max(1, int(size)))
+
+        def producer():
+            try:
+                for d in reader():
+                    q.put(_stage(d))
+            except BaseException as exc:   # propagate to consumer
+                q.put(_Error(exc))
+            else:
+                q.put(_End)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            depth_gauge.set(q.qsize())
+            if e is _End:
+                break
+            if isinstance(e, _Error):
+                raise e.exc
+            yield e
+    return data_reader
 
 
 def map_readers(func, *readers):

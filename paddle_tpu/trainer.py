@@ -323,8 +323,10 @@ class Trainer:
 
     # -- loops -------------------------------------------------------------
     def train(self, num_epochs: int, event_handler: Callable,
-              reader: Callable, feed_order: Sequence[str]):
+              reader: Callable, feed_order: Sequence[str],
+              prefetch_depth: Optional[int] = None):
         from .data_feeder import DataFeeder
+        from .reader.decorator import DeviceBatch, device_prefetch
         block = self.train_program.global_block()
         feed_vars = [block.var(n) for n in feed_order]
         feeder = DataFeeder(feed_vars)
@@ -332,11 +334,28 @@ class Trainer:
         step_in_total = 0
         self.preempted = False
         health = rguard.NumericGuard(ema_decay=_EMA_DECAY)
+        # async input pipeline: a background thread builds feeds and
+        # stages them on device (jax.device_put) while the current step
+        # runs, so the step's data wait is only the NOT-hidden part and
+        # trainer_device_seconds stops charging host->device copies
+        depth = int(flags.get_flag("prefetch_depth")
+                    if prefetch_depth is None else prefetch_depth)
+        prefetch = depth > 0 and self.exe.mesh is None
+        if depth > 0 and self.exe.mesh is not None:
+            warnings.warn(
+                "prefetch_depth ignored under a mesh: feeds must stay "
+                "host-global arrays so jit's in_shardings can scatter "
+                "them", RuntimeWarning, stacklevel=2)
+        if prefetch:
+            reader = device_prefetch(
+                reader, size=depth, feeder=feeder,
+                device=self.exe.place.jax_device())
         stop = self._install_preemption_handlers()
         obs_server.ensure_started()     # obs_http_port flag, 0 = off
         obs_server.note_trainer_running(True)
         # step anatomy accumulators for the input-bound diagnosis
-        anatomy = {"data_wait": 0.0, "step": 0.0, "n": 0, "warned": False}
+        anatomy = {"data_wait": 0.0, "step": 0.0, "n": 0, "warned": False,
+                   "prefetch": prefetch}
         try:
             for epoch_id in range(self.epoch_offset, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
@@ -365,9 +384,18 @@ class Trainer:
                     th0 = time.perf_counter()
                     event_handler(begin)
                     handler_s = time.perf_counter() - th0
-                    tf = time.perf_counter()
-                    feed = feeder.feed(batch)
-                    data_wait += time.perf_counter() - tf
+                    if isinstance(batch, DeviceBatch):
+                        # prefetched: feed already built AND on device;
+                        # its buffers are single-use -> donate them
+                        feed = batch.feed
+                        n_examples = batch.size
+                        donate = True
+                    else:
+                        tf = time.perf_counter()
+                        feed = feeder.feed(batch)
+                        data_wait += time.perf_counter() - tf
+                        n_examples = len(batch)
+                        donate = False
                     with chaos.fault_point("trainer.step"):
                         # --- host: dispatch without blocking ----------
                         th = time.perf_counter()
@@ -375,10 +403,12 @@ class Trainer:
                             fetched = self.exe.run(self.train_program,
                                                    feed=feed,
                                                    fetch_list=fetch,
-                                                   return_numpy=False)
+                                                   return_numpy=False,
+                                                   donate_feeds=donate)
                         else:
                             self.exe.run(self.train_program, feed=feed,
-                                         fetch_list=[])
+                                         fetch_list=[],
+                                         donate_feeds=donate)
                             fetched = []
                         host_s = time.perf_counter() - th
                         # --- device: block-until-ready + D2H copy ----
@@ -413,7 +443,7 @@ class Trainer:
                     obs_server.note_trainer_step()
                     self._note_anatomy(anatomy, data_wait, dt)
                     if dt > 0:
-                        _m_examples_per_sec.set(len(batch) / dt)
+                        _m_examples_per_sec.set(n_examples / dt)
                         self._record_mfu(dt)
                     if metrics:
                         loss_val = float(np.mean(np.asarray(metrics[0])))
@@ -468,7 +498,13 @@ class Trainer:
         """Accumulate the step anatomy and warn ONCE per train() when
         the input pipeline dominates: cumulative data-wait above
         ``input_bound_warn_fraction`` of cumulative step time after
-        enough steps for the evidence to mean something."""
+        enough steps for the evidence to mean something.
+
+        Under the device-prefetch pipeline the measured data_wait is
+        already the OVERLAPPED wait — only the time the prefetch queue
+        could not hide (a hidden reader costs ~0 here, so a fully
+        overlapped pipeline stays quiet); the advice then is to deepen
+        the pipeline, not to enable it."""
         anatomy["data_wait"] += data_wait
         anatomy["step"] += dt
         anatomy["n"] += 1
@@ -481,12 +517,20 @@ class Trainer:
                 and anatomy["data_wait"] > frac * anatomy["step"]):
             anatomy["warned"] = True
             pct = 100.0 * anatomy["data_wait"] / anatomy["step"]
+            if anatomy.get("prefetch"):
+                what = ("un-hidden input wait (reader slower than the "
+                        "device even with async device prefetch)")
+                fix = ("grow prefetch_depth, parallelize decode "
+                       "(xmap_readers) or move it off the training host")
+            else:
+                what = "data wait (reader next + feed build)"
+                fix = ("enable async device prefetch (prefetch_depth "
+                       "flag / reader.device_prefetch) or grow "
+                       "reader.buffered()/xmap_readers parallelism")
             warnings.warn(
-                f"trainer is input-bound: data wait (reader next + feed "
-                f"build) is {pct:.0f}% of step time over {anatomy['n']} "
-                f"steps (threshold {100 * frac:.0f}%) — grow "
-                f"reader.buffered()/xmap_readers parallelism or move "
-                f"decode off the training host", RuntimeWarning,
+                f"trainer is input-bound: {what} is {pct:.0f}% of step "
+                f"time over {anatomy['n']} steps (threshold "
+                f"{100 * frac:.0f}%) — {fix}", RuntimeWarning,
                 stacklevel=3)
 
     # -- resilience plumbing (resilience/, docs/RESILIENCE.md) -------------

@@ -15,6 +15,7 @@ What remains a program transformation on TPU:
 """
 from .quantize_transpiler import QuantizeTranspiler
 from .inference_transpiler import InferenceTranspiler
+from .fused_block import FuseBlockTranspiler
 from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
 from .tensor_parallel import TensorParallelTranspiler

@@ -77,17 +77,26 @@ class QuantizeTranspiler:
             if self.activation_quantize_type == "moving_average_abs_max":
                 op_type = "fake_quantize_moving_average_abs_max"
                 # moving scale is persistable state, initialised to 1.0 in
-                # the startup program (ref quantize_transpiler scale state)
-                in_scale = block.create_var(
-                    unique_name.generate(name + ".in_scale"),
-                    shape=[], dtype="float32", persistable=True)
+                # the startup program (ref quantize_transpiler scale state).
+                # The name is DETERMINISTIC (no unique suffix): transpiling
+                # a train program and its for_test clone must yield the
+                # SAME scale var, so the scales trained by one are seen by
+                # the other through the scope — the reference's standard
+                # QAT flow (train, then freeze the test program)
+                scale_name = name + ".quant_in_scale"
+                in_scale = (block.var(scale_name)
+                            if block.has_var(scale_name) else
+                            block.create_var(scale_name, shape=[],
+                                             dtype="float32",
+                                             persistable=True))
                 sb = self._startup.global_block()
-                sb.create_var(in_scale.name, shape=[], dtype="float32",
-                              persistable=True)
-                sb.append_op("fill_constant", {},
-                             {"Out": [in_scale.name]},
-                             {"shape": [], "dtype": "float32",
-                              "value": 1.0})
+                if not sb.has_var(scale_name):
+                    sb.create_var(scale_name, shape=[], dtype="float32",
+                                  persistable=True)
+                    sb.append_op("fill_constant", {},
+                                 {"Out": [scale_name]},
+                                 {"shape": [], "dtype": "float32",
+                                  "value": 1.0})
                 inputs = {"X": [name], "InScale": [in_scale.name]}
                 attrs = {"bit_length": self.activation_bits,
                          "moving_rate": self.moving_rate, "is_test": False}
@@ -109,12 +118,179 @@ class QuantizeTranspiler:
         new_ops.append(op)
         return qname
 
-    def freeze_program(self, program: Program):
-        """Export-time: flip moving-average quant ops to is_test (scales
-        frozen) — the int8 kernel swap is XLA's int8 matmul when targeted."""
-        for b in program.blocks:
-            for op in b.ops:
-                if op.type == "fake_quantize_moving_average_abs_max":
-                    op.attrs["is_test"] = True
+    def freeze_program(self, program: Program, scope=None,
+                       quantize_dtype: str = "int8"):
+        """Export-time freeze with REAL quantized execution.
+
+        The reference's freeze only folds scales and hopes a downstream
+        engine has an int8 kernel (contrib quantize_transpiler.py:114 —
+        "the quantized ops ... are only supported in int8 kernels").
+        Here the rewrite emits genuinely quantized programs:
+
+          * weights are quantized ONCE (per-channel absmax along the
+            recorded quant_axis) into int8/fp8 arrays stored in the
+            scope, with f32 scale vectors beside them;
+          * each quantizable consumer (fc's ``mul``, plain ``matmul``,
+            ``conv2d``) becomes a ``quantized_matmul`` /
+            ``quantized_conv2d`` op reading the RAW activation: the op
+            quantizes it on the fly against the TRAINED moving-average
+            scale (wired in as InScale) or dynamically (abs_max), and
+            contracts on the low-precision units
+            (int8 x int8 -> int32 via preferred_element_type);
+          * fake-quantize ops whose outputs became dead are dropped;
+            surviving moving-average ops flip to is_test.
+
+        Programs whose quant ops were never trained are REJECTED: a
+        missing weight/scale in the scope (startup or training never
+        ran), or a moving-average scale still at its 1.0 initializer,
+        raises instead of silently folding garbage scales.
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core.enforce import EnforceNotMet
+        from ..framework.program import Operator
+        from ..ops.quantize_ops import channel_scales, qspec, quantize_array
+        if scope is None:
+            from ..framework.executor import global_scope
+            scope = global_scope()
+        qspec(quantize_dtype)           # validate the spelling up front
+        block = program.global_block()
+        params = {p.name for p in program.all_parameters()}
+
+        # quantized-var name -> (source var, fake op) for every fake
+        # quantize op in the program
+        fake_quant = ("fake_quantize_abs_max",
+                      "fake_quantize_moving_average_abs_max",
+                      "fake_quantize_range_abs_max",
+                      "fake_channel_wise_quantize_abs_max")
+        produced: dict = {}
+        for op in block.ops:
+            if op.type in fake_quant:
+                produced[op.outputs["Out"][0]] = (op.inputs["X"][0], op)
+
+        def _need(name, what):
+            v = scope.find_var(name)
+            if v is None:
+                raise EnforceNotMet(
+                    f"freeze_program: no recorded value for {what} "
+                    f"{name!r} in the scope — run the startup program "
+                    f"and train (or load trained params) before "
+                    f"freezing; freezing an untrained program would "
+                    f"fold garbage scales")
+            return np.asarray(v)
+
+        def _act_scale_input(src, fop):
+            """InScale wiring for one quantized activation input: the
+            trained moving-average scale var, or None (dynamic)."""
+            if fop.type != "fake_quantize_moving_average_abs_max":
+                return None
+            scale_name = fop.inputs["InScale"][0]
+            val = _need(scale_name, "moving-average activation scale")
+            if float(np.asarray(val).reshape(())) == 1.0:
+                raise EnforceNotMet(
+                    f"freeze_program: activation scale {scale_name!r} "
+                    f"is still at its 1.0 initializer — the quant op "
+                    f"was never trained (no recorded scales); run "
+                    f"training steps before freezing")
+            return scale_name
+
+        qweights: dict = {}     # (param, axis) -> (qname, scale_name)
+
+        def _quantize_weight(wname, axis, kind):
+            key = (wname, axis)
+            if key in qweights:
+                return qweights[key]
+            W = _need(wname, "weight")
+            if kind == "fake_channel_wise_quantize_abs_max":
+                scales = channel_scales(W, axis)
+            else:
+                scales = np.maximum(np.abs(W).max(), 1e-8).astype(
+                    "float32").reshape(())
+            shape = [1] * W.ndim
+            if scales.ndim:
+                shape[axis] = -1
+            wq = quantize_array(jnp.asarray(W),
+                                jnp.asarray(scales).reshape(shape),
+                                quantize_dtype)
+            dt = "int8" if quantize_dtype == "int8" else \
+                str(jnp.dtype(wq.dtype).name)
+            qname = unique_name.generate(wname + ".quantized_w")
+            sname = unique_name.generate(wname + ".w_scale")
+            block.create_var(qname, shape=W.shape, dtype=dt,
+                             persistable=True, stop_gradient=True)
+            block.create_var(sname, shape=scales.shape, dtype="float32",
+                             persistable=True, stop_gradient=True)
+            scope.set_var(qname, wq)
+            scope.set_var(sname, jnp.asarray(scales))
+            qweights[key] = (qname, sname)
+            return qweights[key]
+
+        def _rewrite(op):
+            """One consumer op -> its quantized twin, or None (keep)."""
+            if op.type == "mul":
+                w_slot, x_slot = "Y", "X"
+            elif op.type == "matmul":
+                if op.attrs.get("transpose_X") or \
+                        op.attrs.get("transpose_Y") or \
+                        float(op.attrs.get("alpha", 1.0)) != 1.0:
+                    return None
+                w_slot, x_slot = "Y", "X"
+            elif op.type == "conv2d":
+                w_slot, x_slot = "Filter", "Input"
+            else:
+                return None
+            wq_name = op.inputs.get(w_slot, [None])[0]
+            xq_name = op.inputs.get(x_slot, [None])[0]
+            if wq_name not in produced or xq_name not in produced:
+                return None
+            w_src, w_fop = produced[wq_name]
+            x_src, x_fop = produced[xq_name]
+            if w_src not in params:
+                return None     # weight input is not a parameter
+            axis = int(w_fop.attrs.get("quant_axis",
+                                       0 if op.type == "conv2d" else 1))
+            qname, sname = _quantize_weight(w_src, axis, w_fop.type)
+            in_scale = _act_scale_input(x_src, x_fop)
+            if op.type == "conv2d":
+                inputs = {"Input": [x_src], "Filter": [qname],
+                          "FilterScale": [sname]}
+                if in_scale:
+                    inputs["InScale"] = [in_scale]
+                return Operator(
+                    block, "quantized_conv2d", inputs,
+                    {"Output": [op.outputs["Output"][0]]},
+                    {"quantize_dtype": quantize_dtype,
+                     "strides": op.attrs.get("strides", [1, 1]),
+                     "paddings": op.attrs.get("paddings", [0, 0]),
+                     "dilations": op.attrs.get("dilations", [1, 1]),
+                     "groups": op.attrs.get("groups", 1)})
+            inputs = {"X": [x_src], "W": [qname], "WScale": [sname]}
+            if in_scale:
+                inputs["InScale"] = [in_scale]
+            return Operator(
+                block, "quantized_matmul", inputs,
+                {"Out": [op.outputs["Out"][0]]},
+                {"quantize_dtype": quantize_dtype,
+                 "x_num_col_dims": op.attrs.get("x_num_col_dims", 1)})
+
+        new_ops = []
+        for op in block.ops:
+            repl = _rewrite(op) if op.type in QUANTIZABLE_OPS else None
+            new_ops.append(repl if repl is not None else op)
+
+        # drop fake-quantize ops whose quantized outputs no longer feed
+        # anything (the rewritten consumers read the raw sources)
+        still_read = {n for op in new_ops if op.type not in fake_quant
+                      for ns in op.inputs.values() for n in ns}
+        kept = []
+        for op in new_ops:
+            if (op.type in fake_quant
+                    and op.outputs["Out"][0] not in still_read):
+                continue
+            if op.type == "fake_quantize_moving_average_abs_max":
+                op.attrs["is_test"] = True
+            kept.append(op)
+        block.ops = kept
         program._bump()
         return program

@@ -1,0 +1,189 @@
+"""Fused transformer block (ISSUE 6 tentpole b): pattern-matching
+transpiler, numerics vs the unfused program (forward AND training),
+the Pallas kernel in interpret mode (randomized shapes, causal, and
+the masked/ragged tail), and the fuse_block executor-key wiring.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.core import flags
+from paddle_tpu.kernels import fused_block as fb
+from paddle_tpu.transpiler.fused_block import (FuseBlockTranspiler,
+                                               maybe_fuse)
+
+# this jax build predates pltpu.CompilerParams; the kernel carries a
+# TPUCompilerParams alias, so interpret mode works either way
+_HAS_PALLAS = fb._CompilerParams is not None
+
+
+def _lm(T=32, n_layer=2, dropout=0.0, fused_attention=True):
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=300, tgt_vocab_size=300, max_length=64,
+        n_layer=n_layer, n_head=4, d_model=32, d_inner=64,
+        dropout=dropout)
+    feeds, avg_cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=T, fused_attention=fused_attention)
+    return cfg, avg_cost
+
+
+def _fresh_scope():
+    from paddle_tpu.framework import executor as em
+    pt.reset_default_programs()
+    em._global_scope = em.Scope()
+
+
+def test_fuse_block_transpiler_matches_unfused_training():
+    """The transpiled program (2 fused_transformer_block ops replacing
+    20) reproduces the unfused program's loss trajectory — forward and
+    gradients — on CPU."""
+    # old-jax CPU: keep the unfused baseline off the flash kernels
+    old = flags.get_flag("use_pallas_kernels")
+    flags.set_flag("use_pallas_kernels", False)
+    try:
+        cfg, avg_cost = _lm()
+        pt.optimizer.SGD(0.1).minimize(avg_cost)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        feed = models.transformer.make_fake_lm_batch(cfg, 2, 32)
+        prog = pt.default_main_program()
+        ref = [float(exe.run(prog, feed=feed, fetch_list=[avg_cost])[0])
+               for _ in range(2)]
+
+        _fresh_scope()
+        cfg, avg_cost = _lm()
+        pt.optimizer.SGD(0.1).minimize(avg_cost)
+        prog = pt.default_main_program()
+        n = FuseBlockTranspiler().transpile(prog)
+        assert n == 2
+        kinds = [op.type for op in prog.global_block().ops]
+        assert kinds.count("fused_transformer_block") == 2
+        assert "fused_mha" not in kinds and "relu" not in kinds
+        exe2 = pt.Executor(pt.CPUPlace())
+        exe2.run(pt.default_startup_program())
+        fused = [float(exe2.run(prog, feed=feed,
+                                fetch_list=[avg_cost])[0])
+                 for _ in range(2)]
+        np.testing.assert_allclose(fused, ref, rtol=1e-4, atol=1e-4)
+    finally:
+        flags.set_flag("use_pallas_kernels", old)
+
+
+def test_fuse_block_skips_foreign_ops_and_external_consumers():
+    # a foreign op inside the would-be window (here: a scale between
+    # attention and its residual — the slot dropout occupies at rate>0)
+    # breaks the contiguous pattern: nothing fuses
+    x = layers.data("x", [12, 16], dtype="float32")
+    ln1 = layers.layer_norm(x, begin_norm_axis=2)
+    attn = layers.fused_mha(ln1, 2, causal=True)
+    attn = layers.scale(attn, scale=1.0)
+    res1 = layers.elementwise_add(attn, x)
+    ln2 = layers.layer_norm(res1, begin_norm_axis=2)
+    ffn = layers.fc(layers.fc(ln2, size=32, num_flatten_dims=2,
+                              act="relu"), size=16, num_flatten_dims=2)
+    layers.elementwise_add(ffn, res1)
+    assert FuseBlockTranspiler().transpile(pt.default_main_program()) == 0
+
+    # an intermediate consumed OUTSIDE the block keeps it unfused
+    _fresh_scope()
+    cfg2 = models.transformer.TransformerConfig(
+        src_vocab_size=100, tgt_vocab_size=100, max_length=32,
+        n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    tokens = layers.data("tokens", [16], dtype="int64")
+    x = models.transformer.prepare_embedding(tokens, 100, 16, 32,
+                                             0.0, name="src")
+    h = models.transformer.encoder_layer(
+        x, None, 2, 8, 8, 16, 32, 0.0, causal=True, fused=True)
+    block = pt.default_main_program().global_block()
+    mha_out = [op for op in block.ops if op.type == "fused_mha"
+               ][0].outputs["Out"][0]
+    # read the attention output from outside the would-be fusion window
+    layers.mean(block.var(mha_out))
+    assert FuseBlockTranspiler().transpile(pt.default_main_program()) == 0
+
+
+def test_maybe_fuse_is_flag_gated():
+    _lm(n_layer=1)
+    assert maybe_fuse(pt.default_main_program()) == 0   # flag off
+    old = flags.get_flag("fuse_block")
+    flags.set_flag("fuse_block", True)
+    try:
+        assert maybe_fuse(pt.default_main_program()) == 1
+    finally:
+        flags.set_flag("fuse_block", old)
+
+
+@pytest.mark.skipif(not _HAS_PALLAS, reason="no pallas compiler params")
+@pytest.mark.parametrize("B,T,causal", [(2, 128, True), (2, 80, True),
+                                        (1, 200, False)])
+def test_block_kernel_interpret_matches_reference(B, T, causal):
+    """The Pallas kernel (interpret mode) vs the XLA composition on
+    randomized shapes, including ragged tails (T=80/200 pad to the 128
+    granule with masked keys)."""
+    D, E, F, H = 32, 32, 64, 4
+    rng = np.random.RandomState(T)
+
+    def mk(*shape):
+        return jnp.asarray(rng.randn(*shape).astype("f4") * 0.3)
+
+    x = mk(B, T, D)
+    p = (mk(D) + 1.0, mk(D), mk(D, E), mk(D, E), mk(D, E), mk(E, D),
+         mk(D) + 1.0, mk(D), mk(D, F), mk(F), mk(F, D), mk(D))
+    ref = fb.block_reference(x, p, H, causal)
+    out = fb.transformer_block(x, p, H, causal, use_pallas=True,
+                               interpret=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-4
+    # custom-VJP gradients == the composition's gradients
+    g_k = jax.grad(lambda xv: jnp.sum(fb.transformer_block(
+        xv, p, H, causal, use_pallas=True, interpret=True)))(x)
+    g_r = jax.grad(lambda xv: jnp.sum(
+        fb.block_reference(xv, p, H, causal)))(x)
+    assert float(jnp.max(jnp.abs(g_k - g_r))) < 1e-4
+
+
+@pytest.mark.skipif(not _HAS_PALLAS, reason="no pallas compiler params")
+def test_block_kernel_bf16_tolerance():
+    """Acceptance bound: fused vs unfused within 2e-2 in bf16,
+    including a ragged tail."""
+    D, E, F, H = 32, 32, 64, 4
+    rng = np.random.RandomState(7)
+
+    def mk(*shape):
+        return jnp.asarray(rng.randn(*shape).astype("f4") * 0.3,
+                           jnp.bfloat16)
+
+    for T in (128, 80):
+        x = mk(2, T, D)
+        p = (mk(D) + 1.0, mk(D), mk(D, E), mk(D, E), mk(D, E), mk(E, D),
+             mk(D) + 1.0, mk(D), mk(D, F), mk(F), mk(F, D), mk(D))
+        ref = fb.block_reference(x, p, H, True)
+        out = fb.transformer_block(x, p, H, True, use_pallas=True,
+                                   interpret=True)
+        rel = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                    - ref.astype(jnp.float32)))
+                    / jnp.max(jnp.abs(ref.astype(jnp.float32))))
+        assert rel < 2e-2, (T, rel)
+
+
+def test_fuse_block_flag_in_executor_compile_key():
+    """Flipping FLAGS_fuse_block must compile a fresh executable (it is
+    part of the jit cache key), so a mid-run toggle can never alias the
+    fused and unfused programs."""
+    x = layers.data("x", [8], dtype="float32")
+    loss = layers.mean(layers.fc(x, size=4))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((2, 8), "float32")}
+    prog = pt.default_main_program()
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    n = len(exe._cache)
+    old = flags.get_flag("fuse_block")
+    flags.set_flag("fuse_block", True)
+    try:
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    finally:
+        flags.set_flag("fuse_block", old)
+    assert len(exe._cache) == n + 1

@@ -179,7 +179,7 @@ def test_moving_average_scale_state_advances():
     exe = pt.Executor(pt.CPUPlace())
     exe.run(startup)
     scale_names = [v.name for v in main.list_vars()
-                   if v.persistable and ".in_scale" in v.name]
+                   if v.persistable and "in_scale" in v.name]
     assert scale_names
     rng = np.random.RandomState(0)
     feed = {"x": (rng.randn(32, 8) * 50).astype("float32")}

@@ -924,6 +924,10 @@ EXEMPT = {
     "scale_sub_region": "tests/test_v2_mixed_tier.py numeric box check",
     "sequence_context": "tests/test_v2_mixed_tier.py context_projection identity checks",
     "fused_lm_head_loss": "tests/test_models.py fused-vs-unfused parity",
+    "fused_transformer_block": "tests/test_fused_block.py (transpiler "
+                               "parity + kernel numerics)",
+    "quantized_matmul": "tests/test_quantize_exec.py freeze/int8 parity",
+    "quantized_conv2d": "tests/test_quantize_exec.py conv numerics",
     "save": "io op — tests/test_reader_trainer.py save/load-as-ops",
     "load": "io op — dedicated test",
     "save_combine": "io op — dedicated test",

@@ -1,0 +1,360 @@
+"""Real quantized execution (ISSUE 6 tentpole a + satellites).
+
+STE gradient round-trips for every fake_quantize_* variant, the
+freeze_program rewrite to genuine int8/fp8 programs (including the
+never-trained rejection), the quantize_dtype training path and its
+fake-quant numerical equivalence, the executor compile-key wiring, and
+the bench_gate --smoke lane.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags
+from paddle_tpu.framework.registry import LowerContext, get_op_def
+from paddle_tpu.observability import bench_gate
+from paddle_tpu.transpiler import QuantizeTranspiler
+
+
+def _lower(op_type, ins, attrs):
+    ctx = LowerContext(jax.random.PRNGKey(0))
+    return get_op_def(op_type).lower(ctx, ins, attrs)
+
+
+# --- satellite: STE gradient round-trips for the fake quant ops ----------
+
+def _np_quant(x, scale, qmax=127.0):
+    return np.clip(np.round(x / scale * qmax), -qmax, qmax) * scale / qmax
+
+
+def test_fake_quantize_abs_max_ste_roundtrip():
+    """Forward quantizes onto the int8 grid; backward passes the
+    cotangent through unchanged (exactly 1.0 for every non-argmax
+    entry — the scale depends only on the absmax element)."""
+    x = jnp.asarray([[0.31, -0.77], [0.505, -1.9]], jnp.float32)
+
+    def f(xv):
+        return _lower("fake_quantize_abs_max", {"X": [xv]},
+                      {"bit_length": 8})["Out"][0]
+
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, _np_quant(np.asarray(x), 1.9),
+                               atol=1e-6)
+    g = np.asarray(jax.grad(lambda xv: jnp.sum(f(xv)))(x))
+    mask = np.ones_like(g, bool)
+    mask[1, 1] = False          # the absmax entry carries scale grads
+    np.testing.assert_allclose(g[mask], 1.0, atol=1e-5)
+
+
+def test_fake_quantize_moving_average_ste_roundtrip():
+    x = jnp.asarray([[0.4, -0.2, 0.9, -0.55]], jnp.float32)
+    in_scale = jnp.asarray(0.8, jnp.float32)
+    attrs = {"bit_length": 8, "moving_rate": 0.9, "is_test": False}
+
+    def f(xv):
+        return _lower("fake_quantize_moving_average_abs_max",
+                      {"X": [xv], "InScale": [in_scale]}, attrs)["Out"][0]
+
+    scale = 0.9 * 0.8 + 0.1 * 0.9
+    np.testing.assert_allclose(np.asarray(f(x)),
+                               _np_quant(np.asarray(x), scale), atol=1e-6)
+    g = np.asarray(jax.grad(lambda xv: jnp.sum(f(xv)))(x))
+    mask = np.ones_like(g, bool)
+    mask[0, 2] = False          # absmax entry
+    np.testing.assert_allclose(g[mask], 1.0, atol=1e-5)
+    # is_test freezes the scale: gradient is identity EVERYWHERE and
+    # the forward uses in_scale alone
+    attrs_t = dict(attrs, is_test=True)
+
+    def ft(xv):
+        return _lower("fake_quantize_moving_average_abs_max",
+                      {"X": [xv], "InScale": [in_scale]},
+                      attrs_t)["Out"][0]
+
+    np.testing.assert_allclose(np.asarray(ft(x)),
+                               _np_quant(np.asarray(x), 0.8), atol=1e-6)
+    gt = np.asarray(jax.grad(lambda xv: jnp.sum(ft(xv)))(x))
+    # clipped entries (|x| > in_scale) have zero STE gradient
+    expect = (np.abs(np.asarray(x)) <= 0.8).astype("f4")
+    np.testing.assert_allclose(gt, expect, atol=1e-5)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_fake_channel_wise_quantize_ste_roundtrip(axis):
+    """Per-channel variant, checked per axis: each channel quantizes
+    against its OWN absmax, and gradients are 1.0 for every entry that
+    is not its channel's absmax."""
+    x = jnp.asarray([[0.5, -2.0, 0.25], [-1.0, 0.4, 0.75]], jnp.float32)
+
+    def f(xv):
+        return _lower("fake_channel_wise_quantize_abs_max", {"X": [xv]},
+                      {"bit_length": 8, "quant_axis": axis})["Out"][0]
+
+    xn = np.asarray(x)
+    scales = np.abs(xn).max(axis=1 - axis, keepdims=True)
+    np.testing.assert_allclose(np.asarray(f(x)), _np_quant(xn, scales),
+                               atol=1e-6)
+    out_scale = np.asarray(
+        _lower("fake_channel_wise_quantize_abs_max", {"X": [x]},
+               {"bit_length": 8, "quant_axis": axis})["OutScale"][0])
+    np.testing.assert_allclose(out_scale, scales.reshape(-1), atol=1e-6)
+    g = np.asarray(jax.grad(lambda xv: jnp.sum(f(xv)))(x))
+    mask = np.abs(xn) != scales     # non-argmax entries per channel
+    np.testing.assert_allclose(g[mask], 1.0, atol=1e-5)
+
+
+# --- tentpole: freeze_program emits real int8 ----------------------------
+
+def _qat_net():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    return main, startup, pred, loss
+
+
+def _reg_feed():
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 1).astype("float32")
+    feed = {"x": rng.randn(64, 16).astype("float32")}
+    feed["y"] = feed["x"] @ w
+    return feed
+
+
+def test_freeze_program_rejects_untrained_scales():
+    """Satellite regression: freezing a moving-average QAT program whose
+    scales were never trained must raise a clear error instead of
+    silently folding garbage scales."""
+    main, startup, pred, loss = _qat_net()
+    qt = QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max")
+    qt.training_transpile(main, startup)
+    exe = pt.Executor(pt.CPUPlace())
+    # startup never ran at all: weights/scales missing from the scope
+    with pytest.raises(Exception, match="no recorded value"):
+        qt.freeze_program(main.clone(for_test=True), scope=exe.scope)
+    # startup ran but training never did: scale still at the 1.0 init
+    exe.run(startup)
+    with pytest.raises(Exception, match="never trained"):
+        qt.freeze_program(main.clone(for_test=True), scope=exe.scope)
+
+
+def test_qat_scale_state_shared_with_test_clone():
+    """Regression (found by the e2e drive): transpiling the train
+    program and its for_test clone SEPARATELY must reuse the same
+    moving-average scale vars — deterministic names, no unique suffix —
+    so scales trained through one program are seen by the other and
+    the test clone can be frozen."""
+    main, startup, pred, loss = _qat_net()
+    test_prog = main.clone(for_test=True)
+    qt = QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max")
+    qt.training_transpile(main, startup)
+    qt.training_transpile(test_prog, startup)
+
+    def scale_vars(p):
+        return sorted(v.name for v in p.list_vars()
+                      if v.persistable and "quant_in_scale" in v.name)
+
+    assert scale_vars(main) == scale_vars(test_prog)
+    with pt.program_guard(main, startup):
+        pt.optimizer.SGD(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    feed = _reg_feed()
+    for _ in range(4):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    # the scales trained via `main` unlock freezing the TEST clone
+    frozen = qt.freeze_program(test_prog, scope=exe.scope,
+                               quantize_dtype="int8")
+    kinds = {op.type for op in frozen.global_block().ops}
+    assert "quantized_matmul" in kinds
+    ref, = exe.run(test_prog, feed=feed, fetch_list=[pred])
+    got, = exe.run(frozen, feed=feed, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0.1, atol=0.1)
+
+
+def test_freeze_program_emits_real_int8():
+    main, startup, pred, loss = _qat_net()
+    qt = QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max")
+    qt.training_transpile(main, startup)
+    infer = main.clone(for_test=True)
+    with pt.program_guard(main, startup):
+        pt.optimizer.SGD(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    feed = _reg_feed()
+    for _ in range(8):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    ref, = exe.run(infer, feed=feed, fetch_list=[pred.name])
+    frozen = qt.freeze_program(infer, scope=exe.scope)
+    kinds = [op.type for op in frozen.global_block().ops]
+    assert kinds.count("quantized_matmul") == 2, kinds
+    assert not any(k.startswith("fake_") for k in kinds), kinds
+    # the folded weights are genuinely int8 in the scope, with
+    # per-channel scale vectors beside them
+    qnames = [op.inputs["W"][0] for op in frozen.global_block().ops
+              if op.type == "quantized_matmul"]
+    for qn in qnames:
+        assert exe.scope.find_var(qn).dtype == jnp.int8
+    w_scale = exe.scope.find_var(
+        [op.inputs["WScale"][0] for op in frozen.global_block().ops
+         if op.type == "quantized_matmul"][0])
+    assert w_scale.shape == (16,)   # quant_axis 1 of the [16, 16] fc
+    got, = exe.run(frozen, feed=feed, fetch_list=[pred.name])
+    # int8 with the trained scales reproduces the fake-quant reference
+    tol = 0.02 * max(1.0, float(np.max(np.abs(ref))))
+    assert float(np.max(np.abs(got - ref))) <= tol
+
+
+def test_freeze_program_fp8_path():
+    main, startup, pred, loss = _qat_net()
+    qt = QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max")
+    qt.training_transpile(main, startup)
+    with pt.program_guard(main, startup):
+        pt.optimizer.SGD(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    feed = _reg_feed()
+    for _ in range(6):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    infer = main.clone(for_test=True)
+    ref, = exe.run(infer, feed=feed, fetch_list=[pred.name])
+    frozen = qt.freeze_program(infer, scope=exe.scope,
+                               quantize_dtype="e4m3")
+    got, = exe.run(frozen, feed=feed, fetch_list=[pred.name])
+    rel = float(np.max(np.abs(got - ref)) / max(1.0, np.max(np.abs(ref))))
+    assert np.isfinite(got).all()
+    assert rel < 0.15, rel          # e4m3 has a ~2^-3 mantissa
+
+
+def test_quantized_conv2d_matches_f32_conv():
+    from paddle_tpu.ops.quantize_ops import channel_scales, quantize_array
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32") * 0.2
+    scales = channel_scales(w, 0)
+    wq = quantize_array(jnp.asarray(w),
+                        jnp.asarray(scales).reshape(-1, 1, 1, 1), "int8")
+    out = _lower("quantized_conv2d",
+                 {"Input": [jnp.asarray(x)], "Filter": [wq],
+                  "FilterScale": [jnp.asarray(scales)]},
+                 {"quantize_dtype": "int8", "strides": [1, 1],
+                  "paddings": [1, 1], "dilations": [1, 1],
+                  "groups": 1})["Output"][0]
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < 0.05 * float(jnp.max(jnp.abs(ref))), err
+
+
+# --- tentpole: quantize_dtype training path ------------------------------
+
+def test_low_precision_matmul_matches_fake_quant_composition():
+    """Acceptance: the real int8 forward equals the fake-quant
+    simulation of the same matmul (per-tensor activation, per-channel
+    weight) up to f32 rounding — same grid, same scales, the contraction
+    just actually runs in int8."""
+    from paddle_tpu.ops.quantize_ops import low_precision_matmul
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 16).astype("float32"))
+    w = jnp.asarray(rng.randn(16, 4).astype("float32"))
+    real = low_precision_matmul(x, w, "int8", jnp.float32)
+    x_fake = _lower("fake_quantize_abs_max", {"X": [x]},
+                    {"bit_length": 8})["Out"][0]
+    w_fake = _lower("fake_channel_wise_quantize_abs_max", {"X": [w]},
+                    {"bit_length": 8, "quant_axis": 1})["Out"][0]
+    fake = jnp.matmul(x_fake, w_fake)
+    np.testing.assert_allclose(np.asarray(real), np.asarray(fake),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_dtype_flag_trains_and_keys_compiles():
+    """int8 execution during training: loss decreases under STE
+    gradients, and flipping quantize_dtype compiles a FRESH executable
+    (flags are part of the jit cache key, so dtype churn is 'flags'
+    drift — not an aliased executable, not a storm)."""
+    x = layers.data("x", [16], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(layers.fc(x, size=16, act="relu"), size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = _reg_feed()
+    prog = pt.default_main_program()
+    base, = exe.run(prog, feed=feed, fetch_list=[loss])
+    n_before = len(exe._cache)
+    old = flags.get_flag("quantize_dtype")
+    flags.set_flag("quantize_dtype", "int8")
+    try:
+        losses = [float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(15)]
+    finally:
+        flags.set_flag("quantize_dtype", old)
+    assert len(exe._cache) == n_before + 1   # fresh executable, cached
+    assert losses[-1] < losses[0] * 0.5
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("qd", ["e4m3", "e5m2"])
+def test_fp8_training_matmul_runs(qd):
+    from paddle_tpu.ops.quantize_ops import low_precision_matmul
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 8).astype("float32"))
+    w = jnp.asarray(rng.randn(8, 4).astype("float32"))
+    out = low_precision_matmul(x, w, qd, jnp.float32)
+    ref = jnp.matmul(x, w)
+    assert np.isfinite(np.asarray(out)).all()
+    # fp8 is coarse; just bound the relative error
+    rel = float(jnp.max(jnp.abs(out - ref))
+                / jnp.maximum(jnp.max(jnp.abs(ref)), 1e-6))
+    assert rel < (0.1 if qd == "e4m3" else 0.3)
+    # STE backward: gradient of sum(x@w) wrt x is row-sums of w
+    g = jax.grad(lambda a: jnp.sum(
+        low_precision_matmul(a, w, qd, jnp.float32)))(x)
+    np.testing.assert_allclose(np.asarray(g),
+                               np.broadcast_to(np.asarray(w).sum(1),
+                                               (8, 8)), rtol=1e-5)
+
+
+def test_int8_lm_compiles_and_trains_on_cpu():
+    """CPU-CI acceptance leg of the new bench row: a (tiny) transformer
+    LM under quantize_dtype=int8 compiles and its loss stays finite and
+    comparable to the fp32 run's."""
+    from paddle_tpu import models
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=128, tgt_vocab_size=128, max_length=32,
+        n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    feeds, avg_cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=16, fused_attention=False)
+    pt.optimizer.SGD(0.1).minimize(avg_cost)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = models.transformer.make_fake_lm_batch(cfg, 2, 16)
+    prog = pt.default_main_program()
+    ref = float(exe.run(prog, feed=feed, fetch_list=[avg_cost])[0])
+    old = flags.get_flag("quantize_dtype")
+    flags.set_flag("quantize_dtype", "int8")
+    try:
+        got = float(exe.run(prog, feed=feed, fetch_list=[avg_cost])[0])
+    finally:
+        flags.set_flag("quantize_dtype", old)
+    assert np.isfinite(got)
+    assert abs(got - ref) < 0.25 * ref + 0.1
+
+
+# --- satellite: the tier-1 perf-path smoke lane --------------------------
+
+def test_bench_gate_smoke_mode():
+    assert bench_gate.main(["--smoke"]) == 0

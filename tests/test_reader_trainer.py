@@ -310,3 +310,149 @@ def test_trainer_env_driven_dist_transpile(monkeypatch):
     monkeypatch.setenv("PADDLE_TRAINING_ROLE", "PSERVER")
     with pytest.raises(RuntimeError, match="no parameter servers"):
         run_losses()
+
+
+# --- async device prefetch (ISSUE 6 tentpole c) ---------------------------
+
+def _prefetch_train_func():
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    p = layers.fc(layers.fc(x, size=8, act="relu"), size=3,
+                  act="softmax")
+    return layers.mean(layers.cross_entropy(p, y))
+
+
+def _slow_reader(n=10, delay=0.03):
+    import time
+
+    def r():
+        rng = np.random.RandomState(0)
+        for _ in range(n):
+            time.sleep(delay)
+            yield [(rng.rand(4).astype("float32"),
+                    np.array([1], "int64")) for _ in range(4)]
+    return r
+
+
+def test_device_prefetch_decorator_stages_feeds():
+    import jax
+
+    def raw():
+        for i in range(3):
+            yield {"a": np.full((2, 4), i, "float32")}
+
+    items = list(reader.device_prefetch(raw, size=2)())
+    assert len(items) == 3
+    assert all(isinstance(b, reader.DeviceBatch) for b in items)
+    assert isinstance(items[0].feed["a"], jax.Array)
+    assert items[0].size == 2
+    np.testing.assert_array_equal(np.asarray(items[2].feed["a"]), 2.0)
+    # producer exceptions reach the consumer, not end-of-data
+    def broken():
+        yield {"a": np.zeros((1, 1), "float32")}
+        raise RuntimeError("decode failed")
+
+    it = reader.device_prefetch(broken, size=2)()
+    next(it)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
+def test_trainer_prefetch_overlaps_slow_reader():
+    """Acceptance: with device prefetch the measured per-step data wait
+    (the NOT-hidden part) collapses vs the unbuffered run of the same
+    slow reader — and donated feed buffers / DeviceBatch plumbing
+    produce the same healthy training loop."""
+    import time
+
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    def hist():
+        h = obs_metrics.REGISTRY.get("trainer_data_wait_seconds")
+        return h.sum, h.count
+
+    s0, c0 = hist()
+    t = pt.Trainer(train_func=_prefetch_train_func,
+                   optimizer_func=lambda: pt.optimizer.SGD(0.1),
+                   place=pt.CPUPlace())
+    t.train(num_epochs=1, event_handler=lambda e: None,
+            reader=_slow_reader(), feed_order=["x", "y"])
+    t.stop()
+    s1, c1 = hist()
+    unbuf_mean = (s1 - s0) / (c1 - c0)
+
+    pt.reset_default_programs()
+    from paddle_tpu.framework import executor as em
+    em._global_scope = em.Scope()
+
+    # the consumer is slower than the producer (EndStep sleep), so the
+    # prefetch thread hides the reader's 30ms entirely
+    def slow_consumer(e):
+        if isinstance(e, pt.EndStepEvent):
+            time.sleep(0.04)
+
+    steps = {"n": 0}
+
+    def count_steps(e):
+        if isinstance(e, pt.EndStepEvent):
+            steps["n"] += 1
+            slow_consumer(e)
+
+    s0, c0 = hist()
+    t = pt.Trainer(train_func=_prefetch_train_func,
+                   optimizer_func=lambda: pt.optimizer.SGD(0.1),
+                   place=pt.CPUPlace())
+    t.train(num_epochs=1, event_handler=count_steps,
+            reader=_slow_reader(), feed_order=["x", "y"],
+            prefetch_depth=2)
+    t.stop()
+    s1, c1 = hist()
+    pf_mean = (s1 - s0) / (c1 - c0)
+    assert steps["n"] == 10          # every batch trained
+    # acceptance (ISSUE 6): >= 5x drop; the 30ms reader sleep is fully
+    # hidden so the measured ratio is typically 50x+
+    assert unbuf_mean / pf_mean >= 5.0, (unbuf_mean, pf_mean)
+    # the prefetch queue depth rides the labeled buffer-depth gauge
+    g = obs_metrics.REGISTRY.get("reader_buffer_depth")
+    assert ("device_prefetch",) in g._series
+
+
+def test_input_bound_warning_prefetch_aware():
+    """Satellite: a prefetch-enabled run whose reader is fully hidden
+    stays quiet; the same slow reader unbuffered warns (and names the
+    prefetch knob in its advice)."""
+    import time
+    import warnings
+
+    from paddle_tpu.core import flags
+
+    old = flags.get_flag("input_bound_warn_fraction")
+    flags.set_flag("input_bound_warn_fraction", 0.2)
+    try:
+        with pytest.warns(RuntimeWarning, match="prefetch_depth"):
+            t = pt.Trainer(train_func=_prefetch_train_func,
+                           optimizer_func=lambda: pt.optimizer.SGD(0.1),
+                           place=pt.CPUPlace())
+            t.train(num_epochs=1, event_handler=lambda e: None,
+                    reader=_slow_reader(), feed_order=["x", "y"])
+            t.stop()
+
+        pt.reset_default_programs()
+        from paddle_tpu.framework import executor as em
+        em._global_scope = em.Scope()
+
+        def slow_consumer(e):
+            if isinstance(e, pt.EndStepEvent):
+                time.sleep(0.04)
+
+        t = pt.Trainer(train_func=_prefetch_train_func,
+                       optimizer_func=lambda: pt.optimizer.SGD(0.1),
+                       place=pt.CPUPlace())
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            t.train(num_epochs=1, event_handler=slow_consumer,
+                    reader=_slow_reader(), feed_order=["x", "y"],
+                    prefetch_depth=2)
+        t.stop()
+    finally:
+        flags.set_flag("input_bound_warn_fraction", old)
