@@ -501,16 +501,29 @@ def main():
     # the bench_* gauges (BENCH rounds regress recovery cost too)
     from paddle_tpu.core import flags
     from paddle_tpu.observability import metrics as obs
+    from paddle_tpu.observability import runlog as obs_runlog
     on_tpu = jax.devices()[0].platform == "tpu"
     flags.set_flag("amp_bf16", True)
     metrics_path = os.environ.get("PTPU_BENCH_METRICS_PATH",
                                   "bench_metrics.json")
+    # durable run history (observability/runlog.py): one record per
+    # workload row, so bench rounds leave a step-aligned trajectory the
+    # runlog CLI can tail/diff — not just the final registry snapshot
+    runlog_path = os.environ.get("PTPU_BENCH_RUNLOG_PATH",
+                                 "bench_runlog.jsonl")
+    # open_runlog absorbs an unopenable path (read-only CI checkout)
+    # with a RuntimeWarning + runlog_write_failures_total instead of
+    # dying — same policy as the Trainer's history
+    rl = obs_runlog.open_runlog(runlog_path, meta={
+        "event": "bench_start",
+        "platform": jax.devices()[0].platform})
 
     rows, errors = [], {}
-    for fn in (bench_lm, bench_lm_int8, bench_lm_fused_block,
-               bench_resnet50, bench_nmt, bench_resnet50_infer,
-               bench_resnet50_infer_int8, bench_alexnet,
-               bench_googlenet, bench_lstm, bench_lm_8k):
+    for wl_index, fn in enumerate((
+            bench_lm, bench_lm_int8, bench_lm_fused_block,
+            bench_resnet50, bench_nmt, bench_resnet50_infer,
+            bench_resnet50_infer_int8, bench_alexnet,
+            bench_googlenet, bench_lstm, bench_lm_8k)):
         try:
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide
@@ -520,6 +533,16 @@ def main():
                 _record_row_metrics(rows[-1])
             except Exception as e:      # telemetry must not fail the row
                 errors.setdefault("record_metrics", repr(e)[:300])
+            if rl is not None:          # runlog row (writes never raise)
+                # step = FIXED workload index (not len(rows)): an
+                # errored workload must not shift later rows, or two
+                # runs stop step-aligning under `runlog --compare`
+                row = rows[-1]
+                rl.write(kind="bench", step=wl_index,
+                         **{k: row[k] for k in
+                            ("metric", "value", "unit", "vs_baseline",
+                             "mfu", "tflops", "flops_per_step", "loss")
+                            if row.get(k) is not None})
         # re-print the cumulative result after EVERY workload (full
         # detail, for humans reading the whole log), then a COMPACT
         # summary line LAST: the driver parses the final JSON line of a
@@ -542,6 +565,9 @@ def main():
             out["errors"] = errors
         print(json.dumps(out), flush=True)
         print(_compact_line(rows, errors), flush=True)
+    if rl is not None:
+        rl.write(kind="meta", event="bench_end", rows=len(rows))
+        rl.close()
 
 
 def _compact_line(rows, errors):

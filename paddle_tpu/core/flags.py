@@ -134,6 +134,38 @@ define_flag("flight_recorder_events", 256,
             "(recent spans, compile/chaos/guard/retry events). "
             "0 disables event recording entirely.")
 
+# --- model-health telemetry (observability/: tensorstats, runlog) ----------
+define_flag("tensor_stats", False,
+            "Compute per-variable tensor statistics (min/max/mean/rms, "
+            "NaN/Inf counts, grad norms, weight-update ratios) INSIDE "
+            "the compiled train step (observability/tensorstats.py) and "
+            "fetch them as one packed array every tensor_stats_interval "
+            "steps.  Off: zero extra compiles, byte-identical compile "
+            "keys.  On: exactly one extra executable (the stats "
+            "variant); flips diagnose as 'flags' drift in forensics.")
+define_flag("tensor_stats_interval", 10,
+            "Sample every Nth train-program step when tensor_stats is "
+            "on (1 = every step — what first-bad-layer NaN attribution "
+            "wants while debugging; larger = cheaper).")
+define_flag("tensor_stats_topk", 8,
+            "Bounded gauge cardinality: how many per-variable series "
+            "(largest grad norms / update ratios / NaN counts) the "
+            "model_* gauges keep per sample, next to the '__all__' "
+            "aggregate row.")
+define_flag("runlog_path", "",
+            "Append-only JSONL run history (observability/runlog.py, "
+            "schema paddle_tpu.runlog.v1): the Trainer writes one "
+            "record per step (loss, lr, throughput, MFU, guard "
+            "verdicts, sampled tensor stats).  A pre-existing file is "
+            "atomically rotated to <path>.1 when a new Trainer opens "
+            "it.  Empty disables.")
+define_flag("grad_divergence_factor", 10.0,
+            "FleetAggregator cross-rank divergence check: warn when "
+            "same-step per-rank global grad norms (shipped by "
+            "FleetReporter from tensorstats samples) differ by more "
+            "than this factor under data parallelism — a desynced "
+            "rank.  <= 1 disables.")
+
 # --- fleet telemetry (observability/: server, fleet) -----------------------
 define_flag("obs_http_port", 0,
             "Port for the live observability HTTP endpoint "

@@ -44,6 +44,7 @@ from ..observability import costmodel as obs_cost
 from ..observability import flight as obs_flight
 from ..observability import forensics as obs_forensics
 from ..observability import metrics as obs_metrics
+from ..observability import tensorstats as obs_tensorstats
 from ..observability import trace as obs_trace
 from ..resilience import chaos
 from .program import Program, Variable, default_main_program
@@ -463,6 +464,30 @@ def run_ops_in_env(ctx, env: Dict[str, Any], ops) -> Dict[str, Any]:
             for n, v in zip(names, produced):
                 if n:
                     env[n] = v
+        if chaos.var_sites_armed():
+            # chaos site family executor.var.<name>: NaN/Inf-poison a
+            # NAMED variable inside the step — the deterministic "this
+            # layer went bad" injection first-bad-layer attribution is
+            # tested against.  On the jitted path the decision lands at
+            # trace time (baked into the executable); eager/per-op
+            # modes decide per step.
+            for slot, names in op.outputs.items():
+                produced = list(outs.get(slot, []))
+                poisoned = False
+                for i, n in enumerate(names[:len(produced)]):
+                    if n and n in env:
+                        pv = chaos.poison_value(
+                            f"executor.var.{n}", env[n])
+                        if pv is not env[n]:
+                            env[n] = pv
+                            # keep `outs` in sync: the per-op NaN
+                            # localizer below inspects outs, and it
+                            # must blame the poisoned PRODUCER, not
+                            # the first downstream consumer
+                            produced[i] = pv
+                            poisoned = True
+                if poisoned:
+                    outs[slot] = produced
         if flags.get_flag("check_nan_inf_per_op"):
             _check_op_outputs_finite(op, outs)
     return env
@@ -496,7 +521,8 @@ class _CompiledProgram:
 
     def __init__(self, program: Program, feed_names, fetch_names,
                  in_state_names, persist_names, place: Place, donate: bool,
-                 mesh=None, batch_axis: str = "data"):
+                 mesh=None, batch_axis: str = "data",
+                 collect_stats: bool = False):
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
@@ -505,6 +531,18 @@ class _CompiledProgram:
         self.mesh = mesh
         ops = program.global_block().ops
         self._ops = [op for op in ops if op.type not in _STRUCTURAL_OPS]
+        # tensorstats variant (observability/tensorstats.py): the step
+        # additionally packs per-variable fused-reduction statistics and
+        # fetches them under a reserved name Executor.run pops back off.
+        # A separate cache key (the tensor_stats flags entry) selects
+        # this variant, so the plain executable stays byte-identical.
+        self.collect_stats = bool(collect_stats)
+        self._stats_order: List[str] = []
+        self._stats_names: List[str] = []
+        if self.collect_stats:
+            self._stats_order = obs_tensorstats.stats_order(
+                self._ops, self.feed_names, self.in_state_names)
+            self.fetch_names.append(obs_tensorstats.FETCH_NAME)
         # persistables that will exist in env after the run: inputs plus
         # anything an op writes — fixed at compile time so the output pytree
         # (and its shardings) are static.
@@ -956,6 +994,14 @@ class _CompiledProgram:
                 env[gname] = grads[pname]
             env = run_ops_in_env(ctx, env, self._ops[self._ad_idx + 1:])
 
+        if self.collect_stats:
+            # fused in-graph reductions over the final environment; the
+            # packed array rides the fetch list (reserved name) so no
+            # step plumbing changes shape
+            names, packed = obs_tensorstats.pack(self._stats_order, env,
+                                                 state)
+            self._stats_names = names
+            env[obs_tensorstats.FETCH_NAME] = packed
         new_state = {n: env[n] for n in self.out_state_names}
         fetches = [env[n] for n in self.fetch_names]
         return fetches, new_state
@@ -1027,8 +1073,21 @@ class Executor:
         # chaos site: a raise/delay here models a failed/slow device
         # dispatch before any state mutates (docs/RESILIENCE.md catalog)
         chaos.trigger("executor.run")
+        # model-health sampling (observability/tensorstats.py): every
+        # Nth dispatch of a TRAIN program runs the stats variant — a
+        # separate cached executable; the off/non-sampled path is
+        # byte-identical to the stats-less executor.  Single-device
+        # only: under a mesh feeds/fetches are sharded and the stats
+        # fetch is not wired through pjit, so the flag is inert there —
+        # note_mesh_skipped warns once rather than staying silent.
+        if self.mesh is None:
+            want_stats = obs_tensorstats.want_sample(program)
+        else:
+            want_stats = False
+            obs_tensorstats.note_mesh_skipped(program)
         compiled, dev_feeds, state, fetch_names = self._prepare(
-            program, feed or {}, list(fetch_list or []), scope)
+            program, feed or {}, list(fetch_list or []), scope,
+            collect_stats=want_stats)
 
         root, counter = self._root_and_counter(program, 1)
         if program.random_seed is None:
@@ -1074,6 +1133,14 @@ class Executor:
 
         for n, v in new_state.items():
             scope.set_var(n, v)
+
+        if want_stats:
+            # pop the reserved stats fetch back off before the caller
+            # sees the list; ingestion blocks on the (sampled) step's
+            # stats array — the every-Nth cost the flag buys
+            stats_val, fetches = fetches[-1], fetches[:-1]
+            obs_tensorstats.note_sample(program, compiled._stats_names,
+                                        stats_val)
 
         if flags.get_flag("check_nan_inf"):
             for n, v in zip(fetch_names, fetches):
@@ -1167,12 +1234,14 @@ class Executor:
         return ys
 
     def _prepare(self, program, feed, fetch_list, scope,
-                 extra_feeds=None):
+                 extra_feeds=None, collect_stats=False):
         """Shared run()/run_steps() prologue: materialise feeds, gather
         persistable state, and fetch (or build) the compiled program.
         `extra_feeds` are run_steps' per-step slabs (leading [steps]
         dim); they go through the same materialisation as other feeds
-        and their names become part of the compiled feed set."""
+        and their names become part of the compiled feed set.
+        `collect_stats` selects the tensorstats variant executable (its
+        key differs by the tensor_stats flags entry only)."""
         if extra_feeds:
             feed = {**feed, **extra_feeds}
         device = self.place.jax_device()
@@ -1223,6 +1292,13 @@ class Executor:
                      ("quantize_dtype",
                       str(flags.get_flag("quantize_dtype"))),
                      ("fuse_block", bool(flags.get_flag("fuse_block"))))
+        if collect_stats:
+            # the stats variant ONLY: appended (never a False entry) so
+            # the tensor_stats=off key stays byte-identical to the
+            # stats-less executor, and the sampled/non-sampled pair
+            # diagnoses as "flags" drift in forensics — two cached
+            # executables, no storm
+            flags_sig += (("tensor_stats", True),)
         key = (program._uid, program._version, feeds_sig,
                tuple(fetch_names), state_sig) \
             + tuple(v for _, v in flags_sig)
@@ -1245,7 +1321,7 @@ class Executor:
             compiled = _CompiledProgram(
                 program, sorted(dev_feeds), fetch_names, sorted(state),
                 persist, self.place, donate=True, mesh=self.mesh,
-                batch_axis=self.batch_axis)
+                batch_axis=self.batch_axis, collect_stats=collect_stats)
             self._cache[key] = compiled
             _m_cached_programs.set(len(self._cache))
         else:
@@ -1340,8 +1416,13 @@ class Executor:
                     owner=self._forensics_owner),
             },
             "flags": {k: flags.get_flag(k) for k in
-                      ("amp_bf16", "use_pallas_kernels", "cost_model",
-                       "quantize_dtype", "fuse_block")},
+                      (("amp_bf16", "use_pallas_kernels", "cost_model",
+                        "quantize_dtype", "fuse_block")
+                       # reported only when ON: the stats-off explain()
+                       # report stays byte-identical to the stats-less
+                       # executor (regression-tested)
+                       + (("tensor_stats", "tensor_stats_interval")
+                          if flags.get_flag("tensor_stats") else ()))},
         }
 
     def last_run_cost(self, prefer_analytic: bool = False):

@@ -29,7 +29,18 @@ Submodules:
     chrome timeline (pid = rank).  Also the offline
     ``python -m paddle_tpu.observability.fleet --merge-traces`` CLI.
   * :mod:`.server` — live HTTP endpoint (``obs_http_port`` flag):
-    ``/metrics`` ``/metrics.json`` ``/healthz`` ``/flight``.
+    ``/metrics`` ``/metrics.json`` ``/healthz`` ``/flight`` ``/model``.
+  * :mod:`.tensorstats` — model-health telemetry computed INSIDE the
+    compiled train step (``tensor_stats`` flag): per-variable
+    min/max/mean/rms, NaN/Inf counts, grad norms and update ratios as
+    fused in-graph reductions, fetched as one packed array every Nth
+    step.  Feeds the ``model_*`` gauges, the NumericGuard's
+    first-bad-layer attribution, the flight bundle, the fleet
+    divergence check and the runlog.
+  * :mod:`.runlog` — append-only JSONL run history (``runlog_path``
+    flag, schema ``paddle_tpu.runlog.v1``) written by the Trainer and
+    ``bench.py``; ``python -m paddle_tpu.observability.runlog`` tails,
+    step-aligned-diffs (``--compare``) and ASCII-plots it.
 
 The instrumented call sites live where the work happens:
 framework/executor.py (compile/cache counters, step latency, per-op

@@ -29,13 +29,26 @@ import sys
 from typing import Dict, List, Optional
 
 
-def load_metric_values(doc: dict) -> Dict[str, float]:
-    """Extract {metric: value} from any accepted input format."""
+def _unwrap_parsed(doc: dict) -> Optional[dict]:
+    """Driver wrapper (n/cmd/rc/tail/parsed): only the parsed payload
+    is metric data.  Returns None for a failed parse (``parsed: null``)
+    — falling through would scrape the wrapper's own numeric
+    bookkeeping fields (n, rc) as bogus metric series."""
     if not isinstance(doc, dict):
         raise ValueError(
             f"expected a JSON object, got {type(doc).__name__}")
-    if "parsed" in doc and isinstance(doc["parsed"], dict):
+    if "parsed" in doc:
         doc = doc["parsed"]
+        if not isinstance(doc, dict):
+            return None
+    return doc
+
+
+def load_metric_values(doc: dict) -> Dict[str, float]:
+    """Extract {metric: value} from any accepted input format."""
+    doc = _unwrap_parsed(doc)
+    if doc is None:
+        return {}
     if "summary" in doc and isinstance(doc["summary"], dict):
         out = {}
         for m, row in doc["summary"].items():
@@ -56,6 +69,89 @@ def load_metric_values(doc: dict) -> Dict[str, float]:
 
 def lower_is_better(metric: str) -> bool:
     return metric.endswith("_ms_per_batch") or metric.endswith("_seconds")
+
+
+def load_trend_record(doc: dict) -> Dict[str, dict]:
+    """Extract ``{metric: {"value": v, "mfu": m?}}`` from one release
+    record — driver BENCH_r*.json files (with or without the compact
+    ``summary``), registry dumps, or plain maps.  Unlike
+    :func:`load_metric_values` this keeps the per-metric MFU, so the
+    trend view tracks efficiency next to throughput."""
+    doc = _unwrap_parsed(doc)
+    if doc is None:
+        return {}
+    if "summary" in doc and isinstance(doc["summary"], dict):
+        out = {}
+        for m, row in doc["summary"].items():
+            if isinstance(row, dict):
+                out[m] = {"value": float(row["value"]),
+                          "mfu": row.get("mfu")}
+            else:
+                out[m] = {"value": float(row), "mfu": None}
+        return out
+    if "metric" in doc and "value" in doc:
+        # pre-summary driver records (BENCH_r01): one row at top level
+        return {str(doc["metric"]): {"value": float(doc["value"]),
+                                     "mfu": doc.get("mfu")}}
+    return {m: {"value": v, "mfu": None}
+            for m, v in load_metric_values(doc).items()}
+
+
+def trend(records: List, tolerance: float = 0.15,
+          allow_missing: bool = False) -> dict:
+    """Cross-release trajectory over ``[(name, {metric: {value, mfu}}),
+    ...]`` (oldest -> newest): per metric, the full series, the
+    best-ever release, and whether the NEWEST record regresses that
+    best by more than `tolerance` (direction-aware; per-metric MFU is
+    tracked as its own higher-is-better series).  Metrics present in
+    any prior record but absent from the newest are flagged
+    ``missing`` and fail the gate unless ``allow_missing``."""
+    if len(records) < 2:
+        raise ValueError(
+            f"trend needs >= 2 release records, got {len(records)}")
+    newest_name, newest = records[-1]
+    rows = []
+
+    def row_for(metric, series, lower, unit):
+        vals = [(n, v) for n, v in series if v is not None]
+        best_name, best = (min if lower else max)(
+            vals, key=lambda kv: kv[1])
+        cur = vals[-1][1] if vals[-1][0] == newest_name else None
+        regressed = (cur is not None
+                     and (cur > best * (1.0 + tolerance) if lower
+                          else cur < best * (1.0 - tolerance)))
+        return {"metric": metric, "unit": unit,
+                "series": [{"release": n, "value": v}
+                           for n, v in series],
+                "best": best, "best_release": best_name,
+                "newest": cur,
+                "status": "regression" if regressed else "ok"}
+
+    # union across ALL records, not just the newest: a workload that
+    # errored out of the newest bench run must surface as "missing",
+    # not silently drop out of the gate
+    all_metrics = sorted({m for _, rec in records for m in rec})
+    for metric in all_metrics:
+        series = [(name, (rec.get(metric) or {}).get("value"))
+                  for name, rec in records]
+        row = row_for(metric, series, lower_is_better(metric), "value")
+        if metric not in newest:
+            row["status"] = "missing"
+        rows.append(row)
+        if any((rec.get(metric) or {}).get("mfu") is not None
+               for _, rec in records):
+            mseries = [(name, (rec.get(metric) or {}).get("mfu"))
+                       for name, rec in records]
+            mrow = row_for(f"{metric}.mfu", mseries, False, "mfu")
+            if (newest.get(metric) or {}).get("mfu") is None:
+                mrow["status"] = "missing"
+            rows.append(mrow)
+    bad = [r["metric"] for r in rows if r["status"] == "regression"]
+    missing = [r["metric"] for r in rows if r["status"] == "missing"]
+    return {"schema": "paddle_tpu.bench_trend.v1",
+            "tolerance": tolerance, "newest": newest_name,
+            "rows": rows, "regressions": bad, "missing": missing,
+            "ok": not bad and (allow_missing or not missing)}
 
 
 def compare(baseline: Dict[str, float], candidate: Dict[str, float],
@@ -192,6 +288,62 @@ def smoke() -> int:
     return 0 if not failures else 1
 
 
+def _fmt_val(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:g}" if isinstance(v, float) else str(v)
+
+
+def _natural_key(name: str) -> List:
+    """Release order must be numeric where names embed numbers:
+    lexicographic sort puts BENCH_r10 before BENCH_r9 and would judge
+    the WRONG record as newest."""
+    import re
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", name)]
+
+
+def _trend_main(paths: List[str], tolerance: float,
+                allow_missing: bool = False) -> int:
+    import os
+    records = []
+    try:
+        ordered = sorted(paths, key=lambda p: (
+            _natural_key(os.path.basename(p)), _natural_key(p)))
+        names = []
+        for path in ordered:
+            name = os.path.basename(path)
+            for suf in (".json",):
+                if name.endswith(suf):
+                    name = name[:-len(suf)]
+            names.append(name)
+        if len(set(names)) != len(names):
+            # releases/<v>/bench_metrics.json layouts collapse to one
+            # basename — disambiguate with the parent directory so the
+            # newest-record match in trend() stays unambiguous
+            names = ["/".join(p.replace("\\", "/").split("/")[-2:])
+                     for p in ordered]
+        for name, path in zip(names, ordered):
+            with open(path) as f:
+                records.append((name, load_trend_record(json.load(f))))
+        result = trend(records, tolerance=tolerance,
+                       allow_missing=allow_missing)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"bench_gate: cannot load trend inputs: {e!r}",
+              file=sys.stderr)
+        return 2
+    for r in result["rows"]:
+        mark = {"regression": "FAIL", "missing": "miss"}.get(
+            r["status"], "  ok")
+        series = " -> ".join(_fmt_val(s["value"]) for s in r["series"])
+        print(f"[{mark}] {r['metric']}: {series}  "
+              f"(best {_fmt_val(r['best'])} @{r['best_release']})")
+    print(json.dumps({k: result[k] for k in
+                      ("tolerance", "newest", "regressions", "missing",
+                       "ok")}))
+    return 0 if result["ok"] else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability.bench_gate",
@@ -208,9 +360,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="run the fast perf-path sanity lane instead of "
                         "a baseline comparison: gate plumbing + the "
                         "quantized-execution path on tiny CPU shapes")
+    p.add_argument("--trend", nargs="+", metavar="RECORD",
+                   help="cross-release trajectory mode: 2+ BENCH_r*.json "
+                        "records (sorted by filename = release order); "
+                        "prints per-metric tokens/s + MFU series and "
+                        "exits 1 when the newest record regresses the "
+                        "best-ever by > tolerance")
     args = p.parse_args(argv)
     if args.smoke:
         return smoke()
+    if args.trend:
+        return _trend_main(args.trend, args.tolerance,
+                           args.allow_missing)
     try:
         with open(args.baseline) as f:
             base = load_metric_values(json.load(f))

@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core import flags
 from . import flight as obs_flight
 from . import metrics as obs_metrics
+from . import tensorstats as obs_tensorstats
 from . import trace as obs_trace
 
 SCHEMA = "paddle_tpu.fleet.v1"
@@ -66,6 +67,12 @@ _m_stragglers = obs_metrics.counter(
     "Straggler warnings emitted by the FleetAggregator (a rank fell "
     "behind the fleet-median step count by > straggler_factor).",
     ("worker",))
+_m_divergence = obs_metrics.counter(
+    "fleet_grad_divergence_warnings_total",
+    "Cross-rank gradient-divergence warnings: same-step per-rank "
+    "global grad norms (tensorstats rows shipped by FleetReporter) "
+    "differed by more than grad_divergence_factor under data "
+    "parallelism — a desynced rank.")
 
 
 # -- worker side -----------------------------------------------------------
@@ -86,6 +93,12 @@ def snapshot_payload(rank: int, closing: bool = False) -> dict:
         "steps_total": float(steps.total()) if steps is not None else 0.0,
         "closing": bool(closing),
         "metrics": obs_metrics.REGISTRY.to_json(),
+        # model-health row (observability/tensorstats.py): this rank's
+        # last sampled grad norm / update ratio / NaN census — what the
+        # coordinator's cross-rank divergence check and /model route
+        # read.  None until a sample lands (tensor_stats flag off, or
+        # no train step yet).
+        "model": obs_tensorstats.fleet_row(),
     }
 
 
@@ -312,7 +325,8 @@ class FleetAggregator:
 
     def __init__(self, stale_after: Optional[float] = None,
                  straggler_factor: Optional[float] = None,
-                 straggler_min_steps: int = 3):
+                 straggler_min_steps: int = 3,
+                 grad_divergence_factor: Optional[float] = None):
         self._lock = threading.Lock()
         self.stale_after = float(
             stale_after if stale_after is not None
@@ -321,10 +335,16 @@ class FleetAggregator:
             straggler_factor if straggler_factor is not None
             else flags.get_flag("straggler_factor"))
         self.straggler_min_steps = int(straggler_min_steps)
+        self.grad_divergence_factor = float(
+            grad_divergence_factor if grad_divergence_factor is not None
+            else flags.get_flag("grad_divergence_factor"))
         self._workers: Dict[int, dict] = {}
         self._spans: Dict[int, List[dict]] = {}
         self._flights: Dict[int, dict] = {}
         self._straggler_warned: set = set()
+        # tensorstats sample steps already diagnosed as diverged (warn
+        # once per step, bounded — a desynced rank stays desynced)
+        self._divergence_warned: set = set()
         # membership truth pushed by the TaskMaster (register / death /
         # goodbye transitions, wired via serve_master(aggregator=...)):
         # rank -> {"state": live|dead|departed, ...}.  When present it
@@ -387,13 +407,28 @@ class FleetAggregator:
             w["departed"] = bool(payload.get("closing"))
             if w["departed"]:
                 self._straggler_warned.discard(w["rank"])
+            w["model"] = payload.get("model")
             stragglers = self._find_stragglers()
+            divergence = self._find_grad_divergence()
         for rank, steps, median in stragglers:
             _m_stragglers.labels(worker=str(rank)).inc()
             warnings.warn(
                 f"fleet straggler: rank {rank} at {steps:.0f} steps is "
                 f"> {self.straggler_factor:g}x behind the fleet median "
                 f"{median:.0f}", RuntimeWarning, stacklevel=2)
+        for (epoch, step), lo_rank, lo, hi_rank, hi in divergence:
+            _m_divergence.inc()
+            pos = (f"step {step}" if epoch < 0
+                   else f"epoch {epoch} step {step}")
+            warnings.warn(
+                f"fleet grad divergence: tensorstats {pos} global "
+                f"grad norms differ by > "
+                f"{self.grad_divergence_factor:g}x across ranks "
+                f"(rank {lo_rank}: {lo:.4g}, rank {hi_rank}: {hi:.4g}) "
+                f"— under data parallelism same-step gradients must "
+                f"match; a desynced rank (bad collective, silent data "
+                f"corruption) looks exactly like this",
+                RuntimeWarning, stacklevel=2)
 
     def note_worker(self, rank: int, state: str, host=None, pid=None,
                     **info):
@@ -472,11 +507,67 @@ class FleetAggregator:
                 self._straggler_warned.discard(rank)
         return out
 
+    def _find_grad_divergence(self) -> List[Tuple[Tuple[int, int], int,
+                                                  float, int, float]]:
+        """Same-step cross-rank grad-norm divergence (call under the
+        lock; warning emission outside).  Compares the latest
+        tensorstats rows of live ranks that sampled the SAME
+        (epoch, step) position — under dp those gradients are
+        post-allreduce identical, so a > factor gap means a desynced
+        rank.  Returns ((epoch, step), min_rank, min_norm, max_rank,
+        max_norm) tuples, one per newly-diagnosed position."""
+        if self.grad_divergence_factor <= 1.0:
+            return []
+        by_step: Dict[Tuple[int, int], List[Tuple[int, float]]] = {}
+        for r, w in self._workers.items():
+            row = w.get("model")
+            if w["departed"] or not isinstance(row, dict):
+                continue
+            if self._membership.get(r, {}).get("state") in ("dead",
+                                                            "departed"):
+                continue
+            try:
+                # (epoch, step-in-epoch) from the trainer's resumable
+                # position — a respawned worker's dispatch counter
+                # restarts at 0, so a bare step would either never
+                # re-align with the survivors or collide with a
+                # different training step; epoch -1 = direct executor
+                # users with no trainer position
+                epoch = row.get("epoch")
+                step = (int(epoch) if epoch is not None else -1,
+                        int(row["step"]))
+                norm = float(row["grad_norm"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if not (norm == norm and abs(norm) != float("inf")):
+                continue         # non-finite norms are the guard's
+                                 # problem, not a sync diagnosis
+            by_step.setdefault(step, []).append((r, norm))
+        out = []
+        for step, rows in by_step.items():
+            if len(rows) < 2 or step in self._divergence_warned:
+                continue
+            lo_rank, lo = min(rows, key=lambda kv: kv[1])
+            hi_rank, hi = max(rows, key=lambda kv: kv[1])
+            if hi > self.grad_divergence_factor * max(lo, 1e-30):
+                self._divergence_warned.add(step)
+                if len(self._divergence_warned) > 1024:
+                    self._divergence_warned = set(sorted(
+                        self._divergence_warned)[-512:])
+                out.append((step, lo_rank, lo, hi_rank, hi))
+        return out
+
     # -- fleet views ---------------------------------------------------
     def workers(self) -> Dict[int, dict]:
         with self._lock:
             return {r: {k: v for k, v in w.items() if k != "metrics"}
                     for r, w in self._workers.items()}
+
+    def model_rows(self) -> Dict[int, dict]:
+        """Latest per-rank tensorstats rows (what /model serves)."""
+        with self._lock:
+            return {r: w["model"] for r, w in self._workers.items()
+                    if isinstance(w.get("model"), dict)}
 
     def health(self) -> dict:
         """Liveness summary for /healthz: per-worker report age, stale

@@ -145,7 +145,7 @@ def bundle(reason: str, extra: Optional[dict] = None,
             _last_counter_snapshot = totals
     deltas = {k: v - prev.get(k, 0.0) for k, v in totals.items()
               if v - prev.get(k, 0.0) != 0.0}
-    from . import costmodel, forensics
+    from . import costmodel, forensics, tensorstats
     doc = {
         "schema": "paddle_tpu.flight.v1",
         "reason": reason,
@@ -156,14 +156,23 @@ def bundle(reason: str, extra: Optional[dict] = None,
         "program_costs": costmodel.summaries(),
         "compile_log": forensics.compile_log()[-32:],
         "metrics": obs_metrics.REGISTRY.to_json(),
+        # the full last tensorstats snapshot (per-variable min/max/rms/
+        # NaN counts): on a NumericGuard trip this is the first-bad-
+        # layer evidence, frozen into the post-mortem
+        "tensor_stats": tensorstats.snapshot_doc(),
     }
     if extra:
         doc["extra"] = {k: _safe(v) for k, v in extra.items()}
     doc = _strict_json(doc)
     # hard size bound: the bundle must stay shippable (one log line /
-    # one blob upload); the full registry is the first thing to go
+    # one blob upload); the full registry is the first thing to go,
+    # then the per-variable stats matrix (its scalar summary survives
+    # in the guard event), then the event ring shrinks
     if len(json.dumps(doc)) > _MAX_BUNDLE_BYTES:
         doc["metrics"] = {"truncated": True}
+        if len(json.dumps(doc)) > _MAX_BUNDLE_BYTES \
+                and doc.get("tensor_stats"):
+            doc["tensor_stats"] = {"truncated": True}
         if len(json.dumps(doc)) > _MAX_BUNDLE_BYTES:
             doc["events"] = doc["events"][-32:]
             doc["truncated_events"] = True

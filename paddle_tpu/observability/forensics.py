@@ -144,9 +144,13 @@ def diff_keys(old: KeyParts, new: KeyParts) -> List[Tuple[str, str]]:
     out += _sig_diff(old.state, new.state, "state_signature",
                      "state_signature", "state_signature")
     if old.flags != new.flags:
-        drifted = [f"{k}: {dict(old.flags).get(k)}->{v}"
-                   for k, v in new.flags
-                   if dict(old.flags).get(k) != v]
+        od, nd = dict(old.flags), dict(new.flags)
+        # symmetric: a flag present only in the OLD key (e.g. the
+        # tensorstats variant's appended tensor_stats entry, absent
+        # from the plain key) still names itself in the detail
+        keys = list(od) + [k for k in nd if k not in od]
+        drifted = [f"{k}: {od.get(k)}->{nd.get(k)}"
+                   for k in keys if od.get(k) != nd.get(k)]
         out.append(("flags", "; ".join(drifted)))
     return out
 

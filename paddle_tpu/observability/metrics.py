@@ -112,6 +112,16 @@ class Metric:
             for key in list(self._series):
                 self._series[key] = _Series(self.buckets)
 
+    def clear(self):
+        """Drop every labeled series (unlike reset(), which keeps the
+        label keys at zero) — for bounded-cardinality publishers that
+        re-publish a fresh top-K per sample (observability/tensorstats)
+        and must not accumulate stale label values forever."""
+        with self._lock:
+            self._series = {}
+            if not self.labelnames:
+                self._series[()] = _Series(self.buckets)
+
 
 class _Child:
     """One addressed series; exposes the metric-type verbs."""

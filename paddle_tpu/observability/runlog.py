@@ -1,0 +1,419 @@
+"""Run-history log: an append-only JSONL scalar trajectory per run.
+
+The metrics registry (PR 1) is process-lifetime state: when a bench or
+soak run ends, every per-step scalar it measured dies with the process,
+and "did loss diverge from last week's run at step 40?" is unanswerable.
+This module gives a run a durable trajectory — the Trainer appends one
+record per step (loss, lr, throughput, MFU, guard verdicts, sampled
+tensor statistics), ``bench.py`` appends one per workload row, and the
+CLI below reads it all back:
+
+    python -m paddle_tpu.observability.runlog run.jsonl             # tail
+    python -m paddle_tpu.observability.runlog run.jsonl --plot loss # trend
+    python -m paddle_tpu.observability.runlog \
+        --compare a.jsonl b.jsonl --metric loss --tolerance 0.05
+
+``--compare`` joins two runs step-aligned, prints the FIRST diverging
+step and exits nonzero when any aligned step's values differ by more
+than the (relative) tolerance — the bisection primitive for "which
+commit changed the loss curve".  ``--plot`` renders an ASCII trend so a
+soak box with no browser still shows a curve.
+
+File format: one JSON object per line, every record carrying
+``schema: paddle_tpu.runlog.v1`` plus ``kind`` (``meta`` | ``step`` |
+``guard`` | ``bench``) and ``time_unix``.  Non-finite floats are
+stringified (a NaN loss is exactly what gets logged) so every line is
+strict JSON.  Opening a path that already holds a previous run rotates
+it to ``<path>.1`` first (atomic ``os.replace``), so a restarted run
+never interleaves with its predecessor.  Writes never raise — a full
+disk must not take training down — failures land in
+``runlog_write_failures_total``.
+
+Enable via the ``runlog_path`` flag (``PTPU_RUNLOG_PATH``); the Trainer
+opens it per ``train()``.  The reference's closest analogue is scraping
+scalars out of its ``Print`` op's stderr — this is that, structured.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import operator
+import os
+import sys
+import threading
+import time
+import warnings
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import flags
+from . import metrics as obs_metrics
+
+SCHEMA = "paddle_tpu.runlog.v1"
+
+_m_records = obs_metrics.counter(
+    "runlog_records_total",
+    "Records appended to the run-history JSONL log.")
+_m_failures = obs_metrics.counter(
+    "runlog_write_failures_total",
+    "Runlog appends that failed (disk full / permission) and were "
+    "absorbed — telemetry must not take training down.")
+
+# every open writer, so tests can reset()/close leaked handles
+_open_logs: "weakref.WeakSet[RunLog]" = weakref.WeakSet()
+
+
+def _strict(v: Any):
+    """JSON-safe copy: non-finite floats stringified (strict JSON),
+    numpy scalars coerced, unknown objects repr-bounded."""
+    if isinstance(v, float):
+        return v if math.isfinite(v) else repr(v)
+    if isinstance(v, (int, bool, str)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _strict(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_strict(x) for x in v]
+    try:                      # integral numpy scalar (np.int64 step):
+        # must stay an int — a float-coerced step (3.0) would be
+        # rejected by _step_key on read-back and silently drop the
+        # record from --compare/--plot alignment
+        return int(operator.index(v))
+    except TypeError:
+        pass
+    try:                      # numpy scalar / 0-d array
+        return _strict(float(v))
+    except (TypeError, ValueError):
+        return repr(v)[:300]
+
+
+class RunLog:
+    """One append-only JSONL run history.  ``rotate=True`` (default)
+    atomically moves a pre-existing non-empty file to ``<path>.1``
+    before the first append, so each RunLog owns a fresh trajectory."""
+
+    def __init__(self, path: str, rotate: bool = True,
+                 meta: Optional[dict] = None):
+        self.path = str(path)
+        self.failed_writes = 0
+        self._lock = threading.Lock()
+        if rotate:
+            try:
+                if os.path.getsize(self.path) > 0:
+                    os.replace(self.path, self.path + ".1")
+            except FileNotFoundError:
+                pass             # no previous run
+            except OSError as e:
+                # rename needs DIR write; append may still succeed and
+                # would interleave two runs in one file — say so rather
+                # than silently corrupting --compare's step alignment
+                _m_failures.inc()
+                warnings.warn(
+                    f"runlog could not rotate {self.path!r} aside "
+                    f"({e}); appending to the previous run's file — "
+                    f"step records from both runs will interleave",
+                    RuntimeWarning, stacklevel=3)
+        self._f = open(self.path, "a", encoding="utf-8")
+        _open_logs.add(self)
+        if meta is not None:
+            self.write(kind="meta", **meta)
+
+    @property
+    def closed(self) -> bool:
+        return self._f is None
+
+    def write(self, **fields) -> Optional[dict]:
+        """Append one record (schema + time_unix added here).  Returns
+        the record, or None when the write failed / the log is closed —
+        never raises."""
+        rec: Dict[str, Any] = {"schema": SCHEMA, "time_unix": time.time()}
+        for k, v in fields.items():
+            rec[k] = _strict(v)
+        try:
+            line = json.dumps(rec, allow_nan=False,
+                              separators=(",", ":"))
+        except (TypeError, ValueError):
+            self.failed_writes += 1
+            _m_failures.inc()
+            return None
+        with self._lock:
+            if self._f is None:
+                return None
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                self.failed_writes += 1
+                _m_failures.inc()
+                return None
+        _m_records.inc()
+        return rec
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def open_runlog(path: Optional[str] = None,
+                meta: Optional[dict] = None) -> Optional[RunLog]:
+    """Flag-driven writer factory (the Trainer's entry point):
+    ``path=None`` reads the ``runlog_path`` flag and returns None at its
+    "" default.  An unopenable path WARNS and returns None — a run must
+    not die on a telemetry-only error."""
+    import warnings
+    if path is None:
+        path = str(flags.get_flag("runlog_path") or "")
+    if not path:
+        return None
+    try:
+        return RunLog(path, meta=meta)
+    except OSError as e:
+        _m_failures.inc()
+        warnings.warn(f"runlog not opened ({path}): {e}",
+                      RuntimeWarning, stacklevel=2)
+        return None
+
+
+def reset():
+    """Test hook: close every open writer so file handles (and their
+    half-written records) never leak across test cases."""
+    for log in list(_open_logs):
+        log.close()
+
+
+# -- reading / analysis -----------------------------------------------------
+
+def read_records(path: str) -> List[dict]:
+    """Parse a runlog back into records.  Strict: every non-blank line
+    must be a JSON object carrying this module's schema — the
+    round-trip contract the CLI (and tests) rely on."""
+    out: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{i}: not JSON ({e})") from e
+            if not isinstance(rec, dict) or rec.get("schema") != SCHEMA:
+                raise ValueError(
+                    f"{path}:{i}: schema "
+                    f"{rec.get('schema') if isinstance(rec, dict) else rec!r}"
+                    f" != {SCHEMA}")
+            out.append(rec)
+    return out
+
+
+def _value(rec: dict, metric: str) -> Optional[float]:
+    """A record's value for `metric` as a float; stringified non-finite
+    floats ("nan"/"inf", how _strict writes them) parse back; missing /
+    non-numeric -> None."""
+    v = rec.get(metric)
+    if isinstance(v, str):
+        try:
+            v = float(v)
+        except ValueError:
+            return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def step_records(records: Sequence[dict]) -> List[dict]:
+    """Alignable records: trainer steps plus bench rows (one per
+    workload, step = fixed workload index) so two bench runlogs diff
+    and plot with the same CLI as two training runs."""
+    return [r for r in records if r.get("kind") in ("step", "bench")]
+
+
+def _step_key(rec: dict) -> Optional[int]:
+    for k in ("global_step", "step"):
+        if isinstance(rec.get(k), int):
+            return int(rec[k])
+    return None
+
+
+def compare(a: Sequence[dict], b: Sequence[dict], metric: str = "loss",
+            tolerance: float = 0.05) -> dict:
+    """Step-aligned diff of two runs on one metric.  Divergence at a
+    step: relative difference > `tolerance` (against the larger
+    magnitude), or exactly one side non-finite.  Returns the verdict
+    plus the FIRST diverging step — what a bisection prints."""
+    av = {s: _value(r, metric) for r in step_records(a)
+          if (s := _step_key(r)) is not None}
+    bv = {s: _value(r, metric) for r in step_records(b)
+          if (s := _step_key(r)) is not None}
+    common = sorted(s for s in av if s in bv
+                    and av[s] is not None and bv[s] is not None)
+    first = None
+    max_rel = 0.0
+    for s in common:
+        x, y = av[s], bv[s]
+        fx, fy = math.isfinite(x), math.isfinite(y)
+        if fx and fy:
+            rel = abs(x - y) / max(abs(x), abs(y), 1e-12)
+        elif x == y or (math.isnan(x) and math.isnan(y)):
+            rel = 0.0            # both went bad the same way
+        else:
+            rel = float("inf")   # one side NaN/Inf = divergence
+        max_rel = max(max_rel, rel)
+        if rel > tolerance and first is None:
+            first = {"step": s, "a": _strict(x), "b": _strict(y),
+                     "rel_diff": _strict(rel)}
+    return {"schema": "paddle_tpu.runlog_compare.v1", "metric": metric,
+            "tolerance": tolerance, "steps_compared": len(common),
+            "only_a": len(av) - len(common), "only_b": len(bv) - len(common),
+            "max_rel_diff": _strict(max_rel),
+            "first_divergence": first, "diverged": first is not None}
+
+
+def render_trend(records: Sequence[dict], metric: str = "loss",
+                 width: int = 60, height: int = 10) -> str:
+    """ASCII trend of one metric over the run's steps — enough curve to
+    eyeball a soak box over ssh.  Steps bucket into `width` columns
+    (bucket mean); non-finite values render as ``!`` on the top row."""
+    pts: List[Tuple[int, float]] = []
+    bad_steps = []
+    for r in step_records(records):
+        s = _step_key(r)
+        v = _value(r, metric)
+        if s is None or v is None:
+            continue
+        if math.isfinite(v):
+            pts.append((s, v))
+        else:
+            bad_steps.append(s)
+    if not pts and not bad_steps:
+        return f"(no finite {metric!r} samples)"
+    pts.sort()
+    lo_s = min([s for s, _ in pts] + bad_steps)
+    hi_s = max([s for s, _ in pts] + bad_steps)
+    span = max(1, hi_s - lo_s)
+    width = max(8, int(width))
+    height = max(3, int(height))
+    cols: List[List[float]] = [[] for _ in range(width)]
+    bad_cols = set()
+    for s, v in pts:
+        cols[min(width - 1, (s - lo_s) * width // (span + 1))].append(v)
+    for s in bad_steps:
+        bad_cols.add(min(width - 1, (s - lo_s) * width // (span + 1)))
+    means = [sum(c) / len(c) if c else None for c in cols]
+    finite = [m for m in means if m is not None]
+    lo_v = min(finite) if finite else 0.0
+    hi_v = max(finite) if finite else 1.0
+    if hi_v == lo_v:
+        hi_v = lo_v + 1.0
+    grid = [[" "] * width for _ in range(height)]
+    for x, m in enumerate(means):
+        if m is None:
+            continue
+        y = int(round((m - lo_v) / (hi_v - lo_v) * (height - 1)))
+        grid[height - 1 - y][x] = "*"
+    for x in sorted(bad_cols):
+        grid[0][x] = "!"
+    label_w = 11
+    lines = []
+    for i, row in enumerate(grid):
+        if i == 0:
+            label = f"{hi_v:>10.4g} "
+        elif i == height - 1:
+            label = f"{lo_v:>10.4g} "
+        else:
+            label = " " * label_w
+        lines.append(label + "|" + "".join(row))
+    lines.append(" " * label_w + "+" + "-" * width)
+    lines.append(" " * label_w + f"step {lo_s} .. {hi_s}  ({metric}"
+                 + (", ! = NaN/Inf" if bad_cols else "") + ")")
+    return "\n".join(lines)
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _fmt_tail(rec: dict) -> str:
+    skip = {"schema", "time_unix"}
+    body = " ".join(f"{k}={rec[k]!r}" if isinstance(rec[k], str)
+                    else f"{k}={rec[k]}"
+                    for k in rec if k not in skip)
+    return body
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.runlog",
+        description="Inspect paddle_tpu.runlog.v1 JSONL run histories: "
+                    "tail records, diff two runs step-aligned, or "
+                    "render an ASCII trend.")
+    ap.add_argument("file", nargs="?",
+                    help="runlog to tail / plot")
+    ap.add_argument("--tail", type=int, default=10, metavar="N",
+                    help="records to show (default 10)")
+    ap.add_argument("--plot", metavar="METRIC",
+                    help="render an ASCII trend of METRIC instead of "
+                         "tailing")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                    help="step-aligned diff of two runlogs; exits 1 on "
+                         "divergence")
+    ap.add_argument("--metric", default="loss",
+                    help="metric for --compare (default loss)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative tolerance for --compare "
+                         "(default 0.05)")
+    ap.add_argument("--width", type=int, default=60)
+    ap.add_argument("--height", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    try:
+        if args.compare:
+            a = read_records(args.compare[0])
+            b = read_records(args.compare[1])
+            res = compare(a, b, metric=args.metric,
+                          tolerance=args.tolerance)
+            if res["steps_compared"] == 0:
+                print(f"runlog: no aligned steps carrying "
+                      f"{args.metric!r} in both runs", file=sys.stderr)
+                return 2
+            print(json.dumps(res))
+            if res["diverged"]:
+                f = res["first_divergence"]
+                print(f"DIVERGED at step {f['step']}: "
+                      f"{args.metric} {f['a']} vs {f['b']} "
+                      f"(rel diff {f['rel_diff']}, tolerance "
+                      f"{args.tolerance})")
+                return 1
+            print(f"ok: {res['steps_compared']} aligned steps within "
+                  f"tolerance {args.tolerance} "
+                  f"(max rel diff {res['max_rel_diff']})")
+            return 0
+        if not args.file:
+            ap.error("need a runlog file (or --compare A B)")
+        records = read_records(args.file)
+        if args.plot:
+            print(render_trend(records, metric=args.plot,
+                               width=args.width, height=args.height))
+            return 0
+        for rec in records[-max(1, args.tail):]:
+            print(_fmt_tail(rec))
+        return 0
+    except (OSError, ValueError) as e:
+        print(f"runlog: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

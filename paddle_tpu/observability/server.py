@@ -17,6 +17,9 @@ first ``train()`` when the flag is set, or call
 * ``/flight`` — the latest flight-recorder bundle (built on demand
   when nothing has tripped yet); with an aggregator, per-worker
   bundles ride along under ``workers``.
+* ``/model`` — model-health telemetry (tensorstats): this process's
+  full last per-variable statistics snapshot, plus every rank's
+  latest compact row when aggregating.
 """
 from __future__ import annotations
 
@@ -109,9 +112,12 @@ class _Handler(BaseHTTPRequestHandler):
                                 doc)
             elif path == "/flight":
                 self._send_json(200, obs.flight())
+            elif path == "/model":
+                self._send_json(200, obs.model())
             elif path == "/":
                 self._send(200, b"paddle_tpu observability: /metrics "
-                                b"/metrics.json /healthz /flight\n",
+                                b"/metrics.json /healthz /flight "
+                                b"/model\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send_json(404, {"error": f"no route {path}"})
@@ -213,6 +219,22 @@ class ObservabilityServer:
                 doc = dict(doc)
                 doc["workers"] = {str(r): b
                                   for r, b in sorted(workers.items())}
+        return doc
+
+    def model(self) -> dict:
+        """Model-health view (observability/tensorstats.py): this
+        process's full last snapshot plus — with an aggregator — every
+        rank's latest compact stats row."""
+        from . import tensorstats as obs_tensorstats
+        doc = {
+            "schema": "paddle_tpu.model.v1",
+            "time_unix": time.time(),
+            "enabled": obs_tensorstats.enabled(),
+            "local": obs_tensorstats.snapshot_doc(),
+        }
+        if self.aggregator is not None:
+            doc["workers"] = {str(r): row for r, row in sorted(
+                self.aggregator.model_rows().items())}
         return doc
 
 

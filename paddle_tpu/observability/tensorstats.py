@@ -1,0 +1,409 @@
+"""In-graph tensor statistics: model-health telemetry computed INSIDE
+the compiled train step.
+
+The systems planes (PRs 1, 3, 4) can say a step was slow or a program
+recompiled; they cannot say WHICH layer produced the first NaN, or how
+the gradient norm trended before the guard tripped.  This module closes
+that gap the TPU way: statistics are fused reductions traced into the
+step executable itself — min/max/mean/rms, NaN/Inf counts, gradient
+norms and weight-update ratios for every floating-point variable — and
+fetched as ONE packed ``[n_vars, 8]`` float32 array every
+``tensor_stats_interval`` steps.  A host-side loop over fetched tensors
+would destroy the MFU the perf PRs bought; a handful of fused
+reductions riding the existing dispatch costs one extra executable and
+nothing else:
+
+* ``tensor_stats`` **off** (default): the executor's compile key,
+  ``explain()`` report and step outputs are byte-identical to the
+  stats-less executor — zero overhead, zero extra compiles.
+* **on**: sampled steps run a second executable (compile key gains a
+  ``tensor_stats`` flags entry, so forensics diagnoses the flip as
+  ``flags`` drift — never a storm); non-sampled steps reuse the
+  ORIGINAL executable bit-for-bit.
+
+Variables are ordered by their FINAL write position in the program
+(feeds, then never-written state, then op outputs in op order), so the
+"earliest variable whose NaN/Inf count went nonzero" is the first bad
+producer in dataflow order — :func:`first_bad` / :func:`attribution`
+are what ``NumericGuard`` asks when it trips, and the flight bundle
+embeds the full last snapshot (:func:`snapshot_doc`).
+
+Consumers:
+
+* gauges ``model_grad_norm`` / ``model_update_ratio`` /
+  ``model_nan_vars`` with a bounded ``var`` label set (top-K per sample
+  + an ``__all__`` aggregate row — the families are CLEARED and
+  re-published each sample so cardinality never creeps);
+* ``FleetReporter`` ships :func:`fleet_row` so the coordinator's
+  ``/metrics`` shows per-rank grad norms and the aggregator warns on
+  cross-rank divergence (``grad_divergence_factor`` flag);
+* the observability HTTP endpoint serves the snapshot at ``/model``;
+* the Trainer's runlog records the sampled summary per step.
+
+The reference's analogue is its ``debugger``/graph-viz plane plus the
+``Print`` op (fetch-and-inspect a tensor mid-program); this module is
+the compiled-era version — no host round-trip per tensor, statistics
+land in the same registry/fleet/flight machinery as everything else.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import flags
+from . import metrics as obs_metrics
+
+SCHEMA = "paddle_tpu.tensorstats.v1"
+
+# reserved fetch name the stats variant appends to its compiled fetch
+# list; Executor.run pops it back off before returning to the caller
+FETCH_NAME = "__tensor_stats__"
+
+# packed-array column order (one row per variable)
+COLUMNS = ("min", "max", "mean", "rms", "nan_count", "inf_count",
+           "numel", "delta_rms")
+_NAN, _INF, _RMS, _NUMEL, _DELTA = 4, 5, 3, 6, 7
+
+GRAD_SUFFIX = "@GRAD"
+
+_m_grad_norm = obs_metrics.gauge(
+    "model_grad_norm",
+    "Per-variable gradient L2 norm from the last tensorstats sample "
+    "(top-K by norm + the '__all__' global norm; bounded cardinality — "
+    "the family is re-published per sample).", ("var",))
+_m_update_ratio = obs_metrics.gauge(
+    "model_update_ratio",
+    "Per-parameter weight-update ratio ||delta|| / ||theta|| of the "
+    "last sampled step (top-K + '__all__'; ~1e-3 is healthy SGD, ~1 "
+    "means the step is rewriting the weights).", ("var",))
+_m_nan_vars = obs_metrics.gauge(
+    "model_nan_vars",
+    "NaN/Inf element counts per variable in the last tensorstats "
+    "sample (top-K offenders; '__all__' = number of bad variables).",
+    ("var",))
+_m_samples = obs_metrics.counter(
+    "model_stats_samples_total",
+    "Tensorstats samples fetched from the compiled step.")
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"counter": 0, "snapshot": None, "samples": 0,
+                          "position": None, "mesh_warned": False}
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("tensor_stats"))
+
+
+def reset():
+    """Test hook: zero the sampling counter and drop the snapshot (and
+    the per-var gauge series, which are re-published per sample)."""
+    with _lock:
+        _state["counter"] = 0
+        _state["snapshot"] = None
+        _state["samples"] = 0
+        _state["position"] = None
+        _state["mesh_warned"] = False
+    for m in (_m_grad_norm, _m_update_ratio, _m_nan_vars):
+        m.clear()
+
+
+def note_position(epoch: int, step: int):
+    """Trainer hook, called before each dispatch: stamps the RESUMABLE
+    (epoch, step-in-epoch) position onto the next sample.  The fallback
+    dispatch counter restarts at 0 when an elastic worker is respawned,
+    so cross-rank row alignment (the fleet divergence check) must key
+    on the trainer's checkpoint-resumed position, not process age."""
+    with _lock:
+        _state["position"] = (int(epoch), int(step))
+
+
+def _is_train_program(program) -> bool:
+    """True when the program contains an autodiff op (a train step) —
+    cached per program version so the per-run check is O(1)."""
+    ver = getattr(program, "_ts_ad_version", None)
+    if ver != program._version:
+        program._ts_ad_version = program._version
+        program._ts_has_ad = any(
+            op.type == "autodiff" for op in program.global_block().ops)
+    return bool(program._ts_has_ad)
+
+
+def want_sample(program) -> bool:
+    """Called by Executor.run once per dispatch of `program`: advances
+    the sampling counter (train programs only, flag on only) and says
+    whether THIS step should run the stats variant."""
+    if not enabled() or not _is_train_program(program):
+        return False
+    interval = max(1, int(flags.get_flag("tensor_stats_interval")))
+    with _lock:
+        n = _state["counter"]
+        _state["counter"] = n + 1
+    return n % interval == 0
+
+
+def note_mesh_skipped(program):
+    """Executor hook for the mesh path: in-graph sampling only augments
+    the single-device jitted step — under a mesh the feeds/fetches are
+    sharded and the stats fetch is not wired through pjit.  When the
+    flag is on anyway, warn ONCE so the operator learns the flag is
+    inert in this configuration (and how to get per-rank stats) instead
+    of silently missing samples, divergence checks and attribution."""
+    if not enabled() or not _is_train_program(program):
+        return
+    with _lock:
+        if _state["mesh_warned"]:
+            return
+        _state["mesh_warned"] = True
+    warnings.warn(
+        "tensor_stats=True but this Executor drives a sharded (mesh) "
+        "program — in-graph tensor statistics are single-device only "
+        "and NO samples will be collected on this path (grad-divergence "
+        "checks and NaN attribution stay dark).  Run each data-parallel "
+        "rank with a mesh-less per-process executor to sample per-rank "
+        "stats, or disable tensor_stats under this mesh.",
+        RuntimeWarning, stacklevel=3)
+
+
+def sample_count() -> int:
+    return int(_state["samples"])
+
+
+# -- trace-time: inside the compiled step -----------------------------------
+
+def stats_order(ops, feed_names: Sequence[str],
+                state_names: Sequence[str]) -> List[str]:
+    """Variable names ordered by FINAL write position: feeds first,
+    then state vars no op rewrites, then every op output at the index
+    of its last producing op.  A NaN scan in this order finds the first
+    bad PRODUCER, not an updated-parameter casualty of a bad gradient
+    (optimizer writes land last)."""
+    pos: Dict[str, Tuple[int, str]] = {}
+    for n in sorted(feed_names):
+        pos.setdefault(n, (-2, n))
+    for n in state_names:
+        pos.setdefault(n, (-1, n))
+    for i, op in enumerate(ops):
+        for names in op.outputs.values():
+            for n in names:
+                if n:
+                    pos[n] = (i, n)
+    return [n for n, _ in sorted(pos.items(), key=lambda kv: kv[1])]
+
+
+def pack(order: Sequence[str], env: Dict[str, Any],
+         state: Dict[str, Any]) -> Tuple[List[str], Any]:
+    """Trace-time: build the packed ``[n_vars, 8]`` float32 stats array
+    from the step environment.  Only floating/complex-free inexact
+    tensors are covered; ``delta_rms`` is nonzero only for state vars
+    an op actually rewrote (identity check against the input state —
+    an untouched var is the SAME traced value)."""
+    import jax.numpy as jnp
+
+    names: List[str] = []
+    rows = []
+    for name in order:
+        v = env.get(name)
+        if v is None or isinstance(v, (bool, int, float, str, bytes)):
+            continue
+        dtype = getattr(v, "dtype", None)
+        shape = getattr(v, "shape", None)
+        if dtype is None or shape is None:
+            continue
+        try:
+            if not jnp.issubdtype(dtype, jnp.floating):
+                continue
+        except TypeError:
+            continue
+        numel = int(np.prod(shape)) if len(shape) else 1
+        if numel == 0:
+            continue
+        x = jnp.asarray(v).astype(jnp.float32).reshape(-1)
+        old = state.get(name)
+        if (old is not None and old is not v
+                and getattr(old, "shape", None) == shape
+                and jnp.issubdtype(getattr(old, "dtype", np.int32),
+                                   jnp.floating)):
+            d = x - jnp.asarray(old).astype(jnp.float32).reshape(-1)
+            delta_rms = jnp.sqrt(jnp.mean(d * d))
+        else:
+            delta_rms = jnp.float32(0.0)
+        rows.append(jnp.stack([
+            jnp.min(x), jnp.max(x), jnp.mean(x),
+            jnp.sqrt(jnp.mean(x * x)),
+            jnp.isnan(x).sum().astype(jnp.float32),
+            jnp.isinf(x).sum().astype(jnp.float32),
+            jnp.float32(numel), delta_rms]))
+        names.append(name)
+    packed = jnp.stack(rows) if rows else jnp.zeros((0, len(COLUMNS)),
+                                                    jnp.float32)
+    return names, packed
+
+
+# -- host-side: sample ingestion --------------------------------------------
+
+def _norm(row) -> float:
+    """L2 norm from a stats row: rms * sqrt(numel)."""
+    return float(row[_RMS] * math.sqrt(max(row[_NUMEL], 0.0)))
+
+
+def note_sample(program, names: List[str], packed) -> Optional[dict]:
+    """Ingest one fetched stats array: store the snapshot (what the
+    guard/flight/fleet read) and re-publish the bounded model_* gauge
+    families.  Never raises — telemetry must not take training down."""
+    try:
+        arr = np.asarray(packed, dtype=np.float64)
+        if arr.ndim != 2 or arr.shape[0] != len(names):
+            return None
+        snap = _build_snapshot(program, list(names), arr)
+        with _lock:
+            _state["snapshot"] = snap
+            _state["samples"] += 1
+            snap["sample"] = _state["samples"]
+        _m_samples.inc()
+        _publish_gauges(snap)
+        return snap
+    except Exception:
+        return None
+
+
+def _build_snapshot(program, names: List[str], arr: np.ndarray) -> dict:
+    bad = arr[:, _NAN] + arr[:, _INF]
+    bad_idx = np.nonzero(bad > 0)[0]
+    grad_sq = upd_sq = theta_sq = 0.0
+    for i, n in enumerate(names):
+        sq = float(arr[i, _RMS]) ** 2 * float(arr[i, _NUMEL])
+        if n.endswith(GRAD_SUFFIX) and math.isfinite(sq):
+            grad_sq += sq
+        if arr[i, _DELTA] > 0:
+            d = float(arr[i, _DELTA]) ** 2 * float(arr[i, _NUMEL])
+            if math.isfinite(d):
+                upd_sq += d
+            if math.isfinite(sq):
+                theta_sq += sq
+    pos = _state["position"]
+    return {
+        "schema": SCHEMA,
+        "time_unix": time.time(),
+        "program": getattr(program, "_uid", None),
+        "epoch": pos[0] if pos is not None else None,
+        "step": (pos[1] if pos is not None
+                 else max(0, int(_state["counter"]) - 1)),
+        "columns": list(COLUMNS),
+        "names": names,
+        "stats": arr,
+        "grad_norm": math.sqrt(grad_sq),
+        "update_ratio": (math.sqrt(upd_sq / theta_sq)
+                         if theta_sq > 0 else 0.0),
+        "nan_vars": int(len(bad_idx)),
+        "first_bad": names[int(bad_idx[0])] if len(bad_idx) else None,
+    }
+
+
+def _publish_gauges(snap: dict):
+    names, arr = snap["names"], snap["stats"]
+    k = max(1, int(flags.get_flag("tensor_stats_topk")))
+    for m in (_m_grad_norm, _m_update_ratio, _m_nan_vars):
+        m.clear()
+
+    grads = [(n, _norm(arr[i])) for i, n in enumerate(names)
+             if n.endswith(GRAD_SUFFIX)]
+    for n, v in sorted(grads, key=lambda kv: -_finite_or_inf(kv[1]))[:k]:
+        _m_grad_norm.labels(var=n).set(v)
+    _m_grad_norm.labels(var="__all__").set(snap["grad_norm"])
+
+    ratios = [(n, float(arr[i, _DELTA]) / (float(arr[i, _RMS]) + 1e-12))
+              for i, n in enumerate(names) if arr[i, _DELTA] > 0]
+    for n, v in sorted(ratios, key=lambda kv: -_finite_or_inf(kv[1]))[:k]:
+        _m_update_ratio.labels(var=n).set(v)
+    _m_update_ratio.labels(var="__all__").set(snap["update_ratio"])
+
+    bad = [(n, float(arr[i, _NAN] + arr[i, _INF]))
+           for i, n in enumerate(names) if arr[i, _NAN] + arr[i, _INF] > 0]
+    for n, v in sorted(bad, key=lambda kv: -kv[1])[:k]:
+        _m_nan_vars.labels(var=n).set(v)
+    _m_nan_vars.labels(var="__all__").set(float(snap["nan_vars"]))
+
+
+def _finite_or_inf(v: float) -> float:
+    # NaN norms (a var that IS all-NaN) sort as +inf: the most broken
+    # variable belongs at the top of the top-K, not dropped by a NaN
+    # comparison quirk
+    return v if not math.isnan(v) else float("inf")
+
+
+# -- consumers: guard attribution, flight, fleet, /model --------------------
+
+def snapshot() -> Optional[dict]:
+    """The raw last sample (stats as an ndarray), or None."""
+    return _state["snapshot"]
+
+
+def first_bad() -> Optional[Tuple[str, float, int]]:
+    """(name, bad_element_count, sample_step) of the EARLIEST variable
+    in final-write order whose NaN/Inf count is nonzero in the last
+    sample — the first bad producer, not just any NaN var."""
+    snap = _state["snapshot"]
+    if not snap or snap["first_bad"] is None:
+        return None
+    arr, names = snap["stats"], snap["names"]
+    i = names.index(snap["first_bad"])
+    return snap["first_bad"], float(arr[i, _NAN] + arr[i, _INF]), \
+        snap["step"]
+
+
+def attribution() -> Tuple[str, str]:
+    """(label, detail) naming the first bad variable for guard log
+    lines and the bounded ``first_var`` metric label.  Always answers:
+    falls back to an 'unattributed' label explaining what to enable."""
+    if not enabled():
+        return "unattributed", "unattributed(enable tensor_stats)"
+    snap = _state["snapshot"]
+    if snap is None:
+        return "unattributed", \
+            "unattributed(tensor_stats on but no sample landed yet)"
+    fb = first_bad()
+    if fb is None:
+        return "unattributed", (
+            f"unattributed(last tensorstats sample @step {snap['step']} "
+            f"was clean; lower tensor_stats_interval to catch the bad "
+            f"step)")
+    name, count, step = fb
+    return name, (f"first bad var {name!r} ({int(count)} NaN/Inf "
+                  f"elements, tensorstats sample @step {step})")
+
+
+def snapshot_doc() -> Optional[dict]:
+    """The last sample as a JSON-ready document (flight bundle embed,
+    the /model HTTP route, tests)."""
+    snap = _state["snapshot"]
+    if snap is None:
+        return None
+    doc = {k: v for k, v in snap.items() if k != "stats"}
+    doc["stats"] = [[_jsonable(x) for x in row]
+                    for row in np.asarray(snap["stats"]).tolist()]
+    return doc
+
+
+def _jsonable(v: float):
+    return v if math.isfinite(v) else repr(float(v))
+
+
+def fleet_row() -> Optional[dict]:
+    """The compact per-rank summary FleetReporter ships: enough for the
+    coordinator's divergence check and per-rank /metrics without moving
+    the whole snapshot every interval."""
+    snap = _state["snapshot"]
+    if snap is None:
+        return None
+    return {"step": snap["step"], "epoch": snap.get("epoch"),
+            "sample": snap.get("sample", 0),
+            "time_unix": snap["time_unix"],
+            "grad_norm": _jsonable(snap["grad_norm"]),
+            "update_ratio": _jsonable(snap["update_ratio"]),
+            "nan_vars": snap["nan_vars"],
+            "first_bad": snap["first_bad"]}

@@ -273,6 +273,47 @@ def poison(site: str, value: Any) -> Any:
     return _poison_one(value)
 
 
+# prefix of the named-variable poison family planted in the executor's
+# lowering loop: `executor.var.<var_name>=nan:1.0` NaN-poisons that
+# variable's value INSIDE the step — the deterministic "this layer went
+# bad" injection tensorstats' first-bad-layer attribution is tested
+# against (docs/RESILIENCE.md catalog).
+VAR_SITE_PREFIX = "executor.var."
+
+
+def var_sites_armed() -> bool:
+    """True when any executor.var.* directive is armed — the executor's
+    per-op guard, so the unarmed hot path pays one empty-dict check."""
+    spec = _active()
+    if not spec:
+        return False
+    return any(s.startswith(VAR_SITE_PREFIX) for s in spec)
+
+
+def poison_value(site: str, value: Any) -> Any:
+    """NaN/Inf-poison a (possibly traced) floating tensor when a
+    nan/inf fault fires at `site`; returns it unchanged otherwise.
+    Unlike :func:`poison` (host-side numpy), this composes with jax
+    tracing: inside a jitted step the fire decision lands at TRACE time
+    and the poison is baked into that executable — so use prob 1.0 (or
+    expect the probability to apply per compile, not per step; eager
+    modes decide per step as usual)."""
+    fault = _active().get(site)
+    if fault is None or fault.kind not in ("nan", "inf"):
+        return value
+    try:
+        import jax.numpy as jnp
+        if not jnp.issubdtype(getattr(value, "dtype", None),
+                              jnp.floating):
+            return value
+    except Exception:
+        return value
+    if _decide(fault) is None:
+        return value
+    bad = float("nan") if fault.kind == "nan" else float("inf")
+    return jnp.full_like(value, bad)
+
+
 def corrupt_file(site: str, path: str):
     """Torn-write simulation: truncate `path` to the armed fraction of
     its bytes when a truncate fault fires at `site` (the partial flush a

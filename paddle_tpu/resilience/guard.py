@@ -25,11 +25,16 @@ from typing import Optional
 from ..core import flags
 from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
+from ..observability import tensorstats as obs_tensorstats
 
 _m_bad_steps = obs_metrics.counter(
     "trainer_bad_steps_total",
     "Steps whose fetched loss failed the numeric guard, by reason "
-    "(nan = NaN/Inf, spike = EMA loss-spike).", ("reason",))
+    "(nan = NaN/Inf, spike = EMA loss-spike) and first-bad-variable "
+    "attribution (the earliest var with NaN/Inf in the last "
+    "tensorstats sample; bounded: top offender only, 'unattributed' "
+    "when tensor_stats sampling has no answer).",
+    ("reason", "first_var"))
 
 POLICIES = ("raise", "skip_step", "rollback")
 
@@ -74,6 +79,10 @@ class NumericGuard:
         self.ema: Optional[float] = None
         self.healthy_steps = 0
         self.consecutive_bad = 0
+        # last non-OK verdict + its first-bad-var attribution detail —
+        # the Trainer names these in its raise/skip/rollback log lines
+        self.last_verdict: str = OK
+        self.last_attribution: str = ""
 
     def observe(self, loss: float) -> str:
         loss = float(loss)
@@ -87,21 +96,41 @@ class NumericGuard:
         if verdict == OK:
             self.consecutive_bad = 0
             self.healthy_steps += 1
+            self.last_verdict = OK
+            self.last_attribution = ""
             self.ema = loss if self.ema is None else (
                 self.ema_decay * self.ema + (1 - self.ema_decay) * loss)
             return verdict
         self.consecutive_bad += 1
-        _m_bad_steps.labels(reason=verdict).inc()
+        # first-bad-layer attribution: the earliest variable (in final-
+        # write order) whose NaN/Inf count went nonzero in the last
+        # tensorstats sample.  Always answers — when sampling is off or
+        # the last sample was clean, the label is 'unattributed' and the
+        # detail says what to enable (satellite: the metric/log carry
+        # the attribution string even with tensor_stats off).  NaN
+        # verdicts only: a finite-loss spike has no NaN to attribute,
+        # and a stale NaN sample from an earlier bad step would pin the
+        # spike on an unrelated layer.
+        if verdict == NAN:
+            label, detail = obs_tensorstats.attribution()
+        else:
+            label, detail = "unattributed", \
+                "unattributed(finite loss spike, no NaN to attribute)"
+        self.last_verdict = verdict
+        self.last_attribution = detail
+        _m_bad_steps.labels(reason=verdict, first_var=label).inc()
         obs_flight.record("guard", verdict, loss=loss,
                           consecutive_bad=self.consecutive_bad,
-                          policy=self.policy)
+                          policy=self.policy, first_var=label,
+                          attribution=detail)
         if 0 < self.bad_step_limit <= self.consecutive_bad:
             obs_flight.dump("circuit_breaker",
                             extra={"verdict": verdict, "loss": loss,
                                    "consecutive_bad": self.consecutive_bad,
-                                   "bad_step_limit": self.bad_step_limit})
+                                   "bad_step_limit": self.bad_step_limit,
+                                   "attribution": detail})
             raise CircuitBreakerOpen(
                 f"{self.consecutive_bad} consecutive bad steps (last: "
-                f"{verdict}, loss={loss!r}) >= bad_step_limit "
+                f"{verdict}, loss={loss!r}, {detail}) >= bad_step_limit "
                 f"{self.bad_step_limit}; training is not recovering")
         return verdict

@@ -17,7 +17,11 @@ import os
 import signal
 import time
 import warnings
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, TYPE_CHECKING
+
+if TYPE_CHECKING:                # runtime import stays lazy (round-7
+    # gotcha: CLI modules must be out of the package-import graph)
+    from .observability import runlog as obs_runlog
 
 import jax
 import numpy as np
@@ -33,6 +37,7 @@ from .observability import costmodel as obs_cost
 from .observability import flight as obs_flight
 from .observability import metrics as obs_metrics
 from .observability import server as obs_server
+from .observability import tensorstats as obs_tensorstats
 from .observability import trace as obs_trace
 from .resilience import chaos, guard as rguard, retry as rretry
 
@@ -194,6 +199,11 @@ class Trainer:
             check_arg(isinstance(opt, optim.Optimizer),
                       "optimizer_func must return an Optimizer")
             opt.minimize(self.loss, accumulate_steps=accumulate_steps)
+        # kept for the runlog's per-step lr field (scalar lr only; a
+        # Variable-scheduled lr is the program's business, not ours)
+        self._optimizer = opt
+        self._runlog: Optional[obs_runlog.RunLog] = None
+        self._runlog_pos = (0, 0, 0)     # (epoch, step, global_step)
 
         self.test_program = self.train_program.clone(for_test=True)
         mesh = self._dist_transpile_if_necessary(mesh)
@@ -353,6 +363,22 @@ class Trainer:
         stop = self._install_preemption_handlers()
         obs_server.ensure_started()     # obs_http_port flag, 0 = off
         obs_server.note_trainer_running(True)
+        # durable run history (runlog_path flag, "" = off): one JSONL
+        # record per step — loss, lr, throughput, MFU, guard verdicts,
+        # sampled tensor stats — surviving the process so two runs can
+        # be diffed step-aligned (observability/runlog.py CLI)
+        # imported here, not at module top: ``python -m
+        # paddle_tpu.observability.runlog`` must not find the CLI module
+        # pre-imported via the paddle_tpu package (runpy RuntimeWarning)
+        from .observability import runlog as obs_runlog
+        self._runlog = obs_runlog.open_runlog(meta={
+            "event": "train_start", "num_epochs": num_epochs,
+            "resume_epoch": self.epoch_offset,
+            "resume_step": self.step_offset,
+            "nan_policy": health.policy})
+        # fresh-sample watermark: a step record embeds tensor stats only
+        # when THIS step fetched a new sample (tensor_stats_interval)
+        last_stats_sample = obs_tensorstats.sample_count()
         # step anatomy accumulators for the input-bound diagnosis
         anatomy = {"data_wait": 0.0, "step": 0.0, "n": 0, "warned": False,
                    "prefetch": prefetch}
@@ -396,6 +422,11 @@ class Trainer:
                         data_wait += time.perf_counter() - tf
                         n_examples = len(batch)
                         donate = False
+                    if obs_tensorstats.enabled():
+                        # stamp the checkpoint-resumable position onto
+                        # any sample this dispatch lands (fleet rows
+                        # must align across worker restarts)
+                        obs_tensorstats.note_position(epoch_id, step_id)
                     with chaos.fault_point("trainer.step"):
                         # --- host: dispatch without blocking ----------
                         th = time.perf_counter()
@@ -445,16 +476,25 @@ class Trainer:
                     if dt > 0:
                         _m_examples_per_sec.set(n_examples / dt)
                         self._record_mfu(dt)
+                    raw_loss = None
+                    guard_verdict = None
+                    self._runlog_pos = (epoch_id, step_id, step_in_total)
                     if metrics:
-                        loss_val = float(np.mean(np.asarray(metrics[0])))
+                        raw_loss = loss_val = \
+                            float(np.mean(np.asarray(metrics[0])))
                         if not self._guard_step(health, loss_val):
                             metrics = []    # unhealthy: keep it out of
                             loss_val = None  # EMA/gauges and the event
+                            guard_verdict = health.last_verdict
                     if metrics:
                         _m_loss.set(loss_val)
                         # the guard's EMA (healthy steps only, decay
                         # _EMA_DECAY) is the single "expected loss"
                         _m_loss_ema.set(health.ema)
+                    last_stats_sample = self._runlog_step(
+                        health, epoch_id, step_id, step_in_total, dt,
+                        n_examples, raw_loss, guard_verdict,
+                        last_stats_sample)
                     if step_in_total % _MEM_SAMPLE_EVERY == 0:
                         observability.record_device_memory()
                     obs_trace.add_instant(
@@ -491,6 +531,11 @@ class Trainer:
                             extra={"error": repr(e)[:500]})
             raise
         finally:
+            if self._runlog is not None:
+                self._runlog.write(kind="meta", event="train_end",
+                                   preempted=self.preempted)
+                self._runlog.close()
+                self._runlog = None
             obs_server.note_trainer_running(False)
             self._restore_preemption_handlers(stop)
 
@@ -553,31 +598,104 @@ class Trainer:
         peak = obs_cost.device_peak_flops()
         if peak > 0:
             _m_mfu.set(fps / peak)
+
+    def _lr_value(self) -> Optional[float]:
+        lr = getattr(getattr(self, "_optimizer", None), "_lr_input", None)
+        return float(lr) if isinstance(lr, (int, float)) else None
+
+    def _runlog_step(self, health, epoch_id, step_id, global_step, dt,
+                     n_examples, raw_loss, guard_verdict,
+                     last_stats_sample: int) -> int:
+        """Append one per-step record to the run history (no-op when
+        the runlog is off).  Returns the tensorstats sample watermark so
+        stats rows land only on the step that actually fetched them."""
+        if self._runlog is None:
+            return last_stats_sample
+        rec = {"kind": "step", "epoch": epoch_id, "step": step_id,
+               "global_step": global_step, "step_seconds": dt,
+               "lr": self._lr_value()}
+        if dt > 0:
+            rec["examples_per_sec"] = n_examples / dt
+        if raw_loss is not None:
+            rec["loss"] = raw_loss
+        if guard_verdict is None and health.ema is not None \
+                and raw_loss is not None:
+            rec["loss_ema"] = health.ema
+        if guard_verdict is not None:
+            rec["guard"] = guard_verdict
+            rec["attribution"] = health.last_attribution
+        mfu = _m_mfu.value
+        if mfu > 0:
+            rec["mfu"] = mfu
+        tflops = _m_tflops.value
+        if tflops > 0:
+            rec["tflops"] = tflops
+        sample = obs_tensorstats.sample_count()
+        if sample != last_stats_sample:
+            rec["stats"] = obs_tensorstats.fleet_row()
+        self._runlog.write(**rec)
+        return sample
+
+    def _write_guard_record(self, health, loss_val,
+                            breaker: bool = False):
+        """Guard trips get their own runlog record — written BEFORE the
+        policy raises, so the fatal step's verdict and attribution are
+        in the durable history, not just the flight bundle."""
+        if self._runlog is None:
+            return
+        epoch_id, step_id, global_step = self._runlog_pos
+        self._runlog.write(
+            kind="guard", epoch=epoch_id, step=step_id,
+            global_step=global_step, verdict=health.last_verdict,
+            loss=float(loss_val), policy=health.policy,
+            attribution=health.last_attribution,
+            consecutive_bad=health.consecutive_bad,
+            circuit_breaker=bool(breaker))
+
     def _guard_step(self, health: "rguard.NumericGuard",
                     loss_val: float) -> bool:
         """Apply the numeric-guard policy to one fetched loss.  True =
         healthy; False = bad step absorbed (skip/rollback).  Raises on
         policy 'raise' and always on an open circuit breaker."""
-        verdict = health.observe(loss_val)   # raises CircuitBreakerOpen
+        try:
+            verdict = health.observe(loss_val)  # raises CircuitBreakerOpen
+        except rguard.CircuitBreakerOpen:
+            self._write_guard_record(health, loss_val, breaker=True)
+            raise
         if verdict == rguard.OK:
             return True
+        self._write_guard_record(health, loss_val)
+        # first-bad-layer attribution (observability/tensorstats.py):
+        # every raise/skip/rollback line names the earliest variable
+        # that went NaN/Inf — or 'unattributed(enable tensor_stats)'
+        attr = health.last_attribution
         if health.policy == "raise":
             obs_flight.dump("numeric_guard",
-                            extra={"verdict": verdict, "loss": loss_val})
+                            extra={"verdict": verdict, "loss": loss_val,
+                                   "attribution": attr})
             raise rguard.BadStepError(
-                f"numeric guard: {verdict} loss {loss_val!r} "
+                f"numeric guard: {verdict} loss {loss_val!r} [{attr}] "
                 f"(nan_policy=raise)")
         if health.policy == "rollback":
             if not self._rollback():
                 obs_flight.dump("numeric_guard",
                                 extra={"verdict": verdict,
                                        "loss": loss_val,
+                                       "attribution": attr,
                                        "rollback": "no valid checkpoint"})
                 raise rguard.BadStepError(
-                    f"numeric guard: {verdict} loss {loss_val!r} and no "
-                    f"valid checkpoint to roll back to")
+                    f"numeric guard: {verdict} loss {loss_val!r} "
+                    f"[{attr}] and no valid checkpoint to roll back to")
+            warnings.warn(
+                f"numeric guard: {verdict} loss {loss_val!r} [{attr}] — "
+                f"rolled back to the newest valid checkpoint "
+                f"(nan_policy=rollback)", RuntimeWarning, stacklevel=3)
         else:
             _m_skipped.inc()
+            warnings.warn(
+                f"numeric guard: {verdict} loss {loss_val!r} [{attr}] — "
+                f"step dropped from the health statistics "
+                f"(nan_policy=skip_step)", RuntimeWarning, stacklevel=3)
         return False
 
     def _install_preemption_handlers(self) -> Dict:

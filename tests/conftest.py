@@ -43,6 +43,7 @@ def fresh_programs():
     from paddle_tpu.distributed import task_queue
     from paddle_tpu.framework import executor as executor_mod
     from paddle_tpu.observability import costmodel, flight, forensics
+    from paddle_tpu.observability import runlog, tensorstats
     from paddle_tpu.observability import server as obs_server
     from paddle_tpu.resilience import chaos
     pt.reset_default_programs()
@@ -53,6 +54,11 @@ def fresh_programs():
     forensics.reset()
     flight.reset()
     obs_server.reset()
+    # model-health telemetry: zero the sampling counter/snapshot and
+    # close any runlog writer a test left open — sampling cadence and
+    # file handles must not leak across cases
+    tensorstats.reset()
+    runlog.reset()
     # forget the previous test's masters (weakset) and zero the
     # queue/membership gauges: a scrape-time refresh_metrics() must not
     # re-publish a dead master's fleet_workers / taskmaster_tasks series

@@ -32,6 +32,31 @@ def test_per_op_nan_check_names_offending_op():
         flags.set_flag("check_nan_inf_per_op", False)
 
 
+def test_per_op_nan_check_names_chaos_poisoned_producer():
+    """An ``executor.var.<name>`` chaos poison is visible to the per-op
+    localizer AT the poisoned producer — not first at a downstream
+    consumer.  (The poison pokes the executor env; the localizer reads
+    the op's outs, so the two views must stay in sync.)"""
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.scale(x, scale=2.0)
+    z = layers.scale(y, scale=3.0)
+    out = layers.mean(z)
+    exe = pt.Executor(pt.CPUPlace())
+    flags.set_flag("check_nan_inf_per_op", True)
+    flags.set_flag("chaos_spec", f"executor.var.{y.name}=nan:1.0")
+    try:
+        with pytest.raises(EnforceNotMet) as ei:
+            exe.run(pt.default_main_program(),
+                    feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[out])
+        msg = str(ei.value)
+        assert repr(y.name) in msg          # the poisoned producer...
+        assert repr(z.name) not in msg      # ...not its consumer
+    finally:
+        flags.set_flag("check_nan_inf_per_op", False)
+        flags.set_flag("chaos_spec", "")
+
+
 def test_per_op_nan_check_passes_clean_program():
     x = layers.data("x", [4], dtype="float32")
     out = layers.mean(layers.exp(x))

@@ -1052,3 +1052,130 @@ def test_two_rank_fleet_scrape_end_to_end(tmp_path):
     finally:
         obs_server.stop_http_server()
         srv.shutdown()
+
+
+# --- model-health fleet path (ISSUE 7) ------------------------------------
+
+def _model_payload(rank, step, norm, steps=10.0, epoch=None):
+    p = _payload(rank, steps=steps)
+    p["model"] = {"step": step, "epoch": epoch, "sample": 1,
+                  "time_unix": time.time(),
+                  "grad_norm": norm, "update_ratio": 0.01,
+                  "nan_vars": 0, "first_bad": None}
+    return p
+
+
+def test_grad_divergence_warning_once_per_step():
+    """Same-step per-rank grad norms differing by > the factor under dp
+    warn ONCE per sample step and bump the counter; matched norms and
+    repeat reports stay quiet."""
+    agg = fleet.FleetAggregator(grad_divergence_factor=10.0)
+    c = obs_metrics.REGISTRY.get("fleet_grad_divergence_warnings_total")
+    c0 = c.value
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        agg.ingest_metrics(_model_payload(0, 5, 1.0))
+        agg.ingest_metrics(_model_payload(1, 5, 1.5))    # in sync: quiet
+        agg.ingest_metrics(_model_payload(1, 6, 55.0))
+        agg.ingest_metrics(_model_payload(0, 6, 1.0))    # 55x gap: warn
+        agg.ingest_metrics(_model_payload(0, 6, 1.0))    # repeat: once
+    div = [x for x in w if "grad divergence" in str(x.message)]
+    assert len(div) == 1
+    msg = str(div[0].message)
+    assert "step 6" in msg and "rank 1" in msg and "55" in msg
+    assert c.value - c0 == 1
+    assert agg.model_rows()[1]["grad_norm"] == 55.0
+
+
+def test_grad_divergence_respects_disable_and_mismatched_steps():
+    agg = fleet.FleetAggregator(grad_divergence_factor=0.0)  # <=1 = off
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        agg.ingest_metrics(_model_payload(0, 3, 1.0))
+        agg.ingest_metrics(_model_payload(1, 3, 1e6))
+    assert [x for x in w if "divergence" in str(x.message)] == []
+    # different sample steps never compare (interval skew is normal)
+    agg2 = fleet.FleetAggregator(grad_divergence_factor=10.0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        agg2.ingest_metrics(_model_payload(0, 3, 1.0))
+        agg2.ingest_metrics(_model_payload(1, 4, 1e6))
+        # non-finite norms are the guard's problem, not a sync verdict
+        agg2.ingest_metrics(_model_payload(1, 3, float("nan")))
+    assert [x for x in w if "divergence" in str(x.message)] == []
+
+
+def test_grad_divergence_aligns_on_resumable_epoch_step():
+    """Rows align on the trainer's (epoch, step-in-epoch) position:
+    a respawned worker whose dispatch counter restarted still compares
+    at the right step, and the SAME step-in-epoch in different epochs
+    never cross-compares (a restarted rank in epoch 0 vs a survivor in
+    epoch 1 is interval skew, not a desync)."""
+    agg = fleet.FleetAggregator(grad_divergence_factor=10.0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        # same step number, DIFFERENT epochs: never compared
+        agg.ingest_metrics(_model_payload(0, 5, 1.0, epoch=1))
+        agg.ingest_metrics(_model_payload(1, 5, 1e6, epoch=0))
+    assert [x for x in w if "divergence" in str(x.message)] == []
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        # survivor and respawned rank meet at the same (epoch, step)
+        agg.ingest_metrics(_model_payload(1, 7, 99.0, epoch=1))
+        agg.ingest_metrics(_model_payload(0, 7, 1.0, epoch=1))
+    div = [x for x in w if "grad divergence" in str(x.message)]
+    assert len(div) == 1
+    assert "epoch 1 step 7" in str(div[0].message)
+
+
+def test_model_route_serves_local_and_worker_rows():
+    """/model: the local snapshot (None when sampling never ran) plus
+    every rank's latest compact stats row; per-rank grad norms also
+    land on /metrics via the gauge-with-worker-label merge."""
+    from paddle_tpu.observability import tensorstats as obs_tensorstats
+    agg = fleet.FleetAggregator(grad_divergence_factor=0.0)
+    agg.ingest_metrics(_model_payload(0, 7, 2.5))
+    p1 = _model_payload(1, 7, 2.6)
+    p1["metrics"] = _doc(gauges={"model_grad_norm":
+                                 [({"var": "__all__"}, 2.6)]})
+    agg.ingest_metrics(p1)
+    srv = obs_server.start_http_server(port=0, aggregator=agg)
+    try:
+        doc = json.load(urllib.request.urlopen(srv.url + "/model"))
+        assert doc["schema"] == "paddle_tpu.model.v1"
+        assert doc["enabled"] == obs_tensorstats.enabled()
+        assert doc["local"] is None        # no local sample this test
+        assert doc["workers"]["0"]["step"] == 7
+        assert doc["workers"]["1"]["grad_norm"] == 2.6
+        # the fleet /metrics view carries rank 1's grad-norm gauge
+        # under a worker label
+        text = urllib.request.urlopen(srv.url + "/metrics").read()
+        assert b'model_grad_norm{var="__all__",worker="1"} 2.6' in text
+    finally:
+        obs_server.stop_http_server()
+
+
+def test_snapshot_payload_carries_model_row():
+    """FleetReporter's metric payload ships the tensorstats row once a
+    sample exists (None before)."""
+    from paddle_tpu.observability import tensorstats as obs_tensorstats
+    assert fleet.snapshot_payload(0)["model"] is None
+    import paddle_tpu.optimizer  # noqa: F401
+    pt.reset_default_programs()
+    x = layers.data("x", [4], dtype="float32")
+    loss = layers.mean(layers.fc(x, size=4))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    flags.set_flag("tensor_stats", True)
+    flags.set_flag("tensor_stats_interval", 1)
+    try:
+        exe.run(pt.default_main_program(),
+                feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[loss])
+    finally:
+        flags.set_flag("tensor_stats", False)
+        flags.set_flag("tensor_stats_interval", 10)
+    row = fleet.snapshot_payload(0)["model"]
+    assert row is not None and row["grad_norm"] > 0
+    assert row["nan_vars"] == 0

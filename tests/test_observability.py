@@ -865,7 +865,9 @@ def test_bench_metrics_feed_the_gate_end_to_end(tmp_path):
     """Full pipeline: bench.py -> bench_metrics.json -> bench_gate
     self-compare (rc 0).  Slow: runs the real benchmarks on CPU."""
     mpath = str(tmp_path / "bench_metrics.json")
+    rpath = str(tmp_path / "bench_runlog.jsonl")
     env = dict(os.environ, PTPU_BENCH_METRICS_PATH=mpath,
+               PTPU_BENCH_RUNLOG_PATH=rpath,
                JAX_PLATFORMS="cpu")
     proc = subprocess.run([sys.executable, "bench.py"], env=env,
                           cwd=os.path.dirname(os.path.dirname(
@@ -880,6 +882,14 @@ def test_bench_metrics_feed_the_gate_end_to_end(tmp_path):
     assert "bench_flops_per_step" in doc["metrics"]
     assert bench_gate.main(["--baseline", mpath,
                             "--candidate", mpath]) == 0
+    # the bench runlog carries one record per completed row, and
+    # round-trips through the CLI parser
+    recs = obs_runlog.read_records(rpath)
+    bench_rows = [r for r in recs if r["kind"] == "bench"]
+    assert len(bench_rows) == len(vals)
+    assert {r["metric"] for r in bench_rows} == set(vals)
+    assert recs[0]["event"] == "bench_start"
+    assert recs[-1]["event"] == "bench_end"
 
 
 def test_parallel_executor_explain_covers_pjit_program():
@@ -903,3 +913,652 @@ def test_parallel_executor_explain_covers_pjit_program():
     assert rep["cost"]["flops"] > 0
     assert rep["cost"]["peak_hbm_bytes"] > 0
     assert pexe.cache_report()["cached_programs"] >= 1
+
+
+# =========================================================================
+# ISSUE 7: model-health telemetry — in-graph tensor statistics, first-bad-
+# layer NaN attribution, run-history log, bench trend gate.
+# =========================================================================
+
+from paddle_tpu.observability import runlog as obs_runlog
+from paddle_tpu.observability import tensorstats as obs_tensorstats
+
+
+def _ts_trainer(hidden=8):
+    def train_func():
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, size=hidden, act="relu")
+        pred = layers.fc(h, size=1, bias_attr=False)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    return pt.Trainer(train_func, lambda: pt.optimizer.SGD(0.05),
+                      place=pt.CPUPlace())
+
+
+def _ts_batches(n, bs=4):
+    rng = np.random.RandomState(0)
+    return [[(rng.randn(4).astype("float32"),
+              rng.randn(1).astype("float32")) for _ in range(bs)]
+            for _ in range(n)]
+
+
+# --- stats-off invariance (satellite) -------------------------------------
+
+def test_tensorstats_off_explain_and_outputs_invariant():
+    """With tensor_stats=False (default) the compile key, explain()
+    flags section and step outputs are byte-identical to the stats-less
+    executor — and flipping the flag ON does not perturb the step's
+    numeric outputs either (the stats fetch rides a separate reserved
+    name)."""
+    assert flags.get_flag("tensor_stats") is False
+    main, loss = _small_program()
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "int64")}
+    exe_off = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe_off.run(pt.default_startup_program())
+    rep = exe_off.explain(main, feed=feed, fetch_list=[loss])
+    # the stats-off report must not even mention the new flags — byte-
+    # identical to the pre-tensorstats explain() contract
+    assert set(rep["flags"]) == {"amp_bf16", "use_pallas_kernels",
+                                 "cost_model", "quantize_dtype",
+                                 "fuse_block"}
+    off1, = exe_off.run(main, feed=feed, fetch_list=[loss])
+    # same program under a stats-sampling executor: identical numerics
+    exe_on = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe_on.run(pt.default_startup_program())
+    flags.set_flag("tensor_stats", True)
+    flags.set_flag("tensor_stats_interval", 1)
+    try:
+        on1, = exe_on.run(main, feed=feed, fetch_list=[loss])
+        rep_on = exe_on.explain(main, feed=feed, fetch_list=[loss])
+        assert "tensor_stats" in rep_on["flags"]       # reported when ON
+        assert obs_tensorstats.sample_count() == 1
+    finally:
+        flags.set_flag("tensor_stats", False)
+        flags.set_flag("tensor_stats_interval", 10)
+    assert np.asarray(off1).tobytes() == np.asarray(on1).tobytes()
+
+
+def test_tensorstats_off_costs_zero_extra_compiles():
+    """Flag off: repeated runs hit the cache exactly as before (one
+    compile), and the OFF key is the same key a pre-tensorstats
+    executor would build — toggling the flag off->off never drifts."""
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "int64")}
+    c0, h0 = _compile_counters()
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    c1, h1 = _compile_counters()
+    assert c1 - c0 == 1
+    assert h1 - h0 == 2
+
+
+def test_tensorstats_mesh_executor_warns_once_not_silent():
+    """tensor_stats=True under a mesh executor cannot sample in-graph
+    (feeds/fetches are sharded; the stats fetch is not wired through
+    pjit) — the executor must say so loudly, exactly once, instead of
+    leaving the flag silently inert in the data-parallel deployment
+    the grad-divergence check was built for."""
+    from paddle_tpu.core.place import make_mesh
+    main, loss = _small_program()
+    feed = {"x": np.ones((8, 4), "float32"),
+            "y": np.zeros((8, 1), "int64")}
+    mesh = make_mesh((8,), ("data",))
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope(), mesh=mesh)
+    exe.run(pt.default_startup_program())
+    flags.set_flag("tensor_stats", True)
+    try:
+        with pytest.warns(RuntimeWarning, match="single-device only"):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        assert obs_tensorstats.sample_count() == 0   # nothing sampled
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            exe.run(main, feed=feed, fetch_list=[loss])
+        assert not [w for w in caught
+                    if "tensor_stats" in str(w.message)]  # once only
+    finally:
+        flags.set_flag("tensor_stats", False)
+
+
+# --- sampling: exactly one extra executable, no storm (acceptance) --------
+
+def test_tensorstats_sampling_two_executables_no_storm():
+    """Acceptance: a 50-step run with tensor_stats on at interval 10
+    compiles exactly TWO step executables (stats + no-stats variants),
+    forensics diagnoses the pair as 'flags' drift, no recompile storm
+    warns, and 5 samples land in the model_* gauges."""
+    t = _ts_trainer()
+    flags.set_flag("tensor_stats", True)
+    flags.set_flag("tensor_stats_interval", 10)
+    c0, _ = _compile_counters()
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t.train(num_epochs=1, event_handler=lambda e: None,
+                    reader=lambda: iter(_ts_batches(50)),
+                    feed_order=["x", "y"])
+    finally:
+        flags.set_flag("tensor_stats", False)
+        flags.set_flag("tensor_stats_interval", 10)
+        t.stop()
+    c1, _ = _compile_counters()
+    assert c1 - c0 == 2, "stats + no-stats variants, nothing else"
+    storms = [x for x in w if "recompile storm" in str(x.message)]
+    assert storms == [], [str(x.message) for x in storms]
+    assert obs_tensorstats.sample_count() == 5      # steps 0,10,..,40
+    # the second compile of the train-step key diagnoses as flags drift
+    recs = t.exe.compile_log(t.train_program)
+    step_recs = [r for r in recs if r["causes"] != ["first_compile"]]
+    assert step_recs and step_recs[-1]["causes"] == ["flags"]
+    assert any("tensor_stats" in d for d in step_recs[-1]["details"])
+    # bounded per-var gauges: top-K + the __all__ aggregate row
+    g = obs_metrics.REGISTRY.get("model_grad_norm")
+    series = g.series()
+    assert ("__all__",) in series
+    topk = int(flags.get_flag("tensor_stats_topk"))
+    assert 2 <= len(series) <= topk + 1
+    assert series[("__all__",)].value > 0
+    assert obs_metrics.REGISTRY.get("model_nan_vars").labels(
+        var="__all__").value == 0
+
+
+def test_tensorstats_non_sampled_steps_within_10pct():
+    """Acceptance (overhead): at interval 10 the NON-sampled steps run
+    the ORIGINAL executable — their median step time stays within 10%
+    of the stats-off baseline.  Off/on dispatches are interleaved so
+    machine drift between two sequential measurement windows cannot
+    masquerade as overhead on these ~1 ms micro-steps."""
+    import time as _time
+    main, loss = _small_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "int64")}
+
+    def one_step():
+        t0 = _time.perf_counter()
+        exe.run(main, feed=feed, fetch_list=[loss])
+        return _time.perf_counter() - t0
+
+    flags.set_flag("tensor_stats_interval", 10)
+    try:
+        for _ in range(3):              # compile + warm the plain path
+            one_step()
+        flags.set_flag("tensor_stats", True)
+        one_step()                      # compile the stats variant
+        base, plain, sampled = [], [], []
+        n_on = 1                        # stats-path dispatches so far
+        for i in range(100):
+            on = i % 2 == 1
+            flags.set_flag("tensor_stats", on)
+            dt = one_step()
+            if not on:
+                base.append(dt)
+            elif n_on % 10 == 0:
+                sampled.append(dt)
+                n_on += 1
+            else:
+                plain.append(dt)
+                n_on += 1
+    finally:
+        flags.set_flag("tensor_stats", False)
+        flags.set_flag("tensor_stats_interval", 10)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    assert len(base) == 50 and len(sampled) == 5 and len(plain) == 45
+    assert med(plain) <= 1.10 * med(base), (med(plain), med(base))
+
+
+# --- first-bad-layer attribution (acceptance e2e) -------------------------
+
+@pytest.mark.chaos
+def test_first_bad_layer_attribution_e2e():
+    """Acceptance: a chaos-injected NaN in a named MID-network variable
+    trips the guard, and the guard's raise line,
+    trainer_bad_steps_total{first_var=...} and the flight bundle all
+    name that variable — first in final-write order, not just any NaN
+    var (everything downstream of it is NaN too)."""
+    t = _ts_trainer()
+    ops = t.train_program.global_block().ops
+    fc_tmps = [n for op in ops for ns in op.outputs.values()
+               for n in ns if n.startswith("fc") and ".tmp_" in n]
+    target = fc_tmps[2]          # the SECOND fc layer's matmul output
+    flags.set_flag("tensor_stats", True)
+    flags.set_flag("tensor_stats_interval", 1)
+    flags.set_flag("chaos_spec", f"executor.var.{target}=nan:1.0")
+    bad = obs_metrics.REGISTRY.get("trainer_bad_steps_total")
+    b0 = bad.total()
+    try:
+        with pytest.raises(rguard.BadStepError) as ei:
+            t.train(num_epochs=1, event_handler=lambda e: None,
+                    reader=lambda: iter(_ts_batches(3)),
+                    feed_order=["x", "y"])
+    finally:
+        flags.set_flag("tensor_stats", False)
+        flags.set_flag("tensor_stats_interval", 10)
+        flags.set_flag("chaos_spec", "")
+        t.stop()
+    # 1. the raise log line names the poisoned variable
+    assert target in str(ei.value)
+    # 2. the metric carries the bounded first_var label
+    assert bad.labels(reason="nan", first_var=target).value >= 1
+    assert bad.total() - b0 >= 1
+    # 3. the flight bundle embeds the full stats snapshot, first_bad
+    #    naming the same variable
+    doc = flight.last_bundle()
+    assert doc["reason"] == "numeric_guard"
+    assert doc["tensor_stats"]["first_bad"] == target
+    assert doc["extra"]["attribution"].startswith(
+        f"first bad var {target!r}")
+    json.dumps(doc, allow_nan=False)     # bundle stays strict JSON
+    # the poison propagated: MORE than one var went NaN, and the
+    # earliest producer won the attribution (not e.g. the loss)
+    names = doc["tensor_stats"]["names"]
+    stats = doc["tensor_stats"]["stats"]
+    nan_col = doc["tensor_stats"]["columns"].index("nan_count")
+    bad_vars = [n for n, row in zip(names, stats)
+                if float(row[nan_col]) > 0]
+    assert len(bad_vars) > 1 and bad_vars[0] == target
+    assert obs_metrics.REGISTRY.get("model_nan_vars").labels(
+        var="__all__").value == len(bad_vars)
+
+
+def test_guard_attribution_fallback_when_stats_off():
+    """Satellite: with tensor_stats sampling off the guard still
+    answers — first_var='unattributed' on the metric and the log line
+    says what to enable."""
+    assert flags.get_flag("tensor_stats") is False
+    t = _ts_trainer()
+    flags.set_flag("chaos_spec", "trainer.step=nan:1.0")
+    flags.set_flag("chaos_seed", 0)
+    bad = obs_metrics.REGISTRY.get("trainer_bad_steps_total")
+    v0 = bad.labels(reason="nan", first_var="unattributed").value
+    try:
+        with pytest.raises(rguard.BadStepError) as ei:
+            t.train(num_epochs=1, event_handler=lambda e: None,
+                    reader=lambda: iter(_ts_batches(2)),
+                    feed_order=["x", "y"])
+    finally:
+        flags.set_flag("chaos_spec", "")
+        t.stop()
+    assert "unattributed(enable tensor_stats)" in str(ei.value)
+    assert bad.labels(reason="nan",
+                      first_var="unattributed").value == v0 + 1
+
+
+def test_guard_spike_not_attributed_to_stale_nan_sample():
+    """A finite loss spike must not be pinned on the first-bad var of
+    an EARLIER sample's NaN: attribution is for NaN verdicts only —
+    a stale sample from a recovered bad step would name an unrelated
+    layer on the spike's metric row and log line."""
+    flags.set_flag("tensor_stats", True)
+    try:
+        # plant a stale poisoned snapshot, as if step 40 sampled a NaN
+        stats = np.zeros((1, len(obs_tensorstats.COLUMNS)), "float64")
+        stats[0, obs_tensorstats.COLUMNS.index("nan_count")] = 3
+        obs_tensorstats._state["snapshot"] = {
+            "step": 40, "names": ["fc_1.tmp_0"], "stats": stats,
+            "first_bad": "fc_1.tmp_0", "time_unix": 0.0}
+        g = rguard.NumericGuard(policy="skip_step", spike_factor=3.0,
+                                warmup_steps=2)
+        for _ in range(4):
+            assert g.observe(1.0) == "ok"
+        assert g.observe(100.0) == "spike"           # finite spike
+        assert g.last_attribution.startswith("unattributed")
+        assert "no NaN to attribute" in g.last_attribution
+        bad = obs_metrics.REGISTRY.get("trainer_bad_steps_total")
+        assert bad.labels(reason="spike",
+                          first_var="unattributed").value >= 1
+        # a real NaN verdict still uses the sample
+        assert g.observe(float("nan")) == "nan"
+        assert "fc_1.tmp_0" in g.last_attribution
+    finally:
+        flags.set_flag("tensor_stats", False)
+
+
+def test_runlog_failed_rotate_warns_instead_of_interleaving_silently(
+        tmp_path, monkeypatch):
+    """When the rotate rename fails but append would succeed (read-only
+    directory, writable file), RunLog warns and counts the failure
+    instead of silently interleaving two runs in one JSONL."""
+    p = str(tmp_path / "run.jsonl")
+    with obs_runlog.RunLog(p) as rl:
+        rl.write(kind="step", step=0, loss=1.0)
+
+    def deny_replace(src, dst):
+        raise PermissionError(13, "Permission denied", src)
+
+    monkeypatch.setattr(obs_runlog.os, "replace", deny_replace)
+    fails = obs_metrics.REGISTRY.get("runlog_write_failures_total")
+    v0 = fails.value
+    with pytest.warns(RuntimeWarning, match="could not rotate"):
+        rl2 = obs_runlog.RunLog(p)
+    rl2.close()
+    assert fails.value == v0 + 1
+    # a simply-missing previous run stays silent
+    monkeypatch.undo()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        rl3 = obs_runlog.RunLog(str(tmp_path / "fresh.jsonl"))
+    rl3.close()
+
+
+# --- run-history log (tentpole part 2) ------------------------------------
+
+def test_runlog_rotate_write_and_roundtrip(tmp_path):
+    """Writer semantics: atomic rotate of a previous run to <path>.1,
+    strict-JSON lines (NaN stringified), schema round-trip through the
+    CLI parser."""
+    p = str(tmp_path / "run.jsonl")
+    with obs_runlog.RunLog(p, meta={"run": 1}) as rl:
+        rl.write(kind="step", step=0, loss=1.5)
+        rl.write(kind="step", step=1, loss=float("nan"))
+    with obs_runlog.RunLog(p, meta={"run": 2}) as rl:
+        rl.write(kind="step", step=0, loss=1.4)
+    assert os.path.exists(p + ".1"), "previous run rotated aside"
+    old = obs_runlog.read_records(p + ".1")
+    assert [r["kind"] for r in old] == ["meta", "step", "step"]
+    assert old[2]["loss"] == "nan"       # stringified, strict JSON
+    assert obs_runlog._value(old[2], "loss") != obs_runlog._value(
+        old[1], "loss")                   # parses back as float('nan')
+    new = obs_runlog.read_records(p)
+    assert all(r["schema"] == "paddle_tpu.runlog.v1" for r in new)
+    assert new[0]["run"] == 2
+    # a non-runlog file is a loud schema error, not garbage records
+    q = str(tmp_path / "not_runlog.jsonl")
+    with open(q, "w") as f:
+        f.write('{"foo": 1}\n')
+    with pytest.raises(ValueError, match="schema"):
+        obs_runlog.read_records(q)
+
+
+def test_runlog_numpy_int_step_survives_alignment(tmp_path):
+    """A numpy-integer step (np.int64 from a trainer counter) must
+    serialize as a JSON int: a float-coerced step (3.0) fails the CLI's
+    strict-int step alignment and the record silently vanishes from
+    --compare/--plot."""
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    with obs_runlog.RunLog(a) as rl:
+        for i in range(3):
+            rl.write(kind="step", step=np.int64(i), loss=1.0 / (i + 1))
+    with obs_runlog.RunLog(b) as rl:
+        for i in range(3):
+            rl.write(kind="step", step=i, loss=1.0 / (i + 1))
+    steps = [r["step"] for r in obs_runlog.step_records(
+        obs_runlog.read_records(a))]
+    assert steps == [0, 1, 2]
+    assert all(type(s) is int for s in steps)
+    doc = obs_runlog.compare(obs_runlog.read_records(a),
+                             obs_runlog.read_records(b))
+    assert doc["steps_compared"] == 3 and doc["diverged"] is False
+    # non-integral numpy scalars still take the float path
+    assert obs_runlog._strict(np.float32(1.5)) == 1.5
+
+
+def _write_run(path, n, spike_at=None, spike=50.0):
+    with obs_runlog.RunLog(path) as rl:
+        for i in range(n):
+            loss = spike if i == spike_at else 1.0 / (i + 1)
+            rl.write(kind="step", step=i, global_step=i, loss=loss,
+                     lr=0.1)
+
+
+def test_runlog_compare_cli_finds_first_divergence(tmp_path, capsys):
+    """Acceptance: --compare on two 20-step runs (one with an injected
+    loss spike) exits nonzero and prints the first diverging step; the
+    identical pair exits 0; bad input exits 2."""
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _write_run(a, 20)
+    _write_run(b, 20, spike_at=12)
+    rc = obs_runlog._main(["--compare", a, b, "--metric", "loss",
+                           "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "DIVERGED at step 12" in out
+    doc = json.loads(out.splitlines()[0])
+    assert doc["schema"] == "paddle_tpu.runlog_compare.v1"
+    assert doc["first_divergence"]["step"] == 12
+    assert doc["steps_compared"] == 20
+    # same trajectory within tolerance -> 0
+    assert obs_runlog._main(["--compare", a, a]) == 0
+    # a missing file is bad input (rc 2), not a traceback
+    assert obs_runlog._main(
+        ["--compare", a, str(tmp_path / "nope.jsonl")]) == 2
+    # one side NaN at an aligned step is a divergence even at huge
+    # tolerance
+    c = str(tmp_path / "c.jsonl")
+    with obs_runlog.RunLog(c) as rl:
+        for i in range(20):
+            rl.write(kind="step", step=i,
+                     loss=float("nan") if i == 7 else 1.0 / (i + 1))
+    assert obs_runlog._main(["--compare", a, c,
+                             "--tolerance", "1e9"]) == 1
+
+
+def test_runlog_tail_and_ascii_trend(tmp_path, capsys):
+    p = str(tmp_path / "t.jsonl")
+    _write_run(p, 30, spike_at=25)
+    assert obs_runlog._main([p, "--tail", "3"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("\n") == 3 and "step=29" in out
+    assert obs_runlog._main([p, "--plot", "loss"]) == 0
+    plot = capsys.readouterr().out
+    assert "step 0 .. 29" in plot and "(loss" in plot
+    assert "*" in plot
+    lines = [ln for ln in plot.splitlines() if "|" in ln]
+    assert len(lines) == 10              # default height
+    # a metric with no samples renders a message, not a crash
+    txt = obs_runlog.render_trend(obs_runlog.read_records(p), "zz")
+    assert "no finite" in txt
+
+
+def test_runlog_trainer_writes_step_history(tmp_path):
+    """The Trainer's runlog: meta open/close, one step record per step
+    carrying loss/lr/throughput, tensorstats rows only on sampled
+    steps, guard trips as their own records."""
+    p = str(tmp_path / "train.jsonl")
+    flags.set_flag("runlog_path", p)
+    flags.set_flag("tensor_stats", True)
+    flags.set_flag("tensor_stats_interval", 3)
+    try:
+        t = _ts_trainer()
+        t.train(num_epochs=1, event_handler=lambda e: None,
+                reader=lambda: iter(_ts_batches(6)),
+                feed_order=["x", "y"])
+        t.stop()
+    finally:
+        flags.set_flag("runlog_path", "")
+        flags.set_flag("tensor_stats", False)
+        flags.set_flag("tensor_stats_interval", 10)
+    recs = obs_runlog.read_records(p)
+    steps = obs_runlog.step_records(recs)
+    assert len(steps) == 6
+    assert recs[0]["kind"] == "meta" and recs[0]["event"] == "train_start"
+    assert recs[-1]["kind"] == "meta" and recs[-1]["event"] == "train_end"
+    for i, r in enumerate(steps):
+        assert r["global_step"] == i
+        assert r["loss"] > 0 and r["lr"] == 0.05
+        assert r["examples_per_sec"] > 0
+    with_stats = [r for r in steps if "stats" in r]
+    assert [r["global_step"] for r in with_stats] == [0, 3]
+    assert with_stats[0]["stats"]["grad_norm"] > 0
+    # guard trip -> a guard record with the attribution, before the raise
+    p2 = str(tmp_path / "guarded.jsonl")
+    flags.set_flag("runlog_path", p2)
+    flags.set_flag("chaos_spec", "trainer.step=nan:1.0")
+    flags.set_flag("chaos_seed", 0)
+    try:
+        t2 = _ts_trainer()
+        with pytest.raises(rguard.BadStepError):
+            t2.train(num_epochs=1, event_handler=lambda e: None,
+                     reader=lambda: iter(_ts_batches(2)),
+                     feed_order=["x", "y"])
+        t2.stop()
+    finally:
+        flags.set_flag("runlog_path", "")
+        flags.set_flag("chaos_spec", "")
+    recs2 = obs_runlog.read_records(p2)
+    guard_recs = [r for r in recs2 if r["kind"] == "guard"]
+    assert len(guard_recs) == 1
+    assert guard_recs[0]["verdict"] == "nan"
+    assert guard_recs[0]["loss"] == "nan"
+    assert "unattributed" in guard_recs[0]["attribution"]
+    assert recs2[-1]["event"] == "train_end"   # closed even on raise
+
+
+# --- bench trend gate (satellite) -----------------------------------------
+
+def _trend_files(tmp_path, newest_tokps, newest_mfu=0.5):
+    paths = []
+    rows = [("BENCH_r01.json", 100.0, 0.2, 50.0),
+            ("BENCH_r02.json", 300.0, 0.4, 20.0),
+            ("BENCH_r03.json", newest_tokps, newest_mfu, 18.0)]
+    for name, tokps, mfu, ms in rows:
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump({"parsed": {"summary": {
+                "lm_tokens_per_sec": {"value": tokps, "mfu": mfu},
+                "conv_ms_per_batch": {"value": ms}}}}, f)
+        paths.append(p)
+    return paths
+
+
+def test_bench_gate_trend_mode_cli(tmp_path, capsys):
+    """Satellite (tier-1 CLI smoke): --trend prints the cross-release
+    trajectory and exits 1 when the newest record regresses best-ever
+    by > tolerance — per metric AND per MFU series."""
+    # improving run: ok
+    paths = _trend_files(tmp_path, newest_tokps=400.0, newest_mfu=0.5)
+    assert bench_gate.main(["--trend", *paths]) == 0
+    out = capsys.readouterr().out
+    assert "100 -> 300 -> 400" in out
+    assert "lm_tokens_per_sec.mfu" in out
+    verdict = json.loads(out.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["newest"] == "BENCH_r03"
+    # newest regresses best-ever tokens/s by 50% -> rc 1
+    paths = _trend_files(tmp_path, newest_tokps=150.0, newest_mfu=0.5)
+    assert bench_gate.main(["--trend", *paths, "--tolerance",
+                            "0.15"]) == 1
+    out = capsys.readouterr().out
+    assert "[FAIL] lm_tokens_per_sec:" in out
+    assert json.loads(out.strip().splitlines()[-1])["regressions"] == \
+        ["lm_tokens_per_sec"]
+    # an MFU-only regression also fails (throughput flat, efficiency
+    # collapsed = something is burning flops)
+    paths = _trend_files(tmp_path, newest_tokps=310.0, newest_mfu=0.1)
+    assert bench_gate.main(["--trend", *paths]) == 1
+    out = capsys.readouterr().out
+    assert "[FAIL] lm_tokens_per_sec.mfu" in out
+    # < 2 records is bad input (rc 2), as is an unreadable file
+    assert bench_gate.main(["--trend", paths[0]]) == 2
+    assert bench_gate.main(
+        ["--trend", paths[0], str(tmp_path / "nope.json")]) == 2
+    # the real committed records must load and pass self-consistency
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    real = sorted(
+        os.path.join(repo, n) for n in os.listdir(repo)
+        if n.startswith("BENCH_r") and n.endswith(".json"))
+    if len(real) >= 2:
+        capsys.readouterr()
+        assert bench_gate.main(["--trend", *real]) in (0, 1)
+
+
+def test_bench_gate_trend_lower_is_better_direction(tmp_path):
+    def write(dirname, r1_ms, r2_ms):
+        d = tmp_path / dirname
+        d.mkdir()
+        paths = []
+        for name, ms in (("r1.json", r1_ms), ("r2.json", r2_ms)):
+            p = str(d / name)
+            with open(p, "w") as f:
+                json.dump({"parsed": {"summary": {
+                    "m_ms_per_batch": {"value": ms}}}}, f)
+            paths.append(p)
+        return paths
+
+    # ms/batch GREW in the newest release (r2) -> regression; input
+    # order is irrelevant, --trend sorts by filename = release order
+    grew = write("grew", 10.0, 20.0)
+    assert bench_gate.main(["--trend", *grew]) == 1
+    assert bench_gate.main(["--trend", *reversed(grew)]) == 1
+    # ms/batch SHRANK in the newest release -> ok
+    shrank = write("shrank", 20.0, 10.0)
+    assert bench_gate.main(["--trend", *shrank]) == 0
+
+
+def test_bench_gate_trend_natural_release_order(tmp_path, capsys):
+    """Release order is numeric, not lexicographic: BENCH_r10 is newer
+    than BENCH_r9, so a regression introduced in r10 must be judged
+    against r9's best — a bytewise sort would judge r9 as newest and
+    wave the regressed r10 through as 'history'."""
+    def write(name, v):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump({"parsed": {"summary": {
+                "m_tokens_per_sec": {"value": v}}}}, f)
+        return p
+    p9 = write("BENCH_r9.json", 100.0)
+    p10 = write("BENCH_r10.json", 40.0)      # newest regressed 60%
+    assert bench_gate.main(["--trend", p9, p10]) == 1
+    verdict = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["newest"] == "BENCH_r10"
+    assert verdict["regressions"] == ["m_tokens_per_sec"]
+
+
+def test_runlog_compare_aligns_bench_rows(tmp_path, capsys):
+    """Two bench runlogs (kind=bench, step = fixed workload index)
+    diff with the same CLI as training runs: --compare aligns on the
+    workload index and flags the regressed row."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, v1 in ((a, 200.0), (b, 90.0)):
+        la = obs_runlog.RunLog(path, meta={"event": "bench_start"})
+        la.write(kind="bench", step=0, metric="lm_tokens_per_sec",
+                 value=v1)
+        la.write(kind="bench", step=2, metric="lstm_ms_per_batch",
+                 value=4.0)                  # workload 1 errored out
+        la.close()
+    rc = obs_runlog._main(["--compare", a, b, "--metric", "value",
+                           "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = json.loads(out.strip().splitlines()[0])
+    assert doc["first_divergence"]["step"] == 0
+    assert doc["steps_compared"] == 2        # aligned despite the gap
+
+
+def test_bench_gate_trend_missing_metric_and_null_parse(tmp_path,
+                                                        capsys):
+    """A metric that drops out of the newest record (its workload
+    errored out of the bench run) fails the trend gate as `missing`
+    unless --allow-missing; a release whose driver parse failed
+    (parsed: null) contributes NO metrics — its wrapper bookkeeping
+    fields (n, rc) must not surface as bogus series."""
+    def write(name, doc):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return p
+
+    p1 = write("r1.json", {"parsed": {"summary": {
+        "keep_tokens_per_sec": {"value": 100.0},
+        "gone_tokens_per_sec": {"value": 50.0}}}})
+    p2 = write("r2.json", {"n": 3, "rc": 0, "tail": "x",
+                           "parsed": None})      # failed driver parse
+    p3 = write("r3.json", {"parsed": {"summary": {
+        "keep_tokens_per_sec": {"value": 110.0}}}})
+    assert bench_gate.main(["--trend", p1, p2, p3]) == 1
+    out = capsys.readouterr().out
+    assert "[miss] gone_tokens_per_sec" in out
+    assert "rc" not in json.loads(out.strip().splitlines()[-1])["missing"]
+    verdict = json.loads(out.strip().splitlines()[-1])
+    assert verdict["missing"] == ["gone_tokens_per_sec"]
+    assert verdict["regressions"] == []
+    # --allow-missing downgrades the drop to informational
+    assert bench_gate.main(["--trend", p1, p2, p3,
+                            "--allow-missing"]) == 0
