@@ -70,6 +70,13 @@ _BASIS = {
     "transformer_lm_8k_train_tokens_per_sec_per_chip":
         "no reference anchor (the 2018 reference cannot train T=8192 "
         "at all; vs_baseline is vs the same assumed 50k tok/s bar)",
+    "transformer_lm_serving_tokens_per_sec":
+        "no reference anchor (the C-API AnalysisPredictor tier "
+        "publishes no TPU serving number and had no incremental "
+        "decode at all); generated tokens/s from the KV-cache "
+        "continuous batcher under loadgen at fixed concurrency, "
+        "vs_baseline vs the same assumed 50k tok/s bar purely as a "
+        "longitudinal ratio — p99 per-token latency rides as p99_ms",
     "resnet50_train_imgs_per_sec_per_chip":
         "reference's published ResNet-50 train bs64: 81.69 img/s, "
         "2x Xeon 6148 MKL-DNN (benchmark/IntelOptimizedPaddle.md:45)",
@@ -469,6 +476,63 @@ def bench_lstm(on_tpu):
     return _attach_cost(row, exe, prog, feed, loss, dt)
 
 
+def bench_lm_serving(on_tpu):
+    """Serving row (ISSUE 8): the KV-cache continuous batcher
+    (paddle_tpu/serving) under a closed-loop loadgen at FIXED
+    concurrency — generated tokens/s plus p99 per-token latency, so
+    serving throughput joins the regression-gated --trend trajectory
+    next to the training rows."""
+    from paddle_tpu import models, serving
+    from paddle_tpu.serving import loadgen as serving_loadgen
+    pt, exe = _fresh(on_tpu)
+    if on_tpu:
+        V, L, D, F, H = 32000, 6, 512, 2048, 8
+        max_len, T, buckets = 512, 256, (64, 128, 256)
+        batch, new_tokens = 8, 32
+    else:               # smoke shapes (the same policy as _bench_lm_cfg)
+        V, L, D, F, H = 2000, 2, 64, 128, 2
+        max_len, T, buckets = 64, 32, (8, 16)
+        batch, new_tokens = 4, 8
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=V, tgt_vocab_size=V, max_length=max_len,
+        n_layer=L, n_head=H, d_model=D, d_inner=F, dropout=0.0)
+    models.transformer.build_lm_net(
+        cfg, seq_len=T, is_test=True, fused_attention=False,
+        fused_head=False)
+    exe.run(pt.default_startup_program())
+    params = serving.extract_lm_params(
+        pt.default_main_program(), exe.scope, cfg)
+    engine = serving.DecodeEngine(cfg, params, max_batch=batch,
+                                  max_len=max_len,
+                                  prompt_buckets=buckets)
+    engine.prepare()
+    batcher = serving.ContinuousBatcher(engine)
+    batcher.start()
+    try:
+        streams = 8
+        rep = serving_loadgen.run_loadgen(
+            serving_loadgen.inproc_submit(batcher), streams=streams,
+            requests_per_stream=4, max_new_tokens=new_tokens,
+            prompt_len_range=(4, buckets[-1] // 2), vocab_size=V,
+            p99_budget_ms=0.0)
+    finally:
+        batcher.stop()
+    if not rep["accounted"] or rep["counts"]["gave_up"]:
+        raise RuntimeError(f"serving loadgen lost requests: "
+                           f"{rep['counts']}")
+    toks = rep["tokens_per_sec"]
+    return {
+        "metric": "transformer_lm_serving_tokens_per_sec",
+        "value": round(toks, 1), "unit": "tokens/s",
+        "vs_baseline": round(toks / V100_TOKENS_PER_SEC, 3),
+        "config": (f"d{D} L{L} maxlen{max_len} slots{batch} "
+                   f"streams{streams} buckets{list(buckets)} "
+                   f"kv-cache continuous batcher"),
+        "p99_ms": rep["per_token_ms"]["p99"],
+        "ttft_p99_ms": rep["ttft_ms"]["p99"],
+    }
+
+
 def _record_row_metrics(row):
     """Publish one workload row through the observability registry, so
     BENCH_r*.json rows and a live process's /metrics share one schema
@@ -488,7 +552,13 @@ def _record_row_metrics(row):
                             ("flops_per_step",
                              "Cost-model FLOPs of one train step "
                              "(observability/costmodel.py)."),
-                            ("loss", "Final training loss of the row.")):
+                            ("loss", "Final training loss of the row."),
+                            ("p99_ms",
+                             "p99 per-token serving latency of the "
+                             "row's loadgen run (ms)."),
+                            ("ttft_p99_ms",
+                             "p99 time-to-first-token of the row's "
+                             "loadgen run (ms).")):
         if row.get(field) is not None:
             obs.gauge(f"bench_{field}", help_str, ("metric",)).labels(
                 metric=row["metric"]).set(row[field])
@@ -523,7 +593,8 @@ def main():
             bench_lm, bench_lm_int8, bench_lm_fused_block,
             bench_resnet50, bench_nmt, bench_resnet50_infer,
             bench_resnet50_infer_int8, bench_alexnet,
-            bench_googlenet, bench_lstm, bench_lm_8k)):
+            bench_googlenet, bench_lstm, bench_lm_8k,
+            bench_lm_serving)):
         try:
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide
@@ -541,7 +612,8 @@ def main():
                 rl.write(kind="bench", step=wl_index,
                          **{k: row[k] for k in
                             ("metric", "value", "unit", "vs_baseline",
-                             "mfu", "tflops", "flops_per_step", "loss")
+                             "mfu", "tflops", "flops_per_step", "loss",
+                             "p99_ms", "ttft_p99_ms")
                             if row.get(k) is not None})
         # re-print the cumulative result after EVERY workload (full
         # detail, for humans reading the whole log), then a COMPACT

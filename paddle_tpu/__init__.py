@@ -40,6 +40,7 @@ from . import contrib
 from . import debugger
 from . import observability
 from . import resilience
+from . import serving
 from . import imperative
 from . import inference
 from . import distributed

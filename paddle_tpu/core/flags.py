@@ -215,6 +215,32 @@ define_flag("retry_max_attempts", 3,
             "(task-queue RPC reconnects, transient checkpoint-save "
             "OSErrors).")
 
+# --- serving plane (serving/: kv_cache, batcher, loadgen) ------------------
+define_flag("serving_max_batch", 8,
+            "Decode-slot count of the serving plane "
+            "(serving/kv_cache.py DecodeEngine): the continuous "
+            "batcher advances this many sequences per compiled decode "
+            "step, retiring finished slots and backfilling from the "
+            "queue at step boundaries.")
+define_flag("serving_queue_limit", 64,
+            "Admission control: pending requests past this bound are "
+            "SHED with an explicit rejection (ShedError / HTTP 429) "
+            "instead of queueing unboundedly — the load-shedding half "
+            "of the serving SLO story.  0 sheds everything (drain "
+            "mode for tests).")
+define_flag("serving_prompt_buckets", "32,64,128",
+            "Comma list of prompt-length buckets the decode engine "
+            "AOT-compiles prefill executables for at prepare() time; "
+            "a prompt pads up to the smallest fitting bucket so the "
+            "request path never compiles.")
+define_flag("serving_max_new_tokens", 32,
+            "Default per-request generation cap when a request does "
+            "not name its own (serving/batcher.py).")
+define_flag("serving_p99_budget_ms", 0.0,
+            "Serving SLO bar: loadgen (serving/loadgen.py) fails its "
+            "run when p99 per-token latency exceeds this many "
+            "milliseconds.  0 = report only, no assertion.")
+
 # --- elastic fleet (distributed/: task_queue membership, supervisor) -------
 define_flag("worker_timeout", 6.0,
             "Master-side heartbeat lease: a registered worker silent "

@@ -111,15 +111,74 @@ class Predictor:
         return tuple(sorted((n, tuple(a.shape), str(a.dtype))
                             for n, a in feeds.items()))
 
+    def _check_feed_names(self, feeds: Dict[str, np.ndarray]):
+        """Both directions: a missing feed can't run, and an UNKNOWN
+        feed silently changes ``_sig`` — every request with a stray
+        key would compile a fresh executable (a request-path recompile
+        storm wearing an innocent face)."""
+        missing = set(self.feed_names) - set(feeds)
+        check_arg(not missing, f"missing feeds: {sorted(missing)}")
+        unknown = set(feeds) - set(self.feed_names)
+        check_arg(
+            not unknown,
+            f"unknown feed names {sorted(unknown)}: this predictor "
+            f"feeds {sorted(self.feed_names)} — extra names would "
+            f"change the compile signature and force a fresh "
+            f"executable per request")
+
     def prepare(self, example_feeds: Dict[str, np.ndarray]):
         """AOT-compile for this input signature (lowered+compiled now, so
         the request path never traces)."""
         feeds = {n: np.asarray(v) for n, v in example_feeds.items()}
+        self._check_feed_names(feeds)
         sig = self._sig(feeds)
         if sig not in self._compiled:
             lowered = jax.jit(self._fn()).lower(self.state, feeds)
             self._compiled[sig] = lowered.compile()
         return self._compiled[sig]
+
+    def prepare_buckets(self, example_feeds: Dict[str, np.ndarray],
+                        batch_sizes: Sequence[int],
+                        seq_lens: Optional[Sequence[int]] = None) -> dict:
+        """AOT-compile the full serving bucket grid up front: every
+        (batch, seq) combination of `batch_sizes` x `seq_lens` gets an
+        executable NOW, so serving startup cost is this one call
+        instead of N hand-written prepare()s — and the request path
+        never compiles.
+
+        `example_feeds` supplies dtypes and trailing feature shapes;
+        axis 0 is resized to each batch size and (for feeds with >= 2
+        dims) axis 1 to each sequence length.  Logs the total compile
+        time; returns {"(batch, seq)": compile_seconds} + totals."""
+        import time as _time
+        feeds0 = {n: np.asarray(v) for n, v in example_feeds.items()}
+        self._check_feed_names(feeds0)
+        report: Dict[str, float] = {}
+        t0 = _time.perf_counter()
+        n_before = len(self._compiled)
+        for bs in batch_sizes:
+            for sl in (seq_lens if seq_lens else [None]):
+                feeds = {}
+                for n, a in feeds0.items():
+                    shape = list(a.shape)
+                    if shape:
+                        shape[0] = int(bs)
+                    if sl is not None and a.ndim >= 2:
+                        shape[1] = int(sl)
+                    feeds[n] = np.zeros(shape, a.dtype)
+                tb = _time.perf_counter()
+                self.prepare(feeds)
+                report[f"({bs}, {sl})"] = round(
+                    _time.perf_counter() - tb, 3)
+        total = _time.perf_counter() - t0
+        compiled = len(self._compiled) - n_before
+        report["total_seconds"] = round(total, 3)
+        report["executables"] = compiled
+        print(f"[predictor] prepared bucket grid: {compiled} "
+              f"executable(s) over batch={list(batch_sizes)} x "
+              f"seq={list(seq_lens) if seq_lens else ['-']} "
+              f"in {total:.2f}s")
+        return report
 
     # -- run ----------------------------------------------------------------
     def run(self, feeds: Dict[str, np.ndarray],
@@ -130,8 +189,7 @@ class Predictor:
         # inference itself
         feeds = {n: v if isinstance(v, jax.Array) else np.asarray(v)
                  for n, v in feeds.items()}
-        missing = set(self.feed_names) - set(feeds)
-        check_arg(not missing, f"missing feeds: {sorted(missing)}")
+        self._check_feed_names(feeds)
         compiled = self._compiled.get(self._sig(feeds))
         if compiled is None:
             compiled = self.prepare(feeds)

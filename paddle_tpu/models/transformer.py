@@ -341,6 +341,74 @@ def build_lm_net(cfg: TransformerConfig, seq_len: int, is_test: bool = False,
     return [tokens, labels], avg_cost, logits
 
 
+def lm_program_spec(program):
+    """Parameter-name structure of an UNFUSED ``build_lm_net`` program.
+
+    Walks the op list of a program built with ``fused_attention=False,
+    fused_head=False`` and maps each trained parameter to its role in
+    the decoder stack — the binding the serving decode engine
+    (serving/kv_cache.py) uses to run the SAME weights through an
+    incremental KV-cache step without re-tracing the program.  The op
+    topology per layer is fixed by :func:`encoder_layer`:
+
+      layer_norm -> mul(qkv) -> ... -> mul(out_proj) -> residual ->
+      layer_norm -> mul(ffn1)+bias -> relu -> mul(ffn2)+bias -> residual
+
+    followed by one final layer_norm and the mul LM head.  Returns::
+
+        {"emb": name, "layers": [{"ln1": (scale, bias), "w_qkv": name,
+          "w_o": name, "ln2": (scale, bias), "w_fc1": name,
+          "b_fc1": name, "w_fc2": name, "b_fc2": name}, ...],
+         "ln_f": (scale, bias), "w_head": name, "n_layer": L}
+
+    Raises ValueError when the program does not look like that build
+    (e.g. the fused_mha path, whose projections live inside one op).
+    """
+    from ..framework.program import Parameter
+    block = program.global_block()
+
+    def _is_param(name: str) -> bool:
+        try:
+            return isinstance(block.var(name), Parameter)
+        except KeyError:
+            return False
+
+    emb = None
+    muls, lns, biases = [], [], []
+    for op in block.ops:
+        if op.type == "lookup_table" and emb is None:
+            emb = op.inputs["W"][0]
+        elif op.type == "layer_norm":
+            lns.append((op.inputs["Scale"][0], op.inputs["Bias"][0]))
+        elif op.type == "mul" and _is_param(op.inputs["Y"][0]):
+            muls.append(op.inputs["Y"][0])
+        elif op.type == "elementwise_add" and _is_param(op.inputs["Y"][0]):
+            biases.append(op.inputs["Y"][0])
+    if emb is None or not muls or (len(muls) - 1) % 4:
+        raise ValueError(
+            "lm_program_spec: program is not an unfused build_lm_net "
+            f"graph (found {len(muls)} fc weights, embedding="
+            f"{emb!r}); build with fused_attention=False, "
+            "fused_head=False")
+    n_layer = (len(muls) - 1) // 4
+    if len(lns) != 2 * n_layer + 1 or len(biases) != 2 * n_layer:
+        raise ValueError(
+            f"lm_program_spec: op census mismatch — {len(muls)} fc "
+            f"weights imply {n_layer} layers but found {len(lns)} "
+            f"layer_norms (want {2 * n_layer + 1}) and {len(biases)} "
+            f"fc biases (want {2 * n_layer})")
+    layers = []
+    for li in range(n_layer):
+        w_qkv, w_o, w_fc1, w_fc2 = muls[4 * li:4 * li + 4]
+        layers.append({
+            "ln1": lns[2 * li], "w_qkv": w_qkv, "w_o": w_o,
+            "ln2": lns[2 * li + 1],
+            "w_fc1": w_fc1, "b_fc1": biases[2 * li],
+            "w_fc2": w_fc2, "b_fc2": biases[2 * li + 1]})
+    return {"emb": emb, "layers": layers, "ln_f": lns[-1],
+            "w_head": muls[-1], "n_layer": n_layer}
+
+
 def make_fake_lm_batch(cfg: TransformerConfig, batch_size: int,
                        seq_len: int, seed: int = 0):
     rng = np.random.RandomState(seed)

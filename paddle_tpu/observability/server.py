@@ -20,6 +20,12 @@ first ``train()`` when the flag is set, or call
 * ``/model`` — model-health telemetry (tensorstats): this process's
   full last per-variable statistics snapshot, plus every rank's
   latest compact row when aggregating.
+* ``/serving`` — serving-plane status (paddle_tpu/serving): queue
+  depth, batch occupancy, p50/p99 TTFT and per-token latency,
+  request/shed counters, bucket grid.
+* ``POST /serving/generate`` — submit one generation request to the
+  attached batcher; 200 with tokens+timing, 429 when admission
+  control sheds, 503 when no batcher is attached or it is draining.
 """
 from __future__ import annotations
 
@@ -114,15 +120,40 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, obs.flight())
             elif path == "/model":
                 self._send_json(200, obs.model())
+            elif path == "/serving":
+                self._send_json(200, obs.serving())
             elif path == "/":
                 self._send(200, b"paddle_tpu observability: /metrics "
                                 b"/metrics.json /healthz /flight "
-                                b"/model\n",
+                                b"/model /serving "
+                                b"[POST /serving/generate]\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send_json(404, {"error": f"no route {path}"})
         except Exception as e:       # the endpoint must not take the
             try:                     # process down with it
+                self._send_json(500, {"error": repr(e)[:500]})
+            except OSError:
+                pass
+
+    def do_POST(self):
+        obs: "ObservabilityServer" = self.server.obs   # type: ignore
+        path = self.path.split("?", 1)[0].rstrip("/")
+        try:
+            if path != "/serving/generate":
+                self._send_json(404, {"error": f"no POST route {path}"})
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b"{}"
+            try:
+                body = json.loads(raw.decode() or "{}")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._send_json(400, {"error": f"bad JSON body: {e}"})
+                return
+            code, doc = obs.serving_generate(body)
+            self._send_json(code, doc)
+        except Exception as e:
+            try:
                 self._send_json(500, {"error": repr(e)[:500]})
             except OSError:
                 pass
@@ -236,6 +267,61 @@ class ObservabilityServer:
             doc["workers"] = {str(r): row for r, row in sorted(
                 self.aggregator.model_rows().items())}
         return doc
+
+    def serving(self) -> dict:
+        """Serving-plane status (paddle_tpu/serving.status_doc): queue
+        depth, occupancy, SLO quantiles, admission counters."""
+        from .. import serving as serving_mod
+        return serving_mod.status_doc()
+
+    def serving_generate(self, body: dict):
+        """``POST /serving/generate`` body: submit to the attached
+        batcher and block for the result.  Returns (http_code, doc)."""
+        from .. import serving as serving_mod
+        batcher = serving_mod.get()
+        if batcher is None or not batcher.running:
+            return 503, {"error": "no serving batcher attached"}
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            return 400, {"error": "body needs a non-empty 'prompt' "
+                                  "list of token ids"}
+        try:
+            # coerce ALL client-typed fields here so a malformed body
+            # is a 400, not a 500 from deep inside the batcher (and a
+            # string eos_id can't silently never match an int token)
+            tokens = [int(t) for t in prompt]
+            mnt = body.get("max_new_tokens")
+            mnt = None if mnt is None else int(mnt)
+            temperature = float(body.get("temperature") or 0.0)
+            eos = body.get("eos_id")
+            eos = None if eos is None else int(eos)
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"malformed request field: {e}"}
+        try:
+            req = batcher.submit(tokens, max_new_tokens=mnt,
+                                 temperature=temperature, eos_id=eos)
+        except serving_mod.ShedError as e:
+            if getattr(e, "draining", False):
+                # instance going away: 503 so clients fail over
+                # instead of retrying a draining replica (429 means
+                # "back off and retry HERE")
+                return 503, {"error": str(e), "status": "drained"}
+            return 429, {"error": str(e), "status": "shed",
+                         "queue_depth": e.queue_depth}
+        except RuntimeError as e:
+            # "batcher is not running" — an availability condition
+            # (it stopped between the check above and submit), not a
+            # client error: 503 so retrying clients classify it right
+            return 503, {"error": str(e), "status": "error"}
+        except ValueError as e:
+            return 400, {"error": str(e), "status": "error"}
+        try:
+            doc = req.result(timeout=float(body.get("timeout_s") or 60.0))
+        except TimeoutError as e:
+            return 504, {"error": str(e), "status": "timeout"}
+        if doc["status"] != "ok":
+            return 503 if doc["status"] == "drained" else 500, doc
+        return 200, doc
 
 
 def start_http_server(port: Optional[int] = None,

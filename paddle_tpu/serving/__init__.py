@@ -1,0 +1,126 @@
+"""Online serving plane (ISSUE 8): continuous batching, decode-loop KV
+cache, and SLO-metered admission control behind the fleet HTTP stack.
+
+The fourth major plane after observability, resilience and the elastic
+fleet — the production analog of the reference's C-API inference tier
+(``AnalysisPredictor``/``NaiveExecutor``, PAPER.md §1 L4):
+
+  * :mod:`.kv_cache`   — batched incremental decode over trained
+    ``build_lm_net`` weights: per-layer K/V buffers, bucketed AOT
+    prefill, one compiled decode step, per-slot retire/backfill.
+  * :mod:`.batcher`    — request queue + continuous batcher + bounded
+    admission (``ShedError`` = HTTP 429) + SIGTERM drain + SLO metrics.
+  * :mod:`.loadgen`    — closed-loop concurrent client streams with
+    p50/p99 TTFT / per-token reporting (the serving soak headline).
+  * :mod:`.worker`     — a supervised serving process (engine + batcher
+    + observability endpoint) the PR 5 supervisor can babysit under
+    chaos.
+
+One process-wide batcher may be ATTACHED here; the observability
+endpoint's ``/serving`` route and ``POST /serving/generate`` resolve
+through :func:`get`, and tests detach via :func:`reset` (conftest).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..observability import metrics as obs_metrics
+from .batcher import ContinuousBatcher, ServingRequest, ShedError
+from .kv_cache import DecodeEngine, extract_lm_params
+
+__all__ = ["DecodeEngine", "extract_lm_params", "ContinuousBatcher",
+           "ServingRequest", "ShedError", "attach", "get", "reset",
+           "status_doc", "histogram_quantiles"]
+
+_lock = threading.Lock()
+_batcher: Optional[ContinuousBatcher] = None
+
+
+def attach(batcher: ContinuousBatcher) -> ContinuousBatcher:
+    """Register the process-wide batcher the HTTP routes serve from."""
+    global _batcher
+    with _lock:
+        if _batcher is not None and _batcher is not batcher \
+                and _batcher.running:
+            raise RuntimeError(
+                "a serving batcher is already attached; reset() first")
+        _batcher = batcher
+    return batcher
+
+
+def get() -> Optional[ContinuousBatcher]:
+    return _batcher
+
+
+def reset():
+    """Test hook (conftest): stop the attached batcher (loop thread
+    JOINED), detach it from the HTTP routes."""
+    global _batcher
+    with _lock:
+        b, _batcher = _batcher, None
+    if b is not None:
+        b.stop()
+
+
+def histogram_quantiles(name: str, qs: List[float]) -> Optional[dict]:
+    """Bucket-interpolated quantiles of a registry histogram (the
+    p50/p99 the /serving route reports).  Returns None when the
+    histogram has no observations."""
+    m = obs_metrics.REGISTRY.get(name)
+    if m is None or m.buckets is None:
+        return None
+    s = m.series().get(())
+    if s is None or s.count == 0:
+        return None
+    out = {}
+    for q in qs:
+        target = q * s.count
+        cum = 0
+        val = None
+        for b, c in zip(m.buckets, s.bucket_counts):
+            cum += c
+            if cum >= target:
+                val = b
+                break
+        if val is None:              # landed in the overflow bucket
+            val = m.buckets[-1]
+        out[f"p{int(round(q * 100))}"] = val
+    out["count"] = s.count
+    out["mean"] = s.sum / s.count
+    return out
+
+
+def status_doc() -> dict:
+    """The ``/serving`` route body: batcher/engine state plus SLO
+    quantiles derived from the serving histograms."""
+    b = get()
+    doc = {
+        "schema": "paddle_tpu.serving.v1",
+        "time_unix": time.time(),
+        "attached": b is not None,
+    }
+    if b is not None:
+        doc.update(b.status_doc())
+
+    def _counter_value(name, **labels):
+        m = obs_metrics.REGISTRY.get(name)
+        if m is None:
+            return 0.0
+        if labels:
+            return m.labels(**labels).value
+        return m.total()
+
+    doc["tokens_generated"] = _counter_value(
+        "serving_tokens_generated_total")
+    doc["requests"] = {
+        status: _counter_value("serving_requests_total", status=status)
+        for status in ("ok", "shed", "drained", "error")}
+    doc["compiles"] = _counter_value("serving_compiles_total")
+    for key, hist in (("ttft_s", "serving_ttft_seconds"),
+                      ("per_token_s", "serving_token_seconds"),
+                      ("prefill_s", "serving_prefill_seconds"),
+                      ("decode_step_s", "serving_decode_step_seconds")):
+        doc[key] = histogram_quantiles(hist, [0.5, 0.99])
+    return doc

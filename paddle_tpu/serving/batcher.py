@@ -1,0 +1,450 @@
+"""Continuous batcher + admission control over the KV-cache decode engine.
+
+The serving plane's control loop (ISSUE 8 tentpole, part a/c): one
+thread owns the :class:`~.kv_cache.DecodeEngine` and runs
+
+    admit (fill free slots from the queue) -> decode_step -> retire
+
+forever.  New requests are admitted AT DECODE-STEP BOUNDARIES — a
+finished sequence's slot is backfilled while the other slots keep
+decoding, so short requests never wait for a full batch to drain
+(in-flight/continuous batching, the vLLM-style scheduling the
+reference's one-shot ``AnalysisPredictor`` tier never had).
+
+Admission control: the queue is bounded by ``serving_queue_limit``;
+past it :meth:`ContinuousBatcher.submit` raises :class:`ShedError`
+(HTTP 429 at the /serving/generate route) — an EXPLICIT rejection the
+client can retry, never an unbounded queue or a silent drop.
+
+SLO metering: every request carries its timing — TTFT (submit to first
+token, prefill inclusive) and per-token decode latency land in
+``serving_ttft_seconds`` / ``serving_token_seconds`` histograms;
+queue depth / batch occupancy / tokens generated ride as gauges and
+counters.  All of it is on the /metrics exposition (local and
+fleet-merged) plus the /serving status route.
+
+Resilience: the PR 2 preemption idiom applies — SIGTERM begins a DRAIN
+honored at the decode-step boundary (stop admitting, shed the queue
+explicitly, finish in-flight sequences, then stop); chaos sites
+``serving.admit`` and ``serving.decode_step`` let the soak kill or
+fault the loop deterministically (docs/RESILIENCE.md).
+"""
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..core import flags
+from ..observability import flight as obs_flight
+from ..observability import metrics as obs_metrics
+from ..resilience import chaos
+from .kv_cache import DecodeEngine
+
+_m_queue_depth = obs_metrics.gauge(
+    "serving_queue_depth",
+    "Requests admitted but not yet prefilled into a decode slot.")
+_m_occupancy = obs_metrics.gauge(
+    "serving_batch_occupancy",
+    "Active decode slots / serving_max_batch (0..1).")
+_m_active = obs_metrics.gauge(
+    "serving_active_slots", "Active decode slots (absolute).")
+_m_tokens = obs_metrics.counter(
+    "serving_tokens_generated_total",
+    "Tokens emitted by the decode loop across all requests.")
+_m_requests = obs_metrics.counter(
+    "serving_requests_total",
+    "Serving requests by terminal status: ok, shed (bounded-queue "
+    "429), drained (rejected/aborted by SIGTERM drain), error.",
+    ("status",))
+_m_ttft = obs_metrics.histogram(
+    "serving_ttft_seconds",
+    "Time to first token: submit -> queue wait -> bucketed prefill -> "
+    "first sampled token.")
+_m_token_latency = obs_metrics.histogram(
+    "serving_token_seconds",
+    "Per-token decode latency (one decode-step dispatch, per active "
+    "slot).")
+_m_step = obs_metrics.histogram(
+    "serving_decode_step_seconds",
+    "Whole decode-step dispatch latency (all slots at once).")
+_m_draining = obs_metrics.gauge(
+    "serving_draining", "1 while a SIGTERM drain is in progress.")
+_m_drains = obs_metrics.counter(
+    "serving_drains_total",
+    "SIGTERM/explicit drains honored at a decode-step boundary.")
+
+
+class ShedError(RuntimeError):
+    """Request rejected by admission control.  ``draining=False`` is
+    the bounded-queue rejection (HTTP 429: back off, retry HERE);
+    ``draining=True`` means the instance is going away (HTTP 503:
+    fail over)."""
+
+    def __init__(self, msg: str, queue_depth: int = 0,
+                 draining: bool = False):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.draining = draining
+
+
+class ServingRequest:
+    """One generation request and its lifecycle/timing record."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "eos_id",
+                 "tokens", "status", "error", "submit_t", "first_token_t",
+                 "finish_t", "_done")
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 temperature: float, eos_id: Optional[int]):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.tokens: List[int] = []
+        self.status = "pending"       # -> ok | error | drained
+        self.error: Optional[str] = None
+        self.submit_t = time.perf_counter()
+        self.first_token_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self._done = threading.Event()
+
+    # -- batcher side -------------------------------------------------------
+    def _finish(self, status: str, error: Optional[str] = None):
+        if self._done.is_set():      # terminal exactly once (a stop()
+            return                   # after loop exit must not recount)
+        self.status = status
+        self.error = error
+        self.finish_t = time.perf_counter()
+        _m_requests.labels(status=status).inc()
+        self._done.set()
+
+    # -- client side --------------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """Block until terminal; returns the response document (also
+        the /serving/generate body).  Raises TimeoutError if the
+        request is still in flight after `timeout`."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request not finished after {timeout}s "
+                f"(status {self.status})")
+        ttft = (None if self.first_token_t is None
+                else self.first_token_t - self.submit_t)
+        total = (None if self.finish_t is None
+                 else self.finish_t - self.submit_t)
+        return {"status": self.status, "tokens": list(self.tokens),
+                "n_tokens": len(self.tokens),
+                "error": self.error,
+                "ttft_s": ttft, "latency_s": total}
+
+
+class ContinuousBatcher:
+    """Single decode loop fronting a :class:`DecodeEngine`.
+
+    ``start()`` spawns the loop thread; ``submit()`` is thread-safe and
+    returns a :class:`ServingRequest` future.  ``begin_drain()`` (or
+    SIGTERM via :meth:`install_signal_handlers`) stops admission,
+    sheds the queue with explicit ``drained`` responses, finishes the
+    in-flight sequences and — with ``stop=True`` — exits the loop.
+    """
+
+    def __init__(self, engine: DecodeEngine,
+                 queue_limit: Optional[int] = None):
+        self.engine = engine
+        self.queue_limit = int(
+            queue_limit if queue_limit is not None
+            else flags.get_flag("serving_queue_limit"))
+        self._queue: List[ServingRequest] = []
+        self._slots: Dict[int, ServingRequest] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._draining = False
+        self._stop_after_drain = False
+        # set by the SIGTERM handler INSTEAD of calling begin_drain
+        # directly: a handler runs on the main thread at an arbitrary
+        # bytecode boundary — possibly inside submit()'s lock — so it
+        # must touch nothing but this plain flag (no locks, no Events);
+        # the loop honors it at the next decode-step boundary
+        self._drain_requested = False
+        self._old_handlers: Dict[int, object] = {}
+        self.started_t: Optional[float] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("batcher already running")
+        self._stop = False
+        self._draining = False
+        self._stop_after_drain = False
+        self._drain_requested = False
+        self.started_t = time.time()
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0):
+        """Hard stop: abort in-flight requests with explicit 'drained'
+        responses and join the loop thread."""
+        import warnings
+        self._stop = True
+        self._wake.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
+            if t.is_alive():
+                # keep the reference: `running` must stay True so a
+                # second loop thread can't be started over an engine
+                # the wedged one still owns
+                warnings.warn(
+                    f"serving batcher loop did not exit within "
+                    f"{timeout}s; engine may be wedged in a dispatch",
+                    RuntimeWarning, stacklevel=2)
+                return
+        self._thread = None
+        self._fail_pending("drained", "serving stopped")
+        _m_draining.set(0.0)
+
+    def begin_drain(self, stop: bool = True):
+        """SIGTERM semantics (the PR 2 preemption contract, honored at
+        the decode-step boundary): no new admissions, queued requests
+        get explicit 'drained' responses, active sequences finish."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self._stop_after_drain = stop
+        _m_draining.set(1.0)
+        _m_drains.inc()
+        obs_flight.record("serving", "drain_begin",
+                          queued=self.queue_depth,
+                          active=len(self._slots))
+        self._shed_queue("drained", "serving is draining (SIGTERM)")
+        self._wake.set()
+
+    def install_signal_handlers(self):
+        """SIGTERM -> drain at the next decode-step boundary, chaining
+        any previous handler (the Trainer's preemption hook coexists).
+        The handler itself only sets a plain flag — it may interrupt
+        the main thread INSIDE one of our own lock sections, where
+        calling begin_drain (or any threading primitive) would
+        deadlock.  Main thread only — elsewhere this degrades to no
+        signal-driven drain, like Trainer._install_preemption_handlers."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _on_term(signum, frame):
+            self._drain_requested = True
+            old = self._old_handlers.get(signum)
+            if callable(old):
+                old(signum, frame)
+
+        self._old_handlers[signal.SIGTERM] = signal.signal(
+            signal.SIGTERM, _on_term)
+
+    def restore_signal_handlers(self):
+        for sig, old in self._old_handlers.items():
+            signal.signal(sig, old)
+        self._old_handlers.clear()
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0,
+               eos_id: Optional[int] = None) -> ServingRequest:
+        """Admit one request (bounded queue) — raises ShedError past
+        serving_queue_limit or while draining."""
+        chaos.trigger("serving.admit", ConnectionAbortedError)
+        if not self.running:
+            raise RuntimeError("serving batcher is not running")
+        if max_new_tokens is None:
+            max_new_tokens = int(flags.get_flag("serving_max_new_tokens"))
+        req = ServingRequest(prompt, max_new_tokens, temperature, eos_id)
+        # validate NOW so a hopeless request is an error at the door,
+        # not a dead slot later (bucket fit AND room to generate)
+        self.engine.validate_prompt(len(req.prompt))
+        with self._lock:
+            if self._draining or self._stop:
+                req._finish("drained", "serving is draining")
+                raise ShedError("serving is draining", self.queue_depth,
+                                draining=True)
+            if len(self._queue) >= self.queue_limit:
+                req._finish("shed",
+                            f"queue at limit {self.queue_limit}")
+                raise ShedError(
+                    f"serving queue at limit ({self.queue_limit})",
+                    len(self._queue))
+            self._queue.append(req)
+            _m_queue_depth.set(len(self._queue))
+        self._wake.set()
+        return req
+
+    # -- loop ---------------------------------------------------------------
+    def _shed_queue(self, status: str, msg: str):
+        with self._lock:
+            shed, self._queue = self._queue, []
+            _m_queue_depth.set(0)
+        for req in shed:
+            req._finish(status, msg)
+
+    def _fail_pending(self, status: str, msg: str):
+        self._shed_queue(status, msg)
+        with self._lock:
+            slots, self._slots = dict(self._slots), {}
+        for slot, req in slots.items():
+            self.engine.retire_slot(slot)
+            if not req.done():
+                req._finish(status, msg)
+        self._publish_gauges()
+
+    def _publish_gauges(self):
+        _m_occupancy.set(self.engine.occupancy)
+        _m_active.set(float(len(self._slots)))
+        _m_queue_depth.set(float(len(self._queue)))
+
+    def _admit_at_boundary(self):
+        """Backfill free slots from the queue — the continuous-batching
+        moment: this runs BETWEEN decode steps, never mid-dispatch."""
+        while True:
+            with self._lock:
+                if self._draining or not self._queue:
+                    return
+                free = self.engine.free_slots()
+                if not free:
+                    return
+                req = self._queue.pop(0)
+                _m_queue_depth.set(len(self._queue))
+                slot = free[0]
+            try:
+                first = self.engine.start_sequence(
+                    slot, req.prompt, temperature=req.temperature)
+            except Exception as e:
+                # the dispatch donates the K/V slabs, so ANY prefill
+                # failure may have invalidated the cache for everyone
+                # (XlaRuntimeError subclasses RuntimeError — exception
+                # type cannot tell pre- from post-dispatch).  Validation
+                # errors were already rejected at submit(), so recover
+                # like a decode failure: fail in-flight requests
+                # explicitly and reallocate via engine.reset()
+                req._finish("error", f"prefill failed: {e!r}")
+                obs_flight.record("serving", "prefill_error",
+                                  error=repr(e)[:200])
+                self._fail_pending_active(e)
+                continue
+            req.first_token_t = time.perf_counter()
+            _m_ttft.observe(req.first_token_t - req.submit_t)
+            req.tokens.append(first)
+            _m_tokens.inc()
+            with self._lock:
+                self._slots[slot] = req
+            if self._maybe_finish(slot, req, first):
+                continue
+            self._publish_gauges()
+
+    def _maybe_finish(self, slot: int, req: ServingRequest,
+                      token: int) -> bool:
+        full = self.engine.remaining_capacity(slot) <= 0
+        hit_eos = req.eos_id is not None and token == req.eos_id
+        if (len(req.tokens) >= req.max_new_tokens or hit_eos or full):
+            self.engine.retire_slot(slot)
+            with self._lock:
+                self._slots.pop(slot, None)
+            req._finish("ok")
+            self._publish_gauges()
+            return True
+        return False
+
+    def _loop(self):
+        # try/finally: even an unexpected exception outside the decode
+        # try-block (admission, bookkeeping) must not strand pending
+        # requests in 'pending' — every request terminates explicitly
+        try:
+            self._loop_body()
+        finally:
+            self._fail_pending("drained", "serving loop exited")
+
+    def _loop_body(self):
+        while True:
+            if self._stop:
+                break
+            if self._drain_requested and not self._draining:
+                # SIGTERM landed since the last boundary (the handler
+                # only sets the flag — see install_signal_handlers)
+                self.begin_drain(stop=True)
+            self._admit_at_boundary()
+            with self._lock:
+                active = dict(self._slots)
+                drain_done = self._draining and not active
+            if drain_done:
+                if self._stop_after_drain:
+                    break
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            if not active:
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            t0 = time.perf_counter()
+            try:
+                chaos.trigger("serving.decode_step")
+                out = self.engine.decode_step()
+            except Exception as e:
+                # one bad step must not wedge the plane: fail the
+                # in-flight requests EXPLICITLY and keep serving
+                obs_flight.record("serving", "decode_step_error",
+                                  error=repr(e)[:200])
+                self._fail_pending_active(e)
+                continue
+            dt = time.perf_counter() - t0
+            _m_step.observe(dt)
+            for slot, tok in out.items():
+                req = active.get(slot)
+                if req is None:
+                    continue
+                req.tokens.append(tok)
+                _m_tokens.inc()
+                _m_token_latency.observe(dt)
+                self._maybe_finish(slot, req, tok)
+            self._publish_gauges()
+
+    def _fail_pending_active(self, exc: Exception):
+        with self._lock:
+            slots, self._slots = dict(self._slots), {}
+        for slot, req in slots.items():
+            self.engine.retire_slot(slot)
+            req._finish("error", f"decode step failed: {exc!r}")
+        self.engine.reset()
+        self._publish_gauges()
+
+    # -- status (the /serving route body) -----------------------------------
+    def status_doc(self) -> dict:
+        return {
+            "running": self.running,
+            "draining": self._draining,
+            "queue_depth": self.queue_depth,
+            "queue_limit": self.queue_limit,
+            "active_slots": len(self._slots),
+            "max_batch": self.engine.max_batch,
+            "occupancy": round(self.engine.occupancy, 4),
+            "prompt_buckets": list(self.engine.prompt_buckets),
+            "max_len": self.engine.max_len,
+            "started_unix": self.started_t,
+        }

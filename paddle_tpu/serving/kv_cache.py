@@ -1,0 +1,454 @@
+"""Decode-loop KV cache for the transformer LM (serving tentpole, ISSUE 8).
+
+The training stack runs ``build_lm_net`` as one whole-program jit — a
+full O(T^2) recompute per step.  Generation that way costs a full
+forward pass PER TOKEN.  This module is the serving-side twin: the same
+trained weights (bound via :func:`models.transformer.lm_program_spec`)
+run through an incremental decode step with pre-allocated per-layer K/V
+buffers updated in place via ``lax.dynamic_update_slice`` /
+scatter-``.at`` — one compiled executable advances EVERY slot of the
+serving batch by one token, so the request path never traces.
+
+Reference analog: the C-API inference tier's ``AnalysisPredictor``
+held a NaiveExecutor loop per request; there was no incremental decode
+at all (2018).  Here the decode state is explicit and batched:
+
+  * K/V buffers  ``[L, B, H, T_max, d_head]`` — one slab per layer,
+    every serving slot side by side, written at per-slot positions.
+  * Per-slot sequence state (lengths, last token, active mask, RNG
+    key, temperature) so the continuous batcher can retire a finished
+    sequence and backfill its slot MID-DECODE without touching the
+    other slots' caches.
+  * Bucketed prompt lengths: prefill compiles once per
+    ``serving_prompt_buckets`` entry at startup (``prepare()``), decode
+    compiles exactly once — the compile log after startup is silent
+    (no request-path recompile storm for forensics to report).
+  * Greedy + temperature sampling per slot (temperature 0 = argmax,
+    matching the full-recompute forward token-for-token).
+
+AOT discipline is the Predictor's: everything is ``.lower().compile()``d
+up front and only compiled executables run on the request path — a
+shape drift is an ERROR, never a silent recompile.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags
+from ..observability import flight as obs_flight
+from ..observability import metrics as obs_metrics
+
+_m_compiles = obs_metrics.counter(
+    "serving_compiles_total",
+    "Serving-plane AOT compiles (prefill buckets + the decode step). "
+    "Moves at prepare() time only; growth under load is a request-path "
+    "recompile — the storm the bucket grid exists to prevent.",
+    ("kind",))
+_m_compile_seconds = obs_metrics.gauge(
+    "serving_startup_compile_seconds",
+    "Total wall time prepare() spent AOT-compiling the bucket grid "
+    "and decode step.")
+_m_prefill = obs_metrics.histogram(
+    "serving_prefill_seconds",
+    "Prompt prefill latency (one compiled bucket dispatch).")
+
+_NEG = -1e9   # the additive mask value build_lm_net bakes into its bias
+
+
+def extract_lm_params(program, scope, cfg) -> Dict[str, np.ndarray]:
+    """Pull the trained LM weights out of (program, scope) keyed by the
+    ROLE names of :func:`models.transformer.lm_program_spec` —
+    ``emb``, ``l{i}.ln1.scale`` … ``w_head`` — the flat pytree
+    :class:`DecodeEngine` binds its compiled steps to."""
+    from ..models.transformer import lm_program_spec
+    spec = lm_program_spec(program)
+    if spec["n_layer"] != cfg.n_layer:
+        raise ValueError(
+            f"program has {spec['n_layer']} layers but cfg.n_layer="
+            f"{cfg.n_layer}")
+
+    def _get(name):
+        v = scope.find_var(name)
+        if v is None:
+            raise ValueError(f"parameter {name!r} missing from scope — "
+                             "run the startup program first")
+        return np.asarray(v)
+
+    params = {"emb": _get(spec["emb"]), "w_head": _get(spec["w_head"]),
+              "ln_f.scale": _get(spec["ln_f"][0]),
+              "ln_f.bias": _get(spec["ln_f"][1])}
+    for i, lay in enumerate(spec["layers"]):
+        params[f"l{i}.ln1.scale"] = _get(lay["ln1"][0])
+        params[f"l{i}.ln1.bias"] = _get(lay["ln1"][1])
+        params[f"l{i}.ln2.scale"] = _get(lay["ln2"][0])
+        params[f"l{i}.ln2.bias"] = _get(lay["ln2"][1])
+        for k in ("w_qkv", "w_o", "w_fc1", "b_fc1", "w_fc2", "b_fc2"):
+            params[f"l{i}.{k}"] = _get(lay[k])
+    return params
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    """layer_norm over the trailing axis — the op's own f32 math
+    (ops/nn_ops.py _layer_norm fallback; the Pallas kernel computes the
+    same formula)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    return (xf - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _sample_one(logits, key, temp):
+    """Greedy when temp == 0, else categorical at ``logits / temp`` —
+    per slot, vmapped in the decode step."""
+    key, sub = jax.random.split(key)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    sampled = jax.random.categorical(sub, scaled).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy), key
+
+
+class DecodeEngine:
+    """Batched incremental decode over ``build_lm_net`` weights.
+
+    Slot protocol (driven by serving/batcher.py, single-threaded):
+
+      1. ``start_sequence(slot, prompt, temperature)`` — bucketed
+         prefill writes the prompt's K/V at the slot and returns the
+         FIRST generated token (the TTFT token).
+      2. ``decode_step()`` — one compiled dispatch appends one token to
+         every active slot (inactive slots compute but are masked).
+      3. ``retire_slot(slot)`` — frees the slot for backfill; its cache
+         rows are simply overwritten by the next prefill.
+
+    Cache layout: ``lengths[slot]`` tokens occupy K/V positions
+    ``[0, lengths)``; ``last_token[slot]`` is the NEXT input, written
+    at position ``lengths`` by the decode step before attending.
+    """
+
+    def __init__(self, cfg, params: Dict[str, np.ndarray],
+                 max_batch: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.max_batch = int(max_batch if max_batch is not None
+                             else flags.get_flag("serving_max_batch"))
+        self.max_len = int(max_len if max_len is not None
+                           else cfg.max_length)
+        if self.max_len > cfg.max_length:
+            raise ValueError(f"max_len {self.max_len} exceeds the "
+                             f"model's max_length {cfg.max_length}")
+        if prompt_buckets is None:
+            prompt_buckets = [
+                int(b) for b in str(flags.get_flag(
+                    "serving_prompt_buckets")).split(",") if b.strip()]
+        buckets = sorted(set(int(b) for b in prompt_buckets))
+        self.prompt_buckets = [b for b in buckets if b <= self.max_len]
+        if not self.prompt_buckets:
+            raise ValueError(
+                f"no prompt bucket fits max_len={self.max_len} "
+                f"(got {buckets})")
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        from ..models.transformer import position_encoding_table
+        self._pos = jnp.asarray(
+            position_encoding_table(cfg.max_length, cfg.d_model)
+            [:self.max_len])
+        self._n_head = cfg.n_head
+        self._d_head = cfg.d_key
+        self._scale = float(cfg.d_key) ** -0.5
+
+        B, L = self.max_batch, cfg.n_layer
+        kv_shape = (L, B, cfg.n_head, self.max_len, cfg.d_key)
+        self._kv_k = jnp.zeros(kv_shape, jnp.float32)
+        self._kv_v = jnp.zeros(kv_shape, jnp.float32)
+        self._lengths = jnp.zeros((B,), jnp.int32)
+        self._last = jnp.zeros((B,), jnp.int32)
+        self._active = np.zeros((B,), bool)       # host-side slot map
+        self._temps = jnp.zeros((B,), jnp.float32)
+        self._keys = jnp.stack(
+            [jax.random.PRNGKey(seed + i) for i in range(B)])
+        self._compiled_prefill: Dict[int, object] = {}
+        self._compiled_step = None
+
+    # -- traced bodies ------------------------------------------------------
+    def _layer(self, p, i, x, attend):
+        """One transformer block shared by prefill and decode; the
+        caller provides the attention plumbing (cache write + score
+        masking differ between the two)."""
+        y = _ln(x, p[f"l{i}.ln1.scale"], p[f"l{i}.ln1.bias"])
+        qkv = jnp.matmul(y, p[f"l{i}.w_qkv"])
+        E = self._n_head * self._d_head
+        q, k, v = qkv[..., :E], qkv[..., E:2 * E], qkv[..., 2 * E:]
+        ctx = attend(i, q, k, v)
+        x = x + jnp.matmul(ctx, p[f"l{i}.w_o"])
+        y2 = _ln(x, p[f"l{i}.ln2.scale"], p[f"l{i}.ln2.bias"])
+        h = jax.nn.relu(jnp.matmul(y2, p[f"l{i}.w_fc1"])
+                        + p[f"l{i}.b_fc1"])
+        return x + jnp.matmul(h, p[f"l{i}.w_fc2"]) + p[f"l{i}.b_fc2"]
+
+    def _prefill_fn(self, bucket: int):
+        """Trace-time factory: prefill for one prompt bucket.  Batch of
+        ONE prompt (the batcher admits at decode boundaries; prefill
+        latency is one small dispatch), written into `slot`."""
+        H, dh = self._n_head, self._d_head
+        D = self.cfg.d_model
+        causal = jnp.where(
+            jnp.arange(bucket)[None, :] > jnp.arange(bucket)[:, None],
+            jnp.float32(_NEG), jnp.float32(0.0))
+
+        def run(p, kv_k, kv_v, tokens, length, slot, key, temp):
+            # tokens [bucket] i32; positions beyond `length` are pad —
+            # causal masking keeps them out of every row < length
+            x = p["emb"][tokens] * jnp.float32(D) ** 0.5 \
+                + self._pos[:bucket]
+
+            def split_heads(t):                     # [T,H*dh]->[H,T,dh]
+                return t.reshape(bucket, H, dh).transpose(1, 0, 2)
+
+            for i in range(self.cfg.n_layer):
+                def attend(li, q, k, v):
+                    nonlocal kv_k, kv_v
+                    kh, vh = split_heads(k), split_heads(v)
+                    kv_k = jax.lax.dynamic_update_slice(
+                        kv_k, kh[None, None], (li, slot, 0, 0, 0))
+                    kv_v = jax.lax.dynamic_update_slice(
+                        kv_v, vh[None, None], (li, slot, 0, 0, 0))
+                    qh = split_heads(q)
+                    s = jnp.einsum("hqd,hkd->hqk", qh, kh) * self._scale
+                    w = jax.nn.softmax(s + causal[None], axis=-1)
+                    ctx = jnp.einsum("hqk,hkd->hqd", w, vh)
+                    return ctx.transpose(1, 0, 2).reshape(bucket, H * dh)
+
+                x = self._layer(p, i, x, attend)
+            x = _ln(x, p["ln_f.scale"], p["ln_f.bias"])
+            xlast = jax.lax.dynamic_index_in_dim(
+                x, length - 1, axis=0, keepdims=False)
+            logits = jnp.matmul(xlast, p["w_head"])
+            tok, key = _sample_one(logits, key, temp)
+            return kv_k, kv_v, tok, key
+
+        return run
+
+    def _step_fn(self):
+        """One decode step for the WHOLE slot batch: write the pending
+        token's K/V at each slot's position, attend over the cache,
+        sample the next token.  Inactive slots compute-and-mask (fixed
+        shape, one executable)."""
+        B, H, dh = self.max_batch, self._n_head, self._d_head
+        D, T = self.cfg.d_model, self.max_len
+        iB = jnp.arange(B)
+
+        def run(p, kv_k, kv_v, last, lengths, active, keys, temps):
+            pos = jnp.clip(lengths, 0, T - 1)
+            x = p["emb"][last] * jnp.float32(D) ** 0.5 + self._pos[pos]
+            valid = jnp.arange(T)[None, :] <= pos[:, None]   # [B,T]
+            bias = jnp.where(valid, 0.0, _NEG)[:, None, :]   # [B,1,T]
+
+            for i in range(self.cfg.n_layer):
+                def attend(li, q, k, v):
+                    nonlocal kv_k, kv_v
+                    kh = k.reshape(B, H, dh)
+                    vh = v.reshape(B, H, dh)
+                    kv_k = kv_k.at[li, iB, :, pos, :].set(kh)
+                    kv_v = kv_v.at[li, iB, :, pos, :].set(vh)
+                    qh = q.reshape(B, H, dh)
+                    s = jnp.einsum("bhd,bhtd->bht", qh, kv_k[li]) \
+                        * self._scale
+                    w = jax.nn.softmax(s + bias, axis=-1)
+                    ctx = jnp.einsum("bht,bhtd->bhd", w, kv_v[li])
+                    return ctx.reshape(B, H * dh)
+
+                x = self._layer(p, i, x, attend)
+            x = _ln(x, p["ln_f.scale"], p["ln_f.bias"])
+            logits = jnp.matmul(x, p["w_head"])            # [B,V]
+            toks, keys = jax.vmap(_sample_one)(logits, keys, temps)
+            toks = jnp.where(active, toks, last)
+            new_len = jnp.where(active, jnp.minimum(lengths + 1, T),
+                                lengths)
+            return kv_k, kv_v, toks, new_len, keys
+
+        return run
+
+    # -- AOT compile --------------------------------------------------------
+    def _sds(self, like):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                           jnp.result_type(a)), like)
+
+    def prepare(self) -> dict:
+        """AOT-compile the full bucket grid + the decode step NOW, so
+        serving startup cost is one call and the request path never
+        traces.  Returns {bucket: seconds} + totals; records
+        serving_compiles_total and the startup-compile gauge."""
+        t0 = time.perf_counter()
+        report = {}
+        p_sds = self._sds(self._params)
+        kv_sds = self._sds(self._kv_k)
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        key_sds = self._sds(self._keys[0])
+        for bucket in self.prompt_buckets:
+            if bucket in self._compiled_prefill:
+                continue
+            tb = time.perf_counter()
+            # donate the K/V slabs: the old cache is dead the moment
+            # the call returns, so XLA updates in place instead of
+            # copying two [L,B,H,T,dh] buffers per dispatch
+            self._compiled_prefill[bucket] = jax.jit(
+                self._prefill_fn(bucket), donate_argnums=(1, 2)).lower(
+                p_sds, kv_sds, kv_sds,
+                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                i32, i32, key_sds, f32).compile()
+            report[f"prefill_{bucket}"] = round(
+                time.perf_counter() - tb, 3)
+            _m_compiles.labels(kind="prefill").inc()
+            obs_flight.record("compile", f"serving.prefill[{bucket}]",
+                              bucket=bucket)
+        if self._compiled_step is None:
+            tb = time.perf_counter()
+            B = self.max_batch
+            self._compiled_step = jax.jit(
+                self._step_fn(), donate_argnums=(1, 2)).lower(
+                p_sds, kv_sds, kv_sds,
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.bool_),
+                self._sds(self._keys),
+                jax.ShapeDtypeStruct((B,), jnp.float32)).compile()
+            report["decode_step"] = round(time.perf_counter() - tb, 3)
+            _m_compiles.labels(kind="decode_step").inc()
+            obs_flight.record("compile", "serving.decode_step",
+                              batch=B)
+        total = time.perf_counter() - t0
+        _m_compile_seconds.set(total)
+        report["total_seconds"] = round(total, 3)
+        print(f"[serving] prepared {len(self.prompt_buckets)} prompt "
+              f"bucket(s) {self.prompt_buckets} x batch "
+              f"{self.max_batch} in {total:.2f}s "
+              f"(decode step + prefill grid AOT-compiled)")
+        return report
+
+    @staticmethod
+    @contextlib.contextmanager
+    def _donation_quiet():
+        """Backends that cannot donate (CPU) warn per dispatch; the
+        donation is intentional (in-place K/V update on TPU), the
+        warning is noise — same policy as the executor's donate-feeds
+        twin."""
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers were not usable.*")
+            yield
+
+    # -- slot lifecycle -----------------------------------------------------
+    def reset(self):
+        """Forget all sequence state (compiled executables survive).
+        The K/V slabs are REALLOCATED, not just ignored: they are
+        donated into every dispatch, so a dispatch that failed midway
+        (the batcher's decode-error recovery path calls reset()) may
+        have invalidated the old buffers."""
+        self._kv_k = jnp.zeros(self._kv_k.shape, jnp.float32)
+        self._kv_v = jnp.zeros(self._kv_v.shape, jnp.float32)
+        self._lengths = jnp.zeros((self.max_batch,), jnp.int32)
+        self._last = jnp.zeros((self.max_batch,), jnp.int32)
+        self._active[:] = False
+        self._temps = jnp.zeros((self.max_batch,), jnp.float32)
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.max_batch) if not self._active[i]]
+
+    def active_slots(self) -> List[int]:
+        return [i for i in range(self.max_batch) if self._active[i]]
+
+    @property
+    def occupancy(self) -> float:
+        return float(self._active.sum()) / float(self.max_batch)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prompt_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"{self.prompt_buckets[-1]}")
+
+    def validate_prompt(self, prompt_len: int) -> int:
+        """Every at-the-door rejection in one place (the batcher calls
+        this BEFORE queueing, so a hopeless request errors at submit,
+        not as a dead slot later): bucket fit AND room to generate.
+        Returns the bucket."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if prompt_len >= self.max_len:
+            raise ValueError(
+                f"prompt length {prompt_len} leaves no room to "
+                f"generate (max_len {self.max_len})")
+        return self.bucket_for(prompt_len)
+
+    def remaining_capacity(self, slot: int) -> int:
+        """Tokens this slot can still EMIT.  The cache holds positions
+        [0, max_len); a decode step at lengths == max_len - 1 writes
+        the final position and still emits a valid token (whose K/V is
+        never needed), so capacity is max_len - lengths, not one less."""
+        return self.max_len - int(self._lengths[slot])
+
+    def start_sequence(self, slot: int, prompt: Sequence[int],
+                       temperature: float = 0.0) -> int:
+        """Bucketed prefill of `prompt` into `slot`; returns the first
+        generated token.  One compiled dispatch — never a trace."""
+        if self._active[slot]:
+            raise ValueError(f"slot {slot} is still active")
+        n = len(prompt)
+        bucket = self.validate_prompt(n)
+        fn = self._compiled_prefill.get(bucket)
+        if fn is None:
+            raise RuntimeError(
+                f"bucket {bucket} not prepared — call prepare() before "
+                "serving (request-path compiles are forbidden)")
+        toks = np.zeros((bucket,), np.int32)
+        toks[:n] = np.asarray(prompt, np.int32)
+        t0 = time.perf_counter()
+        with self._donation_quiet():
+            self._kv_k, self._kv_v, tok, key = fn(
+                self._params, self._kv_k, self._kv_v, jnp.asarray(toks),
+                np.int32(n), np.int32(slot), self._keys[slot],
+                np.float32(temperature))
+        tok = int(tok)
+        _m_prefill.observe(time.perf_counter() - t0)
+        self._lengths = self._lengths.at[slot].set(n)
+        self._last = self._last.at[slot].set(tok)
+        self._temps = self._temps.at[slot].set(float(temperature))
+        self._keys = self._keys.at[slot].set(key)
+        self._active[slot] = True
+        return tok
+
+    def retire_slot(self, slot: int):
+        self._active[slot] = False
+
+    def decode_step(self) -> Dict[int, int]:
+        """Advance every active slot one token (ONE compiled dispatch);
+        returns {slot: token}.  Slots whose cache is full are excluded
+        (the batcher must retire them)."""
+        if self._compiled_step is None:
+            raise RuntimeError("call prepare() first")
+        lengths = np.asarray(self._lengths)
+        runnable = self._active & (lengths < self.max_len)
+        if not runnable.any():
+            return {}
+        active = jnp.asarray(runnable)
+        with self._donation_quiet():
+            self._kv_k, self._kv_v, toks, self._lengths, self._keys = \
+                self._compiled_step(
+                    self._params, self._kv_k, self._kv_v, self._last,
+                    self._lengths, active, self._keys, self._temps)
+        self._last = toks
+        host = np.asarray(toks)
+        return {int(i): int(host[i]) for i in np.where(runnable)[0]}

@@ -1,0 +1,88 @@
+"""Supervised serving worker process.
+
+``python -m paddle_tpu.serving.worker <port> [seed]`` builds a small
+deterministic ``transformer_lm``, AOT-prepares the decode engine's
+bucket grid, starts the continuous batcher and the observability HTTP
+endpoint on `port` (``/serving`` status + ``POST /serving/generate``),
+and serves until SIGTERM — which drains in-flight sequences at a
+decode-step boundary before a clean exit 0 (the PR 2 preemption
+contract applied to serving).
+
+The PR 5 supervisor babysits this process in the chaos soak
+(tests/test_serving.py slow lane): ``PTPU_CHAOS_SPEC=
+"serving.decode_step=exit:..."`` hard-kills it mid-decode, the
+supervisor restarts it chaos-stripped on the SAME port, and loadgen's
+retrying streams ride through the capacity gap.  Model geometry is
+fixed by (seed, env) so a restarted incarnation serves identical
+weights.
+
+Env knobs (all optional): PTPU_SERVING_WORKER_BATCH (decode slots,
+default 4), PTPU_SERVING_WORKER_MAXLEN (default 64),
+PTPU_SERVING_WORKER_BUCKETS (default "8,16").
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: python -m paddle_tpu.serving.worker <port> [seed]",
+              file=sys.stderr)
+        return 2
+    port = int(argv[0])
+    seed = int(argv[1]) if len(argv) > 1 else 7
+    # this container has no reachable TPU; serving tests/soaks run on
+    # CPU unless the operator says otherwise (tests/conftest.py quirk)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import paddle_tpu as pt
+    from paddle_tpu import models, serving
+    from paddle_tpu.framework.executor import global_scope
+    from paddle_tpu.observability import server as obs_server
+
+    max_batch = int(os.environ.get("PTPU_SERVING_WORKER_BATCH", "4"))
+    max_len = int(os.environ.get("PTPU_SERVING_WORKER_MAXLEN", "64"))
+    buckets = [int(b) for b in os.environ.get(
+        "PTPU_SERVING_WORKER_BUCKETS", "8,16").split(",")]
+
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=97, tgt_vocab_size=97, max_length=max_len,
+        n_layer=2, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    _, _, _logits = models.transformer.build_lm_net(
+        cfg, seq_len=min(max_len, 32), is_test=True,
+        fused_attention=False, fused_head=False)
+    exe = pt.Executor(pt.CPUPlace())
+    pt.default_startup_program().random_seed = seed
+    exe.run(pt.default_startup_program())
+
+    params = serving.extract_lm_params(
+        pt.default_main_program(), global_scope(), cfg)
+    engine = serving.DecodeEngine(cfg, params, max_batch=max_batch,
+                                  max_len=max_len,
+                                  prompt_buckets=buckets, seed=seed)
+    engine.prepare()
+    batcher = serving.ContinuousBatcher(engine)
+    batcher.start()
+    serving.attach(batcher)
+    batcher.install_signal_handlers()
+    srv = obs_server.start_http_server(port=port)
+    print(f"SERVING_READY {srv.url}", flush=True)
+    try:
+        while batcher.running:
+            time.sleep(0.1)
+    finally:
+        # SIGTERM landed: the drain already finished (loop exited);
+        # detach routes and release the port for a successor
+        serving.reset()
+        obs_server.stop_http_server()
+    print("SERVING_DRAINED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,6 +40,7 @@ def fresh_programs():
     chaos spec leaking across tests, and no observability HTTP server
     or trainer-liveness state surviving a case."""
     import paddle_tpu as pt
+    import paddle_tpu.serving as serving
     from paddle_tpu.distributed import task_queue
     from paddle_tpu.framework import executor as executor_mod
     from paddle_tpu.observability import costmodel, flight, forensics
@@ -63,11 +64,15 @@ def fresh_programs():
     # queue/membership gauges: a scrape-time refresh_metrics() must not
     # re-publish a dead master's fleet_workers / taskmaster_tasks series
     task_queue.reset_state()
+    # serving plane: no batcher loop thread or HTTP-routed engine may
+    # survive a case (queue threads joined, routes detached)
+    serving.reset()
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
     obs_server.reset()
     task_queue.reset_state()
+    serving.reset()
 
 
 @pytest.fixture

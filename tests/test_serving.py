@@ -1,0 +1,542 @@
+"""Serving plane (ISSUE 8): KV-cache decode correctness, continuous
+batching, admission control / SLO metrics / drain, the tier-1 loadgen
+soak headline, the slow chaos soak, and the Predictor satellites.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import models, serving
+from paddle_tpu.core import flags
+from paddle_tpu.observability import forensics
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.serving import loadgen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter_total(name):
+    m = obs_metrics.REGISTRY.get(name)
+    return 0.0 if m is None else m.total()
+
+
+# --- shared tiny LM + decode engine (compiled ONCE per module) -------------
+
+@pytest.fixture(scope="module")
+def lm():
+    """Tiny trained-init LM, its executor reference path, and a
+    prepared DecodeEngine over the SAME weights."""
+    pt.reset_default_programs()
+    from paddle_tpu.framework import executor as em
+    scope = em.Scope()
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=97, tgt_vocab_size=97, max_length=32,
+        n_layer=2, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    T = 24
+    feeds, cost, logits = models.transformer.build_lm_net(
+        cfg, seq_len=T, is_test=True, fused_attention=False,
+        fused_head=False)
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    pt.default_startup_program().random_seed = 3
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program()
+    params = serving.extract_lm_params(prog, scope, cfg)
+    engine = serving.DecodeEngine(cfg, params, max_batch=4, max_len=32,
+                                  prompt_buckets=(8, 16))
+    engine.prepare()
+
+    def ref_greedy(prompt, n_new):
+        """Full-recompute forward per token — the correctness oracle."""
+        toks = list(prompt)
+        out = []
+        for _ in range(n_new):
+            pad = np.zeros((1, T), np.int64)
+            pad[0, :len(toks)] = toks
+            lg, = exe.run(prog, feed={"tokens": pad,
+                                      "labels": np.zeros((1, T), "i8")},
+                          fetch_list=[logits])
+            tok = int(np.argmax(lg[0, len(toks) - 1]))
+            toks.append(tok)
+            out.append(tok)
+        return out
+
+    return SimpleNamespace(cfg=cfg, engine=engine, ref_greedy=ref_greedy)
+
+
+@pytest.fixture
+def fresh_engine(lm):
+    lm.engine.reset()
+    return lm.engine
+
+
+@pytest.fixture
+def batcher(fresh_engine):
+    b = serving.ContinuousBatcher(fresh_engine, queue_limit=16)
+    b.start()
+    serving.attach(b)
+    yield b
+    serving.reset()
+
+
+def _greedy_via_engine(engine, prompts, n_new):
+    """Start all prompts in parallel slots; step until each has n_new
+    tokens; returns per-prompt token lists."""
+    gen = {}
+    for s, p in enumerate(prompts):
+        gen[s] = [engine.start_sequence(s, p, temperature=0.0)]
+    for _ in range(n_new - 1):
+        for s, t in engine.decode_step().items():
+            gen[s].append(t)
+    return [gen[s] for s in range(len(prompts))]
+
+
+# --- KV-cache decode correctness -------------------------------------------
+
+def test_kv_decode_token_identical_to_full_forward(lm, fresh_engine):
+    """Acceptance bar: batched incremental decode == full-recompute
+    forward, token for token, across bucketed prompt lengths in ONE
+    ragged batch."""
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 97, n).tolist() for n in (5, 8, 13, 16)]
+    got = _greedy_via_engine(fresh_engine, prompts, 6)
+    for p, g in zip(prompts, got):
+        assert g == lm.ref_greedy(p, 6)
+
+
+def test_kv_decode_retire_backfill_mid_decode(lm, fresh_engine):
+    """A retired slot backfilled MID-DECODE (the continuous-batching
+    move) decodes its new sequence token-identically while the
+    neighbours keep their caches."""
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 97, n).tolist() for n in (6, 9, 12)]
+    gen = {s: [fresh_engine.start_sequence(s, p)]
+           for s, p in enumerate(prompts)}
+    for _ in range(2):
+        for s, t in fresh_engine.decode_step().items():
+            gen[s].append(t)
+    # retire slot 1 mid-flight, backfill a fresh prompt into its slot
+    fresh_engine.retire_slot(1)
+    p_new = rng.randint(1, 97, 11).tolist()
+    g_new = [fresh_engine.start_sequence(1, p_new)]
+    for _ in range(3):
+        out = fresh_engine.decode_step()
+        g_new.append(out[1])
+        for s in (0, 2):
+            gen[s].append(out[s])
+    assert g_new == lm.ref_greedy(p_new, 4)
+    for s in (0, 2):
+        assert gen[s] == lm.ref_greedy(prompts[s], 6)
+
+
+def test_temperature_sampling_and_greedy_mix(lm, fresh_engine):
+    """Greedy and temperature slots coexist in one decode step; the
+    sampled slot stays in-vocab and the greedy slot stays reference-
+    exact."""
+    rng = np.random.RandomState(2)
+    p0, p1 = rng.randint(1, 97, 7).tolist(), rng.randint(1, 97, 7).tolist()
+    g0 = [fresh_engine.start_sequence(0, p0, temperature=0.0)]
+    g1 = [fresh_engine.start_sequence(1, p1, temperature=1.0)]
+    for _ in range(4):
+        out = fresh_engine.decode_step()
+        g0.append(out[0])
+        g1.append(out[1])
+    assert g0 == lm.ref_greedy(p0, 5)
+    assert all(0 <= t < 97 for t in g1)
+
+
+def test_cache_capacity_boundary_uses_every_position(lm, fresh_engine,
+                                                     batcher):
+    """A slot may emit exactly max_len - prompt_len tokens after the
+    prefill token: the decode step at lengths == max_len - 1 writes
+    the LAST cache position and its emitted token is still valid (its
+    K/V is never needed)."""
+    prompt = list(range(1, 15))            # len 14, bucket 16
+    cap = fresh_engine.max_len - len(prompt) + 1        # incl. prefill
+    req = batcher.submit(prompt, max_new_tokens=10_000)
+    doc = req.result(timeout=60)
+    assert doc["status"] == "ok"
+    assert doc["n_tokens"] == cap          # 32 - 14 + 1 = 19
+    # reference-exact as far as the T=24 oracle program can see
+    n_ref = 24 - len(prompt)
+    assert doc["tokens"][:n_ref] == lm.ref_greedy(prompt, n_ref)
+
+
+def test_lm_program_spec_rejects_fused_build():
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=50, tgt_vocab_size=50, max_length=16,
+        n_layer=1, n_head=2, d_model=8, d_inner=16, dropout=0.0)
+    models.transformer.build_lm_net(cfg, seq_len=8, is_test=True,
+                                    fused_attention=True)
+    with pytest.raises(ValueError, match="unfused"):
+        models.transformer.lm_program_spec(pt.default_main_program())
+
+
+def test_prompt_too_long_rejected_at_the_door(lm, fresh_engine, batcher):
+    with pytest.raises(ValueError, match="bucket"):
+        batcher.submit(list(range(1, 20)))   # > largest bucket (16)
+
+
+# --- continuous batcher: headline soak, admission, drain -------------------
+
+def test_loadgen_soak_zero_request_path_compiles(lm, batcher):
+    """Tier-1 headline: >= 8 concurrent closed-loop streams against the
+    batcher-fronted LM complete with ZERO compiles on the request path
+    (serving_compiles_total frozen, forensics compile log silent) and
+    p99 per-token latency under budget."""
+    compiles_before = _counter_total("serving_compiles_total")
+    forensics_before = len(forensics.compile_log())
+    rep = loadgen.run_loadgen(
+        loadgen.inproc_submit(batcher), streams=8,
+        requests_per_stream=3, max_new_tokens=6,
+        prompt_len_range=(3, 14), vocab_size=97,
+        p99_budget_ms=2000.0)
+    assert rep["ok"], rep
+    assert rep["counts"]["ok"] == 24
+    assert rep["accounted"]
+    assert rep["per_token_ms"]["p99"] is not None
+    assert rep["per_token_ms"]["p99"] <= 2000.0
+    assert _counter_total("serving_compiles_total") == compiles_before
+    assert len(forensics.compile_log()) == forensics_before
+    assert _counter_total("serving_tokens_generated_total") >= 24 * 6
+
+
+def test_eos_stops_generation(lm, batcher):
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, 97, 5).tolist()
+    ref = lm.ref_greedy(prompt, 8)
+    # pick an eos that does not occur earlier in the greedy tail, so
+    # the stop point is unambiguous
+    idx = next(i for i in range(1, len(ref)) if ref[i] not in ref[:i])
+    req = batcher.submit(prompt, max_new_tokens=8, eos_id=ref[idx])
+    doc = req.result(timeout=30)
+    assert doc["status"] == "ok"
+    assert doc["tokens"] == ref[:idx + 1]    # stops AT the eos token
+
+
+def test_admission_control_sheds_past_queue_limit(lm, fresh_engine):
+    """Bounded queue: past serving_queue_limit submit() raises
+    ShedError and the shed counter moves — the 429 contract."""
+    b = serving.ContinuousBatcher(fresh_engine, queue_limit=0)
+    b.start()
+    serving.attach(b)
+    shed_before = obs_metrics.REGISTRY.get(
+        "serving_requests_total").labels(status="shed").value
+    with pytest.raises(serving.ShedError):
+        b.submit([1, 2, 3])
+    assert obs_metrics.REGISTRY.get(
+        "serving_requests_total").labels(status="shed").value \
+        == shed_before + 1
+    serving.reset()
+    assert not b.running
+
+
+def test_http_shed_is_429_and_generate_roundtrip(lm, batcher):
+    srv = obs_server.start_http_server(port=0)
+    url = srv.url
+    body = json.dumps({"prompt": [4, 5, 6], "max_new_tokens": 4}).encode()
+    doc = json.loads(urllib.request.urlopen(urllib.request.Request(
+        url + "/serving/generate", data=body,
+        headers={"Content-Type": "application/json"}), timeout=30).read())
+    assert doc["status"] == "ok" and len(doc["tokens"]) == 4
+    assert doc["ttft_s"] is not None and doc["latency_s"] is not None
+    # flip to a zero queue: every admission sheds -> HTTP 429
+    batcher.queue_limit = 0
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(urllib.request.Request(
+            url + "/serving/generate", data=body,
+            headers={"Content-Type": "application/json"}), timeout=30)
+    assert ei.value.code == 429
+    assert json.loads(ei.value.read())["status"] == "shed"
+    # draining is NOT a 429 (retry here) — it's a 503 (fail over)
+    batcher.begin_drain(stop=False)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(urllib.request.Request(
+            url + "/serving/generate", data=body,
+            headers={"Content-Type": "application/json"}), timeout=30)
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["status"] == "drained"
+    obs_server.stop_http_server()
+
+
+def test_serving_route_and_metrics_local_and_fleet_merged(lm, batcher):
+    """Acceptance bar: /serving + serving_* series on BOTH the local
+    and the fleet-merged /metrics expositions."""
+    req = batcher.submit([3, 4, 5], max_new_tokens=4)
+    assert req.result(timeout=30)["status"] == "ok"
+    srv = obs_server.start_http_server(port=0)
+    doc = json.loads(urllib.request.urlopen(
+        srv.url + "/serving", timeout=10).read())
+    assert doc["schema"] == "paddle_tpu.serving.v1"
+    assert doc["attached"] and doc["max_batch"] == 4
+    assert doc["requests"]["ok"] >= 1
+    assert doc["ttft_s"]["count"] >= 1
+    assert doc["per_token_s"]["p99"] is not None
+    local_prom = urllib.request.urlopen(
+        srv.url + "/metrics", timeout=10).read().decode()
+    for name in ("serving_queue_depth", "serving_batch_occupancy",
+                 "serving_tokens_generated_total",
+                 "serving_requests_total",
+                 "serving_ttft_seconds_bucket",
+                 "serving_token_seconds_bucket"):
+        assert name in local_prom, name
+    obs_server.stop_http_server()
+    # fleet-merged: a worker snapshot carrying serving_* series merges
+    # into the coordinator's exposition
+    from paddle_tpu.observability import fleet
+    agg = fleet.FleetAggregator(stale_after=3600)
+    agg.ingest("report_metrics", fleet.snapshot_payload(rank=1))
+    merged = agg.prometheus_text(local=obs_metrics.REGISTRY.to_json())
+    for name in ("serving_tokens_generated_total",
+                 "serving_ttft_seconds_bucket",
+                 "serving_requests_total"):
+        assert name in merged, name
+
+
+def test_drain_finishes_in_flight_and_sheds_queue(lm, batcher):
+    """Drain contract: in-flight sequences finish, queued/new requests
+    get EXPLICIT drained/shed responses, nothing hangs."""
+    reqs = [batcher.submit(list(range(1, 6)), max_new_tokens=12)
+            for _ in range(6)]
+    batcher.begin_drain(stop=True)
+    docs = [r.result(timeout=30) for r in reqs]
+    statuses = {d["status"] for d in docs}
+    assert statuses <= {"ok", "drained"}
+    assert all(d["status"] is not None for d in docs)
+    # drained requests answered instantly with no tokens lost silently
+    for d in docs:
+        if d["status"] == "ok":
+            assert len(d["tokens"]) == 12
+    deadline = time.time() + 10
+    while batcher.running and time.time() < deadline:
+        time.sleep(0.05)
+    assert not batcher.running
+    with pytest.raises((serving.ShedError, RuntimeError)):
+        batcher.submit([1, 2, 3])
+
+
+def test_sigterm_begins_drain_and_chains_handler(lm, batcher):
+    """SIGTERM (the PR 2 preemption signal) drains the serving plane
+    AND still reaches a previously-installed handler."""
+    seen = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        batcher.install_signal_handlers()
+        req = batcher.submit([2, 3, 4], max_new_tokens=5)
+        os.kill(os.getpid(), signal.SIGTERM)
+        doc = req.result(timeout=30)
+        assert doc["status"] in ("ok", "drained")
+        deadline = time.time() + 10
+        while batcher.running and time.time() < deadline:
+            time.sleep(0.05)
+        assert not batcher.running
+        assert batcher.draining
+        assert seen == [signal.SIGTERM]      # chained, not swallowed
+    finally:
+        batcher.restore_signal_handlers()
+        signal.signal(signal.SIGTERM, old)
+
+
+@pytest.mark.chaos
+def test_decode_chaos_fails_requests_explicitly_and_recovers(lm, batcher):
+    """A chaos fault mid-decode fails the in-flight requests with an
+    explicit error response; the loop keeps serving afterwards."""
+    flags.set_flag("chaos_spec", "serving.decode_step=raise:1.0")
+    req = batcher.submit([5, 6, 7], max_new_tokens=6)
+    doc = req.result(timeout=30)
+    assert doc["status"] == "error"
+    assert "decode step failed" in doc["error"]
+    flags.set_flag("chaos_spec", "")
+    req2 = batcher.submit([5, 6, 7], max_new_tokens=4)
+    assert req2.result(timeout=30)["status"] == "ok"
+    assert batcher.running
+
+
+# --- Predictor satellites --------------------------------------------------
+
+def _save_tiny_model(tmp_path, with_seq=False):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        if with_seq:
+            tokens = pt.layers.data("tokens", [8], dtype="int64")
+            emb = pt.layers.embedding(tokens, size=[50, 8])
+            pooled = pt.layers.reduce_sum(emb, dim=1)
+            pred = pt.layers.fc(pooled, size=3)
+            feed_names = ["tokens"]
+        else:
+            x = pt.layers.data("x", [4], dtype="float32")
+            pred = pt.layers.fc(x, size=3)
+            feed_names = ["x"]
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, feed_names, [pred], exe,
+                               main_program=main)
+    from paddle_tpu.inference.predictor import (AnalysisConfig,
+                                                create_predictor)
+    cfg = AnalysisConfig(d, use_tpu=False)
+    return create_predictor(cfg)
+
+
+def test_predictor_rejects_unknown_feed_names(tmp_path):
+    """Satellite: an extra feed name must be a ValueError, NOT a fresh
+    executable (it used to silently change _sig and recompile per
+    request)."""
+    p = _save_tiny_model(tmp_path)
+    x = np.ones((2, 4), "f4")
+    p.run({"x": x})
+    n = len(p._compiled)
+    with pytest.raises(ValueError, match="unknown feed names"):
+        p.run({"x": x, "bogus": x})
+    assert len(p._compiled) == n        # no second executable
+    with pytest.raises(ValueError, match="unknown feed names"):
+        p.prepare({"x": x, "bogus": x})
+    with pytest.raises(ValueError, match="missing feeds"):
+        p.run({})
+
+
+def test_predictor_prepare_buckets_grid(tmp_path):
+    """Satellite: prepare_buckets AOT-compiles the whole (batch, seq)
+    grid up front; running any bucket shape afterwards never adds an
+    executable."""
+    p = _save_tiny_model(tmp_path, with_seq=True)
+    rep = p.prepare_buckets({"tokens": np.zeros((1, 8), "i8")},
+                            batch_sizes=(1, 2), seq_lens=(4, 8))
+    assert rep["executables"] == 4
+    assert rep["total_seconds"] >= 0
+    n = len(p._compiled)
+    rng = np.random.RandomState(0)
+    for bs in (1, 2):
+        for sl in (4, 8):
+            out, = p.run({"tokens": rng.randint(0, 50, (bs, sl))
+                          .astype("i8")})
+            assert out.shape == (bs, 3)
+    assert len(p._compiled) == n        # request path: zero compiles
+
+
+def test_predictor_clone_concurrent_matches_serial(tmp_path):
+    """Satellite: M threads over cloned predictors sharing one
+    compiled executable reproduce the serial outputs exactly (the
+    'sharing is free' claim in clone()'s docstring)."""
+    p = _save_tiny_model(tmp_path)
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.randn(2, 4).astype("f4")} for _ in range(8)]
+    p.prepare(feeds[0])
+    serial = [p.run(f)[0] for f in feeds]
+    clones = [p.clone() for _ in range(4)]
+    results = [[None] * len(feeds) for _ in clones]
+    errors = []
+
+    def worker(ci):
+        try:
+            for fi, f in enumerate(feeds):
+                results[ci][fi] = clones[ci].run(f)[0]
+        except Exception as e:             # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(ci,))
+               for ci in range(len(clones))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for ci in range(len(clones)):
+        for fi in range(len(feeds)):
+            np.testing.assert_array_equal(results[ci][fi], serial[fi])
+    # clones shared the executable cache: no extra compiles
+    assert all(c._compiled is p._compiled for c in clones)
+
+
+# --- chaos soak (slow lane): supervised worker killed under load -----------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _seed_where_exit_fires(prob, lo, hi, site="serving.decode_step"):
+    for seed in range(10_000):
+        fires = [n for n in range(hi)
+                 if zlib.crc32(f"{seed}:{site}:{n}".encode())
+                 / 0xFFFFFFFF < prob]
+        if fires and lo <= fires[0] < hi:
+            return seed
+    raise RuntimeError("no seed found")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_soak_worker_kill_supervisor_restores_capacity(tmp_path):
+    """Slow headline: loadgen streams drive a SUPERVISED serving worker
+    over HTTP while chaos hard-kills it mid-decode; the supervisor
+    restarts it (chaos-stripped) on the same port, capacity returns,
+    and every request ends in an explicit ok/shed/error — none lost."""
+    from paddle_tpu.distributed.supervisor import Supervisor
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    # prob 0.2: the crc32 schedule correlation (PR 5 gotcha)
+    # leaves no seed with an 8-step skip run at higher probabilities
+    kseed = _seed_where_exit_fires(0.2, 8, 30)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PYTHONPATH", None)
+    sup = Supervisor(
+        cmds=[[sys.executable, "-m", "paddle_tpu.serving.worker",
+               str(port)]],
+        env=env,
+        envs=[{"PTPU_CHAOS_SPEC": "serving.decode_step=exit:0.2:9",
+               "PTPU_CHAOS_SEED": str(kseed)}],
+        cwd=REPO, log_dir=str(tmp_path))
+    sup.start()
+    try:
+        deadline = time.time() + 90
+        up = False
+        while time.time() < deadline:
+            try:
+                doc = json.loads(urllib.request.urlopen(
+                    url + "/serving", timeout=1).read())
+                if doc.get("attached"):
+                    up = True
+                    break
+            except Exception:
+                time.sleep(0.3)
+        assert up, "worker never became ready"
+        rep = loadgen.run_loadgen(
+            loadgen.http_submit(url, timeout=30), streams=4,
+            requests_per_stream=6, max_new_tokens=6,
+            prompt_len_range=(3, 14), vocab_size=97,
+            p99_budget_ms=0.0, max_attempts=400, retry_sleep_s=0.15)
+        assert rep["accounted"], rep
+        assert rep["counts"]["gave_up"] == 0, rep
+        assert rep["counts"]["ok"] == 4 * 6, rep
+        # the kill actually happened and the supervisor restored it
+        assert sup.restarts[0] >= 1, (rep, sup.status())
+        assert rep["counts"]["error"] >= 1, rep   # someone saw the gap
+        # capacity restored: a fresh request against the restarted
+        # incarnation succeeds
+        body = json.dumps({"prompt": [9, 8, 7],
+                           "max_new_tokens": 3}).encode()
+        doc = json.loads(urllib.request.urlopen(urllib.request.Request(
+            url + "/serving/generate", data=body,
+            headers={"Content-Type": "application/json"}),
+            timeout=30).read())
+        assert doc["status"] == "ok"
+    finally:
+        sup.stop()
