@@ -96,7 +96,21 @@ _BASIS = {
 }
 
 
+def _verify_gate(prog, feed, fetch_list):
+    """Static-analysis gate (ISSUE 10): refuse to time a workload whose
+    program fails verification — named findings instead of a mid-bench
+    jit crash deep in a 100-step scan."""
+    from paddle_tpu import analysis
+    res = analysis.verify_program(prog, feed=set(feed),
+                                  fetch_list=fetch_list)
+    if res.errors:
+        raise RuntimeError(
+            "bench: workload program failed static verification:\n"
+            + res.report())
+
+
 def _time_steps(exe, prog, feed, fetch, on_tpu):
+    _verify_gate(prog, feed, [fetch])
     # run_steps puts the whole timing window in ONE device dispatch
     # (lax.scan over the compiled step), so the measurement is the
     # device-side training-loop rate — the axon tunnel's per-dispatch
@@ -574,6 +588,14 @@ def main():
     from paddle_tpu.observability import runlog as obs_runlog
     on_tpu = jax.devices()[0].platform == "tpu"
     flags.set_flag("amp_bf16", True)
+    # static-analysis gate (ISSUE 10): every workload's compile rejects
+    # up front (ProgramVerificationError with named findings, caught by
+    # the per-workload try/except below) instead of dying mid-jit —
+    # the warm-up runs AND the predictor/serving rows ride the
+    # executor's pre-dispatch verifier; _verify_gate covers the timed
+    # scan.  An explicit PTPU_VERIFY_PROGRAM env still wins.
+    if "PTPU_VERIFY_PROGRAM" not in os.environ:
+        flags.set_flag("verify_program", "error")
     metrics_path = os.environ.get("PTPU_BENCH_METRICS_PATH",
                                   "bench_metrics.json")
     # durable run history (observability/runlog.py): one record per

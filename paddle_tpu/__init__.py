@@ -36,6 +36,7 @@ from . import nets
 from . import reader
 from . import dataset
 from . import transpiler
+from . import analysis
 from . import contrib
 from . import debugger
 from . import observability

@@ -1,0 +1,33 @@
+"""Static program verifier & lint plane (ISSUE 10).
+
+Capability parity with two reference subsystems:
+
+  * per-op compile-time InferShape/InferVarType (framework/
+    shape_inference.h; PAPER.md §1 framework-layer contract) ->
+    shape_inference.py + the infer rules registered alongside OpDef
+    (framework/registry.py register_shape_infer);
+  * the inference analysis pass manager (paddle/fluid/inference/
+    analysis/) that validated graphs ahead of the predictor ->
+    passes.py over the Program IR, read-only, emitting structured
+    Finding records (schema ``paddle_tpu.analysis.v1``).
+
+Consumers: the Executor's pre-dispatch gate (verify_program flag), the
+five transpilers' post-conditions (check_transpiled), the lint CLI
+(``python -m paddle_tpu.analysis.lint`` — the static-analysis CI
+gate), Executor.explain()'s analysis section, bench.py's workload
+gate, and debugger.draw_block_graphviz(highlight=...).
+"""
+from .findings import (ERROR, INFO, SCHEMA, WARN, AnalysisResult,
+                       Finding)
+from .infer_rules import InferError
+from .passes import (ProgramVerificationError, check_transpiled,
+                     maybe_check_transpiled, quick_lints, reset,
+                     verify_program)
+from . import traversal
+
+__all__ = [
+    "AnalysisResult", "Finding", "InferError",
+    "ProgramVerificationError", "SCHEMA", "ERROR", "WARN", "INFO",
+    "check_transpiled", "maybe_check_transpiled", "quick_lints",
+    "reset", "traversal", "verify_program",
+]

@@ -1,0 +1,232 @@
+"""Abstract shape/dtype inference over a whole Program.
+
+The reference validated every program at build time through per-op
+InferShape/InferVarType (PAPER.md §1: the framework layer's
+compile-time contract).  This pass reproduces that capability over the
+Program IR: symbolic shapes (-1 = dynamic batch dims) propagate
+op-by-op through the global block, each op resolved by
+
+  1. its registered infer rule (framework/registry.py
+     register_shape_infer — the InferShape analogue), else
+  2. generic abstract evaluation of the op's own lowering under
+     jax.eval_shape (the layer_helper build-time trick: dynamic dims
+     ride through as a prime sentinel), else
+  3. "unknown shape" — unknown ops NEVER crash the pass.
+
+Findings:
+  * ``shape_mismatch`` (error): an infer rule proves the op's inputs
+    incompatible, or the inferred output provably contradicts the
+    shape the program declares for that var;
+  * ``dtype_mismatch`` (warn): inferred vs declared element type
+    disagree;
+  * ``shape_infer_failed`` (error only under ``strict=True`` — the
+    transpiler post-condition mode — else silent): the generic
+    abstract eval of an op with fully-known input shapes raised,
+    which on a transpiled program means a miscompiled consumer.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.dtypes import to_jnp_dtype
+from ..framework.registry import LowerContext, get_op_def, get_shape_infer
+from . import traversal
+from .findings import ERROR, INFO, WARN, AnalysisResult, Finding
+from .infer_rules import InferError
+
+PASS = "shape_inference"
+
+# prime sentinel for -1 dims during abstract eval (survives products
+# through reshape/flatten; layer_helper.py uses the same trick)
+_DYN = 97
+
+# ops whose lowering cannot be abstractly evaluated outside the
+# executor (they read ctx.env / need a mesh axis in scope / have
+# executor-side semantics) AND have no rule: degrade to unknown even
+# under strict mode
+UNEVALUABLE_OPS = frozenset({
+    "while", "conditional_block", "scan", "static_rnn_scan",
+    "increment_loop_counter", "autodiff",
+    "c_allgather", "c_reducescatter", "c_alltoall",
+    "fused_attention", "moe_ffn",
+})
+
+ShapeDtype = Tuple[Optional[tuple], Optional[str]]
+
+
+def _canon_dtype(dt) -> Optional[str]:
+    if dt is None:
+        return None
+    try:
+        return str(np.dtype(dt).name)
+    except TypeError:
+        return str(dt)          # bfloat16 & fp8: np.dtype handles via ml_dtypes
+
+
+def _abstract(shape, dtype):
+    shp = tuple(_DYN if d == -1 else int(d) for d in shape)
+    return jax.ShapeDtypeStruct(shp, to_jnp_dtype(dtype))
+
+
+def _from_abstract(sd, had_dyn: bool) -> ShapeDtype:
+    shape = list(sd.shape)
+    if had_dyn:
+        shape = [-1 if s != 0 and s % _DYN == 0 else s for s in shape]
+    return tuple(shape), _canon_dtype(sd.dtype)
+
+
+def _fully_known(shape) -> bool:
+    return shape is not None and all(int(d) != -1 for d in shape)
+
+
+def _shapes_conflict(a, b) -> bool:
+    """Both known, provably different (rank or a non-dynamic dim)."""
+    if a is None or b is None:
+        return False
+    if len(a) != len(b):
+        # a scalar () vs (1,) style rank drift is common benign
+        # squeeze territory; only call rank conflicts when both sides
+        # have real extent
+        return bool(a) and bool(b)
+    return any(x != -1 and y != -1 and int(x) != int(y)
+               for x, y in zip(a, b))
+
+
+def _generic_eval(opdef, ins_info: Dict[str, List[ShapeDtype]], attrs,
+                  key) -> Optional[Dict[str, List[ShapeDtype]]]:
+    """One jax.eval_shape of the op's lowering over abstract inputs.
+    Returns None when any input is unknown; raises on lowering error."""
+    flat, slots = [], []
+    had_dyn = False
+    for slot, infos in ins_info.items():
+        for shape, dtype in infos:
+            if shape is None or dtype is None:
+                return None
+            had_dyn = had_dyn or any(int(d) == -1 for d in shape)
+            flat.append(_abstract(shape, dtype))
+            slots.append(slot)
+
+    def g(*arrs):
+        d: Dict[str, List] = {}
+        for slot, a in zip(slots, arrs):
+            d.setdefault(slot, []).append(a)
+        ctx = LowerContext(key)
+        return {k: list(v) for k, v in opdef.lower(ctx, d, attrs).items()}
+
+    out_abs = jax.eval_shape(g, *flat)
+    return {slot: [_from_abstract(sd, had_dyn) for sd in sds]
+            for slot, sds in out_abs.items()}
+
+
+class ShapeInferencePass:
+    """Propagate symbolic shapes through the global block, checking
+    inferred against declared.  Sub-blocks keep their declared
+    (build-time) metadata; the lint surface is block 0, where every
+    transpiler rewrites."""
+
+    name = PASS
+
+    def run(self, program, result: AnalysisResult,
+            feed_shapes: Optional[Dict[str, tuple]] = None,
+            strict: bool = False) -> Dict[str, ShapeDtype]:
+        result.passes_run.append(self.name)
+        block = program.global_block()
+        env: Dict[str, ShapeDtype] = {}
+        # seed: feeds (runtime shapes when the executor knows them),
+        # data vars and persistable state from declared metadata
+        for name, var in block.vars.items():
+            shape, dtype = traversal.declared_info(block, name)
+            if var.is_data or var.persistable:
+                env[name] = (shape, dtype)
+        for name, shape in (feed_shapes or {}).items():
+            _, dtype = traversal.declared_info(block, name)
+            env[name] = (tuple(shape), dtype)
+
+        key = jax.random.PRNGKey(0)
+        for i, op in enumerate(block.ops):
+            if op.type in traversal.STRUCTURAL_OPS:
+                continue
+            ins_info = {
+                slot: [env.get(n) or traversal.declared_info(block, n)
+                       for n in names]
+                for slot, names in op.inputs.items()}
+            outs = None
+            rule = get_shape_infer(op.type)
+            try:
+                if rule is not None:
+                    outs = rule(op, ins_info, op.attrs)
+                if outs is None and op.type not in UNEVALUABLE_OPS:
+                    outs = _generic_eval(get_op_def(op.type), ins_info,
+                                         op.attrs, key)
+            except InferError as e:
+                result.add(Finding(
+                    pass_name=self.name, code="shape_mismatch",
+                    severity=ERROR, message=str(e), block_idx=block.idx,
+                    op_index=i, op_type=op.type,
+                    var_names=tuple(traversal.op_input_names(op)),
+                    callsite=getattr(op, "callsite", None)))
+                outs = None
+            except Exception as e:      # generic abstract eval failed
+                known = all(
+                    info is not None and _fully_known(info[0])
+                    for infos in ins_info.values() for info in infos)
+                if strict and known:
+                    result.add(Finding(
+                        pass_name=self.name, code="shape_infer_failed",
+                        severity=ERROR,
+                        message=(f"abstract evaluation of {op.type!r} "
+                                 f"failed on fully-known input shapes: "
+                                 f"{str(e)[:300]}"),
+                        block_idx=block.idx, op_index=i,
+                        op_type=op.type,
+                        var_names=tuple(traversal.op_input_names(op)),
+                        callsite=getattr(op, "callsite", None)))
+                outs = None
+
+            if outs is None:
+                result.unknown_shape_ops.append(op.type)
+            for slot, names in op.outputs.items():
+                inferred = (outs or {}).get(slot, [])
+                for j, n in enumerate(names):
+                    if not n:
+                        continue
+                    inf: ShapeDtype = (inferred[j] if j < len(inferred)
+                                       else (None, None))
+                    decl_shape, decl_dtype = traversal.declared_info(
+                        block, n)
+                    if _shapes_conflict(inf[0], decl_shape):
+                        result.add(Finding(
+                            pass_name=self.name, code="shape_mismatch",
+                            severity=ERROR,
+                            message=(f"op {op.type!r} produces "
+                                     f"{_fmt(inf[0])} for var {n!r} but "
+                                     f"the program declares "
+                                     f"{_fmt(decl_shape)}"),
+                            block_idx=block.idx, op_index=i,
+                            op_type=op.type, var_names=(n,),
+                            callsite=getattr(op, "callsite", None)))
+                    elif (inf[1] is not None and decl_dtype is not None
+                          and _canon_dtype(inf[1])
+                          != _canon_dtype(decl_dtype)):
+                        result.add(Finding(
+                            pass_name=self.name, code="dtype_mismatch",
+                            severity=WARN,
+                            message=(f"op {op.type!r} produces "
+                                     f"{inf[1]} for var {n!r} but the "
+                                     f"program declares {decl_dtype}"),
+                            block_idx=block.idx, op_index=i,
+                            op_type=op.type, var_names=(n,),
+                            callsite=getattr(op, "callsite", None)))
+                    # prefer the propagated view; fall back to declared
+                    env[n] = (inf[0] if inf[0] is not None
+                              else decl_shape,
+                              inf[1] if inf[1] is not None
+                              else decl_dtype)
+        return env
+
+
+def _fmt(shape):
+    return "?" if shape is None else list(shape)

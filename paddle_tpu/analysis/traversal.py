@@ -1,0 +1,82 @@
+"""Program-IR traversal helpers shared by every analysis pass (and by
+contrib/: op_frequence, memory_usage_calc — they walk the SAME iterators
+so they cannot rot against the IR independently again)."""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from ..framework.program import Block, Operator, Program, Variable
+
+# Ops the executor interprets structurally (no dataflow of their own).
+STRUCTURAL_OPS = ("feed", "fetch", "data")
+
+
+def iter_blocks(program: Program) -> Iterator[Block]:
+    yield from program.blocks
+
+
+def iter_ops(program: Program,
+             include_structural: bool = True
+             ) -> Iterator[Tuple[Block, int, Operator]]:
+    """Yield (block, op_index, op) over every block in program order.
+    ``op_index`` is the position in ``block.ops`` INCLUDING structural
+    ops, so it is stable against the debugger's node ids."""
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            if not include_structural and op.type in STRUCTURAL_OPS:
+                continue
+            yield block, i, op
+
+
+def iter_vars(program: Program) -> Iterator[Tuple[Block, Variable]]:
+    for block in program.blocks:
+        for var in block.vars.values():
+            yield block, var
+
+
+def op_input_names(op: Operator) -> List[str]:
+    return [n for ns in op.inputs.values() for n in ns if n]
+
+
+def op_output_names(op: Operator) -> List[str]:
+    return [n for ns in op.outputs.values() for n in ns if n]
+
+
+def consumers(program: Program) -> Dict[str, List[Tuple[int, int]]]:
+    """var name -> [(block_idx, op_index)] of every op reading it,
+    across ALL blocks (a sub-block read keeps a parent var alive)."""
+    out: Dict[str, List[Tuple[int, int]]] = {}
+    for block, i, op in iter_ops(program):
+        for n in op_input_names(op):
+            out.setdefault(n, []).append((block.idx, i))
+    return out
+
+
+def producers(program: Program) -> Dict[str, List[Tuple[int, int]]]:
+    """var name -> [(block_idx, op_index)] of every op writing it."""
+    out: Dict[str, List[Tuple[int, int]]] = {}
+    for block, i, op in iter_ops(program):
+        for n in op_output_names(op):
+            out.setdefault(n, []).append((block.idx, i))
+    return out
+
+
+def adjacent_op_pairs(program: Program) -> Iterator[Tuple[str, str]]:
+    """(prev_type, type) for each adjacent op pair within a block —
+    the contrib op_frequence adjacency walk."""
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            if prev is not None:
+                yield prev, op.type
+            prev = op.type
+
+
+def declared_info(block: Block, name: str):
+    """(shape tuple | None, dtype str | None) of a var as DECLARED in
+    the program, walking ancestor blocks; (None, None) when unknown."""
+    if not block.has_var(name):
+        return None, None
+    v = block.var(name)
+    shape = tuple(int(s) for s in v.shape) if v.shape else None
+    return shape, (str(v.dtype) if v.dtype else None)

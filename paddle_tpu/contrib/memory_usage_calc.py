@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..analysis import traversal
 from ..core.dtypes import convert_dtype
 
 __all__ = ["memory_usage"]
@@ -49,13 +50,14 @@ def memory_usage(program, batch_size: int):
     if batch_size <= 0:
         raise ValueError(f"batch_size must be positive, got {batch_size}")
     persist = acts = 0
-    for block in program.blocks:
-        for var in block.vars.values():
-            b = _var_bytes(var, batch_size)
-            if getattr(var, "persistable", False):
-                persist += b
-            else:
-                acts += b
+    # the shared IR walk (analysis/traversal.py) — one iterator for the
+    # verifier passes AND these contrib estimators
+    for _, var in traversal.iter_vars(program):
+        b = _var_bytes(var, batch_size)
+        if getattr(var, "persistable", False):
+            persist += b
+        else:
+            acts += b
     lo, hi = float(persist), float(persist + acts)
     for scale, unit in _UNITS:
         if hi >= scale:

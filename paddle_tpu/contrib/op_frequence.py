@@ -1,9 +1,16 @@
 """Op-frequency histogram over a Program (ref
-python/paddle/fluid/contrib/op_frequence.py:1)."""
+python/paddle/fluid/contrib/op_frequence.py:1).
+
+Walks the Program IR through the analysis traversal helpers
+(paddle_tpu/analysis/traversal.py) — the same iterators every verifier
+pass uses — so this module can no longer rot against the IR
+independently (it predates the current Block/Operator layout).
+"""
 from __future__ import annotations
 
 from collections import OrderedDict
 
+from ..analysis import traversal
 from ..framework.program import Program
 
 __all__ = ["op_freq_statistic"]
@@ -18,14 +25,11 @@ def op_freq_statistic(program):
                         f"{type(program)}")
     uni: "OrderedDict[str, int]" = OrderedDict()
     adj: "OrderedDict[str, int]" = OrderedDict()
-    for block in program.blocks:
-        prev = None
-        for op in block.ops:
-            uni[op.type] = uni.get(op.type, 0) + 1
-            if prev is not None:
-                key = f"{prev}->{op.type}"
-                adj[key] = adj.get(key, 0) + 1
-            prev = op.type
+    for _, _, op in traversal.iter_ops(program):
+        uni[op.type] = uni.get(op.type, 0) + 1
+    for prev, cur in traversal.adjacent_op_pairs(program):
+        key = f"{prev}->{cur}"
+        adj[key] = adj.get(key, 0) + 1
     uni = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
     adj = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
     return uni, adj

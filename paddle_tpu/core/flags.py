@@ -105,6 +105,18 @@ define_flag("fuse_block", False,
             "(kernels/fused_block.py) on TPU and to an equivalent XLA "
             "composition elsewhere.  Part of the executor's compile "
             "key.")
+define_flag("verify_program", "warn",
+            "Static program verification before the executor compiles "
+            "a (program, feed, fetch) key (paddle_tpu/analysis): "
+            "'off' = pre-PR behavior, byte-identical compile keys and "
+            "outputs; 'warn' (default) = run the O(ops) dataflow + "
+            "hazard lints on every cache miss and emit ONE "
+            "RuntimeWarning per (program, fetch-list) key with "
+            "error-severity findings; 'error' = also run abstract "
+            "shape inference and REJECT the program "
+            "(ProgramVerificationError, nothing compiles, "
+            "executor_compile_total unchanged) — the mode tests/CI "
+            "run.")
 define_flag("prefetch_depth", 0,
             "Trainer input pipeline: number of feed batches the "
             "device-prefetch wrapper (reader.device_prefetch) stages on "

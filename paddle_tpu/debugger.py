@@ -41,12 +41,43 @@ def _dot_escape(s: str) -> str:
 
 def draw_block_graphviz(block, highlights: Optional[Set[str]] = None,
                         path: str = "./temp.dot",
-                        show_backward: bool = False) -> str:
+                        show_backward: bool = False,
+                        highlight=None) -> str:
     """Write the block's dataflow graph as a DOT file (ref
     debugger.py:118).  Ops are boxes, vars are ellipses (Parameters
     shaded), edges follow input/output names; names in `highlights`
-    are drawn red.  Returns the path."""
-    highlights = highlights or set()
+    are drawn red.  Returns the path.
+
+    ``highlight`` renders verifier findings (paddle_tpu/analysis) onto
+    the graph: an AnalysisResult, or an iterable of Finding records /
+    their dicts.  Findings anchored to this block color their op node
+    — dead ops (code ``dead_op``) fill grey, error-severity findings
+    fill red, other warnings fill orange — and every var named by a
+    finding gets a red outline.  Composes with ``highlights``."""
+    highlights = set(highlights or set())
+    finding_ops = {}        # op_index -> style category
+    if highlight is not None:
+        records = getattr(highlight, "findings", highlight)
+        for f in records:
+            d = f if isinstance(f, dict) else f.to_dict()
+            if d.get("block_idx", 0) != block.idx:
+                continue
+            highlights |= set(d.get("var_names") or ())
+            i = d.get("op_index", -1)
+            if i is None or i < 0:
+                continue
+            cat = ("dead" if d.get("code") == "dead_op" else
+                   "error" if d.get("severity") == "error" else "warn")
+            # error beats warn beats dead when findings stack on one op
+            rank = {"error": 0, "warn": 1, "dead": 2}
+            if rank[cat] < rank.get(finding_ops.get(i), 9):
+                finding_ops[i] = cat
+    _OP_STYLE = {
+        "dead": ' style="rounded,filled" fillcolor="grey80"',
+        "warn": ' style="rounded,filled" fillcolor="orange"',
+        "error": ' style="rounded,filled" fillcolor="red" '
+                 'fontcolor="white"',
+    }
 
     def is_grad(name: str) -> bool:
         return "@GRAD" in name
@@ -82,8 +113,9 @@ def draw_block_graphviz(block, highlights: Optional[Set[str]] = None,
             continue
         op_id = f"op_{i}"
         color = ' color="red"' if op.type in highlights else ""
+        style = _OP_STYLE.get(finding_ops.get(i), " style=rounded")
         lines.append(f'  {op_id} [label="{_dot_escape(op.type)}" '
-                     f'shape=box style=rounded{color}];')
+                     f'shape=box{style}{color}];')
         for ns in op.inputs.values():
             for n in ns:
                 lines.append(f"  {var_node(n)} -> {op_id};")

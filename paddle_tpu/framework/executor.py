@@ -1029,6 +1029,8 @@ class Executor:
         self._compiles_by_fetch_key: Dict[tuple, int] = {}
         self._storm_warned: set = set()
         self._last_compiled: Optional[_CompiledProgram] = None
+        # verify_program=warn warns once per (program, fetch-list) key
+        self._verify_warned: set = set()
         # forensics scope: this executor's jit cache (NOT id(self) —
         # ids are reused after GC and would inherit dead keys)
         self._forensics_owner = obs_forensics.new_owner()
@@ -1087,7 +1089,7 @@ class Executor:
             obs_tensorstats.note_mesh_skipped(program)
         compiled, dev_feeds, state, fetch_names = self._prepare(
             program, feed or {}, list(fetch_list or []), scope,
-            collect_stats=want_stats)
+            collect_stats=want_stats, donate_feeds=donate_feeds)
 
         root, counter = self._root_and_counter(program, 1)
         if program.random_seed is None:
@@ -1233,8 +1235,55 @@ class Executor:
             return [self._fetch_numpy(v) for v in ys]
         return ys
 
+    def _verify_before_compile(self, program, dev_feeds, fetch_names,
+                               scope, donate_feeds, seq_names=()):
+        """Pre-dispatch static verification (paddle_tpu/analysis),
+        gated by the verify_program flag.  Runs only on a cache miss,
+        BEFORE anything compiles or any counter moves, so an 'error'
+        -mode rejection leaves executor_compile_total untouched and the
+        user gets findings naming ops/vars/call sites instead of an
+        XLA trace.  'warn' runs the cheap O(ops) lints and warns once
+        per (program, fetch-list); 'error' adds abstract shape
+        inference and raises."""
+        mode = str(flags.get_flag("verify_program"))
+        if mode not in ("warn", "error"):
+            return
+        from .. import analysis
+        # run_steps per-step slabs carry a leading [steps] dim the
+        # program never sees — the compiled scan slices it off before
+        # any op runs, so shape inference must too
+        feed_shapes = {n: (tuple(np.shape(a))[1:] if n in seq_names
+                           else tuple(np.shape(a)))
+                       for n, a in dev_feeds.items()}
+        if mode == "error":
+            result = analysis.verify_program(
+                program, feed=set(dev_feeds), fetch_list=fetch_names,
+                scope=scope, donate_feeds=donate_feeds,
+                feed_shapes=feed_shapes)
+        else:
+            result = analysis.quick_lints(
+                program, feed=set(dev_feeds), fetch_list=fetch_names,
+                scope=scope, donate_feeds=donate_feeds)
+        errs = result.errors
+        if not errs:
+            return
+        if mode == "error":
+            raise analysis.ProgramVerificationError(
+                f"program v{program._version} failed verification "
+                f"(verify_program=error); nothing was compiled.  "
+                f"Findings:\n" + result.report(), result)
+        wkey = (program._uid, tuple(fetch_names))
+        if wkey not in self._verify_warned:
+            self._verify_warned.add(wkey)
+            warnings.warn(
+                f"program verification found {len(errs)} error(s) "
+                f"(verify_program=warn; the compile proceeds):\n"
+                + result.report(max_findings=10),
+                RuntimeWarning, stacklevel=4)
+
     def _prepare(self, program, feed, fetch_list, scope,
-                 extra_feeds=None, collect_stats=False):
+                 extra_feeds=None, collect_stats=False,
+                 donate_feeds=False):
         """Shared run()/run_steps() prologue: materialise feeds, gather
         persistable state, and fetch (or build) the compiled program.
         `extra_feeds` are run_steps' per-step slabs (leading [steps]
@@ -1304,6 +1353,11 @@ class Executor:
             + tuple(v for _, v in flags_sig)
         compiled = self._cache.get(key)
         if compiled is None:
+            # static verification gate: BEFORE any counter/compile so a
+            # rejection leaves the compile metrics untouched
+            self._verify_before_compile(
+                program, dev_feeds, fetch_names, scope, donate_feeds,
+                seq_names=frozenset(extra_feeds or ()))
             if flags.get_flag("executor_log_compiles"):
                 print(f"[executor] compiling program v{program._version} "
                       f"feeds={sorted(dev_feeds)} fetches={fetch_names}")
@@ -1392,8 +1446,31 @@ class Executor:
         for op in compiled._ops:
             op_hist[op.type] = op_hist.get(op.type, 0) + 1
         fkey = (program._uid, tuple(fetch_names))
+        # static-analysis section (paddle_tpu/analysis): full verifier
+        # view of this (program, feed, fetch) triple.  Present ONLY
+        # when verify_program is on, so the flag-off explain() report
+        # stays byte-identical to the pre-analysis executor
+        # (regression-tested, the PR 7 tensor_stats idiom).
+        verify_mode = str(flags.get_flag("verify_program"))
+        analysis_doc = {}
+        if verify_mode in ("warn", "error"):
+            from .. import analysis
+            res = analysis.verify_program(
+                program, feed=set(dev_feeds), fetch_list=fetch_names,
+                scope=scope,
+                feed_shapes={n: tuple(np.shape(a))
+                             for n, a in dev_feeds.items()},
+                # a read-only report: do NOT count these findings into
+                # analysis_findings_total (explain may be polled)
+                record_metrics=False)
+            analysis_doc = {"analysis": {
+                "mode": verify_mode,
+                "counts": res.counts(),
+                "findings": [f.to_dict() for f in res.sorted()[:20]],
+            }}
         return {
             "schema": "paddle_tpu.explain.v1",
+            **analysis_doc,
             "program": {"uid": program._uid,
                         "version": program._version,
                         "ops": len(compiled._ops),

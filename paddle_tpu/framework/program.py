@@ -21,6 +21,8 @@ import contextlib
 import itertools
 import copy
 import json
+import os
+import sys
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -34,6 +36,33 @@ GRAD_SUFFIX = "@GRAD"  # ref framework: core.grad_var_suffix()
 
 def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
+
+
+# the paddle_tpu package directory: frames under it are framework
+# internals, the first frame OUTSIDE it is the user's layer call site
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _user_callsite() -> Optional[str]:
+    """``file:line`` of the first stack frame outside the paddle_tpu
+    package — the layer call that appended the current op.  The
+    verifier (paddle_tpu/analysis) reports it with every finding, the
+    analogue of the reference's op_callstack attribute
+    (framework.py Operator attrs['op_callstack']).  Best-effort: None
+    when every frame is internal (e.g. Program.from_dict round-trips
+    driven by the framework itself)."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:          # shallow stack
+        return None
+    depth = 0
+    while f is not None and depth < 32:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR) and "importlib" not in fn:
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+        depth += 1
+    return None
 
 
 class Variable:
@@ -115,6 +144,10 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        # user-code origin for verifier diagnostics; NOT serialized
+        # (to_dict/clone outputs stay byte-identical to pre-analysis
+        # builds) — deserialized programs report callsite=None
+        self.callsite = _user_callsite()
         get_op_def(type)  # validates the op exists
 
     def input_names(self) -> List[str]:

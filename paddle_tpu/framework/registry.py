@@ -42,6 +42,15 @@ class OpDef:
 
 
 _REGISTRY: Dict[str, OpDef] = {}
+# compile-time shape/dtype inference rules (the reference's per-op
+# InferShape, framework/shape_inference.h), registered alongside the
+# OpDef via register_shape_infer and consumed by paddle_tpu/analysis.
+# A separate map because rules may register before OR after their op
+# (analysis imports lazily; ops register lazily on first get_op_def);
+# get_shape_infer is the single source of truth.  Ops without a rule
+# fall back to abstract evaluation of `lower`; ops where neither
+# applies degrade to "unknown shape", never a crash.
+_INFER_RULES: Dict[str, Callable] = {}
 
 
 def register_op(type: str, stop_gradient: bool = False, doc: str = ""):
@@ -68,6 +77,39 @@ def get_op_def(type: str) -> OpDef:
 def registered_ops() -> List[str]:
     from .. import ops as _ops  # noqa: F401
     return sorted(_REGISTRY)
+
+
+def register_shape_infer(type: str, allow_override: bool = False):
+    """Decorator: register a compile-time shape/dtype inference rule
+    alongside the op's OpDef (the reference's REGISTER_OPERATOR
+    InferShape slot).
+
+    Rule signature (see analysis/shape_inference.py for the driver):
+
+        rule(op, ins, attrs) -> {slot: [(shape, dtype)]} | None
+
+    where ``ins`` maps input slots to [(shape, dtype)] with shape a
+    tuple (-1 = dynamic dim) or None (unknown) and dtype a canonical
+    string or None.  Raise analysis.InferError on a provable mismatch;
+    return None to defer to the generic abstract-eval fallback.
+    """
+    def deco(fn: Callable):
+        if type in _INFER_RULES and not allow_override:
+            raise EnforceNotMet(f"shape-infer rule for {type!r} "
+                                f"registered twice")
+        _INFER_RULES[type] = fn
+        return fn
+    return deco
+
+
+def get_shape_infer(type: str) -> Optional[Callable]:
+    """The registered infer rule for an op type, or None."""
+    return _INFER_RULES.get(type)
+
+
+def unregister_shape_infer(type: str):
+    """Test hook: drop a rule registered by a test (analysis.reset())."""
+    _INFER_RULES.pop(type, None)
 
 
 class LowerContext:

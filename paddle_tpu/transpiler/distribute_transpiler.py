@@ -78,6 +78,14 @@ class DistributeTranspiler:
                 "(strictly faster over ICI)")
         if trainers > 1:
             self._insert_grad_allreduce(axis_name)
+            # post-condition (ISSUE 10): the rewritten program must
+            # re-verify clean — a malformed allreduce splice becomes a
+            # named diagnostic here, not a mid-jit trace.  Covers the
+            # context/expert-parallel transpilers too (they delegate
+            # their collective rewrite to this pass).
+            from .. import analysis
+            analysis.maybe_check_transpiled(
+                self.program, "DistributeTranspiler")
         self._transpiled = True
         return self
 

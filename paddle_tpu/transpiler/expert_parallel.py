@@ -66,6 +66,6 @@ class ExpertParallelTranspiler:
         # mechanics as the data-parallel rewrite
         DistributeTranspiler().transpile(
             trainer_id=0, program=program, trainers=ep_degree,
-            axis_name=axis)
+            axis_name=axis)      # post-condition runs inside transpile
         program._dist_ep_axis = axis
         return assigned

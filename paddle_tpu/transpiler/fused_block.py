@@ -60,6 +60,12 @@ class FuseBlockTranspiler:
         if fused:
             block.ops = new_ops
             program._bump()
+            # post-condition (ISSUE 10): a fusion that severed dataflow
+            # (wrong consumer count, half-collapsed window) re-verifies
+            # here as a named finding instead of a silent miscompile
+            from .. import analysis
+            analysis.maybe_check_transpiled(program,
+                                            "FuseBlockTranspiler")
         return fused
 
     def _try_match(self, block, ops, i, consumers):

@@ -136,3 +136,7 @@ class PipelineTranspiler:
         program._pp_degree = int(pp_degree)
         program._pp_microbatches = M
         program._pp_schedule = schedule
+        # post-condition (ISSUE 10): the spliced allreduce/assign chain
+        # must re-verify clean
+        from .. import analysis
+        analysis.maybe_check_transpiled(program, "PipelineTranspiler")

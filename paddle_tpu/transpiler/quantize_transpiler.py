@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from ..framework.program import Program
+from ..framework.program import Parameter, Program
 from ..framework import unique_name
 
 QUANTIZABLE_OPS = ("mul", "matmul", "conv2d", "conv2d_transpose")
@@ -58,6 +58,11 @@ class QuantizeTranspiler:
             new_ops.append(op)
         block.ops = new_ops
         program._bump()
+        # post-condition (ISSUE 10): the fake-quant splice must
+        # re-verify clean (every rewired consumer reads a produced var)
+        from .. import analysis
+        analysis.maybe_check_transpiled(
+            program, "QuantizeTranspiler.training_transpile")
         return program
 
     def _insert_quant(self, block, new_ops, name: str, is_weight: bool):
@@ -292,5 +297,24 @@ class QuantizeTranspiler:
                 op.attrs["is_test"] = True
             kept.append(op)
         block.ops = kept
+
+        # drop ORPHANED fp32 weight Parameters: their consumers now
+        # read the int8/fp8 twins, so leaving them declared would (a)
+        # stage dead fp32 buffers from the scope every run and (b)
+        # trip the verifier's orphan_param lint on every frozen program.
+        # "used" walks EVERY block (like the orphan lint itself) — a
+        # param read only inside a while/cond sub-block is not orphaned
+        used = {n for b in program.blocks for op in b.ops
+                for ns in list(op.inputs.values())
+                + list(op.outputs.values()) for n in ns}
+        for name in [n for n, v in block.vars.items()
+                     if isinstance(v, Parameter) and n not in used]:
+            del block.vars[name]
         program._bump()
+        # post-condition (ISSUE 10): the frozen program must re-verify
+        # clean — a half-rewritten consumer or a dangling fake-quant op
+        # is a named diagnostic, not a silent miscompile
+        from .. import analysis
+        analysis.maybe_check_transpiled(
+            program, "QuantizeTranspiler.freeze_program")
         return program

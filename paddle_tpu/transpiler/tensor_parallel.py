@@ -172,4 +172,9 @@ class TensorParallelTranspiler:
                 for o in outs:
                     sharded[o] = False
         program._tp_axis = axis
+        # post-condition (ISSUE 10): annotations must leave the program
+        # verifying clean (a bad spec shows up as a shape finding)
+        from .. import analysis
+        analysis.maybe_check_transpiled(program,
+                                        "TensorParallelTranspiler")
         return assigned

@@ -41,6 +41,7 @@ def fresh_programs():
     or trainer-liveness state surviving a case."""
     import paddle_tpu as pt
     import paddle_tpu.serving as serving
+    from paddle_tpu import analysis
     from paddle_tpu.distributed import task_queue
     from paddle_tpu.framework import executor as executor_mod
     from paddle_tpu.observability import costmodel, flight, forensics
@@ -60,6 +61,11 @@ def fresh_programs():
     # file handles must not leak across cases
     tensorstats.reset()
     runlog.reset()
+    # static-analysis plane: drop test-registered infer rules, zero the
+    # findings metric family, and restore the verify_program default so
+    # an error-mode test cannot leak rejection semantics into the next
+    analysis.reset()
+    pt.core.flags.set_flag("verify_program", "warn")
     # forget the previous test's masters (weakset) and zero the
     # queue/membership gauges: a scrape-time refresh_metrics() must not
     # re-publish a dead master's fleet_workers / taskmaster_tasks series

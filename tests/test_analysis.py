@@ -1,0 +1,699 @@
+"""Static program verifier & lint plane (ISSUE 10): the broken-program
+matrix (one deliberately broken program per lint, exact finding
+records), the executor's pre-dispatch gate (error mode rejects BEFORE
+anything compiles), flag-off invariance, zero-findings passes over
+every bundled model + the transpiled variants, the transpiler
+post-conditions, the contrib walkers, the graphviz finding overlay,
+the lint CLI, and the bench gate."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers, models
+from paddle_tpu.analysis import lint as lint_cli
+from paddle_tpu.core import flags
+
+
+def _fc_net():
+    """x[−1,16] → fc relu → fc → mse loss; returns (loss, pred)."""
+    x = layers.data("x", [16], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss, pred
+
+
+def _feed(batch=4):
+    rng = np.random.RandomState(0)
+    return {"x": rng.randn(batch, 16).astype("float32"),
+            "y": rng.randn(batch, 1).astype("float32")}
+
+
+def _find_op(block, op_type, nth=0):
+    hits = [i for i, op in enumerate(block.ops) if op.type == op_type]
+    return hits[nth]
+
+
+# =====================================================================
+# the verifier matrix: one broken program per lint, exact records
+# =====================================================================
+
+def test_matrix_undefined_read():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    i = _find_op(block, "relu")
+    block.ops[i].inputs["X"] = ["never_produced"]
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"], fetch_list=[loss])
+    (f,) = res.by_code("undefined_read")
+    assert f.severity == analysis.ERROR
+    assert f.pass_name == "dataflow"
+    assert f.block_idx == 0 and f.op_index == i
+    assert f.op_type == "relu"
+    assert f.var_names == ("never_produced",)
+    assert "no producer" in f.message
+    # the op was appended by THIS test file — the layer call site rides
+    # the finding
+    assert f.callsite and "test_analysis.py" in f.callsite
+
+
+def test_matrix_shape_mismatch():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    # a transpiler-style miscompile: rewire the second fc's weight to a
+    # parameter with the wrong contraction dim (8 != 16)
+    block.create_parameter("bad_w", [8, 4])
+    i = _find_op(block, "mul", nth=1)
+    block.ops[i].inputs["Y"] = ["bad_w"]
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"], fetch_list=[loss])
+    f = res.by_code("shape_mismatch")[0]
+    assert f.severity == analysis.ERROR
+    assert f.pass_name == "shape_inference"
+    assert f.op_index == i and f.op_type == "mul"
+    assert "bad_w" in f.var_names
+    assert "contraction mismatch" in f.message
+    assert "16" in f.message and "[8, 4]" in f.message
+
+
+def test_matrix_dead_op():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    unused = layers.scale(block.var("x"), scale=3.0)
+    i = _find_op(block, "scale")
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"], fetch_list=[loss])
+    (f,) = res.by_code("dead_op")
+    assert f.severity == analysis.WARN
+    assert f.pass_name == "dataflow"
+    assert f.op_index == i and f.op_type == "scale"
+    assert f.var_names == (unused.name,)
+    assert "nothing reads" in f.message
+    # fetch-aware: fetching the value makes the op live
+    res2 = analysis.verify_program(pt.default_main_program(),
+                                   feed=["x", "y"],
+                                   fetch_list=[loss, unused])
+    assert not res2.by_code("dead_op")
+
+
+def test_matrix_donated_fetch():
+    loss, _ = _fc_net()
+    res = analysis.verify_program(
+        pt.default_main_program(), feed=["x", "y"],
+        fetch_list=["x", loss], donate_feeds=True)
+    (f,) = res.by_code("donated_fetch")
+    assert f.severity == analysis.ERROR
+    assert f.pass_name == "hazards"
+    assert f.var_names == ("x",)
+    assert "donated" in f.message
+    # without donation the same fetch is legal
+    res2 = analysis.verify_program(
+        pt.default_main_program(), feed=["x", "y"],
+        fetch_list=["x", loss], donate_feeds=False)
+    assert not res2.by_code("donated_fetch")
+
+
+def test_matrix_missing_fetch():
+    loss, _ = _fc_net()
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"],
+                                  fetch_list=[loss, "no_such_var"])
+    (f,) = res.by_code("missing_fetch")
+    assert f.severity == analysis.ERROR
+    assert f.var_names == ("no_such_var",)
+    assert f.op_index == -1
+
+
+def test_finding_record_schema():
+    loss, _ = _fc_net()
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"],
+                                  fetch_list=["nope"])
+    d = res.by_code("missing_fetch")[0].to_dict()
+    assert d["schema"] == "paddle_tpu.analysis.v1"
+    assert set(d) >= {"pass", "code", "severity", "message",
+                      "block_idx", "op_index", "op_type", "var_names",
+                      "callsite"}
+    doc = res.to_dict()
+    assert doc["schema"] == "paddle_tpu.analysis.v1"
+    assert doc["counts"]["error"] == 1
+
+
+def test_findings_metric_increments():
+    from paddle_tpu.analysis.findings import _m_findings
+    before = _m_findings.labels(**{"pass": "dataflow",
+                                   "severity": "error"}).value
+    loss, _ = _fc_net()
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"], fetch_list=["nope"])
+    after = _m_findings.labels(**{"pass": "dataflow",
+                                  "severity": "error"}).value
+    assert after == before + len(res.errors) >= before + 1
+    assert ("dataflow", "error") in _m_findings.series()
+
+
+# =====================================================================
+# hazard lints
+# =====================================================================
+
+def test_hazard_unknown_feed_and_unset_shape():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    block.create_var("shapeless")        # no shape recorded
+    res = analysis.verify_program(
+        pt.default_main_program(),
+        feed=["x", "y", "shapeless", "not_a_var"], fetch_list=[loss])
+    (f,) = res.by_code("unknown_feed")
+    assert f.var_names == ("not_a_var",) and f.severity == analysis.WARN
+    (g,) = res.by_code("unset_feed_shape")
+    assert g.var_names == ("shapeless",)
+    assert "feed_shapes" in g.message    # names the forensics cause
+
+
+def test_hazard_lowp_accum():
+    x = layers.data("x", [8, 8], dtype="bfloat16")
+    w = pt.default_main_program().global_block().create_parameter(
+        "w16", [8, 8], dtype="bfloat16")
+    out = layers.matmul(x, pt.default_main_program().global_block()
+                        .var("w16"))
+    s = layers.reduce_sum(out)
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x"], fetch_list=[s])
+    codes = {f.code for f in res.findings}
+    assert "lowp_accum" in codes
+    f = res.by_code("lowp_accum")[0]
+    assert f.severity == analysis.WARN and "amp_bf16" in f.message
+    # the amp plane (f32 accumulation) silences the lint
+    flags.set_flag("amp_bf16", True)
+    try:
+        res2 = analysis.verify_program(pt.default_main_program(),
+                                       feed=["x"], fetch_list=[s])
+        assert not res2.by_code("lowp_accum")
+    finally:
+        flags.set_flag("amp_bf16", False)
+
+
+# =====================================================================
+# executor pre-dispatch gate
+# =====================================================================
+
+def _compile_counters():
+    from paddle_tpu.framework.executor import _m_cache_miss, _m_compile
+    return _m_compile.total(), _m_cache_miss.total()
+
+
+def test_executor_error_mode_rejects_before_any_compile():
+    """The acceptance bar: a broken program is caught BEFORE any jit
+    trace — executor_compile_total unchanged by the rejection."""
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    block.ops[_find_op(block, "relu")].inputs["X"] = ["never_produced"]
+    exe = pt.Executor(pt.CPUPlace())
+    flags.set_flag("verify_program", "error")
+    c0 = _compile_counters()
+    with pytest.raises(analysis.ProgramVerificationError) as ei:
+        exe.run(pt.default_main_program(), feed=_feed(),
+                fetch_list=[loss])
+    assert _compile_counters() == c0        # nothing compiled
+    assert exe._cache == {}                 # nothing cached
+    assert "undefined_read" in str(ei.value)
+    assert ei.value.result.errors
+
+
+def test_executor_error_mode_rejects_run_steps():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    block.ops[_find_op(block, "relu")].inputs["X"] = ["never_produced"]
+    exe = pt.Executor(pt.CPUPlace())
+    flags.set_flag("verify_program", "error")
+    c0 = _compile_counters()
+    feed = {k: np.stack([v, v]) for k, v in _feed().items()}
+    with pytest.raises(analysis.ProgramVerificationError):
+        exe.run_steps(pt.default_main_program(), feed=feed,
+                      fetch_list=[loss], steps=2,
+                      per_step_feeds=("x", "y"))
+    assert _compile_counters() == c0
+
+
+def test_executor_error_mode_accepts_valid_run_steps_slabs():
+    """Regression (review round): per-step feed slabs carry a leading
+    [steps] dim the program never sees — error-mode verification must
+    strip it, not reject the valid program as a shape mismatch."""
+    loss, _ = _fc_net()
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    flags.set_flag("verify_program", "error")
+    f = _feed()
+    slabs = {k: np.stack([v, v, v]) for k, v in f.items()}
+    outs = exe.run_steps(pt.default_main_program(), feed=slabs,
+                         fetch_list=[loss], steps=3,
+                         per_step_feeds=("x", "y"))
+    assert np.isfinite(outs[0]).all() and outs[0].shape[0] == 3
+
+
+def test_executor_error_mode_catches_shape_mismatch_statically():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    block.create_parameter("bad_w", [8, 4])
+    block.ops[_find_op(block, "mul", nth=1)].inputs["Y"] = ["bad_w"]
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    flags.set_flag("verify_program", "error")
+    c0 = _compile_counters()
+    with pytest.raises(analysis.ProgramVerificationError) as ei:
+        exe.run(pt.default_main_program(), feed=_feed(),
+                fetch_list=[loss])
+    assert _compile_counters() == c0
+    assert "contraction mismatch" in str(ei.value)
+
+
+def test_executor_warn_mode_warns_once_and_proceeds_to_trace():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    block.ops[_find_op(block, "relu")].inputs["X"] = ["never_produced"]
+    exe = pt.Executor(pt.CPUPlace())
+    assert flags.get_flag("verify_program") == "warn"
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with pytest.raises(Exception, match="not materialised"):
+            exe.run(pt.default_main_program(), feed=_feed(),
+                    fetch_list=[loss])
+    msgs = [str(x.message) for x in w
+            if "program verification" in str(x.message)]
+    assert len(msgs) == 1 and "undefined_read" in msgs[0]
+
+
+def test_executor_clean_program_emits_no_warning():
+    loss, _ = _fc_net()
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        exe.run(pt.default_startup_program())
+        out, = exe.run(pt.default_main_program(), feed=_feed(),
+                       fetch_list=[loss])
+    assert np.isfinite(out).all()
+
+
+# =====================================================================
+# flag-off invariance (PR 7 idiom)
+# =====================================================================
+
+def test_verify_off_is_byte_identical():
+    """verify_program=off: compile keys, outputs and explain() match
+    the warn-mode (default) executor bit for bit — verification is a
+    pure observer; 'off' merely skips it."""
+    import json
+    feed = _feed()
+
+    def run_mode(mode):
+        pt.reset_default_programs()
+        from paddle_tpu.framework import executor as em
+        em._global_scope = em.Scope()
+        flags.set_flag("verify_program", mode)
+        loss, _ = _fc_net()
+        pt.optimizer.SGD(0.1).minimize(loss)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(pt.default_startup_program())
+        outs = [exe.run(pt.default_main_program(), feed=feed,
+                        fetch_list=[loss])[0] for _ in range(3)]
+        keys = sorted(k[2:] for k in exe._cache)   # drop uid/version
+        rep = exe.explain(pt.default_main_program(), feed=feed,
+                          fetch_list=[loss])
+        rep["program"]["uid"] = 0      # fresh per run; not behavior
+        if rep.get("cost"):            # cost label embeds the uid too
+            rep["cost"]["label"] = ""
+        return outs, keys, rep
+
+    outs_off, keys_off, rep_off = run_mode("off")
+    outs_warn, keys_warn, rep_warn = run_mode("warn")
+    for a, b in zip(outs_off, outs_warn):
+        np.testing.assert_array_equal(a, b)        # bitwise
+    assert keys_off == keys_warn
+    assert "analysis" not in rep_off               # pre-PR shape
+    assert "analysis" in rep_warn
+    rep_warn.pop("analysis")
+    assert json.dumps(rep_off, sort_keys=True, default=str) \
+        == json.dumps(rep_warn, sort_keys=True, default=str)
+
+
+def test_explain_analysis_section():
+    loss, _ = _fc_net()
+    # an unfetched dead chain shows up in the explain section's counts
+    layers.scale(pt.default_main_program().global_block().var("x"),
+                 scale=2.0)
+    exe = pt.Executor(pt.CPUPlace())
+    rep = exe.explain(pt.default_main_program(), feed=_feed(),
+                      fetch_list=[loss])
+    sec = rep["analysis"]
+    assert sec["mode"] == "warn"
+    assert sec["counts"].get("warn", 0) >= 1
+    codes = {f["code"] for f in sec["findings"]}
+    assert "dead_op" in codes
+
+
+# =====================================================================
+# transpiler post-conditions
+# =====================================================================
+
+def test_check_transpiled_raises_named_diagnostic():
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    block.ops[_find_op(block, "relu")].inputs["X"] = ["never_produced"]
+    with pytest.raises(analysis.ProgramVerificationError,
+                       match="BrokenTranspiler"):
+        analysis.check_transpiled(pt.default_main_program(),
+                                  "BrokenTranspiler")
+    # the escape hatch: off disables post-conditions end to end
+    flags.set_flag("verify_program", "off")
+    assert analysis.maybe_check_transpiled(
+        pt.default_main_program(), "BrokenTranspiler") is None
+
+
+def test_fuse_transpiler_postcondition_catches_miscompile(monkeypatch):
+    """Sabotage FuseBlockTranspiler so its replacement op reads a var
+    it just deleted: the post-condition must reject the rewrite."""
+    from paddle_tpu.transpiler.fused_block import FuseBlockTranspiler
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=100, tgt_vocab_size=100, max_length=64,
+        n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    feeds, cost, _ = models.transformer.build_lm_net(cfg, seq_len=16)
+    orig = FuseBlockTranspiler._try_match
+
+    def sabotage(self, block, ops, i, consumers):
+        repl, width = orig(self, block, ops, i, consumers)
+        if repl is not None:
+            repl.inputs["X"] = [repl.inputs["X"][0] + ".GONE"]
+        return repl, width
+
+    monkeypatch.setattr(FuseBlockTranspiler, "_try_match", sabotage)
+    with pytest.raises(analysis.ProgramVerificationError,
+                       match="FuseBlockTranspiler"):
+        FuseBlockTranspiler().transpile(pt.default_main_program())
+
+
+# =====================================================================
+# zero-findings passes: every bundled model + transpiled variants
+# =====================================================================
+
+@pytest.mark.parametrize("name", ["resnet", "transformer_lm", "bert",
+                                  "deepfm", "nmt", "stacked_lstm"])
+def test_bundled_model_verifies_clean(name):
+    build = lint_cli.model_builders()[name]
+    with pt.program_guard(pt.Program(), pt.Program()):
+        feeds, fetches = build()
+        main = pt.default_main_program()
+        res = analysis.verify_program(
+            main, feed=[v.name for v in feeds], fetch_list=fetches)
+        sres = analysis.verify_program(pt.default_startup_program())
+    assert res.findings == [], res.report()
+    assert sres.findings == [], sres.report()
+
+
+def _trained_qat(quantize_dtype="int8"):
+    x = layers.data("x", [16], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    from paddle_tpu.transpiler import QuantizeTranspiler
+    qt = QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max")
+    qt.training_transpile(pt.default_main_program(),
+                          pt.default_startup_program())
+    infer = pt.default_main_program().clone(for_test=True)
+    pt.optimizer.SGD(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    for _ in range(4):
+        exe.run(pt.default_main_program(), feed=_feed(64),
+                fetch_list=[loss])
+    frozen = qt.freeze_program(infer, scope=exe.scope,
+                               quantize_dtype=quantize_dtype)
+    return frozen, pred, loss
+
+
+def test_quantized_variants_verify_clean():
+    frozen, pred, loss = _trained_qat()
+    # the QAT train program (verified in-transpile too) and the frozen
+    # int8 program both lint clean — zero error findings
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"], fetch_list=[loss])
+    assert res.errors == [], res.report()
+    # the clone carries the loss chain too — fetch both heads so the
+    # zero-findings bar is meaningful (nothing is dead, no orphans)
+    fres = analysis.verify_program(frozen, feed=["x", "y"],
+                                   fetch_list=[pred.name, loss.name])
+    assert fres.findings == [], fres.report()
+    # the frozen program carries no orphaned fp32 weights (they are
+    # deleted with their fake-quant producers)
+    kinds = {op.type for op in frozen.global_block().ops}
+    assert "quantized_matmul" in kinds
+
+
+def test_freeze_keeps_subblock_only_params():
+    """Regression (review round): freeze_program's orphan-Parameter
+    sweep must count sub-block reads — a param consumed only inside a
+    while/cond sub-block is NOT orphaned."""
+    x = layers.data("x", [16], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    main = pt.default_main_program()
+    # a parameter read ONLY by an op in a nested block
+    main.global_block().create_parameter("sub_only_w", [4, 4])
+    sub = main.create_block()
+    sub.append_op("scale", {"X": ["sub_only_w"]},
+                  {"Out": ["sub_scaled"]}, {"scale": 2.0})
+    main.rollback()
+    from paddle_tpu.transpiler import QuantizeTranspiler
+    qt = QuantizeTranspiler(
+        activation_quantize_type="moving_average_abs_max")
+    qt.training_transpile(main, pt.default_startup_program())
+    infer = main.clone(for_test=True)
+    pt.optimizer.SGD(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.scope.set_var("sub_only_w", np.eye(4, dtype="float32"))
+    for _ in range(4):
+        exe.run(main, feed=_feed(64), fetch_list=[loss])
+    frozen = qt.freeze_program(infer, scope=exe.scope)
+    assert "sub_only_w" in frozen.global_block().vars
+
+
+def test_fused_and_tp_and_pp_variants_verify_clean():
+    from paddle_tpu.transpiler import (PipelineTranspiler,
+                                       TensorParallelTranspiler)
+    from paddle_tpu.transpiler.fused_block import FuseBlockTranspiler
+
+    # fused-block variant (post-condition already ran inside transpile)
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=100, tgt_vocab_size=100, max_length=64,
+        n_layer=2, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    feeds, cost, _ = models.transformer.build_lm_net(cfg, seq_len=16)
+    pt.optimizer.SGD(0.1).minimize(cost)
+    assert FuseBlockTranspiler().transpile(
+        pt.default_main_program()) == 2
+    res = analysis.verify_program(
+        pt.default_main_program(), feed=[v.name for v in feeds],
+        fetch_list=[cost])
+    assert res.errors == [], res.report()
+
+    # tensor-parallel variant (annotations only; unfused attention)
+    pt.reset_default_programs()
+    feeds, cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=16, fused_attention=False)
+    pt.optimizer.SGD(0.1).minimize(cost)
+    TensorParallelTranspiler().transpile(pt.default_main_program(),
+                                         num_partitions=2)
+    res = analysis.verify_program(
+        pt.default_main_program(), feed=[v.name for v in feeds],
+        fetch_list=[cost])
+    assert res.errors == [], res.report()
+
+    # pipeline variant (boundary markers + spliced allreduce/assign)
+    pt.reset_default_programs()
+    feeds, cost, _ = models.transformer.build_lm_net(
+        cfg, seq_len=16, pp_stages=2)
+    pt.optimizer.SGD(0.1).minimize(cost)
+    PipelineTranspiler().transpile(pt.default_main_program(),
+                                   pp_degree=2)
+    res = analysis.verify_program(
+        pt.default_main_program(), feed=[v.name for v in feeds],
+        fetch_list=[cost])
+    assert res.errors == [], res.report()
+
+
+# =====================================================================
+# shape-inference internals
+# =====================================================================
+
+def test_infer_rule_registry_alongside_opdef():
+    from paddle_tpu.framework import registry
+    assert registry.get_shape_infer("mul") is not None
+    assert registry.get_op_def("mul") is not None
+    # a test-registered rule is visible, then cleaned by conftest's
+    # analysis.reset()
+    @registry.register_shape_infer("relu")
+    def _rule(op, ins, attrs):
+        return None
+    assert registry.get_shape_infer("relu") is _rule
+    analysis.reset()
+    assert registry.get_shape_infer("relu") is None
+
+
+def test_unknown_op_degrades_to_unknown_shape():
+    """An op the pass cannot abstract-eval must not crash verification
+    (the 'unknown ops degrade, never a crash' contract)."""
+    with pt.program_guard(pt.Program(), pt.Program()):
+        feeds, sent, scores = \
+            models.machine_translation.build_decode_net(
+                src_vocab=50, tgt_vocab=50, src_len=8)
+        res = analysis.verify_program(
+            pt.default_main_program(),
+            feed=[v.name for v in feeds], fetch_list=[sent, scores])
+    assert res.errors == [], res.report()
+    assert "static_rnn_scan" in res.unknown_shape_ops
+
+
+def test_matmul_infer_rule_transpose_and_batch():
+    from paddle_tpu.analysis.infer_rules import (InferError,
+                                                 _infer_matmul)
+
+    class _Op:
+        inputs = {"X": ["a"], "Y": ["b"]}
+    out = _infer_matmul(_Op(), {"X": [((3, 4, 5), "float32")],
+                                "Y": [((3, 5, 7), "float32")]}, {})
+    assert out["Out"][0][0] == (3, 4, 7)
+    out = _infer_matmul(_Op(), {"X": [((4, 5), "float32")],
+                                "Y": [((7, 5), "float32")]},
+                        {"transpose_Y": True})
+    assert out["Out"][0][0] == (4, 7)
+    with pytest.raises(InferError, match="contraction mismatch"):
+        _infer_matmul(_Op(), {"X": [((4, 5), "float32")],
+                              "Y": [((6, 7), "float32")]}, {})
+    # dynamic dims are wildcards, not mismatches
+    out = _infer_matmul(_Op(), {"X": [((-1, 5), "float32")],
+                                "Y": [((5, 7), "float32")]}, {})
+    assert out["Out"][0][0] == (-1, 7)
+    # batch dims broadcast numpy-style (review-round regression):
+    # a size-1 batch dim defers to the other side
+    out = _infer_matmul(_Op(), {"X": [((1, 4, 8), "float32")],
+                                "Y": [((5, 8, 2), "float32")]}, {})
+    assert out["Out"][0][0] == (5, 4, 2)
+    out = _infer_matmul(_Op(), {"X": [((5, 4, 8), "float32")],
+                                "Y": [((8, 2), "float32")]}, {})
+    assert out["Out"][0][0] == (5, 4, 2)
+
+
+def test_explain_is_a_pure_observer_of_the_findings_metric():
+    """Regression (review round): polling explain() must not inflate
+    analysis_findings_total — the counter tracks verifier events, not
+    report reads."""
+    from paddle_tpu.analysis.findings import _m_findings
+    loss, _ = _fc_net()
+    layers.scale(pt.default_main_program().global_block().var("x"),
+                 scale=2.0)          # a warn finding to tempt the counter
+    exe = pt.Executor(pt.CPUPlace())
+    rep = exe.explain(pt.default_main_program(), feed=_feed(),
+                      fetch_list=[loss])
+    assert rep["analysis"]["counts"].get("warn", 0) >= 1
+    before = _m_findings.total()
+    for _ in range(3):
+        exe.explain(pt.default_main_program(), feed=_feed(),
+                    fetch_list=[loss])
+    assert _m_findings.total() == before
+
+
+# =====================================================================
+# satellites: contrib walkers, graphviz overlay, CLI, bench gate
+# =====================================================================
+
+def test_contrib_op_frequence_smoke():
+    from paddle_tpu.contrib.op_frequence import op_freq_statistic
+    loss, _ = _fc_net()
+    uni, adj = op_freq_statistic(pt.default_main_program())
+    assert uni["mul"] == 2
+    assert uni["elementwise_add"] == 2
+    assert adj["mul->elementwise_add"] == 2
+    # sorted most-frequent-first
+    assert list(uni.values()) == sorted(uni.values(), reverse=True)
+    with pytest.raises(TypeError, match="should be Program"):
+        op_freq_statistic(pt.default_main_program().global_block())
+
+
+def test_contrib_memory_usage_smoke():
+    from paddle_tpu.contrib.memory_usage_calc import memory_usage
+    loss, _ = _fc_net()
+    lo1, hi1, unit1 = memory_usage(pt.default_main_program(), 16)
+    assert 0 < lo1 <= hi1 and unit1 in ("B", "KB", "MB", "GB")
+    lo2, hi2, _ = memory_usage(pt.default_main_program(), 256)
+    assert hi2 > hi1          # activations scale with the batch dim
+    assert lo2 == lo1         # the persistable floor does not
+    with pytest.raises(ValueError, match="positive"):
+        memory_usage(pt.default_main_program(), 0)
+
+
+def test_graphviz_highlight_renders_findings(tmp_path):
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    # one dead op + one broken op
+    dead = layers.scale(block.var("x"), scale=3.0)
+    i_relu = _find_op(block, "relu")
+    block.ops[i_relu].inputs["X"] = ["never_produced"]
+    res = analysis.verify_program(pt.default_main_program(),
+                                  feed=["x", "y"], fetch_list=[loss])
+    path = str(tmp_path / "g.dot")
+    dot = open(pt.debugger.draw_block_graphviz(
+        block, highlight=res, path=path)).read()
+    assert f'op_{_find_op(block, "scale")} ' in dot
+    assert 'fillcolor="grey80"' in dot          # dead op greyed
+    assert 'fillcolor="red"' in dot             # error op red
+    assert dot.count("digraph") == 1
+    # regression: without highlight the emission is the pre-PR shape
+    dot_plain = open(pt.debugger.draw_block_graphviz(
+        block, path=str(tmp_path / "p.dot"))).read()
+    assert "fillcolor=\"grey80\"" not in dot_plain
+    assert "style=rounded]" in dot_plain
+
+
+def test_analysis_cli_all_models(capsys):
+    """Tier-1 CI gate: every bundled model builds and verifies with
+    zero errors through the lint CLI."""
+    rc = lint_cli.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 errors" in out.splitlines()[-1]
+    # every registered model ran
+    for name in lint_cli.model_builders():
+        assert f"[lint] {name}:" in out
+
+
+def test_analysis_cli_contract(capsys):
+    assert lint_cli.main(["--list"]) == 0
+    assert "resnet" in capsys.readouterr().out
+    assert lint_cli.main(["--models", "nope"]) == 2
+    # the gate CATCHES a broken program: exit 1
+    assert lint_cli.main(["--self-test"]) == 1
+
+
+def test_bench_refuses_unverified_workload():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..",
+                              "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    loss, _ = _fc_net()
+    block = pt.default_main_program().global_block()
+    block.ops[_find_op(block, "relu")].inputs["X"] = ["never_produced"]
+    with pytest.raises(RuntimeError,
+                       match="failed static verification"):
+        bench._verify_gate(pt.default_main_program(), {"x": 0, "y": 0},
+                           [loss])
