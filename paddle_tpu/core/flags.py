@@ -251,7 +251,17 @@ define_flag("serving_max_new_tokens", 32,
 define_flag("serving_p99_budget_ms", 0.0,
             "Serving SLO bar: loadgen (serving/loadgen.py) fails its "
             "run when p99 per-token latency exceeds this many "
-            "milliseconds.  0 = report only, no assertion.")
+            "milliseconds, and a request whose TTFT or per-token "
+            "latency breaches it auto-captures an X-ray bundle keyed "
+            "by its trace id (observability/tracectx.py).  0 = report "
+            "only, no assertion, no captures.")
+define_flag("serving_lazy_bucket_compile", False,
+            "Allow the decode engine to compile a missing prompt "
+            "bucket ON the request path (recorded as a compile span "
+            "inside the triggering request's X-ray timeline and as "
+            "serving_compiles_total{kind=prefill_lazy}).  Off = the "
+            "PR 8 AOT discipline: an unprepared bucket is an error, "
+            "never a silent compile.")
 
 # --- elastic fleet (distributed/: task_queue membership, supervisor) -------
 define_flag("worker_timeout", 6.0,

@@ -648,67 +648,72 @@ class TaskMaster:
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         master: TaskMaster = self.server.master   # type: ignore
+        from ..observability import tracectx as obs_tracectx
         for line in self.rfile:
             try:
                 req = json.loads(line)
                 method = req["method"]
-                if method == "get_task":
-                    t = master.get_task(worker=req.get("worker"))
-                    resp = {"ok": True,
-                            "task": t.__dict__ if t else None,
-                            "complete": master.complete}
-                elif method == "task_finished":
-                    st = master.task_finished(req["task_id"],
-                                              lease=req.get("lease"),
-                                              worker=req.get("worker"))
-                    resp = {"ok": st == "ok", "status": st}
-                elif method == "task_failed":
-                    st = master.task_failed(req["task_id"],
-                                            lease=req.get("lease"))
-                    resp = {"ok": st == "ok", "status": st}
-                elif method == "register_worker":
-                    resp = {"ok": True,
-                            **master.register_worker(
-                                req["rank"], host=req.get("host"),
-                                pid=req.get("pid"))}
-                elif method == "heartbeat":
-                    st = master.heartbeat(req["rank"], req.get("lease"))
-                    resp = {"ok": st == "ok", "status": st}
-                elif method == "goodbye":
-                    st = master.goodbye(req["rank"], req.get("lease"))
-                    resp = {"ok": st == "ok", "status": st}
-                elif method == "set_dataset":
-                    master.set_dataset(req["shards"],
-                                       req.get("shards_per_task", 1))
-                    resp = {"ok": True}
-                elif method == "stats":
-                    resp = {"ok": True, "stats": master.stats()}
-                elif method == "ledger":
-                    resp = {"ok": True,
-                            "ledger": master.ledger_entries()}
-                elif method in ("report_metrics", "report_events"):
-                    # fleet telemetry verbs (observability/fleet.py):
-                    # workers push snapshots/spans to the aggregator
-                    # attached via serve_master(aggregator=...)
-                    agg = getattr(self.server, "aggregator", None)
-                    if agg is None:
-                        resp = {"ok": False,
-                                "error": "no FleetAggregator attached "
-                                         "to this master"}
-                    else:
-                        ack = agg.ingest(method,
-                                         req.get("payload") or {})
-                        resp = {"ok": True, **(ack or {})}
-                else:
-                    resp = {"ok": False, "error": f"bad method {method}"}
-                # every reply names the master generation: a client that
-                # sees it change KNOWS its leases are void and re-fetches
-                # instead of acking into the new world
+                # the caller's X-ray context rides the RPC: master-side
+                # spans/exemplars recorded while handling this verb
+                # attribute to the originating request/step
+                trace_ctx = obs_tracectx.parse_traceparent(
+                    req.get("traceparent"))
+                with obs_tracectx.activate(trace_ctx):
+                    resp = self._dispatch(master, method, req)
+                # every reply names the master generation: a client
+                # that sees it change KNOWS its leases are void and
+                # re-fetches instead of acking into the new world
                 resp.setdefault("gen", master.generation)
             except Exception as e:   # keep the server alive
                 resp = {"ok": False, "error": str(e)}
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
+
+    def _dispatch(self, master, method, req) -> dict:
+        if method == "get_task":
+            t = master.get_task(worker=req.get("worker"))
+            return {"ok": True, "task": t.__dict__ if t else None,
+                    "complete": master.complete}
+        if method == "task_finished":
+            st = master.task_finished(req["task_id"],
+                                      lease=req.get("lease"),
+                                      worker=req.get("worker"))
+            return {"ok": st == "ok", "status": st}
+        if method == "task_failed":
+            st = master.task_failed(req["task_id"],
+                                    lease=req.get("lease"))
+            return {"ok": st == "ok", "status": st}
+        if method == "register_worker":
+            return {"ok": True,
+                    **master.register_worker(req["rank"],
+                                             host=req.get("host"),
+                                             pid=req.get("pid"))}
+        if method == "heartbeat":
+            st = master.heartbeat(req["rank"], req.get("lease"))
+            return {"ok": st == "ok", "status": st}
+        if method == "goodbye":
+            st = master.goodbye(req["rank"], req.get("lease"))
+            return {"ok": st == "ok", "status": st}
+        if method == "set_dataset":
+            master.set_dataset(req["shards"],
+                               req.get("shards_per_task", 1))
+            return {"ok": True}
+        if method == "stats":
+            return {"ok": True, "stats": master.stats()}
+        if method == "ledger":
+            return {"ok": True, "ledger": master.ledger_entries()}
+        if method in ("report_metrics", "report_events"):
+            # fleet telemetry verbs (observability/fleet.py): workers
+            # push snapshots/spans to the aggregator attached via
+            # serve_master(aggregator=...)
+            agg = getattr(self.server, "aggregator", None)
+            if agg is None:
+                return {"ok": False,
+                        "error": "no FleetAggregator attached to this "
+                                 "master"}
+            ack = agg.ingest(method, req.get("payload") or {})
+            return {"ok": True, **(ack or {})}
+        return {"ok": False, "error": f"bad method {method}"}
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -914,6 +919,14 @@ class TaskMasterClient:
         self.master_generation = gen
 
     def _call(self, **req) -> dict:
+        # request X-ray: RPC payloads carry the ambient trace context
+        # so master-side handling (aggregator ingest, lease ops) is
+        # attributable to the request/step that caused it
+        from ..observability import tracectx as obs_tracectx
+        ctx = obs_tracectx.current()
+        if ctx is not None:
+            req.setdefault("traceparent", ctx.traceparent())
+
         def attempt():
             self._chaos.trigger("task_queue.rpc", exc=ConnectionError)
             if self._f is None:

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import flags
+from ..core import flags, jax_compat
 from ..core.dtypes import to_jnp_dtype
 from ..core.enforce import EnforceNotMet, check_arg
 from ..core.place import Place, default_place
@@ -46,6 +46,7 @@ from ..observability import forensics as obs_forensics
 from ..observability import metrics as obs_metrics
 from ..observability import tensorstats as obs_tensorstats
 from ..observability import trace as obs_trace
+from ..observability import tracectx as obs_tracectx
 from ..resilience import chaos
 from .program import Program, Variable, default_main_program
 from .registry import LowerContext, get_op_def
@@ -99,7 +100,7 @@ def _profiling_ops() -> bool:
 def _pp_micro_split(env, data_names, M, stage_ops, axis):
     """Shared pipeline prologue: stage-count check + reshape every data
     feed to [M, B/M, ...] microbatch slabs (popped out of env)."""
-    Pn = jax.lax.axis_size(axis)
+    Pn = jax_compat.axis_size(axis)
     check_arg(len(stage_ops) == Pn,
               f"program has {len(stage_ops)} pipeline stages but mesh "
               f"axis {axis!r} has {Pn} devices")
@@ -588,6 +589,17 @@ class _CompiledProgram:
         self._feed_sharding_fn = None
         spmd_axis = getattr(program, "_dist_spmd_axis", None)
         pp_axis = getattr(program, "_dist_pp_axis", None)
+        # implicit-SPMD plane only (jit + out_shardings, no shard_map):
+        # random-generation ops constrain their draw to REPLICATED
+        # before GSPMD reshards it, because the legacy threefry lowering
+        # produces DIFFERENT values when the partitioner splits the
+        # generation (a ("model", None)-sharded Parameter's
+        # uniform_random init would diverge from the single-device run
+        # and break every single-vs-mesh parity contract).  Inside
+        # shard_map the axes are manual and per-device draws are
+        # deliberate — no constraint there.
+        self._implicit_mesh = mesh if (spmd_axis is None
+                                       and pp_axis is None) else None
         if (spmd_axis is not None or pp_axis is not None) and mesh is None:
             raise EnforceNotMet(
                 f"this program was rewritten by DistributeTranspiler/"
@@ -603,10 +615,6 @@ class _CompiledProgram:
             # transformation), so run the step under shard_map with the
             # axes in scope instead of leaving collective insertion to
             # XLA sharding propagation.
-            try:
-                from jax import shard_map        # jax >= 0.8
-            except ImportError:
-                from jax.experimental.shard_map import shard_map
             P = jax.sharding.PartitionSpec
             for ax in (spmd_axis, pp_axis):
                 if ax is not None and ax not in mesh.shape:
@@ -672,10 +680,8 @@ class _CompiledProgram:
                 out_specs=([P(fetch_axis)] * len(self.fetch_names),
                            {n: state_spec(n)
                             for n in self.out_state_names}))
-            try:        # jax >= 0.8 renamed check_rep -> check_vma
-                sm = shard_map(spmd_step, check_vma=False, **sm_kwargs)
-            except TypeError:
-                sm = shard_map(spmd_step, check_rep=False, **sm_kwargs)
+            sm = jax_compat.shard_map(spmd_step, check_rep=False,
+                                      **sm_kwargs)
             self._step_fn = sm
             self._jit_kwargs = jit_kwargs
             self._jitted = jax.jit(sm, **jit_kwargs)
@@ -916,6 +922,9 @@ class _CompiledProgram:
         ctx.program = self.program
         ctx.env = env
         ctx.place = self.place
+        # see _implicit_mesh above: ops/creation.py random ops consult
+        # this to pin their generation replicated under implicit SPMD
+        ctx.spmd_mesh = self._implicit_mesh
         # context-parallel plane: sequence-aware ops (fused_attention)
         # read this to run their ring variant with the axis in scope
         ctx.cp_axis = getattr(self.program, "_dist_cp_axis", None)
@@ -1131,6 +1140,17 @@ class Executor:
                            tid=obs_trace.EXECUTOR_TID, cat="executor",
                            args={"mode": mode,
                                  "fetches": len(fetch_names)})
+        xctx = obs_tracectx.current()
+        if xctx is not None:
+            # request X-ray: the dispatch as a child span of whatever
+            # request/step is ambient (trainer per-step traces, a
+            # predictor request) — compile misses above already left
+            # their marker via forensics
+            obs_tracectx.record_span(
+                "executor.step", xctx.trace_id,
+                obs_tracectx.new_span_id(), xctx.span_id,
+                time.time() - dt, t0, dt, kind="dispatch",
+                attrs={"mode": mode, "program": program._uid})
         obs_flight.record("span", "executor.step", mode=mode, dur=dt)
 
         for n, v in new_state.items():

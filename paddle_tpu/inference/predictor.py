@@ -33,6 +33,7 @@ from ..core.enforce import check_arg
 from ..core.place import CPUPlace, Place, TPUPlace, default_place
 from ..framework.executor import LowerContext, Scope, run_ops_in_env
 from ..framework.program import Program
+from ..observability import tracectx as obs_tracectx
 
 
 class NativeConfig:
@@ -133,8 +134,13 @@ class Predictor:
         self._check_feed_names(feeds)
         sig = self._sig(feeds)
         if sig not in self._compiled:
-            lowered = jax.jit(self._fn()).lower(self.state, feeds)
-            self._compiled[sig] = lowered.compile()
+            # X-ray: a request whose signature missed the AOT grid
+            # compiles HERE — the span lands in that request's own
+            # timeline, naming the signature that forced it
+            with obs_tracectx.span("predictor.compile", kind="compile",
+                                   signature=str(sig)[:200]):
+                lowered = jax.jit(self._fn()).lower(self.state, feeds)
+                self._compiled[sig] = lowered.compile()
         return self._compiled[sig]
 
     def prepare_buckets(self, example_feeds: Dict[str, np.ndarray],
@@ -193,7 +199,8 @@ class Predictor:
         compiled = self._compiled.get(self._sig(feeds))
         if compiled is None:
             compiled = self.prepare(feeds)
-        outs = compiled(self.state, feeds)
+        with obs_tracectx.span("predictor.run", kind="dispatch"):
+            outs = compiled(self.state, feeds)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return list(outs)

@@ -41,6 +41,14 @@ Submodules:
     flag, schema ``paddle_tpu.runlog.v1``) written by the Trainer and
     ``bench.py``; ``python -m paddle_tpu.observability.runlog`` tails,
     step-aligned-diffs (``--compare``) and ASCII-plots it.
+  * :mod:`.tracectx` — request X-ray (``request_tracing`` flag): W3C
+    traceparent in/out, ambient per-request/per-step trace context,
+    bounded span store, histogram exemplars, ``GET /trace/<id>``
+    waterfalls (schema ``paddle_tpu.xray.v1``), SLO-breach captures.
+  * :mod:`.xray` — ``python -m paddle_tpu.observability.xray`` ASCII
+    waterfall renderer (``--self-test`` runs in tier-1).
+  * :mod:`.deviceprof` — ``POST /profile`` bounded ``jax.profiler``
+    captures tagged with the active trace ids; graceful fallback.
 
 The instrumented call sites live where the work happens:
 framework/executor.py (compile/cache counters, step latency, per-op
@@ -59,6 +67,37 @@ from . import costmodel, flight, forensics, metrics, trace   # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,    # noqa: F401
                       MetricsRegistry, counter, gauge, histogram)
 from .trace import export_chrome_trace                        # noqa: F401
+
+import os as _os
+import time as _time
+
+# fallback anchor when /proc is unavailable: first paddle_tpu import
+_IMPORT_UNIX = _time.time()
+
+
+def process_start_unix() -> float:
+    """Wall-clock time this PROCESS started (not this module): the
+    anchor for the cold-start metrics ``restart_to_first_step_seconds``
+    (trainer.py) and ``serving_ready_seconds`` (serving/worker.py) —
+    a supervisor-respawned worker's restart cost is exec-to-useful,
+    which includes interpreter + import time that an import-time
+    anchor would hide.  Linux: /proc/self/stat starttime (field 22,
+    clock ticks since boot) + /proc/uptime; elsewhere: the time this
+    package was imported."""
+    try:
+        with open("/proc/self/stat") as f:
+            stat = f.read()
+        # field 2 (comm) may contain spaces — parse after the ')'
+        fields = stat.rsplit(")", 1)[1].split()
+        start_ticks = float(fields[19])          # starttime, field 22
+        hertz = _os.sysconf("SC_CLK_TCK")
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+        boot_unix = _time.time() - uptime
+        return boot_unix + start_ticks / hertz
+    except Exception:
+        return _IMPORT_UNIX
+
 
 _mem_live = metrics.gauge(
     "device_memory_live_bytes",

@@ -1,0 +1,125 @@
+"""On-demand device profiling (``POST /profile``).
+
+Closes the X-ray loop from "this request was slow" (the trace
+waterfall) to "this is the device timeline": one bounded
+``jax.profiler`` capture, started over HTTP against a live process,
+stopped by a watchdog thread after ``duration_s``, its artifact
+directory tagged with the trace ids that were active while it ran —
+the reference framework's profiler plane (``profiler.proto`` +
+tools/timeline.py) as a serving-era endpoint.
+
+Graceful degradation is the contract: a build/platform where
+``jax.profiler.start_trace`` is unavailable or fails returns a clean
+``unavailable`` document instead of 500ing the endpoint; only one
+capture runs at a time (a second request gets ``busy`` + the running
+capture's document).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import metrics as obs_metrics
+from . import tracectx
+
+_MAX_DURATION_S = 60.0
+_DEFAULT_DURATION_S = 2.0
+
+_m_captures = obs_metrics.counter(
+    "deviceprof_captures_total",
+    "On-demand jax.profiler captures by outcome "
+    "(started|unavailable|busy).", ("outcome",))
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"running": False, "last": None}
+
+
+def _profiler():
+    try:
+        import jax.profiler as prof
+        if not hasattr(prof, "start_trace"):
+            return None
+        return prof
+    except Exception:
+        return None
+
+
+def status() -> dict:
+    with _lock:
+        return {"running": bool(_state["running"]),
+                "last": _state["last"]}
+
+
+def start(duration_s: Optional[float] = None,
+          logdir: Optional[str] = None) -> dict:
+    """Begin one bounded capture; returns its document immediately
+    (the capture finishes in the background).  Outcomes:
+
+    * ``started`` — capture running; ``logdir`` holds the XPlane dump.
+    * ``busy`` — another capture is in flight; its doc rides along.
+    * ``unavailable`` — no usable jax.profiler on this build/platform
+      (or start_trace raised); a no-op, never an error."""
+    dur = float(duration_s if duration_s is not None
+                else _DEFAULT_DURATION_S)
+    dur = max(0.1, min(dur, _MAX_DURATION_S))
+    with _lock:
+        if _state["running"]:
+            _m_captures.labels(outcome="busy").inc()
+            return {"status": "busy", "capture": _state["last"]}
+        prof = _profiler()
+        if prof is None:
+            _m_captures.labels(outcome="unavailable").inc()
+            return {"status": "unavailable",
+                    "reason": "jax.profiler.start_trace not available"}
+        logdir = logdir or tempfile.mkdtemp(prefix="ptpu_xprof_")
+        # trace ids active NOW: the link back from the device timeline
+        # to the request waterfalls that asked for it
+        active: List[str] = tracectx.trace_ids()[-8:]
+        cur = tracectx.current_trace_id()
+        if cur and cur not in active:
+            active.append(cur)
+        doc = {"status": "started", "logdir": logdir,
+               "duration_s": dur, "time_unix": time.time(),
+               "trace_ids": active, "done": False}
+        try:
+            prof.start_trace(logdir)
+        except Exception as e:
+            _m_captures.labels(outcome="unavailable").inc()
+            return {"status": "unavailable",
+                    "reason": f"start_trace failed: {e!r}"[:300]}
+        _state["running"] = True
+        _state["last"] = doc
+    _m_captures.labels(outcome="started").inc()
+    t = threading.Thread(target=_stop_after, args=(dur, prof),
+                         daemon=True, name="deviceprof-watchdog")
+    t.start()
+    # the requester's own trace remembers it asked (the waterfall then
+    # points at the device timeline artifact)
+    tracectx.instant("deviceprof.start", kind="profile",
+                     logdir=logdir, duration_s=dur)
+    return dict(doc)
+
+
+def _stop_after(dur: float, prof):
+    time.sleep(dur)
+    err = None
+    try:
+        prof.stop_trace()
+    except Exception as e:              # stop must never kill the host
+        err = repr(e)[:300]
+    with _lock:
+        _state["running"] = False
+        if _state["last"] is not None:
+            _state["last"] = {**_state["last"], "done": True,
+                              **({"stop_error": err} if err else {})}
+
+
+def reset():
+    """Test hook: forget capture state (a running capture's watchdog
+    still stops it)."""
+    with _lock:
+        _state["running"] = False
+        _state["last"] = None

@@ -53,6 +53,9 @@ SCHEMA = "paddle_tpu.fleet.v1"
 # cap on retained normalized spans per rank (newest win): a fleet trace
 # is a debugging artifact, not an unbounded log
 _MAX_SPANS_PER_RANK = 100_000
+# SLO-breach capture bundles retained at the aggregator, fleet-wide
+# (each worker keeps at most tracectx._MAX_CAPTURES=16 of its own)
+_MAX_CAPTURE_KEEP = 16
 
 _m_reports = obs_metrics.counter(
     "fleet_reports_total",
@@ -103,10 +106,17 @@ def snapshot_payload(rank: int, closing: bool = False) -> dict:
 
 
 def events_payload(rank: int, spans: List[dict],
-                   flight_bundle: Optional[dict] = None) -> dict:
-    """Trace spans (+ optional flight bundle) as one fleet payload.
-    Span timestamps stay in this process's perf_counter seconds; the
-    aggregator normalizes them with the clock pair below."""
+                   flight_bundle: Optional[dict] = None,
+                   xray_spans: Optional[List[dict]] = None,
+                   xray_captures: Optional[Dict[str, dict]] = None
+                   ) -> dict:
+    """Trace spans (+ optional flight bundle + X-ray spans) as one
+    fleet payload.  Span timestamps stay in this process's
+    perf_counter seconds; the aggregator normalizes them with the
+    clock pair below.  X-ray spans additionally carry their own
+    ``span_id`` so at-least-once redelivery (and a restarted worker
+    re-shipping its window) dedupes instead of duplicating bars in the
+    request waterfall."""
     return {
         "schema": SCHEMA,
         "rank": int(rank),
@@ -114,6 +124,11 @@ def events_payload(rank: int, spans: List[dict],
         "perf_counter": time.perf_counter(),
         "spans": spans,
         "flight": flight_bundle,
+        "xray": xray_spans or [],
+        # SLO-breach capture bundles keyed by trace id (shipped when
+        # the worker's capture watermark moves): the coordinator's
+        # GET /trace/<id> must serve the evidence, not just the worker
+        "xray_captures": xray_captures or {},
     }
 
 
@@ -138,6 +153,10 @@ class FleetReporter:
         self._span_cursor = 0
         self._trace_gen = obs_trace.generation()
         self._flight_dumps = obs_flight.dump_count()
+        from . import tracectx as obs_tracectx
+        self._xray_cursor = 0
+        self._xray_gen = obs_tracectx.generation()
+        self._xray_capture_seq = obs_tracectx.capture_seq()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # serializes flushes: stop()'s closing flush must not interleave
@@ -187,15 +206,26 @@ class FleetReporter:
         # copies only the tail, not the whole ring, per tick
         gen, total, new_spans = obs_trace.events_since(
             self._span_cursor, self._trace_gen)
+        from . import tracectx as obs_tracectx
+        xgen, xtotal, new_xray = obs_tracectx.spans_since(
+            self._xray_cursor, self._xray_gen)
+        cap_seq = obs_tracectx.capture_seq()
+        caps = (obs_tracectx.captures()
+                if cap_seq != self._xray_capture_seq else None)
         bundle = None
         dumps = obs_flight.dump_count()
         if dumps != self._flight_dumps:
             bundle = obs_flight.last_bundle()
-        if new_spans or bundle is not None:
+        if new_spans or new_xray or caps or bundle is not None:
             self._client.report_events(
-                events_payload(self.rank, new_spans, bundle))
+                events_payload(self.rank, new_spans, bundle,
+                               xray_spans=new_xray,
+                               xray_captures=caps))
         self._span_cursor = total
         self._trace_gen = gen
+        self._xray_cursor = xtotal
+        self._xray_gen = xgen
+        self._xray_capture_seq = cap_seq
         self._flight_dumps = dumps
 
     def stop(self, flush: bool = True):
@@ -280,6 +310,16 @@ def merge_metric_docs(docs: Dict[int, dict]) -> Dict[str, dict]:
                     ent["overflow"] += int(row.get("overflow", 0))
                     for b, c in (row.get("buckets") or {}).items():
                         ent["buckets"][b] = ent["buckets"].get(b, 0) + c
+                    if row.get("exemplars"):
+                        # exemplar per bucket survives the merge:
+                        # newest across ranks wins (each one already
+                        # carries its trace id, which is rank-agnostic)
+                        ex = ent.setdefault("exemplars", {})
+                        for b, e in row["exemplars"].items():
+                            if (b not in ex
+                                    or float(e.get("time_unix", 0.0))
+                                    > float(ex[b].get("time_unix", 0.0))):
+                                ex[b] = e
                 else:   # gauge / untyped: per-worker series
                     labels["worker"] = str(rank)
                     fam["series"][_series_key(labels)] = {
@@ -297,11 +337,14 @@ def _has_signal(fam: dict) -> bool:
     return False
 
 
-def render_prometheus(families: Dict[str, dict]) -> str:
-    """Prometheus text (v0.0.4) for a merged family map — delegates to
-    the registry's single exposition renderer so the fleet view can
-    never diverge from the local one."""
-    return obs_metrics.render_prometheus(families_to_json(families))
+def render_prometheus(families: Dict[str, dict],
+                      exemplars: bool = False) -> str:
+    """Prometheus text for a merged family map — delegates to the
+    registry's single exposition renderer so the fleet view can never
+    diverge from the local one (exemplars only under OpenMetrics
+    negotiation, see metrics.render_prometheus)."""
+    return obs_metrics.render_prometheus(families_to_json(families),
+                                         exemplars=exemplars)
 
 
 def families_to_json(families: Dict[str, dict]) -> dict:
@@ -341,6 +384,13 @@ class FleetAggregator:
         self._workers: Dict[int, dict] = {}
         self._spans: Dict[int, List[dict]] = {}
         self._flights: Dict[int, dict] = {}
+        # request X-ray assembly: trace_id -> {span_id: span}, spans
+        # from EVERY rank merged on the master's wall clock.  Keyed by
+        # span_id so at-least-once redelivery and a restarted worker's
+        # re-shipped window dedupe instead of double-drawing bars.
+        self._xray: Dict[str, Dict[str, dict]] = {}
+        # SLO-breach captures shipped by workers, keyed by trace id
+        self._xray_captures: Dict[str, dict] = {}
         self._straggler_warned: set = set()
         # tensorstats sample steps already diagnosed as diverged (warn
         # once per step, bounded — a desynced rank stays desynced)
@@ -475,6 +525,65 @@ class FleetAggregator:
                 del spans[:len(spans) - _MAX_SPANS_PER_RANK]
             if payload.get("flight") is not None:
                 self._flights[rank] = payload["flight"]
+            for e in payload.get("xray") or []:
+                self._ingest_xray_span(e, rank, offset)
+            for tid, cap in (payload.get("xray_captures") or {}).items():
+                if not isinstance(cap, dict):
+                    continue
+                while len(self._xray_captures) >= 4 * _MAX_CAPTURE_KEEP \
+                        and str(tid) not in self._xray_captures:
+                    self._xray_captures.pop(
+                        next(iter(self._xray_captures)))
+                self._xray_captures[str(tid)] = cap
+
+    _MAX_XRAY_TRACES = 2048
+
+    def _ingest_xray_span(self, e: dict, rank: int, offset: float):
+        """One X-ray span onto the master clock (call under the lock).
+        ``start_perf + offset`` — NOT the worker's own start_unix — so
+        a restarted worker (fresh perf_counter epoch, same request's
+        later spans) and a skewed host both land on ONE timeline; the
+        offset is re-derived from THIS payload's clock pair, which is
+        exactly the sender incarnation that recorded these spans."""
+        try:
+            ev = dict(e)
+            ev["rank"] = int(ev.get("rank", rank))
+            ev["start_unix"] = float(ev["start_perf"]) + offset
+            tid, sid = str(ev["trace_id"]), str(ev["span_id"])
+        except (KeyError, TypeError, ValueError):
+            return                      # malformed span: drop, not 500
+        spans = self._xray.get(tid)
+        if spans is None:
+            while len(self._xray) >= self._MAX_XRAY_TRACES:
+                self._xray.pop(next(iter(self._xray)))
+            spans = self._xray[tid] = {}
+        # dedupe by span id: redelivered windows overwrite, identical
+        spans[sid] = ev
+
+    def xray_waterfall(self, trace_id: str) -> Optional[dict]:
+        """The fleet-assembled ``paddle_tpu.xray.v1`` waterfall for one
+        request: spans from router AND workers merged on the master
+        clock, the worker-shipped SLO-breach capture attached (what
+        ``GET /trace/<id>`` serves on the coordinator)."""
+        from . import tracectx as obs_tracectx
+        with self._lock:
+            spans = list(self._xray.get(trace_id, {}).values())
+            cap = self._xray_captures.get(trace_id)
+        if not spans and cap is None:
+            return None
+        if not spans:
+            # spans evicted (or never shipped) but the breach evidence
+            # survives: serve the capture's own frozen waterfall
+            return cap.get("waterfall") or obs_tracectx.build_waterfall(
+                trace_id, [], capture=cap)
+        return obs_tracectx.build_waterfall(
+            trace_id, spans,
+            capture=None if cap is None else
+            {k: v for k, v in cap.items() if k != "waterfall"})
+
+    def xray_trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._xray)
 
     def _find_stragglers(self) -> List[Tuple[int, float, float]]:
         """Ranks newly fallen behind median/straggler_factor (call under
@@ -702,8 +811,10 @@ class FleetAggregator:
         out["fleet_worker_step_rate"] = rate
         return out
 
-    def prometheus_text(self, local: Optional[dict] = None) -> str:
-        return render_prometheus(self.merged_families(local))
+    def prometheus_text(self, local: Optional[dict] = None,
+                        exemplars: bool = False) -> str:
+        return render_prometheus(self.merged_families(local),
+                                 exemplars=exemplars)
 
     def flight_bundles(self) -> Dict[int, dict]:
         with self._lock:

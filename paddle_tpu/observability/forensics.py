@@ -85,13 +85,20 @@ class CompileRecord:
     fetch_names: Tuple[str, ...]
     causes: List[str]
     details: List[str] = field(default_factory=list)
+    # request X-ray: the trace that was active when the miss happened —
+    # a recompile TRIGGERED by a request/step names that request in the
+    # compile log (and shows inside its waterfall)
+    trace_id: str = ""
 
     def to_dict(self) -> dict:
-        return {"ts": self.ts, "program": self.program_uid,
-                "version": self.program_version,
-                "fetches": list(self.fetch_names),
-                "causes": list(self.causes),
-                "details": list(self.details)}
+        d = {"ts": self.ts, "program": self.program_uid,
+             "version": self.program_version,
+             "fetches": list(self.fetch_names),
+             "causes": list(self.causes),
+             "details": list(self.details)}
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+        return d
 
 
 _lock = threading.Lock()
@@ -183,10 +190,12 @@ def note_compile(parts: KeyParts) -> CompileRecord:
             f"new fetch set {list(parts.fetch_names)}"]
     else:
         causes, details = ["first_compile"], []
+    from . import tracectx
     rec = CompileRecord(ts=time.time(), program_uid=parts.program_uid,
                         program_version=parts.program_version,
                         fetch_names=parts.fetch_names, causes=causes,
-                        details=details)
+                        details=details,
+                        trace_id=tracectx.current_trace_id() or "")
     with _lock:
         hist = _cause_counts.setdefault(fkey, {})
         for c in causes:
@@ -199,7 +208,14 @@ def note_compile(parts: KeyParts) -> CompileRecord:
     from . import flight
     flight.record("compile", f"p{parts.program_uid}",
                   version=parts.program_version, causes=causes,
-                  detail="; ".join(details)[:200])
+                  detail="; ".join(details)[:200],
+                  **({"trace_id": rec.trace_id} if rec.trace_id else {}))
+    # the triggering request/step's own timeline shows the recompile
+    # as an instant marker (kind=compile) with the diagnosed cause
+    tracectx.instant("executor.compile", kind="compile",
+                     program=parts.program_uid,
+                     version=parts.program_version,
+                     cause=causes[0])
     return rec
 
 

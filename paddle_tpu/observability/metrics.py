@@ -38,6 +38,35 @@ def enabled() -> bool:
     return bool(flags.get_flag("metrics"))
 
 
+# -- histogram exemplars (request X-ray, observability/tracectx.py) ------
+# A provider callable returns the ambient trace id (or None).  Injected
+# by tracectx at import time rather than imported here: metrics is the
+# bottom of the observability import graph and must stay cycle-free.
+_exemplar_provider = None
+_EXEMPLAR_RING = 4      # exemplars retained per bucket (newest kept)
+
+
+def set_exemplar_provider(fn):
+    """Register the ambient-trace-id source (tracectx.current_trace_id).
+    When set, every Histogram.observe() that lands under an active
+    trace records a (value, trace_id, time) exemplar on its bucket —
+    the OpenMetrics-style link from a p99 bucket to a retrievable
+    trace."""
+    global _exemplar_provider
+    _exemplar_provider = fn
+
+
+def clear_exemplars():
+    """Drop every exemplar ring in the registry (conftest: trace ids
+    must not leak across tests; bucket counts are untouched)."""
+    for m in REGISTRY.metrics():
+        if m.buckets is None:
+            continue
+        with m._lock:
+            for s in m._series.values():
+                s.exemplars = None
+
+
 # Latency-oriented default buckets (seconds): 50us .. 60s.
 DEFAULT_BUCKETS = (
     5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
@@ -47,13 +76,17 @@ DEFAULT_BUCKETS = (
 class _Series:
     """State of one (metric, label-values) time series."""
 
-    __slots__ = ("value", "sum", "count", "bucket_counts")
+    __slots__ = ("value", "sum", "count", "bucket_counts", "exemplars")
 
     def __init__(self, buckets: Optional[Tuple[float, ...]] = None):
         self.value = 0.0
         self.sum = 0.0
         self.count = 0
         self.bucket_counts = [0] * (len(buckets) + 1) if buckets else None
+        # bucket index -> bounded newest-first list of exemplar dicts
+        # ({value, trace_id, time_unix}); lazily created so the
+        # no-tracing hot path pays nothing
+        self.exemplars: Optional[Dict[int, List[dict]]] = None
 
 
 class Metric:
@@ -236,11 +269,27 @@ class Histogram(Metric):
         value = float(value)
         s.sum += value
         s.count += 1
+        idx = len(self.buckets)         # overflow (+Inf) bucket
         for i, b in enumerate(self.buckets):
             if value <= b:
-                s.bucket_counts[i] += 1
-                return
-        s.bucket_counts[-1] += 1
+                idx = i
+                break
+        s.bucket_counts[idx] += 1
+        if _exemplar_provider is not None:
+            tid = _exemplar_provider()
+            if tid is not None:
+                self._note_exemplar(s, idx, value, tid)
+
+    def _note_exemplar(self, s: _Series, idx: int, value: float,
+                       trace_id: str):
+        ex = {"value": value, "trace_id": trace_id,
+              "time_unix": time.time()}
+        with self._lock:
+            if s.exemplars is None:
+                s.exemplars = {}
+            ring = s.exemplars.setdefault(idx, [])
+            ring.insert(0, ex)
+            del ring[_EXEMPLAR_RING:]
 
     def _set(self, s, value):
         raise TypeError(f"histogram {self.name!r} does not support set()")
@@ -303,11 +352,15 @@ class MetricsRegistry:
             m.reset()
 
     # -- exposition --------------------------------------------------------
-    def prometheus_text(self) -> str:
-        """Prometheus text format v0.0.4 exposition (rendered from the
-        same JSON document to_json() emits, by the ONE renderer the
-        fleet-merged exposition also uses — see render_prometheus)."""
-        return render_prometheus(self.to_json())
+    def prometheus_text(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition (rendered from the same JSON
+        document to_json() emits, by the ONE renderer the fleet-merged
+        exposition also uses — see render_prometheus).
+        ``exemplars=True`` appends OpenMetrics exemplar clauses to
+        histogram bucket lines — ONLY valid when served as
+        ``application/openmetrics-text`` (a v0.0.4 parser rejects a
+        mid-line ``#``); the HTTP endpoint content-negotiates."""
+        return render_prometheus(self.to_json(), exemplars=exemplars)
 
     def to_json(self) -> dict:
         """One JSON document for the whole registry — the schema shared
@@ -322,6 +375,16 @@ class MetricsRegistry:
                                buckets={_fmt(b): c for b, c in
                                         zip(m.buckets, s.bucket_counts)},
                                overflow=s.bucket_counts[-1])
+                    if s.exemplars:
+                        # newest exemplar per bucket, keyed by the
+                        # bucket's upper bound ("+Inf" for overflow) —
+                        # the /metrics.json hook from a p99 bucket to
+                        # a GET /trace/<id> waterfall
+                        row["exemplars"] = {
+                            (_fmt(m.buckets[i]) if i < len(m.buckets)
+                             else "+Inf"): ring[0]
+                            for i, ring in sorted(s.exemplars.items())
+                            if ring}
                 else:
                     row["value"] = s.value
                 series.append(row)
@@ -334,12 +397,20 @@ class MetricsRegistry:
             json.dump(self.to_json(), f, indent=1, sort_keys=True)
 
 
-def render_prometheus(doc: dict) -> str:
-    """Prometheus text (v0.0.4) for a ``paddle_tpu.metrics.v1`` JSON
-    document — the single exposition renderer.  Both the live registry
+def render_prometheus(doc: dict, exemplars: bool = False) -> str:
+    """Prometheus text for a ``paddle_tpu.metrics.v1`` JSON document —
+    the single exposition renderer.  Both the live registry
     (:meth:`MetricsRegistry.prometheus_text`) and the fleet-merged view
     (observability/fleet.py) delegate here, so an exposition fix (e.g.
-    escaping) can never diverge the two."""
+    escaping) can never diverge the two.
+
+    ``exemplars=False`` (default) is strict v0.0.4: no exemplar
+    clauses, because a mid-line ``#`` is a PARSE ERROR there (only a
+    line-initial ``#`` is a comment) and one traced observation would
+    fail the whole scrape.  ``exemplars=True`` appends OpenMetrics
+    exemplar clauses — serve that variant only under the
+    ``application/openmetrics-text`` content type (server.py
+    content-negotiates on the Accept header)."""
     lines: List[str] = []
     metrics_map = doc.get("metrics", {})
     for name in sorted(metrics_map):
@@ -352,14 +423,18 @@ def render_prometheus(doc: dict) -> str:
             if mtype == "histogram":
                 cum = 0
                 buckets = row.get("buckets") or {}
+                exem = (row.get("exemplars") or {}) if exemplars else {}
                 for b in sorted(buckets, key=float):
                     cum += buckets[b]
                     lines.append(_sample(f"{name}_bucket",
                                          {**labels, "le": _fmt(float(b))},
-                                         cum))
+                                         cum)
+                                 + _exemplar_suffix(
+                                     exem.get(_fmt(float(b)))))
                 cum += row.get("overflow", 0)
                 lines.append(_sample(f"{name}_bucket",
-                                     {**labels, "le": "+Inf"}, cum))
+                                     {**labels, "le": "+Inf"}, cum)
+                             + _exemplar_suffix(exem.get("+Inf")))
                 lines.append(_sample(f"{name}_sum", labels,
                                      row.get("sum", 0.0)))
                 lines.append(_sample(f"{name}_count", labels,
@@ -374,6 +449,16 @@ def render_prometheus(doc: dict) -> str:
 
 def _fmt(v: float) -> str:
     return repr(float(v))
+
+
+def _exemplar_suffix(ex: Optional[dict]) -> str:
+    """OpenMetrics exemplar clause for one bucket sample line:
+    `` # {trace_id="<id>"} <value> <ts>``.  Pure-comment syntax to a
+    v0.0.4 scraper, a real exemplar to an OpenMetrics one."""
+    if not ex:
+        return ""
+    return (f' # {{trace_id="{_escape(str(ex.get("trace_id", "")))}"}} '
+            f'{ex.get("value", 0.0)} {ex.get("time_unix", 0.0)}')
 
 
 def _sample(name: str, labels: Dict[str, str], value) -> str:

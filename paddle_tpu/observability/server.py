@@ -44,6 +44,8 @@ from . import metrics as obs_metrics
 # runpy's already-imported warning via trainer.py -> server -> fleet.
 
 _PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+_OPENMETRICS_CTYPE = ("application/openmetrics-text; version=1.0.0; "
+                      "charset=utf-8")
 
 _lock = threading.Lock()
 _server: Optional["ObservabilityServer"] = None
@@ -108,8 +110,17 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
             if path == "/metrics":
-                self._send(200, obs.prometheus_text().encode(),
-                           _PROM_CTYPE)
+                # exemplar clauses are OpenMetrics-only syntax (a
+                # mid-line '#' fails a v0.0.4 parse), so they are
+                # served only to scrapers that negotiate for them
+                want_om = "openmetrics" in (
+                    self.headers.get("Accept") or "").lower()
+                body = obs.prometheus_text(exemplars=want_om)
+                if want_om and not body.endswith("# EOF\n"):
+                    body += "# EOF\n"       # OpenMetrics terminator
+                self._send(200, body.encode(),
+                           _OPENMETRICS_CTYPE if want_om
+                           else _PROM_CTYPE)
             elif path == "/metrics.json":
                 self._send_json(200, obs.metrics_json())
             elif path == "/healthz":
@@ -122,11 +133,23 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, obs.model())
             elif path == "/serving":
                 self._send_json(200, obs.serving())
+            elif path.startswith("/trace/"):
+                trace_id = path[len("/trace/"):]
+                doc = obs.trace(trace_id)
+                if doc is None:
+                    self._send_json(404, {
+                        "error": f"no trace {trace_id!r} (evicted, "
+                                 "never recorded, or tracing off)"})
+                else:
+                    self._send_json(200, doc)
+            elif path == "/profile":
+                from . import deviceprof
+                self._send_json(200, deviceprof.status())
             elif path == "/":
                 self._send(200, b"paddle_tpu observability: /metrics "
                                 b"/metrics.json /healthz /flight "
-                                b"/model /serving "
-                                b"[POST /serving/generate]\n",
+                                b"/model /serving /trace/<id> "
+                                b"[POST /serving/generate /profile]\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send_json(404, {"error": f"no route {path}"})
@@ -140,7 +163,7 @@ class _Handler(BaseHTTPRequestHandler):
         obs: "ObservabilityServer" = self.server.obs   # type: ignore
         path = self.path.split("?", 1)[0].rstrip("/")
         try:
-            if path != "/serving/generate":
+            if path not in ("/serving/generate", "/profile"):
                 self._send_json(404, {"error": f"no POST route {path}"})
                 return
             length = int(self.headers.get("Content-Length") or 0)
@@ -150,13 +173,34 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, UnicodeDecodeError) as e:
                 self._send_json(400, {"error": f"bad JSON body: {e}"})
                 return
-            code, doc = obs.serving_generate(body)
+            if path == "/profile":
+                code, doc = obs.profile(body)
+                self._send_json(code, doc)
+                return
+            # request X-ray: honor (or mint) the W3C traceparent so the
+            # whole queue->prefill->decode lifecycle lands under ONE
+            # trace id, echoed in the response header AND body
+            from . import tracectx
+            parent = tracectx.parse_traceparent(
+                self.headers.get("traceparent"))
+            ctx = tracectx.start_trace("serving.request", parent=parent)
+            self._trace_ctx = ctx
+            code, doc = obs.serving_generate(body, trace=ctx)
+            if ctx is not None and isinstance(doc, dict):
+                doc.setdefault("trace_id", ctx.trace_id)
             self._send_json(code, doc)
         except Exception as e:
             try:
                 self._send_json(500, {"error": repr(e)[:500]})
             except OSError:
                 pass
+
+    def end_headers(self):
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            self.send_header("traceparent", ctx.traceparent())
+            self._trace_ctx = None
+        super().end_headers()
 
 
 class ObservabilityServer:
@@ -210,12 +254,13 @@ class ObservabilityServer:
         except Exception:
             pass                 # scraping must never 500 on refresh
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, exemplars: bool = False) -> str:
         self._refresh_sampled_state()
         if self.aggregator is not None:
             return self.aggregator.prometheus_text(
-                local=obs_metrics.REGISTRY.to_json())
-        return obs_metrics.REGISTRY.prometheus_text()
+                local=obs_metrics.REGISTRY.to_json(),
+                exemplars=exemplars)
+        return obs_metrics.REGISTRY.prometheus_text(exemplars=exemplars)
 
     def metrics_json(self) -> dict:
         self._refresh_sampled_state()
@@ -274,7 +319,36 @@ class ObservabilityServer:
         from .. import serving as serving_mod
         return serving_mod.status_doc()
 
-    def serving_generate(self, body: dict):
+    def trace(self, trace_id: str) -> Optional[dict]:
+        """``GET /trace/<id>``: the assembled X-ray waterfall.  With an
+        aggregator the FLEET view wins (router + worker spans merged on
+        one clock); a plain worker serves its local store, captures
+        included."""
+        if not trace_id:
+            return None
+        if self.aggregator is not None:
+            doc = self.aggregator.xray_waterfall(trace_id)
+            if doc is not None:
+                return doc
+        from . import tracectx
+        return tracectx.waterfall(trace_id)
+
+    def profile(self, body: dict):
+        """``POST /profile``: start one bounded jax.profiler capture
+        tagged with the active trace ids.  Always 200 — 'unavailable'
+        and 'busy' are states, not server errors."""
+        from . import deviceprof
+        try:
+            dur = body.get("duration_s")
+            dur = None if dur is None else float(dur)
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"malformed duration_s: {e}"}
+        logdir = body.get("logdir")
+        if logdir is not None and not isinstance(logdir, str):
+            return 400, {"error": "logdir must be a string"}
+        return 200, deviceprof.start(duration_s=dur, logdir=logdir)
+
+    def serving_generate(self, body: dict, trace=None):
         """``POST /serving/generate`` body: submit to the attached
         batcher and block for the result.  Returns (http_code, doc)."""
         from .. import serving as serving_mod
@@ -299,7 +373,8 @@ class ObservabilityServer:
             return 400, {"error": f"malformed request field: {e}"}
         try:
             req = batcher.submit(tokens, max_new_tokens=mnt,
-                                 temperature=temperature, eos_id=eos)
+                                 temperature=temperature, eos_id=eos,
+                                 trace=trace)
         except serving_mod.ShedError as e:
             if getattr(e, "draining", False):
                 # instance going away: 503 so clients fail over

@@ -1,0 +1,213 @@
+"""X-ray waterfall CLI: render a ``paddle_tpu.xray.v1`` trace document
+as an ASCII waterfall (plus raw JSON passthrough).
+
+Usage::
+
+    # a dumped waterfall document
+    python -m paddle_tpu.observability.xray trace.json
+
+    # straight off a live endpoint (GET /trace/<id>)
+    python -m paddle_tpu.observability.xray --url http://host:port \
+        --trace-id 4bf92f3577b34da6a3ce929d0e0e4736
+
+    # tier-1 smoke: parse + render a bundled fixture
+    python -m paddle_tpu.observability.xray --self-test
+
+Exit codes: 0 rendered, 1 trace not found / malformed, 2 bad usage —
+the ``analysis.lint`` CLI contract.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+from . import tracectx
+
+_BAR_WIDTH = 40
+
+# A miniature but structurally complete serving trace: root request,
+# queue wait, bucketed prefill with a compile inside it (the
+# request-triggered-recompile shape), decode chunks, retire marker —
+# what --self-test parses and renders without any live process.
+_SELF_TEST_DOC = {
+    "schema": tracectx.SCHEMA,
+    "trace_id": "4bf92f3577b34da6a3ce929d0e0e4736",
+    "span_count": 6,
+    "duration_s": 0.1,
+    "start_unix": 1700000000.0,
+    "spans": [
+        {"name": "serving.request", "span_id": "00f067aa0ba902b7",
+         "parent_id": None, "kind": "request", "rank": 0,
+         "offset_s": 0.0, "start_unix": 1700000000.0, "dur": 0.1,
+         "orphan": False, "attrs": {"prompt_len": 9}},
+        {"name": "serving.queue_wait", "span_id": "00f067aa0ba902b8",
+         "parent_id": "00f067aa0ba902b7", "kind": "queue", "rank": 0,
+         "offset_s": 0.0, "start_unix": 1700000000.0, "dur": 0.01,
+         "orphan": False},
+        {"name": "serving.prefill", "span_id": "00f067aa0ba902b9",
+         "parent_id": "00f067aa0ba902b7", "kind": "prefill", "rank": 1,
+         "offset_s": 0.01, "start_unix": 1700000000.01, "dur": 0.05,
+         "orphan": False, "attrs": {"bucket": 16}},
+        {"name": "serving.compile_bucket", "span_id": "00f067aa0ba902ba",
+         "parent_id": "00f067aa0ba902b9", "kind": "compile", "rank": 1,
+         "offset_s": 0.011, "start_unix": 1700000000.011, "dur": 0.04,
+         "orphan": False, "attrs": {"bucket": 16}},
+        {"name": "serving.decode", "span_id": "00f067aa0ba902bb",
+         "parent_id": "00f067aa0ba902b7", "kind": "decode", "rank": 1,
+         "offset_s": 0.06, "start_unix": 1700000000.06, "dur": 0.039,
+         "orphan": False, "attrs": {"tokens": 8}},
+        {"name": "serving.retire", "span_id": "00f067aa0ba902bc",
+         "parent_id": "00f067aa0ba902b7", "kind": "marker", "rank": 1,
+         "offset_s": 0.099, "start_unix": 1700000000.099, "dur": 0.0,
+         "orphan": False},
+    ],
+}
+
+
+def _fmt_dur(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.3f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s * 1e6:.0f}us"
+
+
+def render_waterfall(doc: dict) -> str:
+    """ASCII waterfall of one xray document: one line per span, a bar
+    positioned/scaled on the trace's time axis, parent-indented, rank
+    and slowest-span marked."""
+    if doc.get("schema") != tracectx.SCHEMA:
+        raise ValueError(
+            f"not a {tracectx.SCHEMA} document "
+            f"(schema={doc.get('schema')!r})")
+    spans = list(doc.get("spans") or [])
+    total = float(doc.get("duration_s") or 0.0) or max(
+        (float(s.get("offset_s", 0.0)) + float(s.get("dur", 0.0))
+         for s in spans), default=0.0)
+    lines: List[str] = [
+        f"trace {doc.get('trace_id')}  "
+        f"({len(spans)} span(s), {_fmt_dur(total)})"]
+    if doc.get("capture"):
+        cap = doc["capture"]
+        lines.append(f"  !! captured: {cap.get('reason')} "
+                     f"{cap.get('detail') or ''}")
+    by_id = {s.get("span_id"): s for s in spans}
+
+    def depth(s, seen=()):
+        p = s.get("parent_id")
+        if not p or p not in by_id or s.get("span_id") in seen:
+            return 0
+        return 1 + depth(by_id[p], seen + (s.get("span_id"),))
+
+    slowest = max((s for s in spans if s.get("dur")),
+                  key=lambda s: s["dur"], default=None)
+    for s in spans:
+        off = float(s.get("offset_s", 0.0))
+        dur = float(s.get("dur", 0.0))
+        if total > 0:
+            start = int(round(_BAR_WIDTH * off / total))
+            width = max(1, int(round(_BAR_WIDTH * dur / total))) \
+                if dur > 0 else 0
+        else:
+            start, width = 0, 0
+        start = min(start, _BAR_WIDTH - 1)
+        width = min(width, _BAR_WIDTH - start)
+        bar = " " * start + ("#" * width if width else "|")
+        bar = bar.ljust(_BAR_WIDTH)
+        name = "  " * depth(s) + str(s.get("name"))
+        mark = " <-- slowest" if s is slowest else ""
+        orphan = " (orphan)" if s.get("orphan") else ""
+        attrs = s.get("attrs")
+        attr_s = (" " + ",".join(f"{k}={v}"
+                                 for k, v in sorted(attrs.items()))
+                  if attrs else "")
+        lines.append(f"  [{bar}] {name:<32} {_fmt_dur(dur):>9} "
+                     f"r{s.get('rank', 0)}{attr_s}{orphan}{mark}")
+    return "\n".join(lines)
+
+
+def _fetch_url(url: str, trace_id: str) -> dict:
+    import urllib.request
+    endpoint = url.rstrip("/") + f"/trace/{trace_id}"
+    with urllib.request.urlopen(endpoint, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _self_test() -> int:
+    doc = json.loads(json.dumps(_SELF_TEST_DOC))   # exercise the wire
+    text = render_waterfall(doc)
+    needed = ["trace 4bf92f3577b34da6a3ce929d0e0e4736",
+              "serving.prefill", "serving.compile_bucket",
+              "bucket=16", "<-- slowest"]
+    missing = [n for n in needed if n not in text]
+    if missing:
+        print(f"xray --self-test FAILED: missing {missing}\n{text}",
+              file=sys.stderr)
+        return 1
+    # round-trip through build_waterfall too: raw spans -> document
+    spans = [{**s, "trace_id": doc["trace_id"],
+              "start_unix": s["start_unix"], "dur": s["dur"]}
+             for s in doc["spans"]]
+    rebuilt = tracectx.build_waterfall(doc["trace_id"], spans)
+    if rebuilt["span_count"] != doc["span_count"]:
+        print("xray --self-test FAILED: rebuild span count "
+              f"{rebuilt['span_count']} != {doc['span_count']}",
+              file=sys.stderr)
+        return 1
+    render_waterfall(rebuilt)
+    print("xray --self-test OK")
+    return 0
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.xray",
+        description="Render a request X-ray trace as an ASCII "
+                    "waterfall.")
+    ap.add_argument("trace", nargs="?",
+                    help="path to a paddle_tpu.xray.v1 JSON document "
+                         "('-' = stdin)")
+    ap.add_argument("--url", help="live endpoint root; fetches "
+                                  "GET /trace/<id>")
+    ap.add_argument("--trace-id", help="trace id for --url")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw document instead of rendering")
+    ap.add_argument("--self-test", action="store_true",
+                    help="parse + render the bundled fixture and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    try:
+        if args.url:
+            if not args.trace_id:
+                ap.error("--url needs --trace-id")
+            doc = _fetch_url(args.url, args.trace_id)
+        elif args.trace == "-":
+            doc = json.load(sys.stdin)
+        elif args.trace:
+            with open(args.trace) as f:
+                doc = json.load(f)
+        else:
+            ap.error("give a trace file, '-', or --url/--trace-id")
+            return 2
+    except OSError as e:
+        print(f"xray: cannot load trace: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"xray: malformed JSON: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    try:
+        print(render_waterfall(doc))
+    except ValueError as e:
+        print(f"xray: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

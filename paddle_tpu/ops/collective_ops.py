@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.jax_compat import axis_size
 from ..framework.registry import register_op, single_input
 
 
@@ -76,7 +77,7 @@ def _c_ppermute(ctx, ins, attrs):
     x = single_input(ins)
     axis_name = attrs.get("axis_name", "data")
     shift = int(attrs.get("shift", 1))
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return {"Out": [jax.lax.ppermute(x, axis_name, perm)]}
 

@@ -27,6 +27,23 @@ def _op_key(ctx, attrs):
     return ctx.rng()
 
 
+def _replicated_draw(ctx, value):
+    """Pin a random draw REPLICATED under the implicit-SPMD mesh plane
+    (executor sets ctx.spmd_mesh there, and only there).  The legacy
+    threefry lowering yields different bits when GSPMD partitions the
+    generation, so a sharded Parameter's init would silently diverge
+    from the single-device run; generating replicated and letting the
+    partitioner reshard the RESULT keeps the stream identical under
+    any layout.  No-op single-device and inside shard_map (manual
+    axes; per-device draws there are deliberate)."""
+    mesh = getattr(ctx, "spmd_mesh", None)
+    if mesh is None:
+        return value
+    return jax.lax.with_sharding_constraint(
+        value, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()))
+
+
 @register_op("fill_constant")
 def _fill_constant(ctx, ins, attrs):
     dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
@@ -57,8 +74,8 @@ def _uniform_random_bsl(ctx, ins, attrs):
         int(attrs.get("input_dim_idx", 0))]
     dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
     lo, hi = float(attrs.get("min", -1.0)), float(attrs.get("max", 1.0))
-    u = jax.random.uniform(_op_key(ctx, attrs), tuple(shape), jnp.float32,
-                           lo, hi)
+    u = _replicated_draw(ctx, jax.random.uniform(
+        _op_key(ctx, attrs), tuple(shape), jnp.float32, lo, hi))
     return {"Out": [u.astype(dtype)]}
 
 
@@ -69,7 +86,8 @@ def _gaussian_random_bsl(ctx, ins, attrs):
     shape[int(attrs.get("output_dim_idx", 0))] = x.shape[
         int(attrs.get("input_dim_idx", 0))]
     dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
-    g = jax.random.normal(_op_key(ctx, attrs), tuple(shape), jnp.float32)
+    g = _replicated_draw(ctx, jax.random.normal(
+        _op_key(ctx, attrs), tuple(shape), jnp.float32))
     return {"Out": [(g * float(attrs.get("std", 1.0))
                      + float(attrs.get("mean", 0.0))).astype(dtype)]}
 
@@ -92,7 +110,8 @@ def _uniform_random(ctx, ins, attrs):
     dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
     shape = tuple(attrs["shape"])
     lo, hi = float(attrs.get("min", -1.0)), float(attrs.get("max", 1.0))
-    u = jax.random.uniform(_op_key(ctx, attrs), shape, jnp.float32, lo, hi)
+    u = _replicated_draw(ctx, jax.random.uniform(
+        _op_key(ctx, attrs), shape, jnp.float32, lo, hi))
     return {"Out": [u.astype(dtype)]}
 
 
@@ -101,7 +120,8 @@ def _gaussian_random(ctx, ins, attrs):
     dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
     shape = tuple(attrs["shape"])
     mean, std = float(attrs.get("mean", 0.0)), float(attrs.get("std", 1.0))
-    g = jax.random.normal(_op_key(ctx, attrs), shape, jnp.float32)
+    g = _replicated_draw(ctx, jax.random.normal(
+        _op_key(ctx, attrs), shape, jnp.float32))
     return {"Out": [(g * std + mean).astype(dtype)]}
 
 
@@ -110,8 +130,8 @@ def _trunc_gaussian(ctx, ins, attrs):
     dtype = to_jnp_dtype(attrs.get("dtype", "float32"))
     shape = tuple(attrs["shape"])
     mean, std = float(attrs.get("mean", 0.0)), float(attrs.get("std", 1.0))
-    g = jax.random.truncated_normal(_op_key(ctx, attrs), -2.0, 2.0, shape,
-                                    jnp.float32)
+    g = _replicated_draw(ctx, jax.random.truncated_normal(
+        _op_key(ctx, attrs), -2.0, 2.0, shape, jnp.float32))
     return {"Out": [(g * std + mean).astype(dtype)]}
 
 

@@ -41,6 +41,16 @@ def init_distributed_env(coordinator_address: Optional[str] = None,
     process_id = process_id if process_id is not None else rank
     if num_processes <= 1 or coordinator_address is None:
         return False
+    # CPU worlds need an explicit cross-process collectives backend:
+    # without it XLA's CPU client raises "Multiprocess computations
+    # aren't implemented" at the first collective dispatch.  Best-effort
+    # (older jaxlibs lack the option; TPU/GPU never needs it).
+    try:
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

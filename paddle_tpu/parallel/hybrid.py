@@ -36,6 +36,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import jax_compat
 from .topology import grad_reduce_axes
 
 
@@ -372,11 +373,10 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
     ospecs = {"m": specs, "v": specs, "step": P()}
     data_spec = P("dp", None)
 
-    sharded = jax.shard_map(
+    sharded = jax_compat.shard_map(
         device_step, mesh=mesh,
         in_specs=(pspecs, ospecs, data_spec, data_spec),
-        out_specs=(pspecs, ospecs, P()),
-        check_vma=False)
+        out_specs=(pspecs, ospecs, P()), check_rep=False)
 
     jitted = jax.jit(sharded, donate_argnums=(0, 1))
 

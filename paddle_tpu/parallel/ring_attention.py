@@ -24,6 +24,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import jax_compat
 from .topology import grad_reduce_axes
 
 NEG_INF = -1e30
@@ -36,7 +37,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     Returns [B, Ts, H, hd].  Chunk i holds global positions
     [i*Ts, (i+1)*Ts); causal masking is exact across chunks.
     """
-    cp = lax.axis_size(axis_name)
+    cp = jax_compat.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     B, Ts, H, hd = q.shape
     scale = 1.0 / np.sqrt(hd)
@@ -178,8 +179,8 @@ def cp_build_train_step(mesh: Mesh, cfg: ContextParallelConfig):
         return new_p, loss
 
     data_spec = P("dp", "cp")
-    sharded = jax.shard_map(device_step, mesh=mesh,
-                            in_specs=(specs, data_spec, data_spec),
-                            out_specs=(specs, P()),
-                            check_vma=False)
+    sharded = jax_compat.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(specs, P()), check_rep=False)
     return jax.jit(sharded, donate_argnums=(0,))

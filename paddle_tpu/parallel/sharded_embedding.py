@@ -37,6 +37,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import jax_compat
+
 
 def row_sharded_lookup(local_table, ids, axis_name: str = "model"):
     """Per-device (inside shard_map): gather rows of a row-sharded table.
@@ -174,11 +176,10 @@ def build_ctr_train_step(mesh: Mesh, cfg: ShardedCTRConfig):
 
     specs = param_specs(cfg)
     data_spec = P("data", None)
-    sharded = jax.shard_map(
+    sharded = jax_compat.shard_map(
         device_step, mesh=mesh,
         in_specs=(specs, data_spec, data_spec, data_spec),
-        out_specs=(specs, P()),
-        check_vma=False)
+        out_specs=(specs, P()), check_rep=False)
     return jax.jit(sharded, donate_argnums=(0,))
 
 

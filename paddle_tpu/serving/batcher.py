@@ -39,8 +39,13 @@ from typing import Dict, List, Optional, Sequence
 from ..core import flags
 from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
+from ..observability import tracectx as obs_tracectx
 from ..resilience import chaos
 from .kv_cache import DecodeEngine
+
+# decode spans are flushed per CHUNK tokens (one span per token would
+# bloat the store; one per request would hide mid-decode stalls)
+_DECODE_CHUNK_TOKENS = 8
 
 _m_queue_depth = obs_metrics.gauge(
     "serving_queue_depth",
@@ -94,10 +99,12 @@ class ServingRequest:
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "eos_id",
                  "tokens", "status", "error", "submit_t", "first_token_t",
-                 "finish_t", "_done")
+                 "finish_t", "_done", "trace", "submit_unix", "admit_t",
+                 "_chunk_t0", "_chunk_unix", "_chunk_tokens")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
-                 temperature: float, eos_id: Optional[int]):
+                 temperature: float, eos_id: Optional[int],
+                 trace: Optional[obs_tracectx.TraceContext] = None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -106,11 +113,57 @@ class ServingRequest:
         self.status = "pending"       # -> ok | error | drained
         self.error: Optional[str] = None
         self.submit_t = time.perf_counter()
+        self.submit_unix = time.time()
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
         self._done = threading.Event()
+        # request X-ray (observability/tracectx.py): the trace this
+        # request's queue-wait/prefill/decode spans land under.  Minted
+        # at submit() when tracing is on and none was handed in (the
+        # HTTP route passes the client's traceparent-derived context).
+        self.trace = trace
+        self.admit_t: Optional[float] = None
+        self._chunk_t0: Optional[float] = None
+        self._chunk_unix: Optional[float] = None
+        self._chunk_tokens = 0
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
 
     # -- batcher side -------------------------------------------------------
+    def _span(self, name: str, start_unix: float, start_perf: float,
+              dur: float, kind: str, **attrs):
+        if self.trace is None:
+            return
+        obs_tracectx.record_span(
+            name, self.trace.trace_id, obs_tracectx.new_span_id(),
+            self.trace.span_id, start_unix, start_perf, dur, kind=kind,
+            attrs=attrs or None)
+
+    def _flush_decode_chunk(self, now: float):
+        """Emit the accumulated decode-chunk span (a window of up to
+        _DECODE_CHUNK_TOKENS tokens) — mid-decode stalls then show as a
+        long chunk instead of vanishing into one request-wide span."""
+        if self.trace is None or self._chunk_t0 is None \
+                or self._chunk_tokens == 0:
+            return
+        self._span("serving.decode", self._chunk_unix, self._chunk_t0,
+                   now - self._chunk_t0, "decode",
+                   tokens=self._chunk_tokens)
+        self._chunk_t0 = None
+        self._chunk_tokens = 0
+
+    def _note_token(self, now: float):
+        if self.trace is None:
+            return
+        if self._chunk_t0 is None:
+            self._chunk_t0 = now
+            self._chunk_unix = time.time()
+        self._chunk_tokens += 1
+        if self._chunk_tokens >= _DECODE_CHUNK_TOKENS:
+            self._flush_decode_chunk(time.perf_counter())
+
     def _finish(self, status: str, error: Optional[str] = None):
         if self._done.is_set():      # terminal exactly once (a stop()
             return                   # after loop exit must not recount)
@@ -118,7 +171,43 @@ class ServingRequest:
         self.error = error
         self.finish_t = time.perf_counter()
         _m_requests.labels(status=status).inc()
+        if self.trace is not None:
+            self._flush_decode_chunk(self.finish_t)
+            self._span("serving.retire", time.time(), self.finish_t,
+                       0.0, "marker", status=status)
+            # the ROOT span: the whole request, submit -> terminal
+            obs_tracectx.record_span(
+                "serving.request", self.trace.trace_id,
+                self.trace.span_id, None, self.submit_unix,
+                self.submit_t, self.finish_t - self.submit_t,
+                kind="request",
+                attrs={"status": status, "tokens": len(self.tokens),
+                       "prompt_len": len(self.prompt),
+                       **({"error": error[:120]} if error else {})})
+            self._maybe_capture_slo()
         self._done.set()
+
+    def _maybe_capture_slo(self):
+        """Flight-style capture keyed by trace id when this request
+        breached the serving_p99_budget_ms SLO (TTFT or per-token) —
+        the evidence survives span-store eviction and is served by
+        GET /trace/<id>."""
+        budget_ms = float(flags.get_flag("serving_p99_budget_ms"))
+        if budget_ms <= 0 or self.status != "ok" \
+                or self.first_token_t is None:
+            return
+        ttft_ms = (self.first_token_t - self.submit_t) * 1e3
+        per_tok_ms = None
+        if len(self.tokens) > 1 and self.finish_t is not None:
+            per_tok_ms = ((self.finish_t - self.first_token_t)
+                          / (len(self.tokens) - 1)) * 1e3
+        if ttft_ms > budget_ms or (per_tok_ms is not None
+                                   and per_tok_ms > budget_ms):
+            obs_tracectx.capture(
+                self.trace.trace_id, "slo_breach",
+                budget_ms=budget_ms, ttft_ms=round(ttft_ms, 3),
+                per_token_ms=None if per_tok_ms is None
+                else round(per_tok_ms, 3))
 
     # -- client side --------------------------------------------------------
     def done(self) -> bool:
@@ -136,10 +225,13 @@ class ServingRequest:
                 else self.first_token_t - self.submit_t)
         total = (None if self.finish_t is None
                  else self.finish_t - self.submit_t)
-        return {"status": self.status, "tokens": list(self.tokens),
-                "n_tokens": len(self.tokens),
-                "error": self.error,
-                "ttft_s": ttft, "latency_s": total}
+        doc = {"status": self.status, "tokens": list(self.tokens),
+               "n_tokens": len(self.tokens),
+               "error": self.error,
+               "ttft_s": ttft, "latency_s": total}
+        if self.trace is not None:
+            doc["trace_id"] = self.trace.trace_id
+        return doc
 
 
 class ContinuousBatcher:
@@ -268,15 +360,28 @@ class ContinuousBatcher:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
-               eos_id: Optional[int] = None) -> ServingRequest:
+               eos_id: Optional[int] = None,
+               trace: Optional[obs_tracectx.TraceContext] = None
+               ) -> ServingRequest:
         """Admit one request (bounded queue) — raises ShedError past
-        serving_queue_limit or while draining."""
+        serving_queue_limit or while draining.  ``trace`` carries an
+        upstream traceparent-derived context (the HTTP route); without
+        one, a fresh trace is minted per request when request_tracing
+        is on — EVERY admitted request has a retrievable X-ray."""
         chaos.trigger("serving.admit", ConnectionAbortedError)
         if not self.running:
             raise RuntimeError("serving batcher is not running")
         if max_new_tokens is None:
             max_new_tokens = int(flags.get_flag("serving_max_new_tokens"))
-        req = ServingRequest(prompt, max_new_tokens, temperature, eos_id)
+        if trace is None:
+            # a CHILD of any ambient context, never the ambient context
+            # itself: two submits under one traced scope must not share
+            # a root span id (span-id dedupe would collapse their
+            # roots); no ambient -> a fresh trace per request
+            trace = obs_tracectx.start_trace(
+                "serving.request", parent=obs_tracectx.current())
+        req = ServingRequest(prompt, max_new_tokens, temperature, eos_id,
+                             trace=trace)
         # validate NOW so a hopeless request is an error at the door,
         # not a dead slot later (bucket fit AND room to generate)
         self.engine.validate_prompt(len(req.prompt))
@@ -332,9 +437,20 @@ class ContinuousBatcher:
                 req = self._queue.pop(0)
                 _m_queue_depth.set(len(self._queue))
                 slot = free[0]
+            req.admit_t = time.perf_counter()
+            # X-ray: how long the request sat behind admission control
+            req._span("serving.queue_wait", req.submit_unix,
+                      req.submit_t, req.admit_t - req.submit_t, "queue",
+                      queue_depth=len(self._queue))
+            t_pf_unix, t_pf = time.time(), time.perf_counter()
             try:
-                first = self.engine.start_sequence(
-                    slot, req.prompt, temperature=req.temperature)
+                # activate the request's context for the dispatch: the
+                # engine's prefill histogram gains this trace's
+                # exemplar, and a lazy bucket compile inside
+                # start_sequence lands INSIDE this request's timeline
+                with obs_tracectx.activate(req.trace):
+                    first = self.engine.start_sequence(
+                        slot, req.prompt, temperature=req.temperature)
             except Exception as e:
                 # the dispatch donates the K/V slabs, so ANY prefill
                 # failure may have invalidated the cache for everyone
@@ -349,7 +465,13 @@ class ContinuousBatcher:
                 self._fail_pending_active(e)
                 continue
             req.first_token_t = time.perf_counter()
-            _m_ttft.observe(req.first_token_t - req.submit_t)
+            req._span("serving.prefill", t_pf_unix, t_pf,
+                      req.first_token_t - t_pf, "prefill",
+                      bucket=self.engine.bucket_for(len(req.prompt)),
+                      slot=slot)
+            with obs_tracectx.activate(req.trace):
+                # TTFT exemplar: the p99 bucket links to THIS trace
+                _m_ttft.observe(req.first_token_t - req.submit_t)
             req.tokens.append(first)
             _m_tokens.inc()
             with self._lock:
@@ -413,7 +535,8 @@ class ContinuousBatcher:
                                   error=repr(e)[:200])
                 self._fail_pending_active(e)
                 continue
-            dt = time.perf_counter() - t0
+            now = time.perf_counter()
+            dt = now - t0
             _m_step.observe(dt)
             for slot, tok in out.items():
                 req = active.get(slot)
@@ -421,7 +544,14 @@ class ContinuousBatcher:
                     continue
                 req.tokens.append(tok)
                 _m_tokens.inc()
-                _m_token_latency.observe(dt)
+                if req.trace is not None:
+                    # per-slot exemplar: the per-token p99 bucket links
+                    # to the trace that was decoding in that step
+                    with obs_tracectx.activate(req.trace):
+                        _m_token_latency.observe(dt)
+                    req._note_token(t0)
+                else:
+                    _m_token_latency.observe(dt)
                 self._maybe_finish(slot, req, tok)
             self._publish_gauges()
 

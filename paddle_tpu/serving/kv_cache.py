@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from ..core import flags
 from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
+from ..observability import tracectx as obs_tracectx
 
 _m_compiles = obs_metrics.counter(
     "serving_compiles_total",
@@ -283,6 +284,36 @@ class DecodeEngine:
             lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
                                            jnp.result_type(a)), like)
 
+    def _compile_prefill(self, bucket: int, kind: str) -> float:
+        """AOT-compile one prompt bucket's prefill executable; returns
+        the compile seconds.  ``kind`` labels serving_compiles_total:
+        "prefill" from prepare(), "prefill_lazy" when a request-path
+        miss compiled it under serving_lazy_bucket_compile — tagged
+        with the triggering request's trace so the recompile shows in
+        that request's own timeline."""
+        p_sds = self._sds(self._params)
+        kv_sds = self._sds(self._kv_k)
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        key_sds = self._sds(self._keys[0])
+        tb = time.perf_counter()
+        # donate the K/V slabs: the old cache is dead the moment the
+        # call returns, so XLA updates in place instead of copying two
+        # [L,B,H,T,dh] buffers per dispatch
+        with obs_tracectx.span("serving.compile_bucket", kind="compile",
+                               bucket=bucket, lazy=(kind != "prefill")):
+            self._compiled_prefill[bucket] = jax.jit(
+                self._prefill_fn(bucket), donate_argnums=(1, 2)).lower(
+                p_sds, kv_sds, kv_sds,
+                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                i32, i32, key_sds, f32).compile()
+        dt = time.perf_counter() - tb
+        _m_compiles.labels(kind=kind).inc()
+        obs_flight.record("compile", f"serving.prefill[{bucket}]",
+                          bucket=bucket, compile_kind=kind,
+                          trace_id=obs_tracectx.current_trace_id())
+        return dt
+
     def prepare(self) -> dict:
         """AOT-compile the full bucket grid + the decode step NOW, so
         serving startup cost is one call and the request path never
@@ -292,26 +323,11 @@ class DecodeEngine:
         report = {}
         p_sds = self._sds(self._params)
         kv_sds = self._sds(self._kv_k)
-        i32 = jax.ShapeDtypeStruct((), jnp.int32)
-        f32 = jax.ShapeDtypeStruct((), jnp.float32)
-        key_sds = self._sds(self._keys[0])
         for bucket in self.prompt_buckets:
             if bucket in self._compiled_prefill:
                 continue
-            tb = time.perf_counter()
-            # donate the K/V slabs: the old cache is dead the moment
-            # the call returns, so XLA updates in place instead of
-            # copying two [L,B,H,T,dh] buffers per dispatch
-            self._compiled_prefill[bucket] = jax.jit(
-                self._prefill_fn(bucket), donate_argnums=(1, 2)).lower(
-                p_sds, kv_sds, kv_sds,
-                jax.ShapeDtypeStruct((bucket,), jnp.int32),
-                i32, i32, key_sds, f32).compile()
             report[f"prefill_{bucket}"] = round(
-                time.perf_counter() - tb, 3)
-            _m_compiles.labels(kind="prefill").inc()
-            obs_flight.record("compile", f"serving.prefill[{bucket}]",
-                              bucket=bucket)
+                self._compile_prefill(bucket, kind="prefill"), 3)
         if self._compiled_step is None:
             tb = time.perf_counter()
             B = self.max_batch
@@ -372,6 +388,19 @@ class DecodeEngine:
     def occupancy(self) -> float:
         return float(self._active.sum()) / float(self.max_batch)
 
+    def add_bucket(self, bucket: int):
+        """Grow the prompt-bucket grid after construction (an operator
+        widening the grid on a live replica).  The new bucket compiles
+        at the next prepare() — or lazily on first hit when
+        serving_lazy_bucket_compile is on, attributed to the
+        triggering request's trace."""
+        bucket = int(bucket)
+        if bucket > self.max_len:
+            raise ValueError(
+                f"bucket {bucket} exceeds max_len {self.max_len}")
+        if bucket not in self.prompt_buckets:
+            self.prompt_buckets = sorted(self.prompt_buckets + [bucket])
+
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.prompt_buckets:
             if prompt_len <= b:
@@ -383,15 +412,26 @@ class DecodeEngine:
     def validate_prompt(self, prompt_len: int) -> int:
         """Every at-the-door rejection in one place (the batcher calls
         this BEFORE queueing, so a hopeless request errors at submit,
-        not as a dead slot later): bucket fit AND room to generate.
-        Returns the bucket."""
+        not as a dead slot later): bucket fit, room to generate, AND —
+        unless serving_lazy_bucket_compile allows a request-path
+        compile — a PREPARED bucket.  Without that last check an
+        add_bucket() not followed by prepare() would admit requests
+        that then raise mid-prefill, where the batcher's donated-cache
+        recovery fails every in-flight request.  Returns the bucket."""
         if prompt_len < 1:
             raise ValueError("empty prompt")
         if prompt_len >= self.max_len:
             raise ValueError(
                 f"prompt length {prompt_len} leaves no room to "
                 f"generate (max_len {self.max_len})")
-        return self.bucket_for(prompt_len)
+        bucket = self.bucket_for(prompt_len)
+        if bucket not in self._compiled_prefill \
+                and not flags.get_flag("serving_lazy_bucket_compile"):
+            raise ValueError(
+                f"prompt bucket {bucket} is not prepared — call "
+                f"prepare() (or enable serving_lazy_bucket_compile "
+                f"to pay the compile on the request path)")
+        return bucket
 
     def remaining_capacity(self, slot: int) -> int:
         """Tokens this slot can still EMIT.  The cache holds positions
@@ -410,9 +450,16 @@ class DecodeEngine:
         bucket = self.validate_prompt(n)
         fn = self._compiled_prefill.get(bucket)
         if fn is None:
-            raise RuntimeError(
-                f"bucket {bucket} not prepared — call prepare() before "
-                "serving (request-path compiles are forbidden)")
+            if not flags.get_flag("serving_lazy_bucket_compile"):
+                raise RuntimeError(
+                    f"bucket {bucket} not prepared — call prepare() "
+                    "before serving (request-path compiles are "
+                    "forbidden)")
+            # opt-in escape hatch: compile NOW, attributed — the span
+            # lands inside the active request's X-ray timeline, so "why
+            # was this one slow" answers itself with the compile bar
+            self._compile_prefill(bucket, kind="prefill_lazy")
+            fn = self._compiled_prefill[bucket]
         toks = np.zeros((bucket,), np.int32)
         toks[:n] = np.asarray(prompt, np.int32)
         t0 = time.perf_counter()

@@ -102,7 +102,10 @@ def run_loadgen(submit: SubmitFn, streams: int = 8,
               "gave_up": 0, "tokens": 0}
     ttfts: List[float] = []
     per_token: List[float] = []
-    lock = threading.Lock()
+    trace_ids: List[str] = []      # X-ray: one per ok response that
+    lock = threading.Lock()        # carried a trace_id (all of them,
+    # when request_tracing is on) — the soak's every-request-has-a-
+    # retrievable-trace check reads this
 
     def stream(sid: int):
         rng = np.random.RandomState(seed * 1000 + sid)
@@ -133,6 +136,8 @@ def run_loadgen(submit: SubmitFn, streams: int = 8,
                 with lock:
                     counts["ok"] += 1
                     counts["tokens"] += int(resp.get("n_tokens") or 0)
+                    if resp.get("trace_id"):
+                        trace_ids.append(str(resp["trace_id"]))
                     if resp.get("ttft_s") is not None:
                         ttfts.append(float(resp["ttft_s"]))
                     if (resp.get("latency_s") is not None
@@ -176,6 +181,7 @@ def run_loadgen(submit: SubmitFn, streams: int = 8,
             "p99": p99_tok_ms},
         "p99_budget_ms": p99_budget_ms,
         "budget_ok": budget_ok,
+        "trace_ids": trace_ids,
         "ok": accounted and budget_ok and counts["gave_up"] == 0
               and counts["ok"] == streams * requests_per_stream,
     }

@@ -71,7 +71,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     serving.attach(batcher)
     batcher.install_signal_handlers()
     srv = obs_server.start_http_server(port=port)
-    print(f"SERVING_READY {srv.url}", flush=True)
+    # cold-start headline (ROADMAP item 1): process exec to "can answer
+    # a request" — interpreter + imports + model build + the whole AOT
+    # bucket-grid compile.  On /metrics and in the bench/soak dumps so
+    # the persistent-compilation-cache PR has a gated before/after.
+    from paddle_tpu import observability as obs
+    ready_s = time.time() - obs.process_start_unix()
+    obs.metrics.gauge(
+        "serving_ready_seconds",
+        "Serving cold start: process start to the ready line (model "
+        "build + AOT prefill-grid/decode compile included).").set(
+        ready_s)
+    print(f"SERVING_READY {srv.url} ready_s={ready_s:.2f}", flush=True)
     try:
         while batcher.running:
             time.sleep(0.1)

@@ -39,6 +39,7 @@ from .observability import metrics as obs_metrics
 from .observability import server as obs_server
 from .observability import tensorstats as obs_tensorstats
 from .observability import trace as obs_trace
+from .observability import tracectx as obs_tracectx
 from .resilience import chaos, guard as rguard, retry as rretry
 
 # --- telemetry: the training-loop view (throughput, loss health) --------
@@ -108,6 +109,14 @@ _m_mfu = obs_metrics.gauge(
     "Model FLOPs utilization of the last train step vs the device peak "
     "(device_peak_flops flag, or the per-platform table; unset peak = "
     "gauge not exported).")
+_m_restart_to_first_step = obs_metrics.gauge(
+    "restart_to_first_step_seconds",
+    "Cold-start cost: process start (exec, /proc anchor) to the FIRST "
+    "completed train step of this process — interpreter + imports + "
+    "program build + compile + dispatch.  The before/after number the "
+    "persistent-compilation-cache work is gated on (ROADMAP item 1).")
+# set once per process: a second train() call is warm, not a restart
+_first_step_recorded = False
 _EMA_DECAY = 0.9
 # device-memory sampling cadence: the live_arrays()/memory_stats() walk
 # is O(resident arrays), too heavy for every step of a big model
@@ -427,7 +436,13 @@ class Trainer:
                         # any sample this dispatch lands (fleet rows
                         # must align across worker restarts)
                         obs_tensorstats.note_position(epoch_id, step_id)
-                    with chaos.fault_point("trainer.step"):
+                    # request-X-ray twin of the serving plane: every
+                    # step gets its own trace id; the executor's
+                    # dispatch span and any compile it triggers land
+                    # inside it (None when request_tracing is off)
+                    step_ctx = obs_tracectx.start_trace("trainer.step")
+                    with obs_tracectx.activate(step_ctx), \
+                            chaos.fault_point("trainer.step"):
                         # --- host: dispatch without blocking ----------
                         th = time.perf_counter()
                         if begin.fetch_metrics:
@@ -454,14 +469,23 @@ class Trainer:
                     metrics = chaos.poison("trainer.step", metrics)
                     dt = time.perf_counter() - t0 - handler_s
                     _m_steps.inc()
-                    _m_step_seconds.observe(dt)
-                    _m_data_wait_seconds.observe(data_wait)
-                    _m_host_seconds.observe(host_s)
-                    if fetched:
-                        # no-fetch steps (begin.fetch_metrics=False)
-                        # never block on the device; recording their ~0
-                        # would drown the real device distribution
-                        _m_device_seconds.observe(device_s)
+                    self._note_first_step()
+                    with obs_tracectx.activate(step_ctx):
+                        # step-latency exemplars link the histogram's
+                        # slow buckets back to this step's trace
+                        _m_step_seconds.observe(dt)
+                        _m_data_wait_seconds.observe(data_wait)
+                        _m_host_seconds.observe(host_s)
+                        if fetched:
+                            # no-fetch steps (begin.fetch_metrics=False)
+                            # never block on the device; recording
+                            # their ~0 would drown the real device
+                            # distribution
+                            _m_device_seconds.observe(device_s)
+                    if step_ctx is not None:
+                        self._record_step_spans(
+                            step_ctx, epoch_id, step_id, t0, dt,
+                            data_wait, th, host_s, td, device_s)
                     obs_trace.add_span("trainer.data_wait", t0, data_wait,
                                        tid=obs_trace.TRAINER_TID,
                                        cat="trainer")
@@ -479,6 +503,8 @@ class Trainer:
                     raw_loss = None
                     guard_verdict = None
                     self._runlog_pos = (epoch_id, step_id, step_in_total)
+                    self._step_trace_id = (step_ctx.trace_id
+                                           if step_ctx else None)
                     if metrics:
                         raw_loss = loss_val = \
                             float(np.mean(np.asarray(metrics[0])))
@@ -578,6 +604,44 @@ class Trainer:
                 f"{100 * frac:.0f}%) — {fix}", RuntimeWarning,
                 stacklevel=3)
 
+    def _note_first_step(self):
+        """Publish restart_to_first_step_seconds ONCE per process —
+        the cold-start headline number (ROADMAP item 1): exec() to the
+        first completed optimizer step, compile included."""
+        global _first_step_recorded
+        if _first_step_recorded:
+            return
+        _first_step_recorded = True
+        cold = time.time() - observability.process_start_unix()
+        _m_restart_to_first_step.set(cold)
+        obs_flight.record("trainer", "first_step",
+                          restart_to_first_step_seconds=round(cold, 3))
+        if self._runlog is not None:
+            self._runlog.write(kind="meta", event="first_step",
+                               restart_to_first_step_seconds=cold)
+
+    def _record_step_spans(self, step_ctx, epoch_id, step_id, t0, dt,
+                           data_wait, th, host_s, td, device_s):
+        """One X-ray trace per train step: the root span plus the
+        data-wait/host/device anatomy as children — the same split the
+        chrome-trace lanes carry, now addressable by trace id
+        (GET /trace/<id>, the xray CLI)."""
+        now_unix = time.time()
+        root = step_ctx
+        def child(name, start_perf, dur, kind):
+            obs_tracectx.record_span(
+                name, root.trace_id, obs_tracectx.new_span_id(),
+                root.span_id, now_unix - (time.perf_counter()
+                                          - start_perf),
+                start_perf, dur, kind=kind)
+        child("trainer.data_wait", t0, data_wait, "input")
+        child("trainer.host", th, host_s, "dispatch")
+        child("trainer.device", td, device_s, "device")
+        obs_tracectx.record_span(
+            "trainer.step", root.trace_id, root.span_id, None,
+            now_unix - (time.perf_counter() - t0), t0, dt,
+            kind="step", attrs={"epoch": epoch_id, "step": step_id})
+
     # -- resilience plumbing (resilience/, docs/RESILIENCE.md) -------------
     def _record_mfu(self, dt: float):
         """Export the cost-model MFU/TFLOPs gauges for one step.  FLOPs
@@ -614,6 +678,9 @@ class Trainer:
         rec = {"kind": "step", "epoch": epoch_id, "step": step_id,
                "global_step": global_step, "step_seconds": dt,
                "lr": self._lr_value()}
+        if getattr(self, "_step_trace_id", None):
+            # the durable history links each step to its X-ray trace
+            rec["trace_id"] = self._step_trace_id
         if dt > 0:
             rec["examples_per_sec"] = n_examples / dt
         if raw_loss is not None:

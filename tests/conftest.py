@@ -45,7 +45,8 @@ def fresh_programs():
     from paddle_tpu.distributed import task_queue
     from paddle_tpu.framework import executor as executor_mod
     from paddle_tpu.observability import costmodel, flight, forensics
-    from paddle_tpu.observability import runlog, tensorstats
+    from paddle_tpu.observability import deviceprof, metrics as obs_metrics
+    from paddle_tpu.observability import runlog, tensorstats, tracectx
     from paddle_tpu.observability import server as obs_server
     from paddle_tpu.resilience import chaos
     pt.reset_default_programs()
@@ -61,6 +62,12 @@ def fresh_programs():
     # file handles must not leak across cases
     tensorstats.reset()
     runlog.reset()
+    # request X-ray: traces/captures from one case must not resolve in
+    # the next (GET /trace, exemplar trace ids), and the device-prof
+    # capture latch must not read busy across cases
+    tracectx.reset()
+    obs_metrics.clear_exemplars()
+    deviceprof.reset()
     # static-analysis plane: drop test-registered infer rules, zero the
     # findings metric family, and restore the verify_program default so
     # an error-mode test cannot leak rejection semantics into the next

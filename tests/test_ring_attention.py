@@ -4,6 +4,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from paddle_tpu.core import jax_compat
 from paddle_tpu.parallel import ring_attention as ra
 from paddle_tpu.parallel import topology
 
@@ -17,11 +18,11 @@ def test_ring_matches_plain_attention():
     ref = np.asarray(ra.plain_attention(jnp.asarray(q), jnp.asarray(k),
                                         jnp.asarray(v), causal=True))
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(jax_compat.shard_map(
         lambda q, k, v: ra.ring_attention(q, k, v, "cp", causal=True),
         mesh=mesh,
         in_specs=(P(None, "cp"), P(None, "cp"), P(None, "cp")),
-        out_specs=P(None, "cp"), check_vma=False))
+        out_specs=P(None, "cp"), check_rep=False))
     out = np.asarray(fn(q, k, v))
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
@@ -33,11 +34,11 @@ def test_ring_non_causal_matches():
     q, k, v = [rng.randn(B, T, H, hd).astype("float32") for _ in range(3)]
     ref = np.asarray(ra.plain_attention(jnp.asarray(q), jnp.asarray(k),
                                         jnp.asarray(v), causal=False))
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(jax_compat.shard_map(
         lambda q, k, v: ra.ring_attention(q, k, v, "cp", causal=False),
         mesh=mesh,
         in_specs=(P("dp", "cp"), P("dp", "cp"), P("dp", "cp")),
-        out_specs=P("dp", "cp"), check_vma=False))
+        out_specs=P("dp", "cp"), check_rep=False))
     out = np.asarray(fn(q, k, v))
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 

@@ -11,6 +11,7 @@ import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers, models
+from paddle_tpu.core import jax_compat
 from paddle_tpu.core.place import make_mesh
 from paddle_tpu.parallel import sharded_embedding as se
 
@@ -29,12 +30,12 @@ def test_row_sharded_lookup_matches_take():
     def f(table, ids):
         return se.row_sharded_lookup(table, ids)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(jax_compat.shard_map(
         f, mesh=mesh,
         in_specs=(jax.sharding.PartitionSpec("model", None),
                   jax.sharding.PartitionSpec("data", None)),
         out_specs=jax.sharding.PartitionSpec("data", None, None),
-        check_vma=False))(table, ids)
+        check_rep=False))(table, ids)
     np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
 
 
