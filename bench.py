@@ -93,6 +93,26 @@ _BASIS = {
     "lstm_train_ms_per_batch":
         "reference's published LSTM text-class h512/T100/bs64: 184 "
         "ms/batch on K40m (benchmark/README.md)",
+    "restart_to_first_step_cold_seconds":
+        "no reference anchor (the reference persisted no compiled "
+        "artifacts); process exec to first completed Trainer step with "
+        "an EMPTY persistent executable cache (framework/jit_cache.py "
+        "--restart-probe child) — vs_baseline fixed at 1.0, this row "
+        "IS the bar the warm row beats",
+    "restart_to_first_step_warm_seconds":
+        "same probe, second process against the SAME jit_cache dir: "
+        "executables deserialize instead of compiling "
+        "(executor_compile_total == 0 asserted); vs_baseline = "
+        "cold/warm speedup",
+    "serving_ready_cold_seconds":
+        "no reference anchor (the C-API tier had no serving cold-start "
+        "story); serving worker process exec to the SERVING_READY line "
+        "(full AOT bucket-grid compile) with an empty jit_cache dir — "
+        "vs_baseline fixed at 1.0",
+    "serving_ready_warm_seconds":
+        "same worker restarted against the SAME jit_cache dir: the "
+        "bucket grid + decode step deserialize instead of compiling; "
+        "vs_baseline = cold/warm speedup",
 }
 
 
@@ -547,6 +567,153 @@ def bench_lm_serving(on_tpu):
     }
 
 
+# --- cold-start rows (ROADMAP item 1): restart-twice measurement ----------
+# One shared state per flagship: the cold fn runs the child process
+# twice against one fresh jit_cache dir and memoizes both numbers; the
+# warm fn reads the memo.  Separate workload fns keep one gated row per
+# runlog step index (the PR 7 alignment contract).
+_RESTART_STATE = {}
+
+
+def _probe_restart_lm():
+    """Run the jit_cache CLI's Trainer-based restart probe twice
+    (subprocesses) against one fresh cache dir; returns (cold, warm)
+    probe dicts.  The warm run must record ZERO executor compiles and
+    identical losses — a wrong-but-fast warm start must fail the row,
+    not publish it."""
+    import subprocess
+    import sys
+    import tempfile
+    out = []
+    with tempfile.TemporaryDirectory() as td:
+        for _ in range(2):
+            env = dict(os.environ)
+            env["PTPU_JIT_CACHE_DIR"] = td
+            proc = subprocess.run(
+                [sys.executable, "-m",
+                 "paddle_tpu.framework.jit_cache",
+                 "--restart-probe", "lm"],
+                env=env, capture_output=True, text=True, timeout=600)
+            line = [ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("RESTART_PROBE ")]
+            if proc.returncode != 0 or not line:
+                raise RuntimeError(
+                    f"restart probe failed rc={proc.returncode}: "
+                    f"{(proc.stderr or proc.stdout)[-400:]}")
+            out.append(json.loads(line[-1][len("RESTART_PROBE "):]))
+    cold, warm = out
+    if warm["executor_compile_total"] != 0:
+        raise RuntimeError(
+            f"warm restart recompiled "
+            f"({warm['executor_compile_total']} compiles) — the "
+            f"persistent cache missed: {warm}")
+    if warm["losses"] != cold["losses"]:
+        raise RuntimeError(
+            f"warm restart diverged from cold: {cold['losses']} vs "
+            f"{warm['losses']}")
+    return cold, warm
+
+
+def _probe_serving_ready():
+    """Start the supervised serving worker twice against one fresh
+    cache dir and parse ready_s from its SERVING_READY line; SIGTERM
+    drains each instance.  Returns (cold_s, warm_s)."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    ready = []
+    with tempfile.TemporaryDirectory() as td:
+        for _ in range(2):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            env = dict(os.environ)
+            env["PTPU_JIT_CACHE_DIR"] = td
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.serving.worker",
+                 str(port), "7"],
+                env=env, stdout=subprocess.PIPE, text=True)
+            try:
+                import select
+                deadline = time.time() + 600
+                line = ""
+                while time.time() < deadline:
+                    # bounded wait: a worker that hangs WITHOUT
+                    # printing must not block bench forever (readline
+                    # alone would)
+                    rl, _, _ = select.select(
+                        [proc.stdout], [], [],
+                        max(0.0, deadline - time.time()))
+                    if not rl:
+                        break
+                    line = proc.stdout.readline()
+                    if line.startswith("SERVING_READY") or not line:
+                        break
+                if not line.startswith("SERVING_READY"):
+                    raise RuntimeError(
+                        "serving worker never reached SERVING_READY")
+                ready.append(float(line.rsplit("ready_s=", 1)[1]))
+            finally:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    return ready[0], ready[1]
+
+
+def _restart_lm_state():
+    if "lm" not in _RESTART_STATE:
+        _RESTART_STATE["lm"] = _probe_restart_lm()
+    return _RESTART_STATE["lm"]
+
+
+def _serving_ready_state():
+    if "serving" not in _RESTART_STATE:
+        _RESTART_STATE["serving"] = _probe_serving_ready()
+    return _RESTART_STATE["serving"]
+
+
+def bench_restart_cold(on_tpu):
+    cold, warm = _restart_lm_state()
+    return {"metric": "restart_to_first_step_cold_seconds",
+            "value": round(cold["restart_to_first_step_seconds"], 3),
+            "unit": "s", "vs_baseline": 1.0,
+            "config": "tiny-LM Trainer restart probe, empty jit_cache "
+                      "dir (framework/jit_cache.py --restart-probe)"}
+
+
+def bench_restart_warm(on_tpu):
+    cold, warm = _restart_lm_state()
+    cs = cold["restart_to_first_step_seconds"]
+    ws = warm["restart_to_first_step_seconds"]
+    return {"metric": "restart_to_first_step_warm_seconds",
+            "value": round(ws, 3), "unit": "s",
+            "vs_baseline": round(cs / ws, 3) if ws else 0.0,
+            "config": "same probe, warm jit_cache dir — zero XLA "
+                      "compiles asserted, losses bit-identical to "
+                      "cold"}
+
+
+def bench_serving_ready_cold(on_tpu):
+    cold_s, _ = _serving_ready_state()
+    return {"metric": "serving_ready_cold_seconds",
+            "value": round(cold_s, 3), "unit": "s", "vs_baseline": 1.0,
+            "config": "serving/worker.py exec -> SERVING_READY, empty "
+                      "jit_cache dir (full AOT grid compile)"}
+
+
+def bench_serving_ready_warm(on_tpu):
+    cold_s, warm_s = _serving_ready_state()
+    return {"metric": "serving_ready_warm_seconds",
+            "value": round(warm_s, 3), "unit": "s",
+            "vs_baseline": round(cold_s / warm_s, 3) if warm_s else 0.0,
+            "config": "same worker restarted on the warm jit_cache "
+                      "dir — grid + decode step deserialized"}
+
+
 def _record_row_metrics(row):
     """Publish one workload row through the observability registry, so
     BENCH_r*.json rows and a live process's /metrics share one schema
@@ -611,12 +778,15 @@ def main():
         "platform": jax.devices()[0].platform})
 
     rows, errors = [], {}
+    # cold-start rows ride LAST so earlier rows keep their historical
+    # runlog step indices (the PR 7 alignment contract)
     for wl_index, fn in enumerate((
             bench_lm, bench_lm_int8, bench_lm_fused_block,
             bench_resnet50, bench_nmt, bench_resnet50_infer,
             bench_resnet50_infer_int8, bench_alexnet,
             bench_googlenet, bench_lstm, bench_lm_8k,
-            bench_lm_serving)):
+            bench_lm_serving, bench_restart_cold, bench_restart_warm,
+            bench_serving_ready_cold, bench_serving_ready_warm)):
         try:
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide

@@ -124,6 +124,27 @@ define_flag("prefetch_depth", 0,
             "0 disables; feed build + host->device copy then happen "
             "synchronously inside the step's data wait.")
 
+define_flag("jit_cache_dir", "",
+            "Persistent executable cache (framework/jit_cache.py): "
+            "directory where compiled executables are serialized "
+            "(jax.experimental.serialize_executable) keyed by a stable "
+            "content hash (program topology, feed shapes/dtypes, fetch "
+            "names, state signature, numerics flags, jax/jaxlib/"
+            "backend identity), so a restarted process deserializes "
+            "its executables instead of recompiling — the Executor "
+            "step + run_steps loops, the Predictor AOT grid, and the "
+            "serving prefill-grid/decode step all ride it.  '' = off: "
+            "byte-identical pre-cache behavior (compile keys, outputs, "
+            "explain() reports).  Safe to share across a fleet: writes "
+            "are atomic-rename, corrupt/stale entries recompile with a "
+            "loud warning (jit_cache_errors_total), never a failed "
+            "start.")
+define_flag("jit_cache_limit_bytes", 2_000_000_000,
+            "Byte budget for the persistent executable cache dir; the "
+            "LRU GC (oldest mtime first; hits touch mtime) runs after "
+            "every store and via the jit_cache CLI --gc.  0 = "
+            "unlimited.")
+
 # --- compiled-program introspection (observability/: costmodel, flight) ----
 define_flag("cost_model", True,
             "Allow the XLA cost model (observability/costmodel.py) to "

@@ -98,6 +98,18 @@ class Supervisor:
         if incarnation > 0:
             env.update(self.restart_env)
         env["PTPU_WORKER_RESTART_COUNT"] = str(incarnation)
+        # persistent executable cache (framework/jit_cache.py): a
+        # supervisor-side jit_cache_dir flag reaches every worker —
+        # including respawned incarnations — so a restarted rank
+        # deserializes its executables instead of recompiling (ROADMAP
+        # item 1).  One SHARED dir is safe across ranks: entry writes
+        # are unique-temp-file + atomic-rename, so two ranks storing
+        # the same key race to two complete files and the last replace
+        # wins — no lock, no torn entry.  An explicit per-rank
+        # PTPU_JIT_CACHE_DIR in env/envs still takes precedence.
+        jd = str(flags.get_flag("jit_cache_dir"))
+        if jd and not env.get("PTPU_JIT_CACHE_DIR"):
+            env["PTPU_JIT_CACHE_DIR"] = jd
         return env
 
     def _spawn(self, rank: int):

@@ -576,6 +576,19 @@ class _CompiledProgram:
         # lazily from the same step fn + jit kwargs
         self._jitted_donate = None
         self._multi_cache: Dict[tuple, Any] = {}
+        # persistent executable cache (framework/jit_cache.py): when
+        # the jit_cache_dir flag is set, dispatch goes through an AOT
+        # jax.stages.Compiled — deserialized from disk on a warm start
+        # (zero XLA work), or lower().compile()d + stored on a cold
+        # one.  _persist_meta = (key components, entry hash) of the
+        # step entry; _multi_jit keeps the lowerable jit twin of a
+        # deserialized run_steps loop for the cost model.
+        self._aot = None
+        self._persist_meta: Optional[tuple] = None
+        self._persist_pending = False
+        self._persist_verified = False
+        self._persist_source: Optional[str] = None
+        self._multi_jit: Dict[tuple, Any] = {}
         # cost-model plane (observability/costmodel.py): abstract args
         # are noted at first dispatch (ShapeDtypeStructs — no device
         # buffers pinned), analysis is lazy and cached
@@ -729,8 +742,19 @@ class _CompiledProgram:
         """The compiled step; with donate_feeds=True a twin executable
         that ALSO donates the feed dict (argnum 1) — callers must hand
         over fresh per-step device buffers (the reader.device_prefetch
-        path), never a staged batch they intend to re-feed."""
+        path), never a staged batch they intend to re-feed.
+
+        Persistent cache: a deserialized/stored AOT executable takes
+        over the plain (non-donate-feeds) dispatch path — cold and warm
+        starts then run the LITERAL same executable.  The donate-feeds
+        twin stays on plain jit (its donation signature differs; the
+        prefetch path recompiles it per process)."""
         if not donate_feeds:
+            if self._aot is None and self._persist_pending \
+                    and self._abs_args is not None:
+                self._materialize_persistent()
+            if self._aot is not None:
+                return self._aot
             return self._jitted
         if self._jitted_donate is None:
             kwargs = dict(self._jit_kwargs)
@@ -738,6 +762,25 @@ class _CompiledProgram:
                 sorted(set(kwargs.get("donate_argnums", ())) | {0, 1}))
             self._jitted_donate = jax.jit(self._step_fn, **kwargs)
         return self._jitted_donate
+
+    def _materialize_persistent(self):
+        """First dispatch of a disk-MISSED step under the persistent
+        cache: AOT-compile the step (the compile that was about to
+        happen anyway) and store it — only if the program passed the
+        verify_program gate at _prepare time.  Any failure degrades to
+        the plain jit path (record_error), never to a failed run."""
+        from . import jit_cache as pjit_cache
+        self._persist_pending = False
+        comps, khash = self._persist_meta
+        try:
+            exe = self._jitted.lower(*self._abs_args).compile()
+        except Exception as e:
+            pjit_cache.record_error("aot", repr(e))
+            return
+        self._aot = exe
+        self._persist_source = "compiled"
+        if self._persist_verified:
+            pjit_cache.store("executor_step", khash, comps, exe)
 
     def jitted_steps(self, steps: int, seq_names: tuple):
         """A device-side training loop: `steps` iterations of the
@@ -748,13 +791,30 @@ class _CompiledProgram:
         [steps] dim and are sliced per iteration; the rest are
         broadcast.  RNG folds per-iteration so the result is bit-equal
         to `steps` sequential Executor.run calls."""
+        from . import jit_cache as pjit_cache
         key = (steps, seq_names)
         fn = self._multi_cache.get(key)
         if fn is not None:
             _m_multi_hit.inc()
             return fn
-        _m_multi_miss.inc()
-        _m_compile.labels(kind="multi_step").inc()
+        # persistent cache: the device loop gets its own entry — step
+        # key components + (steps, seq_names).  A warm process
+        # deserializes the WHOLE scan executable; multi-miss/compile
+        # counters stay frozen on a disk hit.
+        mcomps = mhash = loaded = None
+        persist = self._persist_meta is not None and pjit_cache.enabled()
+        if persist:
+            mcomps = dict(self._persist_meta[0])
+            mcomps["steps"] = int(steps)
+            mcomps["seq_names"] = list(seq_names)
+            mhash = pjit_cache.entry_key("executor_multi", mcomps)
+            loaded = pjit_cache.load("executor_multi", mhash, mcomps)
+            # a hit still falls through to BUILD (not compile) the jit
+            # twin below: the cost model needs a lowerable fn and a
+            # deserialized Compiled has no .lower()
+        if loaded is None:
+            _m_multi_miss.inc()
+            _m_compile.labels(kind="multi_step").inc()
         step_fn = self._step_fn
         fold = self.program.random_seed is None
 
@@ -793,6 +853,22 @@ class _CompiledProgram:
                 None, {n: self._state_sharding_fn(n)
                        for n in self.out_state_names})
         fn = jax.jit(multi, **jit_kwargs)
+        if loaded is not None:
+            self._multi_jit[key] = fn       # cost model needs .lower()
+            self._multi_cache[key] = loaded
+            return loaded
+        if persist and self._persist_verified and key in self._multi_abs:
+            # AOT-compile now (the compile the first dispatch was about
+            # to pay) so the stored artifact IS the dispatched one
+            try:
+                exe = fn.lower(*self._multi_abs[key]).compile()
+            except Exception as e:
+                pjit_cache.record_error("aot", repr(e))
+            else:
+                pjit_cache.store("executor_multi", mhash, mcomps, exe)
+                self._multi_jit[key] = fn
+                self._multi_cache[key] = exe
+                return exe
         self._multi_cache[key] = fn
         return fn
 
@@ -852,7 +928,9 @@ class _CompiledProgram:
         if mkey in self._multi_cost:
             return self._multi_cost[mkey]
         abs_args = self._multi_abs.get(mkey)
-        fn = self._multi_cache.get(mkey)
+        # a persisted loop's cache slot holds a jax.stages.Compiled
+        # (no .lower()); analyze its lowerable jit twin instead
+        fn = self._multi_jit.get(mkey) or self._multi_cache.get(mkey)
         if abs_args is None or fn is None or not obs_cost.enabled():
             return None
         steps = mkey[0]
@@ -1044,13 +1122,17 @@ class Executor:
         # ids are reused after GC and would inherit dead keys)
         self._forensics_owner = obs_forensics.new_owner()
 
-    def _note_compile(self, program, fetch_names, key_parts):
+    def _note_compile(self, program, fetch_names, key_parts,
+                      jit_cache: str = ""):
         """Recompile-storm detector + forensics: every miss is diffed
         against the retained key for its (program, fetch-list), so the
         warning names WHICH component churned (feed shapes vs dtypes vs
         scope-state signature vs program version vs flags) instead of
-        guessing.  Warns once per key."""
-        rec = obs_forensics.note_compile(key_parts)
+        guessing.  Warns once per key.  ``jit_cache`` marks the
+        persistent-cache disposition ("miss" = this compile will be
+        serialized; a disk HIT never reaches here — the compile log
+        stays silent on warm starts)."""
+        rec = obs_forensics.note_compile(key_parts, jit_cache=jit_cache)
         n = int(flags.get_flag("recompile_warn_threshold"))
         fkey = (program._uid, tuple(fetch_names))
         count = self._compiles_by_fetch_key.get(fkey, 0) + 1
@@ -1233,10 +1315,12 @@ class Executor:
 
         root, counter = self._root_and_counter(program, steps)
         mkey = (int(steps), tuple(sorted(seq)))
-        fn = compiled.jitted_steps(int(steps), tuple(sorted(seq)))
         counter_arr = jnp.int32(counter)
+        # abs args BEFORE jitted_steps: the persistent cache AOT-lowers
+        # the loop from them to serialize the exact dispatched artifact
         compiled.note_multi_abs_args(
             mkey, (state, const_feeds, seq_feeds, root, counter_arr))
+        fn = compiled.jitted_steps(int(steps), tuple(sorted(seq)))
         with RecordEvent(f"executor.run_steps#{steps}"):
             t0 = time.perf_counter()
             ys, new_state = fn(state, const_feeds, seq_feeds, root,
@@ -1373,29 +1457,93 @@ class Executor:
             + tuple(v for _, v in flags_sig)
         compiled = self._cache.get(key)
         if compiled is None:
-            # static verification gate: BEFORE any counter/compile so a
-            # rejection leaves the compile metrics untouched
-            self._verify_before_compile(
-                program, dev_feeds, fetch_names, scope, donate_feeds,
-                seq_names=frozenset(extra_feeds or ()))
-            if flags.get_flag("executor_log_compiles"):
-                print(f"[executor] compiling program v{program._version} "
-                      f"feeds={sorted(dev_feeds)} fetches={fetch_names}")
-            _m_cache_miss.inc()
-            _m_compile.labels(kind="step").inc()
-            self._note_compile(program, fetch_names,
-                               obs_forensics.KeyParts(
-                                   program_uid=program._uid,
-                                   program_version=program._version,
-                                   feeds=feeds_sig,
-                                   fetch_names=tuple(fetch_names),
-                                   state=state_sig, flags=flags_sig,
-                                   owner=self._forensics_owner))
-            chaos.trigger("executor.compile")   # chaos site: OOM/XLA-crash
+            seq_names = frozenset(extra_feeds or ())
+            # persistent executable cache (framework/jit_cache.py):
+            # before compiling anything, try to deserialize this key's
+            # executable from disk.  A hit records NO compile counters
+            # and NO forensics (nothing compiled — jit_cache_hits_total
+            # + flight carry the event), so a warm restart's metrics
+            # read exactly like an in-memory-cached process.  Single-
+            # device only: sharded executables stay on the jit path.
+            from . import jit_cache as pjit_cache
+            use_pc = self.mesh is None and pjit_cache.enabled()
+            ploaded = pmeta = None
+            if use_pc:
+                # NOTE: no program._version here — it is a process-
+                # local mutation counter; a program reaching the same
+                # topology via a different build path must still HIT
+                # (the fingerprint hashes the full serialized content)
+                pcomponents = {
+                    "program": pjit_cache.program_fingerprint(program),
+                    "feeds": feeds_sig, "fetch": list(fetch_names),
+                    "state": state_sig, "flags": flags_sig,
+                    "random_seed_none": program.random_seed is None,
+                }
+                pkhash = pjit_cache.entry_key("executor_step",
+                                              pcomponents)
+                pmeta = (pcomponents, pkhash)
+                ploaded = pjit_cache.load("executor_step", pkhash,
+                                          pcomponents)
+            verified = False
+            if ploaded is not None and donate_feeds:
+                # a stored entry was verified with donate_feeds=False
+                # semantics; a donating first dispatch still needs the
+                # donated_fetch hazard gate (the _jitted_donate twin
+                # compiles ungated otherwise)
+                self._verify_before_compile(
+                    program, dev_feeds, fetch_names, scope,
+                    donate_feeds, seq_names=seq_names)
+            if ploaded is None:
+                # static verification gate: BEFORE any counter/compile
+                # so a rejection leaves the compile metrics untouched
+                self._verify_before_compile(
+                    program, dev_feeds, fetch_names, scope,
+                    donate_feeds, seq_names=seq_names)
+                if use_pc:
+                    # only verified programs are persisted (PR 10
+                    # gate); error mode just proved it above, other
+                    # modes run the full verifier once here
+                    if str(flags.get_flag("verify_program")) == "error":
+                        verified = True
+                    else:
+                        feed_shapes = {
+                            n: (tuple(np.shape(a))[1:]
+                                if n in seq_names
+                                else tuple(np.shape(a)))
+                            for n, a in dev_feeds.items()}
+                        verified = pjit_cache.program_verified(
+                            program, set(dev_feeds), fetch_names,
+                            scope=scope, feed_shapes=feed_shapes)
+                if flags.get_flag("executor_log_compiles"):
+                    print(f"[executor] compiling program "
+                          f"v{program._version} "
+                          f"feeds={sorted(dev_feeds)} "
+                          f"fetches={fetch_names}")
+                _m_cache_miss.inc()
+                _m_compile.labels(kind="step").inc()
+                self._note_compile(program, fetch_names,
+                                   obs_forensics.KeyParts(
+                                       program_uid=program._uid,
+                                       program_version=program._version,
+                                       feeds=feeds_sig,
+                                       fetch_names=tuple(fetch_names),
+                                       state=state_sig, flags=flags_sig,
+                                       owner=self._forensics_owner),
+                                   jit_cache="miss" if use_pc else "")
+                chaos.trigger("executor.compile")   # chaos: OOM/XLA-crash
             compiled = _CompiledProgram(
                 program, sorted(dev_feeds), fetch_names, sorted(state),
                 persist, self.place, donate=True, mesh=self.mesh,
                 batch_axis=self.batch_axis, collect_stats=collect_stats)
+            if use_pc:
+                compiled._persist_meta = pmeta
+                if ploaded is not None:
+                    compiled._aot = ploaded
+                    compiled._persist_source = "disk"
+                    compiled._persist_verified = True
+                else:
+                    compiled._persist_pending = True
+                    compiled._persist_verified = verified
             self._cache[key] = compiled
             _m_cached_programs.set(len(self._cache))
         else:
@@ -1488,9 +1636,24 @@ class Executor:
                 "counts": res.counts(),
                 "findings": [f.to_dict() for f in res.sorted()[:20]],
             }}
+        # persistent-cache section: present ONLY when jit_cache_dir is
+        # set, so the flag-off explain() report stays byte-identical to
+        # the pre-cache executor (the PR 7/10 idiom).  "source" says
+        # whether THIS key's executable came off disk ("disk"),
+        # compiled-and-stored ("compiled"), or has not dispatched yet.
+        from . import jit_cache as pjit_cache
+        jc_doc = {}
+        if self.mesh is None and pjit_cache.enabled():
+            jc_doc = {"jit_cache": {
+                **pjit_cache.stats(),
+                "entry": (compiled._persist_meta[1]
+                          if compiled._persist_meta else None),
+                "source": compiled._persist_source,
+            }}
         return {
             "schema": "paddle_tpu.explain.v1",
             **analysis_doc,
+            **jc_doc,
             "program": {"uid": program._uid,
                         "version": program._version,
                         "ops": len(compiled._ops),

@@ -1,0 +1,653 @@
+"""Persistent executable cache: warm restarts skip XLA entirely.
+
+Every compile site in the stack — the Executor's jit-cache miss path
+and its ``run_steps`` device loops, the Predictor's AOT grid, the
+serving engine's prefill-bucket grid and decode step — serializes its
+compiled executable to disk (``jax.experimental.serialize_executable``)
+keyed by a STABLE content hash, so a restarted process deserializes
+instead of recompiling.  This is ROADMAP item 1: the elastic fleet
+(PR 5) made worker restarts routine and the serving plane (PR 8)
+re-AOTs its whole bucket grid per replica start; the before/after
+gauges (``restart_to_first_step_seconds``, ``serving_ready_seconds``,
+PR 11) measure exactly the cost this module removes.
+
+Key anatomy (sha256 over canonical JSON; one entry file per key):
+
+  * ``schema``     — on-disk format version (bump = fleet-wide miss)
+  * ``env``        — jax/jaxlib versions + backend platform + device
+                     kind: artifacts from a different build NEVER load
+  * ``kind``       — executor_step | executor_multi | predictor |
+                     serving_prefill | serving_decode
+  * ``components`` — the forensics ``KeyParts`` vocabulary, made
+                     process-independent: program TOPOLOGY hash
+                     (``Program.serialize_to_string``, not the
+                     process-local uid), feed shapes/dtypes, fetch
+                     names, persistable-state signature, numerics
+                     flags — plus per-site extras (bucket, steps, ...)
+
+Entry file layout (``<hash>.jc``)::
+
+  MAGIC(8) | header_len u32 | header JSON | body sha256(32) | body
+
+The header is readable without unpickling the (large) body — the CLI's
+``--ls`` and the stale-build check read it alone.  The body sha256
+catches truncation and bit flips.  Loads are crash-proof by contract:
+ANY failure (bad magic, torn write, flipped bit, foreign build, pickle
+drift) warns loudly, counts ``jit_cache_errors_total{reason}``, drops
+the entry, and the caller recompiles — a poisoned cache dir can never
+brick a start.  Writes go to a unique temp file then ``os.replace``,
+so a mid-write SIGKILL leaves only a ``*.tmp.*`` turd (swept by GC)
+and two ranks storing the same key concurrently both land valid files
+(last replace wins) — a shared fleet cache dir needs no lock.
+
+Only VERIFIED programs are cached (the PR 10 ``verify_program`` gate):
+the executor/predictor run full static verification before a store, so
+a cached artifact is one the analysis plane vouched for.  The serving
+engine's executables are built from framework code, not user programs
+— no gate applies.
+
+Metrics: ``jit_cache_{hits,misses,errors,evictions}_total`` (+kind /
+reason labels) and ``jit_cache_bytes``.  Flags: ``jit_cache_dir``
+("" = off, byte-identical behavior) and ``jit_cache_limit_bytes``
+(LRU-by-mtime GC; hits touch mtime).
+
+CLI: ``python -m paddle_tpu.framework.jit_cache --dir D --ls | --gc |
+--purge | --self-test | --restart-probe lm`` (exit 0 ok / 1 failure /
+2 bad usage; the probe is the bench driver's cold/warm child).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import flags
+from ..observability import flight as obs_flight
+from ..observability import metrics as obs_metrics
+
+_MAGIC = b"PTPUJC01"
+_SCHEMA = 1
+_SUFFIX = ".jc"
+
+_m_hits = obs_metrics.counter(
+    "jit_cache_hits_total",
+    "Persistent executable cache: entries deserialized instead of "
+    "compiled, by compile site.", ("kind",))
+_m_misses = obs_metrics.counter(
+    "jit_cache_misses_total",
+    "Persistent executable cache: lookups that found no usable entry "
+    "(the caller compiles and stores), by compile site.", ("kind",))
+_m_errors = obs_metrics.counter(
+    "jit_cache_errors_total",
+    "Persistent executable cache: corrupt/stale/unwritable entries "
+    "(magic, checksum, stale_env, deserialize, store, aot).  Every one "
+    "degrades to a recompile, never a failed start.", ("reason",))
+_m_evictions = obs_metrics.counter(
+    "jit_cache_evictions_total",
+    "Persistent executable cache entries deleted by the LRU byte-limit "
+    "GC (jit_cache_limit_bytes).")
+_m_unverified = obs_metrics.counter(
+    "jit_cache_unverified_total",
+    "Store attempts skipped because the program did not pass the "
+    "verify_program static gate — only verified programs are cached.")
+_m_bytes = obs_metrics.gauge(
+    "jit_cache_bytes",
+    "Total bytes of persistent executable cache entries on disk "
+    "(refreshed on store/GC/CLI).")
+
+
+# --- enablement --------------------------------------------------------------
+
+def enabled() -> bool:
+    return bool(str(flags.get_flag("jit_cache_dir")))
+
+
+def cache_dir() -> str:
+    return str(flags.get_flag("jit_cache_dir"))
+
+
+def numerics_flags() -> Tuple[Tuple[str, Any], ...]:
+    """The lowering-affecting flags every persistent key carries — the
+    same set the Executor bakes into its in-memory jit key, so a flag
+    flip is a clean MISS (new key), never a corrupt-entry error."""
+    return (("amp_bf16", bool(flags.get_flag("amp_bf16"))),
+            ("use_pallas_kernels",
+             bool(flags.get_flag("use_pallas_kernels"))),
+            ("quantize_dtype", str(flags.get_flag("quantize_dtype"))),
+            ("fuse_block", bool(flags.get_flag("fuse_block"))))
+
+
+def build_env() -> Dict[str, str]:
+    """The build/backend identity stamped into every entry: an artifact
+    serialized under a different jax/jaxlib/backend never loads."""
+    import jax
+    import jaxlib
+    try:
+        dev = jax.devices()[0]
+        platform, kind = dev.platform, dev.device_kind
+    except Exception:       # backend not initializable: identity only
+        platform, kind = "unknown", "unknown"
+    return {"jax": jax.__version__,
+            "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+            "platform": platform, "device_kind": kind}
+
+
+def program_fingerprint(program) -> str:
+    """Process-independent topology hash of a Program — the persistent
+    twin of the forensics KeyParts (program_uid, program_version) pair,
+    which are process-local counters and would never match across a
+    restart."""
+    return hashlib.sha256(program.serialize_to_string()).hexdigest()
+
+
+def entry_key(kind: str, components: Dict[str, Any]) -> str:
+    """Stable content hash for one executable: schema + build env +
+    site kind + the site's key components, canonically JSON-encoded."""
+    doc = {"schema": _SCHEMA, "env": build_env(), "kind": kind,
+           "components": components}
+    blob = json.dumps(doc, sort_keys=True, default=repr).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# --- entry I/O ---------------------------------------------------------------
+
+def _entry_path(key_hash: str) -> str:
+    return os.path.join(cache_dir(), key_hash + _SUFFIX)
+
+
+def _hits_path(key_hash: str) -> str:
+    return os.path.join(cache_dir(), key_hash + ".hits")
+
+
+def _bump_hits(key_hash: str):
+    """Advisory per-entry hit count for --ls; atomic replace, lossy
+    under concurrent ranks (acceptable: it is telemetry, not truth)."""
+    path = _hits_path(key_hash)
+    try:
+        n = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                n = int(f.read().strip() or 0)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(n + 1))
+        os.replace(tmp, path)
+    except (OSError, ValueError):
+        pass
+
+
+def _atomic_write(path: str, data: bytes):
+    """Unique temp file + os.replace: a mid-write SIGKILL cannot leave
+    a half-entry under the final name, and two ranks racing the same
+    key each land a complete file (last replace wins)."""
+    tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _fail_load(key_hash: str, reason: str, detail: str = "",
+               drop: bool = True):
+    _m_errors.labels(reason=reason).inc()
+    obs_flight.record("jit_cache", "load_error", key=key_hash[:16],
+                      reason=reason, detail=detail[:160])
+    verb = "dropping" if drop else "skipping"
+    warnings.warn(
+        f"jit_cache: {verb} unusable entry {key_hash[:16]}… "
+        f"({reason}{': ' + detail[:160] if detail else ''}); "
+        f"recompiling instead — a corrupt cache never fails a start",
+        RuntimeWarning, stacklevel=4)
+    if drop:
+        for p in (_entry_path(key_hash), _hits_path(key_hash)):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def record_error(reason: str, detail: str = ""):
+    """Count a persistence failure that happened OUTSIDE entry I/O
+    (e.g. an AOT lower+compile for serialization failing) — callers
+    degrade to the plain jit path, never to a failed run."""
+    _m_errors.labels(reason=reason).inc()
+    obs_flight.record("jit_cache", "error", reason=reason,
+                      detail=detail[:160])
+
+
+def read_header(path: str) -> Optional[dict]:
+    """Entry header (env/kind/components/created) without touching the
+    pickled body; None when the header itself is unreadable."""
+    try:
+        with open(path, "rb") as f:
+            if f.read(len(_MAGIC)) != _MAGIC:
+                return None
+            (hlen,) = struct.unpack("<I", f.read(4))
+            if hlen > 1 << 20:
+                return None
+            return json.loads(f.read(hlen).decode())
+    except (OSError, ValueError, struct.error):
+        return None
+
+
+def load(kind: str, key_hash: str, components: Dict[str, Any]):
+    """Deserialize one entry into a callable ``jax.stages.Compiled``.
+
+    Returns None on any miss or failure (counted + warned; the caller
+    compiles).  A hit touches the entry's mtime (the LRU clock) and
+    bumps its advisory hit counter."""
+    path = _entry_path(key_hash)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        _m_misses.labels(kind=kind).inc()
+        return None
+    except OSError as e:
+        _fail_load(key_hash, "io", repr(e), drop=False)
+        _m_misses.labels(kind=kind).inc()
+        return None
+    fixed = len(_MAGIC) + 4
+    if len(raw) < fixed or raw[:len(_MAGIC)] != _MAGIC:
+        _fail_load(key_hash, "magic")
+        _m_misses.labels(kind=kind).inc()
+        return None
+    (hlen,) = struct.unpack("<I", raw[len(_MAGIC):fixed])
+    body_at = fixed + hlen + 32
+    if len(raw) < body_at:
+        _fail_load(key_hash, "truncated")
+        _m_misses.labels(kind=kind).inc()
+        return None
+    try:
+        header = json.loads(raw[fixed:fixed + hlen].decode())
+    except ValueError:
+        _fail_load(key_hash, "header")
+        _m_misses.labels(kind=kind).inc()
+        return None
+    digest, body = raw[fixed + hlen:body_at], raw[body_at:]
+    # stale-build guard: the env rides the header OUTSIDE the hash
+    # preimage check so a hand-copied dir from another machine (same
+    # path, different jaxlib) is rejected here, loudly, not at
+    # deserialize time deep inside PJRT
+    # stale entries are INTACT artifacts of another build — reject but
+    # do NOT delete: in a briefly-mixed fleet (rolling jax upgrade)
+    # each side would otherwise destroy the other side's valid cache
+    if header.get("schema") != _SCHEMA:
+        _fail_load(key_hash, "stale_schema", str(header.get("schema")),
+                   drop=False)
+        _m_misses.labels(kind=kind).inc()
+        return None
+    if header.get("env") != build_env():
+        _fail_load(key_hash, "stale_env",
+                   f"{header.get('env')} != {build_env()}", drop=False)
+        _m_misses.labels(kind=kind).inc()
+        return None
+    if hashlib.sha256(body).digest() != digest:
+        _fail_load(key_hash, "checksum")
+        _m_misses.labels(kind=kind).inc()
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = pickle.loads(body)
+        compiled = se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as e:      # pickle drift, PJRT refusal, anything
+        _fail_load(key_hash, "deserialize", repr(e))
+        _m_misses.labels(kind=kind).inc()
+        return None
+    try:
+        os.utime(path)          # LRU clock
+    except OSError:
+        pass
+    _bump_hits(key_hash)
+    _m_hits.labels(kind=kind).inc()
+    obs_flight.record("jit_cache", "hit", site=kind,
+                      key=key_hash[:16])
+    return compiled
+
+
+def store(kind: str, key_hash: str, components: Dict[str, Any],
+          compiled) -> bool:
+    """Serialize ``compiled`` (a jax.stages.Compiled) under the key.
+    Failures warn + count (reason=store) and return False — persistence
+    is an optimization, never a correctness dependency."""
+    try:
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = se.serialize(compiled)
+        body = pickle.dumps((payload, in_tree, out_tree))
+        header = json.dumps(
+            {"schema": _SCHEMA, "env": build_env(), "kind": kind,
+             "components": components, "created": time.time()},
+            sort_keys=True, default=repr).encode()
+        blob = (_MAGIC + struct.pack("<I", len(header)) + header
+                + hashlib.sha256(body).digest() + body)
+        os.makedirs(cache_dir(), exist_ok=True)
+        _atomic_write(_entry_path(key_hash), blob)
+    except Exception as e:
+        _m_errors.labels(reason="store").inc()
+        warnings.warn(
+            f"jit_cache: failed to persist {kind} entry "
+            f"{key_hash[:16]}… ({repr(e)[:160]}); the compiled "
+            f"executable still runs, only the NEXT restart pays",
+            RuntimeWarning, stacklevel=3)
+        return False
+    obs_flight.record("jit_cache", "store", site=kind,
+                      key=key_hash[:16], bytes=len(blob))
+    gc()
+    return True
+
+
+# --- GC / inventory ----------------------------------------------------------
+
+def _entries(dirpath: Optional[str] = None) -> List[dict]:
+    d = dirpath or cache_dir()
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(_SUFFIX):
+            continue
+        path = os.path.join(d, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        out.append({"hash": name[:-len(_SUFFIX)], "path": path,
+                    "bytes": st.st_size, "mtime": st.st_mtime})
+    return out
+
+
+def total_bytes(dirpath: Optional[str] = None) -> int:
+    return sum(e["bytes"] for e in _entries(dirpath))
+
+
+def gc(limit_bytes: Optional[int] = None) -> int:
+    """LRU (oldest mtime first) eviction down to the byte limit; also
+    sweeps ``*.tmp.*`` turds from killed writers.  Returns the number
+    of entries evicted and refreshes jit_cache_bytes."""
+    d = cache_dir()
+    if not d:
+        return 0
+    limit = int(flags.get_flag("jit_cache_limit_bytes")
+                if limit_bytes is None else limit_bytes)
+    evicted = 0
+    try:
+        for name in os.listdir(d):
+            if ".tmp." in name:
+                # sweep only STALE temp files (a killed writer's turd);
+                # a fresh one may be another rank's in-flight store in
+                # a shared dir — deleting it would break the atomic
+                # write it is about to os.replace
+                path = os.path.join(d, name)
+                try:
+                    if time.time() - os.stat(path).st_mtime > 3600:
+                        os.remove(path)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    entries = sorted(_entries(d), key=lambda e: e["mtime"])
+    total = sum(e["bytes"] for e in entries)
+    if limit > 0:
+        for e in entries:
+            if total <= limit:
+                break
+            try:
+                os.remove(e["path"])
+            except OSError:
+                continue
+            try:
+                os.remove(os.path.join(d, e["hash"] + ".hits"))
+            except OSError:
+                pass
+            total -= e["bytes"]
+            evicted += 1
+            _m_evictions.inc()
+    _m_bytes.set(total)
+    return evicted
+
+
+def purge() -> int:
+    """Delete every entry (and hit sidecar); returns entries removed."""
+    d = cache_dir()
+    n = 0
+    for e in _entries(d):
+        try:
+            os.remove(e["path"])
+            n += 1
+        except OSError:
+            pass
+        try:
+            os.remove(os.path.join(d, e["hash"] + ".hits"))
+        except OSError:
+            pass
+    _m_bytes.set(total_bytes(d))
+    return n
+
+
+def ls() -> List[dict]:
+    """Inventory: per entry, key components + size + age + hits."""
+    now = time.time()
+    out = []
+    for e in sorted(_entries(), key=lambda e: -e["mtime"]):
+        header = read_header(e["path"]) or {}
+        hits = 0
+        try:
+            with open(_hits_path(e["hash"])) as f:
+                hits = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            pass
+        out.append({"hash": e["hash"], "kind": header.get("kind"),
+                    "bytes": e["bytes"],
+                    "age_seconds": round(now - e["mtime"], 1),
+                    "hits": hits, "env": header.get("env"),
+                    "components": header.get("components")})
+    return out
+
+
+def stats() -> dict:
+    """Process-wide counters + on-disk totals (the explain() section)."""
+    es = _entries()
+    return {"dir": cache_dir(), "entries": len(es),
+            "bytes": sum(e["bytes"] for e in es),
+            "hits": _m_hits.total(), "misses": _m_misses.total(),
+            "errors": _m_errors.total(),
+            "evictions": _m_evictions.total()}
+
+
+# --- verified-programs gate (PR 10) -----------------------------------------
+
+def program_verified(program, feed_names, fetch_names, scope=None,
+                     feed_shapes=None) -> bool:
+    """True when the program passes full static verification — the
+    condition for persisting its executable.  When the executor already
+    runs in verify_program=error mode the gate has provably passed
+    before any compile; callers skip re-running it there.  An analysis
+    crash counts as NOT verified (skip persistence, never the run)."""
+    try:
+        from .. import analysis
+        res = analysis.verify_program(
+            program, feed=set(feed_names), fetch_list=list(fetch_names),
+            scope=scope, feed_shapes=feed_shapes, record_metrics=False)
+        ok = not res.errors
+    except Exception:
+        ok = False
+    if not ok:
+        _m_unverified.inc()
+        obs_flight.record("jit_cache", "store_skipped_unverified",
+                          program=getattr(program, "_uid", None))
+    return ok
+
+
+# --- CLI ---------------------------------------------------------------------
+
+def _self_test() -> int:
+    """End-to-end round trip in a throwaway subdir of the cache dir:
+    compile a tiny fn, store, corrupt-check, reload, call, GC."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    old = cache_dir()
+    with tempfile.TemporaryDirectory() as td:
+        flags.set_flag("jit_cache_dir", td)
+        try:
+            fn = jax.jit(lambda x: x * 2.0 + 1.0)
+            x = jnp.arange(4, dtype=jnp.float32)
+            compiled = fn.lower(x).compile()
+            comps = {"probe": "self_test"}
+            khash = entry_key("executor_step", comps)
+            if not store("executor_step", khash, comps, compiled):
+                print("self-test: store failed")
+                return 1
+            back = load("executor_step", khash, comps)
+            if back is None:
+                print("self-test: reload failed")
+                return 1
+            import numpy as np
+            if not np.allclose(np.asarray(back(x)),
+                               np.asarray(x) * 2.0 + 1.0):
+                print("self-test: wrong outputs after reload")
+                return 1
+            # corruption must degrade to a miss, loudly, not raise
+            path = _entry_path(khash)
+            with open(path, "r+b") as f:
+                f.seek(-1, os.SEEK_END)
+                f.write(b"\x00")
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                if load("executor_step", khash, comps) is not None:
+                    print("self-test: corrupt entry loaded")
+                    return 1
+            gc()
+            print("self-test: ok (store/load/corrupt-fallback/gc)")
+            return 0
+        finally:
+            flags.set_flag("jit_cache_dir", old)
+
+
+def _restart_probe(workload: str, steps: int = 2) -> int:
+    """Bench/test child: build the flagship LM through the Trainer,
+    complete ``steps`` steps, and print one RESTART_PROBE JSON line
+    with restart_to_first_step_seconds + compile/cache counters.  Run
+    it twice against the same PTPU_JIT_CACHE_DIR for cold vs warm."""
+    if workload != "lm":
+        print(f"unknown --restart-probe workload {workload!r}")
+        return 2
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import models
+
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=211, tgt_vocab_size=211, max_length=32,
+        n_layer=2, n_head=2, d_model=32, d_inner=64, dropout=0.0)
+    B, T = 2, 16
+    batch = models.transformer.make_fake_lm_batch(cfg, B, T)
+    order = ["tokens", "labels"]
+
+    def train_func():
+        _, cost, _ = models.transformer.build_lm_net(
+            cfg, seq_len=T, fused_attention=False, fused_head=False)
+        return cost
+
+    def reader():
+        yield [tuple(batch[n][i] for n in order) for i in range(B)]
+
+    losses: List[float] = []
+
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent):
+            losses.append(float(np.asarray(event.metrics[0])))
+
+    trainer = pt.Trainer(train_func,
+                         lambda: pt.optimizer.Adam(learning_rate=1e-3),
+                         place=pt.CPUPlace())
+    trainer.train(num_epochs=int(steps), event_handler=handler,
+                  reader=reader, feed_order=order)
+    reg = obs_metrics.REGISTRY
+
+    def _total(name):
+        m = reg.get(name)
+        return 0.0 if m is None else m.total()
+
+    restart = reg.get("restart_to_first_step_seconds")
+    print("RESTART_PROBE " + json.dumps({
+        "restart_to_first_step_seconds":
+            None if restart is None else restart.value,
+        "executor_compile_total": _total("executor_compile_total"),
+        "jit_cache_hits_total": _total("jit_cache_hits_total"),
+        "jit_cache_misses_total": _total("jit_cache_misses_total"),
+        "jit_cache_errors_total": _total("jit_cache_errors_total"),
+        "losses": [round(v, 6) for v in losses],
+    }), flush=True)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.framework.jit_cache",
+        description="Persistent executable cache inspector/maintainer.")
+    parser.add_argument("--dir", default=None,
+                        help="cache dir (default: the jit_cache_dir "
+                             "flag / PTPU_JIT_CACHE_DIR)")
+    parser.add_argument("--ls", action="store_true",
+                        help="list entries (key components, size, age, "
+                             "hits)")
+    parser.add_argument("--gc", action="store_true",
+                        help="apply jit_cache_limit_bytes now")
+    parser.add_argument("--purge", action="store_true",
+                        help="delete every entry")
+    parser.add_argument("--self-test", action="store_true",
+                        help="store/load/corrupt-fallback round trip "
+                             "in a temp dir")
+    parser.add_argument("--restart-probe", default=None, metavar="WL",
+                        help="bench child: run WL ('lm') through the "
+                             "Trainer and print cold-start numbers")
+    parser.add_argument("--steps", type=int, default=2)
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    if args.self_test:
+        return _self_test()
+    if args.restart_probe:
+        return _restart_probe(args.restart_probe, args.steps)
+    old_dir = cache_dir()
+    if args.dir is not None:
+        flags.set_flag("jit_cache_dir", args.dir)
+    try:
+        if not (args.ls or args.gc or args.purge):
+            parser.print_usage()
+            return 2
+        if not cache_dir():
+            print("no cache dir: pass --dir or set jit_cache_dir / "
+                  "PTPU_JIT_CACHE_DIR")
+            return 2
+        if args.purge:
+            print(f"purged {purge()} entr(ies) from {cache_dir()}")
+        if args.gc:
+            n = gc()
+            print(f"gc: evicted {n} entr(ies); {total_bytes()} bytes "
+                  f"resident (limit "
+                  f"{flags.get_flag('jit_cache_limit_bytes')})")
+        if args.ls:
+            rows = ls()
+            print(json.dumps({"dir": cache_dir(), "entries": len(rows),
+                              "bytes": sum(r["bytes"] for r in rows),
+                              "rows": rows}, indent=2, default=repr))
+        return 0
+    finally:
+        # in-proc callers (tests) must not inherit the CLI's --dir as
+        # ambient process state
+        flags.set_flag("jit_cache_dir", old_dir)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

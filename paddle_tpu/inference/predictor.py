@@ -127,13 +127,42 @@ class Predictor:
             f"change the compile signature and force a fresh "
             f"executable per request")
 
+    def _persist_components(self, sig) -> Dict[str, object]:
+        """Stable key components of one AOT signature: program
+        topology + persistable-state signature + feed signature +
+        fetch names + the lowering-affecting numerics flags — the
+        executor's KeyParts vocabulary, predictor-shaped."""
+        from ..framework import jit_cache as pjit_cache
+        return {
+            "program": pjit_cache.program_fingerprint(self.program),
+            "state": sorted((n, tuple(np.shape(a)),
+                             str(jax.numpy.result_type(a)))
+                            for n, a in self.state.items()),
+            "feeds": list(sig),
+            "fetch": list(self.fetch_names),
+            "flags": pjit_cache.numerics_flags(),
+        }
+
     def prepare(self, example_feeds: Dict[str, np.ndarray]):
         """AOT-compile for this input signature (lowered+compiled now, so
-        the request path never traces)."""
+        the request path never traces).  With ``jit_cache_dir`` set the
+        executable round-trips the persistent cache: a warm replica
+        deserializes its whole grid instead of compiling it (the
+        reference's save_inference_model tier never persisted compiled
+        artifacts at all)."""
+        from ..framework import jit_cache as pjit_cache
         feeds = {n: np.asarray(v) for n, v in example_feeds.items()}
         self._check_feed_names(feeds)
         sig = self._sig(feeds)
         if sig not in self._compiled:
+            comps = khash = None
+            if pjit_cache.enabled():
+                comps = self._persist_components(sig)
+                khash = pjit_cache.entry_key("predictor", comps)
+                loaded = pjit_cache.load("predictor", khash, comps)
+                if loaded is not None:
+                    self._compiled[sig] = loaded
+                    return loaded
             # X-ray: a request whose signature missed the AOT grid
             # compiles HERE — the span lands in that request's own
             # timeline, naming the signature that forced it
@@ -141,6 +170,12 @@ class Predictor:
                                    signature=str(sig)[:200]):
                 lowered = jax.jit(self._fn()).lower(self.state, feeds)
                 self._compiled[sig] = lowered.compile()
+            if khash is not None and pjit_cache.program_verified(
+                    self.program, set(feeds), self.fetch_names,
+                    feed_shapes={n: tuple(a.shape)
+                                 for n, a in feeds.items()}):
+                pjit_cache.store("predictor", khash, comps,
+                                 self._compiled[sig])
         return self._compiled[sig]
 
     def prepare_buckets(self, example_feeds: Dict[str, np.ndarray],

@@ -89,6 +89,12 @@ class CompileRecord:
     # a recompile TRIGGERED by a request/step names that request in the
     # compile log (and shows inside its waterfall)
     trace_id: str = ""
+    # persistent executable cache: "miss" = this compile also missed
+    # the on-disk cache (and will be serialized for the next restart).
+    # A disk HIT never produces a CompileRecord at all — nothing
+    # compiled — so any record under an armed jit_cache_dir is
+    # distinguishable from the silent warm path.
+    jit_cache: str = ""
 
     def to_dict(self) -> dict:
         d = {"ts": self.ts, "program": self.program_uid,
@@ -98,6 +104,8 @@ class CompileRecord:
              "details": list(self.details)}
         if self.trace_id:
             d["trace_id"] = self.trace_id
+        if self.jit_cache:
+            d["jit_cache"] = self.jit_cache
         return d
 
 
@@ -162,7 +170,8 @@ def diff_keys(old: KeyParts, new: KeyParts) -> List[Tuple[str, str]]:
     return out
 
 
-def note_compile(parts: KeyParts) -> CompileRecord:
+def note_compile(parts: KeyParts,
+                 jit_cache: str = "") -> CompileRecord:
     """Called by the executor on every compiled-program cache miss.
     Diagnoses the drift cause vs the retained key, updates the per-key
     cause histogram, the cause counter, the bounded compile log and the
@@ -195,7 +204,8 @@ def note_compile(parts: KeyParts) -> CompileRecord:
                         program_version=parts.program_version,
                         fetch_names=parts.fetch_names, causes=causes,
                         details=details,
-                        trace_id=tracectx.current_trace_id() or "")
+                        trace_id=tracectx.current_trace_id() or "",
+                        jit_cache=jit_cache)
     with _lock:
         hist = _cause_counts.setdefault(fkey, {})
         for c in causes:

@@ -284,19 +284,48 @@ class DecodeEngine:
             lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
                                            jnp.result_type(a)), like)
 
+    def _persist_components(self, **extra) -> dict:
+        """Stable persistent-cache key components of this engine's
+        executables: model geometry + grid shape + the weight pytree's
+        (name, shape, dtype) signature.  Weight VALUES are call-time
+        arguments — same-geometry engines share entries; a geometry or
+        build change is a clean miss."""
+        comps = {"d_model": self.cfg.d_model,
+                 "n_layer": self.cfg.n_layer, "n_head": self._n_head,
+                 "d_head": self._d_head, "max_batch": self.max_batch,
+                 "max_len": self.max_len,
+                 "params": sorted((k, tuple(v.shape), str(v.dtype))
+                                  for k, v in self._params.items())}
+        comps.update(extra)
+        return comps
+
     def _compile_prefill(self, bucket: int, kind: str) -> float:
         """AOT-compile one prompt bucket's prefill executable; returns
         the compile seconds.  ``kind`` labels serving_compiles_total:
         "prefill" from prepare(), "prefill_lazy" when a request-path
         miss compiled it under serving_lazy_bucket_compile — tagged
         with the triggering request's trace so the recompile shows in
-        that request's own timeline."""
+        that request's own timeline.
+
+        Persistent cache (framework/jit_cache.py): a warm replica
+        deserializes the bucket's executable instead of compiling —
+        serving_compiles_total stays FROZEN on that path (nothing
+        compiled; jit_cache_hits_total{kind=serving_prefill} moves)."""
+        from ..framework import jit_cache as pjit_cache
+        tb = time.perf_counter()
+        comps = khash = None
+        if pjit_cache.enabled():
+            comps = self._persist_components(bucket=int(bucket))
+            khash = pjit_cache.entry_key("serving_prefill", comps)
+            loaded = pjit_cache.load("serving_prefill", khash, comps)
+            if loaded is not None:
+                self._compiled_prefill[bucket] = loaded
+                return time.perf_counter() - tb
         p_sds = self._sds(self._params)
         kv_sds = self._sds(self._kv_k)
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
         f32 = jax.ShapeDtypeStruct((), jnp.float32)
         key_sds = self._sds(self._keys[0])
-        tb = time.perf_counter()
         # donate the K/V slabs: the old cache is dead the moment the
         # call returns, so XLA updates in place instead of copying two
         # [L,B,H,T,dh] buffers per dispatch
@@ -312,6 +341,9 @@ class DecodeEngine:
         obs_flight.record("compile", f"serving.prefill[{bucket}]",
                           bucket=bucket, compile_kind=kind,
                           trace_id=obs_tracectx.current_trace_id())
+        if khash is not None:
+            pjit_cache.store("serving_prefill", khash, comps,
+                             self._compiled_prefill[bucket])
         return dt
 
     def prepare(self) -> dict:
@@ -319,6 +351,7 @@ class DecodeEngine:
         serving startup cost is one call and the request path never
         traces.  Returns {bucket: seconds} + totals; records
         serving_compiles_total and the startup-compile gauge."""
+        from ..framework import jit_cache as pjit_cache
         t0 = time.perf_counter()
         report = {}
         p_sds = self._sds(self._params)
@@ -331,18 +364,32 @@ class DecodeEngine:
         if self._compiled_step is None:
             tb = time.perf_counter()
             B = self.max_batch
-            self._compiled_step = jax.jit(
-                self._step_fn(), donate_argnums=(1, 2)).lower(
-                p_sds, kv_sds, kv_sds,
-                jax.ShapeDtypeStruct((B,), jnp.int32),
-                jax.ShapeDtypeStruct((B,), jnp.int32),
-                jax.ShapeDtypeStruct((B,), jnp.bool_),
-                self._sds(self._keys),
-                jax.ShapeDtypeStruct((B,), jnp.float32)).compile()
-            report["decode_step"] = round(time.perf_counter() - tb, 3)
-            _m_compiles.labels(kind="decode_step").inc()
-            obs_flight.record("compile", "serving.decode_step",
-                              batch=B)
+            comps = khash = None
+            if pjit_cache.enabled():
+                comps = self._persist_components()
+                khash = pjit_cache.entry_key("serving_decode", comps)
+                loaded = pjit_cache.load("serving_decode", khash, comps)
+                if loaded is not None:
+                    self._compiled_step = loaded
+                    report["decode_step"] = round(
+                        time.perf_counter() - tb, 3)
+            if self._compiled_step is None:
+                self._compiled_step = jax.jit(
+                    self._step_fn(), donate_argnums=(1, 2)).lower(
+                    p_sds, kv_sds, kv_sds,
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B,), jnp.bool_),
+                    self._sds(self._keys),
+                    jax.ShapeDtypeStruct((B,), jnp.float32)).compile()
+                report["decode_step"] = round(
+                    time.perf_counter() - tb, 3)
+                _m_compiles.labels(kind="decode_step").inc()
+                obs_flight.record("compile", "serving.decode_step",
+                                  batch=B)
+                if khash is not None:
+                    pjit_cache.store("serving_decode", khash, comps,
+                                     self._compiled_step)
         total = time.perf_counter() - t0
         _m_compile_seconds.set(total)
         report["total_seconds"] = round(total, 3)

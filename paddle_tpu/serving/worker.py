@@ -72,9 +72,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     batcher.install_signal_handlers()
     srv = obs_server.start_http_server(port=port)
     # cold-start headline (ROADMAP item 1): process exec to "can answer
-    # a request" — interpreter + imports + model build + the whole AOT
-    # bucket-grid compile.  On /metrics and in the bench/soak dumps so
-    # the persistent-compilation-cache PR has a gated before/after.
+    # a request" — interpreter + imports + model build + the bucket
+    # grid, which prepare() above either AOT-compiled (cold) or
+    # deserialized from the persistent executable cache (warm: set
+    # PTPU_JIT_CACHE_DIR / the jit_cache_dir flag, framework/
+    # jit_cache.py).  On /metrics and in the bench/soak dumps; bench.py
+    # gates the cold/warm pair as serving_ready_{cold,warm}_seconds.
     from paddle_tpu import observability as obs
     ready_s = time.time() - obs.process_start_unix()
     obs.metrics.gauge(

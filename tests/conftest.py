@@ -80,12 +80,18 @@ def fresh_programs():
     # serving plane: no batcher loop thread or HTTP-routed engine may
     # survive a case (queue threads joined, routes detached)
     serving.reset()
+    # persistent executable cache: tier-1 runs with it OFF — cache
+    # tests point jit_cache_dir at tmp_path themselves, and the flag
+    # must not leak artifacts (or warm-start semantics) across cases
+    # or into the repo
+    pt.core.flags.set_flag("jit_cache_dir", "")
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
     obs_server.reset()
     task_queue.reset_state()
     serving.reset()
+    pt.core.flags.set_flag("jit_cache_dir", "")
 
 
 @pytest.fixture

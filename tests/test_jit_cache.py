@@ -1,0 +1,463 @@
+"""Persistent executable cache (ISSUE 12, framework/jit_cache.py).
+
+Covers: raw store/load round trip, in-proc + CROSS-PROCESS warm starts
+with zero new XLA compiles (executor step, run_steps device loop,
+Predictor grid, serving bucket grid — token-identical outputs), the
+corrupt-entry fallback matrix (truncated / bit-flipped / bad magic /
+wrong-jaxlib header -> loud warning + jit_cache_errors_total +
+recompile, NEVER a failed start), stale-flags = clean miss (no error),
+LRU eviction order, the verified-programs-only store gate, supervisor
+env propagation, flag-off byte-identical behavior, and the CLI
+exit-code contract (the xray/lint idiom).
+"""
+import json
+import os
+import struct
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags
+from paddle_tpu.framework import jit_cache
+from paddle_tpu.observability import forensics
+from paddle_tpu.observability import metrics as obs_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tot(name):
+    m = obs_metrics.REGISTRY.get(name)
+    return 0.0 if m is None else m.total()
+
+
+def _build_fc():
+    img = layers.data("img", [8], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    pred = layers.fc(img, size=4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    return loss
+
+
+def _feed(batch=4):
+    rng = np.random.RandomState(0)
+    return {"img": rng.rand(batch, 8).astype("float32"),
+            "label": rng.randint(0, 4, (batch, 1)).astype("int64")}
+
+
+def _entries(d):
+    return sorted(f for f in os.listdir(d) if f.endswith(".jc"))
+
+
+# --- raw API ---------------------------------------------------------------
+
+def test_store_load_roundtrip_and_ls(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    fn = jax.jit(lambda x: x * 3.0)
+    x = jnp.arange(6, dtype=jnp.float32)
+    compiled = fn.lower(x).compile()
+    comps = {"probe": "roundtrip"}
+    khash = jit_cache.entry_key("executor_step", comps)
+    assert jit_cache.store("executor_step", khash, comps, compiled)
+    h0 = _tot("jit_cache_hits_total")
+    back = jit_cache.load("executor_step", khash, comps)
+    assert back is not None
+    assert np.array_equal(np.asarray(back(x)), np.arange(6) * 3.0)
+    assert _tot("jit_cache_hits_total") == h0 + 1
+    rows = jit_cache.ls()
+    assert len(rows) == 1
+    assert rows[0]["kind"] == "executor_step"
+    assert rows[0]["hits"] == 1
+    assert rows[0]["components"] == {"probe": "roundtrip"}
+    assert rows[0]["bytes"] > 0
+
+
+def test_entry_key_stable_and_flag_sensitive():
+    comps = {"program": "abc", "feeds": [["x", [2, 4], "float32"]]}
+    k1 = jit_cache.entry_key("executor_step", comps)
+    k2 = jit_cache.entry_key("executor_step", dict(comps))
+    assert k1 == k2
+    assert jit_cache.entry_key("executor_multi", comps) != k1
+    comps2 = dict(comps, flags=jit_cache.numerics_flags())
+    old = flags.get_flag("quantize_dtype")
+    try:
+        flags.set_flag("quantize_dtype", "int8")
+        comps3 = dict(comps, flags=jit_cache.numerics_flags())
+    finally:
+        flags.set_flag("quantize_dtype", old)
+    assert jit_cache.entry_key("executor_step", comps2) \
+        != jit_cache.entry_key("executor_step", comps3)
+
+
+# --- executor: in-proc warm start ------------------------------------------
+
+def test_executor_warm_start_inproc(tmp_path):
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = _feed()
+    prog = pt.default_main_program()
+    out_cold = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert len(_entries(tmp_path)) == 2     # startup + main step
+    # a second Executor = the restarted-process shape (fresh in-memory
+    # jit cache): its miss must resolve from DISK with zero compiles
+    # and a silent forensics log
+    c0 = _tot("executor_compile_total")
+    f0 = len(forensics.compile_log())
+    exe2 = pt.Executor(pt.CPUPlace(), scope=exe.scope)
+    out_warm = exe2.run(prog, feed=feed, fetch_list=[loss])
+    assert _tot("executor_compile_total") == c0
+    assert len(forensics.compile_log()) == f0
+    assert np.array_equal(out_cold[0], out_warm[0])
+    rep = exe2.explain(prog, feed=feed, fetch_list=[loss])
+    assert rep["jit_cache"]["source"] == "disk"
+    assert rep["jit_cache"]["hits"] >= 1
+    # the cold process's compile log marked its misses as cache-bound
+    assert forensics.compile_log()[-1]["jit_cache"] == "miss"
+
+
+def test_run_steps_warm_start_inproc(tmp_path):
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program()
+    out_cold = exe.run_steps(prog, feed=_feed(), fetch_list=[loss],
+                             steps=3)
+    multi = obs_metrics.REGISTRY.get("executor_compile_total").labels(
+        kind="multi_step")
+    c0 = multi.value
+    m0 = _tot("executor_multi_cache_miss_total")
+    h0 = _tot("jit_cache_hits_total")
+    exe2 = pt.Executor(pt.CPUPlace(), scope=exe.scope)
+    # the warm executor's device loop deserializes: the multi compile
+    # counter and multi-miss counter stay FROZEN.  (The step-kind
+    # counter still books its in-memory cache entry — pre-existing
+    # semantics: run_steps never dispatches the plain step, so no XLA
+    # work hides behind it.)
+    out_warm = exe2.run_steps(prog, feed=_feed(), fetch_list=[loss],
+                              steps=3)
+    assert multi.value == c0
+    assert _tot("executor_multi_cache_miss_total") == m0
+    assert _tot("jit_cache_hits_total") > h0
+    assert out_warm[0].shape == out_cold[0].shape
+    assert np.all(np.isfinite(out_warm[0]))
+    # the warm loop keeps a lowerable jit twin so multi_cost() is not
+    # silently None on warm processes (review finding)
+    assert exe2._last_compiled._multi_jit
+
+
+def test_flag_off_byte_identical(tmp_path):
+    """jit_cache_dir unset -> pre-cache behavior: no entries, no
+    jit_cache counters, no explain() section, compile-log records
+    carry no jit_cache field."""
+    assert flags.get_flag("jit_cache_dir") == ""
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    h0, m0 = _tot("jit_cache_hits_total"), _tot("jit_cache_misses_total")
+    out = exe.run(pt.default_main_program(), feed=_feed(),
+                  fetch_list=[loss])
+    assert np.all(np.isfinite(out[0]))
+    assert _tot("jit_cache_hits_total") == h0
+    assert _tot("jit_cache_misses_total") == m0
+    assert _entries(tmp_path) == []
+    rep = exe.explain(pt.default_main_program(), feed=_feed(),
+                      fetch_list=[loss])
+    assert "jit_cache" not in rep
+    assert all("jit_cache" not in r for r in forensics.compile_log())
+
+
+# --- cross-process warm start (the headline) -------------------------------
+
+def _run_probe(cache_dir):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PTPU_JIT_CACHE_DIR"] = str(cache_dir)
+    env.pop("PTPU_CHAOS_SPEC", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.framework.jit_cache",
+         "--restart-probe", "lm"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("RESTART_PROBE ")]
+    assert proc.returncode == 0 and lines, (proc.stdout, proc.stderr)
+    return json.loads(lines[-1][len("RESTART_PROBE "):])
+
+
+def test_cross_process_warm_start(tmp_path):
+    """Compile in subprocess A, load in subprocess B: B records ZERO
+    XLA compiles (executor_compile_total frozen at 0 for the whole
+    process) and bit-identical losses — the acceptance headline."""
+    cold = _run_probe(tmp_path)
+    assert cold["executor_compile_total"] > 0
+    assert cold["jit_cache_misses_total"] > 0
+    assert cold["restart_to_first_step_seconds"] > 0
+    warm = _run_probe(tmp_path)
+    assert warm["executor_compile_total"] == 0
+    assert warm["jit_cache_hits_total"] >= 2        # step + multi/init
+    assert warm["jit_cache_errors_total"] == 0
+    assert warm["losses"] == cold["losses"]
+
+
+# --- corrupt-entry fallback matrix -----------------------------------------
+
+def _corrupt_all(d, mode):
+    for name in _entries(d):
+        path = os.path.join(d, name)
+        raw = open(path, "rb").read()
+        if mode == "truncated":
+            doctored = raw[:len(raw) // 2]
+        elif mode == "bit_flip":
+            b = bytearray(raw)
+            b[-3] ^= 0x40               # inside the pickled body
+            doctored = bytes(b)
+        elif mode == "bad_magic":
+            doctored = b"NOTJCMAG" + raw[8:]
+        elif mode == "stale_jaxlib":
+            fixed = 8 + 4
+            (hlen,) = struct.unpack("<I", raw[8:fixed])
+            header = json.loads(raw[fixed:fixed + hlen].decode())
+            header["env"]["jaxlib"] = "0.0.0-foreign-build"
+            hdr = json.dumps(header, sort_keys=True).encode()
+            doctored = (raw[:8] + struct.pack("<I", len(hdr)) + hdr
+                        + raw[fixed + hlen:])
+        else:
+            raise AssertionError(mode)
+        with open(path, "wb") as f:
+            f.write(doctored)
+
+
+@pytest.mark.parametrize("mode", ["truncated", "bit_flip", "bad_magic",
+                                  "stale_jaxlib"])
+def test_corrupt_entry_recompiles_with_warning(tmp_path, mode):
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = _feed()
+    prog = pt.default_main_program()
+    out_good = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert _entries(tmp_path)
+    _corrupt_all(tmp_path, mode)
+    e0 = _tot("jit_cache_errors_total")
+    c0 = _tot("executor_compile_total")
+    exe2 = pt.Executor(pt.CPUPlace(), scope=exe.scope)
+    with pytest.warns(RuntimeWarning, match="jit_cache"):
+        out = exe2.run(prog, feed=feed, fetch_list=[loss])
+    # loud counter + a REAL recompile + correct outputs — never a
+    # bricked start
+    assert _tot("jit_cache_errors_total") > e0
+    assert _tot("executor_compile_total") > c0
+    assert np.array_equal(out[0], out_good[0])
+    # the bad entry was dropped and re-stored by the recompile
+    assert _entries(tmp_path)
+
+
+def test_stale_flags_is_clean_miss_not_error(tmp_path):
+    """A numerics-flag flip changes the KEY (fresh entry), it does not
+    poison the old one: recompile with NO corruption warning and NO
+    error counter movement."""
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = _feed()
+    prog = pt.default_main_program()
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    n_before = len(_entries(tmp_path))
+    e0 = _tot("jit_cache_errors_total")
+    old = flags.get_flag("amp_bf16")
+    try:
+        flags.set_flag("amp_bf16", True)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            exe.run(prog, feed=feed, fetch_list=[loss])
+        assert not [w for w in rec
+                    if "jit_cache" in str(w.message)]
+    finally:
+        flags.set_flag("amp_bf16", old)
+    assert _tot("jit_cache_errors_total") == e0
+    assert len(_entries(tmp_path)) == n_before + 1      # fresh entry
+
+
+# --- LRU GC ----------------------------------------------------------------
+
+def test_lru_eviction_order(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    hashes = []
+    for i, n in enumerate((4, 8, 16)):
+        fn = jax.jit(lambda x: x + 1.0)
+        compiled = fn.lower(
+            jnp.zeros((n,), jnp.float32)).compile()
+        comps = {"i": i}
+        khash = jit_cache.entry_key("executor_step", comps)
+        assert jit_cache.store("executor_step", khash, comps, compiled)
+        hashes.append(khash)
+    paths = [os.path.join(tmp_path, h + ".jc") for h in hashes]
+    sizes = [os.path.getsize(p) for p in paths]
+    # explicit LRU stamps: entry 1 oldest, then 0, then 2 newest
+    now = 1_700_000_000
+    for h, t in zip(hashes, (now + 10, now, now + 20)):
+        os.utime(os.path.join(tmp_path, h + ".jc"), (t, t))
+    ev0 = _tot("jit_cache_evictions_total")
+    # budget for exactly two entries -> the oldest-mtime one (index 1)
+    # must go first
+    evicted = jit_cache.gc(limit_bytes=sizes[0] + sizes[2] + 1)
+    assert evicted == 1
+    assert _tot("jit_cache_evictions_total") == ev0 + 1
+    left = _entries(tmp_path)
+    assert hashes[1] + ".jc" not in left
+    assert hashes[0] + ".jc" in left and hashes[2] + ".jc" in left
+    # a LOAD refreshes mtime: now 0 is oldest -> next squeeze drops it
+    assert jit_cache.load("executor_step", hashes[2],
+                          {"i": 2}) is not None
+    os.utime(os.path.join(tmp_path, hashes[0] + ".jc"), (now, now))
+    assert jit_cache.gc(limit_bytes=sizes[2] + 1) == 1
+    assert hashes[0] + ".jc" not in _entries(tmp_path)
+    assert hashes[2] + ".jc" in _entries(tmp_path)
+    # purge drops everything and zeroes the gauge
+    assert jit_cache.purge() == 1
+    assert _entries(tmp_path) == []
+
+
+# --- verified-programs-only store gate -------------------------------------
+
+def test_unverified_program_not_stored(tmp_path, monkeypatch):
+    """The PR 10 gate: a program the analysis plane cannot vouch for
+    (here: the verifier itself blows up) still RUNS, but nothing is
+    persisted and jit_cache_unverified_total counts it."""
+    from paddle_tpu import analysis
+
+    def boom(*a, **k):
+        raise RuntimeError("verifier exploded")
+    monkeypatch.setattr(analysis, "verify_program", boom)
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    flags.set_flag("verify_program", "off")   # gate still runs for store
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    u0 = _tot("jit_cache_unverified_total")
+    exe.run(pt.default_startup_program())
+    out = exe.run(pt.default_main_program(), feed=_feed(),
+                  fetch_list=[loss])
+    assert np.all(np.isfinite(out[0]))      # the run itself is untouched
+    assert _entries(tmp_path) == []         # nothing persisted
+    assert _tot("jit_cache_unverified_total") > u0
+
+
+# --- predictor + serving warm grids ----------------------------------------
+
+def test_predictor_warm_grid(tmp_path):
+    from paddle_tpu import inference, io
+    flags.set_flag("jit_cache_dir", str(tmp_path / "jc"))
+    img = layers.data("img", [8], dtype="float32")
+    pred = layers.fc(img, size=4, act="softmax")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    model_dir = tmp_path / "model"
+    os.makedirs(model_dir)
+    io.save_inference_model(str(model_dir), ["img"], [pred], exe)
+    cfg = inference.NativeConfig(model_dir=str(model_dir), use_tpu=False)
+    feed = {"img": np.random.RandomState(1).rand(2, 8).astype("f4")}
+    h_cold = _tot("jit_cache_hits_total")
+    p1 = inference.Predictor(cfg)
+    p1.prepare(feed)
+    out_cold = p1.run(feed)
+    assert _tot("jit_cache_hits_total") == h_cold   # cold: no hit
+    # a fresh Predictor (fresh process shape: empty _compiled dict)
+    # deserializes the grid — zero compiles, identical outputs
+    h0 = _tot("jit_cache_hits_total")
+    p2 = inference.Predictor(cfg)
+    p2.prepare(feed)
+    out_warm = p2.run(feed)
+    assert _tot("jit_cache_hits_total") == h0 + 1
+    assert np.array_equal(out_cold[0], out_warm[0])
+
+
+def test_serving_warm_grid_token_identical(tmp_path):
+    from paddle_tpu import models, serving
+    from paddle_tpu.framework import executor as em
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    scope = em.Scope()
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=97, tgt_vocab_size=97, max_length=32,
+        n_layer=2, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    models.transformer.build_lm_net(
+        cfg, seq_len=24, is_test=True, fused_attention=False,
+        fused_head=False)
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    pt.default_startup_program().random_seed = 3
+    exe.run(pt.default_startup_program())
+    params = serving.extract_lm_params(
+        pt.default_main_program(), scope, cfg)
+
+    def decode(engine, prompt, n):
+        t0 = engine.start_sequence(0, prompt)
+        toks = [int(t0)]
+        for _ in range(n):
+            toks.append(int(engine.decode_step()[0]))
+        return toks
+
+    eng = serving.DecodeEngine(cfg, params, max_batch=2, max_len=32,
+                               prompt_buckets=(8,))
+    eng.prepare()
+    cold_compiles = _tot("serving_compiles_total")
+    assert cold_compiles >= 2           # prefill bucket + decode step
+    toks_cold = decode(eng, [5, 6, 7], 5)
+    # warm replica: same geometry/weights, fresh engine — the WHOLE
+    # grid deserializes: serving_compiles_total FROZEN, forensics
+    # silent, decode token-identical to the cold path
+    f0 = len(forensics.compile_log())
+    eng2 = serving.DecodeEngine(cfg, params, max_batch=2, max_len=32,
+                                prompt_buckets=(8,))
+    eng2.prepare()
+    assert _tot("serving_compiles_total") == cold_compiles
+    assert len(forensics.compile_log()) == f0
+    assert _tot("jit_cache_hits_total") >= 2
+    toks_warm = decode(eng2, [5, 6, 7], 5)
+    assert toks_warm == toks_cold
+
+
+# --- supervisor plumbing ----------------------------------------------------
+
+def test_supervisor_propagates_cache_dir(tmp_path):
+    from paddle_tpu.distributed.supervisor import Supervisor
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    sup = Supervisor([["true"], ["true"]],
+                     envs=[None, {"PTPU_JIT_CACHE_DIR": "/rank/own"}])
+    env0 = sup._env_for(0, 0)
+    assert env0["PTPU_JIT_CACHE_DIR"] == str(tmp_path)
+    # a restarted incarnation keeps it too (chaos-stripped env)
+    env0r = sup._env_for(0, 1)
+    assert env0r["PTPU_JIT_CACHE_DIR"] == str(tmp_path)
+    # an explicit per-rank dir wins over the flag
+    env1 = sup._env_for(1, 0)
+    assert env1["PTPU_JIT_CACHE_DIR"] == "/rank/own"
+
+
+# --- CLI exit-code contract -------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    flags.set_flag("jit_cache_dir", "")
+    # no dir, no action -> usage error
+    assert jit_cache.main([]) == 2
+    assert jit_cache.main(["--ls"]) == 2            # no dir configured
+    assert jit_cache.main(["--restart-probe", "bogus"]) == 2
+    # self-test is self-contained (temp dir) and must pass
+    assert jit_cache.main(["--self-test"]) == 0
+    # happy paths against an explicit dir
+    assert jit_cache.main(["--dir", str(tmp_path), "--ls"]) == 0
+    listing = capsys.readouterr().out
+    assert '"entries": 0' in listing
+    assert jit_cache.main(["--dir", str(tmp_path), "--gc"]) == 0
+    assert jit_cache.main(["--dir", str(tmp_path), "--purge"]) == 0
+    flags.set_flag("jit_cache_dir", "")
