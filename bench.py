@@ -93,6 +93,13 @@ _BASIS = {
     "lstm_train_ms_per_batch":
         "reference's published LSTM text-class h512/T100/bs64: 184 "
         "ms/batch on K40m (benchmark/README.md)",
+    "deepfm_train_examples_per_sec":
+        "no reference anchor (the reference's dist_ctr/DeepFM CTR "
+        "path publishes no throughput number); BASELINE config 4 "
+        "shapes (39 fields, 1M+1-row tables) through the Program/"
+        "Executor path, vs_baseline vs an ASSUMED 100k examples/s "
+        "industrial CTR-trainer bar (assumption, not a measurement) "
+        "purely as a longitudinal ratio",
     "restart_to_first_step_cold_seconds":
         "no reference anchor (the reference persisted no compiled "
         "artifacts); process exec to first completed Trainer step with "
@@ -714,6 +721,46 @@ def bench_serving_ready_warm(on_tpu):
                       "dir — grid + decode step deserialized"}
 
 
+CTR_EXAMPLES_PER_SEC_BAR = 100_000.0    # documented assumption, see _BASIS
+
+
+def bench_deepfm(on_tpu):
+    """Sparse-plane recommender row (ISSUE 13): DeepFM at BASELINE
+    config 4 shapes (39 sparse fields over a 1,000,001-row table)
+    through the Program/Executor path with adagrad — the dense-graph
+    twin of the streaming pull/push trainer, so the gated number
+    tracks the embedding + FM + tower math itself."""
+    from paddle_tpu import models
+    pt, exe = _fresh(on_tpu)
+    if on_tpu:
+        cfg = models.deepfm.DeepFMConfig()          # config 4 shapes
+        batch = 512
+    else:       # smoke shapes (same policy as _bench_lm_cfg)
+        cfg = models.deepfm.DeepFMConfig(
+            num_field=8, vocab_size=1000, embed_dim=8,
+            fc_sizes=(64, 64))
+        batch = 8
+    feeds, avg_cost, _prob = models.deepfm.build_train_net(cfg)
+    pt.optimizer.Adagrad(learning_rate=0.01).minimize(avg_cost)
+    exe.run(pt.default_startup_program())
+    feed = _stage(models.deepfm.make_fake_batch(cfg, batch), on_tpu)
+    prog = pt.default_main_program()
+    for _ in range(2):
+        exe.run(prog, feed=feed, fetch_list=[avg_cost])
+    dt, loss = _time_steps(exe, prog, feed, avg_cost, on_tpu)
+    ex_s = batch / dt
+    row = {
+        "metric": "deepfm_train_examples_per_sec",
+        "value": round(ex_s, 1), "unit": "examples/s",
+        "vs_baseline": round(ex_s / CTR_EXAMPLES_PER_SEC_BAR, 3),
+        "config": (f"DeepFM F{cfg.num_field} V{cfg.vocab_size} "
+                   f"K{cfg.embed_dim} fc{list(cfg.fc_sizes)} "
+                   f"bs{batch} adagrad, executor path"),
+        "loss": round(loss, 4),
+    }
+    return _attach_cost(row, exe, prog, feed, avg_cost, dt)
+
+
 def _record_row_metrics(row):
     """Publish one workload row through the observability registry, so
     BENCH_r*.json rows and a live process's /metrics share one schema
@@ -786,7 +833,10 @@ def main():
             bench_resnet50_infer_int8, bench_alexnet,
             bench_googlenet, bench_lstm, bench_lm_8k,
             bench_lm_serving, bench_restart_cold, bench_restart_warm,
-            bench_serving_ready_cold, bench_serving_ready_warm)):
+            bench_serving_ready_cold, bench_serving_ready_warm,
+            bench_deepfm)):
+        # (new rows append at the END so earlier rows keep their
+        # historical runlog step indices — the PR 7 alignment contract)
         try:
             rows.append(fn(on_tpu))
         except Exception as e:          # a broken workload must not hide

@@ -45,6 +45,7 @@ from . import serving
 from . import imperative
 from . import inference
 from . import distributed
+from . import sparse
 from .data_feeder import DataFeeder
 from .trainer import (BeginEpochEvent, BeginStepEvent, CheckpointConfig,
                       EndEpochEvent, EndStepEvent, Trainer)

@@ -137,6 +137,54 @@ def _infer_lookup_table(op, ins, attrs):
     return {"Out": [(tuple(base) + (ws[1],), wd)]}
 
 
+# --- sparse plane (paddle_tpu/sparse; ops/nn_ops.py) ----------------------
+
+@register_shape_infer("sparse_embedding_lookup")
+def _infer_sparse_embedding_lookup(op, ins, attrs):
+    """lookup_table's contract plus hash bucketing: ids may exceed the
+    vocab when hash_bucket is on, so only type/rank are checkable."""
+    (ids, idt) = _first(ins, "Ids")
+    (ws, wd) = _first(ins, "W")
+    if idt is not None and not np.issubdtype(np.dtype(idt), np.integer):
+        raise InferError(
+            f"sparse_embedding_lookup ids {op.inputs['Ids'][0]!r} must "
+            f"be integer, got {idt}")
+    if ws is not None and len(ws) != 2:
+        raise InferError(
+            f"sparse_embedding_lookup table {op.inputs['W'][0]!r} must "
+            f"be 2-D [buckets, dim], got {_fmt(ws)}")
+    if ids is None or ws is None:
+        return {"Out": [(None, wd)]}
+    base = ids[:-1] if (len(ids) >= 2 and ids[-1] == 1) else ids
+    return {"Out": [(tuple(base) + (ws[1],), wd)]}
+
+
+@register_shape_infer("sparse_scatter_update")
+def _infer_sparse_scatter_update(op, ins, attrs):
+    """Out mirrors W (the scatter is in-place-shaped); Grad's trailing
+    dim must match the table dim — the scatter-add-vs-overwrite bug
+    class surfaces as silently wrong numerics, but a transposed grad
+    surfaces HERE."""
+    (ws, wd) = _first(ins, "W")
+    (ids, idt) = _first(ins, "Ids")
+    (gs, gd) = _first(ins, "Grad")
+    if idt is not None and not np.issubdtype(np.dtype(idt), np.integer):
+        raise InferError(
+            f"sparse_scatter_update ids {op.inputs['Ids'][0]!r} must "
+            f"be integer, got {idt}")
+    if ws is not None and len(ws) != 2:
+        raise InferError(
+            f"sparse_scatter_update table {op.inputs['W'][0]!r} must "
+            f"be 2-D [rows, dim], got {_fmt(ws)}")
+    if ws is not None and gs is not None and len(gs) >= 1:
+        # trailing dims must agree when both are concrete
+        if gs[-1] not in (-1, ws[1]) and ws[1] != -1:
+            raise InferError(
+                f"sparse_scatter_update grad {op.inputs['Grad'][0]!r} "
+                f"trailing dim {gs[-1]} != table dim {ws[1]}")
+    return {"Out": [(ws, wd)]}
+
+
 # --- structural / executor-interpreted ops -------------------------------
 
 @register_shape_infer("autodiff")

@@ -78,6 +78,15 @@ def model_builders() -> Dict[str, Callable[[], Tuple[list, list]]]:
         _optimize(cost)
         return feeds, [cost, prob]
 
+    def deepfm_sparse():
+        # the sparse plane's Program-path DeepFM: hash-bucketed
+        # sparse_embedding_lookup ops (19th gate model, ISSUE 13)
+        cfg = models.deepfm.DeepFMConfig(
+            num_field=4, vocab_size=50, embed_dim=4, fc_sizes=(8, 8))
+        feeds, cost, prob = models.deepfm.build_sparse_train_net(cfg)
+        _optimize(cost)
+        return feeds, [cost, prob]
+
     return {
         "lenet": _simple(models.lenet.build_train_net),
         "alexnet": _simple(lambda: models.alexnet.build_train_net(
@@ -95,6 +104,7 @@ def model_builders() -> Dict[str, Callable[[], Tuple[list, list]]]:
         "transformer_lm": lm,
         "bert": bert,
         "deepfm": deepfm,
+        "deepfm_sparse": deepfm_sparse,
         "nmt": nmt,
         "stacked_lstm": _simple(models.stacked_lstm.build_train_net),
         "book_fit_a_line": _simple(models.book.fit_a_line),

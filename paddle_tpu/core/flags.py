@@ -299,3 +299,19 @@ define_flag("max_worker_restarts", 3,
             "more than this many times is declared failed for good "
             "(distributed/supervisor.py; restarts back off "
             "exponentially with deterministic jitter).")
+
+# --- sparse plane (paddle_tpu/sparse/: CTR streaming + shard service) ------
+define_flag("sparse_staleness_bound", 16,
+            "Bounded-staleness window for async sparse pushes: a "
+            "push_grads whose pull_version lags the table version by "
+            "more than this many applied pushes is rejected with "
+            "status 'stale' (the worker re-pulls and recomputes) "
+            "instead of silently applying an arbitrarily old "
+            "gradient.  0 = fully synchronous (any staleness "
+            "rejects); raise for more async slack.")
+define_flag("sparse_push_ledger_size", 4096,
+            "Entries kept in a sparse shard's push ledger (push_id -> "
+            "rows_applied): the exactly-once record that lets an "
+            "at-least-once retried push_grads re-ack instead of "
+            "double-applying.  Oldest entries evict first; keep it "
+            "larger than workers x in-flight pushes.")

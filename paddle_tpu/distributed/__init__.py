@@ -1,5 +1,6 @@
 """Distributed coordination utilities (ref go/ layer of the reference)."""
-from .async_update import AsyncParameterServer, run_async_workers
+from .async_update import (AsyncParameterServer, SparseShardClient,
+                           StalePushError, run_async_workers)
 from .supervisor import Supervisor
 from .task_queue import (Heartbeater, Task, TaskMaster, TaskMasterClient,
                          serve_master)

@@ -30,11 +30,12 @@ compensation beating plain async under forced staleness.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["AsyncParameterServer", "run_async_workers"]
+__all__ = ["AsyncParameterServer", "run_async_workers",
+           "SparseShardClient", "StalePushError"]
 
 
 class AsyncParameterServer:
@@ -122,3 +123,154 @@ def run_async_workers(server: AsyncParameterServer,
     if errs:
         raise errs[0]
     return server.get()
+
+
+# -- remote transport: the sparse plane's worker-side client ----------------
+#
+# The process-scale version of the loop above: pull/push go over the
+# task-queue JSON-lines transport to a SparseShardService
+# (paddle_tpu/sparse/service.py) instead of a threading.Lock.  Every RPC
+# routes through TaskMasterClient._call, which buys three things without
+# new code here: the resilience/retry.py backoff + re-dial loop on
+# transport failure (no hand-rolled sleeps), the task_queue.rpc chaos
+# fault point, and PR 11 traceparent propagation — master-side handling
+# of a sparse push attributes to the worker step that caused it.  On TOP
+# of the transport retry, the sparse verbs carry their own fault points
+# (sparse.pull / sparse.push, docs/RESILIENCE.md catalog) and their own
+# named retry policies, so a chaos schedule can fail the sparse path
+# specifically while the lease plane stays healthy.
+
+class StalePushError(RuntimeError):
+    """A push exceeded the shard's bounded-staleness window even after
+    re-pull retries — the worker is too far behind the fleet."""
+
+
+class SparseShardClient:
+    """Worker-side pull/push client for one shard group.
+
+    ``endpoints`` is one endpoint (or failover list) per SHARD, in
+    shard-id order; global row r is owned by shard ``r % num_shards``
+    (table.partition_rows).  The single-shard case passes one endpoint.
+    Not thread-safe (one client per worker thread, like
+    TaskMasterClient)."""
+
+    def __init__(self, endpoints, timeout: float = 10.0):
+        from ..resilience import chaos as _chaos, retry as _retry
+        from .task_queue import TaskMasterClient
+        self._chaos, self._retry = _chaos, _retry
+        if isinstance(endpoints, str) or (
+                isinstance(endpoints, tuple) and len(endpoints) == 2
+                and isinstance(endpoints[1], int)):
+            endpoints = [endpoints]      # one shard: "h:p" or (h, p)
+        # a plain "h:p,h:p" string is ONE shard with failover endpoints
+        self._clients = [TaskMasterClient(endpoints=ep, timeout=timeout)
+                         for ep in endpoints]
+        self._policy = _retry.RetryPolicy(
+            name="sparse_rpc",
+            retry_on=(ConnectionError, OSError))
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._clients)
+
+    def _rpc(self, shard: int, site: str, **req) -> dict:
+        """One sparse verb through shard `shard`'s TaskMasterClient.
+        The chaos trigger sits INSIDE the retried attempt, so an
+        injected sparse.pull/sparse.push ConnectionError exercises the
+        same backoff path a real transport failure would."""
+        client = self._clients[shard]
+
+        def attempt():
+            self._chaos.trigger(site, exc=ConnectionError)
+            return client._call(**req)
+
+        return self._retry.call_with_retry(attempt, self._policy)
+
+    # -- table lifecycle ---------------------------------------------------
+    def init_tables(self, specs: Sequence) -> None:
+        """sparse_init on EVERY shard (idempotent server-side)."""
+        wire = [s.to_wire() if hasattr(s, "to_wire") else dict(s)
+                for s in specs]
+        for shard in range(self.num_shards):
+            self._rpc(shard, "sparse.pull", method="sparse_init",
+                      tables=wire)
+
+    # -- hot path ----------------------------------------------------------
+    def pull_rows(self, table: str, rows):
+        """[N] global row ids -> ([N, dim] f32 rows, {shard: version}).
+        Rows route to their owning shards; order is restored."""
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        S = self.num_shards
+        out: Optional[np.ndarray] = None
+        versions: Dict[int, int] = {}
+        for shard in range(S):
+            mask = (rows % S) == shard
+            if not mask.any():
+                continue
+            resp = self._rpc(shard, "sparse.pull", method="pull_rows",
+                             table=table, rows=rows[mask].tolist())
+            vals = np.asarray(resp["values"], np.float32)
+            if out is None:
+                out = np.empty((rows.shape[0], vals.shape[1]),
+                               np.float32)
+            out[mask] = vals
+            versions[shard] = int(resp["version"])
+        if out is None:                      # empty pull
+            out = np.zeros((0, 0), np.float32)
+        return out, versions
+
+    def push_grads(self, table: str, grad, versions: Dict[int, int],
+                   push_id: str) -> dict:
+        """Push one SelectedRows gradient, split across owning shards.
+        Returns {"rows_applied": total, "staleness": max, "stale":
+        [shards that rejected]} — a non-empty ``stale`` list means the
+        caller must re-pull those rows and recompute."""
+        g = grad.merged()
+        S = self.num_shards
+        applied, max_stale, stale_shards = 0, 0, []
+        for shard in range(S):
+            mask = (g.rows % S) == shard
+            if not mask.any():
+                continue
+            sub = type(g)(g.rows[mask], g.values[mask], g.height)
+            resp = self._rpc(
+                shard, "sparse.push", method="push_grads", table=table,
+                grad=sub.to_wire(),
+                pull_version=versions.get(shard, 0),
+                push_id=f"{push_id}@s{shard}")
+            if resp.get("status") == "stale":
+                stale_shards.append(shard)
+            else:
+                applied += int(resp.get("rows_applied", 0))
+            max_stale = max(max_stale, int(resp.get("staleness", 0)))
+        return {"rows_applied": applied, "staleness": max_stale,
+                "stale": stale_shards}
+
+    # -- eval / bookkeeping ------------------------------------------------
+    def table_state(self, table: str) -> np.ndarray:
+        """Reassemble the FULL [rows, dim] table from every shard's
+        mod-partition — eval/tests only, never the training path."""
+        parts = [self._rpc(s, "sparse.pull", method="sparse_state",
+                           table=table) for s in range(self.num_shards)]
+        rows, dim = parts[0]["rows"], parts[0]["dim"]
+        full = np.zeros((rows, dim), np.float32)
+        for s, p in enumerate(parts):
+            full[s::self.num_shards] = np.asarray(p["values"],
+                                                  np.float32)
+        return full
+
+    def stats(self) -> List[dict]:
+        return [self._rpc(s, "sparse.pull",
+                          method="sparse_stats")["stats"]
+                for s in range(self.num_shards)]
+
+    def close(self):
+        for c in self._clients:
+            c.close()
+
+    def __enter__(self) -> "SparseShardClient":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

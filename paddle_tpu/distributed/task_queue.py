@@ -645,6 +645,12 @@ class TaskMaster:
 
 # -- TCP transport (JSON lines) -------------------------------------------
 
+# sparse-plane verbs (paddle_tpu/sparse/service.py SparseShardService.
+# VERBS): listed here too so a master WITHOUT a shard service answers
+# them with a named error instead of "bad method"
+_SPARSE_VERBS = ("sparse_init", "pull_rows", "push_grads",
+                 "sparse_state", "sparse_stats")
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         master: TaskMaster = self.server.master   # type: ignore
@@ -702,6 +708,17 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"ok": True, "stats": master.stats()}
         if method == "ledger":
             return {"ok": True, "ledger": master.ledger_entries()}
+        if method in _SPARSE_VERBS:
+            # sparse plane (paddle_tpu/sparse/service.py): the
+            # parameter-shard verbs ride this transport so replies
+            # carry the master generation and requests the caller's
+            # traceparent — wired by serve_master(sparse=...)
+            svc = getattr(self.server, "sparse", None)
+            if svc is None:
+                return {"ok": False,
+                        "error": "no SparseShardService attached to "
+                                 "this master"}
+            return svc.handle(method, req)
         if method in ("report_metrics", "report_events"):
             # fleet telemetry verbs (observability/fleet.py): workers
             # push snapshots/spans to the aggregator attached via
@@ -771,12 +788,16 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 def serve_master(master: TaskMaster, host: str = "127.0.0.1",
-                 port: int = 0, aggregator=None):
+                 port: int = 0, aggregator=None, sparse=None):
     """Start the TCP front end; returns (server, (host, port)).  Call
     server.shutdown() to stop (joins the server thread).  Pass a
     FleetAggregator to accept report_metrics/report_events pushes — it
     is also wired as a membership listener, so /healthz keys on the
-    master's heartbeat truth, not on metric-report staleness.
+    master's heartbeat truth, not on metric-report staleness.  Pass a
+    ``SparseShardService`` (paddle_tpu/sparse) to serve the
+    parameter-shard verbs (pull_rows/push_grads/...) on the same
+    socket — the sparse plane's pserver riding the lease plane's
+    transport.
 
     A reaper thread ticks lease/heartbeat expiry so a silent fleet (the
     exact failure membership exists to catch) is still declared dead on
@@ -788,6 +809,7 @@ def serve_master(master: TaskMaster, host: str = "127.0.0.1",
             f"task master failed to bind {host}:{port}: {e}") from e
     srv.master = master   # type: ignore
     srv.aggregator = aggregator   # type: ignore
+    srv.sparse = sparse   # type: ignore
     if aggregator is not None and hasattr(aggregator, "note_worker"):
         master.add_membership_listener(aggregator.note_worker)
     # poll_interval: shutdown() blocks one poll tick; the 0.5s default

@@ -1,12 +1,12 @@
-"""AsyncExecutor — high-throughput multithread trainer over sharded
-text files.
+"""AsyncExecutor — streaming multithread trainer over sharded text
+files.
 
 Capability parity with the reference's AsyncExecutor stack
 (framework/async_executor.h:60 RunFromFile, executor_thread_worker.h:136,
 data_feed.h:49 MultiSlotDataFeed + data_feed.proto, Python
-async_executor.py:33): N worker threads decouple file reading/parsing
-from training, each pulling file shards from a queue, batching
-MultiSlot-format text lines, and stepping the model.
+async_executor.py:33): worker threads decouple file reading/parsing
+from training, batching MultiSlot-format text lines and stepping the
+model.
 
 TPU-first redesign, not a thread-per-scope interpreter:
   * the program is compiled ONCE (whole-program XLA jit via the shared
@@ -14,19 +14,44 @@ TPU-first redesign, not a thread-per-scope interpreter:
     executables are thread-safe and release the GIL, so parsing/batching
     genuinely overlaps device compute;
   * the reference's Hogwild-style racy in-place updates (each thread's
-    op list writes the shared Scope) become atomic step-granular updates:
-    workers snapshot params, compute, and a lock applies the state
-    update.  Same async-CTR capability, no torn reads;
-  * pslib pull/push (executor_thread_worker.h:195 AsyncExecutorThreadWorker)
-    is out of scope for TPU — the sharded-embedding path
-    (parallel/sharded_embedding.py) carries the big-table capability.
+    op list writes the shared Scope) become atomic step-granular
+    updates: a lock serializes the state transition.  Same async-CTR
+    capability, no torn reads;
+  * pslib pull/push (executor_thread_worker.h:195) lives in the sparse
+    plane: paddle_tpu/sparse carries the big-table pull_rows/push_grads
+    capability, parallel/sharded_embedding.py the in-HBM twin.
+
+Streaming architecture (the sparse-plane rework of the old
+one-queue-of-filenames loop):
+
+  * **per-source readers** — every file gets its own producer thread
+    parsing lines into its own BOUNDED queue (``queue_depth`` batches),
+    so one slow/cold source backpressures only itself; queue depths
+    ride the ``reader_buffer_depth`` gauge labeled per source (the
+    input-pipeline anatomy the trainer path already publishes);
+  * **round-robin consumers** — ``thread_num`` step workers drain the
+    source queues round-robin, so a fast source can't starve the rest
+    (the reference's MultiSlotDataFeed fairness);
+  * **deterministic resume** — ``checkpoint_path`` persists, per
+    source, a contiguous watermark of lines whose batch has COMPLETED
+    its step (CRC-free JSON, atomic rename; out-of-order completions
+    under several step workers park until the gap closes).  A
+    restarted run fast-forwards each source past its watermark: no
+    line is ever skipped, one step worker gives exactly-once, and with
+    N workers the re-trained overlap is bounded by the in-flight
+    window;
+  * **first-failure propagation** — any step/parse error stops the
+    whole pool promptly (producers and consumers observe a stop
+    event), and ``run`` re-raises the FIRST error instead of letting a
+    poisoned batch kill one thread while the rest train on.
 
 File format (MultiSlotDataFeed, data_feed.h:224): per line, for each
-slot in order: `<n> v1 ... vn`; uint64 slots hold ids, float slots hold
-dense values.
+slot in order: ``<n> v1 ... vn``; uint64 slots hold ids, float slots
+hold dense values.
 """
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -36,8 +61,31 @@ import numpy as np
 
 from ..core.enforce import EnforceNotMet
 from ..core.place import CPUPlace, Place
+from ..observability import metrics as obs_metrics
 from .executor import Executor
 from .program import Program
+
+_m_rejected_lines = obs_metrics.counter(
+    "datafeed_rejected_lines_total",
+    "MultiSlot text lines rejected by DataFeedDesc.parse_line "
+    "(short field counts, non-numeric ids, truncated slots).  In "
+    "on_bad_line='skip' mode these lines are dropped and counted; in "
+    "the default 'raise' mode the first one aborts the run AND "
+    "counts.")
+_m_buffer_depth = obs_metrics.gauge(
+    "reader_buffer_depth",
+    "Items queued in a reader.buffered() prefetch queue at its last "
+    "consume, labeled per buffered() decorator (name= arg, or "
+    "buffered<N> in creation order).",
+    ("reader",))
+
+
+class DataFeedParseError(EnforceNotMet, ValueError):
+    """A malformed MultiSlot line: names the source/line/slot so the
+    operator can open the offending shard at the offending byte,
+    instead of an index error from deep inside a split() list.  Both an
+    EnforceNotMet (framework invariant surface) and a ValueError
+    (malformed user data)."""
 
 
 class Slot:
@@ -72,40 +120,161 @@ class DataFeedDesc:
         for s in self.slots:
             s.is_used = s.name in used
 
-    def parse_line(self, line: str):
-        """One MultiSlot line -> {slot: np.ndarray(dim)} for used slots."""
+    def parse_line(self, line: str, lineno: Optional[int] = None,
+                   source: Optional[str] = None):
+        """One MultiSlot line -> {slot: np.ndarray(dim)} for used
+        slots.  Malformed lines raise DataFeedParseError naming the
+        source file, line number, slot and offending token — and bump
+        ``datafeed_rejected_lines_total``."""
+        where = ""
+        if source is not None:
+            where += f" in {source!r}"
+        if lineno is not None:
+            where += f" at line {lineno}"
+
+        def bad(slot_name, why):
+            _m_rejected_lines.inc()
+            return DataFeedParseError(
+                f"MultiSlot parse error{where}: slot {slot_name!r} "
+                f"{why}: {line[:80]!r}")
+
         parts = line.split()
         out, i = {}, 0
         for slot in self.slots:
             if i >= len(parts):
-                raise EnforceNotMet(
-                    f"MultiSlot parse error: line ended before slot "
-                    f"{slot.name!r}: {line[:80]!r}")
-            n = int(parts[i])
+                raise bad(slot.name, "missing (line ended early)")
+            try:
+                n = int(parts[i])
+            except ValueError:
+                raise bad(slot.name,
+                          f"has non-integer value count {parts[i]!r}")
             if n < 0 or i + 1 + n > len(parts):
-                raise EnforceNotMet(
-                    f"MultiSlot parse error: slot {slot.name!r} declares "
-                    f"{n} values but the line ends early: {line[:80]!r}")
+                raise bad(slot.name,
+                          f"declares {n} values but the line ends "
+                          f"early")
             vals = parts[i + 1:i + 1 + n]
             i += 1 + n
             if not slot.is_used:
                 continue
             dtype = np.int64 if slot.type == "uint64" else np.float32
-            arr = np.asarray(vals, dtype=dtype)
+            try:
+                arr = np.asarray(vals, dtype=dtype)
+            except ValueError:
+                kind = "id" if slot.type == "uint64" else "value"
+                raise bad(slot.name, f"has a non-numeric {kind} among "
+                                     f"{vals[:6]!r}")
             if arr.shape[0] < slot.dim:        # pad (ids with 0)
                 arr = np.pad(arr, (0, slot.dim - arr.shape[0]))
             out[slot.name] = arr[:slot.dim]
         return out
 
 
+class _Batch:
+    """One collated batch plus its provenance (source, the producer's
+    per-source sequence number, and the line count through its last
+    line) — what the consumer commits to the stream checkpoint AFTER
+    the step lands."""
+
+    __slots__ = ("feed", "source", "seq", "end_line", "size")
+
+    def __init__(self, feed, source, seq, end_line, size):
+        self.feed = feed
+        self.source = source
+        self.seq = seq
+        self.end_line = end_line
+        self.size = size
+
+
+class _FirstError:
+    """First-failure latch: one error wins, everyone else observes the
+    stop event and unwinds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.exc: Optional[BaseException] = None
+        self.stop = threading.Event()
+
+    def trip(self, exc: BaseException):
+        with self._lock:
+            if self.exc is None:
+                self.exc = exc
+        self.stop.set()
+
+    def raise_if_set(self):
+        if self.exc is not None:
+            raise self.exc
+
+
+class StreamCheckpoint:
+    """Per-source committed line offsets, atomically persisted.
+
+    ``committed[source] = n`` means lines [0, n) of that source have
+    COMPLETED a training step (not merely been parsed).  The persisted
+    offset is a **contiguous watermark**: with several step workers,
+    batch k+1 of a source can finish before batch k (queue dequeue
+    order and step-lock acquisition order can invert), so completions
+    park in a per-source pending map and the watermark only advances
+    through gap-free sequence numbers — a crash can therefore never
+    SKIP a line (the resume-safety contract).  With one step worker
+    every line trains exactly once across a crash; with N workers the
+    re-trained overlap is bounded by the in-flight window."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._lock = threading.Lock()
+        self.committed: Dict[str, int] = {}
+        # out-of-order completion parking: source -> {seq: end_line},
+        # plus the next sequence number the watermark is waiting on
+        self._pending: Dict[str, Dict[int, int]] = {}
+        self._next_seq: Dict[str, int] = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                self.committed = {str(k): int(v) for k, v in
+                                  doc.get("files", {}).items()}
+            except (OSError, ValueError) as e:
+                raise EnforceNotMet(
+                    f"AsyncExecutor: stream checkpoint {path!r} is "
+                    f"unreadable ({e}); delete it to restart the "
+                    f"stream from zero") from e
+
+    def resume_offset(self, source: str) -> int:
+        with self._lock:
+            return self.committed.get(source, 0)
+
+    def commit(self, source: str, seq: int, end_line: int):
+        """Record that the batch with per-source sequence `seq`
+        (covering lines up to `end_line`) completed its step; persist
+        the watermark if it advanced."""
+        with self._lock:
+            self._pending.setdefault(source, {})[seq] = end_line
+            pend = self._pending[source]
+            nxt = self._next_seq.get(source, 0)
+            advanced = False
+            while nxt in pend:
+                line = pend.pop(nxt)
+                nxt += 1
+                if line > self.committed.get(source, 0):
+                    self.committed[source] = line
+                    advanced = True
+            self._next_seq[source] = nxt
+            if not advanced or not self.path:
+                return
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"files": dict(self.committed)}, f)
+            os.replace(tmp, self.path)
+
+
 class AsyncExecutor:
     """ref async_executor.py:33 / async_executor.h:60.
 
-    run(program, data_feed, filelist, thread_num, fetch) trains over all
-    files once (one 'epoch' in reference terms) and returns per-fetch
-    running means.  Metrics from every worker step are folded into the
-    totals under the update lock.
-    """
+    run(program, data_feed, filelist, thread_num, fetch) streams every
+    file once (one 'epoch' in reference terms) through per-source
+    bounded queues and returns per-fetch running means.  Metrics from
+    every worker step are folded into the totals under the update
+    lock."""
 
     def __init__(self, place: Optional[Place] = None):
         self.place = place or CPUPlace()
@@ -116,76 +285,172 @@ class AsyncExecutor:
 
     def run(self, program: Program, data_feed: DataFeedDesc,
             filelist: Sequence[str], thread_num: int,
-            fetch: Sequence[str], mode: str = "", debug: bool = False):
+            fetch: Sequence[str], mode: str = "", debug: bool = False,
+            queue_depth: int = 8,
+            checkpoint_path: Optional[str] = None,
+            on_bad_line: str = "raise",
+            step_fn=None):
+        """Stream ``filelist`` through the compiled program once.
+
+        queue_depth:       bounded batches buffered PER SOURCE — the
+                           backpressure window (reader_buffer_depth).
+        checkpoint_path:   persist per-source committed line offsets
+                           after every step; an existing file resumes
+                           the stream past already-trained lines.
+        on_bad_line:       "raise" (default) aborts on the first
+                           malformed line; "skip" drops it and counts
+                           it in datafeed_rejected_lines_total.
+        step_fn:           override the executor step (signature
+                           ``step_fn(feed) -> {fetch: value}``) — the
+                           sparse-plane worker reuses this loop with a
+                           pull/compute/push body instead of
+                           Executor.run.
+        """
         if thread_num <= 0:
             raise EnforceNotMet("AsyncExecutor: thread_num must be > 0")
+        if on_bad_line not in ("raise", "skip"):
+            raise EnforceNotMet(
+                f"AsyncExecutor: on_bad_line must be 'raise' or "
+                f"'skip', got {on_bad_line!r}")
         missing = [f for f in filelist if not os.path.exists(f)]
         if missing:
             raise EnforceNotMet(f"AsyncExecutor: missing files {missing}")
-        file_q: "queue.Queue[str]" = queue.Queue()
-        for f in filelist:
-            file_q.put(f)
 
+        ckpt = StreamCheckpoint(checkpoint_path)
+        err = _FirstError()
         fetch = list(fetch)
         update_lock = threading.Lock()
         totals = {n: 0.0 for n in fetch}
         counts = {n: 0 for n in fetch}
-        errors: List[BaseException] = []
+        sources = list(filelist)
+        queues: Dict[str, "queue.Queue[Optional[_Batch]]"] = {
+            s: queue.Queue(maxsize=max(1, int(queue_depth)))
+            for s in sources}
+        gauges = {s: _m_buffer_depth.labels(
+            reader=f"async_executor:{os.path.basename(s)}")
+            for s in sources}
 
-        def batches_from(fname):
-            batch: List[Dict[str, np.ndarray]] = []
-            with open(fname) as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    batch.append(data_feed.parse_line(line))
-                    if len(batch) == data_feed.batch_size:
-                        yield _collate(batch)
-                        batch = []
-            if batch:
-                yield _collate(batch)
+        def produce(source: str):
+            """Parse one source into its bounded queue; a None sentinel
+            marks exhaustion."""
+            q = queues[source]
+            try:
+                skip = ckpt.resume_offset(source)
+                batch: List[Dict[str, np.ndarray]] = []
+                lineno = 0
+                seq = 0
+                with open(source) as fh:
+                    for raw in fh:
+                        if err.stop.is_set():
+                            return
+                        lineno += 1
+                        if lineno <= skip:
+                            continue
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        try:
+                            row = data_feed.parse_line(
+                                line, lineno=lineno, source=source)
+                        except DataFeedParseError:
+                            if on_bad_line == "skip":
+                                continue
+                            raise
+                        batch.append(row)
+                        if len(batch) == data_feed.batch_size:
+                            _put(q, _Batch(_collate(batch), source,
+                                           seq, lineno, len(batch)))
+                            seq += 1
+                            batch = []
+                if batch:
+                    _put(q, _Batch(_collate(batch), source, seq,
+                                   lineno, len(batch)))
+            except BaseException as e:
+                err.trip(e)
+            finally:
+                _put(q, None)
+
+        def _put(q, item):
+            """Bounded put that keeps observing the stop event, so a
+            failed consumer can't strand a blocked producer forever."""
+            while not err.stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
 
         def _collate(batch):
             return {k: np.stack([b[k] for b in batch])
                     for k in batch[0]}
 
-        def step(feed):
-            # Executor.run mutates program state (params); serialize the
-            # state transition — XLA compute inside still overlaps with
-            # other threads' parsing (GIL released during execution).
-            with update_lock:
-                outs = self.executor.run(program, feed=feed,
-                                         fetch_list=fetch)
-                for n, v in zip(fetch, outs):
-                    totals[n] += float(np.mean(v))
-                    counts[n] += 1
+        def default_step(feed):
+            outs = self.executor.run(program, feed=feed,
+                                     fetch_list=fetch)
+            return dict(zip(fetch, outs))
 
-        def worker():
+        body = step_fn or default_step
+        live = {s: True for s in sources}
+        live_lock = threading.Lock()
+
+        def consume(wid: int):
+            """Round-robin over the live source queues: step each
+            batch, fold metrics, commit the source offset."""
+            my = sources[wid % len(sources):] + \
+                sources[:wid % len(sources)]   # stagger start points
             try:
-                while True:
-                    try:
-                        fname = file_q.get_nowait()
-                    except queue.Empty:
+                while not err.stop.is_set():
+                    with live_lock:
+                        alive = [s for s in my if live[s]]
+                    if not alive:
                         return
-                    for feed in batches_from(fname):
-                        step(feed)
-                    if debug:
-                        print(f"[async_executor] done {fname}")
-            except BaseException as e:   # propagate like exception_holder.h
-                errors.append(e)
+                    for s in alive:
+                        try:
+                            item = queues[s].get(timeout=0.02)
+                        except queue.Empty:
+                            continue
+                        gauges[s].set(queues[s].qsize())
+                        if item is None:
+                            with live_lock:
+                                live[s] = False
+                            continue
+                        # serialize the state transition (XLA compute
+                        # inside still overlaps other threads' parsing:
+                        # the GIL drops during execution)
+                        with update_lock:
+                            outs = body(item.feed)
+                            for n, v in outs.items():
+                                totals[n] += float(np.mean(v))
+                                counts[n] += 1
+                            ckpt.commit(item.source, item.seq,
+                                        item.end_line)
+                        if debug:
+                            print(f"[async_executor] w{wid} stepped "
+                                  f"{item.source}:{item.end_line}")
+            except BaseException as e:   # first failure wins
+                err.trip(e)
 
-        # no separate warm-up pass: step() serializes under update_lock,
-        # so the first worker to arrive compiles while the rest parse —
-        # and every batch is consumed exactly once per run() (one epoch)
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(thread_num)]
-        for t in threads:
+        producers = [threading.Thread(target=produce, args=(s,),
+                                      daemon=True,
+                                      name=f"feed-{os.path.basename(s)}")
+                     for s in sources]
+        consumers = [threading.Thread(target=consume, args=(i,),
+                                      daemon=True,
+                                      name=f"async-step-{i}")
+                     for i in range(thread_num)]
+        for t in producers + consumers:
             t.start()
-        for t in threads:
+        for t in consumers:
             t.join()
-        if errors:
-            raise errors[0]
+        # consumers are done (drained or tripped); producers unwind on
+        # the same stop event or have already sent their sentinel
+        err.stop.set()
+        for t in producers:
+            t.join(timeout=5.0)
+        err.raise_if_set()
         if fetch and all(c == 0 for c in counts.values()):
-            raise EnforceNotMet("AsyncExecutor: filelist has no samples")
+            resumed = any(ckpt.resume_offset(s) > 0 for s in sources)
+            if not resumed:
+                raise EnforceNotMet(
+                    "AsyncExecutor: filelist has no samples")
         return {n: totals[n] / max(counts[n], 1) for n in fetch}

@@ -90,6 +90,27 @@ def embedding(input: Variable, size, is_sparse=False, padding_idx=None,
     return out
 
 
+def sparse_embedding(input: Variable, size, hash_bucket=True,
+                     param_attr=None, dtype="float32",
+                     name=None) -> Variable:
+    """Sparse-plane table lookup (paddle_tpu/sparse; ref
+    lookup_sparse_table_op.cc): like :func:`embedding` but raw ids of
+    ANY magnitude fold into the ``size[0]`` buckets with the sparse
+    plane's avalanche hash (``hash_bucket=True``, the CTR default) —
+    the table is sized by budget, not by the id space.  The gradient is
+    inherently SelectedRows-shaped: XLA scatter-adds into only the
+    looked-up rows."""
+    helper = LayerHelper("sparse_embedding", name=name)
+    w = helper.create_parameter(
+        param_attr, shape=list(size), dtype=dtype,
+        default_initializer=NormalInitializer(0.0, 1.0 / np.sqrt(size[1])))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("sparse_embedding_lookup",
+                     {"W": [w], "Ids": [input]}, {"Out": [out]},
+                     {"hash_bucket": bool(hash_bucket)})
+    return out
+
+
 def conv2d(input: Variable, num_filters: int, filter_size, stride=1,
            padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None,
            act=None, name=None) -> Variable:

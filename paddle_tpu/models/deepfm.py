@@ -28,26 +28,35 @@ class DeepFMConfig:
         self.sparse_shard_axis = sparse_shard_axis
 
 
-def deepfm(feat_ids, feat_vals, cfg: DeepFMConfig):
+def deepfm(feat_ids, feat_vals, cfg: DeepFMConfig, embed=None):
     """feat_ids [B,F] int64, feat_vals [B,F] float32 -> p(click) [B,1].
 
     FM first-order + second-order + deep MLP (DeepFM, Guo et al. 2017);
-    same capability class as the reference CTR example but one dense graph.
+    same capability class as the reference CTR example but one dense
+    graph.  ``embed(input, size, name)`` builds the table lookups —
+    default is :func:`layers.embedding` with the config's sharding;
+    the sparse plane swaps in the hash-bucketed
+    :func:`layers.sparse_embedding` (see build_sparse_train_net), so
+    ONE forward serves both table disciplines.
     """
-    shard = ((cfg.sparse_shard_axis, None)
-             if cfg.sparse_shard_axis else None)
+    if embed is None:
+        shard = ((cfg.sparse_shard_axis, None)
+                 if cfg.sparse_shard_axis else None)
+
+        def embed(input, size, name):
+            return layers.embedding(
+                input, size=size,
+                param_attr=ParamAttr(name=name, sharding=shard))
+
     # first-order weights: [V,1] table
-    w1 = layers.embedding(
-        feat_ids, size=[cfg.vocab_size, 1],
-        param_attr=ParamAttr(name="fm_w1", sharding=shard))      # [B,F,1]
+    w1 = embed(feat_ids, [cfg.vocab_size, 1], "fm_w1")           # [B,F,1]
     first_order = layers.reduce_sum(
         layers.elementwise_mul(layers.squeeze(w1, [2]), feat_vals),
         dim=[1], keep_dim=True)                                   # [B,1]
 
     # second-order: embeddings [V,K]
-    emb = layers.embedding(
-        feat_ids, size=[cfg.vocab_size, cfg.embed_dim],
-        param_attr=ParamAttr(name="fm_emb", sharding=shard))      # [B,F,K]
+    emb = embed(feat_ids, [cfg.vocab_size, cfg.embed_dim],
+                "fm_emb")                                         # [B,F,K]
     vals = layers.unsqueeze(feat_vals, [2])                       # [B,F,1]
     xv = layers.elementwise_mul(emb, vals)                        # [B,F,K]
     sum_sq = layers.square(layers.reduce_sum(xv, dim=[1]))        # [B,K]
@@ -67,11 +76,11 @@ def deepfm(feat_ids, feat_vals, cfg: DeepFMConfig):
     return logit
 
 
-def build_train_net(cfg: DeepFMConfig):
+def build_train_net(cfg: DeepFMConfig, embed=None):
     feat_ids = layers.data("feat_ids", [cfg.num_field], dtype="int64")
     feat_vals = layers.data("feat_vals", [cfg.num_field], dtype="float32")
     label = layers.data("label", [1], dtype="float32")
-    logit = deepfm(feat_ids, feat_vals, cfg)
+    logit = deepfm(feat_ids, feat_vals, cfg, embed=embed)
     cost = layers.sigmoid_cross_entropy_with_logits(logit, label)
     avg_cost = layers.mean(cost)
     prob = layers.sigmoid(logit)
@@ -86,3 +95,92 @@ def make_fake_batch(cfg: DeepFMConfig, batch_size: int, seed: int = 0):
         "feat_vals": rng.rand(batch_size, cfg.num_field).astype("float32"),
         "label": rng.randint(0, 2, (batch_size, 1)).astype("float32"),
     }
+
+
+def build_sparse_train_net(cfg: DeepFMConfig):
+    """DeepFM over the sparse plane's Program-level ops: the SAME
+    :func:`deepfm` forward with the embedding lookups swapped for
+    ``sparse_embedding_lookup`` (hash bucketing on, so raw ids of any
+    magnitude fold into the ``vocab_size`` buckets — the CTR
+    id-folding discipline; the host-plane twin is
+    paddle_tpu/sparse/table.hash_bucket).  Registered as the 19th
+    model of the ``python -m paddle_tpu.analysis.lint`` gate."""
+    def embed(input, size, name):
+        return layers.sparse_embedding(
+            input, size=size, param_attr=ParamAttr(name=name))
+
+    return build_train_net(cfg, embed=embed)
+
+
+# -- criteo-shaped synthetic dataset (MultiSlot text, sparse plane) --------
+#
+# The streaming pipeline's ground-truth dataset: per line, one id slot
+# per categorical field, one dense value slot of width num_field, one
+# label slot — the shape of a criteo-style CTR shard fed to the
+# reference's MultiSlotDataFeed.  Labels are DRAWN from a seeded
+# logistic model over hidden per-id weights, so the task is learnable
+# (AUC well above 0.5) and two runs over the same files chase the same
+# optimum — the async-vs-sync parity tests depend on that.
+
+def criteo_slots(num_field: int):
+    from ..framework.async_executor import Slot
+    return ([Slot(f"C{f}", "uint64", dim=1) for f in range(num_field)]
+            + [Slot("feat_vals", "float", is_dense=True,
+                    dim=num_field),
+               Slot("label", "float", is_dense=True, dim=1)])
+
+
+def criteo_feed_desc(num_field: int, batch_size: int = 32):
+    from ..framework.async_executor import DataFeedDesc
+    return DataFeedDesc(criteo_slots(num_field),
+                        batch_size=batch_size, name="criteo")
+
+
+def make_criteo_files(dirpath, n_files: int, lines_per_file: int,
+                      num_field: int = 8, vocab_size: int = 128,
+                      seed: int = 0):
+    """Write ``n_files`` MultiSlot shards under ``dirpath``; returns
+    the sorted path list.  Line format (criteo_slots order)::
+
+        1 <id_0> ... 1 <id_{F-1}> <F> <v_0> ... <v_{F-1}> 1 <label>
+    """
+    import os
+    rng = np.random.RandomState(seed)
+    w_true = np.random.RandomState(seed + 7919).randn(
+        vocab_size).astype("float64") * 2.0
+    paths = []
+    for fi in range(n_files):
+        path = os.path.join(dirpath, f"criteo-part-{fi:05d}")
+        with open(path, "w") as f:
+            for _ in range(lines_per_file):
+                ids = rng.randint(0, vocab_size, num_field)
+                vals = rng.rand(num_field)
+                logit = float((w_true[ids] * vals).sum())
+                label = int(rng.rand() < 1.0 / (1.0 + np.exp(-logit)))
+                f.write(" ".join(f"1 {i}" for i in ids)
+                        + f" {num_field} "
+                        + " ".join(f"{v:.4f}" for v in vals)
+                        + f" 1 {label}\n")
+        paths.append(path)
+    return paths
+
+
+def load_criteo_files(files, num_field: int):
+    """Parse shards back into dense arrays (ids [N,F] int64, vals
+    [N,F] f32, label [N,1] f32) — the reference-run/eval side of the
+    parity tests; the streaming path never calls this."""
+    desc = criteo_feed_desc(num_field)
+    ids, vals, labels = [], [], []
+    for path in files:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                row = desc.parse_line(line, lineno=lineno, source=path)
+                ids.append([int(row[f"C{i}"][0])
+                            for i in range(num_field)])
+                vals.append(row["feat_vals"])
+                labels.append(row["label"])
+    return (np.asarray(ids, "int64"), np.asarray(vals, "float32"),
+            np.asarray(labels, "float32").reshape(-1, 1))

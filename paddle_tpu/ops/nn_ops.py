@@ -406,6 +406,59 @@ def _lookup_table_v2(ctx, ins, attrs):
     return _lookup_table(ctx, ins, attrs)
 
 
+def _hash_mix_u32(ids_u32):
+    """xor-shift/multiply avalanche — MUST stay bit-identical to
+    sparse/table.py hash_bucket (host plane) so an id buckets to the
+    same row whether folded in the reader or in the graph."""
+    c = jnp.uint32(0x45D9F3B)
+    x = ids_u32
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * c
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * c
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+@register_op("sparse_embedding_lookup")
+def _sparse_embedding_lookup(ctx, ins, attrs):
+    """Sparse-plane table lookup (paddle_tpu/sparse; ref
+    lookup_sparse_table_op.cc + the CTR pipelines' id folding).  Like
+    lookup_table, plus ``hash_bucket``: raw ids of ANY magnitude fold
+    into [0, vocab) with the sparse plane's avalanche hash, so the
+    table never needs the raw id space's extent.  Differentiable: jax
+    AD turns the gather's cotangent into a scatter-add over the
+    looked-up rows only (duplicate ids accumulate — the SelectedRows
+    merge contract)."""
+    w = single_input(ins, "W")
+    ids = single_input(ins, "Ids")
+    squeeze = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze:
+        ids = ids.squeeze(-1)
+    if bool(attrs.get("hash_bucket", False)):
+        mixed = _hash_mix_u32(ids.astype(jnp.uint32))
+        idsi = (mixed % jnp.uint32(w.shape[0])).astype(jnp.int32)
+    else:
+        idsi = ids.astype(jnp.int32)
+    return {"Out": [jnp.take(w, idsi, axis=0)]}
+
+
+@register_op("sparse_scatter_update")
+def _sparse_scatter_update(ctx, ins, attrs):
+    """SelectedRows-style sparse SGD application: Out = W with
+    ``W[Ids] -= lr * Grad`` scatter-ADDED per occurrence (duplicate ids
+    accumulate, the scatter-add-vs-overwrite bug class the sparse
+    plane's tests pin).  Ids [N] int, Grad [N, dim]; rows not named in
+    Ids pass through untouched — the dense [vocab, dim] gradient never
+    exists."""
+    w = single_input(ins, "W")
+    ids = single_input(ins, "Ids").reshape(-1).astype(jnp.int32)
+    grad = single_input(ins, "Grad")
+    grad = grad.reshape(ids.shape[0], w.shape[1])
+    lr = float(attrs.get("learning_rate", 1.0))
+    return {"Out": [w.at[ids].add(-lr * grad.astype(w.dtype))]}
+
+
 @register_op("interpolate")
 def _interpolate(ctx, ins, attrs):
     """bilinear/nearest resize, NCHW (ref interpolate_op.cc)."""

@@ -1,0 +1,38 @@
+"""Sparse plane: streaming CTR training with sharded embeddings.
+
+The fifth plane of the stack (after observability, resilience,
+low-precision perf, serving, and static analysis): the reference's
+signature industrial workload — AsyncExecutor CTR trainers feeding
+hash-bucketed sparse embedding pservers (PAPER.md §1, layers L4/L5) —
+as one production-shaped story:
+
+  * :mod:`selected_rows` — the {rows, values} sparse-gradient carrier
+    (ref framework/selected_rows.h); duplicate ids merge by ADDITION.
+  * :mod:`table` — hash-bucketed host tables with row-wise adagrad
+    state and optional int8 row storage (PR 6 quantize convention).
+  * :mod:`service` — the parameter-shard service: pull_rows/push_grads
+    verbs on the task-queue JSON-lines transport with a push ledger
+    (exactly-once under at-least-once delivery) and bounded-staleness
+    accounting.
+  * :class:`SparseShardClient` (distributed/async_update.py) — the
+    worker-side client: every RPC rides TaskMasterClient._call
+    (resilience/retry.py backoff, traceparent propagation) plus the
+    sparse.pull / sparse.push chaos fault points.
+  * :mod:`worker` — the streaming CTR worker CLI: lease file shards
+    from the task master, stream criteo-shaped MultiSlot batches,
+    gather-compute-scatter against the shard service.  Dense
+    gradients never materialize.
+
+The DEVICE twin (in-HBM tables inside one shard_map) stays in
+parallel/sharded_embedding.py; docs/SPARSE.md maps both to the
+reference stack.
+"""
+from ..distributed.async_update import SparseShardClient, StalePushError
+from .selected_rows import SelectedRows
+from .service import SparseShardService
+from .table import (EmbeddingShard, TableConfig, hash_bucket,
+                    partition_rows)
+
+__all__ = ["SelectedRows", "SparseShardService", "SparseShardClient",
+           "StalePushError", "EmbeddingShard", "TableConfig",
+           "hash_bucket", "partition_rows"]

@@ -1,0 +1,219 @@
+"""Parameter-shard service: the sparse plane's pserver, speaking the
+task-queue JSON-lines transport.
+
+Capability parity with the reference's sparse pserver
+(/root/reference/paddle/fluid/operators/distributed_ops/
+listen_and_serv_op.cc async loop + go/pserver/service.go): trainers
+pull the rows a microbatch needs, push SelectedRows gradients, and the
+shard applies them as they arrive — no barrier.  Three disciplines from
+the PR 5 lease/ledger era carry over:
+
+* **transport** — the verbs ride the SAME JSON-lines TCP server as the
+  task master (``serve_master(master, sparse=service)``), so every
+  reply carries the master generation, every request carries the
+  caller's X-ray traceparent, and the client inherits
+  ``TaskMasterClient``'s retry/re-dial loop for free;
+* **push ledger** — pushes are at-least-once (the client retries on a
+  lost reply): each push names a ``push_id`` and accepted ids land in a
+  bounded ledger, so a duplicate delivery re-acks ``ok`` with the
+  original row count instead of double-applying the gradient — the
+  task-queue completion-ledger discipline applied to gradients;
+* **bounded staleness** — each pull returns the table ``version``;
+  each push presents the version it pulled.  A push whose staleness
+  (current - pulled) exceeds the ``sparse_staleness_bound`` flag is
+  rejected with status ``"stale"`` (the worker re-pulls and
+  recomputes) — the async pserver loop with a fence against unbounded
+  drift, published as the ``sparse_staleness_steps`` histogram.
+
+Metrics: ``sparse_rows_pulled_total{table}``,
+``sparse_rows_pushed_total{table}``, ``sparse_staleness_steps``,
+``sparse_push_rejected_total{reason}``, ``sparse_table_version{table}``.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import flags
+from ..observability import flight as obs_flight
+from ..observability import metrics as obs_metrics
+from .selected_rows import SelectedRows
+from .table import EmbeddingShard, TableConfig
+
+__all__ = ["SparseShardService"]
+
+_m_rows_pulled = obs_metrics.counter(
+    "sparse_rows_pulled_total",
+    "Embedding rows served to workers by pull_rows, by table.",
+    ("table",))
+_m_rows_pushed = obs_metrics.counter(
+    "sparse_rows_pushed_total",
+    "Distinct embedding rows scatter-applied from push_grads "
+    "SelectedRows gradients, by table (duplicate ids within a push "
+    "merge first; rejected/duplicate pushes don't count).",
+    ("table",))
+_m_staleness = obs_metrics.histogram(
+    "sparse_staleness_steps",
+    "Staleness of each accepted async push in applied-push steps "
+    "(table version at apply minus version at pull); 0 = fully "
+    "synchronous behaviour.",
+    buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
+_m_rejected = obs_metrics.counter(
+    "sparse_push_rejected_total",
+    "push_grads RPCs rejected by the shard, by reason (stale = over "
+    "the sparse_staleness_bound window; the worker re-pulls).",
+    ("reason",))
+_m_version = obs_metrics.gauge(
+    "sparse_table_version",
+    "Applied-push version of each sparse table on this shard.",
+    ("table",))
+
+
+class SparseShardService:
+    """One shard process's tables + the RPC verb handlers.
+
+    Attach to a master's transport with
+    ``serve_master(master, sparse=service)``; the handler routes
+    ``sparse_init`` / ``pull_rows`` / ``push_grads`` / ``sparse_state``
+    / ``sparse_stats`` here.  Thread-safe: the transport is
+    thread-per-connection."""
+
+    def __init__(self, shard_id: int = 0, num_shards: int = 1,
+                 staleness_bound: Optional[int] = None,
+                 ledger_size: Optional[int] = None):
+        self.shard_id = int(shard_id)
+        self.num_shards = int(num_shards)
+        self._staleness_bound = staleness_bound
+        self._ledger_size = int(
+            ledger_size if ledger_size is not None
+            else flags.get_flag("sparse_push_ledger_size"))
+        self._lock = threading.Lock()
+        self.tables: Dict[str, EmbeddingShard] = {}
+        # push_id -> rows_applied: the exactly-once record (bounded,
+        # oldest-first eviction)
+        self._push_ledger: "OrderedDict[str, int]" = OrderedDict()
+        self.stale_rejections = 0
+
+    @property
+    def staleness_bound(self) -> int:
+        if self._staleness_bound is not None:
+            return int(self._staleness_bound)
+        return int(flags.get_flag("sparse_staleness_bound"))
+
+    # -- table lifecycle ---------------------------------------------------
+    def init_tables(self, specs: List[TableConfig]) -> dict:
+        """Create tables (idempotent: an existing table with the same
+        spec re-acks; a conflicting spec is an error — two workers
+        racing sparse_init must agree)."""
+        with self._lock:
+            for cfg in specs:
+                cur = self.tables.get(cfg.name)
+                if cur is not None:
+                    if cur.cfg.to_wire() != cfg.to_wire():
+                        raise ValueError(
+                            f"sparse_init: table {cfg.name!r} already "
+                            f"exists with a different spec")
+                    continue
+                self.tables[cfg.name] = EmbeddingShard(
+                    cfg, self.shard_id, self.num_shards)
+                _m_version.labels(table=cfg.name).set(0)
+            return {"tables": sorted(self.tables)}
+
+    def _table(self, name: str) -> EmbeddingShard:
+        t = self.tables.get(name)
+        if t is None:
+            raise KeyError(f"unknown sparse table {name!r} (did "
+                           f"sparse_init run?)")
+        return t
+
+    # -- verbs -------------------------------------------------------------
+    def pull_rows(self, table: str, rows: List[int]) -> dict:
+        with self._lock:
+            t = self._table(table)
+            values = t.pull(np.asarray(rows, np.int64))
+            _m_rows_pulled.labels(table=table).inc(len(rows))
+            return {"values": values.tolist(), "version": t.version}
+
+    def push_grads(self, table: str, grad: SelectedRows,
+                   pull_version: int, push_id: str) -> dict:
+        """Apply one SelectedRows gradient.  Status:
+        ``ok`` (applied, or duplicate re-ack with the recorded count) |
+        ``stale`` (over the staleness window; nothing applied)."""
+        with self._lock:
+            t = self._table(table)
+            if push_id in self._push_ledger:
+                # at-least-once delivery: the first copy applied and
+                # its reply was lost — re-ack, never re-apply
+                return {"status": "ok", "duplicate": True,
+                        "rows_applied": self._push_ledger[push_id],
+                        "version": t.version}
+            staleness = t.version - int(pull_version)
+            if staleness > self.staleness_bound:
+                self.stale_rejections += 1
+                _m_rejected.labels(reason="stale").inc()
+                obs_flight.record("sparse", "push_stale", table=table,
+                                  staleness=staleness,
+                                  bound=self.staleness_bound)
+                return {"status": "stale", "staleness": staleness,
+                        "version": t.version, "rows_applied": 0}
+            n = t.apply(grad)
+            _m_rows_pushed.labels(table=table).inc(n)
+            _m_staleness.observe(max(0, staleness))
+            _m_version.labels(table=table).set(t.version)
+            self._push_ledger[push_id] = n
+            while len(self._push_ledger) > self._ledger_size:
+                self._push_ledger.popitem(last=False)
+            return {"status": "ok", "rows_applied": n,
+                    "staleness": staleness, "version": t.version}
+
+    def state(self, table: str) -> dict:
+        """Full local shard (eval/checkpoint path, NOT the training hot
+        path — workers pull rows, never tables)."""
+        with self._lock:
+            t = self._table(table)
+            return {"values": t.dense().tolist(), "version": t.version,
+                    "shard_id": t.shard_id, "num_shards": t.num_shards,
+                    "rows": t.cfg.rows, "dim": t.cfg.dim}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "shard_id": self.shard_id,
+                "num_shards": self.num_shards,
+                "staleness_bound": self.staleness_bound,
+                "stale_rejections": self.stale_rejections,
+                "ledger": len(self._push_ledger),
+                "tables": {
+                    name: {"version": t.version,
+                           "local_rows": t.local_rows,
+                           "rows_pulled": t.rows_pulled,
+                           "rows_pushed": t.rows_pushed,
+                           "int8": bool(t.cfg.int8_rows),
+                           "bytes": t.state_bytes()}
+                    for name, t in sorted(self.tables.items())}}
+
+    # -- transport adapter (called by task_queue._Handler) -----------------
+    VERBS = ("sparse_init", "pull_rows", "push_grads", "sparse_state",
+             "sparse_stats")
+
+    def handle(self, method: str, req: dict) -> dict:
+        if method == "sparse_init":
+            out = self.init_tables([TableConfig.from_wire(d)
+                                    for d in req["tables"]])
+            return {"ok": True, **out}
+        if method == "pull_rows":
+            return {"ok": True,
+                    **self.pull_rows(req["table"], req["rows"])}
+        if method == "push_grads":
+            out = self.push_grads(
+                req["table"], SelectedRows.from_wire(req["grad"]),
+                req.get("pull_version", 0), req["push_id"])
+            return {"ok": out["status"] == "ok", **out}
+        if method == "sparse_state":
+            return {"ok": True, **self.state(req["table"])}
+        if method == "sparse_stats":
+            return {"ok": True, "stats": self.stats()}
+        return {"ok": False, "error": f"bad sparse method {method}"}

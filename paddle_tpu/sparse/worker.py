@@ -1,0 +1,468 @@
+"""Streaming CTR worker — the sparse plane's supervised trainer body.
+
+Run::
+
+    python -m paddle_tpu.sparse.worker <endpoints> <rank> <out.json>
+
+``endpoints`` is the task master's ``host:port[,host:port]`` failover
+list; the parameter-shard service rides the same transport
+(``serve_master(master, sparse=service)``) unless
+``PTPU_SPARSE_SHARDS`` names separate per-shard endpoints
+(';'-separated, shard-id order).  The job config is the
+``PTPU_SPARSE_CFG`` env var (JSON, see :class:`CTRJobConfig`).
+
+The worker is the whole ISSUE-13 story in one loop:
+
+* registers + heartbeats under its rank (PR 5 membership — a
+  supervisor-respawned incarnation rejoins under the same rank);
+* leases criteo-shaped file shards from the task master and streams
+  them through :class:`AsyncExecutor`'s multi-queue loop with a
+  ``step_fn`` body — parsing overlaps compute, malformed lines raise
+  named errors, the first failure stops the pool;
+* per microbatch: **gather** (pull_rows for the batch's UNIQUE ids +
+  the dense towers), **compute** (one jitted DeepFM grad step over the
+  pulled rows — fixed shapes via id/sample padding, so the executable
+  compiles once), **scatter** (push_grads SelectedRows; the shard
+  applies adagrad/sgd row-wise).  A dense [vocab, dim] gradient never
+  exists on either side, and every push's ``rows_applied`` is checked
+  against the batch's unique live ids;
+* passes the ``trainer.step`` chaos fault point per microbatch (where
+  a ``PTPU_CHAOS_SPEC=trainer.step=exit:...`` schedule hard-kills it)
+  and the sparse.pull/sparse.push fault points inside the RPC retry
+  loops;
+* a ``stale`` push (bounded-staleness window exceeded) re-pulls the
+  table's rows to refresh the version window and re-pushes — counted,
+  never silently dropped.
+
+Exactly-once accounting: task completions are fenced-lease +
+master-ledger exactly-once (a re-leased task's zombie ack fences);
+gradient pushes are exactly-once per push_id under transport retries
+(shard push ledger) and at-least-once across task RE-executions — a
+worker killed mid-file re-runs that file's pushes under the new lease,
+which plain async SGD absorbs (the parity test's tolerance covers it).
+
+Exit code 0 = this rank saw the job through to ``complete``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["CTRJobConfig", "table_specs", "init_host_params",
+           "make_grad_fn", "CTRStepper", "evaluate_ctr",
+           "reference_train", "auc_score"]
+
+
+@dataclass
+class CTRJobConfig:
+    """Shared by every worker AND the reference/eval side — one JSON
+    blob (PTPU_SPARSE_CFG) keeps the fleet and the single-process
+    ground truth on identical shapes, seeds and learning rate."""
+
+    num_field: int = 4
+    vocab_size: int = 64
+    embed_dim: int = 4
+    fc_sizes: Tuple[int, ...] = (16,)
+    learning_rate: float = 0.1
+    batch_size: int = 16
+    seed: int = 0
+    table_optimizer: str = "sgd"    # "sgd" for reference parity
+    int8_rows: bool = False
+
+    def to_wire(self) -> dict:
+        return {"num_field": self.num_field,
+                "vocab_size": self.vocab_size,
+                "embed_dim": self.embed_dim,
+                "fc_sizes": list(self.fc_sizes),
+                "learning_rate": self.learning_rate,
+                "batch_size": self.batch_size, "seed": self.seed,
+                "table_optimizer": self.table_optimizer,
+                "int8_rows": self.int8_rows}
+
+    @staticmethod
+    def from_wire(doc: dict) -> "CTRJobConfig":
+        doc = dict(doc)
+        doc["fc_sizes"] = tuple(doc.get("fc_sizes", (16,)))
+        return CTRJobConfig(**doc)
+
+
+def _dense_names(cfg: CTRJobConfig) -> List[Tuple[str, int, int]]:
+    """[(name, rows, dim)] of the dense tower, in init order."""
+    sizes = [cfg.num_field * cfg.embed_dim] + list(cfg.fc_sizes) + [1]
+    out = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        out.append((f"fc{i}_w", a, b))
+        out.append((f"fc{i}_b", 1, b))
+    return out
+
+
+def table_specs(cfg: CTRJobConfig):
+    """Every parameter as a shard-service table: the two big sparse
+    tables plus the dense tower as tiny full-pull tables.  Seeds are
+    derived per table so init_host_params reproduces them exactly."""
+    from .table import TableConfig
+    lr, opt = cfg.learning_rate, cfg.table_optimizer
+    specs = [
+        TableConfig("w1", cfg.vocab_size, 1, seed=cfg.seed,
+                    init_std=0.0, learning_rate=lr, optimizer=opt,
+                    int8_rows=cfg.int8_rows),
+        TableConfig("emb", cfg.vocab_size, cfg.embed_dim,
+                    seed=cfg.seed + 1, init_std=0.01,
+                    learning_rate=lr, optimizer=opt,
+                    int8_rows=cfg.int8_rows),
+    ]
+    for j, (name, rows, dim) in enumerate(_dense_names(cfg)):
+        std = 0.0 if name.endswith("_b") else 1.0 / np.sqrt(rows)
+        specs.append(TableConfig(name, rows, dim,
+                                 seed=cfg.seed + 10 + j, init_std=std,
+                                 learning_rate=lr, optimizer=opt))
+    return specs
+
+
+def init_host_params(cfg: CTRJobConfig) -> Dict[str, np.ndarray]:
+    """The single-process reference's params — bit-identical to what
+    the shard service initializes from the same specs."""
+    from .table import EmbeddingShard
+    out = {}
+    for spec in table_specs(cfg):
+        spec = type(spec)(**{**spec.to_wire(), "int8_rows": False})
+        shard = EmbeddingShard(spec)
+        arr = shard.dense()
+        out[spec.name] = arr[0] if spec.name.endswith("_b") else arr
+    return out
+
+
+def _sharded_cfg(cfg: CTRJobConfig):
+    from ..parallel.sharded_embedding import ShardedCTRConfig
+    return ShardedCTRConfig(
+        vocab_size=cfg.vocab_size, num_field=cfg.num_field,
+        embed_dim=cfg.embed_dim, fc_sizes=tuple(cfg.fc_sizes),
+        learning_rate=cfg.learning_rate)
+
+
+def make_grad_fn(cfg: CTRJobConfig):
+    """One jitted gather-side step: (padded unique rows, dense tower,
+    inverse indices, vals, label, sample weights) -> (loss, row grads,
+    dense grads).  Shapes are FIXED (ids padded to batch*num_field
+    unique slots, samples padded to batch_size with weight 0), so the
+    whole stream runs on a single executable."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.sharded_embedding import _ctr_forward
+    scfg = _sharded_cfg(cfg)
+
+    @jax.jit
+    def f(w1_u, emb_u, dense, inv, vals, label, wgt):
+        def loss_fn(w1_u, emb_u, dense):
+            w1_rows = jnp.take(w1_u, inv, axis=0)      # [B, F, 1]
+            emb_rows = jnp.take(emb_u, inv, axis=0)    # [B, F, K]
+            logit = _ctr_forward(dense, w1_rows, emb_rows, vals, scfg)
+            z = jnp.clip(logit, -30, 30)
+            xent = jnp.maximum(z, 0) - z * label + jnp.log1p(
+                jnp.exp(-jnp.abs(z)))
+            return jnp.sum(xent * wgt) / jnp.maximum(jnp.sum(wgt), 1.0)
+
+        loss, (g_w1, g_emb, g_dense) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1, 2))(w1_u, emb_u, dense)
+        return loss, g_w1, g_emb, g_dense
+    return f
+
+
+class CTRStepper:
+    """The pull -> compute -> push body, shaped as an AsyncExecutor
+    ``step_fn``.  One instance per worker process/thread (the sparse
+    client is not thread-safe)."""
+
+    def __init__(self, cfg: CTRJobConfig, client,
+                 push_tag: str = "local"):
+        self.cfg = cfg
+        self.client = client
+        self.push_tag = push_tag        # unique per lease/incarnation
+        self.grad_fn = make_grad_fn(cfg)
+        self.dense_shapes = _dense_names(cfg)
+        self.steps = 0
+        self.rows_applied = 0
+        self.row_count_mismatches = 0
+        self.stale_retries = 0
+        self.max_staleness = 0
+
+    def _pull_dense(self):
+        dense, versions = {}, {}
+        for name, rows, dim in self.dense_shapes:
+            vals, vers = self.client.pull_rows(name, np.arange(rows))
+            dense[name] = vals[0] if name.endswith("_b") else vals
+            versions[name] = vers
+        return dense, versions
+
+    def _push(self, table, grad_sr, versions, push_id):
+        """Push with bounded-staleness refresh: a 'stale' verdict
+        re-pulls one row PER STALE SHARD (to learn each owner's
+        current version) and re-pushes under the refreshed window."""
+        from ..distributed.async_update import StalePushError
+        from ..observability import flight as obs_flight
+        versions = dict(versions)
+        for attempt in range(16):
+            out = self.client.push_grads(table, grad_sr, versions,
+                                         push_id)
+            self.max_staleness = max(self.max_staleness,
+                                     out["staleness"])
+            if not out["stale"]:
+                return out
+            self.stale_retries += 1
+            obs_flight.record("sparse", "push_retry_stale",
+                              table=table, attempt=attempt,
+                              shards=out["stale"])
+            # refresh the window for EXACTLY the stale shards: pull a
+            # row each owns, and MERGE the fresh versions — replacing
+            # the dict would zero the other shards' versions and make
+            # every re-push maximally stale
+            rows = grad_sr.merged().rows
+            S = self.client.num_shards
+            refresh = [int(rows[rows % S == s][0])
+                       for s in out["stale"]
+                       if (rows % S == s).any()]
+            _, fresh = self.client.pull_rows(table,
+                                             refresh or rows[:1])
+            versions.update(fresh)
+        raise StalePushError(
+            f"sparse push to {table!r} stayed stale after refresh "
+            f"retries — staleness bound too tight for this fleet")
+
+    def __call__(self, feed: Dict[str, np.ndarray]) -> dict:
+        from ..resilience import chaos
+        from .selected_rows import SelectedRows
+        cfg = self.cfg
+        # the hard-death fault point: an armed exit schedule kills the
+        # process HERE, mid-stream, lease held — the master requeues
+        # the task, the supervisor respawns the rank
+        chaos.trigger("trainer.step")
+        ids = np.concatenate([feed[f"C{i}"]
+                              for i in range(cfg.num_field)],
+                             axis=1).astype("int64")        # [b, F]
+        vals = feed["feat_vals"].astype("float32")
+        label = feed["label"].astype("float32")
+        b = ids.shape[0]
+        B, F = cfg.batch_size, cfg.num_field
+        if b < B:                       # pad the tail batch: one shape
+            pad = B - b
+            ids = np.pad(ids, ((0, pad), (0, 0)))
+            vals = np.pad(vals, ((0, pad), (0, 0)))
+            label = np.pad(label, ((0, pad), (0, 0)))
+        wgt = np.zeros((B, 1), "float32")
+        wgt[:b] = 1.0
+
+        uniq, inv = np.unique(ids, return_inverse=True)
+        n_unique = int(uniq.size)
+        U = B * F                       # fixed unique-slot budget
+        uniq_pad = np.zeros(U, "int64")
+        uniq_pad[:n_unique] = uniq
+        inv = inv.reshape(B, F).astype("int32")
+
+        w1_u, v_w1 = self.client.pull_rows("w1", uniq_pad[:n_unique])
+        emb_u, v_emb = self.client.pull_rows("emb",
+                                             uniq_pad[:n_unique])
+        w1_full = np.zeros((U, 1), "float32")
+        w1_full[:n_unique] = w1_u
+        emb_full = np.zeros((U, cfg.embed_dim), "float32")
+        emb_full[:n_unique] = emb_u
+        dense, v_dense = self._pull_dense()
+
+        loss, g_w1, g_emb, g_dense = self.grad_fn(
+            w1_full, emb_full, dense, inv, vals, label, wgt)
+        loss = float(loss)
+
+        tag = f"{self.push_tag}:{self.steps}"
+        applied = 0
+        applied += self._push(
+            "w1", SelectedRows(uniq_pad[:n_unique],
+                               np.asarray(g_w1)[:n_unique],
+                               cfg.vocab_size),
+            v_w1, f"{tag}:w1")["rows_applied"]
+        applied += self._push(
+            "emb", SelectedRows(uniq_pad[:n_unique],
+                                np.asarray(g_emb)[:n_unique],
+                                cfg.vocab_size),
+            v_emb, f"{tag}:emb")["rows_applied"]
+        # dense towers: full-row SelectedRows (these tables ARE the
+        # batch's live rows)
+        for name, rows, dim in self.dense_shapes:
+            g = np.asarray(g_dense[name], "float32")
+            g = g.reshape(rows, dim)
+            self._push(name, SelectedRows(np.arange(rows), g, rows),
+                       v_dense[name], f"{tag}:{name}")
+        # the no-dense-materialization invariant: each sparse push must
+        # apply exactly the batch's unique live ids
+        if applied != 2 * n_unique:
+            self.row_count_mismatches += 1
+        self.rows_applied += applied
+        self.steps += 1
+        return {"loss": loss}
+
+
+# -- eval / reference ------------------------------------------------------
+
+def auc_score(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-sum (Mann-Whitney) AUC — no sklearn in the image."""
+    labels = np.asarray(labels).ravel()
+    scores = np.asarray(scores).ravel()
+    pos = scores[labels > 0.5]
+    neg = scores[labels <= 0.5]
+    if pos.size == 0 or neg.size == 0:
+        return 0.5
+    order = np.argsort(np.concatenate([pos, neg]), kind="mergesort")
+    ranks = np.empty(order.size)
+    ranks[order] = np.arange(1, order.size + 1)
+    # midranks for ties
+    allv = np.concatenate([pos, neg])
+    sortv = allv[order]
+    i = 0
+    while i < sortv.size:
+        j = i
+        while j + 1 < sortv.size and sortv[j + 1] == sortv[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = (i + j + 2) / 2.0
+        i = j + 1
+    r_pos = ranks[:pos.size].sum()
+    return float((r_pos - pos.size * (pos.size + 1) / 2.0)
+                 / (pos.size * neg.size))
+
+
+def evaluate_ctr(params: Dict[str, np.ndarray], cfg: CTRJobConfig,
+                 ids, vals, label) -> Tuple[float, float]:
+    """(mean xent loss, AUC) of `params` on a dense dataset — shared by
+    the async fleet's end state and the sync reference."""
+    import jax.numpy as jnp
+
+    from ..parallel.sharded_embedding import _ctr_forward
+    scfg = _sharded_cfg(cfg)
+    dense = {k: jnp.asarray(v) for k, v in params.items()
+             if k not in ("w1", "emb")}
+    w1_rows = jnp.take(jnp.asarray(params["w1"]), ids, axis=0)
+    emb_rows = jnp.take(jnp.asarray(params["emb"]), ids, axis=0)
+    logit = _ctr_forward(dense, w1_rows, emb_rows,
+                         jnp.asarray(vals), scfg)
+    z = np.clip(np.asarray(logit), -30, 30)
+    lab = np.asarray(label)
+    xent = np.maximum(z, 0) - z * lab + np.log1p(np.exp(-np.abs(z)))
+    prob = 1.0 / (1.0 + np.exp(-z))
+    return float(xent.mean()), auc_score(lab, prob)
+
+
+def reference_train(cfg: CTRJobConfig, ids, vals, label,
+                    epochs: int = 1) -> Dict[str, np.ndarray]:
+    """The synchronous single-process ground truth: plain SGD
+    reference_ctr_step over the dataset in file order, from the SAME
+    seeded init the shard service uses."""
+    from ..parallel.sharded_embedding import reference_ctr_step
+    scfg = _sharded_cfg(cfg)
+    params = init_host_params(cfg)
+    B = cfg.batch_size
+    for _ in range(max(1, epochs)):
+        for s in range(0, ids.shape[0], B):
+            bi, bv, bl = (ids[s:s + B], vals[s:s + B], label[s:s + B])
+            params, _ = reference_ctr_step(params, scfg, bi, bv, bl)
+    return {k: np.asarray(v) for k, v in params.items()}
+
+
+# -- CLI -------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 3:
+        print(__doc__, file=sys.stderr)
+        return 2
+    endpoints, rank, out_path = argv[0], int(argv[1]), argv[2]
+    restart_count = int(os.environ.get("PTPU_WORKER_RESTART_COUNT",
+                                       "0"))
+    cfg = CTRJobConfig.from_wire(
+        json.loads(os.environ.get("PTPU_SPARSE_CFG", "{}")))
+    shard_eps = os.environ.get("PTPU_SPARSE_SHARDS", "")
+    shard_eps = ([e for e in shard_eps.split(";") if e.strip()]
+                 or endpoints)
+
+    from ..distributed.async_update import SparseShardClient
+    from ..distributed.task_queue import Heartbeater, TaskMasterClient
+    from ..framework.async_executor import AsyncExecutor
+    from ..models import deepfm as deepfm_model
+
+    hb = Heartbeater(endpoints, rank).start()
+    client = TaskMasterClient(endpoints=endpoints)
+    sc = SparseShardClient(shard_eps)
+    sc.init_tables(table_specs(cfg))
+
+    feed_desc = deepfm_model.criteo_feed_desc(cfg.num_field,
+                                              cfg.batch_size)
+    exe = AsyncExecutor()
+    completed, fenced_acks, failed_acks = [], 0, 0
+    losses: List[float] = []
+    # ONE stepper for the whole incarnation: its jitted grad step
+    # compiles once; only the push tag changes per lease
+    stepper = CTRStepper(cfg, sc, push_tag="idle")
+    generations = set()
+    try:
+        while True:
+            t = client.get_task(worker=rank)
+            if client.master_generation is not None:
+                generations.add(client.master_generation)
+            if t is None:
+                if client.job_complete:
+                    break
+                time.sleep(0.05)
+                continue
+            # a fresh lease means fresh push ids: a RE-executed task's
+            # pushes must not collide with the dead incarnation's
+            # ledger entries
+            stepper.push_tag = f"r{rank}i{restart_count}-{t.lease}"
+            try:
+                out = exe.run(None, feed_desc, t.shards,
+                              thread_num=1, fetch=["loss"],
+                              step_fn=stepper)
+                losses.append(out["loss"])
+            except BaseException:
+                try:
+                    client.task_failed(t.task_id, lease=t.lease)
+                except Exception:
+                    pass        # lease timeout covers it
+                raise
+            status = client.task_finished(t.task_id, lease=t.lease,
+                                          worker=rank)
+            if status == "ok":
+                completed.append([t.task_id, t.epoch])
+            elif status == "fenced":
+                fenced_acks += 1    # another worker owns it now
+            else:
+                failed_acks += 1
+    finally:
+        hb.stop(goodbye=True)
+        client.close()
+        sc.close()
+
+    doc = {"rank": rank, "restart_count": restart_count,
+           "completed": completed, "fenced_acks": fenced_acks,
+           "failed_acks": failed_acks,
+           "generations": sorted(generations),
+           "mean_loss": (float(np.mean(losses)) if losses else None),
+           "hb_re_registrations": hb.re_registrations,
+           "steps": stepper.steps,
+           "rows_applied": stepper.rows_applied,
+           "row_count_mismatches": stepper.row_count_mismatches,
+           "stale_retries": stepper.stale_retries,
+           "max_staleness": stepper.max_staleness}
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    print(f"SPARSE_WORKER_OK rank={rank} "
+          f"completed={len(completed)} fenced={fenced_acks} "
+          f"restarts={restart_count}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

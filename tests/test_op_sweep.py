@@ -928,6 +928,10 @@ EXEMPT = {
                                "parity + kernel numerics)",
     "quantized_matmul": "tests/test_quantize_exec.py freeze/int8 parity",
     "quantized_conv2d": "tests/test_quantize_exec.py conv numerics",
+    "sparse_embedding_lookup": "tests/test_sparse_plane.py (hash-fold "
+                               "host/graph parity + trains + infer rule)",
+    "sparse_scatter_update": "tests/test_sparse_plane.py duplicate-id "
+                             "accumulation + infer rule",
     "save": "io op — tests/test_reader_trainer.py save/load-as-ops",
     "load": "io op — dedicated test",
     "save_combine": "io op — dedicated test",

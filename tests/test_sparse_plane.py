@@ -1,0 +1,764 @@
+"""Sparse plane (ISSUE 13): SelectedRows gradients, hash-bucketed
+adagrad tables with optional int8 rows, the pull_rows/push_grads shard
+service on the task-queue transport (bounded staleness + push ledger),
+the AsyncExecutor streaming loop, DeepFM over the Program-plane sparse
+ops, and the 2-supervised-workers + chaos-kill headline e2e."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, sparse
+from paddle_tpu.core import flags
+from paddle_tpu.distributed.supervisor import Supervisor
+from paddle_tpu.distributed.task_queue import TaskMaster, serve_master
+from paddle_tpu.framework.async_executor import (AsyncExecutor,
+                                                 DataFeedParseError)
+from paddle_tpu.models import deepfm as dfm
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.resilience import retry as rretry, soak
+from paddle_tpu.sparse import worker as sw
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = sw.CTRJobConfig(num_field=4, vocab_size=64, embed_dim=4,
+                       fc_sizes=(16,), learning_rate=0.1,
+                       batch_size=16, seed=0)
+
+
+def _counter(metric_name, **labels):
+    m = obs_metrics.REGISTRY.get(metric_name)
+    if m is None:
+        return 0.0
+    if labels:
+        return m.labels(**labels).value
+    return m.total()
+
+
+def _serve(svc=None, **master_kw):
+    m = TaskMaster(**master_kw)
+    srv, (h, p) = serve_master(m, sparse=svc)
+    return m, srv, f"{h}:{p}"
+
+
+# ------------------------------------------------------- SelectedRows
+
+def test_selected_rows_merge_sums_duplicates():
+    sr = sparse.SelectedRows([3, 1, 3], [[1, 1], [2, 2], [5, 5]], 8)
+    m = sr.merged()
+    assert m.rows.tolist() == [1, 3]
+    np.testing.assert_allclose(m.values, [[2, 2], [6, 6]])
+    # to_dense also scatter-ADDS (the overwrite bug class)
+    np.testing.assert_allclose(sr.to_dense()[3], [6, 6])
+    # wire roundtrip
+    rt = sparse.SelectedRows.from_wire(m.to_wire())
+    assert rt.rows.tolist() == [1, 3] and rt.height == 8
+
+
+def test_selected_rows_bounds_checked():
+    with pytest.raises(ValueError):
+        sparse.SelectedRows([9], [[1.0]], 8)
+    with pytest.raises(ValueError):
+        sparse.SelectedRows([0, 1], [[1.0]], 8)   # row/value mismatch
+
+
+def test_selected_rows_from_dense():
+    g = np.zeros((6, 2), "f4")
+    g[4] = 2.0
+    sr = sparse.SelectedRows.from_dense(g)
+    assert sr.rows.tolist() == [4]
+    np.testing.assert_allclose(sr.to_dense(), g)
+
+
+# ------------------------------------------------------------- tables
+
+def test_embedding_shard_sgd_touches_only_live_rows():
+    cfg = sparse.TableConfig("t", rows=8, dim=2, seed=1,
+                             learning_rate=0.5)
+    sh = sparse.EmbeddingShard(cfg)
+    before = sh.dense()
+    g = sparse.SelectedRows([2, 2, 5], np.ones((3, 2), "f4"), 8)
+    n = sh.apply(g)
+    assert n == 2                       # unique rows, not occurrences
+    after = sh.dense()
+    # duplicate id 2 accumulated BOTH contributions (scatter-add)
+    np.testing.assert_allclose(after[2], before[2] - 0.5 * 2.0)
+    np.testing.assert_allclose(after[5], before[5] - 0.5 * 1.0)
+    untouched = [0, 1, 3, 4, 6, 7]
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+
+
+def test_embedding_shard_adagrad_matches_manual():
+    cfg = sparse.TableConfig("t", rows=4, dim=2, seed=3,
+                             learning_rate=0.5, optimizer="adagrad",
+                             adagrad_eps=1e-6)
+    sh = sparse.EmbeddingShard(cfg)
+    w0 = sh.dense()
+    g1 = np.array([[1.0, 2.0]], "f4")
+    sh.apply(sparse.SelectedRows([1], g1, 4))
+    sh.apply(sparse.SelectedRows([1], g1, 4))
+    acc = g1 * g1 + g1 * g1
+    w_manual = (w0[1] - 0.5 * g1 / (np.sqrt(g1 * g1) + 1e-6)
+                - 0.5 * g1 / (np.sqrt(acc) + 1e-6))
+    np.testing.assert_allclose(sh.dense()[1], w_manual[0], rtol=1e-6)
+
+
+def test_embedding_shard_int8_rows_bounded_error():
+    cfg = sparse.TableConfig("t", rows=16, dim=8, seed=2,
+                             init_std=0.1, learning_rate=0.1,
+                             int8_rows=True)
+    f32 = sparse.TableConfig("t", rows=16, dim=8, seed=2,
+                             init_std=0.1, learning_rate=0.1)
+    q = sparse.EmbeddingShard(cfg)
+    d = sparse.EmbeddingShard(f32)
+    # int8 storage is ~4x smaller on the row payload
+    assert q.state_bytes() < d.state_bytes() / 2
+    # quantization error bounded by one code step per row
+    err = np.abs(q.dense() - d.dense())
+    step = np.abs(d.dense()).max(axis=1, keepdims=True) / 127.0
+    assert (err <= step * 0.51 + 1e-9).all()
+    # updates keep working (requantize path)
+    g = sparse.SelectedRows([3], np.ones((1, 8), "f4"), 16)
+    q.apply(g)
+    d.apply(g)
+    np.testing.assert_allclose(q.dense()[3], d.dense()[3], atol=0.02)
+
+
+def test_hash_bucket_deterministic_and_spread():
+    a = sparse.hash_bucket(np.arange(256), 16)
+    b = sparse.hash_bucket(np.arange(256), 16)
+    assert (a == b).all() and a.min() >= 0 and a.max() < 16
+    # every bucket hit (a degenerate hash concentrates)
+    assert len(set(a.tolist())) == 16
+    # huge ids fold without overflow errors
+    big = sparse.hash_bucket(np.array([2**62, 10**15]), 7)
+    assert ((0 <= big) & (big < 7)).all()
+
+
+def test_partition_rows_mod_ownership():
+    parts = sparse.partition_rows(np.array([0, 1, 2, 3, 4, 5]), 2)
+    assert parts[0].tolist() == [0, 2, 4]
+    assert parts[1].tolist() == [1, 3, 5]
+
+
+# ----------------------------------------------------- shard service
+
+def test_service_staleness_bound_rejects_and_accounts():
+    svc = sparse.SparseShardService(staleness_bound=1)
+    svc.init_tables([sparse.TableConfig("t", rows=8, dim=2, seed=0,
+                                        learning_rate=0.1)])
+    v0 = svc.pull_rows("t", [1])["version"]
+    g = sparse.SelectedRows([1], np.ones((1, 2), "f4"), 8)
+    r0 = _counter("sparse_push_rejected_total", reason="stale")
+    assert svc.push_grads("t", g, v0, "a")["status"] == "ok"
+    assert svc.push_grads("t", g, v0, "b")["status"] == "ok"  # st = 1
+    out = svc.push_grads("t", g, v0, "c")          # staleness 2 > 1
+    assert out["status"] == "stale" and out["rows_applied"] == 0
+    assert svc.stale_rejections == 1
+    assert _counter("sparse_push_rejected_total",
+                    reason="stale") == r0 + 1
+    # a fresh pull refreshes the window; the SAME push id then lands
+    v1 = svc.pull_rows("t", [1])["version"]
+    assert svc.push_grads("t", g, v1, "c")["status"] == "ok"
+
+
+def test_service_push_ledger_is_exactly_once():
+    svc = sparse.SparseShardService()
+    svc.init_tables([sparse.TableConfig("t", rows=8, dim=2, seed=0,
+                                        learning_rate=1.0)])
+    before = svc.state("t")["values"]
+    g = sparse.SelectedRows([2], np.ones((1, 2), "f4"), 8)
+    v = svc.pull_rows("t", [2])["version"]
+    a = svc.push_grads("t", g, v, "push-1")
+    b = svc.push_grads("t", g, v, "push-1")        # retried delivery
+    assert a["status"] == b["status"] == "ok"
+    assert b.get("duplicate") and b["rows_applied"] == 1
+    after = np.asarray(svc.state("t")["values"])
+    np.testing.assert_allclose(
+        after[2], np.asarray(before)[2] - 1.0)     # applied ONCE
+
+
+def test_service_metrics_move():
+    p0 = _counter("sparse_rows_pulled_total", table="m")
+    q0 = _counter("sparse_rows_pushed_total", table="m")
+    h = obs_metrics.REGISTRY.get("sparse_staleness_steps")
+    c0 = h.total_count()
+    svc = sparse.SparseShardService()
+    svc.init_tables([sparse.TableConfig("m", rows=8, dim=2, seed=0,
+                                        learning_rate=0.1)])
+    v = svc.pull_rows("m", [1, 2, 3])["version"]
+    svc.push_grads("m", sparse.SelectedRows(
+        [1, 2], np.ones((2, 2), "f4"), 8), v, "x")
+    assert _counter("sparse_rows_pulled_total", table="m") == p0 + 3
+    assert _counter("sparse_rows_pushed_total", table="m") == q0 + 2
+    assert h.total_count() == c0 + 1
+
+
+def test_two_shard_partition_reassembles_and_trains():
+    """Mod-partitioned tables across two shard services: pulls route by
+    ownership, pushes land on the owner, the reassembled table matches
+    a single-shard run of the same pushes."""
+    specs = [sparse.TableConfig("t", rows=16, dim=2, seed=4,
+                                init_std=0.1, learning_rate=0.5)]
+    svc0 = sparse.SparseShardService(shard_id=0, num_shards=2)
+    svc1 = sparse.SparseShardService(shard_id=1, num_shards=2)
+    one = sparse.SparseShardService()
+    m0, s0, ep0 = _serve(svc0)
+    m1, s1, ep1 = _serve(svc1)
+    try:
+        c = sparse.SparseShardClient([ep0, ep1])
+        c.init_tables(specs)
+        one.init_tables(specs)
+        ids = np.array([0, 1, 2, 3, 8, 9, 15])
+        vals, vers = c.pull_rows("t", ids)
+        ref = one.pull_rows("t", ids.tolist())
+        np.testing.assert_allclose(vals, np.asarray(ref["values"],
+                                                    "f4"))
+        g = sparse.SelectedRows(ids, np.ones((7, 2), "f4"), 16)
+        out = c.push_grads("t", g, vers, "p")
+        assert out["rows_applied"] == 7 and not out["stale"]
+        one.push_grads("t", g, ref["version"], "p")
+        np.testing.assert_allclose(
+            c.table_state("t"),
+            np.asarray(one.state("t")["values"], "f4"))
+        c.close()
+    finally:
+        s0.shutdown()
+        s1.shutdown()
+
+
+@pytest.mark.chaos
+def test_sparse_rpc_chaos_sites_absorbed_by_retry():
+    """sparse.pull / sparse.push fault points (docs/RESILIENCE.md):
+    injected ConnectionErrors ride the resilience/retry.py backoff the
+    same way a dropped socket would — the call succeeds, the retry
+    and fault counters move."""
+    svc = sparse.SparseShardService()
+    svc.init_tables([sparse.TableConfig("t", rows=8, dim=2, seed=0,
+                                        learning_rate=0.1)])
+    m, srv, ep = _serve(svc)
+    try:
+        c = sparse.SparseShardClient(ep)
+        f0 = _counter("resilience_faults_injected_total",
+                      site="sparse.pull", kind="raise")
+        r0 = _counter("retry_attempts_total", name="sparse_rpc")
+        flags.set_flag("chaos_spec",
+                       "sparse.pull=raise:0.5;sparse.push=raise:0.5")
+        pushed = 0
+        for i in range(6):
+            vals, vers = c.pull_rows("t", [1, 2])
+            g = sparse.SelectedRows([1, 2], np.ones((2, 2), "f4"), 8)
+            pushed += c.push_grads("t", g, vers, f"p{i}")[
+                "rows_applied"]
+        flags.set_flag("chaos_spec", "")
+        assert pushed == 12                 # nothing lost
+        assert _counter("resilience_faults_injected_total",
+                        site="sparse.pull", kind="raise") > f0
+        assert _counter("retry_attempts_total",
+                        name="sparse_rpc") > r0
+        c.close()
+    finally:
+        flags.set_flag("chaos_spec", "")
+        srv.shutdown()
+
+
+def test_sparse_verbs_without_service_named_error():
+    m, srv, ep = _serve(None)
+    try:
+        c = sparse.SparseShardClient(ep)
+        with pytest.raises(RuntimeError, match="no SparseShardService"):
+            c.pull_rows("t", [0])
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------- AsyncExecutor streaming
+
+def _write_lines(path, n, start=0):
+    """One sample per line with a globally UNIQUE id — the
+    exactly-once assertions key on it."""
+    with open(path, "w") as f:
+        for i in range(start, start + n):
+            f.write(f"1 {i} 1 {i % 2}\n")
+    return str(path)
+
+
+def _count_feed():
+    return pt.DataFeedDesc([pt.Slot("ids", "uint64", dim=1),
+                            pt.Slot("label", "float", is_dense=True,
+                                    dim=1)], batch_size=4)
+
+
+def test_parse_line_names_source_line_and_slot():
+    feed = _count_feed()
+    r0 = _counter("datafeed_rejected_lines_total")
+    with pytest.raises(DataFeedParseError) as ei:
+        feed.parse_line("x 7 1 0", lineno=3, source="part-9")
+    msg = str(ei.value)
+    assert "part-9" in msg and "line 3" in msg and "'ids'" in msg
+    # non-numeric id inside a well-framed slot
+    with pytest.raises(ValueError) as ei2:
+        feed.parse_line("1 seven 1 0", lineno=4, source="part-9")
+    assert "non-numeric" in str(ei2.value)
+    # truncated slot still raises the legacy EnforceNotMet surface too
+    with pytest.raises(pt.core.enforce.EnforceNotMet):
+        feed.parse_line("2 7")
+    assert _counter("datafeed_rejected_lines_total") == r0 + 3
+
+
+def test_async_executor_skip_mode_counts_rejected_lines(tmp_path):
+    p = tmp_path / "shard"
+    with open(p, "w") as f:
+        f.write("1 1 1 0\n")
+        f.write("BAD LINE\n")
+        f.write("1 2 1 1\n")
+    seen = []
+
+    def step(feed):
+        seen.append(int(feed["ids"].shape[0]))
+        return {"n": feed["ids"].shape[0]}
+
+    r0 = _counter("datafeed_rejected_lines_total")
+    exe = AsyncExecutor()
+    exe.run(None, _count_feed(), [str(p)], thread_num=1, fetch=["n"],
+            step_fn=step, on_bad_line="skip")
+    assert sum(seen) == 2                  # bad line dropped, counted
+    assert _counter("datafeed_rejected_lines_total") == r0 + 1
+    # default mode: the same file aborts with the named error
+    with pytest.raises(DataFeedParseError, match="line 2"):
+        exe.run(None, _count_feed(), [str(p)], thread_num=1,
+                fetch=["n"], step_fn=step)
+
+
+def test_async_executor_propagates_step_failure_and_stops(tmp_path):
+    """Satellite regression: a poisoned batch's exception reaches the
+    caller as the FIRST error and the pool terminates promptly —
+    worker threads must not swallow it and train on."""
+    files = [_write_lines(tmp_path / f"s{i}", 40, start=100 * i)
+             for i in range(3)]
+    calls = []
+
+    class Poison(RuntimeError):
+        pass
+
+    def step(feed):
+        calls.append(1)
+        if len(calls) == 3:
+            raise Poison("poisoned batch")
+        return {"n": feed["ids"].shape[0]}
+
+    exe = AsyncExecutor()
+    t0 = time.time()
+    with pytest.raises(Poison, match="poisoned batch"):
+        exe.run(None, _count_feed(), files, thread_num=3,
+                fetch=["n"], step_fn=step)
+    assert time.time() - t0 < 30           # clean stop, no hang
+    # the pool stopped near the failure, not after draining 30 batches
+    assert len(calls) <= 10
+
+
+def test_async_executor_checkpoint_resume_exactly_once(tmp_path):
+    """file+offset checkpointing: a run killed mid-stream resumes past
+    COMMITTED batches; across both runs every line trains exactly
+    once."""
+    files = [_write_lines(tmp_path / f"s{i}", 24, start=100 * i)
+             for i in range(2)]
+    ck = str(tmp_path / "stream.json")
+    trained = []
+
+    def make_step(fail_after):
+        n_seen = [0]
+
+        def step(feed):
+            if fail_after is not None and n_seen[0] >= fail_after:
+                raise RuntimeError("killed")
+            n_seen[0] += 1
+            trained.extend(feed["ids"].ravel().tolist())
+            return {"n": feed["ids"].shape[0]}
+        return step
+
+    exe = AsyncExecutor()
+    with pytest.raises(RuntimeError, match="killed"):
+        exe.run(None, _count_feed(), files, thread_num=1,
+                fetch=["n"], step_fn=make_step(3), checkpoint_path=ck)
+    assert 0 < len(trained) <= 16
+    doc = json.load(open(ck))
+    assert sum(doc["files"].values()) == len(trained)
+    # the restarted incarnation fast-forwards and finishes the stream
+    exe.run(None, _count_feed(), files, thread_num=1, fetch=["n"],
+            step_fn=make_step(None), checkpoint_path=ck)
+    assert sorted(trained) == sorted(
+        list(range(24)) + list(range(100, 124)))
+    # a third run is a no-op (stream fully committed)
+    before = len(trained)
+    exe.run(None, _count_feed(), files, thread_num=1, fetch=["n"],
+            step_fn=make_step(None), checkpoint_path=ck)
+    assert len(trained) == before
+
+
+def test_async_executor_publishes_per_source_buffer_depth(tmp_path):
+    f = _write_lines(tmp_path / "depth-src", 16)
+    exe = AsyncExecutor()
+    exe.run(None, _count_feed(), [f], thread_num=1, fetch=["n"],
+            step_fn=lambda feed: {"n": feed["ids"].shape[0]})
+    g = obs_metrics.REGISTRY.get("reader_buffer_depth")
+    series = {k[0]: s.value for k, s in g.series().items()}
+    assert "async_executor:depth-src" in series
+
+
+# -------------------------------------- Program-plane sparse ops
+
+def test_sparse_embedding_op_trains_and_folds_huge_ids():
+    cfg = dfm.DeepFMConfig(num_field=4, vocab_size=32, embed_dim=4,
+                           fc_sizes=(8,))
+    feeds, cost, prob = dfm.build_sparse_train_net(cfg)
+    pt.optimizer.Adagrad(learning_rate=0.2).minimize(cost)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"feat_ids": rng.randint(0, 10**12,
+                                    (8, 4)).astype("int64"),
+            "feat_vals": rng.rand(8, 4).astype("float32"),
+            "label": rng.randint(0, 2, (8, 1)).astype("float32")}
+    losses = [float(exe.run(pt.default_main_program(), feed=feed,
+                            fetch_list=[cost])[0]) for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_sparse_lookup_hash_matches_host_plane():
+    """In-graph hash bucketing and sparse/table.hash_bucket agree on
+    every id — the contract that lets a reader fold ids host-side OR
+    leave them raw for the graph."""
+    V = 50
+    ids = np.array([[0, 5, 7, 10**9, 123456789]], dtype="int64")
+    x = layers.data("x", [5], dtype="int64")
+    table = layers.data("tbl", [V], dtype="float32")
+    from paddle_tpu.framework.layer_helper import LayerHelper
+    h = LayerHelper("sparse_embedding_lookup")
+    out = h.create_variable_for_type_inference("float32")
+    h.append_op("sparse_embedding_lookup",
+                {"W": [table], "Ids": [x]}, {"Out": [out]},
+                {"hash_bucket": True})
+    exe = pt.Executor(pt.CPUPlace())
+    tbl = np.arange(V, dtype="f4")[:, None] * np.ones((1, 1), "f4")
+    got, = exe.run(pt.default_main_program(),
+                   feed={"x": ids, "tbl": tbl}, fetch_list=[out])
+    want = sparse.hash_bucket(ids, V).astype("f4")[..., None]
+    np.testing.assert_allclose(got, want)
+
+
+def test_sparse_op_shape_infer_rules():
+    from paddle_tpu import analysis
+    # good program verifies clean
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", [4], dtype="int64")
+        emb = layers.sparse_embedding(ids, size=[32, 8])
+    res = analysis.verify_program(main, feed=["ids"], fetch_list=[emb])
+    assert not res.errors
+    assert emb.shape[-1] == 8
+    # float ids: provable type error
+    pt.reset_default_programs()
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(main2, startup2):
+        bad = layers.data("bad", [4], dtype="float32")
+        emb2 = layers.sparse_embedding(bad, size=[32, 8])
+    res2 = analysis.verify_program(main2, feed=["bad"],
+                                   fetch_list=[emb2])
+    assert any("must be integer" in str(f) for f in res2.errors)
+    # scatter: transposed grad caught statically
+    pt.reset_default_programs()
+    main3, startup3 = pt.Program(), pt.Program()
+    with pt.program_guard(main3, startup3):
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        w = layers.data("w", [8], dtype="float32")      # [B, 8] table
+        i3 = layers.data("i", [], dtype="int64")
+        g3 = layers.data("g", [3], dtype="float32")     # wrong dim
+        h = LayerHelper("sparse_scatter_update")
+        out3 = h.create_variable_for_type_inference("float32")
+        h.append_op("sparse_scatter_update",
+                    {"W": [w], "Ids": [i3], "Grad": [g3]},
+                    {"Out": [out3]}, {"learning_rate": 0.1})
+    res3 = analysis.verify_program(main3, feed=["w", "i", "g"],
+                                   fetch_list=[out3])
+    assert any("trailing dim" in str(f) for f in res3.errors)
+
+
+def test_lint_gate_includes_deepfm_sparse():
+    from paddle_tpu.analysis import lint as lint_cli
+    builders = lint_cli.model_builders()
+    assert "deepfm_sparse" in builders
+    assert len(builders) >= 19
+    e, w = lint_cli.lint_model("deepfm_sparse",
+                               builders["deepfm_sparse"])
+    assert e == 0
+
+
+# -------------------------------------- streaming CTR: parity lanes
+
+def _train_stream(cfg, files, thread_num, svc=None, **run_kw):
+    """In-process fleet lane: stream `files` through CTRStepper(s)
+    against a (fresh) shard service over TCP; returns the final host
+    params."""
+    svc = svc or sparse.SparseShardService()
+    m, srv, ep = _serve(svc)
+    try:
+        c = sparse.SparseShardClient(ep)
+        c.init_tables(sw.table_specs(cfg))
+        stepper = sw.CTRStepper(cfg, c, push_tag="inproc")
+        exe = AsyncExecutor()
+        exe.run(None, dfm.criteo_feed_desc(cfg.num_field,
+                                           cfg.batch_size),
+                files, thread_num=thread_num, fetch=["loss"],
+                step_fn=stepper, **run_kw)
+        params = {}
+        for spec in sw.table_specs(cfg):
+            arr = c.table_state(spec.name)
+            params[spec.name] = (arr[0] if spec.name.endswith("_b")
+                                 else arr)
+        c.close()
+        return params, stepper
+    finally:
+        srv.shutdown()
+
+
+def test_stream_single_source_matches_dense_reference(tmp_path):
+    """Sequential streaming == the dense single-process
+    reference_ctr_step run, parameter-for-parameter: the gather/
+    compute/scatter path is numerically the dense step."""
+    files = dfm.make_criteo_files(tmp_path, 1, 96,
+                                  num_field=TINY.num_field,
+                                  vocab_size=TINY.vocab_size, seed=5)
+    params, stepper = _train_stream(TINY, files, thread_num=1)
+    assert stepper.row_count_mismatches == 0
+    ids, vals, label = dfm.load_criteo_files(files, TINY.num_field)
+    ref = sw.reference_train(TINY, ids, vals, label)
+    for k in ref:
+        np.testing.assert_allclose(params[k], ref[k], rtol=2e-4,
+                                   atol=2e-5, err_msg=k)
+
+
+def test_async_multiqueue_converges_to_reference_tolerance(tmp_path):
+    """The async-vs-sync convergence parity satellite: multi-source
+    round-robin streaming (different batch ORDER than the sequential
+    reference, the async part of async SGD) lands within tolerance of
+    the sync run's loss/AUC on the full set."""
+    files = dfm.make_criteo_files(tmp_path, 4, 48,
+                                  num_field=TINY.num_field,
+                                  vocab_size=TINY.vocab_size, seed=5)
+    params, stepper = _train_stream(TINY, files, thread_num=2)
+    assert stepper.row_count_mismatches == 0
+    ids, vals, label = dfm.load_criteo_files(files, TINY.num_field)
+    ref = sw.reference_train(TINY, ids, vals, label)
+    l_ref, a_ref = sw.evaluate_ctr(ref, TINY, ids, vals, label)
+    l_got, a_got = sw.evaluate_ctr(params, TINY, ids, vals, label)
+    assert abs(l_got - l_ref) < 0.05, (l_got, l_ref)
+    assert a_got > 0.75 and a_got > a_ref - 0.05, (a_got, a_ref)
+
+
+def test_stream_int8_tables_still_learn(tmp_path):
+    """int8 row storage (PR 6 convention) through the full streaming
+    loop: the model still separates the classes."""
+    cfg = sw.CTRJobConfig(**{**TINY.to_wire(), "int8_rows": True})
+    files = dfm.make_criteo_files(tmp_path, 2, 64,
+                                  num_field=cfg.num_field,
+                                  vocab_size=cfg.vocab_size, seed=5)
+    params, stepper = _train_stream(cfg, files, thread_num=1)
+    ids, vals, label = dfm.load_criteo_files(files, cfg.num_field)
+    _, auc = sw.evaluate_ctr(params, cfg, ids, vals, label)
+    assert auc > 0.7, auc
+    assert stepper.row_count_mismatches == 0
+
+
+def test_adagrad_tables_beat_flat_sgd_start(tmp_path):
+    """Row-wise adagrad accumulators live server-side: loss after one
+    pass is finite and falls."""
+    cfg = sw.CTRJobConfig(**{**TINY.to_wire(),
+                             "table_optimizer": "adagrad",
+                             "learning_rate": 0.3})
+    files = dfm.make_criteo_files(tmp_path, 2, 64,
+                                  num_field=cfg.num_field,
+                                  vocab_size=cfg.vocab_size, seed=6)
+    params, _ = _train_stream(cfg, files, thread_num=1)
+    ids, vals, label = dfm.load_criteo_files(files, cfg.num_field)
+    loss, auc = sw.evaluate_ctr(params, cfg, ids, vals, label)
+    init_loss, _ = sw.evaluate_ctr(sw.init_host_params(cfg), cfg,
+                                   ids, vals, label)
+    assert np.isfinite(loss) and loss < init_loss
+    assert auc > 0.7
+
+
+def test_stale_push_refresh_covers_all_shards():
+    """Multi-shard stale recovery: when only SOME shards reject a push
+    as stale, the refresh must re-pull a row from EACH stale shard and
+    MERGE the fresh versions — replacing the dict would zero the other
+    shards' versions and wedge the worker forever."""
+    specs = [sparse.TableConfig("t", rows=16, dim=2, seed=0,
+                                learning_rate=0.1)]
+    svc0 = sparse.SparseShardService(shard_id=0, num_shards=2,
+                                     staleness_bound=0)
+    svc1 = sparse.SparseShardService(shard_id=1, num_shards=2,
+                                     staleness_bound=0)
+    m0, s0, ep0 = _serve(svc0)
+    m1, s1, ep1 = _serve(svc1)
+    try:
+        c = sparse.SparseShardClient([ep0, ep1])
+        c.init_tables(specs)
+        _, vers = c.pull_rows("t", np.array([0, 1, 2, 3]))
+        # advance shard 1 behind the client's back: its next push is
+        # stale (bound 0), shard 0's is fresh
+        g1 = sparse.SelectedRows([1], np.ones((1, 2), "f4"), 16)
+        v1 = svc1.pull_rows("t", [1])["version"]
+        svc1.push_grads("t", g1, v1, "direct")
+        stepper = sw.CTRStepper(TINY, c, push_tag="x")
+        g = sparse.SelectedRows([0, 1, 2, 3], np.ones((4, 2), "f4"),
+                                16)
+        out = stepper._push("t", g, vers, "pid")
+        # recovered: one stale round-trip, then every row applied
+        # (shard 0's re-push deduped by the ledger, not re-applied)
+        assert stepper.stale_retries >= 1
+        assert out["rows_applied"] == 4 and not out["stale"]
+        assert np.asarray(svc0.state("t")["values"]).shape == (8, 2)
+        c.close()
+    finally:
+        s0.shutdown()
+        s1.shutdown()
+
+
+def test_async_executor_multithread_resume_never_skips(tmp_path):
+    """With several step workers, completions can land out of order;
+    the checkpoint watermark must stay contiguous so a crash-resume
+    never SKIPS a line (re-training is allowed only past the
+    watermark)."""
+    from collections import Counter
+    files = [_write_lines(tmp_path / f"s{i}", 24, start=100 * i)
+             for i in range(3)]
+    ck = str(tmp_path / "stream.json")
+    trained = []
+
+    def make_step(fail_after):
+        n = [0]
+
+        def step(feed):
+            if fail_after is not None and n[0] >= fail_after:
+                raise RuntimeError("killed")
+            n[0] += 1
+            trained.extend(feed["ids"].ravel().tolist())
+            return {"n": feed["ids"].shape[0]}
+        return step
+
+    exe = AsyncExecutor()
+    with pytest.raises(RuntimeError, match="killed"):
+        exe.run(None, _count_feed(), files, thread_num=3,
+                fetch=["n"], step_fn=make_step(5), checkpoint_path=ck)
+    crash_mark = json.load(open(ck))["files"]
+    exe.run(None, _count_feed(), files, thread_num=3, fetch=["n"],
+            step_fn=make_step(None), checkpoint_path=ck)
+    every = set(range(24)) | set(range(100, 124)) | set(
+        range(200, 224))
+    assert set(trained) == every         # nothing skipped, ever
+    # re-trained lines sit strictly PAST their source's crash-time
+    # watermark (the bounded in-flight window)
+    for line_id, count in Counter(trained).items():
+        if count > 1:
+            src = str(tmp_path / f"s{line_id // 100}")
+            lineno = line_id % 100 + 1
+            assert lineno > crash_mark.get(src, 0), (line_id,
+                                                     crash_mark)
+
+
+# ------------------------------------------------- headline e2e
+
+@pytest.mark.chaos
+def test_sparse_ctr_e2e_two_workers_chaos_kill(tmp_path):
+    """ISSUE 13 headline acceptance: 2 supervised worker processes + a
+    parameter-shard service stream a criteo-shaped file set; a chaos
+    schedule kill-9s rank 0 mid-stream; the supervisor revives it;
+    training completes with exactly-once task-ledger accounting, every
+    push applied exactly the batch's unique live ids (no dense
+    gradient), and the final AUC/loss lands within tolerance of the
+    synchronous single-process reference run."""
+    cfg = TINY
+    files = dfm.make_criteo_files(tmp_path, 6, 48,
+                                  num_field=cfg.num_field,
+                                  vocab_size=cfg.vocab_size, seed=5)
+    svc = sparse.SparseShardService()
+    master = TaskMaster(snapshot_path=str(tmp_path / "master.json"),
+                        num_epochs=1, worker_timeout=3.0,
+                        lease_timeout=60.0)
+    master.set_dataset(files, shards_per_task=1)
+    srv, (h, p) = serve_master(master, sparse=svc)
+    ep = f"{h}:{p}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PTPU_SPARSE_CFG=json.dumps(cfg.to_wire()))
+    env.pop("XLA_FLAGS", None)
+    env.pop("PYTHONPATH", None)
+    env.pop("PTPU_CHAOS_SPEC", None)
+    cmds, outs = [], []
+    for rank in range(2):
+        out = str(tmp_path / f"worker{rank}.json")
+        outs.append(out)
+        cmds.append([sys.executable, "-m", "paddle_tpu.sparse.worker",
+                     ep, str(rank), out])
+    # rank 0 dies at its trainer.step fault point (hard exit, lease
+    # held); the supervisor's restart env strips the spec so the
+    # revived incarnation runs clean
+    envs = [{"PTPU_CHAOS_SPEC": "trainer.step=exit:0.7:9"}, None]
+    sup = Supervisor(cmds, env=env, envs=envs, cwd=REPO,
+                     max_restarts=3,
+                     backoff=rretry.RetryPolicy(
+                         name="supervisor_restart", max_attempts=1,
+                         base_delay=0.05, max_delay=0.2),
+                     log_dir=str(tmp_path))
+    try:
+        sup.start()
+        ok = sup.wait(timeout=240)
+        status = sup.status()
+        logs = {r: open(tmp_path / f"worker_r{r}.log",
+                        errors="replace").read()[-2000:]
+                for r in range(2)
+                if (tmp_path / f"worker_r{r}.log").exists()}
+        assert ok, (status, logs)
+        # exactly-once: every task completed once, none twice/missing
+        ledger = master.ledger_entries()
+        assert soak.check_ledger(ledger, n_tasks=len(files),
+                                 epochs=1) == []
+        # the chaos kill really happened and was survived
+        assert status[0]["restarts"] >= 1, status
+        results = [json.load(open(o)) for o in outs]
+        by_rank = {r["rank"]: r for r in results}
+        assert by_rank[0]["restart_count"] >= 1
+        # no dense-gradient materialization: every push applied exactly
+        # the batch's unique live ids, on every incarnation
+        for r in results:
+            assert r["row_count_mismatches"] == 0, r
+            assert r["steps"] == 0 or r["rows_applied"] > 0
+        # both workers contributed and all client completion claims are
+        # unique (fenced zombie acks never recorded)
+        claims = [tuple(c) for r in results for c in r["completed"]]
+        assert len(claims) == len(set(claims))
+        assert len(claims) == len(files)
+        # convergence parity vs the synchronous reference
+        ids, vals, label = dfm.load_criteo_files(files, cfg.num_field)
+        got = {}
+        for spec in sw.table_specs(cfg):
+            arr = svc.state(spec.name)["values"]
+            arr = np.asarray(arr, "f4")
+            got[spec.name] = (arr[0] if spec.name.endswith("_b")
+                              else arr)
+        ref = sw.reference_train(cfg, ids, vals, label)
+        l_ref, a_ref = sw.evaluate_ctr(ref, cfg, ids, vals, label)
+        l_got, a_got = sw.evaluate_ctr(got, cfg, ids, vals, label)
+        # a killed worker's half-streamed file re-runs under the new
+        # lease (pushes are at-least-once ACROSS re-executions), so
+        # the bar is convergence tolerance, not bit equality
+        assert abs(l_got - l_ref) < 0.08, (l_got, l_ref)
+        assert a_got > 0.75 and a_got > a_ref - 0.08, (a_got, a_ref)
+    finally:
+        sup.stop()
+        srv.shutdown()
