@@ -27,7 +27,7 @@ import os
 import subprocess
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..core import flags
 from ..observability import flight as obs_flight
@@ -59,12 +59,29 @@ class Supervisor:
                  max_restarts: Optional[int] = None,
                  backoff: Optional[rretry.RetryPolicy] = None,
                  restart_env: Optional[Dict[str, str]] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 cmd_factory: Optional[Callable[[int], List[str]]] = None,
+                 env_factory: Optional[
+                     Callable[[int], Optional[Dict[str, str]]]] = None,
+                 retire_rc: Optional[int] = None):
         self.cmds = [list(c) for c in cmds]
         self.env = dict(os.environ if env is None else env)
         self.envs = list(envs) if envs is not None \
             else [None] * len(cmds)
         self.cwd = cwd
+        # elastic resize (ISSUE 14): the LIVE fleet target.  Ranks >=
+        # it are never (re)started; set_world_size() moves it and spawns
+        # new ranks via cmd_factory/env_factory.
+        self.target_world = len(self.cmds)
+        self.cmd_factory = cmd_factory
+        self.env_factory = env_factory
+        # a worker that exits with this code RETIRED on the master's
+        # shrink directive (distinct from 0 = job complete): the rank
+        # is parked, not failed, and a later grow revives it.  The
+        # exit-code convention is what makes revival race-free — the
+        # supervisor's own target may already have grown by the time
+        # the retiring process finally exits
+        self.retire_rc = retire_rc
         self.max_restarts = int(
             max_restarts if max_restarts is not None
             else flags.get_flag("max_worker_restarts"))
@@ -79,9 +96,13 @@ class Supervisor:
             if restart_env is None else dict(restart_env)
         self.log_dir = log_dir
         self.restarts: Dict[int, int] = {r: 0 for r in range(len(cmds))}
+        # total spawns per rank (crash restarts AND resize revivals):
+        # the incarnation ordinal each process sees
+        self.spawns: Dict[int, int] = {r: 0 for r in range(len(cmds))}
         self._procs: Dict[int, Optional[subprocess.Popen]] = {}
         self._logs: Dict[int, object] = {}
         # rank -> "running" | "restarting" | "done" | "failed"
+        #         | "retired" (parked by a shrink; a grow revives it)
         self._state: Dict[int, str] = {}
         self._rc: Dict[int, Optional[int]] = {}
         self._restart_at: Dict[int, float] = {}
@@ -98,6 +119,12 @@ class Supervisor:
         if incarnation > 0:
             env.update(self.restart_env)
         env["PTPU_WORKER_RESTART_COUNT"] = str(incarnation)
+        # elastic bugfix (ISSUE 14): thread the LIVE fleet target into
+        # every spawn, not the launch-time world baked into the argv —
+        # a worker respawned after a resize must join the CURRENT
+        # fleet, or it re-registers believing a world that no longer
+        # exists (workers prefer this env over their argv world)
+        env["PTPU_FLEET_WORLD_SIZE"] = str(self.target_world)
         # persistent executable cache (framework/jit_cache.py): a
         # supervisor-side jit_cache_dir flag reaches every worker —
         # including respawned incarnations — so a restarted rank
@@ -113,7 +140,8 @@ class Supervisor:
         return env
 
     def _spawn(self, rank: int):
-        incarnation = self.restarts[rank]
+        incarnation = self.spawns.get(rank, 0)
+        self.spawns[rank] = incarnation + 1
         out = subprocess.DEVNULL
         if self.log_dir:
             # one append-mode log per rank, incarnations concatenated —
@@ -133,10 +161,57 @@ class Supervisor:
             return self
         for rank in range(len(self.cmds)):
             self._spawn(rank)
+        self._start_monitor()
+        return self
+
+    def _start_monitor(self):
+        self._all_done.clear()
         self._thread = threading.Thread(target=self._monitor,
                                         daemon=True, name="supervisor")
         self._thread.start()
-        return self
+
+    def set_world_size(self, n: int):
+        """Elastic resize (ISSUE 14): move the supervised fleet to `n`
+        ranks.  Growth spawns new ranks via ``cmd_factory`` (and
+        revives previously retired ones) with the live world threaded
+        through ``_env_for``; shrink is passive — ranks outside the
+        master's effective world retire themselves on its ``retire``
+        directive (exiting with ``retire_rc``), and ``_scan`` stops
+        respawning anything >= the target.  Pair with
+        ``TaskMasterClient.request_resize(n)``."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"set_world_size: need n >= 1, got {n}")
+        spawned = False
+        with self._lock:
+            self.target_world = n
+            for rank in range(len(self.cmds), n):
+                if self.cmd_factory is None:
+                    raise ValueError(
+                        "growing past the launch world needs a "
+                        "cmd_factory (Supervisor(cmd_factory=...))")
+                self.cmds.append(list(self.cmd_factory(rank)))
+                e = self.env_factory(rank) if self.env_factory else None
+                self.envs.append(dict(e) if e else None)
+                self.restarts[rank] = 0
+                self._spawn(rank)
+                spawned = True
+                obs_flight.record("supervisor", "rank_added", rank=rank)
+            # ranks parked by an earlier shrink are revived by the
+            # monitor's sweep (_scan) now that the target covers them
+            spawned = spawned or any(
+                self._state.get(r) == "retired" for r in range(n))
+        if spawned and (self._thread is None
+                        or not self._thread.is_alive()
+                        or self._all_done.is_set()):
+            # the monitor exits when every rank is terminal; a grow
+            # after that moment needs it running again.  The
+            # _all_done check closes the race where the old monitor
+            # decided to exit (under the lock, before our spawn) but
+            # its thread still reads as alive here — both sides
+            # serialize on the lock, so one of the two conditions
+            # always catches an exiting monitor.
+            self._start_monitor()
 
     # -- monitor loop -----------------------------------------------------
     def _monitor(self):
@@ -145,24 +220,58 @@ class Supervisor:
                 with self._lock:
                     self._scan()
                     states = set(self._state.values())
+                    if states <= {"done", "failed", "retired"}:
+                        # terminal check + set UNDER the lock:
+                        # set_world_size also holds it while spawning,
+                        # so either its new rank lands before this
+                        # check (not terminal, keep monitoring) or it
+                        # observes _all_done already set and restarts
+                        # the monitor — a grow can never strand a
+                        # freshly spawned rank unmonitored
+                        self._all_done.set()
+                        return
             except Exception as e:
                 # the monitor thread must never die silently: a dead
                 # monitor means crashes go unrestarted and wait() hangs
                 # for its full timeout with no diagnosis
                 obs_flight.record("supervisor", "monitor_error",
                                   error=repr(e)[:200])
-                self._stop.wait(_POLL)
-                continue
-            if states <= {"done", "failed"}:
-                self._all_done.set()
-                return
             self._stop.wait(_POLL)
 
     def _scan(self):
         now = time.time()
         for rank, proc in self._procs.items():
             state = self._state[rank]
+            if state == "retired" and rank < self.target_world:
+                # the fleet grew back over a parked rank: revive it —
+                # it resumes from its checkpoint and re-registers
+                # under the same rank (a new incarnation).  Revival
+                # rides the RESTART plumbing (backoff schedule +
+                # OSError-guarded spawn) rather than spawning inline:
+                # if the master still directs the rank to retire (a
+                # supervisor/master world mismatch — the paired
+                # request_resize never happened), the spawn/park cycle
+                # degrades to one bounded-rate respawn per max_delay
+                # instead of a tight livelock, and a persistent exec
+                # failure marks the rank failed instead of aborting
+                # the scan mid-iteration
+                attempt = min(self.spawns.get(rank, 1), 30)
+                delay = self.backoff.delay(attempt)
+                self._restart_at[rank] = now + delay
+                self._state[rank] = "restarting"
+                obs_flight.record("supervisor", "rank_revived",
+                                  rank=rank,
+                                  incarnation=self.spawns.get(rank, 0),
+                                  delay=round(delay, 4))
+                continue
             if state == "restarting":
+                if rank >= self.target_world:
+                    # shrank while backing off: cancel the respawn
+                    self._state[rank] = "retired"
+                    obs_flight.record("supervisor", "rank_retired",
+                                      rank=rank, rc=self._rc.get(rank),
+                                      target_world=self.target_world)
+                    continue
                 if now >= self._restart_at[rank]:
                     try:
                         self._spawn(rank)
@@ -180,8 +289,19 @@ class Supervisor:
             if rc is None:
                 continue
             self._rc[rank] = rc
-            if rc == 0:
+            if rc == 0 and rank < self.target_world:
                 self._state[rank] = "done"
+                continue
+            if (self.retire_rc is not None and rc == self.retire_rc) \
+                    or rank >= self.target_world:
+                # retirement (the worker's retire_rc, or any exit of a
+                # rank the fleet shrank past): park it — its leases
+                # requeue via the master's membership reaper, its
+                # checkpoint stays, and a later grow revives it
+                self._state[rank] = "retired"
+                obs_flight.record("supervisor", "rank_retired",
+                                  rank=rank, rc=rc,
+                                  target_world=self.target_world)
                 continue
             if self.restarts[rank] >= self.max_restarts:
                 self._state[rank] = "failed"
@@ -208,13 +328,15 @@ class Supervisor:
                     for rank in range(len(self.cmds))}
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        """Block until every rank is terminal (done/failed); True only
-        when ALL exited 0."""
+        """Block until every rank is terminal (done/failed/retired);
+        True only when ALL finished cleanly (exit 0, or retired by an
+        elastic shrink)."""
         finished = self._all_done.wait(timeout)
         if not finished:
             return False
         st = self.status()
-        return all(s["state"] == "done" for s in st.values())
+        return all(s["state"] in ("done", "retired")
+                   for s in st.values())
 
     def stop(self, kill: bool = True):
         """Stop monitoring; kill whatever is still running."""

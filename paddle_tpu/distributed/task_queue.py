@@ -40,6 +40,17 @@ Elastic-fleet semantics (the etcd lease half of the reference's EDL era):
 * **Failover** — :class:`TaskMasterClient` accepts a list of endpoints
   and rotates on connect failure; ``serve_master`` restart recovers from
   the snapshot (leases void, generation bumped) and the fleet continues.
+* **Elastic resize** (ISSUE 14) — ``request_resize(new_world_size)``
+  changes the fleet's world size at an epoch boundary: the current
+  epoch drains, the pending target flips live inside
+  ``_maybe_rollover``, and the recycled shards rebalance across the new
+  membership by ordinary leasing.  Ranks outside the effective world
+  get a ``retire`` directive on their next empty ``get_task`` (their
+  in-flight leases requeue through the fence/ledger machinery, so a
+  shrink never double-completes work); ranks joining under a pending
+  grow get ``wait_resize`` until the boundary.  Metrics:
+  ``fleet_resizes_total``, ``fleet_target_world_size``; X-ray instants
+  ``fleet.resize_requested`` / ``fleet.resize_applied``.
 """
 from __future__ import annotations
 
@@ -96,6 +107,15 @@ _m_workers_dead = obs_metrics.counter(
     "taskmaster_workers_dead_total",
     "Workers declared dead after heartbeat-lease expiry; their "
     "outstanding task leases were requeued immediately.")
+_m_resizes = obs_metrics.counter(
+    "fleet_resizes_total",
+    "World-size resizes applied by the task master (each takes effect "
+    "at an epoch boundary: the current epoch drains, then shards "
+    "rebalance across the new membership).")
+_m_target_world = obs_metrics.gauge(
+    "fleet_target_world_size",
+    "The task master's current target world size (0 = unbounded "
+    "legacy fleet: no retire/wait directives are issued).")
 
 _WORKER_STATES = ("live", "dead", "departed")
 
@@ -125,6 +145,7 @@ def reset_state():
     _m_tasks.reset()
     _m_fleet_workers.reset()
     _m_generation.reset()
+    _m_target_world.reset()
 
 
 @dataclass
@@ -147,7 +168,8 @@ class TaskMaster:
                  snapshot_interval: float = 0.5,
                  worker_timeout: Optional[float] = None,
                  num_epochs: int = 0,
-                 max_failures: int = MAX_FAILURES):
+                 max_failures: int = MAX_FAILURES,
+                 world_size: int = 0):
         self._lock = threading.Lock()
         self.snapshot_path = snapshot_path
         self.lease_timeout = lease_timeout
@@ -175,6 +197,19 @@ class TaskMaster:
         self._next_id = 0
         self._lease_seq = 0
         self.generation = 1
+        # elastic resize (ISSUE 14): the EFFECTIVE world size (ranks
+        # >= it are directed to retire), the not-yet-applied request
+        # (takes effect when the current epoch drains), and a count of
+        # applied resizes.  0 = legacy unbounded fleet.
+        self.target_world_size = int(world_size)
+        self.pending_world_size: Optional[int] = None
+        self.resizes = 0
+        # one record per applied resize: {"old", "new", "epoch"} where
+        # epoch is the FIRST epoch governed by the new world — the
+        # ground truth the soak checks ledger completions against
+        # (epoch boundaries can outpace the operator requesting the
+        # next step, so the plan alone doesn't pin the alignment)
+        self.resize_log: List[dict] = []
         # rank -> {lease, deadline, state, host, pid}
         self.workers: Dict[int, dict] = {}
         # accepted completions: the exactly-once record
@@ -234,7 +269,12 @@ class TaskMaster:
         immediately."""
         with self._lock:
             events = self._reap()
-            if not self.todo:
+            # elastic resize: a rank outside the effective world leases
+            # nothing — it is retiring (or, during a pending grow,
+            # waiting for the epoch boundary); see worker_directive
+            outside = (worker is not None and self.target_world_size > 0
+                       and int(worker) >= self.target_world_size)
+            if not self.todo or outside:
                 self._publish_gauges()
                 t = None
             else:
@@ -340,8 +380,14 @@ class TaskMaster:
         that can drain the queue — finish, failure, and lease expiry —
         so a final failed task can't strand the done list forever.
         Bounded jobs (num_epochs > 0) stop recycling after the final
-        epoch; the done list becomes the job's terminal state."""
+        epoch; the done list becomes the job's terminal state.
+
+        The drained queue IS the epoch boundary, so a pending resize
+        takes effect here — before the next epoch's tasks requeue —
+        and the recycled shards rebalance across the new membership
+        simply by being leased to whoever is in the world now."""
         if not self.todo and not self.pending and self.done:
+            self._apply_resize()
             if self.num_epochs > 0 and \
                     min(t.epoch for t in self.done) + 1 >= self.num_epochs:
                 return
@@ -369,6 +415,84 @@ class TaskMaster:
                 self._snapshot()
                 self._publish_gauges()
         return status
+
+    # -- elastic resize (ISSUE 14) -----------------------------------------
+    def request_resize(self, new_world_size: int) -> dict:
+        """Ask the fleet to become ``new_world_size`` ranks.  Epoch-
+        boundary semantics: if the queue is mid-epoch the request PENDS
+        and applies when the epoch drains (``_maybe_rollover``); an
+        idle queue applies immediately.  Growing ranks (>= the current
+        target, < the pending one) are directed to WAIT until the
+        boundary; after a shrink applies, ranks >= the target are
+        directed to RETIRE — their in-flight leases requeue through the
+        normal fence/ledger machinery, so nothing completes twice."""
+        n = int(new_world_size)
+        if n < 1:
+            raise ValueError(f"request_resize: world size must be >= 1,"
+                             f" got {n}")
+        with self._lock:
+            events = self._reap()
+            old = self.target_world_size
+            self.pending_world_size = n
+            obs_flight.record("task_queue", "resize_requested",
+                              old=old, new=n)
+            from ..observability import tracectx as obs_tracectx
+            obs_tracectx.instant("fleet.resize_requested", kind="fleet",
+                                 old_world=old, new_world=n)
+            applied = False
+            if not self.todo and not self.pending:
+                # idle queue: nothing to drain, effective now
+                self._apply_resize()
+                applied = True
+            self._snapshot(force=True)
+            self._publish_gauges()
+            out = {"target_world_size": self.target_world_size,
+                   "pending_world_size": self.pending_world_size,
+                   "applied": applied, "resizes": self.resizes}
+        self._emit(events)
+        return out
+
+    def _apply_resize(self):
+        """Flip the pending world size live (call under the lock, at an
+        epoch boundary or on an idle queue)."""
+        if self.pending_world_size is None:
+            return
+        old, new = self.target_world_size, self.pending_world_size
+        self.target_world_size = new
+        self.pending_world_size = None
+        self.resizes += 1
+        # the epoch boundary this fired at: the done list holds the
+        # just-finished epoch, so the new world governs epoch+1 (an
+        # idle-queue apply governs whatever runs next, epoch 0 at
+        # job start)
+        epoch = (min(t.epoch for t in self.done) + 1) if self.done \
+            else 0
+        self.resize_log.append({"old": old, "new": new, "epoch": epoch})
+        _m_resizes.inc()
+        _m_target_world.set(new)
+        obs_flight.record("task_queue", "resize_applied",
+                          old=old, new=new, epoch=epoch)
+        # X-ray plane: the resize lands on whichever request/step's
+        # trace triggered the boundary (the final ack of the epoch)
+        from ..observability import tracectx as obs_tracectx
+        obs_tracectx.instant("fleet.resize_applied", kind="fleet",
+                             old_world=old, new_world=new)
+
+    def worker_directive(self, worker: Optional[int]) -> dict:
+        """What a rank that just got NO task should do: ``retire``
+        (it is outside the effective world — goodbye and exit) or
+        ``wait_resize`` (a pending grow will include it at the next
+        epoch boundary — keep polling).  Empty for in-world ranks and
+        legacy unbounded fleets."""
+        if worker is None:
+            return {}
+        with self._lock:
+            tw, pw = self.target_world_size, self.pending_world_size
+        if tw <= 0 or int(worker) < tw:
+            return {}
+        if pw is not None and int(worker) < pw:
+            return {"wait_resize": True, "target_world_size": tw}
+        return {"retire": True, "target_world_size": tw}
 
     # -- worker membership -------------------------------------------------
     def register_worker(self, rank: int, host: Optional[str] = None,
@@ -488,6 +612,10 @@ class TaskMaster:
                    "generation": self.generation,
                    "complete": self._complete(),
                    "ledger": len(self.ledger),
+                   "target_world_size": self.target_world_size,
+                   "pending_world_size": self.pending_world_size,
+                   "resizes": self.resizes,
+                   "resize_log": [dict(r) for r in self.resize_log],
                    "workers": {str(r): w["state"]
                                for r, w in sorted(self.workers.items())}}
         self._emit(events)
@@ -510,6 +638,7 @@ class TaskMaster:
         for state, n in counts.items():
             _m_fleet_workers.labels(state=state).set(n)
         _m_generation.set(self.generation)
+        _m_target_world.set(self.target_world_size)
 
     def _requeue_expired(self):
         """Lease timeout -> back on the queue (ref checkTimeoutFunc:341)."""
@@ -534,6 +663,13 @@ class TaskMaster:
             "next_id": self._next_id,
             "generation": self.generation,
             "num_epochs": self.num_epochs,
+            # a resize (applied or still pending) survives a master
+            # restart: the recovered fleet keeps its target and a
+            # pending request still applies at the next boundary
+            "target_world_size": self.target_world_size,
+            "pending_world_size": self.pending_world_size,
+            "resizes": self.resizes,
+            "resize_log": self.resize_log,
             "todo": [t.__dict__ for t in self.todo],
             # pending tasks snapshot back into todo: on master restart
             # their leases are void anyway (ref recover semantics)
@@ -630,6 +766,18 @@ class TaskMaster:
                 self.ledger = list(state.get("ledger", []))
                 if self.num_epochs == 0:
                     self.num_epochs = int(state.get("num_epochs", 0))
+                # the snapshot's target reflects APPLIED resizes and is
+                # newer truth than the relaunch argument: a master
+                # restarted with its launch-time world_size must not
+                # silently undo a resize the fleet already completed
+                persisted_world = int(state.get("target_world_size", 0))
+                if persisted_world:
+                    self.target_world_size = persisted_world
+                pw = state.get("pending_world_size")
+                if pw is not None:
+                    self.pending_world_size = int(pw)
+                self.resizes = int(state.get("resizes", 0))
+                self.resize_log = list(state.get("resize_log", []))
                 prev_gen = max(prev_gen, int(state.get("generation", 0)))
             except (KeyError, TypeError, ValueError) as e:
                 _m_snapshot_corrupt.inc()
@@ -678,8 +826,16 @@ class _Handler(socketserver.StreamRequestHandler):
     def _dispatch(self, master, method, req) -> dict:
         if method == "get_task":
             t = master.get_task(worker=req.get("worker"))
-            return {"ok": True, "task": t.__dict__ if t else None,
+            resp = {"ok": True, "task": t.__dict__ if t else None,
                     "complete": master.complete}
+            if t is None:
+                # the elastic directive rides the empty reply: retire
+                # (outside the world) or wait (pending grow)
+                resp.update(master.worker_directive(req.get("worker")))
+            return resp
+        if method == "request_resize":
+            return {"ok": True,
+                    **master.request_resize(req["world_size"])}
         if method == "task_finished":
             st = master.task_finished(req["task_id"],
                                       lease=req.get("lease"),
@@ -893,6 +1049,10 @@ class TaskMasterClient:
         self.master_generation: Optional[int] = None
         self.generation_changes = 0
         self.job_complete = False
+        # elastic directives from the last empty get_task reply
+        self.retire = False
+        self.wait_resize = False
+        self.target_world_size: Optional[int] = None
         self._policy = _retry.RetryPolicy(
             name="task_master_rpc",
             retry_on=(ConnectionError, socket.timeout, OSError))
@@ -982,7 +1142,18 @@ class TaskMasterClient:
     def get_task(self, worker: Optional[int] = None) -> Optional[Task]:
         resp = self._call(method="get_task", worker=worker)
         self.job_complete = bool(resp.get("complete"))
+        self.retire = bool(resp.get("retire"))
+        self.wait_resize = bool(resp.get("wait_resize"))
+        if "target_world_size" in resp:
+            self.target_world_size = int(resp["target_world_size"])
         return Task(**resp["task"]) if resp.get("task") else None
+
+    def request_resize(self, world_size: int) -> dict:
+        """Ask the master to resize the fleet to ``world_size`` ranks
+        (applies at the next epoch boundary; see
+        TaskMaster.request_resize)."""
+        return self._call(method="request_resize",
+                          world_size=int(world_size))
 
     def task_finished(self, task_id: int,
                       lease: Optional[str] = None,

@@ -1463,10 +1463,13 @@ class Executor:
             # executable from disk.  A hit records NO compile counters
             # and NO forensics (nothing compiled — jit_cache_hits_total
             # + flight carry the event), so a warm restart's metrics
-            # read exactly like an in-memory-cached process.  Single-
-            # device only: sharded executables stay on the jit path.
+            # read exactly like an in-memory-cached process.  Mesh
+            # executors participate too (ISSUE 14): their keys carry
+            # the full mesh/sharding identity, so a resized
+            # incarnation under a different mesh is a clean MISS and a
+            # same-mesh warm start deserializes the sharded executable.
             from . import jit_cache as pjit_cache
-            use_pc = self.mesh is None and pjit_cache.enabled()
+            use_pc = pjit_cache.enabled()
             ploaded = pmeta = None
             if use_pc:
                 # NOTE: no program._version here — it is a process-
@@ -1479,6 +1482,10 @@ class Executor:
                     "state": state_sig, "flags": flags_sig,
                     "random_seed_none": program.random_seed is None,
                 }
+                if self.mesh is not None:
+                    # added ONLY under a mesh so every pre-existing
+                    # single-device key (and cached entry) stays valid
+                    pcomponents["mesh"] = self._mesh_components(program)
                 pkhash = pjit_cache.entry_key("executor_step",
                                               pcomponents)
                 pmeta = (pcomponents, pkhash)
@@ -1570,6 +1577,30 @@ class Executor:
         self._last_compiled = compiled
         return compiled, dev_feeds, state, fetch_names
 
+    def _mesh_components(self, program) -> dict:
+        """Mesh/sharding identity for persistent-cache keys (ISSUE 14):
+        axis names+sizes, the exact device assignment (a serialized
+        executable bakes its devices in — a mesh over different ids
+        must not HIT), the batch axis, the transpiler axes, and every
+        var's PartitionSpec.  A resized incarnation with a different
+        mesh gets a clean MISS; the same mesh, a warm HIT."""
+        mesh = self.mesh
+        block = program.global_block()
+        var_shardings = sorted(
+            (name, [None if s is None else str(s) for s in v.sharding])
+            for name, v in block.vars.items()
+            if getattr(v, "sharding", None) is not None)
+        spmd_axis = getattr(program, "_dist_spmd_axis", None)
+        pp_axis = getattr(program, "_dist_pp_axis", None)
+        return {
+            "axes": [[str(a), int(s)] for a, s in mesh.shape.items()],
+            "device_ids": [int(d.id) for d in mesh.devices.flat],
+            "batch_axis": str(self.batch_axis),
+            "spmd_axis": None if spmd_axis is None else str(spmd_axis),
+            "pp_axis": None if pp_axis is None else str(pp_axis),
+            "var_shardings": var_shardings,
+        }
+
     def _root_and_counter(self, program, n):
         """Root PRNG key (unfolded) plus the run-counter window
         [counter, counter+n) this call consumes — run() folds on the
@@ -1643,7 +1674,7 @@ class Executor:
         # compiled-and-stored ("compiled"), or has not dispatched yet.
         from . import jit_cache as pjit_cache
         jc_doc = {}
-        if self.mesh is None and pjit_cache.enabled():
+        if pjit_cache.enabled():
             jc_doc = {"jit_cache": {
                 **pjit_cache.stats(),
                 "entry": (compiled._persist_meta[1]

@@ -18,14 +18,27 @@ The manifest records every array's global shape/dtype, each piece's
 slice, and a CRC32 per shard file.  A checkpoint without a manifest, or
 whose shard CRCs mismatch, is invalid and is skipped by
 latest_checkpoint() — resume falls back to the newest valid serial.
+
+Elastic resize (ISSUE 14): a checkpoint written as N-sharded resumes as
+M-sharded for any N, M (including 1).  :func:`reshard` is the PURE
+planner — given a manifest it maps every array onto ``n_to`` shard
+files (contiguous axis-0 chunks by default; a ``layout`` override picks
+a different split axis per array, e.g. the model axis of a
+tensor-parallel weight).  :func:`reshard_checkpoint` is the IO driver:
+it gathers the source pieces, re-splits, and commits the M-sharded copy
+as a NEW serial under the same root, manifest written last — a crash or
+torn write mid-reshard leaves an invalid serial that
+``latest_checkpoint`` skips, so resume falls back to the pre-resize
+checkpoint instead of bricking the start.
 """
 from __future__ import annotations
 
 import json
 import os
 import shutil
+import warnings
 import zlib
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -246,6 +259,151 @@ def load_state(dirname: str, device=None) -> Tuple[Dict[str, Any], dict]:
     return state, manifest.get("meta", {})
 
 
+# -- elastic resharding (ISSUE 14) -----------------------------------------
+
+Layout = Union[str, Dict[str, int], Callable[[str, Tuple[int, ...]], int]]
+
+
+def _split_ranges(extent: int, n: int) -> List[Tuple[int, int]]:
+    """Contiguous near-even split of [0, extent) into n ranges (first
+    ``extent % n`` ranges get the extra element); deterministic, so an
+    N→M→N round trip reproduces the original piece boundaries."""
+    base, rem = divmod(int(extent), int(n))
+    out, start = [], 0
+    for i in range(n):
+        size = base + (1 if i < rem else 0)
+        out.append((start, start + size))
+        start += size
+    return out
+
+
+def _split_axis(name: str, shape: Tuple[int, ...], layout: Layout) -> int:
+    if callable(layout):
+        return int(layout(name, tuple(shape)))
+    if isinstance(layout, dict):
+        return int(layout.get(name, 0))
+    if layout == "axis0":
+        return 0
+    raise ValueError(f"unknown reshard layout {layout!r} (expected "
+                     f"'axis0', a name->axis dict, or a callable)")
+
+
+def _shard_file(q: int, n: int) -> str:
+    return f"shard_{q:05d}-of-{n:05d}.npz"
+
+
+def reshard(manifest: dict, n_to: int, layout: Layout = "axis0") -> dict:
+    """PURE reshard plan: map every array of an N-sharded manifest onto
+    ``n_to`` shard files.  Returns a new manifest skeleton (entries with
+    piece assignments, ``num_processes``, carried-over meta) whose
+    ``crc`` map is empty — the IO driver fills it as it writes each
+    shard file.  Arrays split along ``layout``'s axis (axis 0 by
+    default, the dp row convention) into contiguous chunks; an array
+    too small to split (0-d, or extent < the shard index) simply
+    contributes no piece to the tail shards and lands whole-or-partial
+    on the head ones — ``load_state`` reassembles from pieces
+    regardless of which file holds them."""
+    n_to = int(n_to)
+    if n_to < 1:
+        raise ValueError(f"reshard: n_to must be >= 1, got {n_to}")
+    entries: Dict[str, dict] = {}
+    for name, e in manifest["entries"].items():
+        shape = tuple(int(s) for s in e["shape"])
+        pcs = []
+        if not shape or shape[0] == 0 or n_to == 1:
+            pcs.append({"key": f"{name}@0",
+                        "index": [(0, s) for s in shape],
+                        "shard": _shard_file(0, n_to)})
+        else:
+            ax = _split_axis(name, shape, layout)
+            if not (0 <= ax < len(shape)):
+                raise ValueError(
+                    f"reshard: layout axis {ax} out of range for "
+                    f"{name!r} with shape {shape}")
+            for q, (a, b) in enumerate(_split_ranges(shape[ax], n_to)):
+                if a == b:
+                    continue          # more shards than rows: skip
+                idx = [(0, s) for s in shape]
+                idx[ax] = (a, b)
+                pcs.append({"key": f"{name}@0", "index": idx,
+                            "shard": _shard_file(q, n_to)})
+        entries[name] = {"shape": list(shape), "dtype": e["dtype"],
+                         "pieces": pcs}
+    return {"entries": entries, "crc": {},
+            "meta": dict(manifest.get("meta", {})),
+            "num_processes": n_to}
+
+
+def reshard_state(dirname: str, state: Dict[str, Any],
+                  meta: Optional[dict], n_to: int,
+                  layout: Layout = "axis0"):
+    """Write ``state`` (full host arrays) as an ``n_to``-sharded
+    checkpoint into ``dirname`` — shard files first, manifest LAST as
+    the commit point (the save_state discipline), so a crash mid-write
+    leaves an invalid directory, never a half-committed one."""
+    src_entries = {}
+    for name, value in state.items():
+        arr = np.asarray(value)
+        src_entries[name] = {"shape": list(arr.shape),
+                             "dtype": arr.dtype.name, "pieces": []}
+    plan = reshard({"entries": src_entries, "meta": meta or {}},
+                   n_to, layout)
+    os.makedirs(dirname, exist_ok=True)
+    crcs: Dict[str, int] = {}
+    # bucket pieces per destination shard file
+    by_shard: Dict[str, list] = {}
+    for name, e in plan["entries"].items():
+        for pc in e["pieces"]:
+            by_shard.setdefault(pc["shard"], []).append((name, pc))
+    for q in range(n_to):
+        shard_name = _shard_file(q, n_to)
+        arrays = {}
+        for name, pc in by_shard.get(shard_name, ()):
+            arr = np.asarray(state[name])
+            if arr.dtype.name == "bfloat16":
+                arr = arr.astype(np.float32)
+            sl = tuple(slice(a, b) for a, b in pc["index"])
+            arrays[pc["key"]] = arr[sl]
+        tmp = os.path.join(dirname, shard_name + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        with open(tmp, "rb") as f:
+            crcs[shard_name] = zlib.crc32(f.read())
+        shard_path = os.path.join(dirname, shard_name)
+        os.replace(tmp, shard_path)
+        # torn-write site (PR 2 idiom): truncate the committed shard so
+        # it no longer matches the CRC the manifest is about to record
+        # — resume must skip this serial and fall back to the source
+        chaos.corrupt_file("checkpoint.reshard_write", shard_path)
+    plan["crc"] = crcs
+    mtmp = os.path.join(dirname, MANIFEST + ".tmp")
+    with open(mtmp, "w") as f:
+        json.dump(plan, f)
+    os.replace(mtmp, os.path.join(dirname, MANIFEST))
+
+
+def reshard_checkpoint(root: str, n_to: int,
+                       serial: Optional[int] = None,
+                       layout: Layout = "axis0") -> int:
+    """Gather the newest valid checkpoint (or ``serial``) under
+    ``root`` and re-commit it as an ``n_to``-sharded NEW serial; the
+    source serial is never touched.  Returns the new serial.  If the
+    reshard tears mid-commit, the new serial has no (or a mismatched)
+    manifest — ``latest_checkpoint`` skips it with a warning and the
+    fleet resumes from the pre-resize checkpoint."""
+    src = latest_checkpoint(root) if serial is None else int(serial)
+    if src < 0:
+        raise CheckpointCorrupt(f"no valid checkpoint under {root} "
+                                f"to reshard")
+    state, meta = load_state(_serial_dir(root, src))
+    meta = dict(meta)
+    meta["resharded_from"] = src
+    new_serial = latest_checkpoint(root, require_valid=False) + 1
+    reshard_state(_serial_dir(root, new_serial), state, meta, n_to,
+                  layout)
+    return new_serial
+
+
 # -- serial-numbered rotation (ref contrib/trainer.py:663,763) -------------
 
 def _serial_dir(root: str, serial: int) -> str:
@@ -268,7 +426,9 @@ def save_checkpoint(root: str, state: Dict[str, Any],
 
 def latest_checkpoint(root: str, require_valid: bool = True) -> int:
     """Newest serial; with require_valid, newest whose CRCs verify —
-    a torn/corrupt checkpoint is skipped so resume falls back."""
+    a torn/corrupt checkpoint (e.g. a reshard that died mid-commit) is
+    skipped with a loud warning so resume falls back instead of
+    bricking the start (the PR 12 corrupt-entry idiom)."""
     if not os.path.isdir(root):
         return -1
     serials = sorted(
@@ -278,6 +438,10 @@ def latest_checkpoint(root: str, require_valid: bool = True) -> int:
     for s in serials:
         if not require_valid or is_valid(_serial_dir(root, s)):
             return s
+        warnings.warn(
+            f"checkpoint {_serial_dir(root, s)} is torn or corrupt "
+            f"(missing manifest or CRC mismatch); falling back to the "
+            f"next older valid serial", RuntimeWarning, stacklevel=2)
     return -1
 
 

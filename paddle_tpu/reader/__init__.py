@@ -1,5 +1,5 @@
 """Reader pipeline (ref python/paddle/reader/)."""
 from .decorator import (DeviceBatch, PipeReader, batch, buffered, cache,
-                        chain, compose, device_prefetch, firstn,
-                        map_readers, multiprocess_reader, shuffle,
-                        xmap_readers)
+                        chain, compose, device_prefetch, elastic_shard,
+                        elastic_watermark, firstn, map_readers,
+                        multiprocess_reader, shuffle, xmap_readers)

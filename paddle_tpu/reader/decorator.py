@@ -42,7 +42,51 @@ _MAX_ANON_BUFFERED_LABELS = 64
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
            "firstn", "xmap_readers", "multiprocess_reader", "batch",
-           "cache", "PipeReader", "DeviceBatch", "device_prefetch"]
+           "cache", "PipeReader", "DeviceBatch", "device_prefetch",
+           "elastic_shard", "elastic_watermark"]
+
+
+def elastic_shard(reader, world: int, rank: int, start: int = 0):
+    """Partition one GLOBAL example stream across an elastic fleet
+    (ISSUE 14): fast-forward past the first ``start`` examples (the
+    watermark everything already consumed before a resize), then yield
+    the round-robin share of the remainder — global index ``i`` goes to
+    ``(i - start) % world == rank``.
+
+    The elastic-resize discipline: resizes land at epoch (or other
+    all-ranks-agree) boundaries, where every rank has consumed the same
+    number of ROUNDS ``r`` — so the fleet-wide watermark is
+    ``start + r * world``.  Re-partitioning the stream from that
+    watermark under a new world size hands every remaining example to
+    exactly one rank and repeats none: N→M resizes drop nothing and
+    double-consume nothing (regression-tested in
+    tests/test_reader_trainer.py).
+
+    A checkpoint records the watermark, not per-rank offsets: compute
+    it with the companion :func:`elastic_watermark` from the per-rank
+    rounds consumed."""
+    world, rank, start = int(world), int(rank), int(start)
+    if not (0 <= rank < world):
+        raise ValueError(f"elastic_shard: rank {rank} outside world "
+                         f"{world}")
+    if start < 0:
+        raise ValueError(f"elastic_shard: negative start {start}")
+
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i < start:
+                continue                       # fast-forward
+            if (i - start) % world == rank:
+                yield item
+    return data_reader
+
+
+def elastic_watermark(start: int, rounds: int, world: int) -> int:
+    """The global consumed-through watermark after ``rounds`` per-rank
+    items under ``world`` ranks from ``start`` — the value to feed the
+    next :func:`elastic_shard` as its ``start`` after a resize at a
+    rank-aligned boundary."""
+    return int(start) + int(rounds) * int(world)
 
 
 class DeviceBatch:

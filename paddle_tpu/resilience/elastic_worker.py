@@ -24,9 +24,21 @@ master endpoints (failover order).  The worker
   hard-kills it;
 * presents its lease on every ack: a ``fenced`` reply (the task was
   re-leased while we were dead/slow) is counted, never treated as a
-  completion.
+  completion;
+* honours the master's elastic directives (ISSUE 14): a ``retire``
+  reply (the fleet shrank past this rank at an epoch boundary) makes
+  it say goodbye and exit :data:`RETIRED_RC` with its cumulative state
+  reported — a later grow revives the rank from its checkpoint;
+  ``wait_resize``
+  (joined under a pending grow) just keeps polling until the boundary.
+  ``PTPU_FLEET_WORLD_SIZE`` (threaded by the supervisor) overrides the
+  launch-argv world so a respawned incarnation joins the CURRENT
+  fleet, not the original one.
 
-Exit code 0 = this rank saw the job through to ``complete``.
+Exit code 0 = this rank saw the job through to ``complete``;
+:data:`RETIRED_RC` = it was retired by a shrink (the supervisor parks
+the rank instead of counting it done — the exit-code convention is
+what lets a later grow revive it race-free).
 """
 from __future__ import annotations
 
@@ -34,6 +46,10 @@ import json
 import os
 import sys
 import time
+
+# distinct from 0 (job complete) and from crash codes: tells the
+# supervisor this rank retired on the master's shrink directive
+RETIRED_RC = 3
 
 
 def _apply(w, shard: str, epoch: int):
@@ -84,6 +100,10 @@ def main(argv=None) -> int:
         return 2
     endpoints, world, rank, out_path, ckpt_dir = argv
     world, rank = int(world), int(rank)
+    # the supervisor threads the LIVE fleet target through the env
+    # (ISSUE 14 bugfix): a worker respawned after a resize must join
+    # the current world, not the launch-time one baked into its argv
+    world = int(os.environ.get("PTPU_FLEET_WORLD_SIZE", world))
     restart_count = int(os.environ.get("PTPU_WORKER_RESTART_COUNT", "0"))
 
     import numpy as np
@@ -104,15 +124,32 @@ def main(argv=None) -> int:
     # task recorded in the meta reconciles against the master's ledger.
     w = np.zeros(16, dtype="float64")
     applied = 0
+    # every (shard, epoch) pair this rank's state currently counts —
+    # the reader-example ledger: the soak sums these across the fleet's
+    # final reports and asserts each pair appears EXACTLY once, i.e. no
+    # example was dropped or double-consumed across resizes/restarts
+    consumed = []
     resumed = False
+    retired = False
     serial = ckpt.latest_checkpoint(ckpt_dir) if os.path.isdir(ckpt_dir) \
         else -1
     if serial >= 0:
         state, meta, _ = ckpt.load_checkpoint(ckpt_dir, serial)
         w = np.asarray(state["w"], dtype="float64")
         applied = int(meta.get("applied", 0))
+        consumed = [list(c) for c in meta.get("consumed", [])]
+        before = applied
         w, applied = reconcile_in_flight(w, applied, meta,
                                          client.ledger())
+        if applied != before:
+            # the in-flight task never committed: its pairs re-run
+            # elsewhere, so they leave this rank's consumed record too
+            inf = meta["in_flight"]
+            for sh in inf["shards"]:
+                try:
+                    consumed.remove([sh, inf["epoch"]])
+                except ValueError:
+                    pass
         resumed = True
     completed, fenced_acks, failed_acks = [], 0, 0
     generations = set()
@@ -124,7 +161,16 @@ def main(argv=None) -> int:
             if t is None:
                 if client.job_complete:
                     break
-                time.sleep(0.05)     # all work leased elsewhere: spin
+                if client.retire:
+                    # the fleet shrank past this rank (ISSUE 14): say
+                    # goodbye and exit RETIRED_RC (the supervisor
+                    # parks, not restarts) — the checkpoint stays so a
+                    # later grow revives this rank with its state
+                    retired = True
+                    break
+                # all work leased elsewhere, or waiting out a pending
+                # grow (client.wait_resize): spin
+                time.sleep(0.05)
                 continue
             # the hard-death fault point: an armed exit schedule kills
             # this process HERE, mid-task, lease held — the master's
@@ -133,12 +179,14 @@ def main(argv=None) -> int:
             chaos.trigger("trainer.step")
             for sh in t.shards:
                 w = _apply(w, sh, t.epoch)
+                consumed.append([sh, t.epoch])
             applied += len(t.shards)
             # the meta carries the not-yet-acked task: a crash between
             # this save and the ack is resolved at resume by
             # reconcile_in_flight (ledger truth), never double-applied
             ckpt.save_checkpoint(ckpt_dir, {"w": w},
                                  {"applied": applied, "rank": rank,
+                                  "consumed": consumed,
                                   "in_flight": {
                                       "task_id": t.task_id,
                                       "epoch": t.epoch,
@@ -157,11 +205,17 @@ def main(argv=None) -> int:
                 fenced_acks += 1
                 w = _unapply(w, t.shards, t.epoch)
                 applied -= len(t.shards)
+                for sh in t.shards:
+                    try:
+                        consumed.remove([sh, t.epoch])
+                    except ValueError:
+                        pass
                 # the pre-rollback state is already on disk: overwrite
                 # it so a later resume can't resurrect the fenced update
                 ckpt.save_checkpoint(ckpt_dir, {"w": w},
-                                     {"applied": applied,
-                                      "rank": rank}, max_keep=2)
+                                     {"applied": applied, "rank": rank,
+                                      "consumed": consumed},
+                                     max_keep=2)
             else:
                 failed_acks += 1
     finally:
@@ -169,9 +223,11 @@ def main(argv=None) -> int:
         client.close()
 
     with open(out_path, "w") as f:
-        json.dump({"rank": rank, "restart_count": restart_count,
-                   "resumed": resumed,
+        json.dump({"rank": rank, "world": world,
+                   "restart_count": restart_count,
+                   "resumed": resumed, "retired": retired,
                    "completed": completed,
+                   "consumed": consumed,
                    "fenced_acks": fenced_acks,
                    "failed_acks": failed_acks,
                    "hb_re_registrations": hb.re_registrations,
@@ -179,8 +235,9 @@ def main(argv=None) -> int:
                    "w_sum": float(w.sum()),
                    "chaos_spec": flags.get_flag("chaos_spec")}, f)
     print(f"ELASTIC_WORKER_OK rank={rank} completed={len(completed)} "
-          f"fenced={fenced_acks} restarts={restart_count}")
-    return 0
+          f"fenced={fenced_acks} restarts={restart_count} "
+          f"retired={retired}")
+    return RETIRED_RC if retired else 0
 
 
 if __name__ == "__main__":

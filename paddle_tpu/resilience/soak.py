@@ -14,14 +14,29 @@ exactly-once:
 * ``rpc_refuse``      — chaos ``refuse`` opens connection-refused
   windows at the RPC site; clients back off / re-dial through them.
 * ``combined``        — all of the above in one run.
+* ``fixed``           — no faults, no resizes: the fixed-fleet baseline
+  the elastic runs are compared against.
+* ``resize_grow`` / ``resize_shrink`` / ``resize_combined`` — elastic
+  resizes (ISSUE 14): the task master's ``request_resize`` drains the
+  current epoch, then the fleet grows (2→3), shrinks (2→1), or grows
+  while chaos kill-9s rank 0 (``resize_combined``); the supervisor
+  spawns/retires rank processes to match.
+* ``resize_soak``     — the headline: 2→4→1→3 across four epochs.
 
 Every schedule asserts: all workers exit 0 inside the deadline, every
 (task, epoch) pair completes EXACTLY once in the master's persisted
 ledger, fenced acks were rejected (never recorded), and — per
 schedule — the dead worker was restarted within its backoff budget /
-the generation bumped.  The same :func:`run_schedule` body backs the
-tier-1 e2e test (tests/test_elastic.py) and the ``slow``-marked soak
-lane.
+the generation bumped.  Resize schedules additionally assert the fleet
+landed on the planned final world, epochs after a shrink were worked
+ONLY by surviving ranks, the fleet-summed end state equals the
+fixed-fleet value (:func:`expected_w_total` — the stand-in training
+update is commutative, so exactly-once processing implies equality),
+and the union of per-rank ``consumed`` records covers every
+(shard, epoch) reader example exactly once — nothing dropped, nothing
+double-consumed across resizes.  The same :func:`run_schedule` body
+backs the tier-1 e2e tests (tests/test_elastic.py, tests/
+test_resize.py) and the ``slow``-marked soak lane.
 """
 from __future__ import annotations
 
@@ -36,7 +51,19 @@ from typing import Dict, List, Optional
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
-SCHEDULES = ("worker_kill", "master_restart", "rpc_refuse", "combined")
+SCHEDULES = ("worker_kill", "master_restart", "rpc_refuse", "combined",
+             "fixed", "resize_grow", "resize_shrink", "resize_combined",
+             "resize_soak")
+
+# world-size plan per resize schedule: one entry per epoch BOUNDARY
+# (requested mid-epoch, applied when the epoch drains), so a plan of
+# length k needs at least k+1 epochs
+RESIZE_PLANS = {
+    "resize_grow": (3,),
+    "resize_shrink": (1,),
+    "resize_combined": (3,),
+    "resize_soak": (4, 1, 3),
+}
 
 # master timing: the heartbeat reaper (worker death -> immediate
 # requeue) must be what recovers leases, not the per-task timeout —
@@ -88,6 +115,50 @@ def worker_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     return env
 
 
+def expected_w_total(n_tasks: int, epochs: int) -> float:
+    """The fixed-fleet end state for a clean run over ``n_tasks``
+    shards x ``epochs``: the elastic worker's stand-in update is a
+    commutative pure sum of per-(shard, epoch) contributions, so ANY
+    fleet — fixed, resized, chaos-restarted — that processes each pair
+    exactly once lands on this value.  Computed with the worker's OWN
+    ``_apply`` (not a re-derived formula) so the oracle is
+    definitionally consistent with what the fleet runs.  The resize
+    schedules assert their fleet-summed end state equals it: the
+    'same final loss as a fixed-fleet run' check."""
+    import numpy as np
+
+    from paddle_tpu.resilience.elastic_worker import _apply
+    w = np.zeros(16, dtype="float64")
+    for i in range(n_tasks):
+        for ep in range(epochs):
+            w = _apply(w, f"shard-{i:03d}", ep)
+    return float(w.sum())
+
+
+def check_consumed(workers: List[dict], n_tasks: int,
+                   epochs: int) -> List[str]:
+    """Reader-example exactly-once: the union of per-rank ``consumed``
+    records (each rank's checkpointed multiset of applied (shard,
+    epoch) pairs, reconciled against the ledger across restarts and
+    resizes) must cover every pair exactly once."""
+    from collections import Counter
+    seen = Counter(tuple(c) for r in workers
+                   for c in r.get("consumed", []))
+    problems = []
+    dups = sorted(k for k, v in seen.items() if v > 1)
+    if dups:
+        problems.append(f"reader examples double-consumed: {dups}")
+    want = {(f"shard-{i:03d}", ep)
+            for i in range(n_tasks) for ep in range(epochs)}
+    missing = sorted(want - set(seen))
+    if missing:
+        problems.append(f"reader examples lost: {missing}")
+    extra = sorted(set(seen) - want)
+    if extra:
+        problems.append(f"unexpected reader examples: {extra}")
+    return problems
+
+
 def check_ledger(ledger: List[dict], n_tasks: int,
                  epochs: int) -> List[str]:
     """Exactly-once: every (task, epoch) pair completed once, none
@@ -124,6 +195,11 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
     if name not in SCHEDULES:
         raise ValueError(f"unknown schedule {name!r} "
                          f"(expected one of {SCHEDULES})")
+    resize_plan = list(RESIZE_PLANS.get(name, ()))
+    if resize_plan:
+        # one boundary per planned world; the final world needs an
+        # epoch of its own to prove it actually trains
+        epochs = max(epochs, len(resize_plan) + 1)
     os.makedirs(workdir, exist_ok=True)
     t_start = time.time()
     port = _free_port()
@@ -138,16 +214,20 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
                           lease_timeout=_LEASE_TIMEOUT,
                           snapshot_interval=0.0,
                           worker_timeout=_WORKER_TIMEOUT,
-                          num_epochs=epochs)
+                          num_epochs=epochs,
+                          world_size=world if resize_plan else 0)
 
     master = _master()
     master.set_dataset([f"shard-{i:03d}" for i in range(n_tasks)])
     srv, _ = serve_master(master, port=port)
 
-    kill_rank0 = name in ("worker_kill", "combined")
+    kill_rank0 = name in ("worker_kill", "combined", "resize_combined")
     restart_master = name in ("master_restart", "combined")
     refuse = name in ("rpc_refuse", "combined")
 
+    # ranks that will ever exist: the launch fleet plus every grow
+    # target — out/checkpoint paths are per-rank for the whole run
+    max_world = max([world] + resize_plan)
     envs: List[Optional[Dict[str, str]]] = [None] * world
     if kill_rank0:
         # die on the 2nd or 3rd leased task (mid-epoch, at least one
@@ -168,16 +248,21 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
         envs[rank] = cur
 
     outs = [os.path.join(workdir, f"worker_{r}.json")
-            for r in range(world)]
+            for r in range(max_world)]
+
+    def _cmd(rank: int) -> List[str]:
+        return worker_cmd(endpoints, world, rank, outs[rank],
+                          os.path.join(workdir, f"ckpt_r{rank}"))
+
+    from paddle_tpu.resilience.elastic_worker import RETIRED_RC
     sup = Supervisor(
-        cmds=[worker_cmd(endpoints, world, r, outs[r],
-                         os.path.join(workdir, f"ckpt_r{r}"))
-              for r in range(world)],
+        cmds=[_cmd(r) for r in range(world)],
         env=worker_env(), envs=envs, cwd=REPO_ROOT,
-        log_dir=workdir)
+        log_dir=workdir, cmd_factory=_cmd, retire_rc=RETIRED_RC)
     sup.start()
 
     generation_after = master.generation
+    resizes_applied = 0
     try:
         if restart_master:
             # wait for real progress, then bounce the coordinator on
@@ -190,8 +275,20 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
             srv.shutdown()
             master = _master()       # recovers from the snapshot
             srv, _ = serve_master(master, port=port)
+        # the resize driver: request each planned world, mirror it on
+        # the supervisor (growth spawns now — new ranks wait out the
+        # epoch; shrink is worker-side retirement), then wait for the
+        # epoch boundary to flip it live before the next step
+        for i, w_new in enumerate(resize_plan):
+            master.request_resize(w_new)
+            sup.set_world_size(w_new)
+            deadline = time.time() + timeout / 2
+            while master.resizes < i + 1 and time.time() < deadline:
+                time.sleep(0.02)
+            resizes_applied = master.resizes
         finished = sup.wait(timeout=timeout)
         generation_after = master.generation
+        resizes_applied = master.resizes
         ledger = master.ledger_entries()
         stats = master.stats()
     finally:
@@ -210,18 +307,73 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
     if restart_master and generation_after < 2:
         problems.append(f"master generation did not bump "
                         f"(still {generation_after})")
+    # ranks ever in the fleet (launch set + every grow target) each
+    # leave a final report; retired ranks' reports carry their share
+    # of the end state
+    spawned = set(range(world))
+    for t in resize_plan:
+        spawned |= set(range(t))
     workers = []
-    for out in outs:
-        if os.path.exists(out):
-            with open(out) as f:
+    for r in sorted(spawned):
+        if os.path.exists(outs[r]):
+            with open(outs[r]) as f:
                 workers.append(json.load(f))
         else:
-            problems.append(f"missing worker report {out}")
+            problems.append(f"missing worker report {outs[r]}")
+    w_total = sum(w["w_sum"] for w in workers)
+    expected_total = expected_w_total(n_tasks, epochs)
+    if resize_plan:
+        if resizes_applied < len(resize_plan):
+            problems.append(
+                f"only {resizes_applied}/{len(resize_plan)} resizes "
+                f"applied (epoch boundary never drained?)")
+        elif stats["target_world_size"] != resize_plan[-1]:
+            problems.append(
+                f"fleet landed on world "
+                f"{stats['target_world_size']}, plan said "
+                f"{resize_plan[-1]}")
+        # the master's resize_log records the FIRST epoch each new
+        # world governed (epoch boundaries can outpace the driver, so
+        # the plan alone doesn't pin which epoch maps to which world);
+        # a completion by a rank outside its epoch's world means a
+        # shrink leaked leases
+        log = stats.get("resize_log", [])
+        applied_worlds = [r["new"] for r in log]
+        if applied_worlds != resize_plan[:len(applied_worlds)]:
+            problems.append(f"resizes applied out of order: "
+                            f"{log} vs plan {resize_plan}")
+
+        def world_at(epoch):
+            w_cur = world
+            for r in log:
+                if epoch >= r["epoch"]:
+                    w_cur = r["new"]
+            return w_cur
+
+        bad = [e for e in ledger
+               if e.get("worker") is not None
+               and e["worker"] >= world_at(e["epoch"])]
+        if bad:
+            problems.append(f"completions by out-of-world ranks: "
+                            f"{bad}")
+    if resize_plan or name == "fixed":
+        # the 'same final loss' check: commutative updates + exactly-
+        # once processing => the fleet sum equals the fixed-fleet value
+        if abs(w_total - expected_total) > 1e-6:
+            problems.append(
+                f"fleet end state {w_total!r} != fixed-fleet "
+                f"{expected_total!r} (examples lost or "
+                f"double-applied)")
+        problems += check_consumed(workers, n_tasks, epochs)
     return {"schedule": name, "ok": not problems, "problems": problems,
             "seed": seed, "world": world, "n_tasks": n_tasks,
             "epochs": epochs, "ledger_entries": len(ledger),
             "restarts": dict(sup.restarts),
+            "spawns": dict(sup.spawns),
+            "resize_plan": resize_plan,
+            "resizes_applied": resizes_applied,
             "generation": generation_after,
+            "w_total": w_total, "expected_w_total": expected_total,
             "stats": stats, "workers": workers,
             "duration_s": round(time.time() - t_start, 2)}
 
@@ -262,9 +414,12 @@ def _main(argv: Optional[List[str]] = None) -> int:
                            timeout=args.timeout)
         reports.append(rep)
         verdict = "PASS" if rep["ok"] else "FAIL"
+        resize = (f" resizes={rep['resizes_applied']}/"
+                  f"{len(rep['resize_plan'])}" if rep["resize_plan"]
+                  else "")
         print(f"[{verdict}] {name:<16} ledger={rep['ledger_entries']} "
-              f"restarts={rep['restarts']} gen={rep['generation']} "
-              f"{rep['duration_s']}s")
+              f"restarts={rep['restarts']} gen={rep['generation']}"
+              f"{resize} {rep['duration_s']}s")
         for p in rep["problems"]:
             print(f"         - {p}")
     if args.out:

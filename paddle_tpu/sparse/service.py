@@ -27,11 +27,32 @@ the PR 5 lease/ledger era carry over:
 
 Metrics: ``sparse_rows_pulled_total{table}``,
 ``sparse_rows_pushed_total{table}``, ``sparse_staleness_steps``,
-``sparse_push_rejected_total{reason}``, ``sparse_table_version{table}``.
+``sparse_push_rejected_total{reason}``, ``sparse_table_version{table}``,
+``sparse_snapshot_corrupt_total``.
+
+Restart persistence (ISSUE 14 satellite, the PR 13 follow-up): give
+the service a ``snapshot_path`` and every applied push is durable
+BEFORE its reply (process-crash scope, the repo-wide discipline — see
+``_wal_append``) — at O(push), not O(table): the push's merged
+SelectedRows gradient appends to a CRC-per-line write-ahead log
+(``<snapshot_path>.wal``), while full table snapshots (tables + push
+ledger, CRC-framed, atomic-rename — the task-master discipline) are
+throttled by ``snapshot_interval`` and truncate the WAL they subsume.
+Recovery loads the snapshot then re-applies the WAL's gradients (pure
+deterministic numpy — bit-identical to the pre-crash state), so a push
+re-delivered across the restart still dedupes against the ledger
+instead of double-applying; a corrupt snapshot falls back to a FRESH
+state with a loud warning, and a torn WAL tail stops replay at the
+tear — never a bricked restart.
 """
 from __future__ import annotations
 
+import json
+import os
 import threading
+import time
+import warnings
+import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
@@ -70,6 +91,11 @@ _m_version = obs_metrics.gauge(
     "sparse_table_version",
     "Applied-push version of each sparse table on this shard.",
     ("table",))
+_m_snapshot_corrupt = obs_metrics.counter(
+    "sparse_snapshot_corrupt_total",
+    "Sparse shard snapshots that failed CRC/parse at recovery; the "
+    "service fell back to a fresh state instead of bricking the "
+    "restart.")
 
 
 class SparseShardService:
@@ -83,7 +109,9 @@ class SparseShardService:
 
     def __init__(self, shard_id: int = 0, num_shards: int = 1,
                  staleness_bound: Optional[int] = None,
-                 ledger_size: Optional[int] = None):
+                 ledger_size: Optional[int] = None,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_interval: float = 5.0):
         self.shard_id = int(shard_id)
         self.num_shards = int(num_shards)
         self._staleness_bound = staleness_bound
@@ -96,12 +124,231 @@ class SparseShardService:
         # oldest-first eviction)
         self._push_ledger: "OrderedDict[str, int]" = OrderedDict()
         self.stale_rejections = 0
+        # restart persistence: every applied push is durable before
+        # its reply via an O(push) WAL append; FULL table snapshots
+        # are throttled by snapshot_interval (0 = full snapshot every
+        # push — test/debug only, it serializes whole tables) and
+        # truncate the WAL they subsume
+        self.snapshot_path = snapshot_path
+        self.snapshot_interval = float(snapshot_interval)
+        self._last_snapshot = 0.0
+        self._wal_f = None
+        self._snap_pending = False
+        if snapshot_path and os.path.exists(snapshot_path):
+            if self._recover():
+                self._replay_wal()
+            else:
+                # corrupt snapshot: do NOT replay the WAL onto the
+                # fresh state — with no tables the gradients can't
+                # apply, and inserting their push_ids into the ledger
+                # would dedupe the re-delivered pushes whose updates
+                # were never applied (silent loss).  Set the stale WAL
+                # aside so the fresh incarnation's version timeline
+                # starts clean and those pushes re-apply on
+                # re-delivery, as the corrupt-snapshot warning promises
+                for suffix in ("", ".old"):
+                    p = self._wal_path() + suffix
+                    try:
+                        if os.path.exists(p):
+                            os.replace(p, p + ".corrupt")
+                    except OSError:
+                        pass
 
     @property
     def staleness_bound(self) -> int:
         if self._staleness_bound is not None:
             return int(self._staleness_bound)
         return int(flags.get_flag("sparse_staleness_bound"))
+
+    # -- restart persistence ----------------------------------------------
+    def _wal_path(self) -> str:
+        return self.snapshot_path + ".wal"
+
+    def _snapshot(self, force: bool = False):
+        """FULL tables + push ledger persistence (call under the
+        lock).  Under the lock only the CHEAP part happens: np-copied
+        table views (memcpy) and a WAL rotation; the O(table) JSON
+        serialization + write run OUTSIDE the lock — on a background
+        thread unless ``force`` — so the push/pull path never stalls
+        behind a snapshot (review finding).  Single-flight: while one
+        snapshot is still writing, due snapshots are skipped (the WAL
+        keeps every push durable meanwhile) — except ``force``, which
+        WAITS the in-flight write out: forced snapshots (init_tables'
+        table creation has no WAL record) must never be dropped."""
+        if not self.snapshot_path:
+            return
+        if self._snap_pending:
+            if not force:
+                return
+            deadline = time.time() + 30.0
+            while self._snap_pending and time.time() < deadline:
+                time.sleep(0.005)
+        now = time.time()
+        if not force and self.snapshot_interval > 0 \
+                and now - self._last_snapshot < self.snapshot_interval:
+            return
+        self._last_snapshot = now
+        self._snap_pending = True
+        view = {"shard_id": self.shard_id,
+                "num_shards": self.num_shards,
+                "stale_rejections": self.stale_rejections,
+                "ledger": list(self._push_ledger.items()),
+                "tables": {name: t.state_view()
+                           for name, t in self.tables.items()}}
+        # rotate the WAL: everything appended so far is subsumed by
+        # this view; new pushes land in a fresh file.  Single-flight
+        # guarantees `.old` is gone (removed by the previous write)
+        # before the next rotation.
+        if self._wal_f is not None:
+            self._wal_f.close()
+            self._wal_f = None
+        wal = self._wal_path()
+        try:
+            if os.path.exists(wal):
+                os.replace(wal, wal + ".old")
+        except OSError:
+            pass
+        if force:
+            self._write_snapshot(view)
+        else:
+            threading.Thread(target=self._write_snapshot, args=(view,),
+                             daemon=True,
+                             name="sparse-snapshot").start()
+
+    def _write_snapshot(self, view: dict):
+        """Serialize + atomically commit one snapshot view, then drop
+        the rotated WAL it subsumes.  Runs OUTSIDE the service lock.
+        The task-master discipline: serialized once, CRC'd as bytes,
+        unique-temp + atomic rename."""
+        try:
+            tables = {name: {k: (v.tolist()
+                                 if isinstance(v, np.ndarray) else v)
+                             for k, v in tview.items()}
+                      for name, tview in view["tables"].items()}
+            payload = json.dumps({**view, "tables": tables})
+            doc = {"v": 1, "crc": zlib.crc32(payload.encode()),
+                   "state": payload}
+            tmp = f"{self.snapshot_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.snapshot_path)
+            # the committed snapshot holds everything the rotated WAL
+            # recorded; a crash BEFORE this remove replays `.old`
+            # entries the snapshot already has — the ledger/version
+            # guards in _replay_wal skip them
+            try:
+                os.remove(self._wal_path() + ".old")
+            except OSError:
+                pass
+        finally:
+            self._snap_pending = False
+
+    def _wal_append(self, entry: dict):
+        """One applied push → one CRC-framed JSON line, flushed before
+        the RPC reply: O(push size), the durable-before-reply lever.
+        Durability scope is PROCESS crash (the repo-wide discipline —
+        the task master's snapshot is likewise fsync-free): flush()
+        hands the line to the OS, an OS/power crash can still lose the
+        tail — add os.fsync here if that scope ever tightens."""
+        if not self.snapshot_path:
+            return
+        payload = json.dumps(entry)
+        if self._wal_f is None:
+            self._wal_f = open(self._wal_path(), "a")
+        self._wal_f.write(json.dumps(
+            {"crc": zlib.crc32(payload.encode()), "e": payload}) + "\n")
+        self._wal_f.flush()
+
+    def _replay_wal(self):
+        """Re-apply WAL gradients on top of the recovered snapshot
+        (pure deterministic numpy — bit-identical to the pre-crash
+        state).  The rotated ``.old`` file (a snapshot commit that
+        never finished) replays first, then the live WAL; entries the
+        snapshot already holds skip via the ledger/version guards, and
+        a torn tail (crash mid-append) stops that file's replay at the
+        tear with a warning."""
+        replayed = 0
+        for path in (self._wal_path() + ".old", self._wal_path()):
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for ln, line in enumerate(f):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                        payload = doc["e"]
+                        if zlib.crc32(payload.encode()) != doc["crc"]:
+                            raise ValueError("WAL line CRC mismatch")
+                        e = json.loads(payload)
+                        push_id, table = e["push_id"], e["table"]
+                    except (ValueError, KeyError, TypeError) as exc:
+                        warnings.warn(
+                            f"sparse shard WAL {path!r} torn at line "
+                            f"{ln} ({exc}); replay stops here — "
+                            f"earlier entries applied, the torn push "
+                            f"re-applies on re-delivery",
+                            RuntimeWarning, stacklevel=3)
+                        break
+                    if push_id in self._push_ledger:
+                        continue         # snapshot already holds it
+                    t = self.tables.get(table)
+                    if t is None:
+                        # no table to apply to (shouldn't happen when
+                        # recovery succeeded — tables snapshot at
+                        # init): do NOT ledger it, or the re-delivery
+                        # would dedupe an update that never applied
+                        continue
+                    if e["version_after"] > t.version:
+                        t.apply(SelectedRows.from_wire(e["grad"]))
+                        replayed += 1
+                    # ledger lands whenever the effect is present
+                    # (just applied, or already in the snapshot)
+                    self._push_ledger[push_id] = int(e["rows_applied"])
+                    while len(self._push_ledger) > self._ledger_size:
+                        self._push_ledger.popitem(last=False)
+        if replayed:
+            for name, t in self.tables.items():
+                _m_version.labels(table=name).set(t.version)
+            obs_flight.record("sparse", "wal_replayed",
+                              entries=replayed)
+
+    def _recover(self) -> bool:
+        """Restore tables + push ledger from the snapshot; a corrupt
+        file (torn write, bit flip) falls back to a FRESH service with
+        a loud warning (returns False — the caller must then skip WAL
+        replay) — recovery failing at exactly the moment it matters is
+        the one unacceptable outcome (the task-master corrupt-snapshot
+        idiom)."""
+        try:
+            with open(self.snapshot_path) as f:
+                doc = json.load(f)
+            payload = doc["state"]
+            if zlib.crc32(payload.encode()) != doc["crc"]:
+                raise ValueError("snapshot CRC mismatch (torn or "
+                                 "bit-flipped write)")
+            state = json.loads(payload)
+            tables = {name: EmbeddingShard.from_state(d)
+                      for name, d in state["tables"].items()}
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            _m_snapshot_corrupt.inc()
+            obs_flight.record("sparse", "snapshot_corrupt",
+                              error=repr(e)[:200])
+            warnings.warn(
+                f"sparse shard snapshot {self.snapshot_path!r} is "
+                f"corrupt ({e}); recovering with a FRESH state — "
+                f"tables must be re-initialised and pushes this "
+                f"snapshot recorded will re-apply", RuntimeWarning,
+                stacklevel=3)
+            return False
+        self.tables = tables
+        self._push_ledger = OrderedDict(
+            (str(k), int(v)) for k, v in state.get("ledger", []))
+        self.stale_rejections = int(state.get("stale_rejections", 0))
+        for name, t in self.tables.items():
+            _m_version.labels(table=name).set(t.version)
+        return True
 
     # -- table lifecycle ---------------------------------------------------
     def init_tables(self, specs: List[TableConfig]) -> dict:
@@ -120,6 +367,7 @@ class SparseShardService:
                 self.tables[cfg.name] = EmbeddingShard(
                     cfg, self.shard_id, self.num_shards)
                 _m_version.labels(table=cfg.name).set(0)
+            self._snapshot(force=True)
             return {"tables": sorted(self.tables)}
 
     def _table(self, name: str) -> EmbeddingShard:
@@ -166,6 +414,15 @@ class SparseShardService:
             self._push_ledger[push_id] = n
             while len(self._push_ledger) > self._ledger_size:
                 self._push_ledger.popitem(last=False)
+            # durable BEFORE the reply: the exactly-once-across-restart
+            # guarantee needs this push on disk by the time the worker
+            # sees "ok" — an O(push) WAL append, with the O(table)
+            # full snapshot throttled behind it
+            self._wal_append({"push_id": push_id, "table": table,
+                              "grad": grad.to_wire(),
+                              "rows_applied": n,
+                              "version_after": t.version})
+            self._snapshot()
             return {"status": "ok", "rows_applied": n,
                     "staleness": staleness, "version": t.version}
 

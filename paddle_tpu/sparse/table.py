@@ -203,6 +203,49 @@ class EmbeddingShard:
         self.rows_pushed += int(loc.size)
         return int(loc.size)
 
+    # -- snapshot (ISSUE 14 satellite: service-restart persistence) --------
+    def state_view(self) -> dict:
+        """CHEAP copied view of this shard's mutable state (np memcpy
+        — taken under the service lock; the O(table) JSON serialization
+        happens OUTSIDE it, see SparseShardService._snapshot)."""
+        v = {"cfg": self.cfg.to_wire(), "shard_id": self.shard_id,
+             "num_shards": self.num_shards, "version": self.version,
+             "rows_pulled": self.rows_pulled,
+             "rows_pushed": self.rows_pushed}
+        if self._table is not None:
+            v["table"] = self._table.copy()
+        else:
+            v["codes"] = self._codes.copy()
+            v["scales"] = self._scales.copy()
+        if self._accum is not None:
+            v["accum"] = self._accum.copy()
+        return v
+
+    def state_doc(self) -> dict:
+        """JSON-able full state of this shard (values/codes, adagrad
+        accumulator, version, counters) — the SparseShardService
+        snapshots it alongside its push ledger so a restarted shard
+        still dedupes re-delivered pushes against the SAME table
+        state."""
+        return {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                for k, v in self.state_view().items()}
+
+    @classmethod
+    def from_state(cls, doc: dict) -> "EmbeddingShard":
+        t = cls(TableConfig.from_wire(doc["cfg"]),
+                int(doc["shard_id"]), int(doc["num_shards"]))
+        if "table" in doc:
+            t._table = np.asarray(doc["table"], np.float32)
+        else:
+            t._codes = np.asarray(doc["codes"], np.int8)
+            t._scales = np.asarray(doc["scales"], np.float32)
+        if "accum" in doc:
+            t._accum = np.asarray(doc["accum"], np.float32)
+        t.version = int(doc["version"])
+        t.rows_pulled = int(doc.get("rows_pulled", 0))
+        t.rows_pushed = int(doc.get("rows_pushed", 0))
+        return t
+
     def state_bytes(self) -> int:
         if self._table is not None:
             n = self._table.nbytes

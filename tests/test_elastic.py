@@ -672,10 +672,12 @@ def test_e2e_worker_kill_and_master_failover_exactly_once(tmp_path):
 @pytest.mark.slow
 def test_soak_matrix_all_schedules(tmp_path):
     """The full chaos matrix (worker kill / master restart / RPC refuse
-    / combined) through the CLI entry point — the CI soak lane."""
+    / combined, plus the fixed-fleet baseline and the ISSUE 14 resize
+    schedules incl. the 2→4→1→3 headline) through the CLI entry point —
+    the CI soak lane."""
     rc = soak._main(["--workdir", str(tmp_path), "--timeout", "120",
                      "--out", str(tmp_path / "report.json")])
     assert rc == 0
     rep = json.load(open(tmp_path / "report.json"))
-    assert len(rep["reports"]) == 4
+    assert len(rep["reports"]) == len(soak.SCHEDULES)
     assert all(r["ok"] for r in rep["reports"])

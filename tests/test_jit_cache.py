@@ -461,3 +461,87 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert jit_cache.main(["--dir", str(tmp_path), "--gc"]) == 0
     assert jit_cache.main(["--dir", str(tmp_path), "--purge"]) == 0
     flags.set_flag("jit_cache_dir", "")
+
+
+# --- mesh/sharding identity (ISSUE 14 satellite) ---------------------------
+
+def _build_mesh_model():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 21
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _mesh_feed():
+    rng = np.random.RandomState(4)
+    return {"x": rng.randn(8, 8).astype("float32"),
+            "y": rng.randn(8, 1).astype("float32")}
+
+
+def test_mesh_executor_warm_start_same_mesh(tmp_path):
+    """Mesh executors persist too: a fresh same-mesh executor resolves
+    its sharded executables from DISK — zero new compiles, silent
+    forensics, no cache errors (the resized-incarnation warm start)."""
+    from paddle_tpu.core.place import make_mesh
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    main, startup, loss = _build_mesh_model()
+    feed = _mesh_feed()
+    mesh = make_mesh((2,), ("data",))
+    scope = pt.Scope()
+    e0 = _tot("jit_cache_errors_total")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")      # any cache warn = failure
+        exe = pt.Executor(pt.CPUPlace(), scope=scope, mesh=mesh)
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        assert len(_entries(tmp_path)) == 2     # startup + main step
+        c0 = _tot("executor_compile_total")
+        f0 = len(forensics.compile_log())
+        h0 = _tot("jit_cache_hits_total")
+        exe2 = pt.Executor(pt.CPUPlace(), scope=scope,
+                           mesh=make_mesh((2,), ("data",)))
+        exe2.run(main, feed=feed, fetch_list=[loss.name])
+    assert _tot("executor_compile_total") == c0
+    assert len(forensics.compile_log()) == f0
+    assert _tot("jit_cache_hits_total") > h0
+    assert _tot("jit_cache_errors_total") == e0
+    rep = exe2.explain(main, feed=feed, fetch_list=[loss.name])
+    assert rep["jit_cache"]["source"] == "disk"
+
+
+def test_mesh_change_is_clean_miss(tmp_path):
+    """A resized incarnation under a DIFFERENT mesh must MISS cleanly:
+    new entry, no corrupt-entry error, no silent wrong-mesh hit — and
+    the key carries the mesh identity (axes/devices/shardings)."""
+    from paddle_tpu.core.place import make_mesh
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    main, startup, loss = _build_mesh_model()
+    feed = _mesh_feed()
+    scope = pt.Scope()
+    e0 = _tot("jit_cache_errors_total")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        exe = pt.Executor(pt.CPUPlace(), scope=scope,
+                          mesh=make_mesh((2,), ("data",)))
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        n2 = len(_entries(tmp_path))
+        m0 = _tot("jit_cache_misses_total")
+        # the grown incarnation: 4-device mesh, same program/scope
+        exe4 = pt.Executor(pt.CPUPlace(), scope=scope,
+                           mesh=make_mesh((4,), ("data",)))
+        out = exe4.run(main, feed=feed, fetch_list=[loss.name])
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert _tot("jit_cache_misses_total") > m0      # clean MISS
+    assert _tot("jit_cache_errors_total") == e0
+    assert len(_entries(tmp_path)) > n2             # its own entry
+    # single-device keys carry NO mesh component (pre-ISSUE-14 entries
+    # stay valid); mesh keys name axes + device assignment
+    comps = exe4._mesh_components(main)
+    assert comps["axes"] == [["data", 4]]
+    assert len(comps["device_ids"]) == 4

@@ -456,3 +456,59 @@ def test_input_bound_warning_prefetch_aware():
         t.stop()
     finally:
         flags.set_flag("input_bound_warn_fraction", old)
+
+
+# ------------------- elastic reader re-partition (ISSUE 14 satellite)
+
+def _stream(n=24):
+    return lambda: iter(range(n))
+
+
+def test_elastic_shard_partitions_disjoint_and_complete():
+    import paddle_tpu.reader as reader
+    parts = [list(reader.elastic_shard(_stream(), 3, r)())
+             for r in range(3)]
+    assert parts[0] == list(range(0, 24, 3))
+    got = sorted(x for p in parts for x in p)
+    assert got == list(range(24))              # nothing lost, no dups
+
+
+def test_elastic_shard_fast_forwards_past_watermark():
+    import paddle_tpu.reader as reader
+    out = list(reader.elastic_shard(_stream(), 2, 1, start=10)())
+    assert out == [11, 13, 15, 17, 19, 21, 23]
+
+
+def test_elastic_shard_resize_exactly_once():
+    """The resize discipline: consume R rounds under world N, resize at
+    the rank-aligned boundary (watermark = start + R*N), re-partition
+    the remainder under world M — across N→M→K no example is dropped or
+    double-consumed, for grow, shrink, and N=1/M=1 edges."""
+    import paddle_tpu.reader as reader
+    n_examples = 30
+    consumed = []
+    start = 0
+    for world, rounds in ((2, 4), (3, 3), (1, 2), (4, None)):
+        phase = []
+        for rank in range(world):
+            it = reader.elastic_shard(_stream(n_examples), world, rank,
+                                      start=start)()
+            taken = list(it) if rounds is None else [
+                x for _, x in zip(range(rounds), it)]
+            phase.append(taken)
+        if rounds is not None:
+            assert all(len(p) == rounds for p in phase)
+        consumed.extend(x for p in phase for x in p)
+        start = reader.elastic_watermark(start, rounds, world) \
+            if rounds is not None else n_examples
+    assert sorted(consumed) == list(range(n_examples))
+    assert len(consumed) == len(set(consumed))     # no double-consume
+
+
+def test_elastic_shard_validates_args():
+    import pytest
+    import paddle_tpu.reader as reader
+    with pytest.raises(ValueError, match="rank"):
+        reader.elastic_shard(_stream(), 2, 2)
+    with pytest.raises(ValueError, match="start"):
+        reader.elastic_shard(_stream(), 2, 0, start=-1)

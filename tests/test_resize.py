@@ -1,0 +1,505 @@
+"""True elasticity (ISSUE 14): dynamic world size with checkpoint
+resharding.
+
+Three layers of drill:
+
+* **protocol units** — the task master's ``request_resize`` epoch-
+  boundary semantics, retire/wait directives, snapshot persistence of a
+  pending resize across a master restart, and the supervisor's
+  grow/park/revive machinery (incl. the live-world respawn-env bugfix);
+* **tier-1 miniature** — the headline soak shrunk to a few seconds:
+  a supervised fleet scales 2→4→1→3 mid-training and lands the exact
+  fixed-fleet end state with a clean exactly-once ledger and zero
+  lost/double-consumed reader examples;
+* **dp resume parity** — a REAL training run under a data-parallel
+  mesh checkpoints, the checkpoint reshards N→M on disk, and training
+  resumes under a DIFFERENT mesh landing the same loss as the
+  fixed-mesh run (the promote-from-dryrun lane; dp×tp in the slow
+  marker).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags
+from paddle_tpu.core.place import make_mesh
+from paddle_tpu.distributed.supervisor import Supervisor
+from paddle_tpu.distributed.task_queue import (TaskMaster,
+                                               TaskMasterClient,
+                                               serve_master)
+from paddle_tpu.incubate import checkpoint as ckpt
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.resilience import soak
+from paddle_tpu.resilience.elastic_worker import RETIRED_RC
+
+
+def _counter(name):
+    m = obs.REGISTRY.get(name)
+    return 0.0 if m is None else m.total()
+
+
+def _drain_epoch(m, rank, n):
+    for _ in range(n):
+        t = m.get_task(worker=rank)
+        assert t is not None
+        assert m.task_finished(t.task_id, lease=t.lease,
+                               worker=rank) == "ok"
+
+
+# ------------------------------------------------- resize protocol units
+
+def test_resize_pends_until_epoch_boundary():
+    """Mid-epoch request pends; the drained queue is the boundary and
+    the recycled epoch runs under the new world."""
+    m = TaskMaster(num_epochs=2, world_size=2)
+    m.set_dataset(["a", "b", "c"])
+    r0 = _counter("fleet_resizes_total")
+    rep = m.request_resize(3)
+    assert rep["applied"] is False and rep["pending_world_size"] == 3
+    assert m.target_world_size == 2
+    assert _counter("fleet_resizes_total") == r0
+    _drain_epoch(m, 0, 3)                  # epoch 0 drains
+    assert m.target_world_size == 3 and m.pending_world_size is None
+    assert m.resizes == 1
+    assert _counter("fleet_resizes_total") == r0 + 1
+
+
+def test_resize_applies_immediately_when_idle():
+    m = TaskMaster(world_size=2)
+    rep = m.request_resize(5)
+    assert rep["applied"] is True and m.target_world_size == 5
+
+
+def test_retire_and_wait_directives():
+    """A pending grow makes the joining rank WAIT; an effective shrink
+    makes out-of-world ranks RETIRE — and they can no longer lease."""
+    m = TaskMaster(num_epochs=3, world_size=2)
+    m.set_dataset(["a", "b"])
+    m.request_resize(3)
+    # rank 2 joins early: no lease, wait directive
+    assert m.get_task(worker=2) is None
+    assert m.worker_directive(2) == {"wait_resize": True,
+                                     "target_world_size": 2}
+    _drain_epoch(m, 0, 2)                  # grow applies
+    assert m.worker_directive(2) == {}
+    m.request_resize(1)
+    assert m.worker_directive(1) == {}     # in-world until the boundary
+    _drain_epoch(m, 1, 2)                  # shrink applies
+    assert m.worker_directive(1) == {"retire": True,
+                                     "target_world_size": 1}
+    assert m.get_task(worker=1) is None    # no leases outside the world
+    assert m.get_task(worker=0) is not None
+    # in-world / legacy callers see no directive
+    assert m.worker_directive(0) == {}
+    assert m.worker_directive(None) == {}
+
+
+def test_shrink_requeues_in_flight_leases_cleanly():
+    """A retiring rank's outstanding lease requeues through the normal
+    membership/fence machinery: the re-leased copy completes exactly
+    once and the zombie ack fences."""
+    m = TaskMaster(num_epochs=2, world_size=2, worker_timeout=0.05)
+    m.set_dataset(["a", "b"])
+    m.register_worker(1)
+    t_held = m.get_task(worker=1)          # rank 1 leases, then dies
+    _drain_epoch(m, 0, 1)                  # the other task completes
+    m.request_resize(1)                    # shrink pending
+    time.sleep(0.08)
+    m.tick()                               # rank 1's heartbeat expires
+    # the lease requeued; epoch 0 drains via rank 0 -> shrink applies
+    t = m.get_task(worker=0)
+    assert t is not None and t.task_id == t_held.task_id
+    assert m.task_finished(t_held.task_id, lease=t_held.lease) == "fenced"
+    assert m.task_finished(t.task_id, lease=t.lease, worker=0) == "ok"
+    assert m.target_world_size == 1
+    assert soak.check_ledger(
+        m.ledger_entries(), 2, 1) == []    # epoch 0 exactly once
+
+
+def test_pending_resize_survives_master_restart(tmp_path):
+    """A resize requested before a master crash still applies at the
+    next epoch boundary after recovery."""
+    snap = str(tmp_path / "master.json")
+    m = TaskMaster(snapshot_path=snap, snapshot_interval=0.0,
+                   num_epochs=2, world_size=2)
+    m.set_dataset(["a", "b"])
+    m.request_resize(4)
+    m2 = TaskMaster(snapshot_path=snap, snapshot_interval=0.0,
+                    num_epochs=2)
+    assert m2.target_world_size == 2
+    assert m2.pending_world_size == 4
+    _drain_epoch(m2, 0, 2)
+    assert m2.target_world_size == 4 and m2.resizes == 1
+
+
+def test_resize_rpc_roundtrip():
+    """request_resize + directives over the TCP transport."""
+    m = TaskMaster(num_epochs=2, world_size=1)
+    m.set_dataset(["a"])
+    srv, (host, port) = serve_master(m)
+    try:
+        with TaskMasterClient(host, port) as c:
+            rep = c.request_resize(2)
+            assert rep["pending_world_size"] == 2
+            assert c.get_task(worker=1) is None
+            assert c.wait_resize and not c.retire
+            _drain_epoch(m, 0, 1)          # grow applies
+            t = c.get_task(worker=1)
+            assert t is not None
+            assert c.task_finished(t.task_id, lease=t.lease,
+                                   worker=1) == "ok"
+            # epoch 1 (the final one) just drained -> job complete,
+            # so this resize applies immediately on the idle queue
+            m.request_resize(1)
+        with TaskMasterClient(host, port) as c2:
+            assert c2.get_task(worker=1) is None   # queue drained
+            assert m.target_world_size == 1
+            assert c2.retire or c2.job_complete
+    finally:
+        srv.shutdown()
+
+    with pytest.raises(ValueError):
+        m.request_resize(0)
+
+
+def test_stats_and_gauge_track_target_world():
+    m = TaskMaster(world_size=3)
+    s = m.stats()
+    assert s["target_world_size"] == 3 and s["resizes"] == 0
+    g = obs.REGISTRY.get("fleet_target_world_size")
+    assert g.value == 3
+
+
+# ----------------------------------------------------- supervisor resize
+
+def _fast_backoff():
+    from paddle_tpu.resilience import retry as rretry
+    return rretry.RetryPolicy(name="supervisor_restart", max_attempts=1,
+                              base_delay=0.01, max_delay=0.05)
+
+
+def _py(code):
+    return [sys.executable, "-c", code]
+
+
+def test_supervisor_grow_spawns_via_factory(tmp_path):
+    """set_world_size past the launch fleet spawns new ranks from
+    cmd_factory, with the live world in their env."""
+    code = ("import os,sys,pathlib\n"
+            "pathlib.Path(sys.argv[1]).write_text("
+            "os.environ['PTPU_FLEET_WORLD_SIZE'])\n")
+
+    def cmd(rank):
+        return [sys.executable, "-c", code,
+                str(tmp_path / f"r{rank}.txt")]
+
+    sup = Supervisor([cmd(0)], cmd_factory=cmd,
+                     backoff=_fast_backoff())
+    sup.start()
+    sup.set_world_size(3)
+    assert sup.wait(timeout=30)
+    assert (tmp_path / "r2.txt").read_text() == "3"
+    # rank 0 was spawned at launch world 1
+    assert (tmp_path / "r0.txt").read_text() == "1"
+    sup.stop()
+
+
+def test_supervisor_grow_without_factory_raises():
+    sup = Supervisor([_py("pass")])
+    with pytest.raises(ValueError, match="cmd_factory"):
+        sup.set_world_size(2)
+
+
+def test_supervisor_parks_retire_rc_and_revives(tmp_path):
+    """A worker exiting with retire_rc is PARKED (state retired, run
+    still counts as clean); growing back over it revives a new
+    incarnation that sees the live world."""
+    marker = tmp_path / "mode"
+    marker.write_text("retire")
+    code = ("import os,sys,pathlib\n"
+            "root = pathlib.Path(sys.argv[1])\n"
+            "(root / ('seen_' + os.environ['PTPU_WORKER_RESTART_COUNT'])"
+            ").write_text(os.environ['PTPU_FLEET_WORLD_SIZE'])\n"
+            "sys.exit(7 if (root / 'mode').read_text() == 'retire' "
+            "else 0)\n")
+
+    def cmd(rank):
+        return [sys.executable, "-c", code, str(tmp_path)]
+
+    sup = Supervisor([cmd(0), cmd(1)], cmd_factory=cmd, retire_rc=7,
+                     backoff=_fast_backoff())
+    sup.target_world = 1                   # rank 1 retires at launch
+    sup.start()
+    deadline = time.time() + 30
+    while sup.status()[1]["state"] != "retired" \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert sup.status()[1]["state"] == "retired"
+    assert sup.wait(timeout=30)            # retired counts as clean
+    marker.write_text("done")              # revived incarnation exits 0
+    sup.set_world_size(2)
+    deadline = time.time() + 30
+    while sup.status()[1]["state"] != "done" and time.time() < deadline:
+        time.sleep(0.02)
+    assert sup.status()[1]["state"] == "done"
+    # the revived incarnation ran with the LIVE world (2), not the
+    # launch-time one — the ISSUE 14 respawn-env bugfix
+    assert (tmp_path / "seen_1").read_text() == "2"
+    assert sup.spawns[1] == 2
+    sup.stop()
+
+
+def test_supervisor_does_not_respawn_outside_world():
+    """A crash of a rank the fleet shrank past parks it instead of
+    burning restarts respawning into a world it left."""
+    sup = Supervisor([_py("import sys; sys.exit(1)")] * 2,
+                     max_restarts=5, backoff=_fast_backoff())
+    sup.target_world = 1
+    sup.start()
+    deadline = time.time() + 30
+    while sup.status()[1]["state"] != "retired" \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert sup.status()[1]["state"] == "retired"
+    assert sup.restarts[1] == 0
+    sup.stop()
+
+
+# ------------------------------------------------- tier-1 headline lane
+
+def test_miniature_soak_grow_shrink_grow(tmp_path):
+    """The ISSUE 14 headline, miniature: a supervised fleet scales
+    2→4→1→3 mid-training (each resize at an epoch boundary), completes
+    hands-off, and lands the EXACT fixed-fleet end state — the ledger
+    is exactly-once and the per-rank consumed records cover every
+    (shard, epoch) reader example exactly once (nothing lost, nothing
+    double-consumed across the resizes)."""
+    rep = soak.run_schedule(str(tmp_path), "resize_soak", world=2,
+                            n_tasks=4, epochs=2, timeout=90)
+    assert rep["ok"], rep["problems"]
+    assert rep["resizes_applied"] == 3
+    assert rep["stats"]["target_world_size"] == 3
+    assert rep["ledger_entries"] == 4 * 4      # 4 tasks x 4 epochs
+    assert rep["w_total"] == pytest.approx(rep["expected_w_total"],
+                                           abs=1e-9)
+    ranks = {w["rank"] for w in rep["workers"]}
+    assert ranks == {0, 1, 2, 3}               # the grown fleet existed
+    # the master's resize_log is the ground truth for which epoch each
+    # world governed (boundaries can outpace the driver): the plan
+    # applied in order, and every epoch governed by the shrunk world
+    # was worked ONLY by rank 0
+    log = rep["stats"]["resize_log"]
+    assert [r["new"] for r in log] == [4, 1, 3]
+    ledger = _ledger_of(tmp_path)
+    for ep in range(log[1]["epoch"], log[2]["epoch"]):
+        assert {e["worker"] for e in ledger
+                if e["epoch"] == ep} <= {0}, ep
+
+
+def _ledger_of(workdir):
+    """Read the persisted master ledger from the soak's snapshot."""
+    import zlib
+    with open(os.path.join(str(workdir), "master.json")) as f:
+        doc = json.load(f)
+    payload = doc["state"]
+    assert zlib.crc32(payload.encode()) == doc["crc"]
+    return json.loads(payload)["ledger"]
+
+
+def test_miniature_soak_grow_with_worker_kill(tmp_path):
+    """resize_combined: the fleet grows 2→3 while chaos kill-9s rank 0
+    mid-task; the supervisor restarts it into the LIVE world and the
+    end state still lands exactly."""
+    rep = soak.run_schedule(str(tmp_path), "resize_combined", world=2,
+                            n_tasks=6, epochs=2, timeout=90)
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"][0] >= 1
+    w = {r["rank"]: r for r in rep["workers"]}
+    # the respawned incarnation reported the live (grown or launch)
+    # world, whichever was current at its spawn — never a stale one
+    assert w[0]["restart_count"] >= 1
+    assert w[0]["world"] in (2, 3)
+    assert rep["w_total"] == pytest.approx(rep["expected_w_total"],
+                                           abs=1e-9)
+
+
+# --------------------------------------- dp resize: real training plane
+
+def _build_lm():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 11
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=8, act="relu", name="fc1")
+        pred = layers.fc(h, size=1, name="fc2")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _dp_batches(n=6, bs=16):
+    rng = np.random.RandomState(3)
+    w = rng.randn(8, 1).astype(np.float32)
+    return [(xb, xb @ w) for xb in
+            (rng.randn(bs, 8).astype(np.float32) for _ in range(n))]
+
+
+def _param_state(scope, program):
+    return {p.name: np.asarray(scope.find_var(p.name))
+            for p in program.all_parameters()}
+
+
+def test_dp_resize_reshard_resume_loss_parity(tmp_path):
+    """Elastic dp promoted from dryrun: train 3 steps on a 2-device
+    data-parallel mesh, checkpoint, reshard the checkpoint on disk
+    (1→4 shard files), resume on a 4-device mesh from the RESHARDED
+    manifest, train 3 more steps — the final loss matches a fixed
+    2-device run, and the resumed params are bit-identical to an
+    unresharded resume."""
+    batches = _dp_batches()
+    root = str(tmp_path / "ck")
+
+    def run(mesh, scope, lo, hi, main, startup, loss, init=True):
+        exe = pt.Executor(pt.CPUPlace(), scope=scope, mesh=mesh)
+        if init:
+            exe.run(startup)
+        out = []
+        for xb, yb in batches[lo:hi]:
+            out.append(float(np.asarray(exe.run(
+                main, feed={"x": xb, "y": yb},
+                fetch_list=[loss.name])[0])))
+        return out
+
+    # fixed-fleet baseline: 6 steps, one 2-device mesh
+    main, startup, loss = _build_lm()
+    mesh2 = make_mesh((2,), ("data",))
+    scope_fixed = pt.Scope()
+    fixed = run(mesh2, scope_fixed, 0, 6, main, startup, loss)
+
+    # elastic: 3 steps on d2, checkpoint, reshard, resume on d4
+    scope_a = pt.Scope()
+    first = run(mesh2, scope_a, 0, 3, main, startup, loss)
+    state = _param_state(scope_a, main)
+    ckpt.save_checkpoint(root, state, {"step": 3})
+    new_serial = ckpt.reshard_checkpoint(root, 4)
+    resharded, meta = ckpt.load_state(
+        os.path.join(root, f"checkpoint_{new_serial}"))
+    direct, _ = ckpt.load_state(os.path.join(root, "checkpoint_0"))
+    for name in state:
+        # acceptance: resharded resume is BIT-identical to unresharded
+        assert np.array_equal(resharded[name], direct[name]), name
+        assert resharded[name].dtype == direct[name].dtype
+    assert meta["resharded_from"] == 0
+
+    mesh4 = make_mesh((4,), ("data",))
+    scope_b = pt.Scope()
+    exe_b = pt.Executor(pt.CPUPlace(), scope=scope_b, mesh=mesh4)
+    exe_b.run(startup)                      # allocate, then overwrite
+    for name, val in resharded.items():
+        scope_b.set_var(name, val)
+    second = run(mesh4, scope_b, 3, 6, main, startup, loss, init=False)
+
+    np.testing.assert_allclose(first + second, fixed,
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_dp_tp_resize_reshard_resume_parity(tmp_path):
+    """dp×tp: a model-sharded weight trains on a ("data",2)×("model",2)
+    mesh, checkpoints, reshards along its MODEL axis via the layout
+    override, and resumes on a ("data",4)×("model",2) mesh with the
+    same loss trajectory as the fixed mesh."""
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 13
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        w_attr = pt.ParamAttr(name="tp_w", sharding=(None, "model"))
+        h = layers.fc(x, size=8, act="relu", param_attr=w_attr,
+                      bias_attr=False)
+        pred = layers.fc(h, size=1, name="head")
+        loss = layers.mean(layers.square_error_cost(
+            pred, layers.data("y", shape=[1])))
+        pt.optimizer.SGD(0.05).minimize(loss)
+    batches = _dp_batches()
+
+    def run(mesh, scope, lo, hi, init):
+        exe = pt.Executor(pt.CPUPlace(), scope=scope, mesh=mesh)
+        if init:
+            exe.run(startup)
+        return [float(np.asarray(exe.run(
+            main, feed={"x": xb, "y": yb},
+            fetch_list=[loss.name])[0])) for xb, yb in batches[lo:hi]]
+
+    mesh22 = make_mesh((2, 2), ("data", "model"))
+    scope_fixed = pt.Scope()
+    fixed = run(mesh22, scope_fixed, 0, 6, True)
+
+    scope_a = pt.Scope()
+    first = run(mesh22, scope_a, 0, 3, True)
+    root = str(tmp_path / "ck")
+    state = _param_state(scope_a, main)
+    ckpt.save_checkpoint(root, state, {"step": 3})
+    # tp weights split along their sharded (model) axis, dense state
+    # along axis 0 — the layout knob
+    serial = ckpt.reshard_checkpoint(
+        root, 2, layout={"tp_w": 1})
+    resharded, _ = ckpt.load_state(
+        os.path.join(root, f"checkpoint_{serial}"))
+    mesh42 = make_mesh((4, 2), ("data", "model"))
+    scope_b = pt.Scope()
+    exe_b = pt.Executor(pt.CPUPlace(), scope=scope_b, mesh=mesh42)
+    exe_b.run(startup)
+    for name, val in resharded.items():
+        scope_b.set_var(name, val)
+    second = run(mesh42, scope_b, 3, 6, False)
+    np.testing.assert_allclose(first + second, fixed,
+                               rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------ slow: full soak
+
+@pytest.mark.slow
+def test_resize_matrix_vs_fixed_fleet(tmp_path):
+    """The full resize matrix at default sizes, plus the direct
+    fixed-fleet comparison the headline promises: the resize_soak run's
+    fleet-summed end state equals an actual fixed-fleet run's."""
+    fixed = soak.run_schedule(str(tmp_path / "fixed"), "fixed",
+                              world=2, n_tasks=6, epochs=4, timeout=120)
+    assert fixed["ok"], fixed["problems"]
+    for name in ("resize_grow", "resize_shrink", "resize_combined",
+                 "resize_soak"):
+        rep = soak.run_schedule(str(tmp_path / name), name, world=2,
+                                n_tasks=6, epochs=4, timeout=120)
+        assert rep["ok"], (name, rep["problems"])
+        # same data, same epochs -> same fleet end state as the fixed
+        # run, to the float-sum tolerance
+        assert rep["w_total"] == pytest.approx(fixed["w_total"],
+                                               abs=1e-9), name
+
+
+def test_applied_resize_survives_relaunch_with_launch_world(tmp_path):
+    """Review regression: a master relaunched with its LAUNCH-time
+    world_size must keep the snapshot's APPLIED resize target — the
+    snapshot is newer truth, and reverting it would silently direct
+    the grown ranks to retire."""
+    snap = str(tmp_path / "master.json")
+    m = TaskMaster(snapshot_path=snap, snapshot_interval=0.0,
+                   num_epochs=2, world_size=2)
+    m.set_dataset(["a", "b"])
+    m.request_resize(4)
+    _drain_epoch(m, 0, 2)                  # grow applies
+    assert m.target_world_size == 4
+    # relaunch with the ORIGINAL argv world (the deployment-script
+    # shape): the persisted target must win
+    m2 = TaskMaster(snapshot_path=snap, snapshot_interval=0.0,
+                    num_epochs=2, world_size=2)
+    assert m2.target_world_size == 4
+    assert m2.worker_directive(3) == {}    # rank 3 stays in-world
+    assert m2.resize_log[-1]["new"] == 4

@@ -762,3 +762,161 @@ def test_sparse_ctr_e2e_two_workers_chaos_kill(tmp_path):
     finally:
         sup.stop()
         srv.shutdown()
+
+
+# ------------------------- push-ledger persistence (ISSUE 14 satellite)
+
+def test_service_snapshot_restart_dedupes_redelivered_push(tmp_path):
+    """PR 13 follow-up regression: the push ledger (and the tables it
+    guards) survive a SparseShardService restart — a push re-delivered
+    across the restart re-acks with the ORIGINAL row count and applies
+    NOTHING twice; fresh pushes still land; adagrad accumulators and
+    the version carry over."""
+    snap = str(tmp_path / "shard.json")
+    svc = sparse.SparseShardService(snapshot_path=snap)
+    svc.init_tables([sparse.TableConfig("t", rows=8, dim=2, seed=0,
+                                        learning_rate=0.5,
+                                        optimizer="adagrad")])
+    g = sparse.SelectedRows([1, 3, 3], np.ones((3, 2), "f4"), 8)
+    v = svc.pull_rows("t", [1, 3])["version"]
+    r1 = svc.push_grads("t", g, v, "push-1")
+    assert r1["status"] == "ok" and r1["rows_applied"] == 2
+    after = svc.state("t")
+
+    # restart mid-stream: a NEW service recovers tables + ledger
+    svc2 = sparse.SparseShardService(snapshot_path=snap)
+    assert sorted(svc2.tables) == ["t"]
+    assert svc2.state("t") == after          # values + version intact
+    # at-least-once delivery re-sends the same push id
+    r2 = svc2.push_grads("t", g, v, "push-1")
+    assert r2["status"] == "ok" and r2.get("duplicate")
+    assert r2["rows_applied"] == r1["rows_applied"]
+    assert svc2.state("t") == after          # ZERO double-applies
+    # the stream continues: a new push lands and re-snapshots
+    v2 = svc2.pull_rows("t", [1])["version"]
+    g2 = sparse.SelectedRows([1], np.ones((1, 2), "f4"), 8)
+    assert svc2.push_grads("t", g2, v2, "push-2")["status"] == "ok"
+    svc3 = sparse.SparseShardService(snapshot_path=snap)
+    assert svc3.push_grads("t", g2, v2, "push-2").get("duplicate")
+    # adagrad accumulators persisted (same grad -> smaller 2nd step)
+    t_live = svc2.tables["t"]
+    t_back = svc3.tables["t"]
+    np.testing.assert_array_equal(t_live._accum, t_back._accum)
+    assert t_back.version == t_live.version
+
+
+def test_service_snapshot_corrupt_falls_back_fresh(tmp_path):
+    """The task-master corrupt-snapshot idiom: a torn/bit-flipped shard
+    snapshot recovers a FRESH service with a loud warning + counter —
+    never a bricked restart."""
+    snap = str(tmp_path / "shard.json")
+    svc = sparse.SparseShardService(snapshot_path=snap)
+    svc.init_tables([sparse.TableConfig("t", rows=4, dim=2, seed=0)])
+    with open(snap, "r+b") as f:
+        f.seek(25)
+        f.write(b"XXXX")
+    c0 = _counter("sparse_snapshot_corrupt_total")
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        svc2 = sparse.SparseShardService(snapshot_path=snap)
+    assert svc2.tables == {}
+    assert _counter("sparse_snapshot_corrupt_total") == c0 + 1
+
+
+def test_service_int8_table_round_trips_snapshot(tmp_path):
+    """int8 row storage (codes + scales) survives the snapshot."""
+    snap = str(tmp_path / "shard.json")
+    svc = sparse.SparseShardService(snapshot_path=snap)
+    svc.init_tables([sparse.TableConfig("q", rows=8, dim=4, seed=3,
+                                        int8_rows=True)])
+    g = sparse.SelectedRows([2], np.full((1, 4), 0.25, "f4"), 8)
+    v = svc.pull_rows("q", [2])["version"]
+    svc.push_grads("q", g, v, "p")
+    svc2 = sparse.SparseShardService(snapshot_path=snap)
+    assert svc2.state("q") == svc.state("q")
+    t, t2 = svc.tables["q"], svc2.tables["q"]
+    np.testing.assert_array_equal(t._codes, t2._codes)
+    np.testing.assert_array_equal(t._scales, t2._scales)
+
+
+def test_service_wal_replay_and_torn_tail(tmp_path):
+    """The per-push durability lever is the O(push) WAL: pushes after
+    the last full snapshot replay deterministically on restart, and a
+    torn tail (crash mid-append) stops replay at the tear with a
+    warning instead of bricking the start."""
+    snap = str(tmp_path / "shard.json")
+    svc = sparse.SparseShardService(snapshot_path=snap)
+    svc.init_tables([sparse.TableConfig("t", rows=8, dim=2, seed=0,
+                                        learning_rate=0.5,
+                                        optimizer="adagrad")])
+    v = svc.pull_rows("t", [1, 3])["version"]
+    for i in range(3):
+        g = sparse.SelectedRows([1, 3], np.full((2, 2), i + 1.0, "f4"),
+                                8)
+        v = svc.push_grads("t", g, v, f"p{i}")["version"]
+    live = svc.state("t")
+    wal = snap + ".wal"
+    assert os.path.getsize(wal) > 0      # pushes rode the WAL, not
+    #                                      full per-push snapshots
+    svc2 = sparse.SparseShardService(snapshot_path=snap)
+    assert svc2.state("t") == live       # bit-identical replay
+    assert all(svc2.push_grads(
+        "t", sparse.SelectedRows([1], np.ones((1, 2), "f4"), 8),
+        0, f"p{i}").get("duplicate") for i in range(3))
+    # tear the last WAL line mid-append
+    raw = open(wal, "rb").read()
+    open(wal, "wb").write(raw[:-9])
+    with pytest.warns(RuntimeWarning, match="torn at line"):
+        svc3 = sparse.SparseShardService(snapshot_path=snap)
+    # earlier entries replayed; only the torn push is missing
+    assert svc3.tables["t"].version == svc2.tables["t"].version - 1
+
+
+def test_service_corrupt_snapshot_does_not_ledger_wal_pushes(tmp_path):
+    """Review regression: when the snapshot is corrupt the WAL must NOT
+    replay into the fresh state — its gradients cannot apply (no
+    tables), and ledgering their push_ids would dedupe the re-delivered
+    pushes whose updates were never applied (silent loss).  After
+    re-init, the re-delivered push must land as a REAL apply."""
+    snap = str(tmp_path / "shard.json")
+    svc = sparse.SparseShardService(snapshot_path=snap)
+    cfg = sparse.TableConfig("t", rows=8, dim=2, seed=0,
+                             learning_rate=0.5)
+    svc.init_tables([cfg])
+    g = sparse.SelectedRows([1], np.ones((1, 2), "f4"), 8)
+    v = svc.pull_rows("t", [1])["version"]
+    assert svc.push_grads("t", g, v, "p1")["status"] == "ok"
+    with open(snap, "r+b") as f:
+        f.seek(30)
+        f.write(b"XXXX")
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        svc2 = sparse.SparseShardService(snapshot_path=snap)
+    assert svc2.tables == {}
+    svc2.init_tables([cfg])                 # the trainer re-inits
+    init_dense = svc2.state("t")["values"]
+    out = svc2.push_grads("t", g, 0, "p1")  # re-delivery
+    assert out["status"] == "ok" and not out.get("duplicate")
+    assert svc2.state("t")["values"] != init_dense   # really applied
+
+
+def test_service_snapshot_runs_off_the_push_path(tmp_path):
+    """Review regression: the O(table) full snapshot runs on a
+    background thread from a copied view — the push reply does not
+    carry it — and the result is restart-equivalent to the live
+    state."""
+    import time as _time
+    snap = str(tmp_path / "shard.json")
+    # interval 0 = a full snapshot is DUE on every push (test mode)
+    svc = sparse.SparseShardService(snapshot_path=snap,
+                                    snapshot_interval=0.0)
+    svc.init_tables([sparse.TableConfig("t", rows=8, dim=2, seed=0)])
+    g = sparse.SelectedRows([1, 2], np.ones((2, 2), "f4"), 8)
+    v = svc.pull_rows("t", [1, 2])["version"]
+    assert svc.push_grads("t", g, v, "bg-1")["status"] == "ok"
+    deadline = _time.time() + 10
+    while svc._snap_pending and _time.time() < deadline:
+        _time.sleep(0.01)
+    assert not svc._snap_pending            # the bg write completed
+    assert not os.path.exists(snap + ".wal.old")   # rotated + dropped
+    svc2 = sparse.SparseShardService(snapshot_path=snap)
+    assert svc2.state("t") == svc.state("t")
+    assert svc2.push_grads("t", g, v, "bg-1").get("duplicate")
