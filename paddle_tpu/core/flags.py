@@ -200,6 +200,24 @@ define_flag("grad_divergence_factor", 10.0,
             "rank.  <= 1 disables.")
 
 # --- fleet telemetry (observability/: server, fleet) -----------------------
+define_flag("alert_rules_path", "",
+            "Watchtower alert rules (observability/alerts.py): path "
+            "to a JSON rules file loaded ON TOP of the built-in "
+            "default set, or the sentinel 'builtin' for the defaults "
+            "alone.  Empty disables alerting entirely (no engine, no "
+            "ticker thread, byte-identical outputs).")
+define_flag("alert_eval_interval", 1.0,
+            "Seconds between background alert-rule evaluations (the "
+            "ticker the pending->firing 'for:' holds are measured "
+            "against); /alerts scrapes also evaluate.")
+define_flag("healthz_stall_seconds", 60.0,
+            "How long a RUNNING trainer may go without completing a "
+            "step before /healthz reads it as hung (503) — was a "
+            "hardcoded 60s; miniature soaks want it small and "
+            "slow-step training wants it large.  The Watchtower "
+            "stalled_rank default alert rule (observability/alerts.py) "
+            "shares this knob: a rank silent past it alerts on the "
+            "coordinator.")
 define_flag("obs_http_port", 0,
             "Port for the live observability HTTP endpoint "
             "(observability/server.py): /metrics (Prometheus text), "

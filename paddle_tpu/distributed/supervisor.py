@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..core import flags
 from ..observability import flight as obs_flight
+from ..observability import journal as obs_journal
 from ..observability import metrics as obs_metrics
 from ..resilience import retry as rretry
 
@@ -155,6 +156,9 @@ class Supervisor:
             self.cmds[rank], env=self._env_for(rank, incarnation),
             cwd=self.cwd, stdout=out, stderr=subprocess.STDOUT)
         self._state[rank] = "running"
+        obs_journal.emit("supervisor", "spawn", worker=rank,
+                         incarnation=incarnation,
+                         child_pid=self._procs[rank].pid)
 
     def start(self) -> "Supervisor":
         if self._thread is not None:
@@ -263,6 +267,9 @@ class Supervisor:
                                   rank=rank,
                                   incarnation=self.spawns.get(rank, 0),
                                   delay=round(delay, 4))
+                obs_journal.emit("supervisor", "revive", worker=rank,
+                                 incarnation=self.spawns.get(rank, 0),
+                                 delay=round(delay, 4))
                 continue
             if state == "restarting":
                 if rank >= self.target_world:
@@ -271,6 +278,9 @@ class Supervisor:
                     obs_flight.record("supervisor", "rank_retired",
                                       rank=rank, rc=self._rc.get(rank),
                                       target_world=self.target_world)
+                    obs_journal.emit("supervisor", "park", worker=rank,
+                                     rc=self._rc.get(rank),
+                                     target_world=self.target_world)
                     continue
                 if now >= self._restart_at[rank]:
                     try:
@@ -302,12 +312,16 @@ class Supervisor:
                 obs_flight.record("supervisor", "rank_retired",
                                   rank=rank, rc=rc,
                                   target_world=self.target_world)
+                obs_journal.emit("supervisor", "park", worker=rank,
+                                 rc=rc, target_world=self.target_world)
                 continue
             if self.restarts[rank] >= self.max_restarts:
                 self._state[rank] = "failed"
                 obs_flight.record("supervisor", "worker_failed",
                                   rank=rank, rc=rc,
                                   restarts=self.restarts[rank])
+                obs_journal.emit("supervisor", "failed", worker=rank,
+                                 rc=rc, restarts=self.restarts[rank])
                 continue
             self.restarts[rank] += 1
             attempt = self.restarts[rank]
@@ -318,6 +332,9 @@ class Supervisor:
             obs_flight.record("supervisor", "worker_restart",
                               rank=rank, rc=rc, attempt=attempt,
                               delay=round(delay, 4))
+            obs_journal.emit("supervisor", "restart", worker=rank,
+                             rc=rc, attempt=attempt,
+                             delay=round(delay, 4))
 
     # -- public surface ---------------------------------------------------
     def status(self) -> Dict[int, dict]:

@@ -68,6 +68,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..core import flags
 from ..observability import flight as obs_flight
+from ..observability import journal as obs_journal
 from ..observability import metrics as obs_metrics
 
 MAX_FAILURES = 3          # ref service.go failureMax
@@ -226,6 +227,12 @@ class TaskMaster:
             self._persist_generation()
         _MASTERS.add(self)
         _m_generation.set(self.generation)
+        # a generation > 1 IS the fence epoch moving — the journal's
+        # record of a master restart/recovery (the incident timeline's
+        # "leases minted before here are void" marker)
+        obs_journal.emit("master", "generation",
+                         generation=self.generation,
+                         recovered=self.generation > 1)
 
     # -- membership listeners ---------------------------------------------
     def add_membership_listener(self,
@@ -320,6 +327,9 @@ class TaskMaster:
         obs_flight.record("task_queue", "fenced", verb=verb,
                           task_id=task_id, rank=rank, lease=lease,
                           gen=self.generation)
+        obs_journal.emit("master", "lease_fenced", verb=verb,
+                         task_id=task_id, worker=rank, lease=lease,
+                         generation=self.generation)
         return "fenced"
 
     def _ack(self, verb: str, task_id: int,
@@ -436,6 +446,8 @@ class TaskMaster:
             self.pending_world_size = n
             obs_flight.record("task_queue", "resize_requested",
                               old=old, new=n)
+            obs_journal.emit("master", "resize_requested",
+                             old_world=old, new_world=n)
             from ..observability import tracectx as obs_tracectx
             obs_tracectx.instant("fleet.resize_requested", kind="fleet",
                                  old_world=old, new_world=n)
@@ -472,6 +484,8 @@ class TaskMaster:
         _m_target_world.set(new)
         obs_flight.record("task_queue", "resize_applied",
                           old=old, new=new, epoch=epoch)
+        obs_journal.emit("master", "resize_applied", old_world=old,
+                         new_world=new, epoch=epoch)
         # X-ray plane: the resize lands on whichever request/step's
         # trace triggered the boundary (the final ack of the epoch)
         from ..observability import tracectx as obs_tracectx
@@ -515,6 +529,8 @@ class TaskMaster:
             events.append((rank, "live", {"host": host, "pid": pid}))
             self._snapshot()
             self._publish_gauges()
+        obs_journal.emit("master", "worker_registered", worker=rank,
+                         reregistration=prev is not None)
         self._emit(events)
         return {"lease": lease, "worker_timeout": self.worker_timeout}
 
@@ -547,6 +563,8 @@ class TaskMaster:
             else:
                 w["state"] = "departed"
                 self._requeue_worker_tasks(rank, count_failure=False)
+                obs_journal.emit("master", "worker_departed",
+                                 worker=rank)
                 events.append((rank, "departed", {}))
                 self._snapshot()
                 self._publish_gauges()
@@ -576,6 +594,10 @@ class TaskMaster:
                 w["state"] = "dead"
                 _m_workers_dead.inc()
                 obs_flight.record("task_queue", "worker_dead", rank=rank)
+                obs_journal.emit("master", "worker_dead", worker=rank,
+                                 held_leases=sum(
+                                     1 for e in self.pending.values()
+                                     if e["worker"] == rank))
                 self._requeue_worker_tasks(rank)
                 events.append((rank, "dead",
                                {"host": w.get("host"),

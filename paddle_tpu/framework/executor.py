@@ -588,6 +588,17 @@ class _CompiledProgram:
         self._persist_pending = False
         self._persist_verified = False
         self._persist_source: Optional[str] = None
+        # donate-feeds twin (trainer prefetch path): its own persistent
+        # entry — key = step components + {"donate_feeds": True} — so a
+        # warm prefetch restart deserializes BOTH executables and
+        # records zero compiles (PR 12 follow-up)
+        self._aot_donate = None
+        self._persist_pending_donate = False
+        # _prepare's probes already MISSED these keys (don't re-probe
+        # and double-count the miss in the _materialize_* resolvers)
+        self._donate_probe_missed = False
+        self._plain_probe_missed = False
+        self._donate_source: Optional[str] = None
         self._multi_jit: Dict[tuple, Any] = {}
         # cost-model plane (observability/costmodel.py): abstract args
         # are noted at first dispatch (ShapeDtypeStructs — no device
@@ -745,10 +756,11 @@ class _CompiledProgram:
         path), never a staged batch they intend to re-feed.
 
         Persistent cache: a deserialized/stored AOT executable takes
-        over the plain (non-donate-feeds) dispatch path — cold and warm
-        starts then run the LITERAL same executable.  The donate-feeds
-        twin stays on plain jit (its donation signature differs; the
-        prefetch path recompiles it per process)."""
+        over BOTH dispatch paths — cold and warm starts then run the
+        LITERAL same executable.  The donate-feeds twin has its own
+        entry (step key + ``donate_feeds: True``, loaded in _prepare /
+        materialized here), so a warm prefetch restart deserializes it
+        instead of paying a silent per-process jit compile."""
         if not donate_feeds:
             if self._aot is None and self._persist_pending \
                     and self._abs_args is not None:
@@ -756,22 +768,49 @@ class _CompiledProgram:
             if self._aot is not None:
                 return self._aot
             return self._jitted
+        if self._aot_donate is None and self._persist_pending_donate \
+                and self._abs_args is not None:
+            self._materialize_donate()
+        if self._aot_donate is not None:
+            return self._aot_donate
         if self._jitted_donate is None:
-            kwargs = dict(self._jit_kwargs)
-            kwargs["donate_argnums"] = tuple(
-                sorted(set(kwargs.get("donate_argnums", ())) | {0, 1}))
-            self._jitted_donate = jax.jit(self._step_fn, **kwargs)
+            self._jitted_donate = jax.jit(self._step_fn,
+                                          **self._donate_kwargs())
         return self._jitted_donate
 
+    def _donate_kwargs(self) -> Dict[str, Any]:
+        kwargs = dict(self._jit_kwargs)
+        kwargs["donate_argnums"] = tuple(
+            sorted(set(kwargs.get("donate_argnums", ())) | {0, 1}))
+        return kwargs
+
     def _materialize_persistent(self):
-        """First dispatch of a disk-MISSED step under the persistent
-        cache: AOT-compile the step (the compile that was about to
+        """First plain dispatch of a not-yet-resolved step under the
+        persistent cache: try the disk entry (unless _prepare's probe
+        already missed it — e.g. the key was resolved via the
+        donate-twin entry and this is the first NON-donating
+        dispatch), else AOT-compile (the compile that was about to
         happen anyway) and store it — only if the program passed the
         verify_program gate at _prepare time.  Any failure degrades to
         the plain jit path (record_error), never to a failed run."""
         from . import jit_cache as pjit_cache
         self._persist_pending = False
         comps, khash = self._persist_meta
+        if not self._plain_probe_missed:
+            loaded = pjit_cache.load("executor_step", khash, comps)
+            if loaded is not None:
+                self._aot = loaded
+                self._persist_source = "disk"
+                return
+            # the key was resolved warm via the donate twin, but the
+            # plain entry is genuinely absent: the AOT below is real
+            # XLA work on a "warm" key.  Deliberately NOT booked in
+            # executor_compile_total/forensics (the key's compile was
+            # accounted when the twin was — same accounting the
+            # pre-persistence donate twin had); the jit_cache miss +
+            # store events above/below make it visible in flight
+            obs_flight.record("jit_cache", "lazy_twin_compile",
+                              twin="plain", key=khash[:16])
         try:
             exe = self._jitted.lower(*self._abs_args).compile()
         except Exception as e:
@@ -781,6 +820,48 @@ class _CompiledProgram:
         self._persist_source = "compiled"
         if self._persist_verified:
             pjit_cache.store("executor_step", khash, comps, exe)
+
+    @staticmethod
+    def _donate_components(comps: dict) -> dict:
+        """The donate-feeds twin's key: the step components plus a
+        ``donate_feeds`` marker — added ONLY on the twin, so every
+        pre-existing plain-step key (and cached entry) stays valid."""
+        out = dict(comps)
+        out["donate_feeds"] = True
+        return out
+
+    def _materialize_donate(self):
+        """First donating dispatch under the persistent cache: resolve
+        the donate-feeds twin from disk (unless _prepare's probe
+        already missed — e.g. the key was first prepared by a
+        non-donating dispatch and this one arrived via the in-memory
+        cache), else AOT-compile it (the compile the plain-jit twin
+        was about to pay anyway) and store it under the donate key —
+        verified programs only, any failure degrades to the plain jit
+        path (PR 12 discipline)."""
+        from . import jit_cache as pjit_cache
+        self._persist_pending_donate = False
+        comps, _ = self._persist_meta
+        dcomps = self._donate_components(comps)
+        dhash = pjit_cache.entry_key("executor_step", dcomps)
+        if not self._donate_probe_missed:
+            loaded = pjit_cache.load("executor_step", dhash, dcomps)
+            if loaded is not None:
+                self._aot_donate = loaded
+                self._donate_source = "disk"
+                return
+            obs_flight.record("jit_cache", "lazy_twin_compile",
+                              twin="donate", key=dhash[:16])
+        try:
+            exe = jax.jit(self._step_fn, **self._donate_kwargs()) \
+                .lower(*self._abs_args).compile()
+        except Exception as e:
+            pjit_cache.record_error("aot", repr(e))
+            return
+        self._aot_donate = exe
+        self._donate_source = "compiled"
+        if self._persist_verified:
+            pjit_cache.store("executor_step", dhash, dcomps, exe)
 
     def jitted_steps(self, steps: int, seq_names: tuple):
         """A device-side training loop: `steps` iterations of the
@@ -1470,7 +1551,7 @@ class Executor:
             # same-mesh warm start deserializes the sharded executable.
             from . import jit_cache as pjit_cache
             use_pc = pjit_cache.enabled()
-            ploaded = pmeta = None
+            ploaded = dloaded = pmeta = None
             if use_pc:
                 # NOTE: no program._version here — it is a process-
                 # local mutation counter; a program reaching the same
@@ -1489,10 +1570,23 @@ class Executor:
                 pkhash = pjit_cache.entry_key("executor_step",
                                               pcomponents)
                 pmeta = (pcomponents, pkhash)
-                ploaded = pjit_cache.load("executor_step", pkhash,
-                                          pcomponents)
+                if donate_feeds:
+                    # the donate-feeds twin has its own entry (key +
+                    # donate marker); probe it FIRST — a prefetch-path
+                    # warm restart may only ever have stored the twin,
+                    # and a twin hit means zero XLA work this dispatch
+                    dcomps = _CompiledProgram._donate_components(
+                        pcomponents)
+                    dloaded = pjit_cache.load(
+                        "executor_step",
+                        pjit_cache.entry_key("executor_step", dcomps),
+                        dcomps)
+                if dloaded is None:
+                    ploaded = pjit_cache.load("executor_step", pkhash,
+                                              pcomponents)
             verified = False
-            if ploaded is not None and donate_feeds:
+            disk_hit = ploaded is not None or dloaded is not None
+            if disk_hit and donate_feeds:
                 # a stored entry was verified with donate_feeds=False
                 # semantics; a donating first dispatch still needs the
                 # donated_fetch hazard gate (the _jitted_donate twin
@@ -1500,7 +1594,7 @@ class Executor:
                 self._verify_before_compile(
                     program, dev_feeds, fetch_names, scope,
                     donate_feeds, seq_names=seq_names)
-            if ploaded is None:
+            if not disk_hit:
                 # static verification gate: BEFORE any counter/compile
                 # so a rejection leaves the compile metrics untouched
                 self._verify_before_compile(
@@ -1544,13 +1638,32 @@ class Executor:
                 batch_axis=self.batch_axis, collect_stats=collect_stats)
             if use_pc:
                 compiled._persist_meta = pmeta
-                if ploaded is not None:
+                if dloaded is not None:
+                    # donate twin off disk: zero XLA work for the
+                    # prefetch path; the plain entry (if ever needed by
+                    # a non-donating dispatch) resolves lazily — disk
+                    # first, since its probe never ran here — and a
+                    # stored twin implies the program verified
+                    compiled._aot_donate = dloaded
+                    compiled._donate_source = "disk"
+                    compiled._persist_pending = True
+                    compiled._persist_verified = True
+                elif ploaded is not None:
                     compiled._aot = ploaded
                     compiled._persist_source = "disk"
                     compiled._persist_verified = True
                 else:
                     compiled._persist_pending = True
                     compiled._persist_verified = verified
+                    # both keys were probed and missed: the resolvers
+                    # must not re-probe (and re-count the miss)
+                    compiled._plain_probe_missed = True
+                # the twin resolves lazily on the first donating
+                # dispatch (disk load, else AOT+store) — also for keys
+                # first prepared by a NON-donating dispatch
+                compiled._persist_pending_donate = dloaded is None
+                compiled._donate_probe_missed = (donate_feeds
+                                                 and dloaded is None)
             self._cache[key] = compiled
             _m_cached_programs.set(len(self._cache))
         else:
@@ -1679,7 +1792,8 @@ class Executor:
                 **pjit_cache.stats(),
                 "entry": (compiled._persist_meta[1]
                           if compiled._persist_meta else None),
-                "source": compiled._persist_source,
+                "source": (compiled._persist_source
+                           or compiled._donate_source),
             }}
         return {
             "schema": "paddle_tpu.explain.v1",

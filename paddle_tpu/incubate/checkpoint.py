@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from ..observability import journal as obs_journal
 from ..resilience import chaos
 
 MANIFEST = "manifest.json"
@@ -401,6 +402,8 @@ def reshard_checkpoint(root: str, n_to: int,
     new_serial = latest_checkpoint(root, require_valid=False) + 1
     reshard_state(_serial_dir(root, new_serial), state, meta, n_to,
                   layout)
+    obs_journal.emit("checkpoint", "reshard_commit", serial=new_serial,
+                     source_serial=src, n_to=n_to, root=root)
     return new_serial
 
 
@@ -421,6 +424,10 @@ def save_checkpoint(root: str, state: Dict[str, Any],
     if max_keep > 0:
         for s in serials[:-max_keep]:
             shutil.rmtree(_serial_dir(root, s), ignore_errors=True)
+    # the manifest landed: this serial is the fleet's newest durable
+    # state — a timeline anchor for "what could that rank resume from"
+    obs_journal.emit("checkpoint", "commit", serial=serial, root=root,
+                     vars=len(state))
     return serial
 
 

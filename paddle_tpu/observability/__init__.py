@@ -49,6 +49,22 @@ Submodules:
     waterfall renderer (``--self-test`` runs in tier-1).
   * :mod:`.deviceprof` — ``POST /profile`` bounded ``jax.profiler``
     captures tagged with the active trace ids; graceful fallback.
+  * :mod:`.alerts` — Watchtower (``alert_rules_path`` flag):
+    declarative threshold/rate/absence/burn-rate rules over the local
+    or fleet-merged metrics with ``for:`` holds and a pending ->
+    firing -> resolved state machine; firing alerts carry exemplar
+    trace ids, a flight-bundle ref and the firing rank set; surfaced
+    as ``alerts_firing``/``alerts_transitions_total``, ``GET /alerts``
+    and the ``alerts --check`` CI validator.
+  * :mod:`.journal` — append-only JSONL fleet event journal
+    (``journal_path`` flag, schema ``paddle_tpu.journal.v1``):
+    supervisor/master/guard/chaos/checkpoint/serving lifecycle events
+    shipped to the coordinator and merged into ONE clock-normalized
+    fleet timeline (``GET /journal``).
+  * :mod:`.incident` — ``python -m paddle_tpu.observability.incident``
+    joins the merged journal, alert history and runlog window into one
+    ``paddle_tpu.incident.v1`` report + ASCII timeline (``--self-test``
+    runs in tier-1).
 
 The instrumented call sites live where the work happens:
 framework/executor.py (compile/cache counters, step latency, per-op

@@ -1,0 +1,932 @@
+"""Watchtower: a declarative alert rule engine over the metrics plane.
+
+Everything before this PR *emits* — fleet-merged metrics (PR 4),
+exemplar-linked traces (PR 11), flight bundles (PR 3), run history
+(PR 7) — but nothing *watches*: an operator learns about a recompile
+storm or a dead rank only by scraping ``/metrics`` at the right
+moment.  This module closes the loop: declarative rules evaluated over
+the local registry (or, on the coordinator, the fleet-merged document)
+with hold durations and a pending → firing → resolved state machine.
+
+Rule grammar (JSON; a file named by the ``alert_rules_path`` flag
+loads ON TOP of the built-in default set — same-name rules override)::
+
+    {"rules": [
+      {"name": "slow_steps",            # unique id
+       "metric": "trainer_step_seconds",# any registry/fleet family
+       "predicate": "threshold",        # threshold|rate|absence|burn_rate
+       "quantile": 0.99,                # histograms: compare this quantile
+       "op": ">",                       # > >= < <= == !=
+       "value": 0.5,                    # the bar
+       "for": 2.0,                      # seconds the breach must hold
+       "window": 60.0,                  # rate/burn_rate lookback
+       "labels": {"worker": "0"},       # optional exact label subset
+       "severity": "critical",          # warning (default) | critical
+       "description": "p99 step > 500ms"}]}
+
+Predicates:
+
+* ``threshold`` — compare a series value (gauges/counters: the value;
+  histograms: the bucket-interpolated ``quantile``) against ``value``.
+* ``rate`` — per-second increase over ``window`` (counters: value;
+  histograms: observation count) compared against ``value``.
+* ``absence`` — fires while NO series matches (metric missing or every
+  matching label set gone) — the dead-exporter/dead-plane alarm.
+* ``burn_rate`` — SLO error-budget burn: the fraction of NEW
+  observations above bucket bound ``bound`` over ``window``, divided
+  by the allowed fraction ``budget``, compared against ``value`` —
+  ``value=10`` fires when the budget burns 10x faster than allowed.
+
+Firing alerts carry context for free: newest exemplar trace ids from
+the breaching histogram series (or, via the aggregator's per-rank
+snapshots, from the firing rank), the latest flight-bundle ref (the
+first fire of each rule auto-captures one), the firing rank set, and
+an alert trace id whose ``alert.fire``/``alert.resolve`` X-ray
+instants resolve at ``GET /trace/<id>``.  Transitions also land in the
+fleet event journal (observability/journal.py), so the incident CLI
+reconstructs fire/resolve against the rest of the timeline.
+
+Surfaces: ``alerts_firing{rule}`` / ``alerts_transitions_total{rule,
+state}`` metrics, the ``GET /alerts`` route (local + fleet-merged),
+and ``python -m paddle_tpu.observability.alerts --check rules.json``
+(exit 0 valid / 1 invalid naming the rule+field or JSON line / 2 bad
+usage — the lint/xray CLI contract).
+
+Gated by ``alert_rules_path``: "" = no engine, no thread, no metrics —
+byte-identical outputs and compile keys (regression-tested).  The
+sentinel value ``builtin`` enables the default set with no file.
+"""
+from __future__ import annotations
+
+import json
+import operator
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core import flags
+from . import flight as obs_flight
+from . import journal as obs_journal
+from . import metrics as obs_metrics
+
+SCHEMA = "paddle_tpu.alerts.v1"
+
+# alert_rules_path / alert_eval_interval are defined in core/flags.py:
+# the Trainer gates on the flag BEFORE this (deliberately lazy) module
+# ever imports.
+
+_m_firing = obs_metrics.gauge(
+    "alerts_firing",
+    "Alert series currently in the firing state, by rule.", ("rule",))
+_m_transitions = obs_metrics.counter(
+    "alerts_transitions_total",
+    "Alert state-machine transitions, by rule and entered state "
+    "(pending | firing | resolved).", ("rule", "state"))
+
+PREDICATES = ("threshold", "rate", "absence", "burn_rate")
+SEVERITIES = ("warning", "critical")
+OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": operator.gt, ">=": operator.ge, "<": operator.lt,
+    "<=": operator.le, "==": operator.eq, "!=": operator.ne,
+}
+
+_HISTORY_MAX = 256
+# per-series (time, value) history for rate/burn_rate.  Samples are
+# time-granulated to window/_SAMPLES_PER_WINDOW on append, so the
+# deque covers the FULL configured window no matter how often the
+# ticker (or /alerts scrapes) evaluate — a raw count cap would shrink
+# a 120s lookback to ~13s under a 0.1s ticker.
+_SAMPLES_MAX = 128
+_SAMPLES_PER_WINDOW = 64
+
+
+class RuleError(ValueError):
+    """A rules file (or rule object) failed validation; the message
+    names the file/rule and the offending field."""
+
+
+class RulesUnreadable(RuleError):
+    """The rules file could not be read at all (missing / permission)
+    — the ``alerts --check`` exit-2 case, distinct from exit-1
+    invalid-content (a typed split, not a message-substring one)."""
+
+
+class Rule:
+    """One declarative alert rule (validated, immutable-by-convention)."""
+
+    __slots__ = ("name", "metric", "predicate", "op", "value",
+                 "for_seconds", "window", "quantile", "labels",
+                 "severity", "description", "bound", "budget", "source")
+
+    def __init__(self, name: str, metric: str, predicate: str,
+                 op: str = ">", value: float = 0.0,
+                 for_seconds: float = 0.0, window: float = 60.0,
+                 quantile: Optional[float] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 severity: str = "warning", description: str = "",
+                 bound: Optional[float] = None, budget: float = 0.01,
+                 source: str = "file"):
+        self.name = name
+        self.metric = metric
+        self.predicate = predicate
+        self.op = op
+        self.value = float(value)
+        self.for_seconds = float(for_seconds)
+        self.window = float(window)
+        self.quantile = quantile
+        self.labels = dict(labels or {})
+        self.severity = severity
+        self.description = description
+        self.bound = bound
+        self.budget = float(budget)
+        self.source = source
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "metric": self.metric,
+             "predicate": self.predicate, "op": self.op,
+             "value": self.value, "for": self.for_seconds,
+             "window": self.window, "severity": self.severity,
+             "source": self.source}
+        if self.quantile is not None:
+            d["quantile"] = self.quantile
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.description:
+            d["description"] = self.description
+        if self.predicate == "burn_rate":
+            d["bound"] = self.bound
+            d["budget"] = self.budget
+        return d
+
+
+def parse_rule(obj: Any, where: str, source: str = "file") -> Rule:
+    """One rule object -> Rule; raises :class:`RuleError` naming
+    `where` (file + rule index/name) and the offending field."""
+    if not isinstance(obj, dict):
+        raise RuleError(f"{where}: rule must be a JSON object, "
+                        f"got {type(obj).__name__}")
+
+    def fail(field, why):
+        raise RuleError(f"{where}: field {field!r} {why}")
+
+    name = obj.get("name")
+    if not isinstance(name, str) or not name:
+        fail("name", "must be a non-empty string")
+    metric = obj.get("metric")
+    predicate = obj.get("predicate", "threshold")
+    if predicate not in PREDICATES:
+        fail("predicate", f"must be one of {PREDICATES}, "
+                          f"got {predicate!r}")
+    if not isinstance(metric, str) or not metric:
+        fail("metric", "must be a non-empty metric family name")
+    op = obj.get("op", ">")
+    if op not in OPS:
+        fail("op", f"must be one of {tuple(OPS)}, got {op!r}")
+    known = {"name", "metric", "predicate", "op", "value", "for",
+             "window", "quantile", "labels", "severity", "description",
+             "bound", "budget"}
+    unknown = sorted(set(obj) - known)
+    if unknown:
+        fail(unknown[0], f"is not a rule field (known: {sorted(known)})")
+
+    def num(field, default, lo=None):
+        v = obj.get(field, default)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            fail(field, f"must be a number, got {v!r}")
+        if lo is not None and v < lo:
+            fail(field, f"must be >= {lo}, got {v!r}")
+        return float(v)
+
+    value = num("value", 0.0)
+    for_s = num("for", 0.0, lo=0.0)
+    window = num("window", 60.0, lo=0.0)
+    quantile = obj.get("quantile")
+    if quantile is not None:
+        if isinstance(quantile, bool) or \
+                not isinstance(quantile, (int, float)) \
+                or not 0.0 < float(quantile) < 1.0:
+            fail("quantile", f"must be a number in (0, 1), "
+                             f"got {quantile!r}")
+        if predicate != "threshold":
+            fail("quantile", "only applies to threshold rules")
+        quantile = float(quantile)
+    labels = obj.get("labels") or {}
+    if not isinstance(labels, dict) or \
+            not all(isinstance(k, str) for k in labels):
+        fail("labels", "must be an object of label -> value strings")
+    labels = {k: str(v) for k, v in labels.items()}
+    severity = obj.get("severity", "warning")
+    if severity not in SEVERITIES:
+        fail("severity", f"must be one of {SEVERITIES}, "
+                         f"got {severity!r}")
+    description = obj.get("description", "")
+    if not isinstance(description, str):
+        fail("description", "must be a string")
+    bound = obj.get("bound")
+    budget = obj.get("budget", 0.01)
+    if predicate == "burn_rate":
+        if isinstance(bound, bool) or not isinstance(bound, (int, float)):
+            fail("bound", "burn_rate rules need a numeric bucket "
+                          "bound (seconds)")
+        budget = num("budget", 0.01)
+        if not 0.0 < budget <= 1.0:
+            fail("budget", f"must be in (0, 1], got {budget!r}")
+        bound = float(bound)
+    elif bound is not None:
+        fail("bound", "only applies to burn_rate rules")
+    return Rule(name=name, metric=metric, predicate=predicate, op=op,
+                value=value, for_seconds=for_s, window=window,
+                quantile=quantile, labels=labels, severity=severity,
+                description=description, bound=bound, budget=budget,
+                source=source)
+
+
+def load_rules(path: str) -> List[Rule]:
+    """Parse a rules file.  Raises :class:`RuleError` naming the line
+    (JSON syntax) or the rule index + field (semantics) — the
+    malformed-rules contract ``alerts --check`` exits 1 on."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        raise RulesUnreadable(f"{path}: unreadable ({e})") from e
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise RuleError(
+            f"{path}:{e.lineno}:{e.colno}: not JSON ({e.msg})") from e
+    rules = doc.get("rules") if isinstance(doc, dict) else doc
+    if not isinstance(rules, list):
+        raise RuleError(
+            f"{path}: expected a list of rules (or an object with a "
+            f"'rules' list), got {type(doc).__name__}")
+    out = []
+    names = set()
+    for i, obj in enumerate(rules):
+        where = f"{path}: rule #{i}"
+        if isinstance(obj, dict) and isinstance(obj.get("name"), str):
+            where += f" ({obj['name']!r})"
+        rule = parse_rule(obj, where)
+        if rule.name in names:
+            raise RuleError(f"{where}: field 'name' duplicates an "
+                            f"earlier rule in this file")
+        names.add(rule.name)
+        out.append(rule)
+    return out
+
+
+def default_rules() -> List[Rule]:
+    """The built-in rule set, constructed from the CURRENT flag values
+    (docs/OBSERVABILITY.md has the table).  Rules whose gating flag is
+    off (e.g. no serving p99 budget) are omitted rather than inert."""
+    out: List[Rule] = []
+
+    def r(**kw):
+        out.append(Rule(source="builtin", **kw))
+
+    budget_ms = float(flags.get_flag("serving_p99_budget_ms"))
+    if budget_ms > 0:
+        r(name="serving_p99_budget",
+          metric="serving_token_seconds", predicate="threshold",
+          quantile=0.99, op=">", value=budget_ms / 1e3,
+          for_seconds=1.0, severity="critical",
+          description="serving per-token p99 over serving_p99_budget_ms")
+        r(name="ttft_burn_rate",
+          metric="serving_ttft_seconds", predicate="burn_rate",
+          bound=budget_ms / 1e3, budget=0.01, op=">", value=10.0,
+          window=60.0, for_seconds=1.0, severity="critical",
+          description="TTFT error budget (1% over the p99 budget) "
+                      "burning > 10x its sustainable rate")
+    r(name="recompile_storm",
+      metric="executor_recompile_storm_total", predicate="rate",
+      op=">", value=0.0, window=120.0, severity="critical",
+      description="the executor diagnosed a recompile storm "
+                  "(forensics names the drifting key component)")
+    r(name="dead_rank",
+      metric="fleet_worker_dead", predicate="threshold",
+      op=">", value=0.0, for_seconds=0.0, severity="critical",
+      description="a fleet rank is dead or stale (membership truth / "
+                  "report staleness, fleet-merged view only; a "
+                  "cleanly-departed rank leaves the family and never "
+                  "alarms)")
+    r(name="stalled_rank",
+      metric="fleet_worker_report_age_seconds", predicate="threshold",
+      op=">", value=float(flags.get_flag("healthz_stall_seconds")),
+      for_seconds=0.0, severity="warning",
+      description="a rank stopped reporting for longer than "
+                  "healthz_stall_seconds (the /healthz hung-trainer "
+                  "knob — one flag tunes both)")
+    r(name="sparse_push_reject_spike",
+      metric="sparse_push_rejected_total", predicate="rate",
+      op=">", value=1.0, window=30.0, for_seconds=2.0,
+      description="sparse staleness rejections spiking (> 1/s): "
+                  "workers are re-pulling faster than the staleness "
+                  "bound admits — grow sparse_staleness_bound or shed "
+                  "load")
+    queue_limit = int(flags.get_flag("serving_queue_limit"))
+    if queue_limit > 0:
+        r(name="queue_saturation",
+          metric="serving_queue_depth", predicate="threshold",
+          op=">=", value=float(queue_limit), for_seconds=1.0,
+          severity="critical",
+          description="serving admission queue at its shed bound — "
+                      "requests are being 429d")
+    r(name="nan_guard",
+      metric="trainer_bad_steps_total", predicate="rate",
+      op=">", value=0.0, window=120.0, severity="critical",
+      description="the numeric guard tripped (NaN/Inf or loss spike; "
+                  "the metric's first_var label and the journal carry "
+                  "the attribution)")
+    r(name="jit_cache_errors",
+      metric="jit_cache_errors_total", predicate="rate",
+      op=">", value=0.0, window=120.0,
+      description="persistent executable cache entries failing to "
+                  "load/store (corrupt or stale-build artifacts; "
+                  "starts degrade to recompiles)")
+    return out
+
+
+# -- doc plumbing -----------------------------------------------------------
+
+def _match_series(doc: dict, rule: Rule) -> List[dict]:
+    fam = (doc.get("metrics") or {}).get(rule.metric)
+    if not fam:
+        return []
+    rows = []
+    for row in fam.get("series", []):
+        labels = row.get("labels") or {}
+        if all(labels.get(k) == v for k, v in rule.labels.items()):
+            rows.append(row)
+    return rows
+
+
+def _series_key(row: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v))
+                 for k, v in (row.get("labels") or {}).items()))
+
+
+def _row_count_above(row: dict, bound: float) -> int:
+    """Observations strictly above bucket bound `bound` in one
+    histogram row (total count minus the cumulative count of buckets
+    <= bound; align `bound` with a bucket boundary for exactness)."""
+    total = int(row.get("count", 0))
+    below = 0
+    for b, c in (row.get("buckets") or {}).items():
+        if float(b) <= bound + 1e-12:
+            below += int(c)
+    return max(0, total - below)
+
+
+class AlertEngine:
+    """Rule evaluation + per-(rule, series) state machines.
+
+    ``doc_fn`` supplies the metrics document each evaluation reads —
+    the local registry by default; the coordinator wires the
+    fleet-merged view (server.metrics_json) so hold durations are
+    measured against ONE consistent source.  ``snapshot_provider``
+    (rank -> that worker's last metrics doc) lets gauge-rule contexts
+    (dead_rank) surface the victim's newest exemplar trace ids."""
+
+    def __init__(self, rules: List[Rule],
+                 doc_fn: Optional[Callable[[], dict]] = None,
+                 snapshot_provider: Optional[
+                     Callable[[int], Optional[dict]]] = None,
+                 now_fn: Callable[[], float] = time.time):
+        self.rules = list(rules)
+        self.doc_fn = doc_fn
+        self.snapshot_provider = snapshot_provider
+        self._now = now_fn
+        self._lock = threading.RLock()
+        # (rule, series_key) -> {"state", "since", "value", "labels",
+        #                        "context", "fired_unix", ...}
+        self._states: Dict[Tuple[str, tuple], dict] = {}
+        # (rule, series_key) -> deque[(t, v0, v1)] rate/burn history
+        self._samples: Dict[Tuple[str, tuple], deque] = {}
+        self._history: deque = deque(maxlen=_HISTORY_MAX)
+        self._fired_rules: set = set()
+        self._warned_inert: set = set()
+        self._eval_count = 0
+        self._last_eval_unix: Optional[float] = None
+        self._ticker: Optional[threading.Thread] = None
+        self._ticker_stop = threading.Event()
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, doc: Optional[dict] = None,
+                 now: Optional[float] = None) -> dict:
+        """One evaluation pass over `doc` (default: this engine's
+        doc_fn, else the local registry).  Returns the status
+        document.  Thread-safe: the ticker and /alerts scrapes share
+        one lock."""
+        with self._lock:
+            if doc is None:
+                doc = self.doc_fn() if self.doc_fn is not None \
+                    else obs_metrics.REGISTRY.to_json()
+            t = self._now() if now is None else float(now)
+            self._eval_count += 1
+            self._last_eval_unix = t
+            for rule in self.rules:
+                self._eval_rule(rule, doc, t)
+            self._prune(t)
+            return self._status_locked()
+
+    # resolved states linger this long for /alerts recent_resolved,
+    # then drop — on a churning elastic fleet every (rule, worker)
+    # series that ever fired would otherwise accumulate forever
+    _RESOLVED_KEEP_S = 3600.0
+
+    def _prune(self, now: float):
+        """Bound long-lived engine state (call under the lock): aged
+        resolved states drop (history keeps the transition record),
+        and rate/burn sample histories for series with no live state
+        and no sample within 2x their retention window drop too."""
+        for key, st in list(self._states.items()):
+            if st["state"] == "resolved" and \
+                    now - st.get("resolved_unix", now) \
+                    > self._RESOLVED_KEEP_S:
+                self._states.pop(key, None)
+        windows = {r.name: r.window for r in self.rules}
+        for key, dq in list(self._samples.items()):
+            horizon = max(windows.get(key[0], 60.0),
+                          1.0) * 2.0
+            if key not in self._states and \
+                    (not dq or now - dq[-1][0] > horizon):
+                self._samples.pop(key, None)
+
+    def _eval_rule(self, rule: Rule, doc: dict, now: float):
+        rows = _match_series(doc, rule)
+        if rule.predicate == "absence":
+            self._advance(rule, ("__absent__",), not rows, None,
+                          dict(rule.labels), now, None)
+            return
+        seen = set()
+        for row in rows:
+            skey = _series_key(row)
+            seen.add(skey)
+            labels = dict(row.get("labels") or {})
+            measured = self._measure(rule, skey, row, now)
+            if measured is None:
+                continue
+            cond = OPS[rule.op](measured, rule.value)
+            self._advance(rule, skey, cond, measured, labels, now, row)
+        # series that vanished from the doc (a departed worker's gauge)
+        # resolve rather than latch firing forever
+        for (rname, skey), st in list(self._states.items()):
+            if rname == rule.name and skey not in seen \
+                    and skey != ("__absent__",) \
+                    and st["state"] in ("pending", "firing"):
+                self._advance(rule, skey, False, None,
+                              st.get("labels", {}), now, None)
+
+    def _measure(self, rule: Rule, skey: tuple, row: dict,
+                 now: float) -> Optional[float]:
+        is_hist = "buckets" in row
+        if rule.predicate == "threshold":
+            if is_hist:
+                if rule.quantile is None:
+                    # a threshold rule pointed at a histogram with no
+                    # quantile can never evaluate — a watchdog that
+                    # silently doesn't watch must at least say so once
+                    if rule.name not in self._warned_inert:
+                        self._warned_inert.add(rule.name)
+                        warnings.warn(
+                            f"alert rule {rule.name!r}: metric "
+                            f"{rule.metric!r} is a histogram but the "
+                            f"threshold rule has no 'quantile' — the "
+                            f"rule matches series it can never "
+                            f"evaluate", RuntimeWarning, stacklevel=2)
+                    return None
+                qs = obs_metrics.histogram_row_quantiles(
+                    row, [rule.quantile])
+                if qs is None:
+                    return None
+                return float(qs[f"p{int(round(rule.quantile * 100))}"])
+            return float(row.get("value", 0.0))
+        if rule.predicate == "rate":
+            v = float(row.get("count", 0)) if is_hist \
+                else float(row.get("value", 0.0))
+            return self._rate_from_history(rule, skey, now, v)
+        if rule.predicate == "burn_rate":
+            if not is_hist:
+                return None
+            total = float(row.get("count", 0))
+            above = float(_row_count_above(row, rule.bound or 0.0))
+            return self._burn_from_history(rule, skey, now, total, above)
+        return None
+
+    def _push_sample(self, rule: Rule, skey: tuple, now: float,
+                     v0: float, v1: float):
+        """Record one (time, v0, v1) sample, time-granulated so the
+        bounded deque spans the FULL window: at most one retained
+        sample per window/_SAMPLES_PER_WINDOW, trimmed past the
+        window.  Returns the deque."""
+        dq = self._samples.setdefault((rule.name, skey),
+                                      deque(maxlen=_SAMPLES_MAX))
+        granule = rule.window / _SAMPLES_PER_WINDOW
+        if not dq or granule <= 0 or now - dq[-1][0] >= granule:
+            dq.append((now, v0, v1))
+        while dq and now - dq[0][0] > rule.window:
+            dq.popleft()
+        return dq
+
+    def _anchor(self, dq, now: float, window: float):
+        """Oldest retained sample still inside the window — what the
+        rate/burn deltas measure against."""
+        for sample in dq:
+            if now - sample[0] <= window:
+                return sample
+        return None
+
+    def _rate_from_history(self, rule, skey, now, v) -> Optional[float]:
+        dq = self._samples.setdefault((rule.name, skey),
+                                      deque(maxlen=_SAMPLES_MAX))
+        anchor = self._anchor(dq, now, rule.window)
+        self._push_sample(rule, skey, now, v, 0.0)
+        if anchor is None or now <= anchor[0]:
+            return 0.0
+        dv = v - anchor[1]
+        if dv < 0:
+            return 0.0               # restarted process: counter reset
+        return dv / (now - anchor[0])
+
+    def _burn_from_history(self, rule, skey, now, total,
+                           above) -> Optional[float]:
+        dq = self._samples.setdefault((rule.name, skey),
+                                      deque(maxlen=_SAMPLES_MAX))
+        anchor = self._anchor(dq, now, rule.window)
+        self._push_sample(rule, skey, now, total, above)
+        if anchor is None:
+            return 0.0
+        d_total = total - anchor[1]
+        d_above = above - anchor[2]
+        if d_total <= 0 or d_above < 0:
+            return 0.0
+        breach_fraction = d_above / d_total
+        return breach_fraction / max(rule.budget, 1e-9)
+
+    # -- state machine -----------------------------------------------------
+    def _advance(self, rule: Rule, skey: tuple, cond: bool,
+                 measured: Optional[float], labels: Dict[str, str],
+                 now: float, row: Optional[dict]):
+        key = (rule.name, skey)
+        st = self._states.get(key)
+        if cond:
+            if st is None or st["state"] == "resolved":
+                st = {"state": "pending", "since": now,
+                      "labels": labels, "context": None,
+                      "fired_unix": None}
+                self._states[key] = st
+                self._transition(rule, st, "pending", measured, now)
+            st["value"] = measured
+            if st["state"] == "pending" \
+                    and now - st["since"] >= rule.for_seconds:
+                st["state"] = "firing"
+                st["fired_unix"] = now
+                st["context"] = self._build_context(rule, labels, row,
+                                                    measured)
+                self._transition(rule, st, "firing", measured, now)
+        else:
+            if st is not None and st["state"] in ("pending", "firing"):
+                was_firing = st["state"] == "firing"
+                st["state"] = "resolved"
+                st["resolved_unix"] = now
+                st["value"] = measured
+                if was_firing:
+                    self._transition(rule, st, "resolved", measured,
+                                     now)
+                else:
+                    # a pending breach that never held for `for:` just
+                    # clears — no resolved noise in history/journal
+                    self._states.pop(key, None)
+        self._refresh_gauge(rule.name)
+
+    def _refresh_gauge(self, rule_name: str):
+        n = sum(1 for (rn, _k), s in self._states.items()
+                if rn == rule_name and s["state"] == "firing")
+        _m_firing.labels(rule=rule_name).set(n)
+
+    def _transition(self, rule: Rule, st: dict, state: str,
+                    measured: Optional[float], now: float):
+        _m_transitions.labels(rule=rule.name, state=state).inc()
+        rec = {"time_unix": now, "rule": rule.name, "state": state,
+               "severity": rule.severity, "value": measured,
+               "labels": dict(st.get("labels") or {})}
+        if state in ("firing", "resolved") and st.get("context"):
+            rec["context"] = st["context"]
+        self._history.append(rec)
+        obs_flight.record("alert", state, rule=rule.name,
+                          value=measured,
+                          labels=dict(st.get("labels") or {}))
+        if state in ("firing", "resolved"):
+            ctx = st.get("context") or {}
+            obs_journal.emit(
+                "alert", "fire" if state == "firing" else "resolve",
+                rule=rule.name, severity=rule.severity, value=measured,
+                labels=dict(st.get("labels") or {}),
+                alert_trace_id=ctx.get("alert_trace_id"))
+            self._xray_instant(rule, st, state, now)
+
+    def _xray_instant(self, rule: Rule, st: dict, state: str,
+                      now: float):
+        """alert.fire / alert.resolve as zero-duration X-ray spans
+        under the alert's OWN trace id, so ``GET /trace/<id>`` renders
+        the alert lifecycle like any request."""
+        from . import tracectx as obs_tracectx
+        if not obs_tracectx.enabled():
+            return
+        ctx = st.get("context")
+        tid = (ctx or {}).get("alert_trace_id")
+        if tid is None:
+            return
+        obs_tracectx.record_span(
+            f"alert.{'fire' if state == 'firing' else 'resolve'}",
+            tid, obs_tracectx.new_span_id(), None, now,
+            time.perf_counter(), 0.0, kind="alert",
+            attrs={"rule": rule.name, "severity": rule.severity,
+                   "value": st.get("value")})
+
+    # -- context -----------------------------------------------------------
+    def _build_context(self, rule: Rule, labels: Dict[str, str],
+                       row: Optional[dict],
+                       measured: Optional[float]) -> dict:
+        from . import tracectx as obs_tracectx
+        ctx: Dict[str, Any] = {}
+        ranks = sorted({labels["worker"]} if "worker" in labels else [])
+        if ranks:
+            ctx["ranks"] = ranks
+        # exemplar trace ids: the breaching histogram series' own
+        # exemplars first; for gauge rules on a labeled rank, that
+        # rank's last snapshot (the aggregator keeps it)
+        trace_ids = self._exemplar_ids(row)
+        if not trace_ids and ranks and self.snapshot_provider:
+            for r in ranks:
+                try:
+                    snap = self.snapshot_provider(int(r))
+                except (TypeError, ValueError):
+                    snap = None
+                trace_ids.extend(self._newest_doc_exemplars(snap))
+        if trace_ids:
+            ctx["exemplar_trace_ids"] = trace_ids[:4]
+        # flight-bundle ref: auto-capture one on the FIRST fire of each
+        # rule (the post-mortem evidence), then reference the latest
+        if rule.name not in self._fired_rules:
+            self._fired_rules.add(rule.name)
+            path = obs_flight.dump(
+                f"alert:{rule.name}",
+                extra={"rule": rule.name, "labels": labels,
+                       "value": measured})
+            ctx["flight_bundle"] = path or "in-memory"
+        last = obs_flight.last_bundle()
+        ctx["flight"] = {"dumps": obs_flight.dump_count(),
+                         "last_reason": (last or {}).get("reason")}
+        if obs_tracectx.enabled():
+            ctx["alert_trace_id"] = obs_tracectx.new_trace_id()
+        return ctx
+
+    @staticmethod
+    def _exemplar_ids(row: Optional[dict]) -> List[str]:
+        if not row:
+            return []
+        exem = row.get("exemplars") or {}
+        ranked = sorted(exem.values(),
+                        key=lambda e: -float(e.get("time_unix", 0.0)))
+        out = []
+        for e in ranked:
+            tid = e.get("trace_id")
+            if tid and tid not in out:
+                out.append(str(tid))
+        return out
+
+    @classmethod
+    def _newest_doc_exemplars(cls, doc: Optional[dict]) -> List[str]:
+        """Newest exemplar trace ids anywhere in a metrics document —
+        the 'what was that rank doing' hook for rules that fire on a
+        gauge (dead_rank) rather than a histogram."""
+        if not isinstance(doc, dict):
+            return []
+        best: List[Tuple[float, str]] = []
+        for fam in (doc.get("metrics") or {}).values():
+            for row in fam.get("series", []):
+                for e in (row.get("exemplars") or {}).values():
+                    tid = e.get("trace_id")
+                    if tid:
+                        best.append((float(e.get("time_unix", 0.0)),
+                                     str(tid)))
+        best.sort(reverse=True)
+        out = []
+        for _t, tid in best:
+            if tid not in out:
+                out.append(tid)
+        return out[:4]
+
+    # -- views -------------------------------------------------------------
+    def status_doc(self) -> dict:
+        with self._lock:
+            return self._status_locked()
+
+    def _status_locked(self) -> dict:
+        active = []
+        for (rname, skey), st in sorted(self._states.items()):
+            row = {"rule": rname, "state": st["state"],
+                   "labels": dict(st.get("labels") or {}),
+                   "since_unix": st.get("since"),
+                   "value": st.get("value")}
+            if st.get("fired_unix") is not None:
+                row["fired_unix"] = st["fired_unix"]
+            if st.get("resolved_unix") is not None:
+                row["resolved_unix"] = st["resolved_unix"]
+            if st.get("context"):
+                row["context"] = st["context"]
+            active.append(row)
+        return {
+            "schema": SCHEMA,
+            "time_unix": time.time(),
+            "enabled": True,
+            "eval_count": self._eval_count,
+            "last_eval_unix": self._last_eval_unix,
+            "rules": [r.to_dict() for r in self.rules],
+            "active": [a for a in active
+                       if a["state"] in ("pending", "firing")],
+            "recent_resolved": [a for a in active
+                                if a["state"] == "resolved"],
+            "firing": sorted({a["rule"] for a in active
+                              if a["state"] == "firing"}),
+            "history": list(self._history),
+        }
+
+    # -- ticker ------------------------------------------------------------
+    def start_ticker(self):
+        if self._ticker is not None and self._ticker.is_alive():
+            return
+        self._ticker_stop.clear()
+
+        def _loop():
+            # clamped: interval <= 0 must not busy-spin a daemon core
+            # rebuilding the fleet-merged doc (scrapes still evaluate)
+            while not self._ticker_stop.wait(max(
+                    0.05, float(flags.get_flag("alert_eval_interval")))):
+                try:
+                    self.evaluate()
+                except Exception:
+                    pass     # watching must never take the watched down
+
+        self._ticker = threading.Thread(target=_loop, daemon=True,
+                                        name="alert-engine")
+        self._ticker.start()
+
+    def stop_ticker(self):
+        self._ticker_stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5.0)
+            self._ticker = None
+
+
+# -- module singleton -------------------------------------------------------
+
+_lock = threading.Lock()
+_engine: Optional[AlertEngine] = None
+
+
+def enabled() -> bool:
+    return bool(str(flags.get_flag("alert_rules_path") or ""))
+
+
+def effective_rules() -> List[Rule]:
+    """Builtins + the rules file (same-name file rules override), per
+    the CURRENT alert_rules_path flag.  Raises RuleError on a bad
+    file — ensure_started() softens that to a warning."""
+    path = str(flags.get_flag("alert_rules_path") or "")
+    by_name = {r.name: r for r in default_rules()}
+    if path and path not in ("builtin", "default"):
+        for r in load_rules(path):
+            by_name[r.name] = r
+    return list(by_name.values())
+
+
+def get_engine() -> Optional[AlertEngine]:
+    return _engine
+
+
+def ensure_started(doc_fn=None, snapshot_provider=None
+                   ) -> Optional[AlertEngine]:
+    """Flag-gated idempotent engine start (the Trainer's and the HTTP
+    server's entry point): returns the process-wide engine with its
+    ticker running, or None when alerting is off.  A malformed rules
+    file WARNS and falls back to the builtins — alerting must not take
+    a training run down (use ``alerts --check`` in CI to reject it
+    loudly)."""
+    global _engine
+    if not enabled():
+        return None
+    with _lock:
+        if _engine is None:
+            try:
+                rules = effective_rules()
+            except RuleError as e:
+                warnings.warn(
+                    f"alert rules file rejected ({e}); running with "
+                    f"the built-in default set only",
+                    RuntimeWarning, stacklevel=2)
+                rules = default_rules()
+            _engine = AlertEngine(rules)
+        if doc_fn is not None:
+            _engine.doc_fn = doc_fn
+        if snapshot_provider is not None:
+            _engine.snapshot_provider = snapshot_provider
+        _engine.start_ticker()
+        return _engine
+
+
+def reset():
+    """Test hook (conftest): stop the ticker, drop the engine, and
+    clear the alert metric families so one case's firing state cannot
+    leak into the next."""
+    global _engine
+    with _lock:
+        if _engine is not None:
+            _engine.stop_ticker()
+            _engine = None
+    _m_firing.clear()
+    _m_transitions.clear()
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _self_test() -> int:
+    """Engine smoke without any live process: a synthetic doc drives a
+    threshold rule through pending -> firing -> resolved."""
+    rule = Rule(name="probe", metric="m", predicate="threshold",
+                op=">", value=1.0, for_seconds=1.0, source="builtin")
+    eng = AlertEngine([rule])
+    doc_hi = {"metrics": {"m": {"type": "gauge", "help": "",
+                                "series": [{"labels": {}, "value": 5.0}]}}}
+    doc_lo = {"metrics": {"m": {"type": "gauge", "help": "",
+                                "series": [{"labels": {}, "value": 0.0}]}}}
+    eng.evaluate(doc_hi, now=100.0)
+    s1 = eng.status_doc()
+    eng.evaluate(doc_hi, now=102.0)
+    s2 = eng.status_doc()
+    eng.evaluate(doc_lo, now=103.0)
+    s3 = eng.status_doc()
+    ok = (s1["active"] and s1["active"][0]["state"] == "pending"
+          and s2["firing"] == ["probe"]
+          and not s3["firing"]
+          and s3["recent_resolved"]
+          and s3["schema"] == SCHEMA)
+    if not ok:
+        print(f"alerts --self-test FAILED: {s1} / {s2} / {s3}")
+        return 1
+    print("alerts --self-test OK "
+          "(pending -> firing -> resolved, schema valid)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.alerts",
+        description="Watchtower alert tooling: validate a rules file "
+                    "(--check, the CI gate) or list the effective rule "
+                    "set.")
+    ap.add_argument("--check", metavar="RULES_JSON",
+                    help="validate a rules file; exit 0 valid / 1 "
+                         "invalid (naming the rule and field, or the "
+                         "JSON line) / 2 unreadable or bad usage")
+    ap.add_argument("--list", action="store_true",
+                    help="print the effective rule set (builtins + "
+                         "alert_rules_path) as JSON")
+    ap.add_argument("--self-test", action="store_true",
+                    help="drive a synthetic rule through the state "
+                         "machine and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if args.check:
+        try:
+            rules = load_rules(args.check)
+        except RulesUnreadable as e:
+            print(f"alerts: {e}")
+            return 2
+        except RuleError as e:
+            print(f"alerts: INVALID rules file: {e}")
+            return 1
+        print(f"alerts: {args.check} OK ({len(rules)} rule(s): "
+              f"{[r.name for r in rules]})")
+        return 0
+    if args.list:
+        try:
+            rules = effective_rules()
+        except RuleError as e:
+            print(f"alerts: {e}")
+            return 1
+        print(json.dumps({"schema": SCHEMA,
+                          "rules": [r.to_dict() for r in rules]},
+                         indent=1))
+        return 0
+    ap.print_usage()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

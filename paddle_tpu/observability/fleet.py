@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import flags
 from . import flight as obs_flight
+from . import journal as obs_journal
 from . import metrics as obs_metrics
 from . import tensorstats as obs_tensorstats
 from . import trace as obs_trace
@@ -108,7 +109,8 @@ def snapshot_payload(rank: int, closing: bool = False) -> dict:
 def events_payload(rank: int, spans: List[dict],
                    flight_bundle: Optional[dict] = None,
                    xray_spans: Optional[List[dict]] = None,
-                   xray_captures: Optional[Dict[str, dict]] = None
+                   xray_captures: Optional[Dict[str, dict]] = None,
+                   journal_events: Optional[List[dict]] = None
                    ) -> dict:
     """Trace spans (+ optional flight bundle + X-ray spans) as one
     fleet payload.  Span timestamps stay in this process's
@@ -129,6 +131,11 @@ def events_payload(rank: int, spans: List[dict],
         # the worker's capture watermark moves): the coordinator's
         # GET /trace/<id> must serve the evidence, not just the worker
         "xray_captures": xray_captures or {},
+        # fleet event journal (observability/journal.py): this
+        # worker's new lifecycle events; the aggregator normalizes
+        # their clocks onto the master timeline and appends them to
+        # the coordinator's journal — ONE ordered fleet record
+        "journal": journal_events or [],
     }
 
 
@@ -157,6 +164,8 @@ class FleetReporter:
         self._xray_cursor = 0
         self._xray_gen = obs_tracectx.generation()
         self._xray_capture_seq = obs_tracectx.capture_seq()
+        self._journal_cursor = 0
+        self._journal_gen = obs_journal.generation()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # serializes flushes: stop()'s closing flush must not interleave
@@ -212,20 +221,26 @@ class FleetReporter:
         cap_seq = obs_tracectx.capture_seq()
         caps = (obs_tracectx.captures()
                 if cap_seq != self._xray_capture_seq else None)
+        jgen, jtotal, new_journal = obs_journal.events_since(
+            self._journal_cursor, self._journal_gen)
         bundle = None
         dumps = obs_flight.dump_count()
         if dumps != self._flight_dumps:
             bundle = obs_flight.last_bundle()
-        if new_spans or new_xray or caps or bundle is not None:
+        if new_spans or new_xray or caps or new_journal \
+                or bundle is not None:
             self._client.report_events(
                 events_payload(self.rank, new_spans, bundle,
                                xray_spans=new_xray,
-                               xray_captures=caps))
+                               xray_captures=caps,
+                               journal_events=new_journal))
         self._span_cursor = total
         self._trace_gen = gen
         self._xray_cursor = xtotal
         self._xray_gen = xgen
         self._xray_capture_seq = cap_seq
+        self._journal_cursor = jtotal
+        self._journal_gen = jgen
         self._flight_dumps = dumps
 
     def stop(self, flush: bool = True):
@@ -391,6 +406,11 @@ class FleetAggregator:
         self._xray: Dict[str, Dict[str, dict]] = {}
         # SLO-breach captures shipped by workers, keyed by trace id
         self._xray_captures: Dict[str, dict] = {}
+        # fleet event journal (observability/journal.py): worker
+        # lifecycle events normalized onto the master clock, one
+        # bounded ordered timeline (and appended to the coordinator's
+        # own journal file for durability)
+        self._journal: List[dict] = []
         self._straggler_warned: set = set()
         # tensorstats sample steps already diagnosed as diverged (warn
         # once per step, bounded — a desynced rank stays desynced)
@@ -527,6 +547,15 @@ class FleetAggregator:
                 self._flights[rank] = payload["flight"]
             for e in payload.get("xray") or []:
                 self._ingest_xray_span(e, rank, offset)
+            journaled = [ev for ev in
+                         (self._ingest_journal_event(e, rank, offset)
+                          for e in payload.get("journal") or [])
+                         if ev is not None]
+        # the durable append happens OUTSIDE the aggregator lock: a
+        # per-event write+flush under it would serialize disk I/O into
+        # every fleet RPC and every metrics/healthz scrape
+        for ev in journaled:
+            obs_journal.append_raw(ev)
             for tid, cap in (payload.get("xray_captures") or {}).items():
                 if not isinstance(cap, dict):
                     continue
@@ -537,6 +566,48 @@ class FleetAggregator:
                 self._xray_captures[str(tid)] = cap
 
     _MAX_XRAY_TRACES = 2048
+    _MAX_JOURNAL = 8192
+
+    def _ingest_journal_event(self, e: dict, rank: int,
+                              offset: float) -> Optional[dict]:
+        """One fleet journal event onto the master clock (call under
+        the lock).  ``perf_counter + offset`` — NOT the worker's own
+        wall clock — the PR 11 X-ray normalization, so a respawned
+        incarnation's fresh perf epoch and a skewed host both land in
+        order on ONE timeline; the original sender stamp survives as
+        ``worker_time_unix``.  Returns the normalized event so the
+        caller can append it to the coordinator's journal file AFTER
+        releasing the lock (one durable merged fleet record, without
+        disk I/O inside the aggregator's critical section)."""
+        try:
+            ev = dict(e)
+            ev["rank"] = int(ev.get("rank", rank))
+            if "perf_counter" in ev:
+                ev["worker_time_unix"] = ev.get("time_unix")
+                ev["time_unix"] = float(ev["perf_counter"]) + offset
+        except (TypeError, ValueError):
+            return None                 # malformed event: drop, not 500
+        self._journal.append(ev)
+        if len(self._journal) > self._MAX_JOURNAL:
+            del self._journal[:len(self._journal) - self._MAX_JOURNAL]
+        return ev
+
+    def journal_events(self) -> List[dict]:
+        """The merged fleet journal timeline, ordered on the master
+        clock (what GET /journal serves next to the local ring)."""
+        with self._lock:
+            out = list(self._journal)
+        out.sort(key=lambda r: (float(r.get("time_unix", 0.0) or 0.0),
+                                r.get("seq", 0)))
+        return out
+
+    def worker_metrics(self, rank: int) -> Optional[dict]:
+        """The latest metric snapshot one rank shipped — the alert
+        engine's context hook: a dead_rank firing pulls the victim's
+        newest exemplar trace ids out of its last snapshot."""
+        with self._lock:
+            w = self._workers.get(int(rank))
+            return w.get("metrics") if w else None
 
     def _ingest_xray_span(self, e: dict, rank: int, offset: float):
         """One X-ray span onto the master clock (call under the lock).
@@ -792,6 +863,12 @@ class FleetAggregator:
         rate = {"type": "gauge",
                 "help": "Rank step rate (steps/s) between its last two "
                         "reports.", "series": {}}
+        dead = {"type": "gauge",
+                "help": "1 when the rank is DEAD (heartbeat-declared) "
+                        "or stale without reports; cleanly-departed "
+                        "ranks leave the family entirely — the "
+                        "dead_rank alert keys on this, so a goodbye "
+                        "is not an alarm.", "series": {}}
         for rank, w in h["per_worker"].items():
             labels = {"worker": rank}
             key = _series_key(labels)
@@ -800,15 +877,27 @@ class FleetAggregator:
                 "value": 0.0 if (w["stale"] or w["departed"]
                                  or w.get("membership") == "dead")
                 else 1.0}
-            age["series"][key] = {
-                "labels": labels,
-                "value": w["last_report_age_s"]
-                if w["last_report_age_s"] is not None else -1.0}
+            # a cleanly-departed rank's report age grows forever and
+            # means nothing — leave it out of the family (like
+            # fleet_worker_dead below) so the stalled_rank alert can't
+            # latch a permanent false alarm on every scale-down
+            if not w["departed"]:
+                age["series"][key] = {
+                    "labels": labels,
+                    "value": w["last_report_age_s"]
+                    if w["last_report_age_s"] is not None else -1.0}
             rate["series"][key] = {"labels": labels,
                                    "value": w["step_rate"]}
+            if not w["departed"]:
+                dead["series"][key] = {
+                    "labels": labels,
+                    "value": 1.0 if (w["stale"]
+                                     or w.get("membership") == "dead")
+                    else 0.0}
         out["fleet_worker_up"] = up
         out["fleet_worker_report_age_seconds"] = age
         out["fleet_worker_step_rate"] = rate
+        out["fleet_worker_dead"] = dead
         return out
 
     def prometheus_text(self, local: Optional[dict] = None,

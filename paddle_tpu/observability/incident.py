@@ -1,0 +1,447 @@
+"""Incident reconstruction CLI: one report for "what happened at 14:32".
+
+Joins the artifacts the observability plane already produces — the
+merged fleet event journal (observability/journal.py), the alert
+engine's fire/resolve history (observability/alerts.py), the run-log
+window (observability/runlog.py) and the X-ray trace ids riding all of
+them — into ONE ordered timeline (schema ``paddle_tpu.incident.v1``
+plus an ASCII rendering), so "rank 0 chaos-killed at T+3.2s, lease
+fenced, supervisor respawn #2, p99 alert resolved at T+9.1s" is one
+command instead of five hand-joined file formats::
+
+    # a time window over journal files (coordinator + per-rank)
+    python -m paddle_tpu.observability.incident coord.jsonl w0.jsonl \
+        --window 1700000000:1700000040
+
+    # everything around one alert's fire..resolve, alerts fetched live
+    python -m paddle_tpu.observability.incident coord.jsonl \
+        --alert dead_rank --url http://127.0.0.1:9100
+
+    # everything stamped with one trace id
+    python -m paddle_tpu.observability.incident coord.jsonl \
+        --trace-id 4bf92f3577b34da6a3ce929d0e0e4736
+
+Journal files merge with at-least-once dedupe (an event shipped to the
+coordinator AND read from its emitter's own file appears once) and
+order on ``time_unix`` — master-normalized for shipped events, so
+cross-host skew is already absorbed.  ``--url`` additionally pulls
+``GET /alerts`` (history + contexts) and ``GET /journal`` (the
+coordinator's in-memory merged tail) from a live endpoint.
+
+Exit codes: 0 report rendered, 1 selector matched nothing / malformed
+input, 2 bad usage — the lint/xray/jit_cache CLI contract.
+``--self-test`` reconstructs a bundled kill → fence → respawn →
+resolve fixture (the tier-1 smoke).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import journal as obs_journal
+
+SCHEMA = "paddle_tpu.incident.v1"
+
+# journal/runlog fields that are record plumbing, not incident detail
+_SKIP_FIELDS = {"schema", "kind", "event", "time_unix", "perf_counter",
+                "rank", "pid", "seq", "worker_time_unix", "trace_id"}
+
+
+# -- gathering --------------------------------------------------------------
+
+def _fetch_json(url: str) -> dict:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def gather_events(journal_paths: List[str],
+                  url: Optional[str] = None,
+                  alerts_doc: Optional[dict] = None,
+                  runlog_records: Optional[List[dict]] = None
+                  ) -> Tuple[List[dict], List[dict]]:
+    """Collect (timeline events, alert transition records) from every
+    source.  Timeline events are journal records plus runlog guard/meta
+    records (steps stay a count, not noise) plus alert transitions NOT
+    already journaled (the engine journals its own fire/resolve — the
+    history is the fallback when only /alerts was captured)."""
+    streams = [obs_journal.read_events(p) for p in journal_paths]
+    if url:
+        doc = _fetch_json(url.rstrip("/") + "/journal")
+        streams.append([e for e in doc.get("events", [])
+                        if isinstance(e, dict)])
+    events = obs_journal.merge_events(streams)
+
+    alert_history: List[dict] = list((alerts_doc or {}).get("history",
+                                                            []))
+    # the engine journals its own transitions with its OWN clock a few
+    # ms after the history entry's evaluation stamp — dedupe by
+    # tolerance, not rounded equality (a 0.049 vs 0.051 pair must not
+    # double-draw the same fire)
+    journaled: Dict[Tuple[str, str], List[float]] = {}
+    for e in events:
+        if e.get("kind") == "alert":
+            journaled.setdefault(
+                (e.get("rule"), e.get("event")), []).append(
+                float(e.get("time_unix", 0.0)))
+    for rec in alert_history:
+        state = rec.get("state")
+        if state not in ("firing", "resolved"):
+            continue
+        ev_name = "fire" if state == "firing" else "resolve"
+        t = float(rec.get("time_unix", 0.0))
+        if any(abs(t - tj) <= 0.5
+               for tj in journaled.get((rec.get("rule"), ev_name), ())):
+            continue             # the journal already carries it
+        events.append({"schema": obs_journal.SCHEMA, "kind": "alert",
+                       "event": ev_name, "time_unix": t,
+                       "rank": None, "rule": rec.get("rule"),
+                       "severity": rec.get("severity"),
+                       "value": rec.get("value"),
+                       "labels": rec.get("labels")})
+    for rec in runlog_records or []:
+        kind = rec.get("kind")
+        if kind == "guard":
+            events.append({
+                "schema": obs_journal.SCHEMA, "kind": "runlog",
+                "event": f"guard_{rec.get('verdict')}",
+                "time_unix": float(rec.get("time_unix", 0.0)),
+                "rank": None, "step": rec.get("step"),
+                "loss": rec.get("loss"),
+                "attribution": rec.get("attribution"),
+                "trace_id": rec.get("trace_id")})
+        elif kind == "meta":
+            events.append({
+                "schema": obs_journal.SCHEMA, "kind": "runlog",
+                "event": str(rec.get("event")),
+                "time_unix": float(rec.get("time_unix", 0.0)),
+                "rank": None})
+    events.sort(key=lambda r: (float(r.get("time_unix", 0.0) or 0.0),
+                               r.get("seq", 0)))
+    return events, alert_history
+
+
+# -- window selection -------------------------------------------------------
+
+def resolve_window(events: List[dict], alert_history: List[dict],
+                   window: Optional[str] = None,
+                   alert: Optional[str] = None,
+                   trace_id: Optional[str] = None,
+                   pad: float = 5.0) -> Tuple[float, float, dict]:
+    """(t0, t1, selector-doc) per the CLI's three addressing modes;
+    raises ValueError when the selector matches nothing."""
+    if window:
+        try:
+            lo, hi = window.split(":", 1)
+            t0, t1 = float(lo), float(hi)
+        except ValueError:
+            raise ValueError(
+                f"--window must be '<t0_unix>:<t1_unix>', got "
+                f"{window!r}")
+        if t1 <= t0:
+            raise ValueError(f"--window is empty: {t0} >= {t1}")
+        return t0, t1, {"mode": "window", "t0": t0, "t1": t1}
+    if alert:
+        fires = [e for e in events if e.get("kind") == "alert"
+                 and e.get("rule") == alert and e.get("event") == "fire"]
+        resolves = [e for e in events if e.get("kind") == "alert"
+                    and e.get("rule") == alert
+                    and e.get("event") == "resolve"]
+        for rec in alert_history:
+            if rec.get("rule") != alert:
+                continue
+            t = float(rec.get("time_unix", 0.0))
+            if rec.get("state") == "firing":
+                fires.append({"time_unix": t})
+            elif rec.get("state") == "resolved":
+                resolves.append({"time_unix": t})
+        if not fires:
+            raise ValueError(f"alert {alert!r} never fired in the "
+                             f"given journals/history")
+        t_fire = min(float(e["time_unix"]) for e in fires)
+        t_end = max((float(e["time_unix"]) for e in resolves),
+                    default=t_fire)
+        return (t_fire - pad, t_end + pad,
+                {"mode": "alert", "alert": alert,
+                 "fired_unix": t_fire,
+                 "resolved_unix": t_end if resolves else None})
+    if trace_id:
+        hits = [float(e["time_unix"]) for e in events
+                if e.get("trace_id") == trace_id]
+        if not hits:
+            raise ValueError(f"trace id {trace_id!r} appears in no "
+                             f"journal/runlog record")
+        return (min(hits) - pad, max(hits) + pad,
+                {"mode": "trace", "trace_id": trace_id})
+    if not events:
+        raise ValueError("no events at all (empty journals and no "
+                         "selector)")
+    ts = [float(e.get("time_unix", 0.0)) for e in events]
+    return min(ts), max(ts) + 1e-6, {"mode": "all"}
+
+
+# -- report -----------------------------------------------------------------
+
+def _detail(ev: dict) -> Dict[str, Any]:
+    return {k: v for k, v in ev.items() if k not in _SKIP_FIELDS}
+
+
+def build_report(events: List[dict], alert_history: List[dict],
+                 t0: float, t1: float, selector: dict,
+                 runlog_records: Optional[List[dict]] = None) -> dict:
+    """The ``paddle_tpu.incident.v1`` document for one window."""
+    rows = []
+    trace_ids: List[str] = []
+    for ev in events:
+        t = float(ev.get("time_unix", 0.0))
+        if not t0 <= t <= t1:
+            continue
+        row = {"time_unix": t, "offset_s": round(t - t0, 6),
+               "kind": ev.get("kind"), "event": ev.get("event"),
+               "rank": ev.get("rank")}
+        det = _detail(ev)
+        if det:
+            row["detail"] = det
+        tid = ev.get("trace_id")
+        if tid:
+            row["trace_id"] = tid
+            if tid not in trace_ids:
+                trace_ids.append(tid)
+        rows.append(row)
+    alerts = []
+    for rec in alert_history:
+        if rec.get("state") != "firing":
+            continue
+        t = float(rec.get("time_unix", 0.0))
+        if not t0 <= t <= t1:
+            continue
+        entry = {"rule": rec.get("rule"),
+                 "severity": rec.get("severity"),
+                 "fired_unix": t, "labels": rec.get("labels"),
+                 "value": rec.get("value")}
+        ctx = rec.get("context") or {}
+        if ctx:
+            entry["context"] = ctx
+            for tid in ctx.get("exemplar_trace_ids") or []:
+                if tid not in trace_ids:
+                    trace_ids.append(tid)
+            if ctx.get("alert_trace_id") \
+                    and ctx["alert_trace_id"] not in trace_ids:
+                trace_ids.append(ctx["alert_trace_id"])
+        res = [float(h.get("time_unix", 0.0)) for h in alert_history
+               if h.get("rule") == rec.get("rule")
+               and h.get("state") == "resolved"
+               and float(h.get("time_unix", 0.0)) >= t]
+        if res:
+            entry["resolved_unix"] = min(res)
+        alerts.append(entry)
+    steps = sum(1 for r in runlog_records or []
+                if r.get("kind") == "step"
+                and t0 <= float(r.get("time_unix", 0.0)) <= t1)
+    ranks = sorted({r["rank"] for r in rows
+                    if isinstance(r.get("rank"), int)})
+    return {"schema": SCHEMA, "generated_unix": time.time(),
+            "selector": selector,
+            "window": {"t0_unix": t0, "t1_unix": t1,
+                       "duration_s": round(t1 - t0, 6)},
+            "ranks": ranks,
+            "timeline": rows, "alerts": alerts,
+            "steps_in_window": steps,
+            "trace_ids": trace_ids}
+
+
+def render_report(doc: dict) -> str:
+    """ASCII incident timeline — enough forensics for a terminal."""
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(f"not a {SCHEMA} document "
+                         f"(schema={doc.get('schema')!r})")
+    w = doc.get("window", {})
+    sel = doc.get("selector", {})
+    lines = [f"incident  window {w.get('t0_unix')} .. "
+             f"{w.get('t1_unix')}  (+{w.get('duration_s')}s, "
+             f"selector={sel.get('mode')}"
+             + (f" {sel.get('alert')}" if sel.get("alert") else "")
+             + (f" {sel.get('trace_id')}" if sel.get("trace_id") else "")
+             + f", ranks={doc.get('ranks')})"]
+    for a in doc.get("alerts", []):
+        t0 = float(w.get("t0_unix", 0.0))
+        fired = float(a.get("fired_unix", 0.0))
+        res = a.get("resolved_unix")
+        ctx = a.get("context") or {}
+        lines.append(
+            f"  alert {a.get('rule')} [{a.get('severity')}] "
+            f"fired T+{fired - t0:.3f}s"
+            + (f", resolved T+{float(res) - t0:.3f}s" if res else
+               ", UNRESOLVED")
+            + (f", ranks={ctx.get('ranks')}" if ctx.get("ranks") else "")
+            + (f", trace={ctx.get('exemplar_trace_ids')[0][:16]}…"
+               if ctx.get("exemplar_trace_ids") else ""))
+    lines.append(f"  timeline ({len(doc.get('timeline', []))} event(s), "
+                 f"{doc.get('steps_in_window', 0)} train step(s) in "
+                 f"window):")
+    for ev in doc.get("timeline", []):
+        rank = ev.get("rank")
+        r = f"r{rank}" if isinstance(rank, int) else "--"
+        det = ev.get("detail") or {}
+        det_s = " ".join(f"{k}={det[k]}" for k in sorted(det)
+                         if det[k] is not None)[:100]
+        lines.append(f"  T+{ev['offset_s']:>8.3f}s  {r:<3} "
+                     f"{str(ev.get('kind')):<10} "
+                     f"{str(ev.get('event')):<20} {det_s}")
+    if doc.get("trace_ids"):
+        lines.append(f"  waterfall refs: "
+                     f"{', '.join(t[:16] + '…' for t in doc['trace_ids'][:6])}"
+                     f"  (GET /trace/<id> or the xray CLI)")
+    return "\n".join(lines)
+
+
+# -- self-test --------------------------------------------------------------
+
+def _fixture_events() -> List[dict]:
+    """A miniature but structurally complete incident: rank 0 is
+    chaos-killed mid-step, the master fences its lease and declares it
+    dead, the supervisor respawns it, the dead-rank alert fires and
+    resolves — what --self-test reconstructs with no live fleet."""
+    T = 1700000000.0
+
+    def ev(dt, kind, event, rank, seq, **fields):
+        return {"schema": obs_journal.SCHEMA, "kind": kind,
+                "event": event, "time_unix": T + dt, "rank": rank,
+                "pid": 100 + (rank or 0), "seq": seq, **fields}
+
+    return [
+        ev(0.0, "worker", "step", 0, 1, step=7,
+           trace_id="4bf92f3577b34da6a3ce929d0e0e4736"),
+        ev(0.8, "chaos", "injected", 0, 2, site="trainer.step",
+           fault_kind="exit", n=8),
+        ev(1.4, "master", "worker_dead", None, 3, dead_rank=0),
+        ev(1.5, "master", "lease_fenced", None, 4, verb="heartbeat",
+           fenced_rank=0),
+        ev(1.6, "alert", "fire", None, 5, rule="dead_rank",
+           severity="critical", labels={"worker": "0"}),
+        ev(2.1, "supervisor", "restart_scheduled", None, 6,
+           restart_rank=0, attempt=1),
+        ev(2.4, "supervisor", "spawn", None, 7, spawn_rank=0,
+           incarnation=1),
+        ev(3.0, "master", "worker_registered", None, 8,
+           registered_rank=0),
+        ev(3.2, "alert", "resolve", None, 9, rule="dead_rank",
+           severity="critical"),
+    ]
+
+
+def _self_test() -> int:
+    events = _fixture_events()
+    t0, t1, sel = resolve_window(events, [], alert="dead_rank",
+                                 pad=2.0)
+    doc = build_report(events, [], t0, t1, sel)
+    order = [(e["kind"], e["event"]) for e in doc["timeline"]]
+    want = [("chaos", "injected"), ("master", "worker_dead"),
+            ("alert", "fire"), ("supervisor", "spawn"),
+            ("alert", "resolve")]
+    pos = []
+    for item in want:
+        if item not in order:
+            print(f"incident --self-test FAILED: {item} missing from "
+                  f"{order}")
+            return 1
+        pos.append(order.index(item))
+    if pos != sorted(pos):
+        print(f"incident --self-test FAILED: out of order {order}")
+        return 1
+    text = render_report(doc)
+    needed = ["chaos", "worker_dead", "spawn", "resolve",
+              "waterfall refs"]
+    missing = [n for n in needed if n not in text]
+    if missing or doc["schema"] != SCHEMA:
+        print(f"incident --self-test FAILED: render missing {missing}\n"
+              f"{text}")
+        return 1
+    print("incident --self-test OK (kill -> fence -> respawn -> "
+          "resolve reconstructed in order)")
+    return 0
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.incident",
+        description="Reconstruct one fleet incident from journal + "
+                    "alerts + runlog artifacts: a paddle_tpu."
+                    "incident.v1 report and an ASCII timeline.")
+    ap.add_argument("journals", nargs="*",
+                    help="journal JSONL file(s) — the coordinator's "
+                         "merged file and/or per-rank files (deduped)")
+    ap.add_argument("--url", help="live endpoint root: pulls GET "
+                                  "/alerts and GET /journal")
+    ap.add_argument("--alerts", metavar="JSON",
+                    help="a saved paddle_tpu.alerts.v1 document "
+                         "(GET /alerts output) for history/contexts")
+    ap.add_argument("--runlog", metavar="JSONL",
+                    help="a paddle_tpu.runlog.v1 run history: guard "
+                         "records join the timeline, steps are counted")
+    ap.add_argument("--window", metavar="T0:T1",
+                    help="unix-seconds window")
+    ap.add_argument("--alert", metavar="RULE",
+                    help="window = RULE's first fire .. last resolve "
+                         "(+/- --pad)")
+    ap.add_argument("--trace-id", metavar="ID",
+                    help="window = every record stamped with ID "
+                         "(+/- --pad)")
+    ap.add_argument("--pad", type=float, default=5.0,
+                    help="seconds of context around --alert/--trace-id "
+                         "(default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw report document")
+    ap.add_argument("--self-test", action="store_true",
+                    help="reconstruct the bundled fixture and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.journals and not args.url:
+        ap.print_usage()
+        print("incident: need at least one journal file or --url",
+              file=sys.stderr)
+        return 2
+    if sum(bool(x) for x in (args.window, args.alert,
+                             args.trace_id)) > 1:
+        print("incident: --window/--alert/--trace-id are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
+    try:
+        alerts_doc = None
+        if args.alerts:
+            with open(args.alerts, encoding="utf-8") as f:
+                alerts_doc = json.load(f)
+        elif args.url:
+            alerts_doc = _fetch_json(args.url.rstrip("/") + "/alerts")
+        runlog_records = None
+        if args.runlog:
+            # runlog is a CLI module: import only when actually asked
+            # for (the PR 7 runpy idiom)
+            from . import runlog as obs_runlog
+            runlog_records = obs_runlog.read_records(args.runlog)
+        events, history = gather_events(
+            args.journals, url=args.url, alerts_doc=alerts_doc,
+            runlog_records=runlog_records)
+        t0, t1, sel = resolve_window(
+            events, history, window=args.window, alert=args.alert,
+            trace_id=args.trace_id, pad=args.pad)
+        doc = build_report(events, history, t0, t1, sel,
+                           runlog_records=runlog_records)
+    except (OSError, ValueError) as e:
+        print(f"incident: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True, default=repr))
+        return 0
+    print(render_report(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

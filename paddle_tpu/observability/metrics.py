@@ -484,6 +484,58 @@ def _escape_help(s: str) -> str:
 REGISTRY = MetricsRegistry()
 
 
+# -- histogram quantiles ----------------------------------------------------
+# Promoted out of serving/__init__.py (which re-exports for compat): the
+# alert engine needs quantile predicates over any histogram — including
+# rows of a FLEET-MERGED document — without importing the serving plane.
+
+def histogram_row_quantiles(row: dict, qs: Sequence[float]
+                            ) -> Optional[dict]:
+    """Bucket-interpolated quantiles for ONE histogram series row in
+    the ``paddle_tpu.metrics.v1`` JSON schema (``buckets`` map +
+    ``overflow``/``count``/``sum``) — works on the local registry's
+    to_json() rows and on fleet-merged rows alike.  Returns None when
+    the row has no observations."""
+    count = int(row.get("count", 0))
+    if count <= 0:
+        return None
+    bounds = sorted((float(b), int(c))
+                    for b, c in (row.get("buckets") or {}).items())
+    out = {}
+    for q in qs:
+        target = q * count
+        cum = 0
+        val = None
+        for b, c in bounds:
+            cum += c
+            if cum >= target:
+                val = b
+                break
+        if val is None:              # landed in the overflow bucket
+            val = bounds[-1][0] if bounds else 0.0
+        out[f"p{int(round(q * 100))}"] = val
+    out["count"] = count
+    out["mean"] = float(row.get("sum", 0.0)) / count
+    return out
+
+
+def histogram_quantiles(name: str, qs: Sequence[float]
+                        ) -> Optional[dict]:
+    """Bucket-interpolated quantiles of a registry histogram's
+    unlabeled series (the p50/p99 the /serving route reports) — one
+    interpolation implementation, shared with the doc-row path.
+    Returns None when the histogram has no observations."""
+    m = REGISTRY.get(name)
+    if m is None or m.buckets is None:
+        return None
+    s = m._series.get(())
+    if s is None or s.count == 0:
+        return None
+    return histogram_row_quantiles(
+        {"buckets": dict(zip(m.buckets, s.bucket_counts)),
+         "count": s.count, "sum": s.sum}, qs)
+
+
 def counter(name: str, help: str = "",
             labelnames: Sequence[str] = ()) -> Counter:
     return REGISTRY.register(Counter(name, help, labelnames))

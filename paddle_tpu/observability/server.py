@@ -52,9 +52,15 @@ _server: Optional["ObservabilityServer"] = None
 
 # trainer liveness for /healthz: updated by Trainer.train at every step
 _liveness = {"steps": 0, "last_step_unix": None, "running": False}
-# a RUNNING trainer with no step for this long reads as hung on
-# /healthz (degraded); a finished or never-started trainer does not
-_TRAINER_STALE_S = 60.0
+
+
+def _trainer_stale_s() -> float:
+    """A RUNNING trainer with no step for this long reads as hung on
+    /healthz (degraded); a finished or never-started trainer does not.
+    Flag-tunable (was hardcoded 60s): miniature soaks and slow-step
+    training both need non-default values, and the Watchtower
+    stalled_rank alert rule shares the same knob."""
+    return float(flags.get_flag("healthz_stall_seconds"))
 
 
 def note_trainer_step():
@@ -75,13 +81,14 @@ def note_trainer_running(running: bool):
 def trainer_liveness() -> dict:
     last = _liveness["last_step_unix"]
     age = None if last is None else time.time() - last
+    stale_s = _trainer_stale_s()
     return {"steps": _liveness["steps"],
             "last_step_unix": last,
             "last_step_age_s": None if age is None else round(age, 3),
             "running": _liveness["running"],
-            "alive": age is not None and age < _TRAINER_STALE_S,
+            "alive": age is not None and age < stale_s,
             "hung": (_liveness["running"] and age is not None
-                     and age > _TRAINER_STALE_S)}
+                     and age > stale_s)}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -133,6 +140,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, obs.model())
             elif path == "/serving":
                 self._send_json(200, obs.serving())
+            elif path == "/alerts":
+                self._send_json(200, obs.alerts())
+            elif path == "/journal":
+                self._send_json(200, obs.journal())
             elif path.startswith("/trace/"):
                 trace_id = path[len("/trace/"):]
                 doc = obs.trace(trace_id)
@@ -148,7 +159,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/":
                 self._send(200, b"paddle_tpu observability: /metrics "
                                 b"/metrics.json /healthz /flight "
-                                b"/model /serving /trace/<id> "
+                                b"/model /serving /alerts /journal "
+                                b"/trace/<id> "
                                 b"[POST /serving/generate /profile]\n",
                            "text/plain; charset=utf-8")
             else:
@@ -319,6 +331,50 @@ class ObservabilityServer:
         from .. import serving as serving_mod
         return serving_mod.status_doc()
 
+    def alerts(self) -> dict:
+        """``GET /alerts``: the Watchtower engine's state after one
+        evaluation — over the FLEET-merged document on a coordinator
+        (the engine's doc_fn is wired to metrics_json when an
+        aggregator is attached), the local registry otherwise."""
+        from . import alerts as obs_alerts
+        eng = obs_alerts.ensure_started()
+        if eng is None:
+            return {"schema": obs_alerts.SCHEMA,
+                    "time_unix": time.time(), "enabled": False,
+                    "rules": [], "active": [], "firing": [],
+                    "history": []}
+        self._wire_alerts(eng)
+        eng.evaluate()
+        doc = eng.status_doc()
+        doc["source"] = ("fleet" if self.aggregator is not None
+                         else "local")
+        return doc
+
+    def _wire_alerts(self, eng) -> None:
+        """Point the engine at THIS server's (possibly fleet-merged)
+        metrics view so the ticker and scrapes evaluate one consistent
+        source — a coordinator engine flipping between local and merged
+        docs would flap every fleet-only series."""
+        if self.aggregator is not None:
+            if eng.doc_fn is None:
+                eng.doc_fn = self.metrics_json
+            if eng.snapshot_provider is None:
+                eng.snapshot_provider = self.aggregator.worker_metrics
+
+    def journal(self) -> dict:
+        """``GET /journal``: the fleet event journal — this process's
+        newest events merged (deduped) with the aggregator's
+        clock-normalized fleet timeline when one is attached."""
+        from . import journal as obs_journal
+        streams = [obs_journal.tail(1000)]
+        if self.aggregator is not None:
+            streams.append(self.aggregator.journal_events())
+        events = obs_journal.merge_events(streams)
+        return {"schema": obs_journal.SCHEMA,
+                "time_unix": time.time(),
+                "enabled": obs_journal.enabled(),
+                "events": events[-1000:]}
+
     def trace(self, trace_id: str) -> Optional[dict]:
         """``GET /trace/<id>``: the assembled X-ray waterfall.  With an
         aggregator the FLEET view wins (router + worker spans merged on
@@ -431,6 +487,7 @@ def start_http_server(port: Optional[int] = None,
                         "observability server already running with a "
                         "different FleetAggregator; stop_http_server() "
                         "first")
+            _start_alert_engine(_server)
             return _server
         if port is None:
             port = int(flags.get_flag("obs_http_port"))
@@ -441,7 +498,23 @@ def start_http_server(port: Optional[int] = None,
             # scrapes (a Prometheus target / the operator's curl)
             host = str(flags.get_flag("obs_http_host"))
         _server = ObservabilityServer(host, port, aggregator=aggregator)
+        _start_alert_engine(_server)
         return _server
+
+
+def _start_alert_engine(server: "ObservabilityServer"):
+    """Flag-gated: bring the Watchtower ticker up alongside the HTTP
+    endpoint and point it at this server's metrics view (fleet-merged
+    when an aggregator rides along) — alerts must fire on their own
+    clock, not only when somebody scrapes /alerts.  Never raises:
+    alerting is an overlay, not a dependency."""
+    try:
+        from . import alerts as obs_alerts
+        eng = obs_alerts.ensure_started()
+        if eng is not None:
+            server._wire_alerts(eng)
+    except Exception:
+        pass
 
 
 def ensure_started() -> Optional[ObservabilityServer]:

@@ -50,6 +50,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import flags
 from ..observability import flight as obs_flight
+from ..observability import journal as obs_journal
 from ..observability import metrics as obs_metrics
 
 _m_injected = obs_metrics.counter(
@@ -172,6 +173,11 @@ def _decide(fault: Fault) -> Optional[int]:
         _fired.append((fault.site, n, fault.kind))
     _m_injected.labels(site=fault.site, kind=fault.kind).inc()
     obs_flight.record("chaos", fault.site, fault_kind=fault.kind, n=n)
+    # journaled BEFORE the fault acts (the write flushes per line), so
+    # even an `exit`-kind hard kill leaves "chaos killed me HERE" in
+    # the victim's journal for the incident timeline
+    obs_journal.emit("chaos", "injected", site=fault.site,
+                     fault_kind=fault.kind, n=n)
     return n
 
 

@@ -112,7 +112,12 @@ def main(argv=None) -> int:
     from paddle_tpu.distributed.task_queue import (Heartbeater,
                                                    TaskMasterClient)
     from paddle_tpu.incubate import checkpoint as ckpt
+    from paddle_tpu.observability import journal as obs_journal
     from paddle_tpu.resilience import chaos
+
+    # fleet identity on every journal event this rank emits (chaos
+    # fires, checkpoint commits) — the incident timeline's rank column
+    obs_journal.set_rank(rank)
 
     hb = Heartbeater(endpoints, rank)
     hb.start()

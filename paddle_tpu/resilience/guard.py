@@ -24,6 +24,7 @@ from typing import Optional
 
 from ..core import flags
 from ..observability import flight as obs_flight
+from ..observability import journal as obs_journal
 from ..observability import metrics as obs_metrics
 from ..observability import tensorstats as obs_tensorstats
 
@@ -123,12 +124,23 @@ class NumericGuard:
                           consecutive_bad=self.consecutive_bad,
                           policy=self.policy, first_var=label,
                           attribution=detail)
+        # the fleet journal carries the trip WITH its first-bad-var:
+        # "what corrupted, where, under which policy" joins the
+        # incident timeline next to chaos/supervisor/master events
+        obs_journal.emit("guard", verdict, loss=loss,
+                         consecutive_bad=self.consecutive_bad,
+                         policy=self.policy, first_var=label,
+                         attribution=detail)
         if 0 < self.bad_step_limit <= self.consecutive_bad:
             obs_flight.dump("circuit_breaker",
                             extra={"verdict": verdict, "loss": loss,
                                    "consecutive_bad": self.consecutive_bad,
                                    "bad_step_limit": self.bad_step_limit,
                                    "attribution": detail})
+            obs_journal.emit("guard", "circuit_breaker", loss=loss,
+                             consecutive_bad=self.consecutive_bad,
+                             bad_step_limit=self.bad_step_limit,
+                             attribution=detail)
             raise CircuitBreakerOpen(
                 f"{self.consecutive_bad} consecutive bad steps (last: "
                 f"{verdict}, loss={loss!r}, {detail}) >= bad_step_limit "

@@ -24,9 +24,13 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Optional
 
 from ..observability import metrics as obs_metrics
+# promoted to observability/metrics.py (ISSUE 15: the alert engine
+# needs quantile predicates without importing the serving plane);
+# re-exported here so existing serving callers keep working
+from ..observability.metrics import histogram_quantiles  # noqa: F401
 from .batcher import ContinuousBatcher, ServingRequest, ShedError
 from .kv_cache import DecodeEngine, extract_lm_params
 
@@ -62,34 +66,6 @@ def reset():
         b, _batcher = _batcher, None
     if b is not None:
         b.stop()
-
-
-def histogram_quantiles(name: str, qs: List[float]) -> Optional[dict]:
-    """Bucket-interpolated quantiles of a registry histogram (the
-    p50/p99 the /serving route reports).  Returns None when the
-    histogram has no observations."""
-    m = obs_metrics.REGISTRY.get(name)
-    if m is None or m.buckets is None:
-        return None
-    s = m.series().get(())
-    if s is None or s.count == 0:
-        return None
-    out = {}
-    for q in qs:
-        target = q * s.count
-        cum = 0
-        val = None
-        for b, c in zip(m.buckets, s.bucket_counts):
-            cum += c
-            if cum >= target:
-                val = b
-                break
-        if val is None:              # landed in the overflow bucket
-            val = m.buckets[-1]
-        out[f"p{int(round(q * 100))}"] = val
-    out["count"] = s.count
-    out["mean"] = s.sum / s.count
-    return out
 
 
 def status_doc() -> dict:

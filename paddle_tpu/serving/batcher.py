@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..core import flags
 from ..observability import flight as obs_flight
+from ..observability import journal as obs_journal
 from ..observability import metrics as obs_metrics
 from ..observability import tracectx as obs_tracectx
 from ..resilience import chaos
@@ -328,6 +329,9 @@ class ContinuousBatcher:
         obs_flight.record("serving", "drain_begin",
                           queued=self.queue_depth,
                           active=len(self._slots))
+        obs_journal.emit("serving", "drain_begin",
+                         queued=self.queue_depth,
+                         active=len(self._slots), stop=stop)
         self._shed_queue("drained", "serving is draining (SIGTERM)")
         self._wake.set()
 
@@ -516,6 +520,7 @@ class ContinuousBatcher:
                 drain_done = self._draining and not active
             if drain_done:
                 if self._stop_after_drain:
+                    obs_journal.emit("serving", "drain_complete")
                     break
                 self._wake.wait(0.05)
                 self._wake.clear()

@@ -35,6 +35,7 @@ from .framework.executor import Executor, Scope
 from .framework.program import Program, program_guard
 from .observability import costmodel as obs_cost
 from .observability import flight as obs_flight
+from .observability import journal as obs_journal
 from .observability import metrics as obs_metrics
 from .observability import server as obs_server
 from .observability import tensorstats as obs_tensorstats
@@ -236,6 +237,9 @@ class Trainer:
                 obs_flight.record("trainer", "resumed", serial=serial,
                                   epoch=self.epoch_offset,
                                   step=self.step_offset)
+                obs_journal.emit("trainer", "resumed", serial=serial,
+                                 epoch=self.epoch_offset,
+                                 step=self.step_offset)
 
     def _dist_transpile_if_necessary(self, mesh):
         """ref contrib/trainer.py _dist_transpile_if_necessary: the same
@@ -375,6 +379,12 @@ class Trainer:
         stop = self._install_preemption_handlers()
         obs_server.ensure_started()     # obs_http_port flag, 0 = off
         obs_server.note_trainer_running(True)
+        # Watchtower (alert_rules_path flag, "" = off): the local alert
+        # ticker watches this worker's own registry; imported lazily so
+        # the alerts CLI module stays out of the package import graph
+        if flags.get_flag("alert_rules_path"):
+            from .observability import alerts as obs_alerts
+            obs_alerts.ensure_started()
         # durable run history (runlog_path flag, "" = off): one JSONL
         # record per step — loss, lr, throughput, MFU, guard verdicts,
         # sampled tensor stats — surviving the process so two runs can
@@ -807,6 +817,8 @@ class Trainer:
         obs_flight.record("trainer", "preempted",
                           signum=stop["signum"], epoch=epoch_id,
                           step=step_id)
+        obs_journal.emit("trainer", "preempted", signum=stop["signum"],
+                         epoch=epoch_id, step=step_id)
         obs_flight.dump("preemption",
                         extra={"signum": stop["signum"],
                                "epoch": epoch_id, "step": step_id})

@@ -44,8 +44,10 @@ def fresh_programs():
     from paddle_tpu import analysis
     from paddle_tpu.distributed import task_queue
     from paddle_tpu.framework import executor as executor_mod
+    from paddle_tpu.observability import alerts as obs_alerts
     from paddle_tpu.observability import costmodel, flight, forensics
     from paddle_tpu.observability import deviceprof, metrics as obs_metrics
+    from paddle_tpu.observability import journal as obs_journal
     from paddle_tpu.observability import runlog, tensorstats, tracectx
     from paddle_tpu.observability import server as obs_server
     from paddle_tpu.resilience import chaos
@@ -62,6 +64,14 @@ def fresh_programs():
     # file handles must not leak across cases
     tensorstats.reset()
     runlog.reset()
+    # Watchtower: stop any alert ticker thread, drop engine state and
+    # the firing gauges; close journal writers and wipe the shipping
+    # ring — one case's firing alerts / journal events must not leak
+    # into the next, and both flags default back to off
+    obs_alerts.reset()
+    obs_journal.reset()
+    pt.core.flags.set_flag("alert_rules_path", "")
+    pt.core.flags.set_flag("journal_path", "")
     # request X-ray: traces/captures from one case must not resolve in
     # the next (GET /trace, exemplar trace ids), and the device-prof
     # capture latch must not read busy across cases
@@ -91,6 +101,10 @@ def fresh_programs():
     obs_server.reset()
     task_queue.reset_state()
     serving.reset()
+    obs_alerts.reset()
+    obs_journal.reset()
+    pt.core.flags.set_flag("alert_rules_path", "")
+    pt.core.flags.set_flag("journal_path", "")
     pt.core.flags.set_flag("jit_cache_dir", "")
 
 

@@ -123,6 +123,79 @@ def test_executor_warm_start_inproc(tmp_path):
     assert forensics.compile_log()[-1]["jit_cache"] == "miss"
 
 
+def test_donate_feeds_twin_persisted_warm(tmp_path):
+    """PR 12 follow-up (ISSUE 15 satellite): the donate-feeds twin
+    executable (the trainer ``prefetch_depth`` path) persists under its
+    own key — step components + a ``donate_feeds`` marker — so a warm
+    prefetch restart deserializes it: compile counters and forensics
+    stay FROZEN and outputs are bit-identical."""
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program()
+    out_cold = exe.run(prog, feed=_feed(), fetch_list=[loss],
+                       donate_feeds=True)
+    # startup entry + the donate twin; the PLAIN step entry is NOT
+    # stored (nothing ever dispatched it — no hidden AOT work)
+    assert len(_entries(tmp_path)) == 2
+    rows = {r["hash"]: r["components"] for r in jit_cache.ls()}
+    donate_rows = [c for c in rows.values()
+                   if c.get("donate_feeds") is True]
+    assert len(donate_rows) == 1
+    c0 = _tot("executor_compile_total")
+    f0 = len(forensics.compile_log())
+    h0 = _tot("jit_cache_hits_total")
+    e0 = _tot("jit_cache_errors_total")
+    # the restarted-process shape: fresh in-memory jit cache, donating
+    # dispatch resolves the TWIN from disk — zero compile bookings
+    exe2 = pt.Executor(pt.CPUPlace(), scope=exe.scope)
+    out_warm = exe2.run(prog, feed=_feed(), fetch_list=[loss],
+                        donate_feeds=True)
+    assert _tot("executor_compile_total") == c0
+    assert len(forensics.compile_log()) == f0
+    assert _tot("jit_cache_hits_total") == h0 + 1
+    assert _tot("jit_cache_errors_total") == e0
+    assert np.array_equal(out_cold[0], out_warm[0])
+    rep = exe2.explain(prog, feed=_feed(), fetch_list=[loss])
+    assert rep["jit_cache"]["source"] == "disk"
+
+
+def test_donate_twin_and_plain_entries_coexist(tmp_path):
+    """Donating and plain dispatches of the SAME program key two
+    distinct entries; a warm process serves each path from its own
+    artifact with identical outputs."""
+    flags.set_flag("jit_cache_dir", str(tmp_path))
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    prog = pt.default_main_program()
+    out_plain = exe.run(prog, feed=_feed(), fetch_list=[loss])
+    out_donate = exe.run(prog, feed=_feed(), fetch_list=[loss],
+                         donate_feeds=True)
+    assert np.array_equal(out_plain[0], out_donate[0])
+    # startup + plain step + donate twin
+    assert len(_entries(tmp_path)) == 3
+    comps = [r["components"] for r in jit_cache.ls()]
+    assert sum(1 for c in comps
+               if c.get("donate_feeds") is True) == 1
+    h0 = _tot("jit_cache_hits_total")
+    c0 = _tot("executor_compile_total")
+    n_entries = len(_entries(tmp_path))
+    # warm restart dispatching DONATE first: the twin resolves in
+    # _prepare, and the later plain dispatch must resolve its OWN
+    # entry from disk too (a hit, not a silent AOT recompile + restore)
+    exe2 = pt.Executor(pt.CPUPlace(), scope=exe.scope)
+    w_donate = exe2.run(prog, feed=_feed(), fetch_list=[loss],
+                        donate_feeds=True)
+    w_plain = exe2.run(prog, feed=_feed(), fetch_list=[loss])
+    assert _tot("jit_cache_hits_total") == h0 + 2
+    assert _tot("executor_compile_total") == c0
+    assert len(_entries(tmp_path)) == n_entries
+    assert np.array_equal(w_plain[0], out_plain[0])
+    assert np.array_equal(w_donate[0], out_donate[0])
+
+
 def test_run_steps_warm_start_inproc(tmp_path):
     flags.set_flag("jit_cache_dir", str(tmp_path))
     loss = _build_fc()

@@ -1,0 +1,861 @@
+"""Watchtower (ISSUE 15): declarative alerts, the fleet event journal,
+and incident reconstruction.
+
+Covers: the rule matrix (threshold / rate / absence / burn_rate x
+pending / firing / resolved x `for:` holds), malformed-rules-file
+rejection naming the line/field, default-rule set + file override,
+journal rotate/merge/clock-normalization round trips, /alerts
+fleet-merge semantics with exemplar/flight/rank context, /journal,
+X-ray fire/resolve instants, the incident CLI's three selectors and
+exit codes, flag-off invariance (bitwise outputs + frozen compile
+counters), the healthz_stall_seconds knob, and the headline e2e: a
+supervised 2-worker fleet, chaos-killed rank -> dead-rank alert fires
+on the coordinator with the victim's exemplar trace id + flight ref,
+resolves after supervisor revival, and `incident` reconstructs
+kill -> fence -> respawn -> resolve in order.
+"""
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags
+from paddle_tpu.distributed import task_queue
+from paddle_tpu.distributed.supervisor import Supervisor
+from paddle_tpu.observability import alerts, incident
+from paddle_tpu.observability import fleet as obs_fleet
+from paddle_tpu.observability import flight as obs_flight
+from paddle_tpu.observability import journal
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.observability import tracectx
+from paddle_tpu.resilience import retry as rretry
+from paddle_tpu.resilience.soak import _seed_where_exit_fires
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _gdoc(name, rows):
+    """Synthetic metrics doc: one gauge family, rows = [(labels, v)]."""
+    return {"schema": "paddle_tpu.metrics.v1", "metrics": {
+        name: {"type": "gauge", "help": "",
+               "series": [{"labels": dict(l), "value": v}
+                          for l, v in rows]}}}
+
+
+def _cdoc(name, value):
+    return {"schema": "paddle_tpu.metrics.v1", "metrics": {
+        name: {"type": "counter", "help": "",
+               "series": [{"labels": {}, "value": value}]}}}
+
+
+def _hdoc(name, buckets, count, total=1.0, exemplars=None):
+    row = {"labels": {}, "sum": total, "count": count,
+           "buckets": dict(buckets),
+           "overflow": count - sum(buckets.values())}
+    if exemplars:
+        row["exemplars"] = exemplars
+    return {"schema": "paddle_tpu.metrics.v1", "metrics": {
+        name: {"type": "histogram", "help": "", "series": [row]}}}
+
+
+def _firing_gauge(rule):
+    return obs_metrics.REGISTRY.get("alerts_firing").labels(
+        rule=rule).value
+
+
+def _transitions(rule, state):
+    return obs_metrics.REGISTRY.get("alerts_transitions_total").labels(
+        rule=rule, state=state).value
+
+
+# ------------------------------------------------------ rule matrix
+
+def test_threshold_pending_firing_resolved_hold():
+    rule = alerts.Rule(name="r", metric="m", predicate="threshold",
+                       op=">", value=1.0, for_seconds=2.0)
+    eng = alerts.AlertEngine([rule])
+    hi = _gdoc("m", [({}, 5.0)])
+    lo = _gdoc("m", [({}, 0.5)])
+    eng.evaluate(hi, now=100.0)
+    st = eng.status_doc()
+    assert st["active"] and st["active"][0]["state"] == "pending"
+    assert st["firing"] == []
+    eng.evaluate(hi, now=101.0)            # held 1s < for: 2s
+    assert eng.status_doc()["firing"] == []
+    eng.evaluate(hi, now=102.5)            # held 2.5s >= 2s -> firing
+    st = eng.status_doc()
+    assert st["firing"] == ["r"]
+    assert _firing_gauge("r") == 1
+    assert st["active"][0]["value"] == 5.0
+    eng.evaluate(lo, now=103.0)            # breach gone -> resolved
+    st = eng.status_doc()
+    assert st["firing"] == [] and _firing_gauge("r") == 0
+    assert st["recent_resolved"] and \
+        st["recent_resolved"][0]["state"] == "resolved"
+    states = [h["state"] for h in st["history"] if h["rule"] == "r"]
+    assert states == ["pending", "firing", "resolved"]
+    assert _transitions("r", "firing") == 1
+    assert _transitions("r", "resolved") == 1
+
+
+def test_threshold_pending_clears_without_resolved_noise():
+    rule = alerts.Rule(name="p", metric="m", predicate="threshold",
+                       op=">", value=1.0, for_seconds=5.0)
+    eng = alerts.AlertEngine([rule])
+    eng.evaluate(_gdoc("m", [({}, 9.0)]), now=10.0)
+    eng.evaluate(_gdoc("m", [({}, 0.0)]), now=11.0)   # never held 5s
+    st = eng.status_doc()
+    assert st["active"] == [] and st["recent_resolved"] == []
+    states = [h["state"] for h in st["history"] if h["rule"] == "p"]
+    assert states == ["pending"]           # no firing/resolved noise
+    assert _transitions("p", "resolved") == 0
+
+
+def test_threshold_histogram_quantile_and_exemplar_context():
+    tid = "ab" * 16
+    rule = alerts.Rule(name="q", metric="h", predicate="threshold",
+                       quantile=0.99, op=">", value=0.5)
+    eng = alerts.AlertEngine([rule])
+    doc = _hdoc("h", {"0.1": 50, "1.0": 49}, count=100, total=60.0,
+                exemplars={"1.0": {"value": 0.9, "trace_id": tid,
+                                   "time_unix": 5.0}})
+    eng.evaluate(doc, now=1.0)             # for: 0 -> fires immediately
+    st = eng.status_doc()
+    assert st["firing"] == ["q"]
+    act = st["active"][0]
+    assert act["value"] == 1.0             # interpolated p99 bucket
+    assert act["context"]["exemplar_trace_ids"] == [tid]
+    # first fire auto-captured a flight bundle ref
+    assert act["context"]["flight"]["dumps"] >= 1
+    assert act["context"]["flight_bundle"]
+    # below the bar -> resolves
+    eng.evaluate(_hdoc("h", {"0.1": 100, "1.0": 0}, count=100),
+                 now=2.0)
+    assert eng.status_doc()["firing"] == []
+
+
+def test_rate_predicate_fire_and_decay():
+    rule = alerts.Rule(name="rate", metric="c", predicate="rate",
+                       op=">", value=1.0, window=10.0)
+    eng = alerts.AlertEngine([rule])
+    eng.evaluate(_cdoc("c", 0.0), now=0.0)     # no anchor yet
+    assert eng.status_doc()["firing"] == []
+    eng.evaluate(_cdoc("c", 5.0), now=1.0)     # 5/s > 1/s
+    assert eng.status_doc()["firing"] == ["rate"]
+    eng.evaluate(_cdoc("c", 5.0), now=2.0)     # 2.5/s, still > 1
+    assert eng.status_doc()["firing"] == ["rate"]
+    eng.evaluate(_cdoc("c", 5.0), now=20.0)    # anchor aged out -> 0/s
+    st = eng.status_doc()
+    assert st["firing"] == []
+    assert [h["state"] for h in st["history"]][-1] == "resolved"
+
+
+def test_rate_window_survives_dense_evaluation():
+    """A 0.05s evaluation cadence (fast ticker + scrapes) must not
+    shrink the configured lookback: samples are time-granulated, so
+    the anchor is genuinely ~window old — a raw 128-sample cap would
+    have truncated a 10s window to 6.4s and missed the rate."""
+    rule = alerts.Rule(name="dense", metric="c", predicate="rate",
+                       op=">", value=0.2, window=10.0)
+    eng = alerts.AlertEngine([rule])
+    t = 0.0
+    while t < 9.0:                 # 1/s increments for 3s, then flat
+        eng.evaluate(_cdoc("c", min(3.0, t)), now=t)
+        t += 0.05
+    # 3 increments inside the 10s window = 0.33/s > 0.2/s
+    assert eng.status_doc()["firing"] == ["dense"]
+    while t < 16.0:                # hot anchor ages out past WINDOW
+        eng.evaluate(_cdoc("c", 3.0), now=t)
+        t += 0.05
+    assert eng.status_doc()["firing"] == []
+
+
+def test_rate_counter_reset_is_not_negative():
+    rule = alerts.Rule(name="rr", metric="c", predicate="rate",
+                       op=">", value=0.0, window=60.0)
+    eng = alerts.AlertEngine([rule])
+    eng.evaluate(_cdoc("c", 100.0), now=0.0)
+    eng.evaluate(_cdoc("c", 3.0), now=1.0)     # restarted process
+    assert eng.status_doc()["firing"] == []    # clamped to 0, not < 0
+
+
+def test_absence_predicate():
+    rule = alerts.Rule(name="a", metric="gone", predicate="absence",
+                       for_seconds=1.0)
+    eng = alerts.AlertEngine([rule])
+    eng.evaluate({"metrics": {}}, now=0.0)
+    assert eng.status_doc()["active"][0]["state"] == "pending"
+    eng.evaluate({"metrics": {}}, now=1.5)
+    assert eng.status_doc()["firing"] == ["a"]
+    eng.evaluate(_gdoc("gone", [({}, 1.0)]), now=2.0)   # it came back
+    st = eng.status_doc()
+    assert st["firing"] == []
+    assert [h["state"] for h in st["history"]][-1] == "resolved"
+
+
+def test_burn_rate_predicate():
+    rule = alerts.Rule(name="burn", metric="h", predicate="burn_rate",
+                       bound=0.1, budget=0.1, op=">", value=2.0,
+                       window=60.0)
+    eng = alerts.AlertEngine([rule])
+    eng.evaluate(_hdoc("h", {"0.1": 100}, count=100), now=0.0)
+    assert eng.status_doc()["firing"] == []
+    # 100 new observations, 80 above the bound: 80% breach vs the 10%
+    # budget = 8x burn > 2x bar
+    eng.evaluate(_hdoc("h", {"0.1": 120}, count=200), now=1.0)
+    assert eng.status_doc()["firing"] == ["burn"]
+    act = eng.status_doc()["active"][0]
+    assert act["value"] == pytest.approx(8.0)
+    # new observations all under the bound: burn decays once the hot
+    # anchor ages out of the window
+    eng.evaluate(_hdoc("h", {"0.1": 320}, count=400), now=90.0)
+    assert eng.status_doc()["firing"] == []
+
+
+def test_vanished_series_resolves():
+    """A gauge series that disappears from the doc (departed worker)
+    must resolve its firing state, not latch forever."""
+    rule = alerts.Rule(name="v", metric="up", predicate="threshold",
+                       op="<", value=1.0)
+    eng = alerts.AlertEngine([rule])
+    eng.evaluate(_gdoc("up", [({"worker": "0"}, 0.0)]), now=0.0)
+    assert eng.status_doc()["firing"] == ["v"]
+    eng.evaluate({"metrics": {}}, now=1.0)
+    assert eng.status_doc()["firing"] == []
+
+
+def test_alert_xray_instants_and_journal_transitions(tmp_path):
+    flags.set_flag("journal_path", str(tmp_path / "j.jsonl"))
+    rule = alerts.Rule(name="x", metric="m", predicate="threshold",
+                       op=">", value=1.0)
+    eng = alerts.AlertEngine([rule])
+    eng.evaluate(_gdoc("m", [({}, 2.0)]), now=float(time.time()))
+    ctx = eng.status_doc()["active"][0]["context"]
+    tid = ctx["alert_trace_id"]
+    assert tid and len(tid) == 32
+    eng.evaluate(_gdoc("m", [({}, 0.0)]), now=float(time.time()))
+    wf = tracectx.waterfall(tid)
+    names = [s["name"] for s in wf["spans"]]
+    assert names == ["alert.fire", "alert.resolve"]
+    evs = journal.read_events(str(tmp_path / "j.jsonl"))
+    alert_evs = [(e["event"], e["rule"]) for e in evs
+                 if e["kind"] == "alert"]
+    assert alert_evs == [("fire", "x"), ("resolve", "x")]
+    assert evs[0]["alert_trace_id"] == tid
+
+
+# ------------------------------------------------- rules file / CLI
+
+def test_malformed_rules_json_names_line(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"rules": [\n  {"name": "a",]\n}')
+    with pytest.raises(alerts.RuleError) as ei:
+        alerts.load_rules(str(p))
+    assert f"{p}:2:" in str(ei.value)      # the JSON line is named
+    assert alerts.main(["--check", str(p)]) == 1
+
+
+def test_malformed_rule_names_rule_and_field(tmp_path):
+    cases = [
+        ({"metric": "m"}, "name"),
+        ({"name": "a", "predicate": "nope", "metric": "m"},
+         "predicate"),
+        ({"name": "a", "metric": "m", "op": "~"}, "op"),
+        ({"name": "a", "metric": "m", "value": "high"}, "value"),
+        ({"name": "a", "metric": "m", "quantile": 2.0}, "quantile"),
+        ({"name": "a", "metric": "m", "severity": "panic"},
+         "severity"),
+        ({"name": "a", "metric": "m", "frobnicate": 1}, "frobnicate"),
+        ({"name": "a", "metric": "m", "predicate": "burn_rate"},
+         "bound"),
+    ]
+    for i, (obj, field) in enumerate(cases):
+        p = tmp_path / f"r{i}.json"
+        p.write_text(json.dumps({"rules": [obj]}))
+        with pytest.raises(alerts.RuleError) as ei:
+            alerts.load_rules(str(p))
+        msg = str(ei.value)
+        assert "rule #0" in msg and repr(field) in msg, (obj, msg)
+        assert alerts.main(["--check", str(p)]) == 1
+    # duplicate names are rejected too
+    p = tmp_path / "dup.json"
+    p.write_text(json.dumps({"rules": [
+        {"name": "a", "metric": "m"}, {"name": "a", "metric": "m2"}]}))
+    with pytest.raises(alerts.RuleError, match="duplicates"):
+        alerts.load_rules(str(p))
+
+
+def test_alerts_check_cli_exit_codes(tmp_path):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"rules": [
+        {"name": "slow", "metric": "trainer_step_seconds",
+         "predicate": "threshold", "quantile": 0.99, "op": ">",
+         "value": 0.5, "for": 2.0, "severity": "critical"}]}))
+    assert alerts.main(["--check", str(good)]) == 0
+    assert alerts.main(["--check", str(tmp_path / "missing.json")]) == 2
+    assert alerts.main([]) == 2
+    assert alerts.main(["--self-test"]) == 0
+    assert incident.main(["--self-test"]) == 0
+    assert incident.main([]) == 2
+
+
+def test_default_rules_and_file_override(tmp_path):
+    flags.set_flag("alert_rules_path", "builtin")
+    names = {r.name for r in alerts.effective_rules()}
+    assert {"dead_rank", "stalled_rank", "recompile_storm",
+            "nan_guard", "jit_cache_errors", "queue_saturation",
+            "sparse_push_reject_spike"} <= names
+    # the serving p99/burn rules gate on the budget flag
+    assert "serving_p99_budget" not in names
+    old = flags.get_flag("serving_p99_budget_ms")
+    flags.set_flag("serving_p99_budget_ms", 50.0)
+    try:
+        names = {r.name for r in alerts.effective_rules()}
+        assert {"serving_p99_budget", "ttft_burn_rate"} <= names
+    finally:
+        flags.set_flag("serving_p99_budget_ms", old)
+    # the stalled_rank rule shares the healthz knob
+    stalled = [r for r in alerts.effective_rules()
+               if r.name == "stalled_rank"][0]
+    assert stalled.value == float(flags.get_flag(
+        "healthz_stall_seconds"))
+    # a file rule with a builtin's name overrides it
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps({"rules": [
+        {"name": "nan_guard", "metric": "trainer_bad_steps_total",
+         "predicate": "rate", "op": ">", "value": 42.0}]}))
+    flags.set_flag("alert_rules_path", str(p))
+    by_name = {r.name: r for r in alerts.effective_rules()}
+    assert by_name["nan_guard"].value == 42.0
+    assert by_name["nan_guard"].source == "file"
+    assert "dead_rank" in by_name          # builtins still there
+
+
+def test_ensure_started_survives_bad_rules_file(tmp_path):
+    p = tmp_path / "broken.json"
+    p.write_text("{not json")
+    flags.set_flag("alert_rules_path", str(p))
+    with pytest.warns(RuntimeWarning, match="rules file rejected"):
+        eng = alerts.ensure_started()
+    assert eng is not None                  # builtins still watching
+    assert {r.name for r in eng.rules} == {
+        r.name for r in alerts.default_rules()}
+
+
+# ------------------------------------------------------ journal
+
+def test_journal_emit_read_roundtrip_and_strict_json(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    n0 = obs_metrics.REGISTRY.get("journal_events_total").total()
+    flags.set_flag("journal_path", p)
+    journal.set_rank(2)
+    journal.emit("guard", "nan", loss=float("nan"), step=3)
+    journal.emit("master", "generation", generation=np.int64(4))
+    evs = journal.read_events(p)
+    assert [e["event"] for e in evs] == ["nan", "generation"]
+    assert evs[0]["rank"] == 2 and evs[0]["kind"] == "guard"
+    assert evs[0]["loss"] == "nan"          # strict JSON, stringified
+    assert evs[1]["generation"] == 4        # numpy int stays an int
+    assert evs[0]["seq"] < evs[1]["seq"]
+    assert {"time_unix", "perf_counter", "pid"} <= set(evs[0])
+    # the ambient trace id rides along
+    ctx = tracectx.start_trace("t")
+    with tracectx.activate(ctx):
+        journal.emit("worker", "step", step=9)
+    evs = journal.read_events(p)
+    assert evs[-1]["trace_id"] == ctx.trace_id
+    assert obs_metrics.REGISTRY.get(
+        "journal_events_total").total() == n0 + 3
+
+
+def test_journal_disabled_is_noop(tmp_path):
+    assert not journal.enabled()
+    assert journal.emit("x", "y") is None
+    g, total, tail = journal.events_since(0)
+    assert total == 0 and tail == []
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_journal_appends_across_writers_and_rotates_at_cap(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    flags.set_flag("journal_path", p)
+    journal.emit("a", "one")
+    journal.reset()                         # process restart shape
+    flags.set_flag("journal_path", p)
+    journal.emit("a", "two")
+    # append, not rotate: both incarnations share one timeline
+    assert [e["event"] for e in journal.read_events(p)] == ["one",
+                                                           "two"]
+    assert not os.path.exists(p + ".1")
+    # an oversized file DOES rotate aside (atomically) on reopen
+    flags.set_flag("journal_rotate_bytes", 10)
+    journal.reset()
+    flags.set_flag("journal_path", p)
+    journal.emit("a", "three")
+    assert os.path.exists(p + ".1")
+    assert [e["event"] for e in journal.read_events(p)] == ["three"]
+    assert [e["event"] for e in journal.read_events(p + ".1")] == [
+        "one", "two"]
+    flags.set_flag("journal_rotate_bytes", 64_000_000)
+
+
+def test_journal_cursor_and_generation(tmp_path):
+    flags.set_flag("journal_path", str(tmp_path / "cursor.jsonl"))
+    journal.emit("k", "e1")
+    g, total, tail = journal.events_since(0)
+    assert total == 1 and [e["event"] for e in tail] == ["e1"]
+    journal.emit("k", "e2")
+    g2, total2, tail2 = journal.events_since(total, g)
+    assert total2 == 2 and [e["event"] for e in tail2] == ["e2"]
+    # a generation mismatch replays the whole buffer
+    g3, _t, tail3 = journal.events_since(total2, g2 - 1)
+    assert len(tail3) == 2
+
+
+def test_journal_fleet_ship_clock_normalization_and_merge(tmp_path):
+    """A worker with a skewed wall clock ships journal events; the
+    aggregator lands them on the MASTER clock (perf + offset, the
+    PR 11 idiom), appends them to the coordinator's journal file, and
+    merge_events dedupes the shipped copy against the rank's own."""
+    coord = str(tmp_path / "coord.jsonl")
+    flags.set_flag("journal_path", coord)
+    journal.emit("master", "generation", generation=1)
+    agg = obs_fleet.FleetAggregator(stale_after=60.0)
+    now = time.time()
+    perf = 5000.0
+    skew = 123.0                            # worker clock runs ahead
+    w_events = [
+        {"schema": journal.SCHEMA, "kind": "worker", "event": "step",
+         "time_unix": now + skew + 0.1, "perf_counter": perf + 0.1,
+         "rank": 3, "pid": 77, "seq": 1},
+        {"schema": journal.SCHEMA, "kind": "chaos", "event": "injected",
+         "time_unix": now + skew + 0.3, "perf_counter": perf + 0.3,
+         "rank": 3, "pid": 77, "seq": 2},
+    ]
+    payload = {"schema": obs_fleet.SCHEMA, "rank": 3,
+               "time_unix": now + skew, "perf_counter": perf,
+               "spans": [], "journal": list(w_events)}
+    agg.ingest_events(payload, recv_unix=now)
+    evs = agg.journal_events()
+    assert [e["event"] for e in evs] == ["step", "injected"]
+    # normalized onto the master clock: recv - perf + ev_perf
+    assert evs[0]["time_unix"] == pytest.approx(now + 0.1, abs=1e-6)
+    assert evs[0]["worker_time_unix"] == pytest.approx(
+        now + skew + 0.1, abs=1e-6)
+    # ... and durably appended to the coordinator's journal
+    disk = journal.read_events(coord)
+    assert [e["event"] for e in disk] == ["generation", "step",
+                                          "injected"]
+    assert disk[1]["rank"] == 3
+    # offline merge: the shipped copy dedupes against the rank's own
+    # file (same (rank, pid, seq) identity), order is master-clock
+    merged = journal.merge_events([disk, w_events])
+    assert [e["event"] for e in merged] == ["generation", "step",
+                                            "injected"]
+
+
+def test_journal_http_route(tmp_path):
+    flags.set_flag("journal_path", str(tmp_path / "j.jsonl"))
+    journal.emit("worker", "step", step=1)
+    srv = obs_server.start_http_server(port=0)
+    doc = _get_json(srv.url + "/journal")
+    assert doc["schema"] == journal.SCHEMA and doc["enabled"]
+    assert [e["event"] for e in doc["events"]] == ["step"]
+
+
+def test_alerts_http_route_disabled_by_default():
+    srv = obs_server.start_http_server(port=0)
+    doc = _get_json(srv.url + "/alerts")
+    assert doc["enabled"] is False and doc["rules"] == []
+
+
+def test_healthz_stall_seconds_flag():
+    obs_server.note_trainer_running(True)
+    obs_server.note_trainer_step()
+    old = flags.get_flag("healthz_stall_seconds")
+    try:
+        flags.set_flag("healthz_stall_seconds", 0.05)
+        time.sleep(0.12)
+        assert obs_server.trainer_liveness()["hung"] is True
+        flags.set_flag("healthz_stall_seconds", 100.0)
+        assert obs_server.trainer_liveness()["hung"] is False
+    finally:
+        flags.set_flag("healthz_stall_seconds", old)
+
+
+# ---------------------------------------------- /alerts fleet merge
+
+def _worker_snapshot_payload(rank, steps, exemplar_tid=None):
+    buckets = {"0.1": steps}
+    row = {"labels": {}, "sum": 0.5, "count": steps,
+           "buckets": buckets, "overflow": 0}
+    if exemplar_tid:
+        row["exemplars"] = {"0.1": {"value": 0.05,
+                                    "trace_id": exemplar_tid,
+                                    "time_unix": time.time()}}
+    return {"schema": obs_fleet.SCHEMA, "rank": rank, "host": "h",
+            "pid": 1000 + rank, "time_unix": time.time(),
+            "perf_counter": time.perf_counter(),
+            "steps_total": float(steps), "closing": False,
+            "model": None,
+            "metrics": {"schema": "paddle_tpu.metrics.v1", "metrics": {
+                "trainer_step_seconds": {"type": "histogram",
+                                         "help": "", "series": [row]},
+                "trainer_steps_total": {"type": "counter", "help": "",
+                                        "series": [{"labels": {},
+                                                    "value": steps}]},
+            }}}
+
+
+def test_alerts_fleet_merge_dead_rank_context_over_http(tmp_path):
+    """The /alerts fleet-merge semantics: the coordinator's engine
+    evaluates the MERGED document, a membership-dead rank fires
+    dead_rank with the victim's rank + exemplar trace id attached
+    (pulled from its last snapshot), and membership recovery resolves
+    it."""
+    tid = "cd" * 16
+    agg = obs_fleet.FleetAggregator(stale_after=60.0)
+    agg.ingest_metrics(_worker_snapshot_payload(0, 10,
+                                                exemplar_tid=tid))
+    agg.note_worker(0, "live", host="h", pid=1000)
+    flags.set_flag("alert_rules_path", "builtin")
+    srv = obs_server.start_http_server(port=0, aggregator=agg)
+    doc = _get_json(srv.url + "/alerts")
+    assert doc["enabled"] and doc["source"] == "fleet"
+    assert "dead_rank" not in doc["firing"]
+    # the heartbeat plane declares the rank dead -> fleet_worker_dead 1
+    agg.note_worker(0, "dead", host="h", pid=1000)
+    doc = _get_json(srv.url + "/alerts")
+    assert doc["firing"] == ["dead_rank"]
+    act = [a for a in doc["active"] if a["rule"] == "dead_rank"][0]
+    ctx = act["context"]
+    assert ctx["ranks"] == ["0"]
+    assert ctx["exemplar_trace_ids"] == [tid]
+    assert ctx["flight"]["dumps"] >= 1      # auto-captured bundle ref
+    # the alert's own trace resolves over HTTP (fire instant recorded)
+    atid = ctx["alert_trace_id"]
+    wf = _get_json(srv.url + f"/trace/{atid}")
+    assert [s["name"] for s in wf["spans"]] == ["alert.fire"]
+    # revival: membership live again -> resolved
+    agg.note_worker(0, "live", host="h", pid=1001)
+    doc = _get_json(srv.url + "/alerts")
+    assert doc["firing"] == []
+    assert any(h["rule"] == "dead_rank" and h["state"] == "resolved"
+               for h in doc["history"])
+    # a clean goodbye is NOT an alarm: departed ranks leave the
+    # fleet_worker_dead AND fleet_worker_report_age_seconds families
+    # entirely — neither dead_rank nor stalled_rank (whose age would
+    # grow forever) can latch on a scale-down
+    agg.note_worker(0, "departed")
+    doc = _get_json(srv.url + "/alerts")
+    assert "dead_rank" not in doc["firing"], doc["active"]
+    mdoc = _get_json(srv.url + "/metrics.json")
+    for fam in ("fleet_worker_dead", "fleet_worker_report_age_seconds"):
+        rows = mdoc["metrics"].get(fam, {}).get("series", [])
+        assert all(r["labels"].get("worker") != "0" for r in rows), fam
+
+
+# ------------------------------------------------- incident CLI
+
+def _write_journal(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps({"schema": journal.SCHEMA, **e}) + "\n")
+
+
+def test_incident_selectors_report_and_exit_codes(tmp_path, capsys):
+    T = 1700000000.0
+    p = str(tmp_path / "j.jsonl")
+    _write_journal(p, [
+        {"kind": "worker", "event": "step", "time_unix": T + 0.5,
+         "rank": 0, "pid": 1, "seq": 1, "trace_id": "ee" * 16},
+        {"kind": "chaos", "event": "injected", "time_unix": T + 1.0,
+         "rank": 0, "pid": 1, "seq": 2, "site": "trainer.step",
+         "fault_kind": "exit"},
+        {"kind": "master", "event": "worker_dead", "time_unix": T + 2.0,
+         "rank": 0, "pid": 2, "seq": 1, "worker": 0},
+        {"kind": "alert", "event": "fire", "time_unix": T + 2.2,
+         "rank": 0, "pid": 2, "seq": 2, "rule": "dead_rank"},
+        {"kind": "supervisor", "event": "spawn", "time_unix": T + 3.0,
+         "rank": 0, "pid": 2, "seq": 3, "worker": 0, "incarnation": 1},
+        {"kind": "alert", "event": "resolve", "time_unix": T + 4.0,
+         "rank": 0, "pid": 2, "seq": 4, "rule": "dead_rank"},
+        {"kind": "worker", "event": "step", "time_unix": T + 60.0,
+         "rank": 0, "pid": 3, "seq": 1},
+    ])
+    # --window
+    events, hist = incident.gather_events([p])
+    t0, t1, sel = incident.resolve_window(events, hist,
+                                          window=f"{T + 0.9}:{T + 3.5}")
+    doc = incident.build_report(events, hist, t0, t1, sel)
+    assert [e["event"] for e in doc["timeline"]] == [
+        "injected", "worker_dead", "fire", "spawn"]
+    # --alert: fire .. resolve with padding
+    t0, t1, sel = incident.resolve_window(events, hist,
+                                          alert="dead_rank", pad=1.5)
+    doc = incident.build_report(events, hist, t0, t1, sel)
+    names = [e["event"] for e in doc["timeline"]]
+    assert names == ["injected", "worker_dead", "fire", "spawn",
+                     "resolve"]
+    assert sel["fired_unix"] == T + 2.2
+    # --trace-id
+    t0, t1, sel = incident.resolve_window(events, hist,
+                                          trace_id="ee" * 16, pad=0.1)
+    doc = incident.build_report(events, hist, t0, t1, sel)
+    assert [e["event"] for e in doc["timeline"]] == ["step"]
+    assert doc["trace_ids"] == ["ee" * 16]
+    # CLI contract
+    assert incident.main([p, "--alert", "dead_rank"]) == 0
+    out = capsys.readouterr().out
+    assert "injected" in out and "worker_dead" in out \
+        and "spawn" in out and "resolve" in out
+    assert incident.main([p, "--alert", "never_fired"]) == 1
+    assert incident.main([p, "--window", "bogus"]) == 1
+    assert incident.main([p, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["schema"] == incident.SCHEMA
+    assert incident.main(
+        [p, "--window", "1:2", "--alert", "x"]) == 2
+
+
+def test_incident_runlog_join(tmp_path, capsys):
+    from paddle_tpu.observability import runlog as obs_runlog
+    T = time.time()
+    jp = str(tmp_path / "j.jsonl")
+    _write_journal(jp, [
+        {"kind": "guard", "event": "nan", "time_unix": T + 1.0,
+         "rank": 0, "pid": 1, "seq": 1, "first_var": "fc_1.w"}])
+    rp = str(tmp_path / "run.jsonl")
+    log = obs_runlog.RunLog(rp)
+    log._f.write(json.dumps({
+        "schema": obs_runlog.SCHEMA, "time_unix": T + 1.05,
+        "kind": "step", "step": 1, "loss": 0.5}) + "\n")
+    log._f.write(json.dumps({
+        "schema": obs_runlog.SCHEMA, "time_unix": T + 1.1,
+        "kind": "guard", "verdict": "nan", "step": 2, "loss": "nan",
+        "attribution": "fc_1.w"}) + "\n")
+    log.close()
+    assert incident.main([jp, "--runlog", rp]) == 0
+    out = capsys.readouterr().out
+    assert "guard_nan" in out and "1 train step" in out
+
+
+# --------------------------------------------- flag-off invariance
+
+def _tiny_training(ckpt_dir):
+    losses = []
+
+    def train_func():
+        x = layers.data("x", [6], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        p = layers.fc(layers.fc(x, size=8, act="relu"), size=3,
+                      act="softmax")
+        return layers.mean(layers.cross_entropy(p, y))
+
+    rng = np.random.RandomState(0)
+    batches = [[(rng.rand(6).astype("float32"),
+                 np.array([rng.randint(3)], "int64"))
+                for _ in range(4)] for _ in range(4)]
+
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent) and event.metrics:
+            losses.append(np.asarray(event.metrics[0]).copy())
+
+    trainer = pt.Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: pt.optimizer.SGD(0.1),
+        place=pt.CPUPlace(),
+        checkpoint_config=pt.CheckpointConfig(
+            checkpoint_dir=ckpt_dir, step_interval=2))
+    trainer.train(num_epochs=1, event_handler=handler,
+                  reader=lambda: iter(batches), feed_order=["x", "y"])
+    trainer.stop()
+    return losses
+
+
+def test_watchtower_flag_off_invariance(tmp_path):
+    """alert_rules_path="" + journal off is byte-identical on outputs
+    and compile bookkeeping (the PR 7/10/11 idiom): the whole plane is
+    a pure observer."""
+    from paddle_tpu.observability import forensics
+
+    def _compiles():
+        return obs_metrics.REGISTRY.get("executor_compile_total").total()
+
+    assert not journal.enabled() and not alerts.enabled()
+    c0 = _compiles()
+    f0 = len(forensics.compile_log())
+    base = _tiny_training(str(tmp_path / "ck_off"))
+    d_compiles = _compiles() - c0
+    d_forensics = len(forensics.compile_log()) - f0
+    # watched run: journal + builtin alerts + a fast ticker
+    flags.set_flag("journal_path", str(tmp_path / "j.jsonl"))
+    flags.set_flag("alert_rules_path", "builtin")
+    flags.set_flag("alert_eval_interval", 0.05)
+    c1 = _compiles()
+    f1 = len(forensics.compile_log())
+    watched = _tiny_training(str(tmp_path / "ck_on"))
+    assert _compiles() - c1 == d_compiles
+    assert len(forensics.compile_log()) - f1 == d_forensics
+    assert len(watched) == len(base)
+    for a, b in zip(base, watched):
+        assert np.array_equal(a, b)         # bitwise identical losses
+    # the watched run actually journaled (checkpoint commits)
+    evs = journal.read_events(str(tmp_path / "j.jsonl"))
+    assert any(e["kind"] == "checkpoint" and e["event"] == "commit"
+               for e in evs)
+    assert alerts.get_engine() is not None
+
+
+# ------------------------------------------------- headline e2e
+
+def test_watchtower_e2e_chaos_kill_dead_rank_alert(tmp_path):
+    """ISSUE 15 headline: supervised 2-worker fleet; chaos kill-9s
+    rank 0 mid-loop; the dead-rank alert fires on the coordinator with
+    the victim's exemplar trace id + flight ref attached; the
+    supervisor revives the rank and the alert resolves; the incident
+    CLI over the journals reconstructs kill -> fence (dead) ->
+    respawn -> resolve in order."""
+    coord_journal = str(tmp_path / "coord.jsonl")
+    flags.set_flag("journal_path", coord_journal)
+    flags.set_flag("alert_rules_path", "builtin")
+    flags.set_flag("alert_eval_interval", 0.1)
+    agg = obs_fleet.FleetAggregator(stale_after=5.0)
+    master = task_queue.TaskMaster(worker_timeout=1.0)
+    srv, (mhost, mport) = task_queue.serve_master(master, port=0,
+                                                  aggregator=agg)
+    http = obs_server.start_http_server(port=0, aggregator=agg)
+    assert alerts.get_engine() is not None  # wired by the server
+
+    stop_file = str(tmp_path / "stop")
+    worker_py = os.path.join(REPO, "tests", "watchtower_worker.py")
+
+    def cmd(rank):
+        return [sys.executable, worker_py, f"127.0.0.1:{mport}",
+                str(rank), stop_file]
+
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_env.pop("XLA_FLAGS", None)
+    base_env.pop("PYTHONPATH", None)        # axon quirk (conftest)
+    base_env["PTPU_WORKER_HEARTBEAT_INTERVAL"] = "0.2"
+    base_env["PTPU_FLEET_REPORT_INTERVAL"] = "0.2"
+    # kill rank 0 on a step in [20, 40): late enough that several
+    # reporter flushes (0.2s) shipped exemplar-carrying snapshots first
+    kseed = _seed_where_exit_fires(0.2, 20, 40)
+    envs = [
+        {"PTPU_JOURNAL_PATH": str(tmp_path / "w0.jsonl"),
+         "PTPU_CHAOS_SPEC": "trainer.step=exit:0.2:9",
+         "PTPU_CHAOS_SEED": str(kseed)},
+        {"PTPU_JOURNAL_PATH": str(tmp_path / "w1.jsonl")},
+    ]
+    # restart backoff SLOWER than the heartbeat death detector
+    # (worker_timeout 1.0s + reaper tick): an instant respawn would
+    # re-register the rank before the master ever declares it dead —
+    # no death, no alert, nothing to watch.  2.5-3.75s of backoff
+    # leaves a ~2s dead window for the 0.1s alert ticker.
+    sup = Supervisor(
+        cmds=[cmd(0), cmd(1)], env=base_env, envs=envs, cwd=REPO,
+        backoff=rretry.RetryPolicy(name="wt_restart", max_attempts=1,
+                                   base_delay=2.5, max_delay=4.0))
+    sup.start()
+    try:
+        # --- the dead-rank alert fires on the coordinator -----------
+        deadline = time.time() + 60
+        fired = None
+        while time.time() < deadline:
+            doc = _get_json(http.url + "/alerts")
+            hits = [a for a in doc["active"]
+                    if a["rule"] == "dead_rank"
+                    and a["state"] == "firing"]
+            if hits:
+                fired = hits[0]
+                break
+            time.sleep(0.1)
+        assert fired is not None, f"dead_rank never fired: {doc}"
+        ctx = fired["context"]
+        assert ctx["ranks"] == ["0"], ctx   # the victim, attributed
+        # the victim's exemplar trace id (from its last snapshot)
+        assert ctx.get("exemplar_trace_ids"), ctx
+        tid = ctx["exemplar_trace_ids"][0]
+        assert len(tid) == 32 and int(tid, 16) >= 0
+        # flight-bundle ref attached (auto-captured on first fire)
+        assert ctx.get("flight_bundle")
+        assert ctx["flight"]["dumps"] >= 1
+        # --- supervisor revival resolves it -------------------------
+        while time.time() < deadline:
+            doc = _get_json(http.url + "/alerts")
+            if "dead_rank" not in doc["firing"]:
+                break
+            time.sleep(0.1)
+        assert "dead_rank" not in doc["firing"], doc["active"]
+        assert any(h["rule"] == "dead_rank" and h["state"] == "resolved"
+                   for h in doc["history"]), doc["history"]
+        assert sup.restarts[0] >= 1         # the respawn really happened
+        alerts_doc = doc
+    finally:
+        with open(stop_file, "w"):
+            pass
+        finished = sup.wait(timeout=30)
+        status = sup.status()
+        sup.stop()
+        srv.shutdown()
+    assert finished, status
+    assert all(s["state"] == "done" for s in status.values()), status
+
+    # --- incident reconstruction over the merged journals -----------
+    journals = [coord_journal, str(tmp_path / "w0.jsonl"),
+                str(tmp_path / "w1.jsonl")]
+    events, hist = incident.gather_events(journals,
+                                          alerts_doc=alerts_doc)
+    t0, t1, sel = incident.resolve_window(events, hist,
+                                          alert="dead_rank", pad=60.0)
+    rep = incident.build_report(events, hist, t0, t1, sel)
+    tl = rep["timeline"]
+
+    def first_idx(pred):
+        for i, e in enumerate(tl):
+            if pred(e):
+                return i
+        raise AssertionError(
+            f"missing from timeline: {[(e['kind'], e['event']) for e in tl]}")
+
+    i_kill = first_idx(lambda e: e["kind"] == "chaos"
+                       and e["event"] == "injected" and e["rank"] == 0)
+    i_dead = first_idx(lambda e: e["kind"] == "master"
+                       and e["event"] == "worker_dead"
+                       and e.get("detail", {}).get("worker") == 0)
+    i_respawn = first_idx(lambda e: e["kind"] == "supervisor"
+                          and e["event"] == "spawn"
+                          and e.get("detail", {}).get("worker") == 0
+                          and e.get("detail", {}).get(
+                              "incarnation", 0) >= 1)
+    i_fire = first_idx(lambda e: e["kind"] == "alert"
+                       and e["event"] == "fire"
+                       and e.get("detail", {}).get("rule")
+                       == "dead_rank")
+    i_resolve = first_idx(lambda e: e["kind"] == "alert"
+                          and e["event"] == "resolve"
+                          and e.get("detail", {}).get("rule")
+                          == "dead_rank")
+    assert i_kill < i_dead < i_respawn < i_resolve, \
+        [(e["kind"], e["event"]) for e in tl]
+    assert i_dead < i_fire < i_resolve
+    # the ASCII rendering holds the whole story
+    text = incident.render_report(rep)
+    for needle in ("chaos", "worker_dead", "spawn", "resolve",
+                   "alert dead_rank"):
+        assert needle in text, text

@@ -1,0 +1,86 @@
+"""Watchtower fleet worker — one rank of the ISSUE 15 headline e2e
+(tests/test_watchtower.py).
+
+Run:  python tests/watchtower_worker.py <host:port> <rank> <stop_file>
+
+The process registers + heartbeats with the coordinator the TEST owns,
+runs a synthetic traced step loop (each step observes
+``trainer_step_seconds`` under its own X-ray trace — so the shipped
+metric snapshots carry exemplar trace ids — and journals a ``worker
+step`` event), and reports via a background FleetReporter.  A
+``trainer.step`` chaos fault point fires every step: the e2e arms an
+``exit`` schedule on rank 0 so the process hard-dies mid-loop, the
+master's heartbeat reaper declares it dead (dead-rank alert fires on
+the coordinator), the supervisor respawns it clean (restart_env strips
+chaos) and the alert resolves.  The loop exits 0 once `stop_file`
+appears.
+"""
+import json
+import os
+import sys
+import time
+
+# repo root on sys.path (PYTHONPATH must stay unset — axon plugin
+# quirk, tests/conftest.py)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    endpoints, rank, stop_file = (sys.argv[1], int(sys.argv[2]),
+                                  sys.argv[3])
+    host, port = endpoints.rsplit(":", 1)
+
+    from paddle_tpu.distributed.task_queue import Heartbeater
+    from paddle_tpu.observability import fleet, journal, tracectx
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.resilience import chaos
+
+    journal.set_rank(rank)
+    tracectx.set_rank(rank)
+    restart_count = int(os.environ.get("PTPU_WORKER_RESTART_COUNT",
+                                       "0"))
+    journal.emit("worker", "start", restart_count=restart_count)
+
+    steps = obs_metrics.counter(
+        "trainer_steps_total", "Optimizer steps taken by Trainer.train.")
+    step_h = obs_metrics.histogram(
+        "trainer_step_seconds", "Wall time of one train step.")
+
+    hb = Heartbeater(f"{host}:{port}", rank)
+    hb.start()
+    reporter = fleet.FleetReporter(host, int(port), rank=rank)
+    reporter.start()
+
+    i = 0
+    try:
+        while not os.path.exists(stop_file):
+            t0 = time.perf_counter()
+            # the kill site: an armed exit schedule hard-dies HERE,
+            # journal already carrying the chaos event (flushed line)
+            chaos.trigger("trainer.step")
+            time.sleep(0.02)
+            ctx = tracectx.start_trace("worker.step")
+            with tracectx.activate(ctx):
+                # observed under an active trace -> the histogram
+                # bucket gains a (value, trace_id) exemplar, shipped in
+                # the next metrics snapshot — the dead-rank alert's
+                # "what was the victim doing" context
+                step_h.observe(time.perf_counter() - t0)
+            steps.inc()
+            journal.emit("worker", "step", step=i)
+            i += 1
+    finally:
+        journal.emit("worker", "stopping", steps_done=i)
+        try:
+            reporter.stop()
+        except Exception:
+            pass
+        hb.stop(goodbye=True)
+    print(json.dumps({"rank": rank, "steps": i,
+                      "restart_count": restart_count}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
