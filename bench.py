@@ -183,14 +183,19 @@ def _attach_cost(row, exe, prog, feed, fetch, dt, analytic=None):
     old hand-rolled FLOPs formula where one exists: kept as the
     cross-check (flops_vs_analytic, asserted within 10% by
     tests/test_observability.py) and as the fallback when the cost
-    model is off or unavailable."""
+    model is off or unavailable.  Every costed row also gains the
+    perfscope roofline fields: arithmetic intensity plus a
+    deterministic bound classification (bench_gate --trend flags a
+    bound FLIP across releases as a named regression)."""
     flops = None
+    bytes_accessed = 0.0
     try:
         rep = exe.explain(prog, feed=feed, fetch_list=[fetch])
         c = rep.get("cost") or {}
         f = float(c.get("flops") or 0.0)
         if f > 0:
             flops = f
+            bytes_accessed = float(c.get("bytes_accessed") or 0.0)
             row["cost_source"] = c.get("source")
     except Exception:
         pass
@@ -206,9 +211,15 @@ def _attach_cost(row, exe, prog, feed, fetch, dt, analytic=None):
     row["tflops"] = round(tflops, 3)
     # same peak source as trainer_mfu: the device_peak_flops flag, else
     # the per-platform table (197e12 on TPU; no peak -> no mfu)
-    from paddle_tpu.observability import costmodel
+    from paddle_tpu.observability import costmodel, perfscope
     peak = costmodel.device_peak_flops()
     row["mfu"] = round(flops / dt / peak, 3) if peak > 0 else None
+    if bytes_accessed > 0:
+        verdict = perfscope.classify(flops, bytes_accessed,
+                                     device_s=dt)
+        row["bytes_per_step"] = bytes_accessed
+        row["arith_intensity"] = round(verdict["arith_intensity"], 2)
+        row["bound"] = verdict["bound"]
     return row
 
 
@@ -893,7 +904,7 @@ def _compact_line(rows, errors):
     summary = {}
     for r in rows:
         s = {"value": r["value"]}
-        for k in ("mfu", "tflops", "vs_baseline"):
+        for k in ("mfu", "tflops", "vs_baseline", "bound"):
             if r.get(k) is not None:
                 s[k] = r[k]
         summary[r["metric"]] = s

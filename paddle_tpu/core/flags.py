@@ -243,6 +243,42 @@ define_flag("input_bound_warn_fraction", 0.5,
             "the cumulative data-wait time (reader next + feed build) "
             "exceeds this fraction of total step time.  0 disables.")
 
+# --- perf attribution (observability/perfscope.py) -------------------------
+define_flag("perfscope", False,
+            "Performance-attribution engine (observability/"
+            "perfscope.py): joins the cost model's FLOPs/bytes with "
+            "measured dispatch time into a roofline verdict (achieved "
+            "FLOP/s, arithmetic intensity, bound classification "
+            "compute|memory|comms|input|host), accounts exposed "
+            "collective time from the jaxpr's collective:* named "
+            "scopes (perf_comm_exposed_seconds / perf_bubble_fraction "
+            "gauges), and runs the rolling per-phase step-time "
+            "regression watch behind the built-in perf_regression "
+            "Watchtower rule.  Off: byte-identical outputs, compile "
+            "keys and explain() reports — zero extra compiles either "
+            "way (the comm model is a jaxpr trace, not an XLA "
+            "compile).")
+define_flag("perf_regression_factor", 2.0,
+            "Regression-watch trip point: a phase's rolling step-time "
+            "median exceeding its frozen baseline median by this "
+            "factor marks the phase regressed (perf_regression_ratio "
+            "gauge; the built-in perf_regression alert fires at this "
+            "same bar).  <= 1 disables the watch.")
+define_flag("perf_baseline_window", 32,
+            "Samples per phase the regression watch keeps: the FIRST "
+            "window freezes as the baseline, the newest window is the "
+            "rolling median compared against it.")
+define_flag("perf_hbm_gbps", 0.0,
+            "Per-device HBM bandwidth (GB/s) for roofline ridge "
+            "points.  0 = auto: TPU uses the v5e ~819 GB/s figure; "
+            "other backends fall back to a documented 100 GB/s CPU "
+            "prior so classification stays deterministic in tests.")
+define_flag("perf_ici_gbps", 0.0,
+            "Per-link interconnect bandwidth (GB/s) used to cost "
+            "collective bytes in the comm model.  0 = auto: TPU uses "
+            "a ~45 GB/s ICI figure; other backends fall back to a "
+            "documented 10 GB/s prior.")
+
 # --- resilience plane (resilience/: chaos, guard, retry) -------------------
 define_flag("chaos_spec", "",
             "Deterministic fault-injection spec, "

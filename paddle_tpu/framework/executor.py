@@ -1299,6 +1299,18 @@ class Executor:
                 mode = "jit"
             dt = time.perf_counter() - t0
         _m_step_seconds.labels(mode=mode).observe(dt)
+        # lazy import: perfscope has a `python -m` CLI, and eager
+        # package-graph imports trip runpy's sys.modules warning
+        from ..observability import perfscope as obs_perfscope
+        if obs_perfscope.enabled():
+            # roofline sink accounting per compiled program; the cost
+            # is the cached analytic view (a jaxpr trace at most once
+            # per program — never an XLA compile on the step path)
+            pcost = compiled.cost(prefer_analytic=True)
+            obs_perfscope.note_dispatch(
+                pcost.label if pcost is not None
+                else f"p{program._uid}.v{program._version}.step",
+                dt, pcost)
         obs_trace.add_span("executor.step", t0, dt,
                            tid=obs_trace.EXECUTOR_TID, cat="executor",
                            args={"mode": mode,
@@ -1738,7 +1750,8 @@ class Executor:
     def explain(self, program: Optional[Program] = None,
                 feed: Optional[Dict[str, Any]] = None,
                 fetch_list: Optional[Sequence] = None,
-                scope: Optional[Scope] = None) -> dict:
+                scope: Optional[Scope] = None,
+                perf: bool = False) -> dict:
         """Cost/memory report for the compiled program this
         (program, feed, fetch_list) resolves to — compiling it if
         needed, WITHOUT running it or consuming RNG state.
@@ -1795,10 +1808,25 @@ class Executor:
                 "source": (compiled._persist_source
                            or compiled._donate_source),
             }}
+        # perf section: present ONLY when the caller asked AND the
+        # perfscope flag is on — the default explain() report stays
+        # byte-identical to the pre-perfscope executor
+        perf_doc = {}
+        from ..observability import perfscope as obs_perfscope
+        if perf and obs_perfscope.enabled() and cost is not None:
+            prior = obs_perfscope.status_doc()["programs"].get(
+                cost.label) or {}
+            perf_doc = {"perf": {
+                **obs_perfscope.explain_section(
+                    cost, seconds=prior.get("last_s", 0.0)),
+                "dispatches": prior.get("count", 0),
+                "total_seconds": prior.get("total_s", 0.0),
+            }}
         return {
             "schema": "paddle_tpu.explain.v1",
             **analysis_doc,
             **jc_doc,
+            **perf_doc,
             "program": {"uid": program._uid,
                         "version": program._version,
                         "ops": len(compiled._ops),

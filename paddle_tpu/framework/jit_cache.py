@@ -52,8 +52,13 @@ reason labels) and ``jit_cache_bytes``.  Flags: ``jit_cache_dir``
 (LRU-by-mtime GC; hits touch mtime).
 
 CLI: ``python -m paddle_tpu.framework.jit_cache --dir D --ls | --gc |
---purge | --self-test | --restart-probe lm`` (exit 0 ok / 1 failure /
-2 bad usage; the probe is the bench driver's cold/warm child).
+--purge | --warm SRC | --self-test | --restart-probe lm`` (exit 0 ok /
+1 failure / 2 bad usage; the probe is the bench driver's cold/warm
+child).  ``--warm`` pre-seeds the cache dir from another run's (or a
+shared fleet dir's) entries — each candidate is fully validated
+(magic, schema, THIS build's env, body checksum) before the copy, so
+a new replica's first compile sites all hit without ever having
+compiled here.
 """
 from __future__ import annotations
 
@@ -412,6 +417,68 @@ def gc(limit_bytes: Optional[int] = None) -> int:
     return evicted
 
 
+def warm(src_dir: str, dst_dir: Optional[str] = None) -> dict:
+    """Pre-seed ``dst_dir`` (default: the active cache dir) from the
+    entries in ``src_dir`` — a previous run's dir, or a shared fleet
+    dir a new replica copies from before its first compile.
+
+    Every candidate is validated BEFORE the copy with the same checks
+    ``load`` applies (magic, header JSON, schema, env == this build,
+    body sha256), so warming from a poisoned or foreign-build dir
+    seeds nothing bad: stale/corrupt entries are counted and skipped,
+    never copied and never deleted from the source.  Entries already
+    present in the destination are left alone (their mtime is their
+    LRU clock).  Copies use the atomic-write path, so a concurrent
+    reader in the destination dir never sees a torn entry."""
+    dst = dst_dir or cache_dir()
+    env = build_env()
+    fixed = len(_MAGIC) + 4
+    out = {"src": src_dir, "dst": dst, "copied": 0, "present": 0,
+           "stale": 0, "corrupt": 0, "bytes": 0}
+    for e in _entries(src_dir):
+        dst_path = os.path.join(dst, os.path.basename(e["path"]))
+        if os.path.exists(dst_path):
+            out["present"] += 1
+            continue
+        try:
+            with open(e["path"], "rb") as f:
+                raw = f.read()
+        except OSError:
+            out["corrupt"] += 1
+            continue
+        if len(raw) < fixed or raw[:len(_MAGIC)] != _MAGIC:
+            out["corrupt"] += 1
+            continue
+        (hlen,) = struct.unpack("<I", raw[len(_MAGIC):fixed])
+        body_at = fixed + hlen + 32
+        if len(raw) < body_at:
+            out["corrupt"] += 1
+            continue
+        try:
+            header = json.loads(raw[fixed:fixed + hlen].decode())
+        except ValueError:
+            out["corrupt"] += 1
+            continue
+        if (header.get("schema") != _SCHEMA
+                or header.get("env") != env):
+            out["stale"] += 1
+            continue
+        digest, body = raw[fixed + hlen:body_at], raw[body_at:]
+        if hashlib.sha256(body).digest() != digest:
+            out["corrupt"] += 1
+            continue
+        os.makedirs(dst, exist_ok=True)
+        _atomic_write(dst_path, raw)
+        out["copied"] += 1
+        out["bytes"] += len(raw)
+    obs_flight.record("jit_cache", "warm", src=src_dir,
+                      copied=out["copied"], stale=out["stale"],
+                      corrupt=out["corrupt"])
+    if dst == cache_dir():
+        gc()                    # respect the byte limit + refresh gauge
+    return out
+
+
 def purge() -> int:
     """Delete every entry (and hit sidecar); returns entries removed."""
     d = cache_dir()
@@ -604,6 +671,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="apply jit_cache_limit_bytes now")
     parser.add_argument("--purge", action="store_true",
                         help="delete every entry")
+    parser.add_argument("--warm", default=None, metavar="SRC",
+                        help="pre-seed the cache dir from SRC's entries "
+                             "(validated: only intact artifacts of THIS "
+                             "build are copied)")
     parser.add_argument("--self-test", action="store_true",
                         help="store/load/corrupt-fallback round trip "
                              "in a temp dir")
@@ -623,13 +694,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.dir is not None:
         flags.set_flag("jit_cache_dir", args.dir)
     try:
-        if not (args.ls or args.gc or args.purge):
+        if not (args.ls or args.gc or args.purge or args.warm):
             parser.print_usage()
             return 2
         if not cache_dir():
             print("no cache dir: pass --dir or set jit_cache_dir / "
                   "PTPU_JIT_CACHE_DIR")
             return 2
+        if args.warm:
+            r = warm(args.warm)
+            print(f"warm: copied {r['copied']} entr(ies) "
+                  f"({r['bytes']} bytes) from {args.warm}; "
+                  f"{r['present']} already present, {r['stale']} stale, "
+                  f"{r['corrupt']} corrupt skipped")
         if args.purge:
             print(f"purged {purge()} entr(ies) from {cache_dir()}")
         if args.gc:

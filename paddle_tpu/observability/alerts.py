@@ -118,7 +118,8 @@ class Rule:
 
     __slots__ = ("name", "metric", "predicate", "op", "value",
                  "for_seconds", "window", "quantile", "labels",
-                 "severity", "description", "bound", "budget", "source")
+                 "severity", "description", "bound", "budget", "source",
+                 "context_fn")
 
     def __init__(self, name: str, metric: str, predicate: str,
                  op: str = ">", value: float = 0.0,
@@ -127,7 +128,9 @@ class Rule:
                  labels: Optional[Dict[str, str]] = None,
                  severity: str = "warning", description: str = "",
                  bound: Optional[float] = None, budget: float = 0.01,
-                 source: str = "file"):
+                 source: str = "file",
+                 context_fn: Optional[Callable[
+                     [Dict[str, str]], dict]] = None):
         self.name = name
         self.metric = metric
         self.predicate = predicate
@@ -142,6 +145,11 @@ class Rule:
         self.bound = bound
         self.budget = float(budget)
         self.source = source
+        # code-only hook (NOT a rules-file field): built-in rules whose
+        # breaching metric is a bare gauge (no exemplars, no per-rank
+        # snapshot) supply their own context — perfscope's
+        # perf_regression names the phase + an exemplar trace id
+        self.context_fn = context_fn
 
     def to_dict(self) -> dict:
         d = {"name": self.name, "metric": self.metric,
@@ -345,6 +353,21 @@ def default_rules() -> List[Rule]:
       description="persistent executable cache entries failing to "
                   "load/store (corrupt or stale-build artifacts; "
                   "starts degrade to recompiles)")
+    # perfscope regression watch: present only when the perfscope flag
+    # is on (the rules-whose-gating-flag-is-off-are-omitted idiom) —
+    # the context_fn supplies the offending phase + an exemplar trace
+    # id, which a bare gauge series cannot carry itself
+    from . import perfscope
+    factor = float(flags.get_flag("perf_regression_factor"))
+    if perfscope.enabled() and factor > 1.0:
+        r(name="perf_regression",
+          metric="perf_regression_ratio", predicate="threshold",
+          op=">=", value=factor, for_seconds=0.0, severity="warning",
+          description="a trainer/serving phase's rolling step-time "
+                      "median regressed past perf_regression_factor x "
+                      "its frozen baseline (perfscope context names "
+                      "the phase + an exemplar trace id)",
+          context_fn=perfscope.alert_context)
     return out
 
 
@@ -682,6 +705,16 @@ class AlertEngine:
                          "last_reason": (last or {}).get("reason")}
         if obs_tracectx.enabled():
             ctx["alert_trace_id"] = obs_tracectx.new_trace_id()
+        # rule-supplied context (perf_regression: phase + exemplar
+        # trace id) — merged last, never clobbering engine keys
+        fn = getattr(rule, "context_fn", None)
+        if fn is not None:
+            try:
+                extra = fn(dict(labels))
+            except Exception:
+                extra = None
+            for k, v in (extra or {}).items():
+                ctx.setdefault(k, v)
         return ctx
 
     @staticmethod

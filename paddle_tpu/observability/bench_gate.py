@@ -72,11 +72,13 @@ def lower_is_better(metric: str) -> bool:
 
 
 def load_trend_record(doc: dict) -> Dict[str, dict]:
-    """Extract ``{metric: {"value": v, "mfu": m?}}`` from one release
-    record — driver BENCH_r*.json files (with or without the compact
-    ``summary``), registry dumps, or plain maps.  Unlike
-    :func:`load_metric_values` this keeps the per-metric MFU, so the
-    trend view tracks efficiency next to throughput."""
+    """Extract ``{metric: {"value": v, "mfu": m?, "bound": b?}}`` from
+    one release record — driver BENCH_r*.json files (with or without
+    the compact ``summary``), registry dumps, or plain maps.  Unlike
+    :func:`load_metric_values` this keeps the per-metric MFU and the
+    perfscope roofline bound classification, so the trend view tracks
+    efficiency and perf character next to throughput.  Records written
+    before bench.py attached bounds simply carry ``bound: None``."""
     doc = _unwrap_parsed(doc)
     if doc is None:
         return {}
@@ -85,15 +87,18 @@ def load_trend_record(doc: dict) -> Dict[str, dict]:
         for m, row in doc["summary"].items():
             if isinstance(row, dict):
                 out[m] = {"value": float(row["value"]),
-                          "mfu": row.get("mfu")}
+                          "mfu": row.get("mfu"),
+                          "bound": row.get("bound")}
             else:
-                out[m] = {"value": float(row), "mfu": None}
+                out[m] = {"value": float(row), "mfu": None,
+                          "bound": None}
         return out
     if "metric" in doc and "value" in doc:
         # pre-summary driver records (BENCH_r01): one row at top level
         return {str(doc["metric"]): {"value": float(doc["value"]),
-                                     "mfu": doc.get("mfu")}}
-    return {m: {"value": v, "mfu": None}
+                                     "mfu": doc.get("mfu"),
+                                     "bound": doc.get("bound")}}
+    return {m: {"value": v, "mfu": None, "bound": None}
             for m, v in load_metric_values(doc).items()}
 
 
@@ -105,7 +110,14 @@ def trend(records: List, tolerance: float = 0.15,
     best by more than `tolerance` (direction-aware; per-metric MFU is
     tracked as its own higher-is-better series).  Metrics present in
     any prior record but absent from the newest are flagged
-    ``missing`` and fail the gate unless ``allow_missing``."""
+    ``missing`` and fail the gate unless ``allow_missing``.
+
+    Records carrying a perfscope ``bound`` classification get a
+    ``{metric}.bound`` row; when the newest record's bound differs
+    from the last known one (e.g. compute -> comms) the row is a
+    named regression — the workload's perf character changed, so the
+    roofline knobs tuned against the old bound no longer apply, even
+    if raw throughput squeaked under the tolerance."""
     if len(records) < 2:
         raise ValueError(
             f"trend needs >= 2 release records, got {len(records)}")
@@ -146,6 +158,21 @@ def trend(records: List, tolerance: float = 0.15,
             if (newest.get(metric) or {}).get("mfu") is None:
                 mrow["status"] = "missing"
             rows.append(mrow)
+        bounds = [(name, (rec.get(metric) or {}).get("bound"))
+                  for name, rec in records]
+        known = [(n, b) for n, b in bounds if b]
+        if known:
+            cur = (newest.get(metric) or {}).get("bound")
+            prior = [b for n, b in known if n != newest_name]
+            brow = {"metric": f"{metric}.bound", "unit": "bound",
+                    "series": [{"release": n, "value": b}
+                               for n, b in bounds],
+                    "best": None, "best_release": None,
+                    "newest": cur, "status": "ok"}
+            if cur is not None and prior and prior[-1] != cur:
+                brow["status"] = "regression"
+                brow["flip"] = f"{prior[-1]}->{cur}"
+            rows.append(brow)
     bad = [r["metric"] for r in rows if r["status"] == "regression"]
     missing = [r["metric"] for r in rows if r["status"] == "missing"]
     return {"schema": "paddle_tpu.bench_trend.v1",
@@ -336,8 +363,10 @@ def _trend_main(paths: List[str], tolerance: float,
         mark = {"regression": "FAIL", "missing": "miss"}.get(
             r["status"], "  ok")
         series = " -> ".join(_fmt_val(s["value"]) for s in r["series"])
-        print(f"[{mark}] {r['metric']}: {series}  "
-              f"(best {_fmt_val(r['best'])} @{r['best_release']})")
+        tail = (f"(FLIP {r['flip']})" if "flip" in r
+                else "" if r["best"] is None
+                else f"(best {_fmt_val(r['best'])} @{r['best_release']})")
+        print(f"[{mark}] {r['metric']}: {series}  {tail}")
     print(json.dumps({k: result[k] for k in
                       ("tolerance", "newest", "regressions", "missing",
                        "ok")}))
@@ -363,9 +392,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--trend", nargs="+", metavar="RECORD",
                    help="cross-release trajectory mode: 2+ BENCH_r*.json "
                         "records (sorted by filename = release order); "
-                        "prints per-metric tokens/s + MFU series and "
-                        "exits 1 when the newest record regresses the "
-                        "best-ever by > tolerance")
+                        "prints per-metric tokens/s + MFU + roofline-"
+                        "bound series and exits 1 when the newest record "
+                        "regresses the best-ever by > tolerance or flips "
+                        "its bound classification")
     args = p.parse_args(argv)
     if args.smoke:
         return smoke()

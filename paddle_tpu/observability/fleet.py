@@ -749,6 +749,17 @@ class FleetAggregator:
             return {r: w["model"] for r, w in self._workers.items()
                     if isinstance(w.get("model"), dict)}
 
+    def perf_rows(self) -> Dict[str, dict]:
+        """Per-rank roofline rows reconstructed from each worker's
+        last shipped metric snapshot (perf_* gauge families) — the
+        fleet-merged half of GET /perf."""
+        from . import perfscope as obs_perfscope
+        with self._lock:
+            docs = {r: w.get("metrics") for r, w in self._workers.items()
+                    if isinstance(w.get("metrics"), dict)}
+        return {str(r): obs_perfscope.rows_from_metrics_doc(doc)
+                for r, doc in sorted(docs.items())}
+
     def health(self) -> dict:
         """Liveness summary for /healthz: per-worker report age, stale
         set, straggler set, and the fleet degraded verdict."""

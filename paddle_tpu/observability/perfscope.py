@@ -1,0 +1,689 @@
+"""Perfscope: roofline attribution, collective-bubble accounting, and a
+perf-regression watch.
+
+The bench trajectory says *what* throughput is (MFU flat at 0.56 since
+BENCH_r05); this module says *why*.  It joins the signals the stack
+already has — the cost model's FLOPs / bytes_accessed (costmodel.py),
+the measured step anatomy (trainer data-wait/host/device split,
+executor dispatch histograms, serving prefill/decode timings) and the
+``collective:*`` named scopes in parallel/hybrid.py — into one roofline
+verdict per program and per trainer/serving phase:
+
+  achieved FLOP/s vs device peak, arithmetic intensity vs the ridge
+  point, and a bound classification {compute|memory|comms|input|host}
+  with a recommended knob per verdict (the docs/PERF.md anatomy->knob
+  table, machine-executed).
+
+Collective accounting — how, honestly: ``jax.named_scope`` blocks run
+at TRACE time, so the host cannot time individual collectives per
+execution.  Instead perfscope traces the jitted step to a jaxpr
+(``jax.make_jaxpr`` — an abstract trace, NOT an XLA compile; the
+forensics compile log stays silent) and walks it like costmodel's
+analytic walker, attributing each collective equation's output bytes to
+the ``collective:<label>`` name found on its source-info name stack
+(scan bodies multiply by trip count; gradient transposes keep the scope
+as a substring).  Byte counts over per-platform link bandwidth give a
+deterministic comm-time model; the MEASURED device step time anchors
+the absolute seconds:
+
+  perf_comm_exposed_seconds  = device_s x (comm model share)
+  perf_bubble_fraction{collective} = that collective's share of the
+                                     modeled step time
+
+Device parameters: TPU uses the costmodel peak-FLOPs table plus ~819
+GB/s HBM / ~45 GB/s ICI; other backends fall back to DOCUMENTED priors
+(1 TFLOP/s peak, 100 GB/s HBM, 10 GB/s ICI) so classification is
+deterministic in CPU tier-1 runs.  ``perf_hbm_gbps`` /
+``perf_ici_gbps`` / ``device_peak_flops`` override all three.
+
+Regression watch: per phase, the FIRST ``perf_baseline_window`` step
+times freeze as the baseline; the rolling median of the newest window
+is compared against it and published as ``perf_regression_ratio{phase}``
+— the gauge the built-in ``perf_regression`` Watchtower rule
+(alerts.py) thresholds at ``perf_regression_factor``, with this
+module's :func:`alert_context` supplying the offending phase and an
+exemplar trace id.
+
+Everything is gated on the ``perfscope`` flag: off means byte-identical
+outputs, compile keys and explain() reports, and zero gauges published.
+On adds NO compiles on any step/request path either — the comm model
+and the analytic cost are both jaxpr traces.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import threading
+from collections import deque
+from statistics import median
+from typing import Any, Dict, List, Optional
+
+from ..core import flags
+from . import costmodel as obs_cost
+from . import metrics as obs_metrics
+
+# --- registry metrics ------------------------------------------------------
+_m_ratio = obs_metrics.gauge(
+    "perf_regression_ratio",
+    "Rolling step-time median / frozen baseline median per phase "
+    "(perfscope regression watch; the built-in perf_regression alert "
+    "thresholds this at perf_regression_factor).", ("phase",))
+_m_exposed = obs_metrics.gauge(
+    "perf_comm_exposed_seconds",
+    "Exposed (non-overlapped) collective seconds of the last "
+    "collective-bearing step: measured device time x the comm model's "
+    "share of modeled step time.")
+_m_bubble = obs_metrics.gauge(
+    "perf_bubble_fraction",
+    "Per-collective bubble: that collective's share of the modeled "
+    "step time (named from the collective:* scopes in "
+    "parallel/hybrid.py via the jaxpr name stack).", ("collective",))
+_m_mfu = obs_metrics.gauge(
+    "perf_mfu",
+    "Achieved FLOP/s / device peak per perfscope phase.", ("phase",))
+_m_achieved = obs_metrics.gauge(
+    "perf_achieved_flops",
+    "Achieved FLOP/s (model FLOPs / measured device seconds) per "
+    "perfscope phase.", ("phase",))
+_m_intensity = obs_metrics.gauge(
+    "perf_arith_intensity",
+    "Arithmetic intensity (FLOPs / bytes accessed) per perfscope "
+    "phase; compare against the device ridge point.", ("phase",))
+_m_bound = obs_metrics.gauge(
+    "perf_bound",
+    "1 on the series matching a phase's CURRENT bound classification "
+    "(compute|memory|comms|input|host), 0 on its previous one.",
+    ("phase", "bound"))
+
+BOUNDS = ("compute", "memory", "comms", "input", "host")
+
+# Documented CPU-fallback priors — arbitrary but FIXED, so tier-1
+# classification is deterministic without real hardware counters.
+_CPU_PEAK_FLOPS = 1e12
+_CPU_HBM_BPS = 100e9
+_CPU_ICI_BPS = 10e9
+# v5e figures (HBM from the spec sheet, ICI per link); peak FLOPs come
+# from costmodel's table / the device_peak_flops flag.
+_TPU_HBM_BPS = 819e9
+_TPU_ICI_BPS = 45e9
+
+# classification thresholds (fractions of wall / modeled step time)
+_INPUT_FRACTION = 0.5
+_HOST_FRACTION = 0.5
+_COMM_SHARE = 1.0 / 3.0         # comms = plurality of the modeled time
+
+RECOMMEND = {
+    "compute": "raise MXU throughput: fuse_block, amp_bf16, or "
+               "quantize_dtype (int8/fp8 matmuls)",
+    "memory": "cut HBM traffic: fuse_block (VMEM-resident blocks), "
+              "less remat, larger fused steps (run_steps)",
+    "comms": "overlap collectives with compute (ROADMAP item 5) or "
+             "grow the per-device batch to amortize the psum",
+    "input": "raise prefetch_depth (double-buffered feeds) or speed "
+             "up the reader",
+    "host": "batch device work with run_steps (one dispatch per N "
+            "steps) and trim per-step host work",
+}
+
+_COLLECTIVE_RE = re.compile(r"collective:([A-Za-z0-9_.\-]+)")
+# fallback labels for collectives outside any collective:* scope
+_COLLECTIVE_PRIMS = frozenset((
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "all_gather_invariant",
+))
+
+_lock = threading.RLock()
+_phases: Dict[str, dict] = {}        # phase -> record (see _phase_rec)
+_programs: Dict[str, dict] = {}      # program label -> sink record
+_models: Dict[str, Optional[dict]] = {}   # label -> cached program model
+_collectives: Dict[str, dict] = {}   # collective label -> last accounting
+_last_regression: Optional[dict] = None
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("perfscope"))
+
+
+def device_params() -> dict:
+    """Roofline parameters for THIS process's backend, with documented
+    CPU-fallback priors so verdicts stay deterministic off-TPU."""
+    platform = "unknown"
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:
+        pass
+    peak = obs_cost.device_peak_flops()
+    if peak <= 0:
+        peak = _CPU_PEAK_FLOPS
+    hbm = float(flags.get_flag("perf_hbm_gbps")) * 1e9
+    if hbm <= 0:
+        hbm = _TPU_HBM_BPS if platform == "tpu" else _CPU_HBM_BPS
+    ici = float(flags.get_flag("perf_ici_gbps")) * 1e9
+    if ici <= 0:
+        ici = _TPU_ICI_BPS if platform == "tpu" else _CPU_ICI_BPS
+    return {"platform": platform, "peak_flops": peak, "hbm_bps": hbm,
+            "ici_bps": ici, "ridge_intensity": peak / hbm}
+
+
+# --- the comm model (jaxpr walk keyed by collective:* name scopes) ---------
+
+def comm_model(fn, abs_args) -> Dict[str, float]:
+    """Bytes moved per collective label in one execution of ``fn`` —
+    an abstract jaxpr trace (NO XLA compile), walking sub-jaxprs with
+    scan-trip multipliers exactly like costmodel's analytic walker.
+    Labels come from the ``collective:<name>`` scopes on each
+    equation's name stack (substring match, so gradient transposes
+    keep their attribution); un-scoped collective primitives fall back
+    to the primitive name.  {} when the trace fails or the program has
+    no collectives."""
+    try:
+        import jax
+        closed = jax.make_jaxpr(fn)(*abs_args)
+    except Exception:
+        return {}
+    out: Dict[str, float] = {}
+    _walk_comm(closed.jaxpr, 1.0, out)
+    return out
+
+
+def _walk_comm(jaxpr, mult: float, out: Dict[str, float]):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "cond":
+            # charge the branch with the most collective bytes (the
+            # costmodel max-branch idiom)
+            best: Dict[str, float] = {}
+            for br in eqn.params.get("branches", ()):
+                sub = obs_cost._as_jaxpr(br)
+                if sub is None:
+                    continue
+                acc: Dict[str, float] = {}
+                _walk_comm(sub, mult, acc)
+                if sum(acc.values()) > sum(best.values()):
+                    best = acc
+            for k, v in best.items():
+                out[k] = out.get(k, 0.0) + v
+            continue
+        subs = obs_cost._sub_jaxprs(eqn)
+        if subs:
+            for sub, m in subs:
+                _walk_comm(sub, mult * m, out)
+            continue
+        stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+        m_ = _COLLECTIVE_RE.search(stack)
+        if m_:
+            label = m_.group(1)
+        elif name in _COLLECTIVE_PRIMS:
+            label = name
+        else:
+            continue
+        nbytes = sum(obs_cost._aval_bytes(v.aval) for v in eqn.outvars)
+        out[label] = out.get(label, 0.0) + nbytes * mult
+
+
+def program_model(label: str, fn, args) -> Optional[dict]:
+    """Cached {flops, bytes_accessed, comm:{label: bytes}} model of a
+    jitted step — built ONCE per label from abstract shapes (call
+    before dispatch: donated buffers must still be valid).  Uses the
+    cost model when its flag is on (publishing program_cost_* gauges),
+    the raw jaxpr walker otherwise; None only when both traces fail."""
+    with _lock:
+        if label in _models:
+            return _models[label]
+    abs_args = obs_cost.abstractify(args)
+    comm = comm_model(fn, abs_args)
+    cost = obs_cost.analyze_jitted(fn, abs_args, label,
+                                   prefer_analytic=True)
+    if cost is None:                     # cost_model flag off
+        cost = obs_cost._jaxpr_analyze(fn, abs_args, label)
+    model = None
+    if cost is not None or comm:
+        model = {"flops": float(cost.flops) if cost else 0.0,
+                 "bytes_accessed":
+                     float(cost.bytes_accessed) if cost else 0.0,
+                 "comm": comm}
+    with _lock:
+        _models[label] = model
+    return model
+
+
+# --- classification --------------------------------------------------------
+
+def classify(flops: float, bytes_accessed: float,
+             comm_bytes: float = 0.0, *, device_s: float = 0.0,
+             data_wait_s: float = 0.0, host_s: float = 0.0,
+             wall_s: float = 0.0, params: Optional[dict] = None) -> dict:
+    """Roofline verdict for one step/program.  Pure and deterministic:
+    measured seconds feed the achieved-FLOP/s and input/host checks;
+    the compute-vs-memory-vs-comms split is the cost MODEL (flops/peak,
+    bytes/hbm_bw, comm_bytes/ici_bw), so CPU tier-1 classification
+    does not depend on wall-clock noise.  bound is None when there is
+    nothing to classify (no cost model and no anatomy)."""
+    p = params or device_params()
+    compute_s = flops / p["peak_flops"]
+    mem_s = bytes_accessed / p["hbm_bps"]
+    comm_s = comm_bytes / p["ici_bps"]
+    model_s = compute_s + mem_s + comm_s
+    wall = wall_s or (data_wait_s + host_s + device_s)
+    intensity = flops / bytes_accessed if bytes_accessed > 0 else 0.0
+    achieved = flops / device_s if device_s > 0 and flops > 0 else 0.0
+    bound = None
+    if wall > 0 and data_wait_s / wall >= _INPUT_FRACTION:
+        bound = "input"
+    elif wall > 0 and host_s / wall >= _HOST_FRACTION \
+            and host_s > device_s:
+        bound = "host"
+    elif model_s > 0:
+        if comm_s >= _COMM_SHARE * model_s:
+            bound = "comms"
+        elif intensity >= p["ridge_intensity"]:
+            bound = "compute"
+        else:
+            bound = "memory"
+    comm_share = comm_s / model_s if model_s > 0 else 0.0
+    return {
+        "bound": bound,
+        "recommend": RECOMMEND.get(bound),
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "comm_bytes": comm_bytes,
+        "arith_intensity": intensity,
+        "ridge_intensity": p["ridge_intensity"],
+        "achieved_flops": achieved,
+        "mfu": achieved / p["peak_flops"] if achieved > 0 else 0.0,
+        "comm_share": comm_share,
+        "exposed_comm_seconds":
+            device_s * comm_share if device_s > 0 else comm_s,
+        "model_seconds": {"compute": compute_s, "memory": mem_s,
+                          "comms": comm_s},
+    }
+
+
+# --- phase / program recording --------------------------------------------
+
+def _phase_rec(phase: str) -> dict:
+    rec = _phases.get(phase)
+    if rec is None:
+        window = max(1, int(flags.get_flag("perf_baseline_window")))
+        rec = {"count": 0, "total_s": 0.0, "last_s": 0.0,
+               "baseline": deque(maxlen=window),
+               "recent": deque(maxlen=window),
+               "ratio": 1.0, "regressed": False,
+               "last_trace_id": None, "verdict": None}
+        _phases[phase] = rec
+    return rec
+
+
+def _watch(phase: str, rec: dict, seconds: float,
+           trace_id: Optional[str]):
+    """One regression-watch sample (call under _lock): the first
+    window freezes as baseline, the rolling median of the newest
+    window is the ratio numerator."""
+    global _last_regression
+    if trace_id:
+        rec["last_trace_id"] = trace_id
+    if len(rec["baseline"]) < rec["baseline"].maxlen:
+        rec["baseline"].append(seconds)
+    else:
+        rec["recent"].append(seconds)
+    ratio = 1.0
+    if rec["recent"] and rec["baseline"]:
+        base = median(rec["baseline"])
+        ratio = median(rec["recent"]) / max(base, 1e-12)
+    rec["ratio"] = ratio
+    factor = float(flags.get_flag("perf_regression_factor"))
+    rec["regressed"] = factor > 1.0 and ratio >= factor
+    _m_ratio.labels(phase=phase).set(ratio)
+    if rec["regressed"]:
+        _last_regression = {
+            "phase": phase, "ratio": ratio,
+            "baseline_s": median(rec["baseline"]),
+            "recent_s": median(rec["recent"]),
+            "trace_id": rec["last_trace_id"]}
+
+
+def _publish_bound(phase: str, rec: dict, bound: Optional[str]):
+    prev = (rec.get("verdict") or {}).get("bound")
+    if prev and prev != bound:
+        _m_bound.labels(phase=phase, bound=prev).set(0)
+    if bound:
+        _m_bound.labels(phase=phase, bound=bound).set(1)
+
+
+def note_step(phase: str, device_s: float = 0.0,
+              data_wait_s: float = 0.0, host_s: float = 0.0,
+              wall_s: float = 0.0, cost: Any = None,
+              model: Optional[dict] = None,
+              trace_id: Optional[str] = None):
+    """Record one measured step of ``phase``: roofline verdict (from
+    ``model`` — a :func:`program_model` dict — or a costmodel
+    ProgramCost), comm-exposure gauges when the model names
+    collectives, and a regression-watch sample.  No-op when the
+    perfscope flag is off."""
+    if not enabled():
+        return
+    flops = bytes_acc = 0.0
+    comm: Dict[str, float] = {}
+    if model:
+        flops = float(model.get("flops", 0.0))
+        bytes_acc = float(model.get("bytes_accessed", 0.0))
+        comm = dict(model.get("comm") or {})
+    elif cost is not None:
+        flops = float(getattr(cost, "flops", 0.0))
+        bytes_acc = float(getattr(cost, "bytes_accessed", 0.0))
+    params = device_params()
+    verdict = classify(flops, bytes_acc, sum(comm.values()),
+                       device_s=device_s, data_wait_s=data_wait_s,
+                       host_s=host_s, wall_s=wall_s, params=params)
+    with _lock:
+        rec = _phase_rec(phase)
+        rec["count"] += 1
+        seconds = wall_s or (data_wait_s + host_s + device_s)
+        rec["total_s"] += seconds
+        rec["last_s"] = seconds
+        _publish_bound(phase, rec, verdict["bound"])
+        rec["verdict"] = verdict
+        if verdict["achieved_flops"] > 0:
+            _m_achieved.labels(phase=phase).set(
+                verdict["achieved_flops"])
+            _m_mfu.labels(phase=phase).set(verdict["mfu"])
+        if verdict["arith_intensity"] > 0:
+            _m_intensity.labels(phase=phase).set(
+                verdict["arith_intensity"])
+        if comm:
+            _m_exposed.set(verdict["exposed_comm_seconds"])
+            model_total = sum(verdict["model_seconds"].values())
+            for label, nbytes in comm.items():
+                frac = (nbytes / params["ici_bps"]) / model_total \
+                    if model_total > 0 else 0.0
+                _m_bubble.labels(collective=label).set(frac)
+                _collectives[label] = {
+                    "bytes": nbytes,
+                    "model_seconds": nbytes / params["ici_bps"],
+                    "bubble_fraction": frac}
+        _watch(phase, rec, seconds, trace_id)
+
+
+def note_phase(phase: str, seconds: float,
+               trace_id: Optional[str] = None):
+    """Timing-only sample (serving prefill/decode): regression watch
+    and time-sink accounting, no roofline (no cost model attached)."""
+    note_step(phase, device_s=seconds, trace_id=trace_id)
+
+
+def note_dispatch(label: str, seconds: float, cost: Any = None):
+    """One executor dispatch of a compiled program: per-PROGRAM sink
+    accounting for the top-N report and explain(perf=True).  Programs
+    are not phases — no regression watch (label cardinality follows
+    compiled variants, not pipeline stages)."""
+    if not enabled():
+        return
+    flops = float(getattr(cost, "flops", 0.0) or 0.0)
+    bytes_acc = float(getattr(cost, "bytes_accessed", 0.0) or 0.0)
+    verdict = classify(flops, bytes_acc, device_s=seconds)
+    with _lock:
+        rec = _programs.setdefault(
+            label, {"count": 0, "total_s": 0.0, "last_s": 0.0})
+        rec["count"] += 1
+        rec["total_s"] += seconds
+        rec["last_s"] = seconds
+        rec["verdict"] = verdict
+
+
+# --- alert context (the perf_regression built-in rule) ---------------------
+
+def alert_context(labels: Optional[Dict[str, str]] = None) -> dict:
+    """Context for a firing perf_regression alert: the offending phase
+    (from the breaching series' labels, else the last regressed
+    phase), its ratio/baseline, and an exemplar trace id of a slow
+    step.  Wired as the rule's ``context_fn`` in alerts.py — gauges
+    carry no exemplars, so the engine cannot find these itself."""
+    with _lock:
+        phase = (labels or {}).get("phase")
+        rec = _phases.get(phase) if phase else None
+        if rec is None and _last_regression is not None:
+            phase = _last_regression["phase"]
+            rec = _phases.get(phase)
+        if rec is None:
+            return {}
+        ctx: Dict[str, Any] = {
+            "phase": phase, "regression_ratio": rec["ratio"]}
+        if rec["baseline"]:
+            ctx["baseline_seconds"] = median(rec["baseline"])
+        if rec["recent"]:
+            ctx["recent_seconds"] = median(rec["recent"])
+        if rec["last_trace_id"]:
+            ctx["exemplar_trace_ids"] = [rec["last_trace_id"]]
+        return ctx
+
+
+# --- reporting -------------------------------------------------------------
+
+def _phase_doc(rec: dict) -> dict:
+    d = {"count": rec["count"], "total_s": rec["total_s"],
+         "last_s": rec["last_s"], "regression_ratio": rec["ratio"],
+         "regressed": rec["regressed"],
+         "last_trace_id": rec["last_trace_id"]}
+    if rec["baseline"]:
+        d["baseline_s"] = median(rec["baseline"])
+    v = rec.get("verdict")
+    if v:
+        d.update({k: v[k] for k in
+                  ("bound", "recommend", "mfu", "achieved_flops",
+                   "arith_intensity", "comm_share",
+                   "exposed_comm_seconds")})
+    return d
+
+
+def status_doc() -> dict:
+    """The full perfscope view — GET /perf (local half), the CLI, and
+    Executor.explain(perf=True) all render from this one document."""
+    with _lock:
+        phases = {name: _phase_doc(rec)
+                  for name, rec in sorted(_phases.items())}
+        programs = {
+            label: {"count": rec["count"], "total_s": rec["total_s"],
+                    "last_s": rec["last_s"],
+                    **{k: rec["verdict"][k] for k in
+                       ("bound", "recommend", "mfu", "achieved_flops",
+                        "arith_intensity")
+                       if rec.get("verdict")}}
+            for label, rec in sorted(_programs.items())}
+        collectives = {k: dict(v)
+                       for k, v in sorted(_collectives.items())}
+        last_reg = dict(_last_regression) if _last_regression else None
+    return {
+        "schema": "paddle_tpu.perf.v1",
+        "enabled": enabled(),
+        "device": device_params(),
+        "regression": {
+            "factor": float(flags.get_flag("perf_regression_factor")),
+            "window": int(flags.get_flag("perf_baseline_window")),
+            "last": last_reg},
+        "phases": phases,
+        "programs": programs,
+        "collectives": collectives,
+    }
+
+
+def report(top: int = 5) -> List[str]:
+    """Top-N time sinks (phases + programs by cumulative seconds),
+    one line each: verdict + the recommended knob."""
+    doc = status_doc()
+    sinks = [("phase", name, d) for name, d in doc["phases"].items()]
+    sinks += [("program", label, d)
+              for label, d in doc["programs"].items()]
+    sinks.sort(key=lambda s: -s[2].get("total_s", 0.0))
+    dev = doc["device"]
+    lines = [f"perfscope: platform={dev['platform']} "
+             f"peak={dev['peak_flops']:.3g} FLOP/s "
+             f"hbm={dev['hbm_bps']:.3g} B/s ici={dev['ici_bps']:.3g} "
+             f"B/s ridge={dev['ridge_intensity']:.1f} flops/byte"]
+    for kind, name, d in sinks[:max(0, top)]:
+        bound = d.get("bound") or "unmeasured"
+        line = (f"  {kind} {name}: {d['total_s'] * 1e3:.1f} ms over "
+                f"{d['count']} runs -> {bound}-bound")
+        if d.get("mfu"):
+            line += f" (mfu {d['mfu']:.3f})"
+        if d.get("regression_ratio", 1.0) and \
+                d.get("regressed"):
+            line += f" REGRESSED x{d['regression_ratio']:.2f}"
+        lines.append(line)
+        if d.get("recommend"):
+            lines.append(f"      knob: {d['recommend']}")
+    for label, c in doc["collectives"].items():
+        lines.append(f"  collective {label}: {c['bytes']:.3g} B/step, "
+                     f"bubble {c['bubble_fraction']:.1%}")
+    if not sinks:
+        lines.append("  (no samples recorded)")
+    return lines
+
+
+def explain_section(cost: Any, seconds: float = 0.0) -> dict:
+    """Roofline verdict for one compiled program's cost — the
+    Executor.explain(perf=True) section body."""
+    flops = float(getattr(cost, "flops", 0.0) or 0.0)
+    bytes_acc = float(getattr(cost, "bytes_accessed", 0.0) or 0.0)
+    v = classify(flops, bytes_acc, device_s=seconds)
+    return {"device": device_params(),
+            "bound": v["bound"], "recommend": v["recommend"],
+            "arith_intensity": v["arith_intensity"],
+            "ridge_intensity": v["ridge_intensity"],
+            "achieved_flops": v["achieved_flops"], "mfu": v["mfu"]}
+
+
+def rows_from_metrics_doc(doc: Optional[dict]) -> dict:
+    """Reconstruct per-phase roofline rows from a metrics DOCUMENT
+    (this process's registry or a fleet worker's shipped snapshot) —
+    what fleet.perf_rows() builds the per-rank merged view from."""
+    fams = (doc or {}).get("metrics") or {}
+
+    def series(name):
+        return (fams.get(name) or {}).get("series") or []
+
+    phases: Dict[str, dict] = {}
+
+    def row_for(labels):
+        return phases.setdefault(str((labels or {}).get("phase")), {})
+
+    for metric, key in (("perf_regression_ratio", "regression_ratio"),
+                        ("perf_mfu", "mfu"),
+                        ("perf_achieved_flops", "achieved_flops"),
+                        ("perf_arith_intensity", "arith_intensity")):
+        for row in series(metric):
+            row_for(row.get("labels"))[key] = row.get("value", 0.0)
+    for row in series("perf_bound"):
+        if row.get("value"):
+            labels = row.get("labels") or {}
+            row_for(labels)["bound"] = labels.get("bound")
+    exposed = 0.0
+    for row in series("perf_comm_exposed_seconds"):
+        exposed = float(row.get("value", 0.0))
+    bubbles = {
+        (row.get("labels") or {}).get("collective"):
+            float(row.get("value", 0.0))
+        for row in series("perf_bubble_fraction")}
+    return {"phases": phases, "comm_exposed_seconds": exposed,
+            "bubble_fraction": bubbles}
+
+
+def reset():
+    """Drop baselines, sinks, cached models and every perf_* gauge
+    series (conftest: one test's rooflines/regressions must not leak
+    into the next)."""
+    global _last_regression
+    with _lock:
+        _phases.clear()
+        _programs.clear()
+        _models.clear()
+        _collectives.clear()
+        _last_regression = None
+    for m in (_m_ratio, _m_exposed, _m_bubble, _m_mfu, _m_achieved,
+              _m_intensity, _m_bound):
+        m.clear()
+
+
+# --- CLI -------------------------------------------------------------------
+
+def _self_test() -> int:
+    """Hermetic fixture smoke (the xray/incident CLI idiom): synthetic
+    verdicts + a synthetic regression exercised against TEMPORARY flag
+    state; prints one PERFSCOPE_SELF_TEST json line, exit 0 on pass."""
+    saved = {k: flags.get_flag(k) for k in
+             ("perfscope", "perf_baseline_window",
+              "perf_regression_factor")}
+    flags.set_flag("perfscope", True)
+    flags.set_flag("perf_baseline_window", 4)
+    flags.set_flag("perf_regression_factor", 2.0)
+    reset()
+    try:
+        p = device_params()
+        checks = {}
+        # 512^3 matmul: intensity ~85 flops/byte >> any ridge point
+        v = classify(2 * 512.0 ** 3, 3 * 512.0 * 512 * 4,
+                     device_s=1e-3, params=p)
+        checks["compute_bound"] = v["bound"] == "compute"
+        # tiny compute, 1 GB over the interconnect
+        v = classify(1e8, 1e7, comm_bytes=1e9, device_s=1e-3, params=p)
+        checks["comms_bound"] = v["bound"] == "comms"
+        checks["exposed_positive"] = v["exposed_comm_seconds"] > 0
+        # reader starvation: 90% of the wall is data wait
+        v = classify(1e8, 1e8, data_wait_s=0.9, device_s=0.1, params=p)
+        checks["input_bound"] = v["bound"] == "input"
+        # regression watch: 4 fast samples freeze the baseline, then
+        # 4 slow ones trip the x5 ratio past the x2 factor
+        for _ in range(4):
+            note_phase("selftest.phase", 0.010, trace_id="t-fast")
+        for _ in range(4):
+            note_phase("selftest.phase", 0.050, trace_id="t-slow")
+        doc = status_doc()
+        rec = doc["phases"]["selftest.phase"]
+        checks["regression_fires"] = bool(rec["regressed"])
+        ctx = alert_context({"phase": "selftest.phase"})
+        checks["regression_context"] = \
+            ctx.get("phase") == "selftest.phase" and \
+            ctx.get("exemplar_trace_ids") == ["t-slow"]
+        checks["ratio_gauge"] = \
+            _m_ratio.labels(phase="selftest.phase").value >= 2.0
+        ok = all(checks.values())
+        print("PERFSCOPE_SELF_TEST " + json.dumps(
+            {"ok": ok, "checks": checks,
+             "ratio": rec["regression_ratio"]}, sort_keys=True))
+        return 0 if ok else 1
+    finally:
+        reset()
+        for k, v in saved.items():
+            flags.set_flag(k, v)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.perfscope",
+        description="Perfscope: roofline attribution + regression "
+                    "watch over the live registry.")
+    ap.add_argument("--doc", action="store_true",
+                    help="print the full perf status document as JSON")
+    ap.add_argument("--top", type=int, default=5,
+                    help="time sinks to print (default 5)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="hermetic fixture smoke; exit 0 on pass")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not enabled():
+        print("perfscope: disabled (set the perfscope flag / "
+              "PTPU_PERFSCOPE=1)", file=sys.stderr)
+        return 2
+    if args.doc:
+        print(json.dumps(status_doc(), indent=2, sort_keys=True))
+        return 0
+    for line in report(args.top):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":          # pragma: no cover
+    sys.exit(main())

@@ -142,6 +142,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, obs.serving())
             elif path == "/alerts":
                 self._send_json(200, obs.alerts())
+            elif path == "/perf":
+                self._send_json(200, obs.perf())
             elif path == "/journal":
                 self._send_json(200, obs.journal())
             elif path.startswith("/trace/"):
@@ -159,8 +161,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/":
                 self._send(200, b"paddle_tpu observability: /metrics "
                                 b"/metrics.json /healthz /flight "
-                                b"/model /serving /alerts /journal "
-                                b"/trace/<id> "
+                                b"/model /serving /alerts /perf "
+                                b"/journal /trace/<id> "
                                 b"[POST /serving/generate /profile]\n",
                            "text/plain; charset=utf-8")
             else:
@@ -348,6 +350,18 @@ class ObservabilityServer:
         doc = eng.status_doc()
         doc["source"] = ("fleet" if self.aggregator is not None
                          else "local")
+        return doc
+
+    def perf(self) -> dict:
+        """``GET /perf``: the perfscope roofline view — this process's
+        full status document, plus fleet-merged per-rank roofline rows
+        (fleet.perf_rows) on a coordinator."""
+        from . import perfscope as obs_perfscope
+        doc = obs_perfscope.status_doc()
+        doc["source"] = ("fleet" if self.aggregator is not None
+                         else "local")
+        if self.aggregator is not None:
+            doc["ranks"] = self.aggregator.perf_rows()
         return doc
 
     def _wire_alerts(self, eng) -> None:

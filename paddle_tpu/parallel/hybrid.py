@@ -387,7 +387,24 @@ def build_train_step(mesh: Mesh, cfg: HybridConfig):
         # injectable; the host boundary is where recovery logic lives)
         from ..resilience import chaos
         chaos.trigger("hybrid.collective_dispatch")
-        return jitted(params, opt_state, tokens, labels)
+        from ..observability import perfscope
+        if not perfscope.enabled():
+            return jitted(params, opt_state, tokens, labels)
+        # perfscope on: the comm/cost model is built ONCE from the
+        # abstract shapes (a jaxpr trace, before donation invalidates
+        # the buffers — never an XLA compile), then every step is
+        # timed to completion so the roofline verdict and the
+        # collective bubble fractions read against real device time
+        import time
+        model = perfscope.program_model(
+            "hybrid.step", jitted, (params, opt_state, tokens, labels))
+        t0 = time.perf_counter()
+        out = jitted(params, opt_state, tokens, labels)
+        jax.block_until_ready(out)
+        perfscope.note_step("hybrid.step",
+                            device_s=time.perf_counter() - t0,
+                            model=model)
+        return out
 
     step.jitted = jitted        # AOT users (lower/compile) reach through
     return step

@@ -473,6 +473,12 @@ class ContinuousBatcher:
                       req.first_token_t - t_pf, "prefill",
                       bucket=self.engine.bucket_for(len(req.prompt)),
                       slot=slot)
+            from ..observability import perfscope as obs_perfscope
+            if obs_perfscope.enabled():
+                obs_perfscope.note_phase(
+                    "serving.prefill", req.first_token_t - t_pf,
+                    trace_id=(req.trace.trace_id
+                              if req.trace else None))
             with obs_tracectx.activate(req.trace):
                 # TTFT exemplar: the p99 bucket links to THIS trace
                 _m_ttft.observe(req.first_token_t - req.submit_t)
@@ -543,6 +549,14 @@ class ContinuousBatcher:
             now = time.perf_counter()
             dt = now - t0
             _m_step.observe(dt)
+            from ..observability import perfscope as obs_perfscope
+            if obs_perfscope.enabled():
+                # exemplar: any slot that decoded in this step links
+                # the regression verdict back to a retrievable trace
+                tid = next((r.trace.trace_id for r in active.values()
+                            if r.trace is not None), None)
+                obs_perfscope.note_phase("serving.decode_step", dt,
+                                         trace_id=tid)
             for slot, tok in out.items():
                 req = active.get(slot)
                 if req is None:

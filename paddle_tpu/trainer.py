@@ -518,6 +518,22 @@ class Trainer:
                     self._runlog_pos = (epoch_id, step_id, step_in_total)
                     self._step_trace_id = (step_ctx.trace_id
                                            if step_ctx else None)
+                    # lazy import: perfscope has a `python -m` CLI,
+                    # and eager package-graph imports trip runpy's
+                    # sys.modules warning (the runlog idiom)
+                    from .observability import perfscope \
+                        as obs_perfscope
+                    if obs_perfscope.enabled():
+                        # roofline + regression watch per step: the
+                        # cost is the cached analytic view (no extra
+                        # compile), the anatomy the measured split
+                        obs_perfscope.note_step(
+                            "trainer.step", device_s=device_s,
+                            data_wait_s=data_wait, host_s=host_s,
+                            wall_s=dt,
+                            cost=self.exe.last_run_cost(
+                                prefer_analytic=True),
+                            trace_id=self._step_trace_id)
                     if metrics:
                         raw_loss = loss_val = \
                             float(np.mean(np.asarray(metrics[0])))

@@ -48,6 +48,7 @@ def fresh_programs():
     from paddle_tpu.observability import costmodel, flight, forensics
     from paddle_tpu.observability import deviceprof, metrics as obs_metrics
     from paddle_tpu.observability import journal as obs_journal
+    from paddle_tpu.observability import perfscope as obs_perfscope
     from paddle_tpu.observability import runlog, tensorstats, tracectx
     from paddle_tpu.observability import server as obs_server
     from paddle_tpu.resilience import chaos
@@ -95,6 +96,15 @@ def fresh_programs():
     # must not leak artifacts (or warm-start semantics) across cases
     # or into the repo
     pt.core.flags.set_flag("jit_cache_dir", "")
+    # perfscope: baselines, cached comm models and the perf_* gauges
+    # must not leak rooflines (or a regression verdict) across cases,
+    # and the flag defaults back to off
+    obs_perfscope.reset()
+    pt.core.flags.set_flag("perfscope", False)
+    for _pf, _pv in (("perf_regression_factor", 2.0),
+                     ("perf_baseline_window", 32),
+                     ("perf_hbm_gbps", 0.0), ("perf_ici_gbps", 0.0)):
+        pt.core.flags.set_flag(_pf, _pv)
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
@@ -106,6 +116,12 @@ def fresh_programs():
     pt.core.flags.set_flag("alert_rules_path", "")
     pt.core.flags.set_flag("journal_path", "")
     pt.core.flags.set_flag("jit_cache_dir", "")
+    obs_perfscope.reset()
+    pt.core.flags.set_flag("perfscope", False)
+    for _pf, _pv in (("perf_regression_factor", 2.0),
+                     ("perf_baseline_window", 32),
+                     ("perf_hbm_gbps", 0.0), ("perf_ici_gbps", 0.0)):
+        pt.core.flags.set_flag(_pf, _pv)
 
 
 @pytest.fixture

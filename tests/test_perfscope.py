@@ -1,0 +1,586 @@
+"""Perfscope (ISSUE 16, observability/perfscope.py): roofline
+attribution, collective-bubble accounting, and the perf-regression
+watch.
+
+Covers the acceptance matrix: a comms-heavy 2-device dp step
+classified comms-bound with perf_bubble_fraction naming grad_psum, a
+matmul-dominated executor step classified compute-bound, an
+artificially slowed phase firing the built-in perf_regression rule
+with phase + exemplar trace id in the alert context, flag-off
+byte-identical outputs with zero step-path compiles, the CLI
+exit-code contract and --self-test smoke, the GET /perf route, the
+fleet doc-row reconstruction, plus the satellites that ride along:
+histogram_quantiles edge cases, the bench_gate --trend roofline-bound
+column (flip = named regression), and jit_cache --warm (validation
+matrix + cross-process zero-compile warm start).
+"""
+import json
+import os
+import struct
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags
+from paddle_tpu.framework import jit_cache
+from paddle_tpu.observability import alerts
+from paddle_tpu.observability import bench_gate
+from paddle_tpu.observability import forensics
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import perfscope
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.parallel import hybrid, topology
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BOUNDS = {"compute", "memory", "comms", "input", "host"}
+
+
+def _tot(name):
+    m = obs_metrics.REGISTRY.get(name)
+    return 0.0 if m is None else m.total()
+
+
+def _gauge(name, **labels):
+    m = obs_metrics.REGISTRY.get(name)
+    assert m is not None, f"gauge {name} not registered"
+    return m.labels(**labels).value
+
+
+def _fc_program(size=512, batch=512):
+    """A matmul-dominated forward program: batch x size @ size x size
+    puts the arithmetic intensity far above any CPU ridge point."""
+    pt.reset_default_programs()
+    x = layers.data("x", [size], dtype="float32")
+    h = layers.fc(x, size=size, bias_attr=False)
+    loss = layers.mean(h)
+    feed = {"x": np.ones((batch, size), "float32")}
+    return pt.default_main_program(), loss, feed
+
+
+def _dp_cfg():
+    """The comms-heavy workload: pure dp=2, one microbatch, d_model
+    large enough that grad_psum dominates the step's communication."""
+    return hybrid.HybridConfig(vocab_size=64, seq_len=8, d_model=256,
+                               n_heads=4, n_layers=2, d_ff=512,
+                               n_microbatches=1, remat=False)
+
+
+# =========================================================================
+# tentpole: roofline verdicts
+# =========================================================================
+
+def test_comms_bound_dp_step_names_grad_psum():
+    """A 2-device dp step on a slow modeled interconnect is classified
+    comms-bound; the bubble accounting names grad_psum from the
+    collective:* scopes; building the model is an abstract jaxpr trace
+    — no executor compile and no forensics record."""
+    flags.set_flag("perfscope", True)
+    # CPU default priors make this tiny model memory-bound; a 0.5 GB/s
+    # interconnect models the regime the acceptance targets (on real
+    # hardware the measured ICI prior plays this role)
+    flags.set_flag("perf_ici_gbps", 0.5)
+    cfg = _dp_cfg()
+    mesh = topology.make_hybrid_mesh(dp=2, pp=1, tp=1)
+    params = hybrid.init_params(mesh, cfg, seed=0)
+    opt = hybrid.init_opt_state(params)
+    step = hybrid.build_train_step(mesh, cfg)
+    tokens, labels_ = hybrid.make_fake_lm_batch(cfg, global_batch=4)
+
+    compiles = _tot("executor_compile_total")
+    nrec = len(forensics.compile_log())
+    params, opt, loss = step(params, opt, tokens, labels_)
+    assert np.isfinite(float(loss))
+    assert _tot("executor_compile_total") == compiles
+    assert len(forensics.compile_log()) == nrec
+
+    doc = perfscope.status_doc()
+    ph = doc["phases"]["hybrid.step"]
+    assert ph["bound"] == "comms"
+    assert ph["exposed_comm_seconds"] > 0
+    assert ph["comm_share"] > 0
+    # the dominant collective is the dp gradient all-reduce, named
+    # from its collective:grad_psum scope
+    assert "grad_psum" in doc["collectives"]
+    col = doc["collectives"]["grad_psum"]
+    assert col["bytes"] > 0 and col["bubble_fraction"] > 0
+    assert col["bytes"] == max(
+        c["bytes"] for c in doc["collectives"].values())
+    assert _gauge("perf_bubble_fraction", collective="grad_psum") > 0
+    assert obs_metrics.REGISTRY.get(
+        "perf_comm_exposed_seconds").value > 0
+    assert _gauge("perf_bound", phase="hybrid.step",
+                  bound="comms") == 1.0
+
+
+def test_matmul_step_is_compute_bound():
+    """The matmul-dominated executor program lands above the ridge
+    point -> compute-bound, and explain(perf=True) renders the same
+    verdict as a section."""
+    flags.set_flag("perfscope", True)
+    main, loss, feed = _fc_program()
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe.run(pt.default_startup_program())
+    exe.run(main, feed=feed, fetch_list=[loss])
+
+    progs = perfscope.status_doc()["programs"]
+    assert progs, "note_dispatch must record executor programs"
+    verdicts = [d for d in progs.values() if d.get("bound")]
+    assert any(d["bound"] == "compute" for d in verdicts)
+    best = max(verdicts, key=lambda d: d.get("arith_intensity", 0.0))
+    assert best["bound"] == "compute"
+    assert best["arith_intensity"] > best_ridge()
+    assert "fuse" in best["recommend"] or "mfu" in best["recommend"]
+
+    rep = exe.explain(main, feed=feed, fetch_list=[loss], perf=True)
+    assert rep["perf"]["bound"] == "compute"
+    assert rep["perf"]["ridge_intensity"] > 0
+    assert rep["perf"]["device"]["platform"] == "cpu"
+
+
+def best_ridge():
+    return perfscope.device_params()["ridge_intensity"]
+
+
+def test_report_and_top_sinks():
+    flags.set_flag("perfscope", True)
+    perfscope.note_phase("trainer.step", 0.020)
+    perfscope.note_phase("serving.decode", 0.005)
+    lines = perfscope.report(top=5)
+    assert lines[0].startswith("perfscope: platform=")
+    body = "\n".join(lines)
+    assert "trainer.step" in body and "serving.decode" in body
+    # top=1 keeps only the biggest sink
+    one = "\n".join(perfscope.report(top=1))
+    assert "trainer.step" in one and "serving.decode" not in one
+
+
+# =========================================================================
+# tentpole: regression watch -> built-in perf_regression rule
+# =========================================================================
+
+def _slow_phase(phase="trainer.step"):
+    """4 fast samples freeze the baseline, 4 slow ones trip the x5
+    ratio past the x2 factor."""
+    flags.set_flag("perfscope", True)
+    flags.set_flag("perf_baseline_window", 4)
+    flags.set_flag("perf_regression_factor", 2.0)
+    for _ in range(4):
+        perfscope.note_phase(phase, 0.010, trace_id="t-fast")
+    for _ in range(4):
+        perfscope.note_phase(phase, 0.050, trace_id="t-slow")
+
+
+def test_regression_watch_ratio_and_status():
+    _slow_phase()
+    doc = perfscope.status_doc()
+    rec = doc["phases"]["trainer.step"]
+    assert rec["regressed"] is True
+    assert rec["regression_ratio"] == pytest.approx(5.0)
+    assert rec["last_trace_id"] == "t-slow"
+    assert rec["baseline_s"] == pytest.approx(0.010)
+    assert doc["regression"]["last"]["phase"] == "trainer.step"
+    assert doc["regression"]["last"]["trace_id"] == "t-slow"
+    assert _gauge("perf_regression_ratio",
+                  phase="trainer.step") == pytest.approx(5.0)
+
+
+def test_perf_regression_rule_fires_with_phase_and_exemplar():
+    """The built-in perf_regression Watchtower rule fires on the
+    breaching perf_regression_ratio series and its context names the
+    offending phase + an exemplar trace id of a slow step."""
+    _slow_phase()
+    rules = [r for r in alerts.default_rules()
+             if r.name == "perf_regression"]
+    assert rules, "perfscope on -> default rules include perf_regression"
+    eng = alerts.AlertEngine(rules)
+    eng.evaluate(obs_metrics.REGISTRY.to_json(), now=100.0)
+    st = eng.status_doc()
+    assert "perf_regression" in st["firing"]
+    act = [a for a in st["active"] if a["rule"] == "perf_regression"
+           and a["state"] == "firing"]
+    assert act and act[0]["labels"].get("phase") == "trainer.step"
+    ctx = act[0]["context"]
+    assert ctx["phase"] == "trainer.step"
+    assert ctx["regression_ratio"] >= 2.0
+    assert ctx["exemplar_trace_ids"] == ["t-slow"]
+    assert ctx["baseline_seconds"] == pytest.approx(0.010)
+    assert ctx["recent_seconds"] == pytest.approx(0.050)
+
+
+def test_perf_regression_rule_absent_when_flag_off():
+    assert flags.get_flag("perfscope") is False
+    assert not [r for r in alerts.default_rules()
+                if r.name == "perf_regression"]
+
+
+# =========================================================================
+# tentpole: flag-off invariance + zero step-path compiles
+# =========================================================================
+
+def test_flag_off_byte_identical_and_no_new_compiles():
+    """Flipping perfscope ON does not perturb outputs, does not enter
+    the compile key (the warm program is re-used: zero new compiles)
+    and the default explain() report carries no perf section."""
+    assert flags.get_flag("perfscope") is False
+    main, loss, feed = _fc_program(size=16, batch=8)
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe.run(pt.default_startup_program())
+    out_off = exe.run(main, feed=feed, fetch_list=[loss])[0]
+    rep_off = exe.explain(main, feed=feed, fetch_list=[loss],
+                          perf=True)
+    assert "perf" not in rep_off          # flag off: no section at all
+    compiles = _tot("executor_compile_total")
+    nrec = len(forensics.compile_log())
+
+    flags.set_flag("perfscope", True)
+    out_on = exe.run(main, feed=feed, fetch_list=[loss])[0]
+    assert np.array_equal(out_off, out_on)
+    assert _tot("executor_compile_total") == compiles
+    assert len(forensics.compile_log()) == nrec
+    # and explain without perf=True stays perf-free even when enabled
+    rep_on = exe.explain(main, feed=feed, fetch_list=[loss])
+    assert "perf" not in rep_on
+
+
+# =========================================================================
+# tentpole: CLI + /perf route + fleet doc rows
+# =========================================================================
+
+def test_cli_exit_codes_and_self_test(capsys):
+    assert perfscope.main(["--self-test"]) == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("PERFSCOPE_SELF_TEST ")][-1]
+    doc = json.loads(line[len("PERFSCOPE_SELF_TEST "):])
+    assert doc["ok"] is True and all(doc["checks"].values())
+    # self-test restores flag state: still disabled -> rc 2
+    assert flags.get_flag("perfscope") is False
+    assert perfscope.main([]) == 2
+    flags.set_flag("perfscope", True)
+    perfscope.note_phase("trainer.step", 0.01)
+    assert perfscope.main([]) == 0
+    assert perfscope.main(["--doc"]) == 0
+    out = capsys.readouterr().out
+    assert "trainer.step" in out
+    assert '"schema": "paddle_tpu.perf.v1"' in out
+
+
+def test_http_perf_route():
+    flags.set_flag("perfscope", True)
+    perfscope.note_phase("trainer.step", 0.01)
+    srv = obs_server.start_http_server(port=0)
+    with urllib.request.urlopen(f"{srv.url}/perf", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["schema"] == "paddle_tpu.perf.v1"
+    assert doc["source"] == "local"       # no aggregator: local half
+    assert "trainer.step" in doc["phases"]
+    assert doc["enabled"] is True
+
+
+def test_rows_from_metrics_doc_reconstructs_rooflines():
+    """fleet.perf_rows() rebuilds per-rank roofline rows from shipped
+    metrics documents — the gauges alone carry enough to recover
+    bound/mfu/intensity and the bubble fractions."""
+    flags.set_flag("perfscope", True)
+    perfscope.note_step("trainer.step", device_s=0.01,
+                        model={"flops": 2 * 512.0 ** 3,
+                               "bytes_accessed": 3 * 512.0 * 512 * 4,
+                               "comm": {"grad_psum": 1e5}})
+    rows = perfscope.rows_from_metrics_doc(
+        obs_metrics.REGISTRY.to_json())
+    ph = rows["phases"]["trainer.step"]
+    assert ph["bound"] == "compute"
+    assert ph["mfu"] > 0 and ph["achieved_flops"] > 0
+    assert ph["arith_intensity"] > 10
+    assert rows["bubble_fraction"]["grad_psum"] > 0
+    assert rows["comm_exposed_seconds"] >= 0
+    # empty / absent documents degrade to empty rows, not a crash
+    assert perfscope.rows_from_metrics_doc(None) == {
+        "phases": {}, "comm_exposed_seconds": 0.0,
+        "bubble_fraction": {}}
+
+
+# =========================================================================
+# satellite: histogram_quantiles edge cases
+# =========================================================================
+
+def test_histogram_quantiles_missing_and_empty():
+    assert obs_metrics.histogram_quantiles("no_such_hist",
+                                           [0.5]) is None
+    obs_metrics.histogram("edge_empty_seconds", "edge",
+                          buckets=[0.1, 1.0])
+    # registered but zero observations -> None, not zeros
+    assert obs_metrics.histogram_quantiles("edge_empty_seconds",
+                                           [0.5, 0.99]) is None
+    # a counter is not a histogram
+    obs_metrics.counter("edge_not_a_hist_total", "edge").inc()
+    assert obs_metrics.histogram_quantiles("edge_not_a_hist_total",
+                                           [0.5]) is None
+
+
+def test_histogram_quantiles_single_bucket():
+    h = obs_metrics.histogram("edge_single_seconds", "edge",
+                              buckets=[0.25])
+    for _ in range(10):
+        h.observe(0.1)
+    q = obs_metrics.histogram_quantiles("edge_single_seconds",
+                                        [0.5, 0.99])
+    assert q["p50"] == 0.25 and q["p99"] == 0.25
+    assert q["count"] == 10
+    assert q["mean"] == pytest.approx(0.1)
+
+
+def test_histogram_row_quantiles_all_mass_in_overflow():
+    """Every observation past the largest bound: the quantile clamps
+    to the largest finite bucket bound (the honest lower estimate)
+    rather than inventing +Inf."""
+    row = {"buckets": {"0.1": 0, "1.0": 0}, "overflow": 5,
+           "count": 5, "sum": 50.0}
+    q = obs_metrics.histogram_row_quantiles(row, [0.5, 0.99])
+    assert q["p50"] == 1.0 and q["p99"] == 1.0
+    assert q["mean"] == pytest.approx(10.0)
+    # no observations in the row -> None (the fleet-merged doc path)
+    assert obs_metrics.histogram_row_quantiles(
+        {"buckets": {"0.1": 0}, "count": 0, "sum": 0.0},
+        [0.5]) is None
+    # bucketless degenerate row clamps to 0.0 instead of raising
+    q0 = obs_metrics.histogram_row_quantiles(
+        {"buckets": {}, "count": 3, "sum": 3.0}, [0.5])
+    assert q0["p50"] == 0.0
+
+
+def test_histogram_row_quantiles_matches_registry_path():
+    """One interpolation implementation: the registry helper and the
+    raw doc-row helper agree on the same data."""
+    h = obs_metrics.histogram("edge_agree_seconds", "edge",
+                              buckets=[0.05, 0.1, 0.5])
+    for v in (0.01, 0.02, 0.07, 0.2, 0.4):
+        h.observe(v)
+    via_name = obs_metrics.histogram_quantiles("edge_agree_seconds",
+                                               [0.5, 0.9])
+    fam = obs_metrics.REGISTRY.to_json()["metrics"][
+        "edge_agree_seconds"]
+    row = fam["series"][0]
+    via_row = obs_metrics.histogram_row_quantiles(row, [0.5, 0.9])
+    assert via_name == via_row
+
+
+# =========================================================================
+# satellite: bench_gate --trend roofline-bound column
+# =========================================================================
+
+def _trend_rec(value, mfu=None, bound=None):
+    return {"lm_tokens_per_sec": {"value": value, "mfu": mfu,
+                                  "bound": bound}}
+
+
+def test_trend_bound_flip_is_named_regression():
+    res = bench_gate.trend([
+        ("r01", _trend_rec(100.0, mfu=0.30, bound="compute")),
+        ("r02", _trend_rec(104.0, mfu=0.31, bound="compute")),
+        ("r03", _trend_rec(105.0, mfu=0.31, bound="comms")),
+    ])
+    rows = {r["metric"]: r for r in res["rows"]}
+    brow = rows["lm_tokens_per_sec.bound"]
+    assert brow["status"] == "regression"
+    assert brow["flip"] == "compute->comms"
+    assert brow["newest"] == "comms"
+    assert "lm_tokens_per_sec.bound" in res["regressions"]
+    assert res["ok"] is False
+    # throughput itself improved: the value row stays ok — the flip
+    # alone fails the gate
+    assert rows["lm_tokens_per_sec"]["status"] == "ok"
+    assert rows["lm_tokens_per_sec.mfu"]["status"] == "ok"
+
+
+def test_trend_bound_first_appearance_is_ok():
+    """A bound appearing for the first time in the newest record (the
+    first post-perfscope release) is not a flip."""
+    res = bench_gate.trend([
+        ("r01", _trend_rec(100.0)),
+        ("r02", _trend_rec(101.0, bound="compute")),
+    ])
+    rows = {r["metric"]: r for r in res["rows"]}
+    assert rows["lm_tokens_per_sec.bound"]["status"] == "ok"
+    assert res["ok"] is True
+    # and a bound that disappears (perfscope off for one run) is not
+    # a flip either — None never participates
+    res2 = bench_gate.trend([
+        ("r01", _trend_rec(100.0, bound="compute")),
+        ("r02", _trend_rec(101.0)),
+    ])
+    rows2 = {r["metric"]: r for r in res2["rows"]}
+    assert rows2["lm_tokens_per_sec.bound"]["status"] == "ok"
+    # records with no bound anywhere grow no .bound row at all
+    res3 = bench_gate.trend([("r01", _trend_rec(100.0)),
+                             ("r02", _trend_rec(101.0))])
+    assert not [r for r in res3["rows"]
+                if r["metric"].endswith(".bound")]
+
+
+def test_trend_load_record_bound_variants():
+    # driver summary rows carry bound through
+    rec = bench_gate.load_trend_record(
+        {"summary": {"m": {"value": 7.0, "mfu": 0.2,
+                           "bound": "memory"}}})
+    assert rec["m"] == {"value": 7.0, "mfu": 0.2, "bound": "memory"}
+    # bare pre-summary record (the BENCH_r01 layout)
+    rec = bench_gate.load_trend_record({"metric": "m", "value": 3.0})
+    assert rec["m"]["bound"] is None
+    # plain {metric: value} maps never carry a bound
+    rec = bench_gate.load_trend_record({"m": 5.0})
+    assert rec["m"]["bound"] is None
+
+
+def test_trend_cli_over_committed_records():
+    """The committed BENCH_r01..r05 records predate perfscope: the
+    trend CLI must stay green over them (bound None everywhere, no
+    .bound rows, rc 0)."""
+    paths = [os.path.join(REPO, f"BENCH_r0{i}.json")
+             for i in range(1, 6)]
+    records = []
+    for p in paths:
+        with open(p) as f:
+            records.append((os.path.basename(p)[:-len(".json")],
+                            bench_gate.load_trend_record(json.load(f))))
+    res = bench_gate.trend(records, allow_missing=True)
+    assert res["ok"] is True
+    assert not [r for r in res["rows"]
+                if r["metric"].endswith(".bound")]
+    assert bench_gate.main(
+        ["--trend", *paths, "--allow-missing"]) == 0
+
+
+# =========================================================================
+# satellite: jit_cache --warm
+# =========================================================================
+
+def _seed_entries(src, n=3):
+    """Compile + store n distinct tiny executables into src."""
+    import jax
+    import jax.numpy as jnp
+    flags.set_flag("jit_cache_dir", str(src))
+    x = jnp.arange(4, dtype=jnp.float32)
+    names = []
+    for i in range(n):
+        fn = jax.jit(lambda v, k=float(i + 1): v * k)
+        compiled = fn.lower(x).compile()
+        comps = {"probe": f"warm-{i}"}
+        khash = jit_cache.entry_key("executor_step", comps)
+        assert jit_cache.store("executor_step", khash, comps, compiled)
+        names.append(khash)
+    return names
+
+
+def _entry_paths(d):
+    return sorted(os.path.join(d, f) for f in os.listdir(d)
+                  if f.endswith(".jc"))
+
+
+def test_warm_validation_matrix(tmp_path):
+    """warm() copies only entries that pass the full load() validation:
+    a bit-flipped body is counted corrupt, a foreign-build header is
+    counted stale, and neither lands in the destination; re-warming
+    counts the survivor as already present."""
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    _seed_entries(src, n=3)
+    paths = _entry_paths(str(src))
+    assert len(paths) == 3
+    # corrupt: flip a bit inside the pickled body of entry 0
+    raw = bytearray(open(paths[0], "rb").read())
+    raw[-3] ^= 0x40
+    open(paths[0], "wb").write(bytes(raw))
+    # stale: rewrite entry 1's header with a foreign env fingerprint
+    raw = open(paths[1], "rb").read()
+    fixed = len(jit_cache._MAGIC) + 4
+    (hlen,) = struct.unpack("<I", raw[fixed - 4:fixed])
+    header = json.loads(raw[fixed:fixed + hlen].decode())
+    header["env"] = "foreign-build"
+    hb = json.dumps(header).encode()
+    open(paths[1], "wb").write(
+        raw[:fixed - 4] + struct.pack("<I", len(hb)) + hb
+        + raw[fixed + hlen:])
+
+    res = jit_cache.warm(str(src), str(dst))
+    assert (res["copied"], res["stale"], res["corrupt"],
+            res["present"]) == (1, 1, 1, 0)
+    assert res["bytes"] > 0
+    assert len(_entry_paths(str(dst))) == 1
+    # skipped entries are never deleted from the source
+    assert len(_entry_paths(str(src))) == 3
+    # idempotent: the survivor is now present, nothing re-copies
+    res2 = jit_cache.warm(str(src), str(dst))
+    assert res2["copied"] == 0 and res2["present"] == 1
+    # the warmed entry actually loads and runs in the destination
+    flags.set_flag("jit_cache_dir", str(dst))
+    rows = jit_cache.ls()
+    assert len(rows) == 1
+    comps = rows[0]["components"]
+    back = jit_cache.load("executor_step",
+                          jit_cache.entry_key("executor_step", comps),
+                          comps)
+    assert back is not None
+
+
+def test_warm_cli_exit_and_counts(tmp_path, capsys):
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    _seed_entries(src, n=2)
+    assert jit_cache.main(["--dir", str(dst),
+                           "--warm", str(src)]) == 0
+    out = capsys.readouterr().out
+    assert "copied 2 entr" in out
+    assert len(_entry_paths(str(dst))) == 2
+    # warming an empty/missing source copies nothing but exits 0
+    assert jit_cache.main(["--dir", str(dst),
+                           "--warm", str(tmp_path / "nope")]) == 0
+
+
+def _run_probe(cache_dir):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PTPU_JIT_CACHE_DIR"] = str(cache_dir)
+    env.pop("PTPU_CHAOS_SPEC", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.framework.jit_cache",
+         "--restart-probe", "lm"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("RESTART_PROBE ")]
+    assert proc.returncode == 0 and lines, (proc.stdout, proc.stderr)
+    return json.loads(lines[-1][len("RESTART_PROBE "):])
+
+
+def test_warm_cross_process_zero_compile(tmp_path):
+    """The fleet warmup story: rank 0 compiles into a shared dir, a
+    new replica warms its own dir from it BEFORE first use and then
+    records ZERO XLA compiles — with bit-identical losses."""
+    shared, local = tmp_path / "shared", tmp_path / "local"
+    cold = _run_probe(shared)
+    assert cold["executor_compile_total"] > 0
+    assert jit_cache.main(["--dir", str(local),
+                           "--warm", str(shared)]) == 0
+    warm = _run_probe(local)
+    assert warm["executor_compile_total"] == 0
+    assert warm["jit_cache_hits_total"] >= 2
+    assert warm["jit_cache_errors_total"] == 0
+    assert warm["losses"] == cold["losses"]
+
+
+# =========================================================================
+# satellite: conftest isolation
+# =========================================================================
+
+def test_state_isolated_between_tests():
+    """conftest resets perfscope state + flag around every test: no
+    phases/programs survive from the earlier tests in this module."""
+    assert flags.get_flag("perfscope") is False
+    doc = perfscope.status_doc()
+    assert doc["phases"] == {} and doc["programs"] == {}
+    assert doc["collectives"] == {}
+    assert doc["regression"]["last"] is None
