@@ -354,6 +354,55 @@ define_flag("max_worker_restarts", 3,
             "(distributed/supervisor.py; restarts back off "
             "exponentially with deterministic jitter).")
 
+# --- Helmsman self-healing controller (observability/controller.py) --------
+define_flag("controller", False,
+            "Closed-loop self-healing (ISSUE 17 'Helmsman'): alert "
+            "rules with an action: clause actuate the fleet "
+            "(request_resize / drain / revive / log) through a policy "
+            "layer with cooldowns, hysteresis, world clamps, fenced "
+            "single-flight actuation and a failure circuit breaker.  "
+            "Off (default) = Watchtower stays observe-only: no "
+            "controller object, no extra thread, no decision events.")
+define_flag("controller_cooldown_s", 30.0,
+            "Default per-action-class cooldown between APPLIED "
+            "controller decisions when the rule's action clause does "
+            "not set its own 'cooldown'.  The anti-flap floor: total "
+            "applied decisions per class is bounded by run_duration / "
+            "cooldown (+1).")
+define_flag("controller_hysteresis_s", 60.0,
+            "Default direction-reversal guard for resize actions: "
+            "after a grow (shrink) applies, a shrink (grow) decision "
+            "is suppressed for this many seconds unless the rule's "
+            "action clause sets its own 'hysteresis'.  Stops "
+            "grow/shrink ping-pong around a target band.")
+define_flag("controller_min_world", 1,
+            "Default lower world clamp for controller resize actions "
+            "(per-rule 'min_world' overrides).  The controller never "
+            "shrinks the fleet below this.")
+define_flag("controller_max_world", 0,
+            "Default upper world clamp for controller resize actions "
+            "(per-rule 'max_world' overrides).  0 = unbounded; set it "
+            "— an unbounded grower is a cost incident.")
+define_flag("controller_max_step", 4,
+            "Cap on a burn-rate-proportional resize step: however hot "
+            "the triggering signal reads, one decision changes the "
+            "world by at most this many ranks.")
+define_flag("controller_breaker_threshold", 3,
+            "Consecutive actuator failures (per action class) that "
+            "trip the controller's circuit breaker into alert-only "
+            "mode: rules keep firing and journaling, nothing "
+            "actuates until reset_breaker() — a broken controller "
+            "must never be worse than no controller.")
+define_flag("controller_backoff_s", 5.0,
+            "Base delay before retrying an action class after an "
+            "actuator failure (doubles per consecutive failure up to "
+            "the breaker threshold).")
+define_flag("controller_state_path", "",
+            "Path for persisted controller state (cooldown clocks, "
+            "breaker counters, decision seq).  A restarted "
+            "coordinator resumes its cooldowns instead of instantly "
+            "re-firing every held action.  Empty = in-memory only.")
+
 # --- sparse plane (paddle_tpu/sparse/: CTR streaming + shard service) ------
 define_flag("sparse_staleness_bound", 16,
             "Bounded-staleness window for async sparse pushes: a "

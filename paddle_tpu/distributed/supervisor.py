@@ -27,6 +27,7 @@ import os
 import subprocess
 import threading
 import time
+import warnings
 from typing import Callable, Dict, List, Optional
 
 from ..core import flags
@@ -64,7 +65,8 @@ class Supervisor:
                  cmd_factory: Optional[Callable[[int], List[str]]] = None,
                  env_factory: Optional[
                      Callable[[int], Optional[Dict[str, str]]]] = None,
-                 retire_rc: Optional[int] = None):
+                 retire_rc: Optional[int] = None,
+                 worker_timeout: Optional[float] = None):
         self.cmds = [list(c) for c in cmds]
         self.env = dict(os.environ if env is None else env)
         self.envs = list(envs) if envs is not None \
@@ -111,6 +113,39 @@ class Supervisor:
         self._all_done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._check_backoff_vs_timeout(worker_timeout)
+
+    def _check_backoff_vs_timeout(self, worker_timeout: Optional[float]):
+        """Config footgun from the PR 15 headline e2e: a restart
+        backoff faster than the master's death declaration means a
+        crashed rank RESPAWNS and re-registers before its heartbeat
+        lease expires — the master sees one continuous worker, so
+        ``fleet_worker_dead`` (the dead_rank alert, and now the
+        controller's revive path) can never trigger.  Warn at
+        construction, but only when something actually consumes death
+        declarations: an explicit ``worker_timeout=`` opts in, and an
+        enabled alert plane / controller implies consumers (the silent
+        default stays silent — plenty of fleets only want fast
+        respawn)."""
+        wt = worker_timeout
+        if wt is None:
+            if not (str(flags.get_flag("alert_rules_path") or "")
+                    or bool(flags.get_flag("controller"))):
+                return
+            wt = float(flags.get_flag("worker_timeout"))
+        # reaper tick: task_queue.serve_master polls _reap at
+        # worker_timeout/4 clamped to [0.02, 0.25] — death is declared
+        # at most one tick late
+        tick = max(0.02, min(0.25, float(wt) / 4.0))
+        if self.backoff.base_delay <= float(wt) + tick:
+            warnings.warn(
+                f"supervisor restart backoff base_delay="
+                f"{self.backoff.base_delay}s <= worker_timeout ({wt}s) "
+                f"+ reaper tick ({tick:.2f}s): a crashed rank respawns "
+                f"and re-registers before the master ever declares it "
+                f"dead, so dead_rank alerts and the controller's "
+                f"revive path can never trigger — raise base_delay or "
+                f"lower worker_timeout", RuntimeWarning, stacklevel=3)
 
     # -- spawning ---------------------------------------------------------
     def _env_for(self, rank: int, incarnation: int) -> Dict[str, str]:
@@ -216,6 +251,40 @@ class Supervisor:
             # serialize on the lock, so one of the two conditions
             # always catches an exiting monitor.
             self._start_monitor()
+
+    def revive(self, ranks: Optional[List[int]] = None) -> List[int]:
+        """Helmsman's ``revive`` verb (ISSUE 17): respawn parked or
+        backoff-pending ranks inside the target world NOW, resetting
+        any pending restart delay.  ``ranks`` None = every eligible
+        rank.  Distinct from ``set_world_size`` (which only moves the
+        target): revive is the controller reacting to a dead_rank
+        alert — the rank is wanted, it is not running, bring it back
+        without waiting out the backoff.  Returns the ranks revived."""
+        revived: List[int] = []
+        with self._lock:
+            candidates = range(len(self.cmds)) if ranks is None \
+                else [int(r) for r in ranks]
+            for rank in candidates:
+                if rank >= self.target_world:
+                    continue
+                st = self._state.get(rank)
+                if st == "retired":
+                    self._state[rank] = "restarting"
+                    self._restart_at[rank] = 0.0
+                    revived.append(rank)
+                elif st == "restarting":
+                    self._restart_at[rank] = 0.0
+                    revived.append(rank)
+            for rank in revived:
+                obs_journal.emit("supervisor", "revive_now",
+                                 worker=rank)
+                obs_flight.record("supervisor", "revive_now",
+                                  rank=rank)
+        if revived and (self._thread is None
+                        or not self._thread.is_alive()
+                        or self._all_done.is_set()):
+            self._start_monitor()
+        return revived
 
     # -- monitor loop -----------------------------------------------------
     def _monitor(self):

@@ -189,6 +189,11 @@ class TaskMaster:
         # 0 = endless epoch rollover (legacy); N > 0 = the job completes
         # once every task has been finished in epochs 0..N-1
         self.num_epochs = int(num_epochs)
+        # streaming arrivals (ISSUE 17): extend_dataset(final=False)
+        # UNSEALS the queue — a drained unsealed queue is "waiting for
+        # traffic", not "job complete".  Batch jobs (set_dataset) stay
+        # sealed, preserving their completion semantics exactly.
+        self.sealed = True
         self.max_failures = int(max_failures)
         self.todo: List[Task] = []
         self.pending: Dict[int, dict] = {}   # id -> {task, deadline,
@@ -264,6 +269,56 @@ class TaskMaster:
             self._snapshot(force=True)
             self._publish_gauges()
 
+    def extend_dataset(self, shard_paths: List[str],
+                       shards_per_task: int = 1,
+                       final: bool = False) -> dict:
+        """Streaming arrivals (ISSUE 17): append NEW tasks to a LIVE
+        queue — unlike the idempotent batch ``set_dataset`` this works
+        mid-job, which is what an open-loop loadgen feeding a traffic
+        trace needs.  The first call unseals the queue (a drained
+        queue means "no traffic right now", the job is not complete);
+        ``final=True`` re-seals it — end of stream, the queue draining
+        completes the job.  Streaming is the ``num_epochs=1`` mode:
+        arriving tasks run once at epoch 0 (no rollover recycling).
+
+        New tasks join at the current epoch so a queue that already
+        rolled over doesn't interleave epochs."""
+        with self._lock:
+            epoch = self._current_epoch_locked()
+            if self.num_epochs > 0:
+                # an arrival can never join an epoch past the job's
+                # last: a momentarily-drained queue (a valley in the
+                # traffic trace) reads as "at the boundary" to
+                # _current_epoch_locked, but arriving work still
+                # belongs to the current pass — without the cap a
+                # streaming (num_epochs=1) arrival after a valley
+                # would land in a phantom epoch 1
+                epoch = min(epoch, self.num_epochs - 1)
+            added = 0
+            for i in range(0, len(shard_paths), shards_per_task):
+                self.todo.append(Task(self._next_id,
+                                      shard_paths[i:i + shards_per_task],
+                                      epoch=epoch))
+                self._next_id += 1
+                added += 1
+            self.sealed = bool(final)
+            self._snapshot(force=True)
+            self._publish_gauges()
+            return {"added": added, "sealed": self.sealed,
+                    "epoch": epoch}
+
+    def _current_epoch_locked(self) -> int:
+        """The epoch the queue is currently working (call under the
+        lock): the epoch of outstanding tasks, or — at a boundary —
+        the one the done list is about to roll into."""
+        eps = [t.epoch for t in self.todo] \
+            + [e["task"].epoch for e in self.pending.values()]
+        if eps:
+            return min(eps)
+        if self.done:
+            return min(t.epoch for t in self.done) + 1
+        return 0
+
     # -- trainer API ------------------------------------------------------
     def _mint_lease(self) -> str:
         self._lease_seq += 1
@@ -304,6 +359,8 @@ class TaskMaster:
 
     def _complete(self) -> bool:
         """Call under the lock — see :attr:`complete`."""
+        if not self.sealed:
+            return False      # streaming: drained != done (more may come)
         if self.num_epochs <= 0 or self.todo or self.pending:
             return False
         if not self.done and not self.failed_forever:
@@ -427,7 +484,9 @@ class TaskMaster:
         return status
 
     # -- elastic resize (ISSUE 14) -----------------------------------------
-    def request_resize(self, new_world_size: int) -> dict:
+    def request_resize(self, new_world_size: int,
+                       fence: Optional[dict] = None,
+                       immediate: bool = False) -> dict:
         """Ask the fleet to become ``new_world_size`` ranks.  Epoch-
         boundary semantics: if the queue is mid-epoch the request PENDS
         and applies when the epoch drains (``_maybe_rollover``); an
@@ -435,38 +494,71 @@ class TaskMaster:
         target, < the pending one) are directed to WAIT until the
         boundary; after a shrink applies, ranks >= the target are
         directed to RETIRE — their in-flight leases requeue through the
-        normal fence/ledger machinery, so nothing completes twice."""
+        normal fence/ledger machinery, so nothing completes twice.
+
+        ``fence`` (ISSUE 17 Helmsman): ``{"generation", "resizes"}``
+        captured when the caller DECIDED to resize.  A mismatch —
+        master restarted, or another resize applied since — rejects
+        the request (``{"fenced": True}``, counted in
+        ``fenced_rpcs_total{verb=request_resize}``) instead of
+        applying a decision made against a fleet that no longer
+        exists.  ``immediate=True`` applies mid-epoch without waiting
+        for the boundary — the streaming (``num_epochs=1``) mode,
+        where a queue under sustained load HAS no boundary to wait
+        for; batch jobs keep the default boundary semantics."""
         n = int(new_world_size)
         if n < 1:
             raise ValueError(f"request_resize: world size must be >= 1,"
                              f" got {n}")
         with self._lock:
             events = self._reap()
-            old = self.target_world_size
-            self.pending_world_size = n
-            obs_flight.record("task_queue", "resize_requested",
-                              old=old, new=n)
-            obs_journal.emit("master", "resize_requested",
-                             old_world=old, new_world=n)
-            from ..observability import tracectx as obs_tracectx
-            obs_tracectx.instant("fleet.resize_requested", kind="fleet",
+            fenced = fence is not None and (
+                int(fence.get("generation", -1)) != self.generation
+                or int(fence.get("resizes", -1)) != self.resizes)
+            if fenced:
+                self._fence(
+                    "request_resize",
+                    f"{fence.get('generation')}-{fence.get('resizes')}")
+                out = {"fenced": True, "applied": False,
+                       "target_world_size": self.target_world_size,
+                       "pending_world_size": self.pending_world_size,
+                       "resizes": self.resizes}
+            else:
+                old = self.target_world_size
+                self.pending_world_size = n
+                obs_flight.record("task_queue", "resize_requested",
+                                  old=old, new=n)
+                obs_journal.emit("master", "resize_requested",
                                  old_world=old, new_world=n)
-            applied = False
-            if not self.todo and not self.pending:
-                # idle queue: nothing to drain, effective now
-                self._apply_resize()
-                applied = True
-            self._snapshot(force=True)
-            self._publish_gauges()
-            out = {"target_world_size": self.target_world_size,
-                   "pending_world_size": self.pending_world_size,
-                   "applied": applied, "resizes": self.resizes}
+                from ..observability import tracectx as obs_tracectx
+                obs_tracectx.instant("fleet.resize_requested",
+                                     kind="fleet",
+                                     old_world=old, new_world=n)
+                applied = False
+                if not self.todo and not self.pending:
+                    # idle queue: nothing to drain, effective now
+                    self._apply_resize()
+                    applied = True
+                elif immediate:
+                    # streaming: apply mid-epoch, attributed to the
+                    # epoch currently being worked (all outstanding
+                    # tasks keep their epoch — no interleave)
+                    self._apply_resize(
+                        epoch=self._current_epoch_locked())
+                    applied = True
+                self._snapshot(force=True)
+                self._publish_gauges()
+                out = {"fenced": False,
+                       "target_world_size": self.target_world_size,
+                       "pending_world_size": self.pending_world_size,
+                       "applied": applied, "resizes": self.resizes}
         self._emit(events)
         return out
 
-    def _apply_resize(self):
+    def _apply_resize(self, epoch: Optional[int] = None):
         """Flip the pending world size live (call under the lock, at an
-        epoch boundary or on an idle queue)."""
+        epoch boundary or on an idle queue; ``immediate`` resizes pass
+        the mid-epoch attribution explicitly)."""
         if self.pending_world_size is None:
             return
         old, new = self.target_world_size, self.pending_world_size
@@ -477,8 +569,9 @@ class TaskMaster:
         # just-finished epoch, so the new world governs epoch+1 (an
         # idle-queue apply governs whatever runs next, epoch 0 at
         # job start)
-        epoch = (min(t.epoch for t in self.done) + 1) if self.done \
-            else 0
+        if epoch is None:
+            epoch = (min(t.epoch for t in self.done) + 1) if self.done \
+                else 0
         self.resize_log.append({"old": old, "new": new, "epoch": epoch})
         _m_resizes.inc()
         _m_target_world.set(new)
@@ -633,6 +726,8 @@ class TaskMaster:
                    "failed_forever": len(self.failed_forever),
                    "generation": self.generation,
                    "complete": self._complete(),
+                   "epoch": self._current_epoch_locked(),
+                   "sealed": self.sealed,
                    "ledger": len(self.ledger),
                    "target_world_size": self.target_world_size,
                    "pending_world_size": self.pending_world_size,
@@ -685,6 +780,7 @@ class TaskMaster:
             "next_id": self._next_id,
             "generation": self.generation,
             "num_epochs": self.num_epochs,
+            "sealed": self.sealed,
             # a resize (applied or still pending) survives a master
             # restart: the recovered fleet keeps its target and a
             # pending request still applies at the next boundary
@@ -788,6 +884,7 @@ class TaskMaster:
                 self.ledger = list(state.get("ledger", []))
                 if self.num_epochs == 0:
                     self.num_epochs = int(state.get("num_epochs", 0))
+                self.sealed = bool(state.get("sealed", True))
                 # the snapshot's target reflects APPLIED resizes and is
                 # newer truth than the relaunch argument: a master
                 # restarted with its launch-time world_size must not
@@ -857,7 +954,9 @@ class _Handler(socketserver.StreamRequestHandler):
             return resp
         if method == "request_resize":
             return {"ok": True,
-                    **master.request_resize(req["world_size"])}
+                    **master.request_resize(
+                        req["world_size"], fence=req.get("fence"),
+                        immediate=bool(req.get("immediate")))}
         if method == "task_finished":
             st = master.task_finished(req["task_id"],
                                       lease=req.get("lease"),
@@ -882,6 +981,11 @@ class _Handler(socketserver.StreamRequestHandler):
             master.set_dataset(req["shards"],
                                req.get("shards_per_task", 1))
             return {"ok": True}
+        if method == "extend_dataset":
+            return {"ok": True,
+                    **master.extend_dataset(
+                        req["shards"], req.get("shards_per_task", 1),
+                        final=bool(req.get("final")))}
         if method == "stats":
             return {"ok": True, "stats": master.stats()}
         if method == "ledger":
@@ -1155,6 +1259,15 @@ class TaskMasterClient:
         self._call(method="set_dataset", shards=shards,
                    shards_per_task=shards_per_task)
 
+    def extend_dataset(self, shards: List[str],
+                       shards_per_task: int = 1,
+                       final: bool = False) -> dict:
+        """Streaming arrivals: append tasks to the live queue (see
+        TaskMaster.extend_dataset; final=True seals the stream)."""
+        return self._call(method="extend_dataset", shards=shards,
+                          shards_per_task=shards_per_task,
+                          final=bool(final))
+
     def _status_call(self, **req) -> str:
         """One RPC whose reply is a fencing status: "ok" | "fenced" |
         "unknown" (legacy masters reply with just ``ok``)."""
@@ -1170,12 +1283,16 @@ class TaskMasterClient:
             self.target_world_size = int(resp["target_world_size"])
         return Task(**resp["task"]) if resp.get("task") else None
 
-    def request_resize(self, world_size: int) -> dict:
+    def request_resize(self, world_size: int,
+                       fence: Optional[dict] = None,
+                       immediate: bool = False) -> dict:
         """Ask the master to resize the fleet to ``world_size`` ranks
         (applies at the next epoch boundary; see
-        TaskMaster.request_resize)."""
+        TaskMaster.request_resize for the ``fence``/``immediate``
+        controller semantics)."""
         return self._call(method="request_resize",
-                          world_size=int(world_size))
+                          world_size=int(world_size), fence=fence,
+                          immediate=bool(immediate))
 
     def task_finished(self, task_id: int,
                       lease: Optional[str] = None,

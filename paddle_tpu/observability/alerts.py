@@ -87,6 +87,14 @@ _m_transitions = obs_metrics.counter(
 
 PREDICATES = ("threshold", "rate", "absence", "burn_rate")
 SEVERITIES = ("warning", "critical")
+# action: clause verbs (ISSUE 17 Helmsman) — what a FIRING rule may do
+# to the fleet when the controller flag is on.  "log" is the dry-run:
+# the full decision pipeline (cooldowns, clamps, journal) without an
+# actuator call.
+ACTIONS = ("request_resize", "drain", "revive", "log")
+# action-clause fields that only make sense on a resize verb
+_RESIZE_ONLY_FIELDS = ("direction", "step", "proportional", "max_step",
+                       "min_world", "max_world", "immediate")
 OPS: Dict[str, Callable[[float, float], bool]] = {
     ">": operator.gt, ">=": operator.ge, "<": operator.lt,
     "<=": operator.le, "==": operator.eq, "!=": operator.ne,
@@ -119,7 +127,7 @@ class Rule:
     __slots__ = ("name", "metric", "predicate", "op", "value",
                  "for_seconds", "window", "quantile", "labels",
                  "severity", "description", "bound", "budget", "source",
-                 "context_fn")
+                 "context_fn", "action")
 
     def __init__(self, name: str, metric: str, predicate: str,
                  op: str = ">", value: float = 0.0,
@@ -130,7 +138,8 @@ class Rule:
                  bound: Optional[float] = None, budget: float = 0.01,
                  source: str = "file",
                  context_fn: Optional[Callable[
-                     [Dict[str, str]], dict]] = None):
+                     [Dict[str, str]], dict]] = None,
+                 action: Optional[dict] = None):
         self.name = name
         self.metric = metric
         self.predicate = predicate
@@ -150,6 +159,10 @@ class Rule:
         # snapshot) supply their own context — perfscope's
         # perf_regression names the phase + an exemplar trace id
         self.context_fn = context_fn
+        # normalized action clause (parse_action) or None; the rule
+        # itself never actuates — the controller reads this off
+        # firing states the engine hands its action_sink
+        self.action = dict(action) if action else None
 
     def to_dict(self) -> dict:
         d = {"name": self.name, "metric": self.metric,
@@ -166,7 +179,78 @@ class Rule:
         if self.predicate == "burn_rate":
             d["bound"] = self.bound
             d["budget"] = self.budget
+        if self.action:
+            d["action"] = dict(self.action)
         return d
+
+
+def parse_action(obj: Any, where: str, predicate: str) -> dict:
+    """Validate one ``action:`` clause -> normalized dict; raises
+    :class:`RuleError` naming `where` and the offending field.  Runs
+    inside ``alerts --check`` (exit 1 on any of these), so an
+    unactuatable clause is a CI failure, not a runtime surprise."""
+
+    def fail(field, why):
+        raise RuleError(f"{where}: action field {field!r} {why}")
+
+    if not isinstance(obj, dict):
+        raise RuleError(f"{where}: field 'action' must be a JSON "
+                        f"object, got {type(obj).__name__}")
+    kind = obj.get("kind")
+    if kind not in ACTIONS:
+        fail("kind", f"must be one of {ACTIONS}, got {kind!r}")
+    if predicate == "absence":
+        # an absence rule has no numeric observed value — there is
+        # nothing to scale a step by and no band to hold, so an action
+        # on it is a config error, not a degenerate controller input
+        fail("kind", "cannot act on an 'absence' rule (no numeric "
+                     "observed value; alert on a gauge instead)")
+    known = {"kind", "cooldown", "hysteresis"} | set(_RESIZE_ONLY_FIELDS)
+    unknown = sorted(set(obj) - known)
+    if unknown:
+        fail(unknown[0],
+             f"is not an action field (known: {sorted(known)})")
+    if kind != "request_resize":
+        for f in _RESIZE_ONLY_FIELDS:
+            if f in obj:
+                fail(f, f"only applies to request_resize actions, "
+                        f"not {kind!r}")
+
+    def num(field, lo, integral=False):
+        v = obj[field]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            fail(field, f"must be a number, got {v!r}")
+        if integral and int(v) != v:
+            fail(field, f"must be an integer, got {v!r}")
+        if v < lo:
+            fail(field, f"must be >= {lo}, got {v!r}")
+        return int(v) if integral else float(v)
+
+    act: Dict[str, Any] = {"kind": kind}
+    for field, lo in (("cooldown", 0.0), ("hysteresis", 0.0)):
+        if field in obj:
+            act[field] = num(field, lo)
+    if kind == "request_resize":
+        direction = obj.get("direction")
+        if direction not in ("grow", "shrink"):
+            fail("direction", f"must be 'grow' or 'shrink', "
+                              f"got {direction!r}")
+        act["direction"] = direction
+        for field, lo in (("step", 1), ("max_step", 1),
+                          ("min_world", 1), ("max_world", 0)):
+            if field in obj:
+                act[field] = num(field, lo, integral=True)
+        for field in ("proportional", "immediate"):
+            if field in obj:
+                v = obj[field]
+                if not isinstance(v, bool):
+                    fail(field, f"must be a boolean, got {v!r}")
+                act[field] = v
+        if act.get("max_world") and \
+                act.get("min_world", 1) > act["max_world"]:
+            fail("min_world", f"must be <= max_world, got "
+                              f"{act['min_world']} > {act['max_world']}")
+    return act
 
 
 def parse_rule(obj: Any, where: str, source: str = "file") -> Rule:
@@ -194,7 +278,7 @@ def parse_rule(obj: Any, where: str, source: str = "file") -> Rule:
         fail("op", f"must be one of {tuple(OPS)}, got {op!r}")
     known = {"name", "metric", "predicate", "op", "value", "for",
              "window", "quantile", "labels", "severity", "description",
-             "bound", "budget"}
+             "bound", "budget", "action"}
     unknown = sorted(set(obj) - known)
     if unknown:
         fail(unknown[0], f"is not a rule field (known: {sorted(known)})")
@@ -244,11 +328,14 @@ def parse_rule(obj: Any, where: str, source: str = "file") -> Rule:
         bound = float(bound)
     elif bound is not None:
         fail("bound", "only applies to burn_rate rules")
+    action = None
+    if obj.get("action") is not None:
+        action = parse_action(obj["action"], where, predicate)
     return Rule(name=name, metric=metric, predicate=predicate, op=op,
                 value=value, for_seconds=for_s, window=window,
                 quantile=quantile, labels=labels, severity=severity,
                 description=description, bound=bound, budget=budget,
-                source=source)
+                source=source, action=action)
 
 
 def load_rules(path: str) -> List[Rule]:
@@ -434,6 +521,13 @@ class AlertEngine:
         self._last_eval_unix: Optional[float] = None
         self._ticker: Optional[threading.Thread] = None
         self._ticker_stop = threading.Event()
+        # Helmsman hook (ISSUE 17): fn(actionable, now) called after
+        # each evaluation with the currently-FIRING states whose rule
+        # carries an action clause.  Called OUTSIDE the engine lock
+        # (actuation does RPCs) and never allowed to raise into the
+        # ticker.  None (default) = observe-only Watchtower.
+        self.action_sink: Optional[
+            Callable[[List[dict], float], None]] = None
 
     # -- evaluation --------------------------------------------------------
     def evaluate(self, doc: Optional[dict] = None,
@@ -452,7 +546,39 @@ class AlertEngine:
             for rule in self.rules:
                 self._eval_rule(rule, doc, t)
             self._prune(t)
-            return self._status_locked()
+            status = self._status_locked()
+            actionable = self._actionable_locked()
+        sink = self.action_sink
+        if sink is not None and actionable:
+            try:
+                sink(actionable, t)
+            except Exception as e:   # the watchdog outlives its hands
+                obs_flight.record("alert", "action_sink_error",
+                                  error=repr(e)[:200])
+        return status
+
+    def _actionable_locked(self) -> List[dict]:
+        """Firing states whose rule has an action clause (call under
+        the lock): the controller's per-tick input.  Each entry is a
+        self-contained snapshot — the sink runs outside the lock."""
+        if self.action_sink is None:
+            return []
+        by_name = {r.name: r for r in self.rules}
+        out = []
+        for (rname, _skey), st in self._states.items():
+            rule = by_name.get(rname)
+            if rule is None or rule.action is None \
+                    or st["state"] != "firing":
+                continue
+            out.append({"rule": rule, "value": st.get("value"),
+                        "labels": dict(st.get("labels") or {}),
+                        "context": dict(st.get("context") or {}),
+                        "fired_unix": st.get("fired_unix"),
+                        "since": st.get("since")})
+        # deterministic actuation order: criticals first, then by name
+        out.sort(key=lambda e: (e["rule"].severity != "critical",
+                                e["rule"].name))
+        return out
 
     # resolved states linger this long for /alerts recent_resolved,
     # then drop — on a churning elastic fleet every (rule, worker)

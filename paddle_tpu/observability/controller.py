@@ -1,0 +1,605 @@
+"""Helmsman: closed-loop self-healing on top of Watchtower (ISSUE 17).
+
+The reference's EDL controller loop (Go master + etcd + the k8s
+autoscaler it fed) exists so the fleet *governs itself*: observed load
+decides the world size, dead pods respawn, overloaded servers drain —
+no human in the loop.  Our reproduction had every sensor (PR 15 alert
+rules over the fleet-merged metrics doc) and every actuator (PR 14
+``request_resize`` + supervisor park/revive, PR 8 serving drain) but
+nothing connecting them.  This module is the connection: alert rules
+gain an ``action:`` clause (alerts.parse_action), and a firing rule's
+action flows through the policy layer here before anything touches the
+fleet.
+
+The policy layer IS the robustness — every clause exists because the
+naive "alert fires -> call resize" loop fails in a specific way:
+
+  * per-action-class **cooldowns** + direction-reversal **hysteresis**
+    bound the decision rate (no flapping: applied decisions per class
+    <= duration/cooldown + 1);
+  * **min/max world clamps** make a runaway rule a "clamped" journal
+    entry, not a cost incident;
+  * burn-proportional **step** sizing (capped by ``max_step``) reacts
+    harder to hotter signals without unbounded jumps;
+  * a **single-flight** lock per action class plus a **fence token**
+    captured from the master's (generation, resizes) at decision time
+    means a stale decision — made before a master restart or a
+    concurrent resize — is REJECTED by the master, never
+    double-applied;
+  * actuator failures back off exponentially and a **circuit breaker**
+    degrades the controller to alert-only mode after
+    ``controller_breaker_threshold`` consecutive failures: a broken
+    controller must never be worse than no controller;
+  * **state persistence** (``controller_state_path``) lets a restarted
+    coordinator resume its cooldown clocks instead of instantly
+    re-firing every still-held action.
+
+Every decision — applied or not — is journaled as a
+``controller.decision`` event (triggering rule, observed value, action
++ magnitude, fence token, outcome) and carries the alert's trace id,
+so ``incident --decision <id>`` reconstructs *why the fleet changed
+size*.  Flag ``controller`` off (default): :func:`ensure_started`
+returns None, no sink attaches, no thread exists, no events are
+emitted — Watchtower stays observe-only (the PR 7 flag-off-invariance
+contract).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import flags
+from . import alerts as obs_alerts
+from . import flight as obs_flight
+from . import journal as obs_journal
+from . import metrics as obs_metrics
+
+SCHEMA = "paddle_tpu.controller.v1"
+_STATE_SCHEMA = "paddle_tpu.controller_state.v1"
+
+# decision outcomes (the journal/metric vocabulary):
+#   applied     — the actuator accepted the action
+#   dry_run     — action kind "log": full pipeline, no actuator
+#   clamped     — policy reduced the action to a no-op (world already
+#                 at the bound / nothing to revive); cooldown still
+#                 charges so a pinned rule can't spam
+#   fenced      — the master rejected a stale fence token (a resize or
+#                 master restart happened after the decision was cut);
+#                 NOT a failure: retried next tick with a fresh token
+#   failed      — the actuator raised; feeds the backoff/breaker
+#   no_actuator — the action kind has no wired actuator (controller on,
+#                 hands not connected); visible, cooldown charges
+OUTCOMES = ("applied", "dry_run", "clamped", "fenced", "failed",
+            "no_actuator")
+
+_m_decisions = obs_metrics.counter(
+    "controller_decisions_total",
+    "Helmsman decisions by action kind and outcome (see "
+    "controller.OUTCOMES; a 'fenced' outcome is a correctness save, "
+    "not an error).", ("action", "outcome"))
+_m_fence_rejections = obs_metrics.counter(
+    "controller_fence_rejections_total",
+    "Decisions the master rejected on a stale fence token "
+    "(generation/resizes moved between decision and actuation) — "
+    "counted, never absorbed: each one is a double-apply that did "
+    "NOT happen.")
+_m_skips = obs_metrics.counter(
+    "controller_skips_total",
+    "Firing actionable rules the policy layer declined to act on, "
+    "by reason (cooldown | hysteresis | inflight | resize_pending | "
+    "backoff | degraded | no_fleet).", ("reason",))
+_m_degraded = obs_metrics.gauge(
+    "controller_degraded",
+    "1 while the circuit breaker holds the controller in alert-only "
+    "mode (actuator failures >= controller_breaker_threshold); 0 "
+    "otherwise.  Cleared only by reset_breaker().")
+
+
+def _flag(name: str, override: Any) -> Any:
+    return flags.get_flag(name) if override is None else override
+
+
+class Controller:
+    """Policy layer between firing action-rules and the fleet.
+
+    ``fleet_fn`` returns the master's stats doc (target_world_size,
+    pending_world_size, generation, resizes, workers) — the fence
+    source of truth.  ``actuators`` maps action kind -> callable:
+
+      * ``request_resize``: fn(target_world, fence, immediate) ->
+        master reply dict (honours ``fenced``/``applied`` keys);
+      * ``drain``:          fn() -> any;
+      * ``revive``:         fn(ranks) -> list of revived ranks.
+
+    Decisions arrive via :meth:`consider` — wired as the alert
+    engine's ``action_sink``, so the controller runs on the alert
+    ticker's clock and owns NO thread of its own."""
+
+    def __init__(self,
+                 fleet_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 actuators: Optional[Dict[str, Callable]] = None,
+                 now_fn: Callable[[], float] = time.time,
+                 state_path: Optional[str] = None,
+                 pre_actuate: Optional[Callable[[dict], None]] = None):
+        self.fleet_fn = fleet_fn
+        self.actuators = dict(actuators or {})
+        self._now = now_fn
+        # test/chaos seam: called with the decision doc after the
+        # fence token is cut but BEFORE the actuator runs — the soak's
+        # "kill the coordinator mid-decision" window
+        self.pre_actuate = pre_actuate
+        self.state_path = str(_flag("controller_state_path", state_path)
+                              or "")
+        self._lock = threading.RLock()
+        self._seq = 0
+        # action class (= kind) -> unix time of the last decision that
+        # charged a cooldown (applied/dry_run/clamped/no_actuator)
+        self._last_action: Dict[str, float] = {}
+        # last APPLIED resize: (direction, unix time) — hysteresis
+        self._last_resize: Optional[List] = None
+        self._fails: Dict[str, int] = {}       # consecutive failures
+        self._retry_at: Dict[str, float] = {}  # post-failure backoff
+        self._inflight: set = set()            # single-flight classes
+        self.degraded = False
+        self._decisions: deque = deque(maxlen=128)
+        self._load_state()
+        _m_degraded.set(1.0 if self.degraded else 0.0)
+
+    # -- persistence -------------------------------------------------------
+    def _load_state(self):
+        if not self.state_path or not os.path.exists(self.state_path):
+            return
+        try:
+            with open(self.state_path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("schema") != _STATE_SCHEMA:
+                raise ValueError(f"unknown schema {doc.get('schema')!r}")
+            self._seq = int(doc.get("seq", 0))
+            self._last_action = {str(k): float(v) for k, v
+                                 in (doc.get("last_action") or {}).items()}
+            lr = doc.get("last_resize")
+            self._last_resize = [str(lr[0]), float(lr[1])] if lr else None
+            self._fails = {str(k): int(v) for k, v
+                           in (doc.get("fails") or {}).items()}
+            self._retry_at = {str(k): float(v) for k, v
+                              in (doc.get("retry_at") or {}).items()}
+            self.degraded = bool(doc.get("degraded", False))
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            # a corrupt state file must not brick the coordinator —
+            # fresh cooldowns are safe (at worst one early decision)
+            warnings.warn(
+                f"controller state {self.state_path!r} is unreadable "
+                f"({e}); starting with fresh cooldowns",
+                RuntimeWarning, stacklevel=3)
+
+    def _save_state(self):
+        if not self.state_path:
+            return
+        doc = {"schema": _STATE_SCHEMA, "seq": self._seq,
+               "last_action": self._last_action,
+               "last_resize": self._last_resize,
+               "fails": self._fails, "retry_at": self._retry_at,
+               "degraded": self.degraded,
+               "time_unix": self._now()}
+        try:
+            tmp = self.state_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.state_path)    # atomic, like snapshots
+        except OSError:
+            pass      # persistence is an optimization, never a crash
+
+    # -- policy ------------------------------------------------------------
+    def _skip(self, reason: str):
+        _m_skips.labels(reason=reason).inc()
+
+    def consider(self, actionable: List[dict],
+                 now: Optional[float] = None) -> List[dict]:
+        """One policy pass over the engine's firing actionable states
+        (the ``action_sink`` signature).  Returns the decision docs it
+        cut this pass (empty when everything was skipped)."""
+        if not flags.get_flag("controller"):
+            return []
+        t = self._now() if now is None else float(now)
+        out = []
+        for ent in actionable:
+            dec = self._consider_one(ent, t)
+            if dec is not None:
+                out.append(dec)
+        return out
+
+    def _consider_one(self, ent: dict, now: float) -> Optional[dict]:
+        rule = ent["rule"]
+        act = rule.action or {}
+        kind = act.get("kind")
+        if kind not in obs_alerts.ACTIONS:
+            return None
+        cls = kind
+        with self._lock:
+            if self.degraded and kind != "log":
+                self._skip("degraded")
+                return None
+            cooldown = float(_flag("controller_cooldown_s",
+                                   act.get("cooldown")))
+            last = self._last_action.get(cls)
+            if last is not None and now - last < cooldown:
+                self._skip("cooldown")
+                return None
+            retry_at = self._retry_at.get(cls)
+            if retry_at is not None and now < retry_at:
+                self._skip("backoff")
+                return None
+            if cls in self._inflight:
+                self._skip("inflight")
+                return None
+            if kind in ("request_resize", "revive"):
+                fleet = self._fleet()
+                if fleet is None:
+                    self._skip("no_fleet")
+                    return None
+            else:
+                fleet = self._fleet()
+            plan = self._plan(rule, act, ent, fleet, now)
+            if plan is None:
+                return None
+            self._inflight.add(cls)
+        try:
+            return self._actuate(rule, ent, plan, now)
+        finally:
+            with self._lock:
+                self._inflight.discard(cls)
+
+    def _fleet(self) -> Optional[dict]:
+        if self.fleet_fn is None:
+            return None
+        try:
+            return self.fleet_fn()
+        except Exception:
+            return None
+
+    def _plan(self, rule, act: dict, ent: dict,
+              fleet: Optional[dict], now: float) -> Optional[dict]:
+        """Turn a firing rule into a concrete decision plan (call under
+        the lock).  None = skipped (metrics say why); a plan with
+        ``noop`` set journals as "clamped"."""
+        kind = act["kind"]
+        plan: Dict[str, Any] = {"kind": kind, "magnitude": 0,
+                                "noop": False, "fence": None}
+        if kind == "request_resize":
+            if fleet.get("pending_world_size") is not None:
+                # one resize in flight fleet-wide, whoever asked for it
+                self._skip("resize_pending")
+                return None
+            direction = act["direction"]
+            hys = float(_flag("controller_hysteresis_s",
+                              act.get("hysteresis")))
+            if self._last_resize is not None \
+                    and self._last_resize[0] != direction \
+                    and now - self._last_resize[1] < hys:
+                self._skip("hysteresis")
+                return None
+            world = int(fleet.get("target_world_size") or 0)
+            step = self._step(rule, act, ent)
+            lo = int(_flag("controller_min_world", act.get("min_world")))
+            hi = int(_flag("controller_max_world", act.get("max_world")))
+            target = world + step if direction == "grow" \
+                else world - step
+            target = max(target, lo)
+            if hi > 0:
+                target = min(target, hi)
+            plan.update(direction=direction, magnitude=abs(target - world),
+                        target_world=target, old_world=world,
+                        noop=target == world,
+                        immediate=bool(act.get("immediate", False)),
+                        # the fence: actuation is valid only against
+                        # the exact fleet this decision observed
+                        fence={"generation": int(fleet.get("generation",
+                                                           0)),
+                               "resizes": int(fleet.get("resizes", 0))})
+        elif kind == "revive":
+            workers = (fleet or {}).get("workers") or {}
+            world = int((fleet or {}).get("target_world_size") or 0)
+            dead = sorted(int(r) for r, s in workers.items()
+                          if s == "dead" and (world <= 0 or int(r) < world))
+            plan.update(ranks=dead, magnitude=len(dead),
+                        noop=not dead)
+        elif kind == "drain":
+            plan.update(magnitude=1)
+        else:                                    # "log" dry-run
+            plan.update(magnitude=0)
+        return plan
+
+    def _step(self, rule, act: dict, ent: dict) -> int:
+        step = int(act.get("step", 1))
+        if act.get("proportional") and rule.value != 0 \
+                and ent.get("value") is not None:
+            # burn-proportional: a signal at 3x the rule's threshold
+            # asks for 3x the step — hotter breach, harder correction
+            try:
+                ratio = abs(float(ent["value"])) / abs(float(rule.value))
+                step = max(step, int(step * ratio))
+            except (TypeError, ValueError, ZeroDivisionError):
+                pass
+        return max(1, min(step, int(_flag("controller_max_step",
+                                          act.get("max_step")))))
+
+    # -- actuation ---------------------------------------------------------
+    def _actuate(self, rule, ent: dict, plan: dict,
+                 now: float) -> dict:
+        kind = plan["kind"]
+        with self._lock:
+            self._seq += 1
+            decision_id = f"helm-{self._seq:05d}"
+        dec: Dict[str, Any] = {
+            "decision_id": decision_id, "time_unix": now,
+            "rule": rule.name, "severity": rule.severity,
+            "action": kind, "observed": ent.get("value"),
+            "magnitude": plan["magnitude"], "fence": plan["fence"],
+            "alert_trace_id": (ent.get("context") or {}).get(
+                "alert_trace_id"),
+        }
+        for k in ("direction", "target_world", "old_world", "ranks"):
+            if k in plan:
+                dec[k] = plan[k]
+        error = None
+        if plan["noop"]:
+            outcome = "clamped"
+        elif kind == "log":
+            outcome = "dry_run"
+        else:
+            outcome, error = self._run_actuator(kind, plan, dec)
+        dec["outcome"] = outcome
+        if error:
+            dec["error"] = error
+        with self._lock:
+            self._settle(kind, plan, outcome, now)
+            self._decisions.append(dec)
+            self._save_state()
+        self._record(dec)
+        return dec
+
+    def _run_actuator(self, kind: str, plan: dict, dec: dict):
+        """Run the wired actuator through the chaos seam; returns
+        (outcome, error)."""
+        fn = self.actuators.get(kind)
+        if fn is None:
+            return "no_actuator", None
+        try:
+            from ..resilience import chaos
+            chaos.trigger("controller.actuate")
+            if self.pre_actuate is not None:
+                self.pre_actuate(dict(dec))
+            if kind == "request_resize":
+                reply = fn(plan["target_world"], plan["fence"],
+                           plan.get("immediate", False)) or {}
+                if reply.get("fenced"):
+                    _m_fence_rejections.inc()
+                    return "fenced", None
+                return "applied", None
+            if kind == "revive":
+                fn(plan.get("ranks") or [])
+                return "applied", None
+            fn()
+            return "applied", None
+        except Exception as e:
+            return "failed", repr(e)[:200]
+
+    def _settle(self, kind: str, plan: dict, outcome: str, now: float):
+        """Cooldown / hysteresis / breaker bookkeeping (under lock)."""
+        cls = kind
+        if outcome in ("applied", "dry_run", "clamped", "no_actuator"):
+            # every counted decision charges the class cooldown —
+            # including clamped ones, or a rule pinned at a bound
+            # would journal-spam every tick
+            self._last_action[cls] = now
+            self._fails.pop(cls, None)
+            self._retry_at.pop(cls, None)
+            if outcome == "applied" and kind == "request_resize":
+                self._last_resize = [plan["direction"], now]
+        elif outcome == "fenced":
+            # a correctness save, not an error and not an action: no
+            # cooldown (retry with a fresh token next tick), no
+            # breaker strike
+            pass
+        elif outcome == "failed":
+            n = self._fails.get(cls, 0) + 1
+            self._fails[cls] = n
+            base = float(flags.get_flag("controller_backoff_s"))
+            self._retry_at[cls] = now + base * (2 ** (n - 1))
+            if not self.degraded and \
+                    n >= int(flags.get_flag("controller_breaker_threshold")):
+                self._degrade(cls, n, now)
+
+    def _degrade(self, cls: str, fails: int, now: float):
+        """Trip the breaker (under lock): alert-only until
+        reset_breaker()."""
+        self.degraded = True
+        _m_degraded.set(1.0)
+        obs_journal.emit("controller", "degraded", action=cls,
+                         consecutive_failures=fails)
+        obs_flight.record("controller", "degraded", action=cls,
+                          consecutive_failures=fails)
+        warnings.warn(
+            f"controller degraded to alert-only mode after {fails} "
+            f"consecutive {cls!r} actuator failures; rules keep "
+            f"firing, nothing actuates until reset_breaker()",
+            RuntimeWarning, stacklevel=4)
+
+    def reset_breaker(self):
+        """Operator hook: re-arm a degraded controller."""
+        with self._lock:
+            was = self.degraded
+            self.degraded = False
+            self._fails.clear()
+            self._retry_at.clear()
+            _m_degraded.set(0.0)
+            self._save_state()
+        if was:
+            obs_journal.emit("controller", "breaker_reset")
+
+    def _record(self, dec: dict):
+        _m_decisions.labels(action=dec["action"],
+                            outcome=dec["outcome"]).inc()
+        obs_flight.record("controller", "decision",
+                          decision_id=dec["decision_id"],
+                          rule=dec["rule"], action=dec["action"],
+                          outcome=dec["outcome"],
+                          magnitude=dec["magnitude"])
+        # time_unix is a journal-reserved field (emit stamps its own);
+        # the decision's own clock rides in the record body
+        obs_journal.emit("controller", "decision",
+                         **{k: v for k, v in dec.items()
+                            if k != "time_unix"},
+                         decided_unix=dec["time_unix"])
+        # X-ray: the decision lands on the triggering alert's own
+        # trace, so GET /trace/<id> shows fire -> decision -> resize
+        tid = dec.get("alert_trace_id")
+        if tid:
+            from . import tracectx as obs_tracectx
+            if obs_tracectx.enabled():
+                obs_tracectx.record_span(
+                    f"controller.{dec['action']}", tid,
+                    obs_tracectx.new_span_id(), None, dec["time_unix"],
+                    time.perf_counter(), 0.0, kind="controller",
+                    attrs={"decision_id": dec["decision_id"],
+                           "outcome": dec["outcome"],
+                           "magnitude": dec["magnitude"]})
+
+    # -- introspection -----------------------------------------------------
+    def status_doc(self) -> dict:
+        now = self._now()
+        with self._lock:
+            cooldowns = {}
+            for cls, last in sorted(self._last_action.items()):
+                cooldowns[cls] = {"last_decision_unix": last,
+                                  "age_s": round(now - last, 3)}
+            return {
+                "schema": SCHEMA, "time_unix": now, "enabled": True,
+                "degraded": self.degraded,
+                "seq": self._seq,
+                "actuators": sorted(self.actuators),
+                "breaker": {
+                    "consecutive_failures": dict(self._fails),
+                    "retry_at": dict(self._retry_at),
+                    "threshold": int(flags.get_flag(
+                        "controller_breaker_threshold"))},
+                "cooldowns": cooldowns,
+                "last_resize": list(self._last_resize)
+                if self._last_resize else None,
+                "decisions": [dict(d) for d in self._decisions],
+            }
+
+
+# -- module singleton (the alerts.py idiom) ---------------------------------
+
+_lock = threading.Lock()
+_ctrl: Optional[Controller] = None
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("controller"))
+
+
+def get_controller() -> Optional[Controller]:
+    return _ctrl
+
+
+def ensure_started(fleet_fn=None, actuators: Optional[dict] = None,
+                   state_path: Optional[str] = None,
+                   pre_actuate=None) -> Optional[Controller]:
+    """Start (or re-wire) the process-wide controller and attach it as
+    the alert engine's action sink.  No-op returning None while the
+    ``controller`` flag is off — the flag-off path allocates nothing
+    and hooks nothing (invariance contract).  Requires the alert plane
+    (``alert_rules_path``): a controller with no sensors is refused
+    loudly rather than silently idle."""
+    if not enabled():
+        return None
+    engine = obs_alerts.ensure_started()
+    if engine is None:
+        warnings.warn(
+            "controller flag is on but the alert plane is off "
+            "(alert_rules_path empty) — the controller has no sensor "
+            "input and will not start", RuntimeWarning, stacklevel=2)
+        return None
+    global _ctrl
+    with _lock:
+        if _ctrl is None:
+            _ctrl = Controller(fleet_fn=fleet_fn, actuators=actuators,
+                               state_path=state_path,
+                               pre_actuate=pre_actuate)
+        else:
+            if fleet_fn is not None:
+                _ctrl.fleet_fn = fleet_fn
+            if actuators:
+                _ctrl.actuators.update(actuators)
+            if pre_actuate is not None:
+                _ctrl.pre_actuate = pre_actuate
+        engine.action_sink = _ctrl.consider
+        return _ctrl
+
+
+def wire_master(master, supervisor=None,
+                serving_drain: Optional[Callable] = None,
+                state_path: Optional[str] = None) -> Optional[Controller]:
+    """Convenience wiring for a coordinator that owns an in-process
+    TaskMaster (and optionally the Supervisor + serving plane): fleet
+    doc from ``master.stats()``; resize actuation goes through the
+    master's fenced ``request_resize`` and is mirrored to the
+    supervisor AFTER the master accepts (the read-the-resize-log
+    discipline — the master's ledger is the truth, the supervisor
+    follows it)."""
+
+    def _fleet():
+        return master.stats()
+
+    def _resize(target, fence, immediate=False):
+        reply = master.request_resize(target, fence=fence,
+                                      immediate=immediate)
+        if not reply.get("fenced") and supervisor is not None:
+            supervisor.set_world_size(target)
+        return reply
+
+    actuators: Dict[str, Callable] = {"request_resize": _resize}
+    if supervisor is not None:
+        actuators["revive"] = supervisor.revive
+    if serving_drain is None:
+        def serving_drain():
+            from .. import serving
+            return serving.drain()
+    actuators["drain"] = serving_drain
+    return ensure_started(fleet_fn=_fleet, actuators=actuators,
+                          state_path=state_path)
+
+
+def status_doc() -> dict:
+    """The ``GET /controller`` document — meaningful even while
+    disabled (enabled=False, empty decision list)."""
+    ctrl = _ctrl
+    if ctrl is not None:
+        return ctrl.status_doc()
+    return {"schema": SCHEMA, "time_unix": time.time(),
+            "enabled": enabled(), "degraded": False, "seq": 0,
+            "actuators": [], "breaker": None, "cooldowns": {},
+            "last_resize": None, "decisions": []}
+
+
+def reset():
+    """Test hook (conftest): detach from the engine, drop the
+    singleton, zero the metric families."""
+    global _ctrl
+    with _lock:
+        eng = obs_alerts.get_engine()
+        if eng is not None:
+            eng.action_sink = None
+        _ctrl = None
+    _m_decisions.clear()
+    _m_fence_rejections.clear()
+    _m_skips.clear()
+    _m_degraded.clear()

@@ -551,11 +551,6 @@ class FleetAggregator:
                          (self._ingest_journal_event(e, rank, offset)
                           for e in payload.get("journal") or [])
                          if ev is not None]
-        # the durable append happens OUTSIDE the aggregator lock: a
-        # per-event write+flush under it would serialize disk I/O into
-        # every fleet RPC and every metrics/healthz scrape
-        for ev in journaled:
-            obs_journal.append_raw(ev)
             for tid, cap in (payload.get("xray_captures") or {}).items():
                 if not isinstance(cap, dict):
                     continue
@@ -564,6 +559,11 @@ class FleetAggregator:
                     self._xray_captures.pop(
                         next(iter(self._xray_captures)))
                 self._xray_captures[str(tid)] = cap
+        # the durable append happens OUTSIDE the aggregator lock: a
+        # per-event write+flush under it would serialize disk I/O into
+        # every fleet RPC and every metrics/healthz scrape
+        for ev in journaled:
+            obs_journal.append_raw(ev)
 
     _MAX_XRAY_TRACES = 2048
     _MAX_JOURNAL = 8192

@@ -21,6 +21,11 @@ command instead of five hand-joined file formats::
     python -m paddle_tpu.observability.incident coord.jsonl \
         --trace-id 4bf92f3577b34da6a3ce929d0e0e4736
 
+    # why did the fleet change size: one Helmsman controller decision
+    # (ISSUE 17) joined with the alert + resize it caused
+    python -m paddle_tpu.observability.incident coord.jsonl \
+        --decision helm-00003
+
 Journal files merge with at-least-once dedupe (an event shipped to the
 coordinator AND read from its emitter's own file appears once) and
 order on ``time_unix`` — master-normalized for shipped events, so
@@ -130,8 +135,9 @@ def resolve_window(events: List[dict], alert_history: List[dict],
                    window: Optional[str] = None,
                    alert: Optional[str] = None,
                    trace_id: Optional[str] = None,
+                   decision: Optional[str] = None,
                    pad: float = 5.0) -> Tuple[float, float, dict]:
-    """(t0, t1, selector-doc) per the CLI's three addressing modes;
+    """(t0, t1, selector-doc) per the CLI's four addressing modes;
     raises ValueError when the selector matches nothing."""
     if window:
         try:
@@ -176,6 +182,29 @@ def resolve_window(events: List[dict], alert_history: List[dict],
                              f"journal/runlog record")
         return (min(hits) - pad, max(hits) + pad,
                 {"mode": "trace", "trace_id": trace_id})
+    if decision:
+        # ISSUE 17: a Helmsman controller decision is addressable — the
+        # window spans the decision event itself plus everything sharing
+        # its alert trace id (the firing rule's exemplars, the master's
+        # resize_applied/lease events the actuation caused), so "why did
+        # the fleet change size" is one command
+        matches = [e for e in events
+                   if e.get("kind") == "controller"
+                   and e.get("event") == "decision"
+                   and str(e.get("decision_id")) == decision]
+        if not matches:
+            raise ValueError(f"decision {decision!r} appears in no "
+                             f"journal")
+        hits = [float(e["time_unix"]) for e in matches]
+        tids = {e.get("alert_trace_id") for e in matches
+                if e.get("alert_trace_id")}
+        hits.extend(float(e["time_unix"]) for e in events
+                    if e.get("trace_id") in tids)
+        sel = {"mode": "decision", "decision_id": decision,
+               "rule": matches[0].get("rule"),
+               "action": matches[0].get("action"),
+               "outcome": matches[0].get("outcome")}
+        return min(hits) - pad, max(hits) + pad, sel
     if not events:
         raise ValueError("no events at all (empty journals and no "
                          "selector)")
@@ -265,6 +294,10 @@ def render_report(doc: dict) -> str:
              f"selector={sel.get('mode')}"
              + (f" {sel.get('alert')}" if sel.get("alert") else "")
              + (f" {sel.get('trace_id')}" if sel.get("trace_id") else "")
+             + (f" {sel.get('decision_id')} "
+                f"{sel.get('rule')}->{sel.get('action')}"
+                f"={sel.get('outcome')}"
+                if sel.get("decision_id") else "")
              + f", ranks={doc.get('ranks')})"]
     for a in doc.get("alerts", []):
         t0 = float(w.get("t0_unix", 0.0))
@@ -330,6 +363,18 @@ def _fixture_events() -> List[dict]:
            registered_rank=0),
         ev(3.2, "alert", "resolve", None, 9, rule="dead_rank",
            severity="critical"),
+        # ISSUE 17: the Helmsman controller acting on a backlog alert —
+        # a decision event plus the fleet change it caused, linked by
+        # the alert's trace id so --decision joins them into one window
+        ev(4.0, "controller", "decision", None, 10,
+           decision_id="helm-00001", rule="task_backlog",
+           severity="warning", action="request_resize",
+           direction="grow", observed=37.0, magnitude=2,
+           old_world=2, target_world=4, outcome="applied",
+           fence={"generation": 1, "resizes": 0},
+           alert_trace_id="9f1a2b3c4d5e6f709f1a2b3c4d5e6f70"),
+        ev(4.4, "master", "resize_applied", None, 11, old=2, new=4,
+           trace_id="9f1a2b3c4d5e6f709f1a2b3c4d5e6f70"),
     ]
 
 
@@ -360,8 +405,30 @@ def _self_test() -> int:
         print(f"incident --self-test FAILED: render missing {missing}\n"
               f"{text}")
         return 1
+    # the --decision selector: one controller decision id resolves to a
+    # window holding the decision AND the resize it caused (joined on
+    # the alert trace id)
+    t0, t1, sel = resolve_window(events, [], decision="helm-00001",
+                                 pad=1.0)
+    doc = build_report(events, [], t0, t1, sel)
+    order = [(e["kind"], e["event"]) for e in doc["timeline"]]
+    if ("controller", "decision") not in order \
+            or ("master", "resize_applied") not in order \
+            or sel.get("outcome") != "applied":
+        print(f"incident --self-test FAILED: --decision window missing "
+              f"decision/resize pair: {order} sel={sel}")
+        return 1
+    try:
+        resolve_window(events, [], decision="helm-99999")
+    except ValueError:
+        pass
+    else:
+        print("incident --self-test FAILED: unknown decision id did "
+              "not raise")
+        return 1
     print("incident --self-test OK (kill -> fence -> respawn -> "
-          "resolve reconstructed in order)")
+          "resolve reconstructed in order; --decision joins decision "
+          "-> resize)")
     return 0
 
 
@@ -392,6 +459,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--trace-id", metavar="ID",
                     help="window = every record stamped with ID "
                          "(+/- --pad)")
+    ap.add_argument("--decision", metavar="ID",
+                    help="window = one controller decision (helm-NNNNN) "
+                         "plus everything on its alert trace: why the "
+                         "fleet changed size, as one timeline")
     ap.add_argument("--pad", type=float, default=5.0,
                     help="seconds of context around --alert/--trace-id "
                          "(default 5)")
@@ -408,9 +479,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     if sum(bool(x) for x in (args.window, args.alert,
-                             args.trace_id)) > 1:
-        print("incident: --window/--alert/--trace-id are mutually "
-              "exclusive", file=sys.stderr)
+                             args.trace_id, args.decision)) > 1:
+        print("incident: --window/--alert/--trace-id/--decision are "
+              "mutually exclusive", file=sys.stderr)
         return 2
     try:
         alerts_doc = None
@@ -430,7 +501,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             runlog_records=runlog_records)
         t0, t1, sel = resolve_window(
             events, history, window=args.window, alert=args.alert,
-            trace_id=args.trace_id, pad=args.pad)
+            trace_id=args.trace_id, decision=args.decision,
+            pad=args.pad)
         doc = build_report(events, history, t0, t1, sel,
                            runlog_records=runlog_records)
     except (OSError, ValueError) as e:

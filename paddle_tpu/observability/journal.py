@@ -76,6 +76,19 @@ _m_failures = obs_metrics.counter(
     "journal_write_failures_total",
     "Journal appends that failed (disk full / permission) and were "
     "absorbed — the journal must never take the fleet down.")
+_m_collisions = obs_metrics.counter(
+    "journal_field_collisions_total",
+    "emit() instrumentation fields DROPPED because they collide with "
+    "a reserved record name (rank/pid/seq/...).  Non-zero means a "
+    "call site is silently losing data — rename the field (the PR 15 "
+    "'worker=' gotcha, caught at the source).", ("field",))
+
+# the envelope emit() owns; an instrumentation field by one of these
+# names would either be dropped (old behavior, silently) or corrupt
+# the dedupe/merge keys if honored — so it is dropped LOUDLY instead
+_RESERVED_FIELDS = ("schema", "kind", "event", "time_unix",
+                    "perf_counter", "rank", "pid", "seq", "trace_id")
+_warned_collisions: set = set()
 
 _RING_MAX = 4096
 
@@ -174,7 +187,21 @@ def emit(kind: str, event: str, **fields) -> Optional[dict]:
         if tid is not None:
             rec["trace_id"] = tid
         for k, v in fields.items():
-            if k not in rec:
+            if k in _RESERVED_FIELDS:
+                # LOUD drop: a collision with the envelope loses the
+                # caller's data either way — say so (warn once per
+                # site, count always) instead of eating it
+                _m_collisions.labels(field=k).inc()
+                site = (rec["kind"], rec["event"], k)
+                if site not in _warned_collisions:
+                    _warned_collisions.add(site)
+                    warnings.warn(
+                        f"journal.emit({rec['kind']}/{rec['event']}): "
+                        f"field {k!r} collides with a reserved record "
+                        f"name and was DROPPED — rename it (reserved: "
+                        f"{_RESERVED_FIELDS})", RuntimeWarning,
+                        stacklevel=2)
+            elif k not in rec:
                 rec[k] = _strict(v)
         _ring.append(rec)
         if len(_ring) > _RING_MAX:
@@ -269,6 +296,8 @@ def reset():
         _generation += 1
         _seq = 0
         _rank = 0
+        _warned_collisions.clear()
+    _m_collisions.clear()
 
 
 # -- reading / merging ------------------------------------------------------

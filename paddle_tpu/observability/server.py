@@ -142,6 +142,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, obs.serving())
             elif path == "/alerts":
                 self._send_json(200, obs.alerts())
+            elif path == "/controller":
+                self._send_json(200, obs.controller())
             elif path == "/perf":
                 self._send_json(200, obs.perf())
             elif path == "/journal":
@@ -161,9 +163,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/":
                 self._send(200, b"paddle_tpu observability: /metrics "
                                 b"/metrics.json /healthz /flight "
-                                b"/model /serving /alerts /perf "
-                                b"/journal /trace/<id> "
-                                b"[POST /serving/generate /profile]\n",
+                                b"/model /serving /alerts /controller "
+                                b"/perf /journal /trace/<id> "
+                                b"[POST /serving/generate "
+                                b"/serving/drain /profile]\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send_json(404, {"error": f"no route {path}"})
@@ -177,7 +180,8 @@ class _Handler(BaseHTTPRequestHandler):
         obs: "ObservabilityServer" = self.server.obs   # type: ignore
         path = self.path.split("?", 1)[0].rstrip("/")
         try:
-            if path not in ("/serving/generate", "/profile"):
+            if path not in ("/serving/generate", "/serving/drain",
+                            "/profile"):
                 self._send_json(404, {"error": f"no POST route {path}"})
                 return
             length = int(self.headers.get("Content-Length") or 0)
@@ -189,6 +193,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if path == "/profile":
                 code, doc = obs.profile(body)
+                self._send_json(code, doc)
+                return
+            if path == "/serving/drain":
+                code, doc = obs.serving_drain(body)
                 self._send_json(code, doc)
                 return
             # request X-ray: honor (or mint) the W3C traceparent so the
@@ -351,6 +359,28 @@ class ObservabilityServer:
         doc["source"] = ("fleet" if self.aggregator is not None
                          else "local")
         return doc
+
+    def controller(self) -> dict:
+        """``GET /controller``: the Helmsman status document — breaker
+        state, cooldown clocks and the recent decision ring; a
+        meaningful disabled doc when the ``controller`` flag is off."""
+        from . import controller as obs_controller
+        return obs_controller.status_doc()
+
+    def serving_drain(self, body: dict):
+        """``POST /serving/drain``: remote drain-on-command (the
+        controller's drain actuator reaching a serving worker over
+        HTTP, and an operator verb in its own right).  Body:
+        ``{"stop": bool}`` — stop=true also ends the batcher loop
+        after the drain completes (SIGTERM semantics)."""
+        from .. import serving
+        b = serving.get()
+        if b is None:
+            return 503, {"error": "no serving batcher attached"}
+        b.begin_drain(stop=bool(body.get("stop", False)))
+        return 200, {"status": "draining",
+                     "stop": bool(body.get("stop", False)),
+                     "queued": b.queue_depth}
 
     def perf(self) -> dict:
         """``GET /perf``: the perfscope roofline view — this process's
@@ -527,6 +557,13 @@ def _start_alert_engine(server: "ObservabilityServer"):
         eng = obs_alerts.ensure_started()
         if eng is not None:
             server._wire_alerts(eng)
+            # Helmsman rides the same lifecycle: flag-gated, riding
+            # the alert ticker's clock (no thread of its own).  With
+            # no coordinator wiring (controller.wire_master) it runs
+            # sensor-complete but hands-empty: decisions journal with
+            # outcome "no_actuator" — visible, never destructive.
+            from . import controller as obs_controller
+            obs_controller.ensure_started()
     except Exception:
         pass
 

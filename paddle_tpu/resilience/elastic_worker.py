@@ -63,6 +63,24 @@ def _apply(w, shard: str, epoch: int):
     return w
 
 
+def _work_seconds(shards) -> float:
+    """Open-loop trace hook (ISSUE 17): a shard named
+    ``shard-007#0.25`` carries 0.25s of simulated per-shard work — the
+    controller soak encodes its arrival trace's service times in the
+    shard names so backlog builds under real wall-clock load.  Shards
+    without the ``#`` suffix (every pre-existing test) cost nothing.
+    The FULL name, suffix included, stays the exactly-once ledger key."""
+    total = 0.0
+    for sh in shards:
+        _, sep, tail = str(sh).rpartition("#")
+        if sep:
+            try:
+                total += max(0.0, float(tail))
+            except ValueError:
+                pass
+    return total
+
+
 def _unapply(w, shards, epoch: int):
     import numpy as np
     for sh in shards:
@@ -182,6 +200,9 @@ def main(argv=None) -> int:
             # membership reaper requeues it and the supervisor respawns
             # this rank
             chaos.trigger("trainer.step")
+            work_s = _work_seconds(t.shards)
+            if work_s > 0.0:
+                time.sleep(work_s)
             for sh in t.shards:
                 w = _apply(w, sh, t.epoch)
                 consumed.append([sh, t.epoch])

@@ -22,6 +22,22 @@ exactly-once:
   while chaos kill-9s rank 0 (``resize_combined``); the supervisor
   spawns/retires rank processes to match.
 * ``resize_soak``     — the headline: 2→4→1→3 across four epochs.
+* ``controller`` / ``controller_ramp`` / ``controller_chaos`` — the
+  Helmsman closed loop (ISSUE 17): an open-loop arrival trace (per-task
+  service time rides in the shard name, ``shard-NNN#<seconds>``) feeds
+  a STREAMING task master (``extend_dataset``, ``num_epochs=1``) while
+  backlog-driven alert rules with ``action:`` clauses grow and shrink
+  the fleet through the controller — ZERO human resize calls.
+  ``controller`` is the tier-1 miniature (≥1 grow + ≥1 shrink);
+  ``controller_ramp`` is the slow headline (two bursts/two valleys,
+  ≥2 grow + ≥2 shrink, p99 task sojourn under
+  ``serving_p99_budget_ms``, chip-seconds BEAT a static max-world
+  baseline run of the same trace); ``controller_chaos`` additionally
+  kill-9s rank 0 mid-task, bounces the coordinator mid-decision (the
+  stale fence token must be REJECTED, the retry applies — no
+  double-apply) and fires a drain action with no serving plane
+  attached until the circuit breaker degrades the controller to
+  alert-only mode.
 
 Every schedule asserts: all workers exit 0 inside the deadline, every
 (task, epoch) pair completes EXACTLY once in the master's persisted
@@ -44,16 +60,18 @@ import json
 import os
 import socket
 import sys
+import threading
 import time
 import zlib
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 SCHEDULES = ("worker_kill", "master_restart", "rpc_refuse", "combined",
              "fixed", "resize_grow", "resize_shrink", "resize_combined",
-             "resize_soak")
+             "resize_soak", "controller", "controller_ramp",
+             "controller_chaos")
 
 # world-size plan per resize schedule: one entry per epoch BOUNDARY
 # (requested mid-epoch, applied when the epoch drains), so a plan of
@@ -64,6 +82,51 @@ RESIZE_PLANS = {
     "resize_combined": (3,),
     "resize_soak": (4, 1, 3),
 }
+
+# Helmsman closed-loop profiles (ISSUE 17).  ``phases`` is the arrival
+# trace: (duration_s, tasks_per_second) segments, each task carrying
+# ``work_s`` of simulated service time in its shard name.  The
+# controller's knobs (cooldown/hysteresis/clamps) ride the flags the
+# schedule sets; ``grow_at``/``idle_for`` parameterize the backlog
+# rules.  Numbers are sized so the policy outcome is structural, not a
+# timing coin-flip: heavy phases oversubscribe the launch world by >2x
+# (backlog must build), valleys are longer than idle_for + cooldown
+# (a shrink must land), and the tier-1 miniature stays ~10s wall.
+_CONTROLLER_PROFILES = {
+    "controller": {
+        "world": 1, "min_world": 1, "max_world": 3, "max_step": 2,
+        "cooldown": 1.0, "hysteresis": 1.0, "work_s": 0.3,
+        "phases": ((3.0, 4.0), (4.5, 0.0)),
+        "grow_at": 2, "grow_for": 0.3, "idle_for": 0.8,
+        "min_grow": 1, "min_shrink": 1, "p99_budget_ms": 0.0,
+        "baseline": False, "chaos": False, "drain_at": None,
+    },
+    "controller_ramp": {
+        "world": 1, "min_world": 1, "max_world": 4, "max_step": 2,
+        "cooldown": 2.0, "hysteresis": 2.0, "work_s": 0.4,
+        "phases": ((5.0, 6.0), (4.0, 0.5), (5.0, 6.0), (4.0, 0.25)),
+        "grow_at": 3, "grow_for": 0.3, "idle_for": 1.2,
+        "min_grow": 2, "min_shrink": 2, "p99_budget_ms": 15000.0,
+        "baseline": True, "chaos": False, "drain_at": None,
+    },
+    "controller_chaos": {
+        "world": 2, "min_world": 1, "max_world": 4, "max_step": 2,
+        "cooldown": 1.0, "hysteresis": 1.0, "work_s": 0.35,
+        "phases": ((4.0, 5.0), (4.0, 0.4), (4.0, 0.0)),
+        "grow_at": 3, "grow_for": 0.3, "idle_for": 1.2,
+        "min_grow": 1, "min_shrink": 1, "p99_budget_ms": 0.0,
+        "baseline": False, "chaos": True, "drain_at": 10.0,
+    },
+}
+
+# flags every controller schedule sets (and restores) around its run
+_CONTROLLER_FLAGS = (
+    "controller", "alert_rules_path", "alert_eval_interval",
+    "controller_cooldown_s", "controller_hysteresis_s",
+    "controller_min_world", "controller_max_world",
+    "controller_max_step", "controller_backoff_s",
+    "controller_breaker_threshold", "controller_state_path",
+    "serving_p99_budget_ms", "journal_path")
 
 # master timing: the heartbeat reaper (worker death -> immediate
 # requeue) must be what recovers leases, not the per-task timeout —
@@ -115,7 +178,8 @@ def worker_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     return env
 
 
-def expected_w_total(n_tasks: int, epochs: int) -> float:
+def expected_w_total(n_tasks: int, epochs: int,
+                     shard_names: Optional[List[str]] = None) -> float:
     """The fixed-fleet end state for a clean run over ``n_tasks``
     shards x ``epochs``: the elastic worker's stand-in update is a
     commutative pure sum of per-(shard, epoch) contributions, so ANY
@@ -128,15 +192,17 @@ def expected_w_total(n_tasks: int, epochs: int) -> float:
     import numpy as np
 
     from paddle_tpu.resilience.elastic_worker import _apply
+    names = shard_names if shard_names is not None \
+        else [f"shard-{i:03d}" for i in range(n_tasks)]
     w = np.zeros(16, dtype="float64")
-    for i in range(n_tasks):
+    for sh in names:
         for ep in range(epochs):
-            w = _apply(w, f"shard-{i:03d}", ep)
+            w = _apply(w, sh, ep)
     return float(w.sum())
 
 
-def check_consumed(workers: List[dict], n_tasks: int,
-                   epochs: int) -> List[str]:
+def check_consumed(workers: List[dict], n_tasks: int, epochs: int,
+                   shard_names: Optional[List[str]] = None) -> List[str]:
     """Reader-example exactly-once: the union of per-rank ``consumed``
     records (each rank's checkpointed multiset of applied (shard,
     epoch) pairs, reconciled against the ledger across restarts and
@@ -148,8 +214,9 @@ def check_consumed(workers: List[dict], n_tasks: int,
     dups = sorted(k for k, v in seen.items() if v > 1)
     if dups:
         problems.append(f"reader examples double-consumed: {dups}")
-    want = {(f"shard-{i:03d}", ep)
-            for i in range(n_tasks) for ep in range(epochs)}
+    names = shard_names if shard_names is not None \
+        else [f"shard-{i:03d}" for i in range(n_tasks)]
+    want = {(sh, ep) for sh in names for ep in range(epochs)}
     missing = sorted(want - set(seen))
     if missing:
         problems.append(f"reader examples lost: {missing}")
@@ -195,6 +262,12 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
     if name not in SCHEDULES:
         raise ValueError(f"unknown schedule {name!r} "
                          f"(expected one of {SCHEDULES})")
+    if name in _CONTROLLER_PROFILES:
+        # the Helmsman closed loop has its own driver: a streaming
+        # trace and a controller making every resize decision (the
+        # batch params world/n_tasks/epochs don't apply)
+        return run_controller_schedule(workdir, name, seed=seed,
+                                       timeout=timeout)
     resize_plan = list(RESIZE_PLANS.get(name, ()))
     if resize_plan:
         # one boundary per planned world; the final world needs an
@@ -375,6 +448,439 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
             "generation": generation_after,
             "w_total": w_total, "expected_w_total": expected_total,
             "stats": stats, "workers": workers,
+            "duration_s": round(time.time() - t_start, 2)}
+
+
+def _build_trace(prof: dict) -> Tuple[List[Tuple[float, str]], float]:
+    """Expand a profile's ``phases`` into an arrival trace: a list of
+    (offset_s, shard_name) sorted by offset, plus the trace duration.
+    Each shard name carries the per-task service time in its ``#``
+    suffix (elastic_worker._work_seconds) so backlog builds under real
+    wall-clock load."""
+    trace: List[Tuple[float, str]] = []
+    base = 0.0
+    idx = 0
+    for dur, rate in prof["phases"]:
+        if rate > 0:
+            gap = 1.0 / rate
+            t = 0.0
+            while t < dur - 1e-9:
+                trace.append((base + t,
+                              f"shard-{idx:03d}#{prof['work_s']}"))
+                idx += 1
+                t += gap
+        base += dur
+    return trace, base
+
+
+def _controller_rules(prof: dict) -> dict:
+    """The Helmsman rules file for a controller schedule: backlog over
+    target grows the fleet (critical, burn-proportional), a drained
+    queue shrinks it (warning — criticals-first ordering means a real
+    backlog always outranks the shrink), and the chaos lane adds an
+    operator drain lever wired to a deliberately-broken actuator (the
+    circuit-breaker food)."""
+    rules = [
+        {"name": "task_backlog", "metric": "taskmaster_tasks",
+         "predicate": "threshold", "labels": {"state": "todo"},
+         "op": ">", "value": prof["grow_at"], "for": prof["grow_for"],
+         "severity": "critical",
+         "description": "queue backlog over target: grow the fleet",
+         "action": {"kind": "request_resize", "direction": "grow",
+                    "step": 1, "proportional": True,
+                    "immediate": True}},
+        {"name": "fleet_idle", "metric": "taskmaster_tasks",
+         "predicate": "threshold", "labels": {"state": "todo"},
+         "op": "<", "value": 1, "for": prof["idle_for"],
+         "severity": "warning",
+         "description": "queue drained: shrink the fleet",
+         "action": {"kind": "request_resize", "direction": "shrink",
+                    "step": 1, "immediate": True}},
+    ]
+    if prof["chaos"]:
+        rules.append(
+            {"name": "drain_cmd", "metric": "helm_drain_cmd",
+             "predicate": "threshold", "op": ">", "value": 0,
+             "for": 0.0, "severity": "critical",
+             "description": "operator lever: drain serving now",
+             "action": {"kind": "drain", "cooldown": 0.3}})
+    return {"rules": rules}
+
+
+def _run_trace_fleet(workdir: str, prof: dict,
+                     trace: List[Tuple[float, str]], trace_dur: float,
+                     timeout: float, controlled: bool,
+                     chaos: bool = False) -> dict:
+    """Drive one open-loop arrival trace against a supervised fleet.
+
+    ``controlled=True`` wires the Helmsman controller (caller has
+    already set the flags): the controller makes EVERY fleet-size
+    decision; this driver only feeds arrivals and samples chip-seconds.
+    ``controlled=False`` is the static max-world baseline the elastic
+    run must beat on chip-seconds.  ``chaos`` additionally kills rank 0
+    mid-run and bounces the coordinator between a resize decision's
+    fence cut and its actuation (the pre_actuate seam)."""
+    from paddle_tpu.distributed.supervisor import Supervisor
+    from paddle_tpu.distributed.task_queue import (TaskMaster,
+                                                   serve_master)
+    from paddle_tpu.observability import controller as obs_controller
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.resilience import retry as rretry
+    from paddle_tpu.resilience.elastic_worker import RETIRED_RC
+
+    os.makedirs(workdir, exist_ok=True)
+    port = _free_port()
+    endpoints = f"127.0.0.1:{port}"
+    snap = os.path.join(workdir, "master.json")
+    world0 = prof["world"] if controlled else prof["max_world"]
+
+    def _master() -> "TaskMaster":
+        # streaming mode: one epoch, tasks arrive via extend_dataset
+        # as the trace plays; sealed only when the trace ends
+        return TaskMaster(snapshot_path=snap,
+                          lease_timeout=_LEASE_TIMEOUT,
+                          snapshot_interval=0.0,
+                          worker_timeout=_WORKER_TIMEOUT,
+                          num_epochs=1, world_size=world0)
+
+    holder = {"master": _master()}
+    holder["srv"], _ = serve_master(holder["master"], port=port)
+
+    outs = [os.path.join(workdir, f"worker_{r}.json")
+            for r in range(prof["max_world"])]
+
+    def _cmd(rank: int) -> List[str]:
+        return worker_cmd(endpoints, world0, rank, outs[rank],
+                          os.path.join(workdir, f"ckpt_r{rank}"))
+
+    envs: List[Optional[Dict[str, str]]] = [None] * world0
+    if chaos:
+        kseed = _seed_where_exit_fires(0.4, 1, 3)
+        envs[0] = {"PTPU_CHAOS_SPEC": "trainer.step=exit:0.4:9",
+                   "PTPU_CHAOS_SEED": str(kseed)}
+    sup = Supervisor(
+        cmds=[_cmd(r) for r in range(world0)], env=worker_env(),
+        envs=envs, cwd=REPO_ROOT, log_dir=workdir, cmd_factory=_cmd,
+        retire_rc=RETIRED_RC, worker_timeout=_WORKER_TIMEOUT,
+        # restart slower than the death-declaration window (the PR 15
+        # gotcha: a chaos-killed rank must be DECLARED dead and its
+        # lease requeued before the respawn rejoins) but capped so a
+        # revived rank never waits out a full exponential tail
+        backoff=rretry.RetryPolicy(name="supervisor_restart",
+                                   max_attempts=1, base_delay=2.5,
+                                   max_delay=2.5))
+    sup.start()
+
+    # bounce + feed serialize on one lock: the coordinator swap must
+    # never interleave with an extend_dataset (a task landing on the
+    # outgoing master AFTER the successor read the snapshot would be
+    # lost — exactly the torn-write class snapshots exist to prevent)
+    swap_lock = threading.Lock()
+    arrivals: Dict[int, float] = {}
+    fed = {"i": 0}
+
+    def _feed_due(now_off: float):
+        while fed["i"] < len(trace) and \
+                trace[fed["i"]][0] <= now_off:
+            with swap_lock:
+                holder["master"].extend_dataset([trace[fed["i"]][1]])
+            arrivals[fed["i"]] = time.time()
+            fed["i"] += 1
+
+    # the t=0 arrivals go in BEFORE the controller starts: an empty
+    # pre-traffic queue reads as "idle", and the shrink rule must not
+    # charge its first cooldown on launch noise
+    _feed_due(0.0)
+
+    ctrl = None
+    g_drain = None
+    bounced = {"n": 0}
+    if controlled:
+        def _bounce(dec: dict):
+            # the chaos seam: the coordinator dies between the fence
+            # token and the actuation — exactly once, on the first
+            # resize decision.  The successor recovers from the
+            # snapshot with a bumped generation, so the in-flight
+            # decision's fence MUST be rejected (never double-applied);
+            # the controller retries with a fresh token next tick.
+            if not chaos or bounced["n"] \
+                    or dec.get("action") != "request_resize":
+                return
+            bounced["n"] += 1
+            with swap_lock:
+                holder["srv"].shutdown()
+                holder["master"] = _master()
+                holder["srv"], _ = serve_master(holder["master"],
+                                                port=port)
+
+        def _fleet() -> dict:
+            return holder["master"].stats()
+
+        def _resize(target: int, fence, immediate: bool = False):
+            reply = holder["master"].request_resize(
+                target, fence=fence, immediate=immediate)
+            if not reply.get("fenced"):
+                # follower discipline: the supervisor mirrors what the
+                # master ACCEPTED — mechanical, not a human resize
+                sup.set_world_size(target)
+            return reply
+
+        def _drain():
+            # no serving batcher is attached in this soak: every drain
+            # raises — deliberately, to feed the circuit breaker
+            from paddle_tpu import serving
+            return serving.drain()
+
+        g_drain = obs_metrics.gauge(
+            "helm_drain_cmd",
+            "Soak lever: nonzero arms the chaos lane's drain rule.")
+        g_drain.set(0.0)
+        ctrl = obs_controller.ensure_started(
+            fleet_fn=_fleet,
+            actuators={"request_resize": _resize,
+                       "revive": sup.revive, "drain": _drain},
+            pre_actuate=_bounce)
+
+    t0 = time.time()
+    last = [t0]
+    chip = [0.0]
+    finished = False
+    drain_armed = False
+    deadline = t0 + timeout
+
+    def _tick():
+        now = time.time()
+        alive = sum(1 for s in sup.status().values()
+                    if s["state"] == "running")
+        chip[0] += alive * (now - last[0])
+        last[0] = now
+
+    try:
+        while time.time() - t0 < trace_dur and time.time() < deadline:
+            _feed_due(time.time() - t0)
+            if controlled and chaos and not drain_armed \
+                    and prof["drain_at"] is not None \
+                    and time.time() - t0 >= prof["drain_at"]:
+                g_drain.set(1.0)
+                drain_armed = True
+            _tick()
+            time.sleep(0.05)
+        _feed_due(float("inf"))       # anything the loop granularity missed
+        with swap_lock:
+            holder["master"].extend_dataset([], final=True)   # seal
+        while time.time() < deadline:
+            _tick()
+            if sup.wait(timeout=0.25):
+                finished = True
+                break
+        _tick()
+        status_doc = ctrl.status_doc() if ctrl is not None else None
+        degraded = bool(ctrl.degraded) if ctrl is not None else False
+        ledger = holder["master"].ledger_entries()
+        stats = holder["master"].stats()
+    finally:
+        sup.stop()
+        try:
+            holder["srv"].shutdown()
+        except Exception:
+            pass
+        if g_drain is not None:
+            g_drain.set(0.0)
+    workers, missing = [], []
+    for r in sorted(set(sup.spawns)):
+        if sup.spawns.get(r, 0) <= 0:
+            continue
+        if os.path.exists(outs[r]):
+            with open(outs[r]) as f:
+                workers.append(json.load(f))
+        else:
+            missing.append(outs[r])
+    return {"finished": finished, "chip_seconds": chip[0],
+            "arrivals": arrivals, "ledger": ledger, "stats": stats,
+            "workers": workers, "missing_reports": missing,
+            "restarts": dict(sup.restarts), "spawns": dict(sup.spawns),
+            "controller": status_doc, "degraded": degraded,
+            "duration_s": time.time() - t0}
+
+
+def run_controller_schedule(workdir: str, name: str, seed: int = 0,
+                            timeout: float = 120.0) -> dict:
+    """One Helmsman closed-loop schedule (ISSUE 17): the fleet
+    grows/shrinks ITSELF off the alert rules with zero human resizes.
+    Asserts the exactly-once invariants of every other schedule PLUS
+    the control-plane gates: enough applied grow and shrink decisions,
+    a 1:1 map between applied decisions and the master's resize_log,
+    cooldown-bounded decision rate (no flapping), and per-lane
+    headline checks — p99 sojourn under the serving budget and
+    chip-seconds below the static max-world baseline (ramp), fence
+    rejection + breaker degradation under coordinator/rank-0 chaos
+    (chaos)."""
+    from paddle_tpu.core import flags
+    from paddle_tpu.observability import alerts as obs_alerts
+    from paddle_tpu.observability import controller as obs_controller
+    from paddle_tpu.observability import journal as obs_journal
+
+    prof = _CONTROLLER_PROFILES[name]
+    os.makedirs(workdir, exist_ok=True)
+    t_start = time.time()
+    trace, trace_dur = _build_trace(prof)
+    n_tasks = len(trace)
+    shard_names = [s for _, s in trace]
+
+    # the static max-world baseline runs FIRST, controller plane
+    # untouched: the chip-seconds bar the elastic run must beat
+    base = None
+    if prof["baseline"]:
+        base = _run_trace_fleet(os.path.join(workdir, "baseline"),
+                                prof, trace, trace_dur, timeout,
+                                controlled=False)
+
+    rules_path = os.path.join(workdir, "rules.json")
+    with open(rules_path, "w") as f:
+        json.dump(_controller_rules(prof), f, indent=1)
+    saved = {k: flags.get_flag(k) for k in _CONTROLLER_FLAGS}
+    try:
+        flags.set_flag("controller", True)
+        flags.set_flag("alert_rules_path", rules_path)
+        flags.set_flag("alert_eval_interval", 0.1)
+        flags.set_flag("controller_cooldown_s", prof["cooldown"])
+        flags.set_flag("controller_hysteresis_s", prof["hysteresis"])
+        flags.set_flag("controller_min_world", prof["min_world"])
+        flags.set_flag("controller_max_world", prof["max_world"])
+        flags.set_flag("controller_max_step", prof["max_step"])
+        flags.set_flag("controller_backoff_s", 0.2)
+        flags.set_flag("controller_breaker_threshold", 3)
+        flags.set_flag("controller_state_path",
+                       os.path.join(workdir, "controller_state.json"))
+        flags.set_flag("serving_p99_budget_ms", prof["p99_budget_ms"])
+        flags.set_flag("journal_path",
+                       os.path.join(workdir, "journal.jsonl"))
+        run = _run_trace_fleet(os.path.join(workdir, "elastic"), prof,
+                               trace, trace_dur, timeout,
+                               controlled=True, chaos=prof["chaos"])
+    finally:
+        obs_controller.reset()
+        obs_alerts.reset()
+        obs_journal.reset()
+        for k, v in saved.items():
+            flags.set_flag(k, v)
+
+    problems = []
+    if not run["finished"]:
+        problems.append(f"fleet did not finish within {timeout}s")
+    problems += [f"missing worker report {p}"
+                 for p in run["missing_reports"]]
+    problems += check_ledger(run["ledger"], n_tasks, 1)
+    w_total = sum(w["w_sum"] for w in run["workers"])
+    expected_total = expected_w_total(n_tasks, 1,
+                                      shard_names=shard_names)
+    if abs(w_total - expected_total) > 1e-6:
+        problems.append(f"fleet end state {w_total!r} != fixed-fleet "
+                        f"{expected_total!r} (examples lost or "
+                        f"double-applied across controller resizes)")
+    problems += check_consumed(run["workers"], n_tasks, 1,
+                               shard_names=shard_names)
+
+    decisions = list((run["controller"] or {}).get("decisions", []))
+    applied = [d for d in decisions if d["action"] == "request_resize"
+               and d["outcome"] == "applied"]
+    grows = [d for d in applied if d.get("direction") == "grow"]
+    shrinks = [d for d in applied if d.get("direction") == "shrink"]
+    fenced = [d for d in decisions if d["outcome"] == "fenced"]
+    if len(grows) < prof["min_grow"]:
+        problems.append(f"only {len(grows)} grow decisions applied "
+                        f"(need >= {prof['min_grow']}): the fleet "
+                        f"never scaled itself up under backlog")
+    if len(shrinks) < prof["min_shrink"]:
+        problems.append(f"only {len(shrinks)} shrink decisions applied "
+                        f"(need >= {prof['min_shrink']}): the fleet "
+                        f"never scaled itself down when idle")
+    # ZERO human resizes + exactly-once actuation: every entry in the
+    # master's resize_log maps 1:1 to an applied controller decision
+    # (a fenced decision adds NO entry — that's the no-double-apply
+    # guarantee under the coordinator bounce)
+    log = run["stats"].get("resize_log", [])
+    if len(log) != len(applied):
+        problems.append(f"resize_log has {len(log)} entries but "
+                        f"{len(applied)} controller decisions applied "
+                        f"(double-apply, or a resize the controller "
+                        f"did not make)")
+    for a, b in zip(log, log[1:]):
+        if b["old"] != a["new"]:
+            problems.append(f"resize_log does not chain: {log}")
+            break
+    # anti-flap: cooldown bounds the decision rate per action class
+    charged = [d for d in decisions if d["action"] == "request_resize"
+               and d["outcome"] in ("applied", "dry_run", "clamped",
+                                    "no_actuator")]
+    bound = int(run["duration_s"] / prof["cooldown"]) + 2
+    if len(charged) > bound:
+        problems.append(f"{len(charged)} cooldown-charging resize "
+                        f"decisions in {run['duration_s']:.1f}s "
+                        f"(cooldown {prof['cooldown']}s allows "
+                        f"{bound}): the controller is flapping")
+    p99_ms = None
+    if prof["p99_budget_ms"] > 0:
+        soj = sorted(
+            (e["time_unix"] - run["arrivals"][e["task_id"]]) * 1000.0
+            for e in run["ledger"]
+            if "time_unix" in e and e["task_id"] in run["arrivals"])
+        if soj:
+            p99_ms = soj[min(len(soj) - 1,
+                             int(round(0.99 * (len(soj) - 1))))]
+            if p99_ms > prof["p99_budget_ms"]:
+                problems.append(
+                    f"p99 task sojourn {p99_ms:.0f}ms blew the "
+                    f"{prof['p99_budget_ms']:.0f}ms budget (the "
+                    f"controller grew too little or too late)")
+        else:
+            problems.append("no sojourn samples (empty ledger?)")
+    chip_base = base["chip_seconds"] if base else None
+    if base is not None:
+        if not base["finished"]:
+            problems.append("static baseline run did not finish")
+        if run["chip_seconds"] >= base["chip_seconds"]:
+            problems.append(
+                f"elastic chip-seconds {run['chip_seconds']:.1f} did "
+                f"not beat the static world={prof['max_world']} "
+                f"baseline {base['chip_seconds']:.1f}")
+    if prof["chaos"]:
+        if not fenced:
+            problems.append("coordinator bounce mid-decision produced "
+                            "no fence rejection (a stale decision was "
+                            "silently applied?)")
+        if run["restarts"].get(0, 0) < 1:
+            problems.append("rank 0 was never chaos-killed/restarted")
+        if int(run["stats"].get("generation", 1)) < 2:
+            problems.append("master generation never bumped (the "
+                            "mid-decision bounce did not happen)")
+        failed = [d for d in decisions if d["outcome"] == "failed"]
+        if len(failed) < 3:
+            problems.append(f"expected >= 3 failed drain decisions "
+                            f"before the breaker trips, saw "
+                            f"{len(failed)}")
+        if not run["degraded"]:
+            problems.append("drain actuator failures never tripped "
+                            "the circuit breaker (controller should "
+                            "be degraded to alert-only)")
+    return {"schedule": name, "ok": not problems, "problems": problems,
+            "seed": seed, "world": prof["world"], "n_tasks": n_tasks,
+            "epochs": 1, "ledger_entries": len(run["ledger"]),
+            "restarts": run["restarts"], "spawns": run["spawns"],
+            "resize_plan": [], "resizes_applied": len(log),
+            "generation": run["stats"].get("generation"),
+            "w_total": w_total, "expected_w_total": expected_total,
+            "stats": run["stats"], "workers": run["workers"],
+            "decisions": decisions,
+            "grows": len(grows), "shrinks": len(shrinks),
+            "fence_rejections": len(fenced),
+            "degraded": run["degraded"],
+            "chip_seconds": round(run["chip_seconds"], 2),
+            "chip_seconds_baseline": (round(chip_base, 2)
+                                      if chip_base is not None
+                                      else None),
+            "p99_sojourn_ms": (round(p99_ms, 1)
+                               if p99_ms is not None else None),
             "duration_s": round(time.time() - t_start, 2)}
 
 

@@ -35,8 +35,8 @@ from .batcher import ContinuousBatcher, ServingRequest, ShedError
 from .kv_cache import DecodeEngine, extract_lm_params
 
 __all__ = ["DecodeEngine", "extract_lm_params", "ContinuousBatcher",
-           "ServingRequest", "ShedError", "attach", "get", "reset",
-           "status_doc", "histogram_quantiles"]
+           "ServingRequest", "ShedError", "attach", "get", "drain",
+           "reset", "status_doc", "histogram_quantiles"]
 
 _lock = threading.Lock()
 _batcher: Optional[ContinuousBatcher] = None
@@ -56,6 +56,21 @@ def attach(batcher: ContinuousBatcher) -> ContinuousBatcher:
 
 def get() -> Optional[ContinuousBatcher]:
     return _batcher
+
+
+def drain(stop: bool = False) -> dict:
+    """Drain the attached batcher on command (ISSUE 17): the
+    controller's ``drain`` actuator and the body behind
+    ``POST /serving/drain``.  Raises RuntimeError when no batcher is
+    attached — a drain that silently did nothing is exactly the
+    actuator failure the controller's circuit breaker exists to
+    catch."""
+    b = get()
+    if b is None:
+        raise RuntimeError("serving.drain: no serving batcher attached")
+    b.begin_drain(stop=stop)
+    return {"status": "draining", "stop": bool(stop),
+            "queued": b.queue_depth}
 
 
 def reset():

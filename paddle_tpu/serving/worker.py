@@ -70,6 +70,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     batcher.start()
     serving.attach(batcher)
     batcher.install_signal_handlers()
+    # drain-on-command (ISSUE 17): attaching before the HTTP server
+    # comes up means POST /serving/drain — the Helmsman controller's
+    # remote drain actuator — is live from the first ready line; a
+    # drain directed at a worker that hasn't attached yet is a 503,
+    # which the controller counts as an actuator failure
     srv = obs_server.start_http_server(port=port)
     # cold-start headline (ROADMAP item 1): process exec to "can answer
     # a request" — interpreter + imports + model build + the bucket

@@ -45,6 +45,7 @@ def fresh_programs():
     from paddle_tpu.distributed import task_queue
     from paddle_tpu.framework import executor as executor_mod
     from paddle_tpu.observability import alerts as obs_alerts
+    from paddle_tpu.observability import controller as obs_controller
     from paddle_tpu.observability import costmodel, flight, forensics
     from paddle_tpu.observability import deviceprof, metrics as obs_metrics
     from paddle_tpu.observability import journal as obs_journal
@@ -73,6 +74,11 @@ def fresh_programs():
     obs_journal.reset()
     pt.core.flags.set_flag("alert_rules_path", "")
     pt.core.flags.set_flag("journal_path", "")
+    # Helmsman: drop the controller singleton (decision ring, breaker
+    # state, cooldown clocks) and default the flag back to off — one
+    # case's actuation history must not charge the next case's cooldowns
+    obs_controller.reset()
+    pt.core.flags.set_flag("controller", False)
     # request X-ray: traces/captures from one case must not resolve in
     # the next (GET /trace, exemplar trace ids), and the device-prof
     # capture latch must not read busy across cases
@@ -113,8 +119,10 @@ def fresh_programs():
     serving.reset()
     obs_alerts.reset()
     obs_journal.reset()
+    obs_controller.reset()
     pt.core.flags.set_flag("alert_rules_path", "")
     pt.core.flags.set_flag("journal_path", "")
+    pt.core.flags.set_flag("controller", False)
     pt.core.flags.set_flag("jit_cache_dir", "")
     obs_perfscope.reset()
     pt.core.flags.set_flag("perfscope", False)

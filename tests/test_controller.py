@@ -1,0 +1,633 @@
+"""Helmsman (ISSUE 17): closed-loop self-healing and traffic-driven
+autoscaling — the policy layer between firing action-rules and the
+fleet's actuators.
+
+Covers: the ``action:`` clause validation matrix + the ``alerts
+--check`` exit-code contract, the engine -> action_sink delivery
+(criticals first, sink errors isolated), every policy clause on a fake
+clock (cooldown, hysteresis, clamps, burn-proportional step,
+single-flight + fence rejection, failure backoff -> circuit breaker ->
+alert-only degrade -> reset, state persistence across a coordinator
+restart incl. the corrupt-file path), flag-off invariance, the
+satellites (journal reserved-name collision warning + counter,
+supervisor backoff-vs-worker-timeout warning, revive semantics,
+request_resize storms coalescing, streaming extend_dataset epoch cap),
+the HTTP surface (GET /controller, POST /serving/drain), the
+``incident --decision`` selector, and the tier-1 miniature controller
+soak where the fleet grows AND shrinks itself with zero human resizes.
+"""
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+import warnings
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.supervisor import Supervisor
+from paddle_tpu.distributed.task_queue import TaskMaster
+from paddle_tpu.observability import alerts, incident
+from paddle_tpu.observability import controller as ctrl_mod
+from paddle_tpu.observability import journal
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.resilience import retry as rretry
+from paddle_tpu.resilience import soak
+
+
+def _gdoc(name, rows):
+    """Synthetic metrics doc: one gauge family, rows = [(labels, v)]."""
+    return {"schema": "paddle_tpu.metrics.v1", "metrics": {
+        name: {"type": "gauge", "help": "",
+               "series": [{"labels": dict(l), "value": v}
+                          for l, v in rows]}}}
+
+
+def _fleet_doc(world=2, generation=1, resizes=0, pending=None,
+               workers=None):
+    return {"target_world_size": world, "pending_world_size": pending,
+            "generation": generation, "resizes": resizes,
+            "workers": workers or {}}
+
+
+def _grow_rule(value=3.0, **act):
+    action = {"kind": "request_resize", "direction": "grow", **act}
+    return alerts.Rule(name="backlog", metric="m", predicate="threshold",
+                       op=">", value=value, severity="critical",
+                       action=alerts.parse_action(action, "t",
+                                                  "threshold"))
+
+
+def _shrink_rule(**act):
+    action = {"kind": "request_resize", "direction": "shrink", **act}
+    return alerts.Rule(name="idle", metric="m", predicate="threshold",
+                       op="<", value=1.0,
+                       action=alerts.parse_action(action, "t",
+                                                  "threshold"))
+
+
+def _ent(rule, value=10.0):
+    return {"rule": rule, "value": value, "labels": {}, "context": {}}
+
+
+def _counter(name, **labels):
+    fam = obs_metrics.REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    return fam.labels(**labels).value if labels else fam.total()
+
+
+# ------------------------------------------------ action clause parsing
+
+def test_parse_action_valid_matrix():
+    a = alerts.parse_action(
+        {"kind": "request_resize", "direction": "grow", "step": 2,
+         "proportional": True, "immediate": True, "cooldown": 5,
+         "hysteresis": 10, "max_step": 4, "min_world": 1,
+         "max_world": 8}, "t", "threshold")
+    assert a["kind"] == "request_resize" and a["direction"] == "grow"
+    assert a["step"] == 2 and a["max_step"] == 4
+    assert a["proportional"] is True and a["immediate"] is True
+    assert a["cooldown"] == 5.0 and a["hysteresis"] == 10.0
+    for kind in ("drain", "revive", "log"):
+        assert alerts.parse_action({"kind": kind}, "t",
+                                   "threshold")["kind"] == kind
+
+
+@pytest.mark.parametrize("obj,match", [
+    ("drain", "must be a JSON object"),
+    ({"kind": "reboot"}, "must be one of"),
+    ({"kind": "drain", "step": 1}, "only applies to request_resize"),
+    ({"kind": "request_resize"}, "'grow' or 'shrink'"),
+    ({"kind": "request_resize", "direction": "up"}, "'grow' or 'shrink'"),
+    ({"kind": "request_resize", "direction": "grow", "step": 0},
+     "must be >= 1"),
+    ({"kind": "request_resize", "direction": "grow", "step": 1.5},
+     "must be an integer"),
+    ({"kind": "request_resize", "direction": "grow",
+      "proportional": "yes"}, "must be a boolean"),
+    ({"kind": "request_resize", "direction": "grow", "min_world": 5,
+      "max_world": 2}, "must be <= max_world"),
+    ({"kind": "log", "cooldown": -1}, "must be >= 0"),
+    ({"kind": "log", "frobnicate": 1}, "is not an action field"),
+])
+def test_parse_action_invalid_matrix(obj, match):
+    with pytest.raises(alerts.RuleError, match=match):
+        alerts.parse_action(obj, "t", "threshold")
+
+
+def test_parse_action_refuses_absence_rules():
+    with pytest.raises(alerts.RuleError, match="absence"):
+        alerts.parse_action({"kind": "drain"}, "t", "absence")
+
+
+def test_alerts_check_cli_action_exit_codes(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"rules": [
+        {"name": "r", "metric": "m", "predicate": "threshold",
+         "op": ">", "value": 1,
+         "action": {"kind": "request_resize", "direction": "grow"}}]}))
+    assert alerts.main(["--check", str(good)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"rules": [
+        {"name": "r", "metric": "m", "predicate": "threshold",
+         "op": ">", "value": 1, "action": {"kind": "reboot"}}]}))
+    assert alerts.main(["--check", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "INVALID" in out and "kind" in out
+    assert alerts.main(["--check", str(tmp_path / "missing.json")]) == 2
+
+
+# ------------------------------------------------ engine -> sink wiring
+
+def test_action_sink_gets_criticals_first_and_survives_errors():
+    grow = _grow_rule()                        # severity critical
+    shrink = _shrink_rule()                    # severity warning
+    plain = alerts.Rule(name="noact", metric="m",
+                        predicate="threshold", op=">", value=0.0)
+    eng = alerts.AlertEngine([shrink, plain, grow])
+    got = []
+    eng.action_sink = lambda actionable, now: got.append(
+        [e["rule"].name for e in actionable])
+    eng.evaluate(_gdoc("m", [({}, 0.5)]), now=100.0)
+    # 0.5 breaches both "idle" (< 1) and "noact" (> 0) but only rules
+    # WITH an action clause reach the sink
+    assert got == [["idle"]]
+    eng.evaluate(_gdoc("m", [({}, 5.0)]), now=101.0)
+    assert got[-1] == ["backlog"]              # critical grow fires
+    # a raising sink must never take down the evaluation pass
+    eng.action_sink = lambda actionable, now: 1 / 0
+    st = eng.evaluate(_gdoc("m", [({}, 5.0)]), now=102.0)
+    assert "backlog" in st["firing"]
+
+
+# ------------------------------------------------ policy, on a fake clock
+
+def _mk(actuators=None, fleet=None, state_path=None, **kw):
+    pt.core.flags.set_flag("controller", True)
+    holder = fleet if callable(fleet) else (lambda: fleet)
+    return ctrl_mod.Controller(fleet_fn=holder,
+                               actuators=actuators or {},
+                               state_path=state_path or "", **kw)
+
+
+def test_cooldown_bounds_decision_rate():
+    calls = []
+    c = _mk({"request_resize": lambda t, f, i: calls.append(t) or {}},
+            _fleet_doc(world=2))
+    rule = _grow_rule(cooldown=10, max_world=8)
+    assert c.consider([_ent(rule)], now=100.0)[0]["outcome"] == "applied"
+    for t in (101.0, 105.0, 109.9):            # inside the cooldown
+        assert c.consider([_ent(rule)], now=t) == []
+    assert c.consider([_ent(rule)], now=110.1)[0]["outcome"] == "applied"
+    assert len(calls) == 2
+    assert _counter("controller_skips_total", reason="cooldown") == 3
+
+
+def test_hysteresis_blocks_direction_reversal():
+    c = _mk({"request_resize": lambda t, f, i: {}}, _fleet_doc(world=4))
+    grow = _grow_rule(cooldown=1, hysteresis=30, max_world=8)
+    shrink = _shrink_rule(cooldown=1, hysteresis=30)
+    assert c.consider([_ent(grow)], now=100.0)[0]["outcome"] == "applied"
+    # reversal inside the hysteresis window: skipped, not clamped
+    assert c.consider([_ent(shrink)], now=110.0) == []
+    assert _counter("controller_skips_total", reason="hysteresis") == 1
+    dec = c.consider([_ent(shrink)], now=131.0)
+    assert dec and dec[0]["outcome"] == "applied"
+    assert dec[0]["direction"] == "shrink"
+
+
+def test_clamp_is_a_noop_decision_that_still_charges_cooldown():
+    calls = []
+    c = _mk({"request_resize": lambda t, f, i: calls.append(t) or {}},
+            _fleet_doc(world=1))
+    shrink = _shrink_rule(cooldown=10, min_world=1)
+    dec = c.consider([_ent(shrink, value=0.0)], now=100.0)
+    assert dec[0]["outcome"] == "clamped"
+    assert calls == []                          # actuator never ran
+    assert dec[0]["target_world"] == 1
+    # the clamped decision charged the cooldown: a rule pinned at a
+    # bound journals once per cooldown, it does not spam every tick
+    assert c.consider([_ent(shrink, value=0.0)], now=105.0) == []
+    assert _counter("controller_decisions_total",
+                    action="request_resize", outcome="clamped") == 1
+
+
+def test_proportional_step_scales_with_breach_and_caps():
+    seen = []
+    c = _mk({"request_resize": lambda t, f, i: seen.append(t) or {}},
+            _fleet_doc(world=2))
+    rule = _grow_rule(value=3.0, step=1, proportional=True, max_step=4,
+                      max_world=32, cooldown=1)
+    # observed 9 = 3x threshold -> step 3; world 2 -> target 5
+    c.consider([_ent(rule, value=9.0)], now=100.0)
+    assert seen[-1] == 5
+    # observed 60 = 20x threshold -> step capped at max_step 4
+    c.consider([_ent(rule, value=60.0)], now=102.0)
+    assert seen[-1] == 2 + 4
+
+
+def test_fence_rejection_counted_never_cooldown_charged():
+    fenced = {"n": 0}
+
+    def _resize(t, fence, i):
+        fenced["n"] += 1
+        return {"fenced": True}
+    c = _mk({"request_resize": _resize}, _fleet_doc(world=2))
+    rule = _grow_rule(cooldown=100, max_world=8)
+    dec = c.consider([_ent(rule)], now=100.0)
+    assert dec[0]["outcome"] == "fenced"
+    assert dec[0]["fence"] == {"generation": 1, "resizes": 0}
+    assert _counter("controller_fence_rejections_total") == 1
+    # a fenced outcome charges NO cooldown: the very next tick retries
+    # with a fresh token (the decision was never applied)
+    dec = c.consider([_ent(rule)], now=100.5)
+    assert dec[0]["outcome"] == "fenced" and fenced["n"] == 2
+
+
+def test_failure_backoff_breaker_degrade_and_reset():
+    def _drain():
+        raise RuntimeError("boom")
+    c = _mk({"drain": _drain}, _fleet_doc())
+    rule = alerts.Rule(name="d", metric="m", predicate="threshold",
+                       op=">", value=0.0,
+                       action=alerts.parse_action(
+                           {"kind": "drain", "cooldown": 1},
+                           "t", "threshold"))
+    # defaults: controller_backoff_s=5, breaker threshold 3
+    assert c.consider([_ent(rule)], now=100.0)[0]["outcome"] == "failed"
+    assert c.consider([_ent(rule)], now=101.0) == []   # backoff 5s
+    assert _counter("controller_skips_total", reason="backoff") == 1
+    assert c.consider([_ent(rule)], now=106.0)[0]["outcome"] == "failed"
+    with pytest.warns(RuntimeWarning, match="alert-only"):
+        dec = c.consider([_ent(rule)], now=120.0)      # 3rd strike
+    assert dec[0]["outcome"] == "failed"
+    assert c.degraded
+    assert obs_metrics.REGISTRY.get("controller_degraded").value == 1.0
+    # degraded = alert-only: NOTHING actuates, grow rules included
+    grow = _grow_rule(max_world=8)
+    assert c.consider([_ent(grow)], now=130.0) == []
+    assert _counter("controller_skips_total", reason="degraded") == 1
+    c.reset_breaker()
+    assert not c.degraded
+    assert c.consider([_ent(grow)], now=131.0)[0]["outcome"] \
+        == "no_actuator"
+
+
+def test_no_actuator_is_visible_not_silent():
+    c = _mk({}, _fleet_doc(world=2))
+    dec = c.consider([_ent(_grow_rule(max_world=8))], now=100.0)
+    assert dec[0]["outcome"] == "no_actuator"
+    assert _counter("controller_decisions_total",
+                    action="request_resize", outcome="no_actuator") == 1
+
+
+def test_state_persists_across_controller_restart(tmp_path):
+    sp = str(tmp_path / "state.json")
+    c = _mk({"request_resize": lambda t, f, i: {}},
+            _fleet_doc(world=2), state_path=sp)
+    rule = _grow_rule(cooldown=50, max_world=8)
+    c.consider([_ent(rule)], now=100.0)
+    assert os.path.exists(sp)
+    # a restarted coordinator resumes its cooldown clocks instead of
+    # instantly re-firing every still-held action
+    c2 = ctrl_mod.Controller(fleet_fn=lambda: _fleet_doc(world=3),
+                             actuators={"request_resize":
+                                        lambda t, f, i: {}},
+                             state_path=sp)
+    assert c2.consider([_ent(rule)], now=120.0) == []     # still held
+    dec = c2.consider([_ent(rule)], now=151.0)
+    assert dec and dec[0]["outcome"] == "applied"
+    assert dec[0]["decision_id"] == "helm-00002"          # seq resumed
+
+
+def test_corrupt_state_file_warns_and_starts_fresh(tmp_path):
+    sp = str(tmp_path / "state.json")
+    with open(sp, "w") as f:
+        f.write("{not json")
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        c = ctrl_mod.Controller(state_path=sp)
+    assert not c.degraded and c.status_doc()["seq"] == 0
+
+
+def test_single_flight_per_action_class():
+    pt.core.flags.set_flag("controller", True)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def _slow(t, f, i):
+        entered.set()
+        release.wait(5)
+        return {}
+    c = _mk({"request_resize": _slow}, _fleet_doc(world=2))
+    rule = _grow_rule(cooldown=0, max_world=8)
+    out = []
+    th = threading.Thread(target=lambda: out.extend(
+        c.consider([_ent(rule)], now=100.0)))
+    th.start()
+    assert entered.wait(5)
+    # a second decision for the same class while one is actuating is
+    # skipped, not queued behind the lock
+    assert c.consider([_ent(rule)], now=100.1) == []
+    assert _counter("controller_skips_total", reason="inflight") == 1
+    release.set()
+    th.join(5)
+    assert out and out[0]["outcome"] == "applied"
+
+
+# ------------------------------------------------ flag-off invariance
+
+def test_flag_off_is_invisible(tmp_path):
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps({"rules": [
+        {"name": "r", "metric": "m", "predicate": "threshold",
+         "op": ">", "value": 1,
+         "action": {"kind": "request_resize", "direction": "grow"}}]}))
+    pt.core.flags.set_flag("alert_rules_path", str(rules))
+    jp = tmp_path / "j.jsonl"
+    pt.core.flags.set_flag("journal_path", str(jp))
+    before = threading.active_count()
+    assert ctrl_mod.ensure_started(fleet_fn=lambda: _fleet_doc()) is None
+    assert ctrl_mod.get_controller() is None
+    doc = ctrl_mod.status_doc()
+    assert doc["enabled"] is False and doc["decisions"] == []
+    # no sink attaches: an enabled alert plane stays observe-only
+    eng = alerts.ensure_started()
+    assert eng is not None and eng.action_sink is None
+    eng.evaluate(_gdoc("m", [({}, 9.0)]), now=100.0)
+    assert _counter("controller_decisions_total") == 0
+    assert threading.active_count() <= before + 1   # alert ticker only
+    # no controller journal events, ever
+    journal_kinds = [json.loads(ln).get("kind")
+                     for ln in open(jp)] if jp.exists() else []
+    assert "controller" not in journal_kinds
+
+
+def test_controller_without_sensors_is_refused_loudly():
+    pt.core.flags.set_flag("controller", True)
+    pt.core.flags.set_flag("alert_rules_path", "")
+    with pytest.warns(RuntimeWarning, match="no sensor"):
+        assert ctrl_mod.ensure_started() is None
+
+
+# ------------------------------------------------ satellite: storms
+
+def test_resize_storm_coalesces_to_one_pending_target(tmp_path):
+    m = TaskMaster(snapshot_path=str(tmp_path / "s.json"),
+                   num_epochs=2, world_size=2)
+    m.set_dataset([f"sh-{i}" for i in range(4)])    # mid-epoch: pends
+    targets = [3, 4, 5, 6, 7, 8]
+    barrier = threading.Barrier(len(targets))
+
+    def _storm(n):
+        barrier.wait()
+        m.request_resize(n)
+    ths = [threading.Thread(target=_storm, args=(n,)) for n in targets]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    st = m.stats()
+    # N racing clients coalesce to ONE pending target (last write
+    # wins); nothing applied mid-epoch, the log stays empty
+    assert st["pending_world_size"] in targets
+    assert st["resizes"] == 0 and st["resize_log"] == []
+
+
+def test_fenced_resize_storm_applies_exactly_once(tmp_path):
+    m = TaskMaster(snapshot_path=str(tmp_path / "s.json"),
+                   num_epochs=1, world_size=2)
+    m.extend_dataset(["sh-0"])                  # non-idle: immediate path
+    st = m.stats()
+    fence = {"generation": st["generation"], "resizes": st["resizes"]}
+    replies = []
+    barrier = threading.Barrier(6)
+
+    def _storm(n):
+        barrier.wait()
+        replies.append(m.request_resize(n, fence=fence, immediate=True))
+    ths = [threading.Thread(target=_storm, args=(3 + i,))
+           for i in range(6)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    applied = [r for r in replies if r["applied"]]
+    fenced = [r for r in replies if r["fenced"]]
+    # everyone raced with the SAME fence token: exactly one decision
+    # applied, every other one rejected — never coalesced into a
+    # second apply
+    assert len(applied) == 1 and len(fenced) == 5
+    log = m.stats()["resize_log"]
+    assert len(log) == 1 and log[0]["old"] == 2
+    # monotonic chain under further fenced resizes
+    for target in (6, 1, 4):
+        st = m.stats()
+        m.request_resize(target, fence={"generation": st["generation"],
+                                        "resizes": st["resizes"]},
+                         immediate=True)
+    log = m.stats()["resize_log"]
+    assert [e["old"] for e in log[1:]] == [e["new"] for e in log[:-1]]
+
+
+def test_streaming_extend_after_valley_stays_epoch_zero(tmp_path):
+    m = TaskMaster(snapshot_path=str(tmp_path / "s.json"),
+                   num_epochs=1, world_size=1)
+    m.extend_dataset(["sh-0"])
+    t = m.get_task(worker=0)
+    assert t.epoch == 0
+    m.task_finished(t.task_id, lease=t.lease, worker=0)
+    assert not m.complete                       # unsealed: more may come
+    # the queue momentarily drained (a traffic valley) — a new arrival
+    # must still join epoch 0, not a phantom epoch 1
+    m.extend_dataset(["sh-1"])
+    t = m.get_task(worker=0)
+    assert t.epoch == 0
+    m.task_finished(t.task_id, lease=t.lease, worker=0)
+    m.extend_dataset([], final=True)            # end of stream
+    assert m.complete
+    assert sorted(e["task_id"] for e in m.ledger_entries()) == [0, 1]
+    assert {e["epoch"] for e in m.ledger_entries()} == {0}
+
+
+# ------------------------------------------------ satellite: journal
+
+def test_journal_reserved_field_collision_warns_and_counts(tmp_path):
+    pt.core.flags.set_flag("journal_path", str(tmp_path / "j.jsonl"))
+    with pytest.warns(RuntimeWarning, match="reserved"):
+        rec = journal.emit("test", "collide", rank=5, payload=7)
+    assert rec["rank"] == 0                     # envelope value kept
+    assert rec["payload"] == 7                  # honest field kept
+    assert _counter("journal_field_collisions_total", field="rank") == 1
+    # warn once per site, count always
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        journal.emit("test", "collide", rank=6)
+    assert _counter("journal_field_collisions_total", field="rank") == 2
+
+
+# ------------------------------------------------ satellite: supervisor
+
+def test_supervisor_warns_when_backoff_outruns_death_declaration():
+    with pytest.warns(RuntimeWarning, match="declares it dead"):
+        Supervisor(cmds=[["true"]], worker_timeout=1.0)
+    # a backoff slower than timeout + reaper tick is fine
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        Supervisor(cmds=[["true"]], worker_timeout=1.0,
+                   backoff=rretry.RetryPolicy(name="t", max_attempts=1,
+                                              base_delay=2.0,
+                                              max_delay=2.0))
+        # and the silent default stays silent: nothing consumes death
+        # declarations (no explicit timeout, no alert plane/controller)
+        Supervisor(cmds=[["true"]])
+    # an enabled controller implies a consumer -> the flag-derived
+    # timeout is checked too
+    pt.core.flags.set_flag("controller", True)
+    with pytest.warns(RuntimeWarning, match="revive path"):
+        Supervisor(cmds=[["true"]])
+
+
+def test_supervisor_revive_respawns_parked_ranks_now():
+    sup = Supervisor(cmds=[[sys.executable, "-c", "pass"]],
+                     backoff=rretry.RetryPolicy(name="t",
+                                                max_attempts=1,
+                                                base_delay=9.0,
+                                                max_delay=9.0))
+    try:
+        with sup._lock:
+            sup._state[0] = "retired"
+        assert sup.revive(ranks=[5]) == []      # outside the world
+        assert sup.revive() == [0]
+        with sup._lock:
+            assert sup._state[0] == "restarting"
+            assert sup._restart_at[0] == 0.0    # no backoff wait
+    finally:
+        sup.stop()
+
+
+# ------------------------------------------------ HTTP surface
+
+def test_http_controller_route_and_drain_503(tmp_path):
+    srv = obs_server.start_http_server(port=0)
+    try:
+        with urllib.request.urlopen(srv.url + "/controller",
+                                    timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["schema"] == ctrl_mod.SCHEMA
+        assert doc["enabled"] is False and doc["decisions"] == []
+        # drain with no serving batcher attached is a 503 — the remote
+        # actuator failure the controller's breaker counts
+        req = urllib.request.Request(srv.url + "/serving/drain",
+                                     data=b"{}", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+    finally:
+        obs_server.stop_http_server()
+
+
+# ------------------------------------------------ incident --decision
+
+def test_incident_decision_selector(tmp_path, capsys):
+    T = 1700000000.0
+    p = str(tmp_path / "j.jsonl")
+    tid = "9f" * 16
+    with open(p, "w") as f:
+        for e in [
+            {"kind": "alert", "event": "fire", "time_unix": T + 1.0,
+             "rank": 0, "pid": 1, "seq": 1, "rule": "backlog",
+             "trace_id": tid},
+            {"kind": "controller", "event": "decision",
+             "time_unix": T + 1.5, "rank": 0, "pid": 1, "seq": 2,
+             "decision_id": "helm-00007", "rule": "backlog",
+             "action": "request_resize", "direction": "grow",
+             "outcome": "applied", "alert_trace_id": tid},
+            {"kind": "master", "event": "resize_applied",
+             "time_unix": T + 1.8, "rank": 0, "pid": 1, "seq": 3,
+             "old_world": 2, "new_world": 4, "trace_id": tid},
+            {"kind": "worker", "event": "step", "time_unix": T + 900.0,
+             "rank": 0, "pid": 1, "seq": 4},
+        ]:
+            f.write(json.dumps({"schema": journal.SCHEMA, **e}) + "\n")
+    events, hist = incident.gather_events([p])
+    t0, t1, sel = incident.resolve_window(events, hist,
+                                          decision="helm-00007", pad=1.0)
+    doc = incident.build_report(events, hist, t0, t1, sel)
+    names = [(e["kind"], e["event"]) for e in doc["timeline"]]
+    # the decision joins its alert (via alert_trace_id) and the resize
+    # it caused into one timeline; the unrelated late event stays out
+    assert ("controller", "decision") in names
+    assert ("alert", "fire") in names
+    assert ("master", "resize_applied") in names
+    assert ("worker", "step") not in names
+    assert sel["decision_id"] == "helm-00007"
+    assert sel["outcome"] == "applied"
+    with pytest.raises(ValueError, match="no journal"):
+        incident.resolve_window(events, hist, decision="helm-99999")
+    # CLI: selector renders, mutual exclusion holds, self-test passes
+    assert incident.main([p, "--decision", "helm-00007"]) == 0
+    out = capsys.readouterr().out
+    assert "helm-00007" in out and "resize_applied" in out
+    assert incident.main([p, "--decision", "x", "--alert", "y"]) == 2
+    assert incident.main(["--self-test"]) == 0
+
+
+# ------------------------------------------------ closed loop e2e
+
+def test_schedule_registry_covers_controller_lanes():
+    assert {"controller", "controller_ramp",
+            "controller_chaos"} <= set(soak.SCHEDULES)
+    for name in ("controller", "controller_ramp", "controller_chaos"):
+        assert name in soak._CONTROLLER_PROFILES
+
+
+def test_controller_soak_fleet_scales_itself(tmp_path):
+    """Tier-1 miniature of the ISSUE 17 headline: an arrival trace
+    oversubscribes a 1-rank fleet; the controller grows it off the
+    backlog rule, the valley shrinks it back, every resize in the
+    master's log maps 1:1 to an applied controller decision (zero
+    human resizes), and the exactly-once ledger holds across the
+    controller's own resizes."""
+    rep = soak.run_schedule(str(tmp_path), "controller", timeout=90)
+    assert rep["ok"], rep["problems"]
+    assert rep["grows"] >= 1 and rep["shrinks"] >= 1
+    assert rep["resizes_applied"] == len(
+        [d for d in rep["decisions"]
+         if d["action"] == "request_resize"
+         and d["outcome"] == "applied"])
+    assert rep["stats"]["complete"]
+    # anti-flap: applied+clamped resize decisions respect the cooldown
+    charged = [d for d in rep["decisions"]
+               if d["outcome"] in ("applied", "clamped")]
+    assert len(charged) <= rep["duration_s"] / 1.0 + 2
+
+
+@pytest.mark.slow
+def test_controller_ramp_and_chaos_soaks(tmp_path):
+    """The two slow Helmsman lanes end-to-end.  Ramp: two full
+    load/valley cycles; SLO holds (p99 sojourn under the serving
+    budget) AND the elastic fleet beats the static max-world baseline
+    on chip-seconds.  Chaos: the coordinator dies between a decision's
+    fence cut and its actuation (fence REJECTED, retried — never
+    double-applied), rank 0 is chaos-killed mid-run, and a broken
+    drain actuator trips the circuit breaker into alert-only mode."""
+    ramp = soak.run_schedule(str(tmp_path / "ramp"), "controller_ramp",
+                             timeout=110)
+    assert ramp["ok"], ramp["problems"]
+    assert ramp["grows"] >= 2 and ramp["shrinks"] >= 2
+    assert ramp["p99_sojourn_ms"] < 15000.0
+    assert ramp["chip_seconds"] < ramp["chip_seconds_baseline"]
+    chaos = soak.run_schedule(str(tmp_path / "chaos"),
+                              "controller_chaos", timeout=110)
+    assert chaos["ok"], chaos["problems"]
+    assert chaos["fence_rejections"] >= 1
+    assert chaos["resizes_applied"] == len(
+        [d for d in chaos["decisions"]
+         if d["action"] == "request_resize"
+         and d["outcome"] == "applied"])
+    assert chaos["restarts"][0] >= 1 and chaos["generation"] >= 2
+    assert chaos["degraded"]
