@@ -197,6 +197,12 @@ def _attach_cost(row, exe, prog, feed, fetch, dt, analytic=None):
             flops = f
             bytes_accessed = float(c.get("bytes_accessed") or 0.0)
             row["cost_source"] = c.get("source")
+        peak_hbm = float(c.get("peak_hbm_bytes") or 0.0)
+        if peak_hbm > 0:
+            # the memory half of the record: bench_gate --trend treats
+            # any *_bytes metric as lower-is-better, so a peak-HBM
+            # regression is a named gate failure like a bound flip
+            row["peak_hbm_bytes"] = peak_hbm
     except Exception:
         pass
     if flops is None and analytic:
@@ -797,7 +803,10 @@ def _record_row_metrics(row):
                              "row's loadgen run (ms)."),
                             ("ttft_p99_ms",
                              "p99 time-to-first-token of the row's "
-                             "loadgen run (ms).")):
+                             "loadgen run (ms)."),
+                            ("peak_hbm_bytes",
+                             "Cost-model peak HBM bytes of the row's "
+                             "compiled program.")):
         if row.get(field) is not None:
             obs.gauge(f"bench_{field}", help_str, ("metric",)).labels(
                 metric=row["metric"]).set(row[field])
@@ -904,7 +913,8 @@ def _compact_line(rows, errors):
     summary = {}
     for r in rows:
         s = {"value": r["value"]}
-        for k in ("mfu", "tflops", "vs_baseline", "bound"):
+        for k in ("mfu", "tflops", "vs_baseline", "bound",
+                  "peak_hbm_bytes"):
             if r.get(k) is not None:
                 s[k] = r[k]
         summary[r["metric"]] = s

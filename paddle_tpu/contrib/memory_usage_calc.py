@@ -22,7 +22,7 @@ import numpy as np
 from ..analysis import traversal
 from ..core.dtypes import convert_dtype
 
-__all__ = ["memory_usage"]
+__all__ = ["memory_usage", "memory_usage_bytes", "cross_check"]
 
 _DTYPE_SIZE = {
     "bool": 1, "int8": 1, "uint8": 1, "int16": 2, "float16": 2,
@@ -41,12 +41,10 @@ def _var_bytes(var, batch_size: int) -> int:
         convert_dtype(var.dtype), 4)
 
 
-def memory_usage(program, batch_size: int):
-    """Estimate memory for `program` at `batch_size`.
-
-    Returns (min_usage, max_usage, unit_str): the persistable floor and
-    the persistable + total-activation ceiling, in the largest unit
-    that keeps max_usage >= 1."""
+def memory_usage_bytes(program, batch_size: int):
+    """Raw-bytes variant of :func:`memory_usage`: returns
+    (persistable_bytes, activation_bytes) unscaled — what the memscope
+    cross-check joins against the cost model."""
     if batch_size <= 0:
         raise ValueError(f"batch_size must be positive, got {batch_size}")
     persist = acts = 0
@@ -58,8 +56,66 @@ def memory_usage(program, batch_size: int):
             persist += b
         else:
             acts += b
+    return persist, acts
+
+
+def memory_usage(program, batch_size: int):
+    """Estimate memory for `program` at `batch_size`.
+
+    Returns (min_usage, max_usage, unit_str): the persistable floor and
+    the persistable + total-activation ceiling, in the largest unit
+    that keeps max_usage >= 1."""
+    persist, acts = memory_usage_bytes(program, batch_size)
     lo, hi = float(persist), float(persist + acts)
     for scale, unit in _UNITS:
         if hi >= scale:
             return lo / scale, hi / scale, unit
     return lo, hi, "B"
+
+
+def cross_check(program, batch_size: int, cost, tolerance: float = 8.0):
+    """Join this static walk with the cost model's per-component
+    memory_bytes view of the SAME program (Executor.explain's ``cost``
+    dict, or a ProgramCost) and verdict each comparison within a
+    factor-``tolerance`` band (log-scale: ok iff 1/t <= static/model
+    <= t).
+
+    Two comparisons, each a row naming its component:
+
+      * ``persistable_vs_argument``: the persistable floor against the
+        cost model's argument_bytes.  Arguments carry the persistable
+        state INTO the step (plus feeds, plus donated doubles under
+        the analytic fallback), so these agree within a small factor.
+      * ``ceiling_vs_peak``: persistable + total activations against
+        peak_hbm_bytes.  The static ceiling counts EVERY intermediate
+        var while XLA's liveness frees/fuses aggressively, so the band
+        absorbs an op-count-shaped gap — the default factor 8 is the
+        documented tolerance (tests assert with it).
+
+    Returns {"ok": bool, "rows": [...], "diverging": [component...]}
+    — the diverging list names what drifted, for the test failure
+    message and the parity table."""
+    if hasattr(cost, "to_dict"):
+        cost = cost.to_dict()
+    persist, acts = memory_usage_bytes(program, batch_size)
+    rows = []
+
+    def row(component, static_b, model_b):
+        static_b, model_b = float(static_b), float(model_b or 0.0)
+        if static_b > 0 and model_b > 0:
+            ratio = static_b / model_b
+            ok = (1.0 / tolerance) <= ratio <= tolerance
+        else:
+            # degenerate programs (no persistables / zero-cost): no
+            # signal either way — don't fail the check on them
+            ratio, ok = None, True
+        rows.append({"component": component, "static_bytes": static_b,
+                     "model_bytes": model_b, "ratio": ratio, "ok": ok})
+
+    row("persistable_vs_argument", persist,
+        (cost or {}).get("argument_bytes"))
+    row("ceiling_vs_peak", persist + acts,
+        (cost or {}).get("peak_hbm_bytes"))
+    diverging = [r["component"] for r in rows if not r["ok"]]
+    return {"ok": not diverging, "tolerance": tolerance, "rows": rows,
+            "diverging": diverging}

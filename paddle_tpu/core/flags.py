@@ -279,6 +279,43 @@ define_flag("perf_ici_gbps", 0.0,
             "a ~45 GB/s ICI figure; other backends fall back to a "
             "documented 10 GB/s prior.")
 
+# --- memory attribution (observability/memscope.py) ------------------------
+define_flag("memscope", False,
+            "Live-HBM attribution engine (observability/memscope.py): "
+            "census over jax.live_arrays() + device memory_stats "
+            "attributing resident bytes per owner plane (params, "
+            "optimizer state, serving KV slabs, sparse tables, "
+            "jit-cache executables, feeds) into mem_resident_bytes"
+            "{plane}; per-program predicted-vs-measured peak "
+            "reconciliation (mem_peak_ratio); KV-cache occupancy "
+            "accounting (serving_kv_*); OOM forensics at the "
+            "memory.alloc chaos site and the built-in hbm_pressure "
+            "Watchtower rule.  Off: byte-identical outputs and "
+            "compile keys, zero step-path work.")
+define_flag("memscope_interval", 0.0,
+            "Census ticker period in seconds: > 0 starts one bounded "
+            "daemon thread sampling the census between step/dispatch "
+            "boundaries.  0 (default) samples only at boundaries.")
+define_flag("memscope_topk", 8,
+            "Top-N fattest live buffers kept in the census doc, the "
+            "OOM flight bundle, and the CLI report.")
+define_flag("memscope_pressure_fraction", 0.9,
+            "hbm_pressure trip point: the built-in alert fires when "
+            "mem_pressure_fraction (used/limit, max over devices) "
+            "holds at or above this value.  <= 0 disables the rule.")
+define_flag("memscope_hbm_limit_bytes", 0,
+            "Device memory budget used for the pressure fraction.  "
+            "0 = auto from Device.memory_stats()['bytes_limit'] (TPU); "
+            "backends without allocator stats (CPU) report no "
+            "pressure unless this is set explicitly.")
+define_flag("memscope_ratio_factor", 8.0,
+            "Predicted-vs-measured acceptance band: a program's "
+            "mem_peak_ratio (measured high-water / cost-model "
+            "peak_hbm_bytes) gets verdict 'ok' iff it lies within "
+            "[1/factor, factor].  The wide default absorbs the "
+            "analytic cost fallback double-counting donated state "
+            "on backends without compiled HLO cost analysis.")
+
 # --- resilience plane (resilience/: chaos, guard, retry) -------------------
 define_flag("chaos_spec", "",
             "Deterministic fault-injection spec, "

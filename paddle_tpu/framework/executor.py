@@ -1268,6 +1268,23 @@ class Executor:
             root = jax.random.fold_in(root, counter)
         compiled.note_abs_args(state, dev_feeds, root)
 
+        # chaos site: a simulated RESOURCE_EXHAUSTED at the dispatch
+        # allocation (docs/RESILIENCE.md catalog).  Memscope, when on,
+        # freezes the census + the triggering program's cost row into
+        # a flight bundle before the fault propagates to the caller.
+        try:
+            chaos.trigger("memory.alloc")
+        except chaos.InjectedFault:
+            from ..observability import memscope as obs_memscope
+            if obs_memscope.enabled():
+                mcost = compiled.cost(prefer_analytic=True)
+                obs_memscope.note_alloc_failure(
+                    "executor.run",
+                    label=(mcost.label if mcost is not None else
+                           f"p{program._uid}.v{program._version}.step"),
+                    cost=mcost)
+            raise
+
         profile_ops = bool(flags.get_flag("profile_ops"))
         with RecordEvent(f"executor.run#{len(compiled.fetch_names)}f"):
             t0 = time.perf_counter()
@@ -1330,6 +1347,23 @@ class Executor:
 
         for n, v in new_state.items():
             scope.set_var(n, v)
+
+        from ..observability import memscope as obs_memscope
+        if obs_memscope.enabled():
+            # dispatch-boundary census (AFTER the scope write-back, so
+            # the live new-state arrays attribute to params/optimizer
+            # planes, not "other") + predicted-vs-measured peak
+            # reconciliation off the same cached analytic cost view
+            mcost = compiled.cost(prefer_analytic=True)
+            try:
+                feed_b = sum(int(getattr(v, "nbytes", 0) or 0)
+                             for v in dev_feeds.values())
+            except Exception:
+                feed_b = 0
+            obs_memscope.note_dispatch(
+                mcost.label if mcost is not None
+                else f"p{program._uid}.v{program._version}.step",
+                mcost, feed_bytes=feed_b, scope=scope)
 
         if want_stats:
             # pop the reserved stats fetch back off before the caller
@@ -1751,7 +1785,8 @@ class Executor:
                 feed: Optional[Dict[str, Any]] = None,
                 fetch_list: Optional[Sequence] = None,
                 scope: Optional[Scope] = None,
-                perf: bool = False) -> dict:
+                perf: bool = False,
+                memory: bool = False) -> dict:
         """Cost/memory report for the compiled program this
         (program, feed, fetch_list) resolves to — compiling it if
         needed, WITHOUT running it or consuming RNG state.
@@ -1822,11 +1857,19 @@ class Executor:
                 "dispatches": prior.get("count", 0),
                 "total_seconds": prior.get("total_s", 0.0),
             }}
+        # memory section: same contract — present ONLY when the caller
+        # asked AND the memscope flag is on (predicted-vs-measured peak
+        # reconciliation + the current plane census)
+        mem_doc = {}
+        from ..observability import memscope as obs_memscope
+        if memory and obs_memscope.enabled() and cost is not None:
+            mem_doc = {"memory": obs_memscope.explain_section(cost)}
         return {
             "schema": "paddle_tpu.explain.v1",
             **analysis_doc,
             **jc_doc,
             **perf_doc,
+            **mem_doc,
             "program": {"uid": program._uid,
                         "version": program._version,
                         "ops": len(compiled._ops),

@@ -129,32 +129,12 @@ _mem_stats = metrics.gauge(
 
 def record_device_memory() -> int:
     """Sample device-memory occupancy into the registry; returns the
-    live-bytes figure.  Uses jax.live_arrays() (always available) plus
-    Device.memory_stats() where the backend provides it (TPU does;
-    CPU returns None)."""
-    import jax
+    live-bytes figure.  Since the memscope PR this delegates to
+    memscope.sample() — ONE measurement path: the legacy
+    device_memory_* watermark gauges above always publish, and the
+    per-plane census rides the same walk when the memscope flag is on.
+    (Lazy import: memscope has a ``python -m`` CLI, and eager
+    package-graph imports trip runpy's sys.modules warning.)"""
+    from . import memscope
 
-    if not metrics.enabled():
-        return 0
-    live = 0
-    for a in jax.live_arrays():
-        try:
-            live += a.nbytes
-        except Exception:       # deleted/donated arrays race the walk
-            pass
-    _mem_live.set(live)
-    if live > _mem_peak.value:
-        _mem_peak.set(live)
-    for d in jax.local_devices():
-        stats = None
-        try:
-            stats = d.memory_stats()
-        except Exception:
-            pass
-        if not stats:
-            continue
-        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-            if key in stats:
-                _mem_stats.labels(device=str(d.id), stat=key).set(
-                    stats[key])
-    return live
+    return memscope.sample(reason="boundary")

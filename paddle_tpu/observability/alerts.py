@@ -455,6 +455,19 @@ def default_rules() -> List[Rule]:
                       "its frozen baseline (perfscope context names "
                       "the phase + an exemplar trace id)",
           context_fn=perfscope.alert_context)
+    # memscope HBM pressure: same gated idiom — the context_fn names
+    # the fattest owner plane, which the scalar pressure gauge cannot
+    from . import memscope
+    pfrac = float(flags.get_flag("memscope_pressure_fraction"))
+    if memscope.enabled() and pfrac > 0.0:
+        r(name="hbm_pressure",
+          metric="mem_pressure_fraction", predicate="threshold",
+          op=">=", value=pfrac, for_seconds=1.0, severity="critical",
+          description="device memory used/limit held at or above "
+                      "memscope_pressure_fraction — the next "
+                      "allocation is an OOM candidate (memscope "
+                      "context names the fattest plane and top owner)",
+          context_fn=memscope.alert_context)
     return out
 
 

@@ -68,7 +68,11 @@ def load_metric_values(doc: dict) -> Dict[str, float]:
 
 
 def lower_is_better(metric: str) -> bool:
-    return metric.endswith("_ms_per_batch") or metric.endswith("_seconds")
+    # *_bytes: memory footprints (bench_peak_hbm_bytes and friends) —
+    # a new release using MORE HBM is a regression, not an improvement
+    return (metric.endswith("_ms_per_batch")
+            or metric.endswith("_seconds")
+            or metric.endswith("_bytes"))
 
 
 def load_trend_record(doc: dict) -> Dict[str, dict]:
@@ -89,16 +93,22 @@ def load_trend_record(doc: dict) -> Dict[str, dict]:
                 out[m] = {"value": float(row["value"]),
                           "mfu": row.get("mfu"),
                           "bound": row.get("bound")}
+                # pre-Memscope summaries carry no peak: keep their
+                # loaded shape unchanged, key present only when dumped
+                if row.get("peak_hbm_bytes") is not None:
+                    out[m]["peak_hbm_bytes"] = row["peak_hbm_bytes"]
             else:
                 out[m] = {"value": float(row), "mfu": None,
-                          "bound": None}
+                          "bound": None, "peak_hbm_bytes": None}
         return out
     if "metric" in doc and "value" in doc:
         # pre-summary driver records (BENCH_r01): one row at top level
-        return {str(doc["metric"]): {"value": float(doc["value"]),
-                                     "mfu": doc.get("mfu"),
-                                     "bound": doc.get("bound")}}
-    return {m: {"value": v, "mfu": None, "bound": None}
+        return {str(doc["metric"]): {
+            "value": float(doc["value"]), "mfu": doc.get("mfu"),
+            "bound": doc.get("bound"),
+            "peak_hbm_bytes": doc.get("peak_hbm_bytes")}}
+    return {m: {"value": v, "mfu": None, "bound": None,
+                "peak_hbm_bytes": None}
             for m, v in load_metric_values(doc).items()}
 
 
@@ -158,6 +168,19 @@ def trend(records: List, tolerance: float = 0.15,
             if (newest.get(metric) or {}).get("mfu") is None:
                 mrow["status"] = "missing"
             rows.append(mrow)
+        if any((rec.get(metric) or {}).get("peak_hbm_bytes") is not None
+               for _, rec in records):
+            # memory subseries: the "_bytes" suffix routes through the
+            # lower-is-better rule, so a fatter peak is a named
+            # regression exactly like a slower step
+            hseries = [(name,
+                        (rec.get(metric) or {}).get("peak_hbm_bytes"))
+                       for name, rec in records]
+            hrow = row_for(f"{metric}.peak_hbm_bytes", hseries,
+                           lower_is_better("peak_hbm_bytes"), "bytes")
+            if (newest.get(metric) or {}).get("peak_hbm_bytes") is None:
+                hrow["status"] = "missing"
+            rows.append(hrow)
         bounds = [(name, (rec.get(metric) or {}).get("bound"))
                   for name, rec in records]
         known = [(n, b) for n, b in bounds if b]

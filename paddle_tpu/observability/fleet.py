@@ -760,6 +760,17 @@ class FleetAggregator:
         return {str(r): obs_perfscope.rows_from_metrics_doc(doc)
                 for r, doc in sorted(docs.items())}
 
+    def mem_rows(self) -> Dict[str, dict]:
+        """Per-rank census rows reconstructed from each worker's last
+        shipped metric snapshot (mem_*/serving_kv_* gauge families) —
+        the fleet-merged half of GET /memory."""
+        from . import memscope as obs_memscope
+        with self._lock:
+            docs = {r: w.get("metrics") for r, w in self._workers.items()
+                    if isinstance(w.get("metrics"), dict)}
+        return {str(r): obs_memscope.rows_from_metrics_doc(doc)
+                for r, doc in sorted(docs.items())}
+
     def health(self) -> dict:
         """Liveness summary for /healthz: per-worker report age, stale
         set, straggler set, and the fleet degraded verdict."""

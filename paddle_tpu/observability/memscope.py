@@ -1,0 +1,797 @@
+"""Memscope: live HBM attribution, OOM forensics, KV occupancy.
+
+The memory tier's sensing layer (the role perfscope plays for time):
+the reference framework ships memory_optimization_transpiler and
+contrib/memory_usage_calc but never *observes* residency — this module
+closes that loop with four instruments, all behind the ``memscope``
+flag (default off: byte-identical outputs and compile keys, zero
+step-path work — the invariance idiom shared with tensorstats/
+perfscope/journal):
+
+  census      jax.live_arrays() + Device.memory_stats() walked at step/
+              dispatch boundaries (and an optional bounded ticker),
+              attributing resident bytes per owner plane — params,
+              optimizer_state, serving_kv, sparse_tables,
+              jit_executables, executor_feeds, other — into
+              mem_resident_bytes{plane} / mem_device_used_bytes /
+              mem_device_free_bytes / mem_pressure_fraction.
+  reconcile   per compiled program, the cost model's predicted
+              peak_hbm_bytes joined with the measured high-water mark:
+              mem_peak_ratio{program} + a drift verdict surfaced by
+              Executor.explain(memory=True).
+  kv ledger   DecodeEngine slab occupancy: reserved-vs-written
+              positions per slot (and per prompt bucket) →
+              serving_kv_reserved_bytes / serving_kv_written_bytes /
+              serving_kv_waste_fraction — the number that makes the
+              paged-KV case (ROADMAP item 1) quantitatively.
+  forensics   the memory.alloc chaos site (simulated
+              RESOURCE_EXHAUSTED at executor/serving dispatch) dumps a
+              flight bundle carrying the census + top-K owners + the
+              triggering program's cost row, journals a
+              memory/alloc_failure event for ``incident``, and the
+              built-in hbm_pressure Watchtower rule names the fattest
+              plane in its context.
+
+Satellite contract: observability.record_device_memory() (the PR 1
+trainer watermark path) delegates to sample() here, so the legacy
+device_memory_* gauges and the census are ONE measurement path — the
+old names stay valid for dashboards and runlogs.
+
+CLI: ``python -m paddle_tpu.observability.memscope`` (top-N owners,
+--doc, --self-test).  HTTP: GET /memory (fleet-merged per rank).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from ..core import flags
+from . import flight
+from . import journal
+from . import metrics
+
+SCHEMA = "paddle_tpu.mem.v1"
+
+# Same metric objects observability/__init__.py registered at import
+# (the registry returns the existing instance for a same-shape name):
+# the legacy watermark names keep publishing from the unified path.
+_m_live = metrics.gauge(
+    "device_memory_live_bytes",
+    "Bytes held by live jax.Arrays on this process's devices.")
+_m_peak = metrics.gauge(
+    "device_memory_peak_bytes",
+    "High-watermark of device_memory_live_bytes within this process.")
+_m_stats = metrics.gauge(
+    "device_memory_stats_bytes",
+    "Allocator stats per device (when the backend reports them).",
+    ("device", "stat"))
+
+_m_resident = metrics.gauge(
+    "mem_resident_bytes",
+    "Census: resident bytes attributed to one owner plane.", ("plane",))
+_m_used = metrics.gauge(
+    "mem_device_used_bytes",
+    "Census: allocator bytes_in_use per device (live bytes on "
+    "backends without allocator stats).", ("device",))
+_m_free = metrics.gauge(
+    "mem_device_free_bytes",
+    "Census: device budget minus used bytes (needs a bytes_limit "
+    "stat or the memscope_hbm_limit_bytes flag).", ("device",))
+_m_pressure = metrics.gauge(
+    "mem_pressure_fraction",
+    "Max over devices of used/limit — what the built-in hbm_pressure "
+    "alert watches.")
+_m_ratio = metrics.gauge(
+    "mem_peak_ratio",
+    "Measured high-water bytes / cost-model predicted peak_hbm_bytes "
+    "per compiled program.", ("program",))
+_m_kv_reserved = metrics.gauge(
+    "serving_kv_reserved_bytes",
+    "KV slab bytes reserved by active decode slots (active_slots x "
+    "max_len worth of positions).")
+_m_kv_written = metrics.gauge(
+    "serving_kv_written_bytes",
+    "KV slab bytes actually written (sum of active slot lengths).")
+_m_kv_waste = metrics.gauge(
+    "serving_kv_waste_fraction",
+    "1 - written/reserved over active decode slots: the worst-case "
+    "over-reservation a paged KV cache would reclaim.")
+_m_kv_bucket = metrics.gauge(
+    "serving_kv_bucket_waste_fraction",
+    "Per prompt-bucket KV waste fraction.", ("bucket",))
+
+# Optimizer accumulators are named "{opt}.{param}.{acc}" (see
+# optimizer.py _add_accumulator) — these substrings split the
+# executor-scope plane into params vs optimizer_state.
+_OPT_MARKERS = ("velocity", "moment", "_pow", "grad_acc", "mean_square")
+
+_lock = threading.RLock()
+_state: Dict[str, Any] = {}
+_programs: Dict[str, Dict[str, Any]] = {}
+# Providers survive reset() on purpose: engines/shards register once at
+# construction, and conftest resets between tests while module-scoped
+# fixtures live on.  WeakSets drop dead providers automatically.
+_kv_engines: "weakref.WeakSet" = weakref.WeakSet()
+_sparse_shards: "weakref.WeakSet" = weakref.WeakSet()
+# Scopes seen at dispatch boundaries: the Trainer (and any caller of
+# Executor(scope=...)) runs against a PRIVATE scope, not the global
+# one — without tracking these the census would file its params under
+# "other".
+_scopes: "weakref.WeakSet" = weakref.WeakSet()
+_ticker: Optional[threading.Thread] = None
+_ticker_stop: Optional[threading.Event] = None
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("memscope"))
+
+
+# --- provider registry -----------------------------------------------------
+
+def register_kv_engine(engine) -> None:
+    """Called by DecodeEngine.__init__ (construction-time, not step
+    path): lets the census claim the engine's KV slabs."""
+    try:
+        _kv_engines.add(engine)
+    except TypeError:
+        pass
+
+
+def register_sparse_shard(shard) -> None:
+    """Called by sparse EmbeddingShard.__init__: host-side table bytes
+    join the census as the sparse_tables plane."""
+    try:
+        _sparse_shards.add(shard)
+    except TypeError:
+        pass
+
+
+# --- the census ------------------------------------------------------------
+
+def sample(reason: str = "tick") -> int:
+    """The unified device-memory measurement path.  Always publishes
+    the legacy device_memory_* watermark gauges (what
+    observability.record_device_memory() did since PR 1); when the
+    memscope flag is on, additionally attributes the live set per
+    owner plane and refreshes the mem_* gauges.  Returns live bytes."""
+    import jax
+
+    if not metrics.enabled():
+        return 0
+    live = 0
+    arrays: List[Any] = []
+    for a in jax.live_arrays():
+        try:
+            nb = int(a.nbytes)
+        except Exception:       # deleted/donated arrays race the walk
+            continue
+        live += nb
+        arrays.append((nb, a))
+    _m_live.set(live)
+    if live > _m_peak.value:
+        _m_peak.set(live)
+    device_stats: Dict[str, dict] = {}
+    for d in jax.local_devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        if not stats:
+            continue
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                _m_stats.labels(device=str(d.id), stat=key).set(stats[key])
+        device_stats[str(d.id)] = stats
+    if enabled():
+        _census(arrays, live, device_stats, reason)
+        start_ticker()
+    return live
+
+
+def _scope_claims() -> Dict[int, tuple]:
+    """id(array) -> (plane, name) for every executor-scope var (the
+    global scope plus every private scope seen at a dispatch
+    boundary), split params vs optimizer_state by accumulator
+    naming."""
+    claims: Dict[int, tuple] = {}
+    scopes = []
+    try:
+        from ..framework import executor as executor_mod
+        scopes.append(executor_mod._global_scope)
+    except Exception:
+        pass
+    scopes.extend(list(_scopes))
+    for scope in scopes:
+        try:
+            names = scope.var_names()
+        except Exception:
+            continue
+        for name in names:
+            try:
+                v = scope.find_var(name)
+            except Exception:
+                continue
+            if v is None or not hasattr(v, "nbytes"):
+                continue
+            plane = ("optimizer_state"
+                     if any(m in name for m in _OPT_MARKERS)
+                     else "params")
+            claims[id(v)] = (plane, name)
+    return claims
+
+
+def _census(arrays, live: int, device_stats: Dict[str, dict],
+            reason: str) -> None:
+    topk = max(1, int(flags.get_flag("memscope_topk")))
+    claims = _scope_claims()
+    for i, eng in enumerate(list(_kv_engines)):
+        for part, a in (("k", getattr(eng, "_kv_k", None)),
+                        ("v", getattr(eng, "_kv_v", None))):
+            if a is not None:
+                claims[id(a)] = ("serving_kv", f"kv_slab_{part}.{i}")
+
+    planes: Dict[str, int] = {}
+    owners: List[dict] = []
+    for nb, a in arrays:
+        plane, name = claims.get(id(a), ("other", None))
+        planes[plane] = planes.get(plane, 0) + nb
+        owners.append({"bytes": nb, "plane": plane, "name": name,
+                       "shape": list(getattr(a, "shape", ()) or ()),
+                       "dtype": str(getattr(a, "dtype", "?"))})
+    owners.sort(key=lambda o: -o["bytes"])
+    owners = owners[:topk]
+
+    # host/disk-side planes (not jax arrays): sparse tables and the
+    # persistent-executable cache footprint
+    sparse_b = 0
+    for sh in list(_sparse_shards):
+        try:
+            sparse_b += int(sh.state_bytes())
+        except Exception:
+            pass
+    if sparse_b:
+        planes["sparse_tables"] = sparse_b
+    try:
+        from ..framework import jit_cache
+        if jit_cache.enabled():
+            planes["jit_executables"] = int(jit_cache.stats().get(
+                "bytes", 0))
+    except Exception:
+        pass
+    with _lock:
+        feed_b = float(_state.get("feed_bytes") or 0.0)
+    if feed_b:
+        planes["executor_feeds"] = int(feed_b)
+
+    limit_flag = int(flags.get_flag("memscope_hbm_limit_bytes"))
+    device_doc: Dict[str, dict] = {}
+    pressure: Optional[float] = None
+    for dev, stats in device_stats.items():
+        used = stats.get("bytes_in_use")
+        if used is None:
+            continue
+        limit = limit_flag or int(stats.get("bytes_limit") or 0)
+        _m_used.labels(device=dev).set(used)
+        row = {"used_bytes": int(used), "limit_bytes": limit or None,
+               "peak_bytes": stats.get("peak_bytes_in_use")}
+        if limit > 0:
+            row["free_bytes"] = max(0, limit - int(used))
+            _m_free.labels(device=dev).set(row["free_bytes"])
+            pressure = max(pressure or 0.0, used / limit)
+        device_doc[dev] = row
+    if not device_stats:
+        # allocator-stats-less backend (CPU): the live-array total is
+        # the best available "used"; pressure needs the explicit budget
+        _m_used.labels(device="host").set(live)
+        row = {"used_bytes": live,
+               "limit_bytes": limit_flag or None, "peak_bytes": None}
+        if limit_flag > 0:
+            row["free_bytes"] = max(0, limit_flag - live)
+            _m_free.labels(device="host").set(row["free_bytes"])
+            pressure = live / limit_flag
+        device_doc["host"] = row
+    if pressure is not None:
+        _m_pressure.set(pressure)
+
+    threshold = float(flags.get_flag("memscope_pressure_fraction"))
+    with _lock:
+        known = _state.setdefault("known_planes", set())
+        known |= set(planes)
+        for plane in known:
+            _m_resident.labels(plane=plane).set(planes.get(plane, 0))
+        was_active = bool(_state.get("pressure_active"))
+        now_active = (pressure is not None and threshold > 0
+                      and pressure >= threshold)
+        _state.update(planes=planes, owners=owners, device=device_doc,
+                      pressure=pressure, live_bytes=live,
+                      pressure_active=now_active,
+                      last_sample={"reason": reason,
+                                   "time_unix": time.time()})
+    if now_active and not was_active:
+        fattest = max(planes, key=planes.get) if planes else None
+        journal.emit("memory", "pressure",
+                     fraction=round(float(pressure), 4),
+                     threshold=threshold, live_bytes=live,
+                     plane=fattest, trigger=reason)
+
+
+# --- predicted-vs-measured reconciliation ----------------------------------
+
+def _verdict(ratio: float) -> str:
+    factor = max(1.0, float(flags.get_flag("memscope_ratio_factor")))
+    if ratio > factor:
+        return "under_predicted"
+    if ratio < 1.0 / factor:
+        return "over_predicted"
+    return "ok"
+
+
+def note_dispatch(label: str, cost=None, feed_bytes: float = 0.0,
+                  scope=None) -> None:
+    """Dispatch-boundary hook (executor.run): census + per-program
+    high-water mark joined with the cost model's predicted peak."""
+    if not enabled():
+        return
+    if scope is not None:
+        try:
+            _scopes.add(scope)
+        except TypeError:
+            pass
+    with _lock:
+        _state["feed_bytes"] = float(feed_bytes)
+    live = sample(reason="dispatch")
+    measured = float(live)
+    with _lock:
+        for row in (_state.get("device") or {}).values():
+            used = row.get("used_bytes")
+            if used:
+                measured = max(measured, float(used))
+        rec = _programs.setdefault(label, {
+            "dispatches": 0, "measured_high_water_bytes": 0.0,
+            "predicted_peak_bytes": None, "ratio": None,
+            "verdict": "unpredicted"})
+        rec["dispatches"] += 1
+        rec["measured_high_water_bytes"] = max(
+            rec["measured_high_water_bytes"], measured)
+        predicted = 0.0
+        if cost is not None:
+            predicted = float(getattr(cost, "peak_hbm_bytes", 0.0) or 0.0)
+        if predicted > 0:
+            rec["predicted_peak_bytes"] = predicted
+            ratio = rec["measured_high_water_bytes"] / predicted
+            rec["ratio"] = ratio
+            rec["verdict"] = _verdict(ratio)
+            _m_ratio.labels(program=label).set(ratio)
+
+
+# --- KV-cache occupancy ledger ---------------------------------------------
+
+def kv_occupancy(engine) -> dict:
+    """Reserved-vs-written slot math over a DecodeEngine's slabs (pure
+    host-side arithmetic; also exercised by --self-test on a synthetic
+    engine)."""
+    import numpy as np
+
+    slab = int(engine._kv_k.nbytes) + int(engine._kv_v.nbytes)
+    nslots = int(engine.max_batch)
+    max_len = int(engine.max_len)
+    per_slot = slab // max(1, nslots)
+    bpp = per_slot // max(1, max_len)
+    lengths = np.asarray(engine._lengths)
+    active = np.asarray(engine._active, dtype=bool)
+    n_active = int(active.sum())
+    written_pos = int(lengths[active].sum()) if n_active else 0
+    reserved = n_active * per_slot
+    written = written_pos * bpp
+    waste = (1.0 - written / reserved) if reserved else 0.0
+    buckets: Dict[str, dict] = {}
+    slot_bucket = getattr(engine, "_slot_bucket", None)
+    if slot_bucket is not None:
+        for slot in np.nonzero(active)[0]:
+            b = str(int(slot_bucket[slot]))
+            row = buckets.setdefault(b, {"slots": 0, "reserved_bytes": 0,
+                                         "written_bytes": 0})
+            row["slots"] += 1
+            row["reserved_bytes"] += per_slot
+            row["written_bytes"] += int(lengths[slot]) * bpp
+        for row in buckets.values():
+            row["waste_fraction"] = (
+                1.0 - row["written_bytes"] / row["reserved_bytes"]
+                if row["reserved_bytes"] else 0.0)
+    return {"slab_bytes": slab, "slots": nslots,
+            "active_slots": n_active, "max_len": max_len,
+            "bytes_per_position": bpp, "reserved_bytes": reserved,
+            "written_bytes": written, "waste_fraction": waste,
+            "buckets": buckets}
+
+
+def note_kv(engine) -> None:
+    """Serving-boundary hook (start_sequence / decode_step /
+    retire_slot): refresh the occupancy ledger + gauges."""
+    if not enabled():
+        return
+    doc = kv_occupancy(engine)
+    _m_kv_reserved.set(doc["reserved_bytes"])
+    _m_kv_written.set(doc["written_bytes"])
+    _m_kv_waste.set(doc["waste_fraction"])
+    _m_kv_bucket.clear()
+    for b, row in doc["buckets"].items():
+        _m_kv_bucket.labels(bucket=b).set(row["waste_fraction"])
+    with _lock:
+        _state["kv"] = doc
+        if doc["active_slots"]:
+            _state["kv_peak_waste"] = max(
+                float(_state.get("kv_peak_waste") or 0.0),
+                doc["waste_fraction"])
+
+
+# --- OOM forensics ---------------------------------------------------------
+
+def _cost_row(cost) -> Optional[dict]:
+    if cost is None:
+        return None
+    row = {}
+    for f in ("label", "flops", "bytes_accessed", "argument_bytes",
+              "output_bytes", "temp_bytes", "alias_bytes",
+              "peak_hbm_bytes", "source"):
+        v = getattr(cost, f, None)
+        if v is not None:
+            row[f] = v
+    return row or None
+
+
+def note_alloc_failure(where: str, label: Optional[str] = None,
+                       cost=None) -> Optional[str]:
+    """An allocation failed (the memory.alloc chaos site, or a real
+    RESOURCE_EXHAUSTED caller): freeze the census + top-K owners + the
+    triggering program's cost row into a flight bundle and journal the
+    event so ``incident`` can reconstruct the kill timeline."""
+    if not enabled():
+        return None
+    try:
+        sample(reason="alloc_failure")
+    except Exception:
+        pass
+    with _lock:
+        planes = dict(_state.get("planes") or {})
+        census = {"planes": planes,
+                  "owners": [dict(o) for o in _state.get("owners") or []],
+                  "device": {k: dict(v) for k, v in
+                             (_state.get("device") or {}).items()},
+                  "live_bytes": _state.get("live_bytes"),
+                  "pressure_fraction": _state.get("pressure")}
+        _state["alloc_failures"] = int(_state.get("alloc_failures") or 0) + 1
+    fattest = max(planes, key=planes.get) if planes else None
+    journal.emit("memory", "alloc_failure", where=where,
+                 program=label, plane=fattest,
+                 live_bytes=census["live_bytes"])
+    path = flight.dump("memory_alloc_failure",
+                       extra={"memory": {"where": where, "program": label,
+                                         "cost": _cost_row(cost),
+                                         "census": census}})
+    with _lock:
+        _state["last_alloc_failure"] = {
+            "where": where, "program": label, "plane": fattest,
+            "time_unix": time.time(), "bundle_path": path}
+    return path
+
+
+def alert_context(labels: Optional[Dict[str, str]] = None) -> dict:
+    """Context for a firing hbm_pressure alert: the pressure numbers
+    and the fattest plane/owner (the engine cannot derive ownership
+    from a scalar gauge itself)."""
+    with _lock:
+        planes = dict(_state.get("planes") or {})
+        owners = [dict(o) for o in _state.get("owners") or []]
+        ctx: Dict[str, Any] = {
+            "pressure_fraction": _state.get("pressure"),
+            "live_bytes": _state.get("live_bytes")}
+        last = _state.get("last_alloc_failure")
+    if planes:
+        fattest = max(planes, key=planes.get)
+        ctx["fattest_plane"] = fattest
+        ctx["fattest_plane_bytes"] = planes[fattest]
+    if owners:
+        ctx["top_owner"] = owners[0]
+    if last:
+        ctx["last_alloc_failure"] = dict(last)
+    return ctx
+
+
+# --- ticker ----------------------------------------------------------------
+
+def start_ticker() -> None:
+    """Idempotent: one bounded daemon thread sampling the census every
+    memscope_interval seconds (0 = boundary-only, the default)."""
+    global _ticker, _ticker_stop
+    interval = float(flags.get_flag("memscope_interval"))
+    if interval <= 0 or not enabled():
+        return
+    with _lock:
+        if _ticker is not None and _ticker.is_alive():
+            return
+        stop = threading.Event()
+        t = threading.Thread(target=_ticker_loop, args=(stop, interval),
+                             name="memscope-ticker", daemon=True)
+        _ticker, _ticker_stop = t, stop
+    t.start()
+
+
+def _ticker_loop(stop: threading.Event, interval: float) -> None:
+    while not stop.wait(interval):
+        if not enabled():
+            break
+        try:
+            sample(reason="tick")
+        except Exception:
+            break
+
+
+# --- reporting -------------------------------------------------------------
+
+def status_doc() -> dict:
+    """The paddle_tpu.mem.v1 document (GET /memory body; --doc)."""
+    with _lock:
+        doc = {
+            "schema": SCHEMA, "enabled": enabled(),
+            "live_bytes": _state.get("live_bytes"),
+            "peak_bytes": _m_peak.value,
+            "planes": dict(_state.get("planes") or {}),
+            "owners": [dict(o) for o in _state.get("owners") or []],
+            "device": {k: dict(v) for k, v in
+                       (_state.get("device") or {}).items()},
+            "pressure": {
+                "fraction": _state.get("pressure"),
+                "threshold": float(
+                    flags.get_flag("memscope_pressure_fraction")),
+                "active": bool(_state.get("pressure_active"))},
+            "programs": {k: dict(v) for k, v in _programs.items()},
+            "kv": (dict(_state["kv"]) if _state.get("kv") else None),
+            "kv_peak_waste_fraction": _state.get("kv_peak_waste"),
+            "alloc_failures": int(_state.get("alloc_failures") or 0),
+            "last_alloc_failure": (dict(_state["last_alloc_failure"])
+                                   if _state.get("last_alloc_failure")
+                                   else None),
+            "ratio_factor": float(flags.get_flag("memscope_ratio_factor")),
+            "last_sample": _state.get("last_sample"),
+        }
+    return doc
+
+
+def explain_section(cost) -> dict:
+    """The explain(memory=True) body for one compiled program: the
+    predicted peak + components next to the measured high-water mark
+    and the drift verdict."""
+    label = getattr(cost, "label", None)
+    with _lock:
+        rec = dict(_programs.get(label) or {})
+        planes = dict(_state.get("planes") or {})
+    return {
+        "predicted_peak_bytes": getattr(cost, "peak_hbm_bytes", None),
+        "components": {
+            "argument": getattr(cost, "argument_bytes", None),
+            "output": getattr(cost, "output_bytes", None),
+            "temp": getattr(cost, "temp_bytes", None),
+            "alias": getattr(cost, "alias_bytes", None)},
+        "measured_high_water_bytes":
+            rec.get("measured_high_water_bytes"),
+        "ratio": rec.get("ratio"),
+        "verdict": rec.get("verdict", "unmeasured"),
+        "ratio_factor": float(flags.get_flag("memscope_ratio_factor")),
+        "planes": planes,
+    }
+
+
+def report(top: int = 8) -> List[str]:
+    """ASCII census for the CLI."""
+    doc = status_doc()
+
+    def mb(b):
+        return "-" if b is None else f"{b / (1 << 20):10.2f} MiB"
+
+    lines = [f"memscope census (live {mb(doc['live_bytes'])}, "
+             f"peak {mb(doc['peak_bytes'])})"]
+    lines.append("  plane                      resident")
+    for plane, b in sorted(doc["planes"].items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {plane:<24} {mb(b)}")
+    lines.append(f"  top {top} owners:")
+    for o in doc["owners"][:top]:
+        lines.append(f"    {mb(o['bytes'])}  {o['plane']:<16} "
+                     f"{o.get('name') or '?'} {o['shape']} {o['dtype']}")
+    p = doc["pressure"]
+    if p["fraction"] is not None:
+        lines.append(f"  pressure {p['fraction']:.3f} "
+                     f"(threshold {p['threshold']:.2f}"
+                     f"{', ACTIVE' if p['active'] else ''})")
+    for label, rec in sorted(doc["programs"].items()):
+        if rec.get("ratio") is not None:
+            lines.append(
+                f"  program {label}: measured "
+                f"{mb(rec['measured_high_water_bytes'])} / predicted "
+                f"{mb(rec['predicted_peak_bytes'])} = "
+                f"{rec['ratio']:.3f} [{rec['verdict']}]")
+    kv = doc.get("kv")
+    if kv:
+        lines.append(
+            f"  kv: {kv['active_slots']}/{kv['slots']} slots, reserved "
+            f"{mb(kv['reserved_bytes'])}, written "
+            f"{mb(kv['written_bytes'])}, waste "
+            f"{kv['waste_fraction']:.3f}")
+    if doc["alloc_failures"]:
+        lines.append(f"  alloc failures: {doc['alloc_failures']} "
+                     f"(last: {doc['last_alloc_failure']})")
+    return lines
+
+
+def rows_from_metrics_doc(doc: Optional[dict]) -> dict:
+    """Reconstruct census rows from a metrics DOCUMENT (this process's
+    registry or a fleet worker's shipped snapshot) — what
+    fleet.mem_rows() builds the per-rank merged view from."""
+    fams = (doc or {}).get("metrics") or {}
+
+    def series(name):
+        return (fams.get(name) or {}).get("series") or []
+
+    planes = {}
+    for row in series("mem_resident_bytes"):
+        plane = (row.get("labels") or {}).get("plane")
+        if plane is not None:
+            planes[plane] = row.get("value", 0.0)
+    device: Dict[str, dict] = {}
+    for metric, key in (("mem_device_used_bytes", "used_bytes"),
+                        ("mem_device_free_bytes", "free_bytes")):
+        for row in series(metric):
+            dev = (row.get("labels") or {}).get("device")
+            if dev is not None:
+                device.setdefault(dev, {})[key] = row.get("value", 0.0)
+    pressure = None
+    for row in series("mem_pressure_fraction"):
+        pressure = float(row.get("value", 0.0))
+    ratios = {}
+    for row in series("mem_peak_ratio"):
+        prog = (row.get("labels") or {}).get("program")
+        if prog is not None:
+            ratios[prog] = row.get("value", 0.0)
+    kv = {}
+    for metric, key in (("serving_kv_reserved_bytes", "reserved_bytes"),
+                        ("serving_kv_written_bytes", "written_bytes"),
+                        ("serving_kv_waste_fraction", "waste_fraction")):
+        for row in series(metric):
+            kv[key] = row.get("value", 0.0)
+    live = None
+    for row in series("device_memory_live_bytes"):
+        live = float(row.get("value", 0.0))
+    return {"planes": planes, "device": device,
+            "pressure_fraction": pressure, "peak_ratio": ratios,
+            "kv": kv, "live_bytes": live}
+
+
+# --- lifecycle -------------------------------------------------------------
+
+def reset() -> None:
+    """Stop the ticker thread (joined), drop census/program state and
+    every mem_*/serving_kv_* gauge series (conftest: one test's
+    residency or pressure verdict must not leak into the next).  The
+    provider weaksets survive — registration happens once at object
+    construction and module-scoped fixtures outlive a single test.
+    The legacy device_memory_* watermarks are left alone (pre-memscope
+    behavior: never cleared between tests)."""
+    global _ticker, _ticker_stop
+    with _lock:
+        t, stop = _ticker, _ticker_stop
+        _ticker, _ticker_stop = None, None
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=2.0)
+    with _lock:
+        _state.clear()
+        _programs.clear()
+    for m in (_m_resident, _m_used, _m_free, _m_pressure, _m_ratio,
+              _m_kv_reserved, _m_kv_written, _m_kv_waste, _m_kv_bucket):
+        m.clear()
+
+
+# --- CLI -------------------------------------------------------------------
+
+def _self_test() -> int:
+    """Hermetic smoke against TEMPORARY flag state: a census over a
+    synthetic live array (pressure forced via a 1-byte budget), the
+    ratio-verdict math, and the KV slot ledger on a synthetic engine.
+    Prints one MEMSCOPE_SELF_TEST json line; exit 0 on pass."""
+    import types
+
+    import numpy as np
+
+    saved = {k: flags.get_flag(k) for k in
+             ("memscope", "memscope_interval", "memscope_topk",
+              "memscope_hbm_limit_bytes", "memscope_pressure_fraction",
+              "memscope_ratio_factor")}
+    flags.set_flag("memscope", True)
+    flags.set_flag("memscope_interval", 0.0)
+    flags.set_flag("memscope_hbm_limit_bytes", 1)
+    notes: Dict[str, Any] = {}
+    ok = True
+    try:
+        import jax.numpy as jnp
+        x = jnp.ones((64, 64), jnp.float32)
+        live = sample(reason="self_test")
+        doc = status_doc()
+        notes["live_bytes"] = live
+        notes["planes"] = sorted(doc["planes"])
+        ok &= live >= x.nbytes and bool(doc["planes"])
+        ok &= (doc["pressure"]["fraction"] or 0.0) >= 1.0
+        ok &= doc["pressure"]["active"]
+        ctx = alert_context({})
+        ok &= bool(ctx.get("fattest_plane"))
+
+        cost = types.SimpleNamespace(label="selftest.prog",
+                                     peak_hbm_bytes=float(live))
+        note_dispatch("selftest.prog", cost=cost)
+        rec = status_doc()["programs"]["selftest.prog"]
+        notes["ratio"] = rec["ratio"]
+        ok &= rec["verdict"] == "ok" and rec["ratio"] is not None
+
+        eng = types.SimpleNamespace(
+            max_batch=4, max_len=16,
+            _kv_k=np.zeros((2, 4, 2, 16, 8), np.float32),
+            _kv_v=np.zeros((2, 4, 2, 16, 8), np.float32),
+            _lengths=np.array([4, 8, 0, 0], np.int32),
+            _active=np.array([True, True, False, False]),
+            _slot_bucket=np.array([8, 16, 0, 0], np.int32))
+        occ = kv_occupancy(eng)
+        notes["kv_waste"] = occ["waste_fraction"]
+        ok &= abs(occ["waste_fraction"] - (1.0 - 12 / 32)) < 1e-9
+        ok &= occ["reserved_bytes"] == 2 * (occ["slab_bytes"] // 4)
+        ok &= set(occ["buckets"]) == {"8", "16"}
+        note_kv(eng)
+        ok &= abs(_m_kv_waste.value - occ["waste_fraction"]) < 1e-9
+        del x
+    except Exception as e:          # pragma: no cover - diagnosed by ok
+        notes["error"] = f"{type(e).__name__}: {e}"
+        ok = False
+    finally:
+        reset()
+        for k, v in saved.items():
+            flags.set_flag(k, v)
+    print("MEMSCOPE_SELF_TEST " + json.dumps(
+        {"ok": bool(ok), **notes}, sort_keys=True, default=str))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.memscope",
+        description="Live HBM census: per-plane attribution, top-N "
+                    "owners, predicted-vs-measured peaks and the KV "
+                    "occupancy ledger.")
+    ap.add_argument("--doc", action="store_true",
+                    help="print the paddle_tpu.mem.v1 json document")
+    ap.add_argument("--top", type=int, default=None,
+                    help="owners to list (default: memscope_topk flag)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="hermetic synthetic-census smoke (tier-1)")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not enabled():
+        print("memscope is disabled — set PTPU_MEMSCOPE=1 (flag "
+              "'memscope') and rerun.", file=sys.stderr)
+        return 2
+    sample(reason="cli")
+    for line in report(args.top or int(flags.get_flag("memscope_topk"))):
+        print(line)
+    if args.doc:
+        print(json.dumps(status_doc(), indent=2, sort_keys=True,
+                         default=str))
+    return 0
+
+
+if __name__ == "__main__":         # pragma: no cover
+    raise SystemExit(main())

@@ -146,6 +146,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, obs.controller())
             elif path == "/perf":
                 self._send_json(200, obs.perf())
+            elif path == "/memory":
+                self._send_json(200, obs.memory())
             elif path == "/journal":
                 self._send_json(200, obs.journal())
             elif path.startswith("/trace/"):
@@ -164,7 +166,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, b"paddle_tpu observability: /metrics "
                                 b"/metrics.json /healthz /flight "
                                 b"/model /serving /alerts /controller "
-                                b"/perf /journal /trace/<id> "
+                                b"/perf /memory /journal /trace/<id> "
                                 b"[POST /serving/generate "
                                 b"/serving/drain /profile]\n",
                            "text/plain; charset=utf-8")
@@ -392,6 +394,18 @@ class ObservabilityServer:
                          else "local")
         if self.aggregator is not None:
             doc["ranks"] = self.aggregator.perf_rows()
+        return doc
+
+    def memory(self) -> dict:
+        """``GET /memory``: the memscope census — this process's full
+        status document, plus fleet-merged per-rank census rows
+        (fleet.mem_rows) on a coordinator."""
+        from . import memscope as obs_memscope
+        doc = obs_memscope.status_doc()
+        doc["source"] = ("fleet" if self.aggregator is not None
+                         else "local")
+        if self.aggregator is not None:
+            doc["ranks"] = self.aggregator.mem_rows()
         return doc
 
     def _wire_alerts(self, eng) -> None:

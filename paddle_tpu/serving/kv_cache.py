@@ -46,6 +46,7 @@ from ..core import flags
 from ..observability import flight as obs_flight
 from ..observability import metrics as obs_metrics
 from ..observability import tracectx as obs_tracectx
+from ..resilience import chaos
 
 _m_compiles = obs_metrics.counter(
     "serving_compiles_total",
@@ -176,8 +177,15 @@ class DecodeEngine:
         self._temps = jnp.zeros((B,), jnp.float32)
         self._keys = jnp.stack(
             [jax.random.PRNGKey(seed + i) for i in range(B)])
+        # host-side prompt bucket per slot — the memscope occupancy
+        # ledger aggregates waste per bucket from this
+        self._slot_bucket = np.zeros((B,), np.int32)
         self._compiled_prefill: Dict[int, object] = {}
         self._compiled_step = None
+        # construction-time registration (not the request path): lets
+        # the memscope census claim the slabs as the serving_kv plane
+        from ..observability import memscope as obs_memscope
+        obs_memscope.register_kv_engine(self)
 
     # -- traced bodies ------------------------------------------------------
     def _layer(self, p, i, x, attend):
@@ -424,6 +432,7 @@ class DecodeEngine:
         self._last = jnp.zeros((self.max_batch,), jnp.int32)
         self._active[:] = False
         self._temps = jnp.zeros((self.max_batch,), jnp.float32)
+        self._slot_bucket[:] = 0
 
     def free_slots(self) -> List[int]:
         return [i for i in range(self.max_batch) if not self._active[i]]
@@ -522,10 +531,17 @@ class DecodeEngine:
         self._temps = self._temps.at[slot].set(float(temperature))
         self._keys = self._keys.at[slot].set(key)
         self._active[slot] = True
+        self._slot_bucket[slot] = bucket
+        from ..observability import memscope as obs_memscope
+        if obs_memscope.enabled():
+            obs_memscope.note_kv(self)
         return tok
 
     def retire_slot(self, slot: int):
         self._active[slot] = False
+        from ..observability import memscope as obs_memscope
+        if obs_memscope.enabled():
+            obs_memscope.note_kv(self)
 
     def decode_step(self) -> Dict[int, int]:
         """Advance every active slot one token (ONE compiled dispatch);
@@ -537,6 +553,17 @@ class DecodeEngine:
         runnable = self._active & (lengths < self.max_len)
         if not runnable.any():
             return {}
+        # chaos site: a simulated RESOURCE_EXHAUSTED at the serving
+        # dispatch — the shared memory.alloc catalog entry; memscope
+        # (when on) freezes the census into a flight bundle first
+        try:
+            chaos.trigger("memory.alloc")
+        except chaos.InjectedFault:
+            from ..observability import memscope as obs_memscope
+            if obs_memscope.enabled():
+                obs_memscope.note_alloc_failure("serving.decode_step",
+                                                label="serving.decode")
+            raise
         active = jnp.asarray(runnable)
         with self._donation_quiet():
             self._kv_k, self._kv_v, toks, self._lengths, self._keys = \
@@ -545,4 +572,7 @@ class DecodeEngine:
                     self._lengths, active, self._keys, self._temps)
         self._last = toks
         host = np.asarray(toks)
+        from ..observability import memscope as obs_memscope
+        if obs_memscope.enabled():
+            obs_memscope.note_kv(self)
         return {int(i): int(host[i]) for i in np.where(runnable)[0]}

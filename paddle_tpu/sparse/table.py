@@ -139,6 +139,10 @@ class EmbeddingShard:
         self.version = 0            # bumps once per applied push
         self.rows_pulled = 0
         self.rows_pushed = 0
+        # construction-time registration: the memscope census reports
+        # state_bytes() as the host-side sparse_tables plane
+        from ..observability import memscope as obs_memscope
+        obs_memscope.register_sparse_shard(self)
 
     # -- local/global row mapping ------------------------------------------
     def _local(self, rows: np.ndarray) -> np.ndarray:

@@ -551,6 +551,9 @@ class Trainer:
                         n_examples, raw_loss, guard_verdict,
                         last_stats_sample)
                     if step_in_total % _MEM_SAMPLE_EVERY == 0:
+                        # one measurement path: the legacy watermark
+                        # gauges AND (flag on) the memscope per-plane
+                        # census + ticker arm ride this same call
                         observability.record_device_memory()
                     obs_trace.add_instant(
                         "trainer.step", t0, tid=obs_trace.TRAINER_TID,

@@ -49,6 +49,7 @@ def fresh_programs():
     from paddle_tpu.observability import costmodel, flight, forensics
     from paddle_tpu.observability import deviceprof, metrics as obs_metrics
     from paddle_tpu.observability import journal as obs_journal
+    from paddle_tpu.observability import memscope as obs_memscope
     from paddle_tpu.observability import perfscope as obs_perfscope
     from paddle_tpu.observability import runlog, tensorstats, tracectx
     from paddle_tpu.observability import server as obs_server
@@ -111,6 +112,17 @@ def fresh_programs():
                      ("perf_baseline_window", 32),
                      ("perf_hbm_gbps", 0.0), ("perf_ici_gbps", 0.0)):
         pt.core.flags.set_flag(_pf, _pv)
+    # memscope: join the census ticker, drop the plane/program/KV state
+    # and every mem_*/serving_kv_* gauge series, and default the flag
+    # family back off — one test's residency census or pressure verdict
+    # must not leak into the next
+    obs_memscope.reset()
+    pt.core.flags.set_flag("memscope", False)
+    for _mf, _mv in (("memscope_interval", 0.0), ("memscope_topk", 8),
+                     ("memscope_pressure_fraction", 0.9),
+                     ("memscope_hbm_limit_bytes", 0),
+                     ("memscope_ratio_factor", 8.0)):
+        pt.core.flags.set_flag(_mf, _mv)
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
@@ -130,6 +142,13 @@ def fresh_programs():
                      ("perf_baseline_window", 32),
                      ("perf_hbm_gbps", 0.0), ("perf_ici_gbps", 0.0)):
         pt.core.flags.set_flag(_pf, _pv)
+    obs_memscope.reset()
+    pt.core.flags.set_flag("memscope", False)
+    for _mf, _mv in (("memscope_interval", 0.0), ("memscope_topk", 8),
+                     ("memscope_pressure_fraction", 0.9),
+                     ("memscope_hbm_limit_bytes", 0),
+                     ("memscope_ratio_factor", 8.0)):
+        pt.core.flags.set_flag(_mf, _mv)
 
 
 @pytest.fixture

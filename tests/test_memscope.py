@@ -1,0 +1,815 @@
+"""Memscope (ISSUE 18, observability/memscope.py): live HBM
+attribution, OOM forensics, and KV-cache occupancy accounting.
+
+Covers the acceptance matrix: flag-off bitwise invariance through a
+real checkpointing Trainer run (losses AND final weights byte-equal,
+frozen compile counters/forensics), the census planes/owners over the
+executor scope with the legacy device_memory_* gauges riding the same
+path, predicted-vs-measured peak reconciliation on CPU (verdict inside
+the documented factor-8 band, surfaced by explain(memory=True)), the
+KV reserved-vs-written ledger under direct slot math / mid-decode
+retire+backfill / the 8-stream loadgen soak, the chaos memory.alloc
+site -> flight bundle + firing hbm_pressure alert joined by
+``incident``, plus the satellites: the memory_usage_calc cross-check
+against the cost model for the bundled transformer-LM and resnet,
+bench.py's peak-HBM row + the bench_gate lower-is-better *_bytes
+direction and --trend subseries, the CLI/--self-test contract, the
+GET /memory route (local and fleet-merged), and conftest isolation.
+"""
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models, observability, serving
+from paddle_tpu.contrib import memory_usage_calc
+from paddle_tpu.core import flags
+from paddle_tpu.framework import executor as executor_mod
+from paddle_tpu.observability import alerts
+from paddle_tpu.observability import bench_gate
+from paddle_tpu.observability import fleet
+from paddle_tpu.observability import flight
+from paddle_tpu.observability import forensics
+from paddle_tpu.observability import incident
+from paddle_tpu.observability import memscope
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import loadgen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tot(name):
+    m = obs_metrics.REGISTRY.get(name)
+    return 0.0 if m is None else m.total()
+
+
+def _val(name):
+    m = obs_metrics.REGISTRY.get(name)
+    assert m is not None, f"gauge {name} not registered"
+    return m.value
+
+
+def _gauge(name, **labels):
+    m = obs_metrics.REGISTRY.get(name)
+    assert m is not None, f"gauge {name} not registered"
+    return m.labels(**labels).value
+
+
+def _train_program(opt="adam"):
+    """Tiny fc regression step in the GLOBAL scope (what the census
+    attributes): Adam so the accumulator-naming split has both a
+    params and an optimizer_state plane to find."""
+    pt.reset_default_programs()
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False, name="fc")
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    if opt == "adam":
+        pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    else:
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 4).astype("float32"),
+            "y": rng.randn(4, 1).astype("float32")}
+    return pt.default_main_program(), loss, feed
+
+
+def _batches(n, bs=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[(rng.randn(4).astype("float32"),
+              rng.randn(1).astype("float32")) for _ in range(bs)]
+            for _ in range(n)]
+
+
+# --- shared tiny LM + decode engine (compiled ONCE per module), the
+# --- test_serving construction verbatim so KV slab shapes are real ----
+
+@pytest.fixture(scope="module")
+def lm():
+    pt.reset_default_programs()
+    from paddle_tpu.framework import executor as em
+    scope = em.Scope()
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=97, tgt_vocab_size=97, max_length=32,
+        n_layer=2, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    feeds, cost, logits = models.transformer.build_lm_net(
+        cfg, seq_len=24, is_test=True, fused_attention=False,
+        fused_head=False)
+    exe = pt.Executor(pt.CPUPlace(), scope=scope)
+    pt.default_startup_program().random_seed = 3
+    exe.run(pt.default_startup_program())
+    params = serving.extract_lm_params(pt.default_main_program(),
+                                       scope, cfg)
+    engine = serving.DecodeEngine(cfg, params, max_batch=4, max_len=32,
+                                  prompt_buckets=(8, 16))
+    engine.prepare()
+    return SimpleNamespace(cfg=cfg, engine=engine)
+
+
+@pytest.fixture
+def fresh_engine(lm):
+    lm.engine.reset()
+    return lm.engine
+
+
+@pytest.fixture
+def batcher(fresh_engine):
+    b = serving.ContinuousBatcher(fresh_engine, queue_limit=64)
+    b.start()
+    serving.attach(b)
+    yield b
+    serving.reset()
+
+
+# =========================================================================
+# tentpole: flag-off bitwise invariance (checkpointing Trainer run)
+# =========================================================================
+
+def _trainer_run(ckroot):
+    """One checkpointing Trainer run from scratch: fresh programs +
+    fresh global scope, fixed data/seeds.  Returns (loss_bytes,
+    weight_bytes, compile_delta, forensics_delta) — everything the
+    invariance contract compares bitwise."""
+    pt.reset_default_programs()
+    executor_mod._global_scope = executor_mod.Scope()
+
+    def train_func():
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False, name="fc")
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    cfg = pt.CheckpointConfig(ckroot, max_num_checkpoints=2,
+                              epoch_interval=1, step_interval=2)
+    t = pt.Trainer(train_func,
+                   lambda: pt.optimizer.SGD(learning_rate=0.05),
+                   place=pt.CPUPlace(), checkpoint_config=cfg)
+    data = _batches(6)
+    losses = []
+
+    def handler(e):
+        if type(e).__name__ == "EndStepEvent" and e.metrics:
+            losses.append(np.asarray(e.metrics[0]).tobytes())
+
+    compiles = _tot("executor_compile_total")
+    nrec = len(forensics.compile_log())
+    for _ in range(2):
+        t.train(num_epochs=1, event_handler=handler,
+                reader=lambda: iter(data), feed_order=["x", "y"])
+    wname, = [n for n in t.scope.var_names() if n.endswith(".w_0")]
+    w = np.asarray(t.scope.find_var(wname)).tobytes()
+    return (b"".join(losses), w,
+            _tot("executor_compile_total") - compiles,
+            len(forensics.compile_log()) - nrec)
+
+
+def test_flag_off_bitwise_invariance_checkpointing_trainer(tmp_path):
+    """Flipping memscope ON must not perturb a real checkpointing
+    Trainer run: losses and final weights stay BYTE-identical and the
+    compile counter / forensics log grow by exactly the same amount
+    (nothing entered a compile key)."""
+    assert flags.get_flag("memscope") is False
+    base = _trainer_run(str(tmp_path / "a"))
+    again = _trainer_run(str(tmp_path / "b"))
+    assert again == base, "trainer run must be deterministic off->off"
+
+    flags.set_flag("memscope", True)
+    on = _trainer_run(str(tmp_path / "c"))
+    assert on == base, "memscope=True must be byte-identical"
+    # and the flag-on run actually measured: the trainer's
+    # record_device_memory boundary + the executor dispatch hook both
+    # route through sample(), so the census saw the training state
+    doc = memscope.status_doc()
+    assert doc["planes"].get("params", 0) > 0
+    assert doc["last_sample"] is not None
+
+
+def test_explain_has_no_memory_section_unless_asked():
+    main, loss, feed = _train_program(opt="sgd")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    # flag off: no section even when explicitly requested
+    rep = exe.explain(main, feed=feed, fetch_list=[loss], memory=True)
+    assert "memory" not in rep
+    flags.set_flag("memscope", True)
+    # enabled but not asked: default explain stays memory-free
+    rep = exe.explain(main, feed=feed, fetch_list=[loss])
+    assert "memory" not in rep
+
+
+# =========================================================================
+# tentpole: census planes/owners + legacy gauge unification
+# =========================================================================
+
+def test_census_attributes_params_and_optimizer_state():
+    flags.set_flag("memscope", True)
+    flags.set_flag("memscope_topk", 64)
+    main, loss, feed = _train_program(opt="adam")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.run(main, feed=feed, fetch_list=[loss])
+
+    doc = memscope.status_doc()
+    planes = doc["planes"]
+    assert planes.get("params", 0) > 0
+    assert planes.get("optimizer_state", 0) > 0
+    assert planes.get("executor_feeds", 0) > 0
+    # Adam keeps two moments (+ scalar power terms) per param: the
+    # optimizer plane outweighs the params plane
+    assert planes["optimizer_state"] > planes["params"]
+    # owners are named: the fc weight and an adam accumulator both
+    # resolve through the scope claims
+    names = [o["name"] for o in doc["owners"] if o["name"]]
+    assert any(n.endswith(".w_0") for n in names)
+    assert any("moment" in n for n in names)
+    by_plane = {o["name"]: o["plane"] for o in doc["owners"]
+                if o["name"]}
+    assert all(p == "optimizer_state" for n, p in by_plane.items()
+               if "moment" in n or "_pow" in n)
+    # gauges mirror the doc
+    assert _gauge("mem_resident_bytes",
+                  plane="params") == planes["params"]
+    assert _gauge("mem_resident_bytes",
+                  plane="optimizer_state") == planes["optimizer_state"]
+    assert _val("device_memory_live_bytes") == doc["live_bytes"]
+
+
+def test_record_device_memory_is_the_same_path():
+    """The PR 1 trainer watermark entrypoint delegates to sample():
+    one call refreshes BOTH the legacy device_memory_* gauges and,
+    when enabled, the census."""
+    import jax.numpy as jnp
+    keep = jnp.ones((32, 32), jnp.float32)   # noqa: F841 — stays live
+    live = observability.record_device_memory()
+    assert live >= keep.nbytes
+    assert _val("device_memory_live_bytes") == live
+    assert _val("device_memory_peak_bytes") >= live
+    # flag off: no census happened
+    assert memscope.status_doc()["planes"] == {}
+    flags.set_flag("memscope", True)
+    live2 = observability.record_device_memory()
+    doc = memscope.status_doc()
+    assert doc["live_bytes"] == live2
+    assert doc["last_sample"]["reason"] == "boundary"
+    assert doc["planes"]
+
+
+# =========================================================================
+# tentpole: predicted-vs-measured reconciliation
+# =========================================================================
+
+def test_peak_ratio_within_documented_band_on_cpu():
+    """A megabyte-scale matmul step: its own state dominates the live
+    set, so measured-vs-predicted lands inside the documented factor-8
+    band regardless of what small arrays earlier tests left alive."""
+    flags.set_flag("memscope", True)
+    pt.reset_default_programs()
+    x = layers.data("x", [512], dtype="float32")
+    h = layers.fc(x, size=512, bias_attr=False)
+    loss = layers.mean(h)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    feed = {"x": np.ones((256, 512), "float32")}
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.run(main, feed=feed, fetch_list=[loss])
+
+    factor = float(flags.get_flag("memscope_ratio_factor"))
+    assert factor == 8.0          # the documented tolerance
+    rep = exe.explain(main, feed=feed, fetch_list=[loss], memory=True)
+    mem = rep["memory"]
+    assert mem["predicted_peak_bytes"] > (1 << 20)
+    assert mem["ratio"] is not None
+    assert mem["verdict"] == "ok"
+    assert 1.0 / factor <= mem["ratio"] <= factor
+    assert mem["measured_high_water_bytes"] > 0
+    assert mem["ratio_factor"] == factor
+    assert mem["components"]["argument"] is not None
+    assert mem["planes"].get("params", 0) > 0
+    # the dispatch record behind the section, and its gauge series
+    recs = {k: r for k, r in memscope.status_doc()["programs"].items()
+            if r.get("ratio") == mem["ratio"]}
+    assert recs, "explain must surface the train step's own record"
+    label, rec = next(iter(recs.items()))
+    assert rec["dispatches"] == 1
+    # dispatch reconciles against the analytic view while explain may
+    # surface the XLA cost model's peak — same order of magnitude
+    assert rec["predicted_peak_bytes"] == pytest.approx(
+        mem["predicted_peak_bytes"], rel=0.5)
+    assert _gauge("mem_peak_ratio",
+                  program=label) == pytest.approx(rec["ratio"])
+
+
+def test_verdict_band_edges():
+    import jax.numpy as jnp
+    flags.set_flag("memscope", True)
+    flags.set_flag("memscope_ratio_factor", 2.0)
+    keep = jnp.ones((64, 64), jnp.float32)  # noqa: F841 — stays live
+    memscope.sample()
+    live = float(memscope.status_doc()["live_bytes"])
+    assert live >= keep.nbytes
+    # predicted far above measured -> over_predicted; far below ->
+    # under_predicted (the drift verdicts explain() surfaces)
+    memscope.note_dispatch("edge.over", cost=SimpleNamespace(
+        label="edge.over", peak_hbm_bytes=live * 100.0))
+    memscope.note_dispatch("edge.under", cost=SimpleNamespace(
+        label="edge.under", peak_hbm_bytes=live / 100.0))
+    progs = memscope.status_doc()["programs"]
+    assert progs["edge.over"]["verdict"] == "over_predicted"
+    assert progs["edge.under"]["verdict"] == "under_predicted"
+    # no cost model at all -> the record stays honest about it
+    memscope.note_dispatch("edge.none", cost=None)
+    assert memscope.status_doc()["programs"]["edge.none"][
+        "verdict"] == "unpredicted"
+
+
+# =========================================================================
+# tentpole: KV occupancy ledger
+# =========================================================================
+
+def test_kv_occupancy_direct_slot_math(lm, fresh_engine):
+    flags.set_flag("memscope", True)
+    eng = fresh_engine
+    cfg = lm.cfg
+    # bytes per written position: K and V planes, n_layer x n_head x
+    # head_dim float32 each
+    bpp = cfg.n_layer * cfg.n_head * (cfg.d_model // cfg.n_head) * 4 * 2
+    eng.start_sequence(0, [1, 2, 3, 4])
+    eng.start_sequence(1, [5, 6, 7, 8, 9, 10])
+    doc = memscope.status_doc()["kv"]
+    assert doc["bytes_per_position"] == bpp
+    assert doc["active_slots"] == 2 and doc["slots"] == 4
+    per_slot = doc["slab_bytes"] // 4
+    assert doc["reserved_bytes"] == 2 * per_slot == 2 * 32 * bpp
+    assert doc["written_bytes"] == (4 + 6) * bpp
+    assert doc["waste_fraction"] == pytest.approx(1.0 - 10 / 64)
+    # both prompts landed in the 8-token bucket
+    assert set(doc["buckets"]) == {"8"}
+    assert doc["buckets"]["8"]["slots"] == 2
+    # the gauges carry the same ledger
+    assert _val("serving_kv_reserved_bytes") == doc["reserved_bytes"]
+    assert _val("serving_kv_written_bytes") == doc["written_bytes"]
+    assert _val("serving_kv_waste_fraction") == pytest.approx(
+        doc["waste_fraction"])
+    assert _gauge("serving_kv_bucket_waste_fraction",
+                  bucket="8") == pytest.approx(doc["waste_fraction"])
+    # one decode step writes one more position per active slot
+    eng.decode_step()
+    doc = memscope.status_doc()["kv"]
+    assert doc["written_bytes"] == (4 + 6 + 2) * bpp
+    # the census claims the slabs as the serving_kv plane
+    memscope.sample()
+    planes = memscope.status_doc()["planes"]
+    assert planes.get("serving_kv") == doc["slab_bytes"]
+
+
+def test_kv_mid_decode_retire_and_backfill(fresh_engine):
+    flags.set_flag("memscope", True)
+    eng = fresh_engine
+    eng.start_sequence(0, [1, 2, 3, 4])
+    eng.start_sequence(1, [5, 6, 7, 8, 9, 10])
+    eng.decode_step()
+    # retire mid-decode and backfill the freed slot with a prompt long
+    # enough to land in the OTHER bucket
+    eng.retire_slot(0)
+    doc = memscope.status_doc()["kv"]
+    assert doc["active_slots"] == 1
+    eng.start_sequence(0, list(range(1, 13)))
+    doc = memscope.status_doc()["kv"]
+    assert doc["active_slots"] == 2
+    assert set(doc["buckets"]) == {"8", "16"}
+    b8, b16 = doc["buckets"]["8"], doc["buckets"]["16"]
+    assert b8["slots"] == 1 and b16["slots"] == 1
+    bpp = doc["bytes_per_position"]
+    assert b16["written_bytes"] == 12 * bpp
+    assert b8["written_bytes"] == 7 * bpp
+    # per-bucket gauges: the longer prompt wastes less of its slot
+    assert b16["waste_fraction"] < b8["waste_fraction"]
+    assert _gauge("serving_kv_bucket_waste_fraction",
+                  bucket="16") == pytest.approx(b16["waste_fraction"])
+    # retiring everything zeroes the ledger but keeps the peak
+    eng.retire_slot(0)
+    eng.retire_slot(1)
+    doc = memscope.status_doc()
+    assert doc["kv"]["reserved_bytes"] == 0
+    assert doc["kv"]["waste_fraction"] == 0.0
+    assert doc["kv_peak_waste_fraction"] > 0.5
+
+
+def test_kv_waste_under_loadgen_soak(lm, batcher):
+    """The acceptance soak: 8 concurrent streams through the
+    continuous batcher leave a nonzero peak waste fraction consistent
+    with the slot math (prompts >= 4 tokens into 32-position slots
+    bound the waste at 1 - 4/32)."""
+    flags.set_flag("memscope", True)
+    rep = loadgen.run_loadgen(loadgen.inproc_submit(batcher),
+                              streams=8, requests_per_stream=3,
+                              prompt_len_range=(4, 14),
+                              max_new_tokens=8, temperature=0.0,
+                              vocab_size=64)
+    assert rep["ok"] and rep["counts"]["ok"] == 24
+    peak = memscope.status_doc()["kv_peak_waste_fraction"]
+    assert peak is not None and peak > 0.0
+    assert 0.3 <= peak <= 1.0 - 4.0 / lm.cfg.max_length
+    # the final ledger is internally consistent
+    doc = memscope.status_doc()["kv"]
+    assert doc["written_bytes"] <= doc["reserved_bytes"] or \
+        doc["reserved_bytes"] == 0
+
+
+# =========================================================================
+# tentpole: OOM forensics -> flight + hbm_pressure -> incident join
+# =========================================================================
+
+def test_hbm_pressure_rule_absent_when_flag_off():
+    assert flags.get_flag("memscope") is False
+    assert not [r for r in alerts.default_rules()
+                if r.name == "hbm_pressure"]
+    # and disabled by the threshold knob even when memscope is on
+    flags.set_flag("memscope", True)
+    flags.set_flag("memscope_pressure_fraction", 0.0)
+    assert not [r for r in alerts.default_rules()
+                if r.name == "hbm_pressure"]
+    flags.set_flag("memscope_pressure_fraction", 0.9)
+    assert [r for r in alerts.default_rules()
+            if r.name == "hbm_pressure"]
+
+
+@pytest.mark.chaos
+def test_chaos_alloc_failure_flight_alert_incident(tmp_path):
+    """The kill chain: a chaos-injected allocation failure at the
+    executor dispatch freezes the census into a flight bundle, the
+    1-byte HBM budget drives mem_pressure_fraction past the built-in
+    hbm_pressure rule (context naming the fattest plane), and
+    ``incident`` joins the journal + alert history into one
+    timeline."""
+    jp = str(tmp_path / "journal.jsonl")
+    flags.set_flag("journal_path", jp)
+    flags.set_flag("memscope", True)
+    flags.set_flag("memscope_hbm_limit_bytes", 1)
+    main, loss, feed = _train_program(opt="adam")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.run(main, feed=feed, fetch_list=[loss])
+
+    flags.set_flag("chaos_spec", "memory.alloc=raise:1.0")
+    chaos.reset()
+    with pytest.raises(chaos.InjectedFault):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    flags.set_flag("chaos_spec", "")
+    chaos.reset()
+
+    # -- flight bundle: census + top owners + the program's cost row
+    b = flight.last_bundle()
+    assert b is not None and b["reason"] == "memory_alloc_failure"
+    mem = b["extra"]["memory"]
+    assert mem["where"] == "executor.run"
+    assert mem["program"]
+    assert mem["cost"] and mem["cost"]["peak_hbm_bytes"] > 0
+    census = mem["census"]
+    assert census["planes"].get("params", 0) > 0
+    assert census["owners"]
+    assert census["pressure_fraction"] >= 1.0
+    doc = memscope.status_doc()
+    assert doc["alloc_failures"] == 1
+    assert doc["last_alloc_failure"]["where"] == "executor.run"
+
+    # -- the built-in rule fires and names the fattest plane
+    rules = [r for r in alerts.default_rules()
+             if r.name == "hbm_pressure"]
+    assert rules
+    eng = alerts.AlertEngine(rules)
+    t0 = time.time()
+    eng.evaluate(obs_metrics.REGISTRY.to_json(), now=t0)
+    eng.evaluate(obs_metrics.REGISTRY.to_json(), now=t0 + 1.5)
+    st = eng.status_doc()
+    assert "hbm_pressure" in st["firing"]
+    act = [a for a in st["active"] if a["rule"] == "hbm_pressure"
+           and a["state"] == "firing"]
+    ctx = act[0]["context"]
+    assert ctx["pressure_fraction"] >= 1.0
+    fattest = max(census["planes"], key=census["planes"].get)
+    assert ctx["fattest_plane"] == fattest
+    assert ctx["fattest_plane_bytes"] > 0
+    assert ctx["top_owner"]["bytes"] > 0
+    assert ctx["last_alloc_failure"]["where"] == "executor.run"
+
+    # -- incident joins journal events with the alert history
+    events, hist = incident.gather_events([jp], alerts_doc=st)
+    w0, w1, sel = incident.resolve_window(events, hist,
+                                          alert="hbm_pressure",
+                                          pad=30.0)
+    rep = incident.build_report(events, hist, w0, w1, sel)
+    tl = rep["timeline"]
+    kinds = [(e["kind"], e["event"]) for e in tl]
+    assert ("memory", "pressure") in kinds
+    assert ("memory", "alloc_failure") in kinds
+    assert ("chaos", "injected") in kinds
+    assert ("alert", "fire") in kinds
+    assert kinds.index(("memory", "alloc_failure")) \
+        < kinds.index(("alert", "fire"))
+
+
+@pytest.mark.chaos
+def test_chaos_alloc_failure_at_serving_decode(fresh_engine):
+    flags.set_flag("memscope", True)
+    eng = fresh_engine
+    eng.start_sequence(0, [1, 2, 3, 4])
+    flags.set_flag("chaos_spec", "memory.alloc=raise:1.0")
+    chaos.reset()
+    with pytest.raises(chaos.InjectedFault):
+        eng.decode_step()
+    flags.set_flag("chaos_spec", "")
+    chaos.reset()
+    b = flight.last_bundle()
+    assert b is not None and b["reason"] == "memory_alloc_failure"
+    assert b["extra"]["memory"]["where"] == "serving.decode_step"
+    assert memscope.status_doc()["alloc_failures"] == 1
+
+
+# =========================================================================
+# tentpole: CLI + /memory route + fleet doc rows
+# =========================================================================
+
+def test_cli_exit_codes_and_self_test(capsys):
+    assert memscope.main(["--self-test"]) == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("MEMSCOPE_SELF_TEST ")][-1]
+    doc = json.loads(line[len("MEMSCOPE_SELF_TEST "):])
+    assert doc["ok"] is True
+    assert doc["kv_waste"] == pytest.approx(0.625)
+    # self-test restores flag state: still disabled -> rc 2
+    assert flags.get_flag("memscope") is False
+    assert memscope.main([]) == 2
+    flags.set_flag("memscope", True)
+    assert memscope.main([]) == 0
+    assert memscope.main(["--doc"]) == 0
+    out = capsys.readouterr().out
+    assert "memscope census" in out
+    assert '"schema": "paddle_tpu.mem.v1"' in out
+
+
+def test_http_memory_route_local():
+    flags.set_flag("memscope", True)
+    memscope.sample()
+    srv = obs_server.start_http_server(port=0)
+    with urllib.request.urlopen(f"{srv.url}/memory", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["schema"] == "paddle_tpu.mem.v1"
+    assert doc["source"] == "local"
+    assert doc["enabled"] is True
+    assert doc["planes"]
+    with urllib.request.urlopen(f"{srv.url}/", timeout=10) as r:
+        assert b"/memory" in r.read()
+
+
+def test_fleet_merged_memory_route():
+    flags.set_flag("memscope", True)
+    main, loss, feed = _train_program(opt="sgd")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.run(main, feed=feed, fetch_list=[loss])
+    local = memscope.status_doc()
+
+    agg = fleet.FleetAggregator(stale_after=60.0)
+    agg.ingest("report_metrics",
+               {"schema": fleet.SCHEMA, "rank": 0,
+                "time_unix": time.time(),
+                "perf_counter": time.perf_counter(),
+                "steps_total": 1.0,
+                "metrics": obs_metrics.REGISTRY.to_json()})
+    rows = agg.mem_rows()
+    assert set(rows) == {"0"}
+    for plane, b in local["planes"].items():
+        assert rows["0"]["planes"][plane] == pytest.approx(b)
+    assert rows["0"]["live_bytes"] == pytest.approx(local["live_bytes"])
+
+    srv = obs_server.start_http_server(port=0, aggregator=agg)
+    with urllib.request.urlopen(f"{srv.url}/memory", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["source"] == "fleet"
+    assert doc["ranks"]["0"]["planes"]
+
+
+def test_rows_from_metrics_doc_reconstructs_census():
+    flags.set_flag("memscope", True)
+    flags.set_flag("memscope_hbm_limit_bytes", 1 << 40)
+    main, loss, feed = _train_program(opt="sgd")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.run(main, feed=feed, fetch_list=[loss])
+    local = memscope.status_doc()
+
+    rows = memscope.rows_from_metrics_doc(obs_metrics.REGISTRY.to_json())
+    for plane, b in local["planes"].items():
+        assert rows["planes"][plane] == pytest.approx(b)
+    assert rows["pressure_fraction"] == pytest.approx(
+        local["pressure"]["fraction"])
+    assert rows["device"]["host"]["used_bytes"] == pytest.approx(
+        local["device"]["host"]["used_bytes"])
+    assert rows["peak_ratio"]          # the dispatch published a ratio
+    # empty / absent documents degrade to empty rows, not a crash
+    assert memscope.rows_from_metrics_doc(None) == {
+        "planes": {}, "device": {}, "pressure_fraction": None,
+        "peak_ratio": {}, "kv": {}, "live_bytes": None}
+
+
+# =========================================================================
+# satellite: memory_usage_calc cross-check vs the cost model
+# =========================================================================
+
+def _explain_cost(loss, feed):
+    exe = pt.Executor(pt.CPUPlace(), scope=pt.Scope())
+    exe.run(pt.default_startup_program())
+    rep = exe.explain(pt.default_main_program(), feed=feed,
+                      fetch_list=[loss])
+    return rep["cost"]
+
+
+def test_cross_check_transformer_lm():
+    """The flagship LM train program: the static walk and the cost
+    model agree within the documented factor-8 tolerance on both the
+    persistable floor and the activation ceiling."""
+    pt.reset_default_programs()
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=97, tgt_vocab_size=97, max_length=32,
+        n_layer=2, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    feeds, avg_cost, logits = models.transformer.build_lm_net(
+        cfg, seq_len=16, fused_attention=False, fused_head=False)
+    pt.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    B = 4
+    feed = {"tokens": np.ones((B, 16), "int64"),
+            "labels": np.ones((B, 16), "int64")}
+    cost = _explain_cost(avg_cost, feed)
+    res = memory_usage_calc.cross_check(pt.default_main_program(), B,
+                                        cost)
+    assert res["tolerance"] == 8.0
+    assert res["ok"] is True, res["diverging"]
+    assert res["diverging"] == []
+    by = {r["component"]: r for r in res["rows"]}
+    assert set(by) == {"persistable_vs_argument", "ceiling_vs_peak"}
+    for r in by.values():
+        assert r["ratio"] is not None
+        assert 1 / 8.0 <= r["ratio"] <= 8.0
+
+
+def test_cross_check_resnet():
+    pt.reset_default_programs()
+    img = layers.data("img", [3, 32, 32], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    pred = models.resnet.resnet_cifar10(img, class_dim=10, depth=8)
+    loss = layers.mean(layers.cross_entropy(input=pred, label=label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    B = 4
+    feed = {"img": np.zeros((B, 3, 32, 32), "float32"),
+            "label": np.zeros((B, 1), "int64")}
+    cost = _explain_cost(loss, feed)
+    res = memory_usage_calc.cross_check(pt.default_main_program(), B,
+                                        cost)
+    assert res["ok"] is True, res["diverging"]
+    # a too-tight tolerance names the diverging component instead of
+    # failing silently
+    tight = memory_usage_calc.cross_check(pt.default_main_program(), B,
+                                          cost, tolerance=1.01)
+    assert tight["ok"] is False
+    assert tight["diverging"]
+    assert all(c in ("persistable_vs_argument", "ceiling_vs_peak")
+               for c in tight["diverging"])
+
+
+def test_cross_check_degenerate_and_errors():
+    pt.reset_default_programs()
+    x = layers.data("x", [4], dtype="float32")
+    loss = layers.mean(x)
+    # no cost model at all: no signal, no verdict, never a failure
+    res = memory_usage_calc.cross_check(pt.default_main_program(), 2,
+                                        None)
+    assert res["ok"] is True
+    assert all(r["ratio"] is None for r in res["rows"])
+    with pytest.raises(ValueError):
+        memory_usage_calc.memory_usage_bytes(pt.default_main_program(),
+                                             0)
+    lo, hi, unit = memory_usage_calc.memory_usage(
+        pt.default_main_program(), 2)
+    assert hi >= lo >= 0 and unit in ("B", "KB", "MB", "GB")
+
+
+# =========================================================================
+# satellite: bench peak-HBM row + bench_gate *_bytes direction
+# =========================================================================
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "ptpu_bench_module", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_row_carries_peak_hbm_bytes():
+    bench = _load_bench()
+    main, loss, feed = _train_program(opt="sgd")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    row = {"metric": "probe_tokens_per_sec", "unit": "tokens/s",
+           "value": 1.0, "vs_baseline": 1.0}
+    bench._attach_cost(row, exe, main, feed, loss, dt=0.01)
+    assert row["peak_hbm_bytes"] > 0
+    bench._record_row_metrics(row)
+    assert _gauge("bench_peak_hbm_bytes",
+                  metric="probe_tokens_per_sec") == row["peak_hbm_bytes"]
+
+
+def test_bytes_metrics_are_lower_is_better():
+    assert bench_gate.lower_is_better("peak_hbm_bytes") is True
+    assert bench_gate.lower_is_better("bench_peak_hbm_bytes") is True
+    assert bench_gate.lower_is_better("lm_tokens_per_sec") is False
+    # direction end to end: a fatter candidate is a named regression
+    res = bench_gate.gate({"m_bytes": 100.0}, {"m_bytes": 200.0},
+                          tolerance=0.15)
+    assert res["ok"] is False and res["regressions"] == ["m_bytes"]
+    # and a slimmer one is an improvement, not a regression
+    res = bench_gate.gate({"m_bytes": 200.0}, {"m_bytes": 100.0},
+                          tolerance=0.15)
+    assert res["ok"] is True
+
+
+def _hbm_rec(value, peak=None):
+    return {"m_tokens_per_sec": {"value": value,
+                                 "peak_hbm_bytes": peak}}
+
+
+def test_trend_peak_hbm_regression_is_named():
+    res = bench_gate.trend([
+        ("r01", _hbm_rec(100.0, peak=1.0e6)),
+        ("r02", _hbm_rec(104.0, peak=1.1e6)),
+        ("r03", _hbm_rec(110.0, peak=2.0e6)),
+    ])
+    rows = {r["metric"]: r for r in res["rows"]}
+    hrow = rows["m_tokens_per_sec.peak_hbm_bytes"]
+    assert hrow["status"] == "regression"
+    assert hrow["best"] == 1.0e6 and hrow["newest"] == 2.0e6
+    assert "m_tokens_per_sec.peak_hbm_bytes" in res["regressions"]
+    assert res["ok"] is False
+    # throughput itself improved: memory alone fails the gate
+    assert rows["m_tokens_per_sec"]["status"] == "ok"
+
+
+def test_trend_peak_hbm_first_appearance_and_missing():
+    # first post-memscope record: not a regression
+    res = bench_gate.trend([("r01", _hbm_rec(100.0)),
+                            ("r02", _hbm_rec(101.0, peak=1.0e6))])
+    rows = {r["metric"]: r for r in res["rows"]}
+    assert rows["m_tokens_per_sec.peak_hbm_bytes"]["status"] == "ok"
+    assert res["ok"] is True
+    # the newest record dropping the column is flagged missing
+    res = bench_gate.trend([("r01", _hbm_rec(100.0, peak=1.0e6)),
+                            ("r02", _hbm_rec(101.0))])
+    rows = {r["metric"]: r for r in res["rows"]}
+    assert rows["m_tokens_per_sec.peak_hbm_bytes"]["status"] == "missing"
+    assert res["ok"] is False
+    assert bench_gate.trend(
+        [("r01", _hbm_rec(100.0, peak=1.0e6)),
+         ("r02", _hbm_rec(101.0))], allow_missing=True)["ok"] is True
+    # records with no peaks anywhere grow no subseries row at all
+    res = bench_gate.trend([("r01", _hbm_rec(100.0)),
+                            ("r02", _hbm_rec(101.0))])
+    assert not [r for r in res["rows"]
+                if r["metric"].endswith(".peak_hbm_bytes")]
+
+
+def test_trend_load_record_peak_variants():
+    rec = bench_gate.load_trend_record(
+        {"summary": {"m": {"value": 7.0, "peak_hbm_bytes": 5.0e5}}})
+    assert rec["m"]["peak_hbm_bytes"] == 5.0e5
+    rec = bench_gate.load_trend_record({"metric": "m", "value": 3.0})
+    assert rec["m"]["peak_hbm_bytes"] is None
+    rec = bench_gate.load_trend_record({"m": 5.0})
+    assert rec["m"]["peak_hbm_bytes"] is None
+
+
+# =========================================================================
+# satellite: conftest isolation
+# =========================================================================
+
+def test_state_isolated_between_tests():
+    """conftest resets memscope state + the flag family around every
+    test: no census, programs, KV ledger or alloc forensics survive
+    from the earlier tests in this module."""
+    assert flags.get_flag("memscope") is False
+    assert flags.get_flag("memscope_hbm_limit_bytes") == 0
+    assert flags.get_flag("memscope_ratio_factor") == 8.0
+    doc = memscope.status_doc()
+    assert doc["planes"] == {} and doc["programs"] == {}
+    assert doc["kv"] is None
+    assert doc["alloc_failures"] == 0
+    assert doc["last_alloc_failure"] is None
