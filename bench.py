@@ -146,18 +146,30 @@ def _time_steps(exe, prog, feed, fetch, on_tpu):
     # 100 steps/dispatch: measured 20->100 takes the flagship from
     # 36.7 to 33.6 ms/step (= the traced device time); beyond that the
     # dispatch share is <1%
+    from paddle_tpu.observability import goodput as obs_goodput
+    track = obs_goodput.enabled()
     iters = 100 if on_tpu else 2
     reps = 5 if on_tpu else 1
     dt = float("inf")
+    t_c = time.perf_counter() if track else 0.0
     out = exe.run_steps(prog, feed=feed, fetch_list=[fetch],
                         steps=iters, return_numpy=False)[0]  # compile
     jax.block_until_ready(out)
+    if track:
+        # the warm-up dispatch IS the compile in this driver — feed the
+        # Timecard from the timing the bench already takes
+        obs_goodput.note_span("compile", time.perf_counter() - t_c)
+    compute_s = 0.0
     for _ in range(reps):             # best-of-reps: tunnel jitter guard
         t0 = time.perf_counter()
         out, = exe.run_steps(prog, feed=feed, fetch_list=[fetch],
                              steps=iters, return_numpy=False)
         jax.block_until_ready(out)
-        dt = min(dt, (time.perf_counter() - t0) / iters)
+        rep_dt = time.perf_counter() - t0
+        compute_s += rep_dt
+        dt = min(dt, rep_dt / iters)
+    if track:
+        obs_goodput.note_span("compute", compute_s)
     return dt, float(np.asarray(out).ravel()[-1])
 
 
@@ -806,7 +818,11 @@ def _record_row_metrics(row):
                              "loadgen run (ms)."),
                             ("peak_hbm_bytes",
                              "Cost-model peak HBM bytes of the row's "
-                             "compiled program.")):
+                             "compiled program."),
+                            ("goodput_fraction",
+                             "Timecard goodput of the row's workload: "
+                             "compute chip-seconds / tracked "
+                             "chip-seconds (higher is better).")):
         if row.get(field) is not None:
             obs.gauge(f"bench_{field}", help_str, ("metric",)).labels(
                 metric=row["metric"]).set(row[field])
@@ -818,9 +834,13 @@ def main():
     # registry dump below carries the recovery-overhead series next to
     # the bench_* gauges (BENCH rounds regress recovery cost too)
     from paddle_tpu.core import flags
+    from paddle_tpu.observability import goodput as obs_goodput
     from paddle_tpu.observability import metrics as obs
     from paddle_tpu.observability import runlog as obs_runlog
     on_tpu = jax.devices()[0].platform == "tpu"
+    # Timecard rides every row: per-workload chip-time accounting fed
+    # from the timings this driver already takes (ISSUE 19)
+    flags.set_flag("goodput", True)
     flags.set_flag("amp_bf16", True)
     # static-analysis gate (ISSUE 10): every workload's compile rejects
     # up front (ProgramVerificationError with named findings, caught by
@@ -857,8 +877,15 @@ def main():
             bench_deepfm)):
         # (new rows append at the END so earlier rows keep their
         # historical runlog step indices — the PR 7 alignment contract)
+        obs_goodput.reset()             # each row's Timecard is its own
         try:
-            rows.append(fn(on_tpu))
+            row = fn(on_tpu)
+            if row.get("goodput_fraction") is None:
+                snap = obs_goodput.snapshot()
+                if snap["tracked_s"] > 0:
+                    row["goodput_fraction"] = round(
+                        snap["goodput_fraction"], 3)
+            rows.append(row)
         except Exception as e:          # a broken workload must not hide
             errors[fn.__name__] = repr(e)[:300]
         else:
@@ -875,7 +902,8 @@ def main():
                          **{k: row[k] for k in
                             ("metric", "value", "unit", "vs_baseline",
                              "mfu", "tflops", "flops_per_step", "loss",
-                             "p99_ms", "ttft_p99_ms")
+                             "p99_ms", "ttft_p99_ms",
+                             "goodput_fraction")
                             if row.get(k) is not None})
         # re-print the cumulative result after EVERY workload (full
         # detail, for humans reading the whole log), then a COMPACT
@@ -914,7 +942,7 @@ def _compact_line(rows, errors):
     for r in rows:
         s = {"value": r["value"]}
         for k in ("mfu", "tflops", "vs_baseline", "bound",
-                  "peak_hbm_bytes"):
+                  "peak_hbm_bytes", "goodput_fraction"):
             if r.get(k) is not None:
                 s[k] = r[k]
         summary[r["metric"]] = s

@@ -316,6 +316,34 @@ define_flag("memscope_ratio_factor", 8.0,
             "analytic cost fallback double-counting donated state "
             "on backends without compiled HLO cost analysis.")
 
+# --- fleet chip-time accounting (observability/goodput.py) -----------------
+define_flag("goodput", False,
+            "Timecard chip-time accounting (observability/goodput.py): "
+            "a per-rank wall-clock state machine partitioning the "
+            "rank's lifetime into compute|input_wait|compile|"
+            "checkpoint_save|checkpoint_restore|resize_barrier|"
+            "restart_gap|drain|idle, fed from boundaries the stack "
+            "already times (trainer anatomy, executor compile spans, "
+            "checkpoint save/restore, elastic-worker waits, serving "
+            "drain).  Publishes chip_seconds_total{state} + "
+            "goodput_fraction and arms the built-in goodput_collapse "
+            "Watchtower rule.  Off: byte-identical outputs and compile "
+            "keys, zero step-path work.")
+define_flag("goodput_collapse_fraction", 0.3,
+            "goodput_collapse trip point: the built-in alert fires "
+            "when goodput_fraction (compute chip-seconds / total "
+            "tracked chip-seconds) holds at or below this value for "
+            "goodput_collapse_for_s.  Watched via the published "
+            "badput_fraction complement (>= 1 - this value), which is "
+            "0.0 until any chip-time is tracked, so an idle or "
+            "just-started rank never false-fires.  <= 0 disables the "
+            "rule.")
+define_flag("goodput_collapse_for_s", 3.0,
+            "for:-hold of the built-in goodput_collapse rule: the "
+            "fraction must stay collapsed this many seconds before "
+            "the alert fires (one slow accounting tick is not an "
+            "efficiency incident).")
+
 # --- resilience plane (resilience/: chaos, guard, retry) -------------------
 define_flag("chaos_spec", "",
             "Deterministic fault-injection spec, "

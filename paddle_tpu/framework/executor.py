@@ -811,11 +811,19 @@ class _CompiledProgram:
             # store events above/below make it visible in flight
             obs_flight.record("jit_cache", "lazy_twin_compile",
                               twin="plain", key=khash[:16])
+        t_c = time.perf_counter()
         try:
             exe = self._jitted.lower(*self._abs_args).compile()
         except Exception as e:
             pjit_cache.record_error("aot", repr(e))
             return
+        finally:
+            # Timecard (observability/goodput.py): the explicit AOT
+            # compile span — a boundary with its own start/end, never
+            # a hot-loop timer
+            from ..observability import goodput as obs_goodput
+            obs_goodput.note_span("compile",
+                                  time.perf_counter() - t_c)
         self._aot = exe
         self._persist_source = "compiled"
         if self._persist_verified:
@@ -852,12 +860,17 @@ class _CompiledProgram:
                 return
             obs_flight.record("jit_cache", "lazy_twin_compile",
                               twin="donate", key=dhash[:16])
+        t_c = time.perf_counter()
         try:
             exe = jax.jit(self._step_fn, **self._donate_kwargs()) \
                 .lower(*self._abs_args).compile()
         except Exception as e:
             pjit_cache.record_error("aot", repr(e))
             return
+        finally:
+            from ..observability import goodput as obs_goodput
+            obs_goodput.note_span("compile",
+                                  time.perf_counter() - t_c)
         self._aot_donate = exe
         self._donate_source = "compiled"
         if self._persist_verified:
@@ -941,11 +954,17 @@ class _CompiledProgram:
         if persist and self._persist_verified and key in self._multi_abs:
             # AOT-compile now (the compile the first dispatch was about
             # to pay) so the stored artifact IS the dispatched one
+            exe = None
+            t_c = time.perf_counter()
             try:
                 exe = fn.lower(*self._multi_abs[key]).compile()
             except Exception as e:
                 pjit_cache.record_error("aot", repr(e))
-            else:
+            finally:
+                from ..observability import goodput as obs_goodput
+                obs_goodput.note_span("compile",
+                                      time.perf_counter() - t_c)
+            if exe is not None:
                 pjit_cache.store("executor_multi", mhash, mcomps, exe)
                 self._multi_jit[key] = fn
                 self._multi_cache[key] = exe

@@ -52,13 +52,14 @@ reason labels) and ``jit_cache_bytes``.  Flags: ``jit_cache_dir``
 (LRU-by-mtime GC; hits touch mtime).
 
 CLI: ``python -m paddle_tpu.framework.jit_cache --dir D --ls | --gc |
---purge | --warm SRC | --self-test | --restart-probe lm`` (exit 0 ok /
-1 failure / 2 bad usage; the probe is the bench driver's cold/warm
-child).  ``--warm`` pre-seeds the cache dir from another run's (or a
-shared fleet dir's) entries — each candidate is fully validated
-(magic, schema, THIS build's env, body checksum) before the copy, so
-a new replica's first compile sites all hit without ever having
-compiled here.
+--purge | --warm SRC [DST] [--dry-run] | --self-test |
+--restart-probe lm`` (exit 0 ok / 1 failure / 2 bad usage; the probe
+is the bench driver's cold/warm child).  ``--warm`` pre-seeds the
+cache dir (or an explicit DST dir) from another run's (or a shared
+fleet dir's) entries — each candidate is fully validated (magic,
+schema, THIS build's env, body checksum) before the copy, so a new
+replica's first compile sites all hit without ever having compiled
+here; ``--dry-run`` lists what would be copied and writes nothing.
 """
 from __future__ import annotations
 
@@ -417,7 +418,8 @@ def gc(limit_bytes: Optional[int] = None) -> int:
     return evicted
 
 
-def warm(src_dir: str, dst_dir: Optional[str] = None) -> dict:
+def warm(src_dir: str, dst_dir: Optional[str] = None,
+         dry_run: bool = False) -> dict:
     """Pre-seed ``dst_dir`` (default: the active cache dir) from the
     entries in ``src_dir`` — a previous run's dir, or a shared fleet
     dir a new replica copies from before its first compile.
@@ -429,12 +431,16 @@ def warm(src_dir: str, dst_dir: Optional[str] = None) -> dict:
     never copied and never deleted from the source.  Entries already
     present in the destination are left alone (their mtime is their
     LRU clock).  Copies use the atomic-write path, so a concurrent
-    reader in the destination dir never sees a torn entry."""
+    reader in the destination dir never sees a torn entry.
+
+    ``dry_run`` validates and counts but writes nothing: ``copied``
+    becomes would-copy and ``entries`` names each candidate."""
     dst = dst_dir or cache_dir()
     env = build_env()
     fixed = len(_MAGIC) + 4
     out = {"src": src_dir, "dst": dst, "copied": 0, "present": 0,
-           "stale": 0, "corrupt": 0, "bytes": 0}
+           "stale": 0, "corrupt": 0, "bytes": 0,
+           "dry_run": bool(dry_run), "entries": []}
     for e in _entries(src_dir):
         dst_path = os.path.join(dst, os.path.basename(e["path"]))
         if os.path.exists(dst_path):
@@ -467,14 +473,16 @@ def warm(src_dir: str, dst_dir: Optional[str] = None) -> dict:
         if hashlib.sha256(body).digest() != digest:
             out["corrupt"] += 1
             continue
-        os.makedirs(dst, exist_ok=True)
-        _atomic_write(dst_path, raw)
+        out["entries"].append(os.path.basename(e["path"]))
+        if not dry_run:
+            os.makedirs(dst, exist_ok=True)
+            _atomic_write(dst_path, raw)
         out["copied"] += 1
         out["bytes"] += len(raw)
     obs_flight.record("jit_cache", "warm", src=src_dir,
                       copied=out["copied"], stale=out["stale"],
-                      corrupt=out["corrupt"])
-    if dst == cache_dir():
+                      corrupt=out["corrupt"], dry_run=bool(dry_run))
+    if not dry_run and dst == cache_dir():
         gc()                    # respect the byte limit + refresh gauge
     return out
 
@@ -671,10 +679,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="apply jit_cache_limit_bytes now")
     parser.add_argument("--purge", action="store_true",
                         help="delete every entry")
-    parser.add_argument("--warm", default=None, metavar="SRC",
-                        help="pre-seed the cache dir from SRC's entries "
-                             "(validated: only intact artifacts of THIS "
-                             "build are copied)")
+    parser.add_argument("--warm", default=None, nargs="+",
+                        metavar=("SRC", "DST"),
+                        help="pre-seed DST (default: the cache dir) "
+                             "from SRC's entries (validated: only "
+                             "intact artifacts of THIS build are "
+                             "copied)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="with --warm: validate and list what WOULD "
+                             "be copied, write nothing")
     parser.add_argument("--self-test", action="store_true",
                         help="store/load/corrupt-fallback round trip "
                              "in a temp dir")
@@ -697,16 +710,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not (args.ls or args.gc or args.purge or args.warm):
             parser.print_usage()
             return 2
-        if not cache_dir():
+        if args.warm and len(args.warm) > 2:
+            print("--warm takes SRC [DST]")
+            return 2
+        # the two-dir form names its destination explicitly — only the
+        # one-dir form (and every other op) needs an active cache dir
+        warm_dst = args.warm[1] if args.warm and len(args.warm) == 2 \
+            else None
+        needs_dir = (args.ls or args.gc or args.purge
+                     or (args.warm and warm_dst is None))
+        if needs_dir and not cache_dir():
             print("no cache dir: pass --dir or set jit_cache_dir / "
                   "PTPU_JIT_CACHE_DIR")
             return 2
         if args.warm:
-            r = warm(args.warm)
-            print(f"warm: copied {r['copied']} entr(ies) "
-                  f"({r['bytes']} bytes) from {args.warm}; "
+            r = warm(args.warm[0], dst_dir=warm_dst,
+                     dry_run=args.dry_run)
+            verb = "would copy" if args.dry_run else "copied"
+            print(f"warm: {verb} {r['copied']} entr(ies) "
+                  f"({r['bytes']} bytes) from {args.warm[0]} to "
+                  f"{r['dst']}; "
                   f"{r['present']} already present, {r['stale']} stale, "
                   f"{r['corrupt']} corrupt skipped")
+            if args.dry_run:
+                for nm in r["entries"]:
+                    print(f"  would copy {nm}")
         if args.purge:
             print(f"purged {purge()} entr(ies) from {cache_dir()}")
         if args.gc:

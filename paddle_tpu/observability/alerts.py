@@ -468,6 +468,27 @@ def default_rules() -> List[Rule]:
                       "allocation is an OOM candidate (memscope "
                       "context names the fattest plane and top owner)",
           context_fn=memscope.alert_context)
+    # Timecard goodput collapse: same gated idiom — the context_fn
+    # names the dominant badput state (the scalar fraction says "bad",
+    # the breakdown says WHERE the chip-seconds went).  The rule
+    # thresholds badput_fraction (the published complement), not
+    # goodput_fraction: a labelless gauge's 0.0 default series would
+    # make a "goodput low" rule false-fire on a rank that has not
+    # tracked any chip-time yet, while 0.0 badput is the safe end
+    from . import goodput
+    gfrac = float(flags.get_flag("goodput_collapse_fraction"))
+    if goodput.enabled() and gfrac > 0.0:
+        r(name="goodput_collapse",
+          metric="badput_fraction", predicate="threshold",
+          op=">=", value=round(1.0 - gfrac, 6),
+          for_seconds=float(flags.get_flag("goodput_collapse_for_s")),
+          severity="critical",
+          description="non-compute's share of tracked chip-seconds "
+                      "held at or above 1 - goodput_collapse_fraction "
+                      "— the fleet is paying for chips it is not "
+                      "training on (goodput context names the "
+                      "dominant badput state)",
+          context_fn=goodput.alert_context)
     return out
 
 

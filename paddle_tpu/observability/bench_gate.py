@@ -93,10 +93,13 @@ def load_trend_record(doc: dict) -> Dict[str, dict]:
                 out[m] = {"value": float(row["value"]),
                           "mfu": row.get("mfu"),
                           "bound": row.get("bound")}
-                # pre-Memscope summaries carry no peak: keep their
-                # loaded shape unchanged, key present only when dumped
+                # pre-Memscope summaries carry no peak and pre-Timecard
+                # ones no goodput: keep their loaded shape unchanged,
+                # keys present only when dumped
                 if row.get("peak_hbm_bytes") is not None:
                     out[m]["peak_hbm_bytes"] = row["peak_hbm_bytes"]
+                if row.get("goodput_fraction") is not None:
+                    out[m]["goodput_fraction"] = row["goodput_fraction"]
             else:
                 out[m] = {"value": float(row), "mfu": None,
                           "bound": None, "peak_hbm_bytes": None}
@@ -106,9 +109,10 @@ def load_trend_record(doc: dict) -> Dict[str, dict]:
         return {str(doc["metric"]): {
             "value": float(doc["value"]), "mfu": doc.get("mfu"),
             "bound": doc.get("bound"),
-            "peak_hbm_bytes": doc.get("peak_hbm_bytes")}}
+            "peak_hbm_bytes": doc.get("peak_hbm_bytes"),
+            "goodput_fraction": doc.get("goodput_fraction")}}
     return {m: {"value": v, "mfu": None, "bound": None,
-                "peak_hbm_bytes": None}
+                "peak_hbm_bytes": None, "goodput_fraction": None}
             for m, v in load_metric_values(doc).items()}
 
 
@@ -168,6 +172,20 @@ def trend(records: List, tolerance: float = 0.15,
             if (newest.get(metric) or {}).get("mfu") is None:
                 mrow["status"] = "missing"
             rows.append(mrow)
+        if any((rec.get(metric) or {}).get("goodput_fraction")
+               is not None for _, rec in records):
+            # Timecard subseries (ISSUE 19): goodput is higher-is-
+            # better like MFU — a release whose rows spend more
+            # chip-time outside compute regresses by name
+            gseries = [(name,
+                        (rec.get(metric) or {}).get("goodput_fraction"))
+                       for name, rec in records]
+            grow = row_for(f"{metric}.goodput_fraction", gseries,
+                           False, "fraction")
+            if (newest.get(metric) or {}).get("goodput_fraction") \
+                    is None:
+                grow["status"] = "missing"
+            rows.append(grow)
         if any((rec.get(metric) or {}).get("peak_hbm_bytes") is not None
                for _, rec in records):
             # memory subseries: the "_bytes" suffix routes through the

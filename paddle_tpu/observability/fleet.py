@@ -771,6 +771,18 @@ class FleetAggregator:
         return {str(r): obs_memscope.rows_from_metrics_doc(doc)
                 for r, doc in sorted(docs.items())}
 
+    def goodput_rows(self) -> Dict[str, dict]:
+        """Per-rank chip-time breakdown reconstructed from each
+        worker's last shipped metric snapshot (chip_seconds_total /
+        goodput_fraction families) — the fleet-merged half of
+        GET /goodput."""
+        from . import goodput as obs_goodput
+        with self._lock:
+            docs = {r: w.get("metrics") for r, w in self._workers.items()
+                    if isinstance(w.get("metrics"), dict)}
+        return {str(r): obs_goodput.rows_from_metrics_doc(doc)
+                for r, doc in sorted(docs.items())}
+
     def health(self) -> dict:
         """Liveness summary for /healthz: per-worker report age, stale
         set, straggler set, and the fleet degraded verdict."""

@@ -1,0 +1,833 @@
+"""Timecard: fleet chip-time accounting and goodput/badput attribution.
+
+Every other observability plane measures instantaneous rates or single
+events; this one integrates over time.  A per-rank wall-clock **state
+machine** partitions the rank's lifetime into monotonic, non-overlapping
+segments drawn from a closed state catalog:
+
+  compute | input_wait | compile | checkpoint_save | checkpoint_restore
+  | resize_barrier | restart_gap | drain | idle
+
+and answers the production question "what fraction of paid chip-seconds
+did useful training?" — goodput, the fleet-level complement of MFU.
+
+Feeding discipline (the tentpole constraint): NO new timers in hot
+loops.  Every segment transition happens at a boundary the stack
+already times —
+
+* the trainer's per-step anatomy split (data-wait / host / device,
+  PR 4) feeds ``input_wait`` and ``compute`` via :func:`note_step`;
+* the executor's explicit AOT compile spans feed ``compile`` via
+  :func:`note_span`;
+* checkpoint save/restore in the trainer and the elastic worker feed
+  ``checkpoint_save`` / ``checkpoint_restore``;
+* the elastic worker's existing wait/retire boundaries feed ``idle``
+  and ``resize_barrier`` via :func:`note_wait`;
+* the serving batcher's drain_begin/drain_complete boundary feeds
+  ``drain``;
+* restart gaps (death -> respawn) and park gaps exist only OUTSIDE a
+  process lifetime, so the live plane never records them — the offline
+  reconstructor derives them from supervisor journal pairs.
+
+Conservation invariant (asserted in the tier-1 elastic soak): the
+accounting clock ``_last_perf`` only moves forward and every charge
+advances it, so per-rank segments are non-overlapping BY CONSTRUCTION
+and their sum equals the rank's tracked wall time exactly.  A span
+reported with a duration that overlaps already-charged time is clipped
+(never double-booked), and :func:`note_step` scales its anatomy parts
+to the unclaimed remainder when a compile span already ate into the
+step's wall.
+
+Surfaces:
+
+* live: ``chip_seconds_total{state}`` + ``goodput_fraction`` on the
+  registry (local and fleet-merged /metrics), ``GET /goodput`` with
+  per-rank rows via fleet.goodput_rows();
+* offline: ``python -m paddle_tpu.observability.goodput <journal...>``
+  replays the fleet journal (+ optional runlog) into the same per-rank
+  timeline — a badput breakdown table and an ASCII timeline, with
+  ``--compare`` across two runs and the runlog CLI exit-code contract
+  (0 ok / 1 goodput regression / 2 bad input);
+* alerting: the built-in ``goodput_collapse`` Watchtower rule
+  (alerts.default_rules) fires when ``badput_fraction`` (the published
+  complement — 0.0 until any chip-time is tracked, so an idle fresh
+  rank can never false-fire) holds at or above
+  ``1 - goodput_collapse_fraction``, with this module's
+  :func:`alert_context` naming the dominant badput state.
+
+Everything is gated on the ``goodput`` flag: off means byte-identical
+outputs and compile keys and zero step-path work (one flag read per
+already-existing boundary).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import flags
+from . import metrics as obs_metrics
+
+# the closed state catalog; compute is the only goodput state
+STATES = ("compute", "input_wait", "compile", "checkpoint_save",
+          "checkpoint_restore", "resize_barrier", "restart_gap",
+          "drain", "idle")
+GOOD_STATE = "compute"
+BADPUT_STATES = tuple(s for s in STATES if s != GOOD_STATE)
+
+SCHEMA = "paddle_tpu.goodput.v1"
+
+# --- registry metrics ------------------------------------------------------
+_m_chip = obs_metrics.counter(
+    "chip_seconds_total",
+    "Accounted chip-seconds per Timecard state (compute|input_wait|"
+    "compile|checkpoint_save|checkpoint_restore|resize_barrier|"
+    "restart_gap|drain|idle).  Per-rank segments are non-overlapping "
+    "and sum to the rank's tracked wall time (the conservation "
+    "invariant).", ("state",))
+_m_fraction = obs_metrics.gauge(
+    "goodput_fraction",
+    "compute chip-seconds / total tracked chip-seconds of this rank.")
+# the alerting series: a labelless gauge always exposes a 0.0 default
+# series, so a low-goodput rule thresholding goodput_fraction directly
+# would false-fire on a rank that has not tracked ANY chip-time yet.
+# The complement starts at the safe end: 0.0 badput until data exists,
+# and goodput_collapse fires on badput_fraction >= 1 - collapse_fraction
+_m_badput = obs_metrics.gauge(
+    "badput_fraction",
+    "1 - goodput_fraction once chip-time is tracked (0 before): the "
+    "built-in goodput_collapse alert fires when this holds at or "
+    "above 1 - goodput_collapse_fraction.")
+
+# durations below this are noise, not segments (clock granularity)
+_EPS = 1e-9
+# timeline ring bound: merging makes transitions rare, but a pathological
+# alternation must not grow without bound
+_SEG_MAX = 4096
+
+_lock = threading.RLock()
+_t0_unix: Optional[float] = None
+_t0_perf: Optional[float] = None
+_last_perf: Optional[float] = None
+_accum: Dict[str, float] = {}
+_segments: List[dict] = []          # closed segments (merged)
+_cur: Optional[dict] = None         # open segment {state, start_unix, dur}
+_drain_start: Optional[float] = None
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("goodput"))
+
+
+# --- the state machine -----------------------------------------------------
+
+def _ensure_started_locked(now_perf: float):
+    global _t0_unix, _t0_perf, _last_perf
+    if _t0_perf is None:
+        _t0_perf = _last_perf = now_perf
+        _t0_unix = time.time() - (time.perf_counter() - now_perf)
+
+
+def _close_cur_locked():
+    """Close the open segment: move it to the ring and (journal on)
+    emit it — the offline reconstructor's per-rank timeline source."""
+    global _cur
+    if _cur is None or _cur["dur"] <= _EPS:
+        _cur = None
+        return
+    seg = {"state": _cur["state"],
+           "start_unix": round(_cur["start_unix"], 6),
+           "dur": round(_cur["dur"], 6)}
+    _segments.append(seg)
+    if len(_segments) > _SEG_MAX:
+        del _segments[:_SEG_MAX // 2]
+    _cur = None
+    from . import journal as obs_journal
+    obs_journal.emit("goodput", "segment", state=seg["state"],
+                     seg_start_unix=seg["start_unix"], dur=seg["dur"])
+
+
+def _charge_locked(state: str, start_perf: float, dur: float):
+    """Book ``dur`` seconds of ``state`` starting at ``start_perf``.
+    Callers guarantee start_perf >= _last_perf (monotonic)."""
+    global _cur, _last_perf
+    if dur <= _EPS:
+        return
+    _accum[state] = _accum.get(state, 0.0) + dur
+    _m_chip.labels(state=state).inc(dur)
+    start_unix = _t0_unix + (start_perf - _t0_perf)
+    if _cur is not None and _cur["state"] == state:
+        _cur["dur"] = (start_perf + dur) - _cur["_start_perf"]
+    else:
+        _close_cur_locked()
+        _cur = {"state": state, "start_unix": start_unix,
+                "_start_perf": start_perf, "dur": dur}
+    _last_perf = max(_last_perf, start_perf + dur)
+    total = sum(_accum.values())
+    if total > _EPS:
+        frac = _accum.get(GOOD_STATE, 0.0) / total
+        _m_fraction.set(frac)
+        _m_badput.set(1.0 - frac)
+
+
+def note_wait(state: str):
+    """Charge everything since the last accounted boundary to
+    ``state`` — the elastic worker's idle/resize spin and RPC waits
+    (the sleep/return IS the boundary; no new timer)."""
+    if not enabled():
+        return
+    now = time.perf_counter()
+    with _lock:
+        _ensure_started_locked(now)
+        _charge_locked(state, _last_perf, now - _last_perf)
+
+
+def note_span(state: str, seconds: float):
+    """Charge a span that just ENDED with a caller-measured duration
+    (compile spans, checkpoint save/restore).  The span is clipped to
+    the unclaimed interval — time already booked is never re-booked —
+    and any gap between the last boundary and the span start is idle."""
+    if not enabled():
+        return
+    now = time.perf_counter()
+    seconds = max(0.0, float(seconds))
+    with _lock:
+        # first-ever charge: start the clock at the span START so the
+        # span itself is inside the tracked window
+        _ensure_started_locked(now - seconds)
+        start = max(_last_perf, now - seconds)
+        gap = start - _last_perf
+        if gap > _EPS:
+            _charge_locked("idle", _last_perf, gap)
+        _charge_locked(state, start, now - start)
+
+
+def note_step(data_wait_s: float, host_s: float, device_s: float,
+              wall_s: float):
+    """The trainer anatomy seam (PR 4 splits, measured already): one
+    training step's wall partitions into input_wait (reader next +
+    feed build), compute (dispatch + device), and idle residual.  When
+    part of the step's wall was already claimed (an executor compile
+    span fired mid-step), the anatomy is scaled down proportionally to
+    the unclaimed remainder so the conservation invariant holds."""
+    if not enabled():
+        return
+    now = time.perf_counter()
+    wall = max(0.0, float(wall_s))
+    with _lock:
+        # first-ever charge: start the clock at the step START so the
+        # step itself is inside the tracked window
+        _ensure_started_locked(now - wall)
+        avail = now - _last_perf
+        if avail <= _EPS:
+            return
+        if avail > wall:
+            # inter-step gap (event handlers, between-epoch work)
+            _charge_locked("idle", _last_perf, avail - wall)
+            avail = wall
+        parts = [("input_wait", max(0.0, float(data_wait_s))),
+                 ("compute", max(0.0, float(host_s))
+                  + max(0.0, float(device_s)))]
+        part_sum = sum(p for _, p in parts)
+        parts.append(("idle", max(0.0, wall - part_sum)))
+        total = max(part_sum, wall)
+        scale = avail / total if total > _EPS else 0.0
+        cursor = _last_perf
+        for state, dur in parts:
+            d = dur * scale
+            if d <= _EPS:
+                continue
+            _charge_locked(state, cursor, d)
+            cursor += d
+
+
+def note_drain_begin():
+    """Serving drain started (batcher.begin_drain — already journals
+    here); the matching note_drain_end charges the span."""
+    if not enabled():
+        return
+    global _drain_start
+    with _lock:
+        _drain_start = time.perf_counter()
+
+
+def note_drain_end():
+    if not enabled():
+        return
+    global _drain_start
+    with _lock:
+        if _drain_start is None:
+            return
+        dur = time.perf_counter() - _drain_start
+        _drain_start = None
+    note_span("drain", dur)
+
+
+def flush():
+    """Close the open segment (journal emit) — call before reading a
+    final snapshot or exiting, so the timeline is complete."""
+    if not enabled():
+        return
+    with _lock:
+        _close_cur_locked()
+
+
+def emit_final():
+    """Journal this rank's final per-state totals — the offline
+    reconstructor's per-rank breakdown source (segments give the
+    timeline; the final gives totals that survive ring bounds)."""
+    if not enabled():
+        return
+    with _lock:
+        _close_cur_locked()
+        snap = _snapshot_locked()
+    from . import journal as obs_journal
+    obs_journal.emit("goodput", "final",
+                     states={k: round(v, 6)
+                             for k, v in snap["states"].items()},
+                     wall_s=round(snap["wall_s"], 6),
+                     fraction=snap["goodput_fraction"])
+
+
+# --- reading ---------------------------------------------------------------
+
+def _snapshot_locked() -> dict:
+    tracked = sum(_accum.values())
+    wall = 0.0 if _t0_perf is None else (_last_perf - _t0_perf)
+    frac = (_accum.get(GOOD_STATE, 0.0) / tracked) if tracked > _EPS \
+        else 0.0
+    segs = list(_segments)
+    if _cur is not None and _cur["dur"] > _EPS:
+        segs.append({"state": _cur["state"],
+                     "start_unix": round(_cur["start_unix"], 6),
+                     "dur": round(_cur["dur"], 6)})
+    return {"states": {k: round(v, 6) for k, v in sorted(_accum.items())},
+            "wall_s": round(wall, 6),
+            "tracked_s": round(tracked, 6),
+            "goodput_fraction": round(frac, 6),
+            "started_unix": _t0_unix,
+            "segments": segs}
+
+
+def snapshot() -> dict:
+    """This rank's accounting: per-state seconds, tracked wall, the
+    fraction, and the (bounded) segment timeline."""
+    with _lock:
+        return _snapshot_locked()
+
+
+def fraction() -> float:
+    with _lock:
+        tracked = sum(_accum.values())
+        return (_accum.get(GOOD_STATE, 0.0) / tracked) \
+            if tracked > _EPS else 0.0
+
+
+def dominant_badput() -> Tuple[Optional[str], float]:
+    """(state, seconds) of the largest non-compute accumulator — the
+    goodput_collapse alert's context headline."""
+    with _lock:
+        bad = [(s, _accum.get(s, 0.0)) for s in BADPUT_STATES
+               if _accum.get(s, 0.0) > _EPS]
+    if not bad:
+        return None, 0.0
+    return max(bad, key=lambda kv: kv[1])
+
+
+def status_doc() -> dict:
+    """One document behind GET /goodput and the live CLI."""
+    doc = snapshot()
+    doc["schema"] = SCHEMA
+    doc["enabled"] = enabled()
+    doc["states_catalog"] = list(STATES)
+    state, secs = dominant_badput()
+    doc["dominant_badput"] = state
+    doc["dominant_badput_s"] = round(secs, 6)
+    return doc
+
+
+def rows_from_metrics_doc(doc: Optional[dict]) -> dict:
+    """Reconstruct the per-rank breakdown from a metrics DOCUMENT
+    (this process's registry or a fleet worker's shipped snapshot) —
+    what fleet.goodput_rows() builds the per-rank merged view from."""
+    fams = (doc or {}).get("metrics") or {}
+
+    def series(name):
+        return (fams.get(name) or {}).get("series") or []
+
+    states: Dict[str, float] = {}
+    for row in series("chip_seconds_total"):
+        state = (row.get("labels") or {}).get("state")
+        if state:
+            states[state] = float(row.get("value", 0.0))
+    # derive the fraction from the chip-second counters, never from the
+    # gauge: a labelless gauge exposes a 0.0 default series even on a
+    # rank that tracked nothing, and "no data" must read as None here
+    total = sum(states.values())
+    frac = states.get(GOOD_STATE, 0.0) / total if total > _EPS else None
+    return {"states": states, "goodput_fraction": frac,
+            "chip_seconds": round(total, 6)}
+
+
+def alert_context(labels: Dict[str, str]) -> dict:
+    """Context for the built-in goodput_collapse rule: the fraction
+    plus the dominant badput state the operator should chase."""
+    state, secs = dominant_badput()
+    with _lock:
+        states = {k: round(v, 6) for k, v in sorted(_accum.items())}
+    return {"goodput_fraction": round(fraction(), 6),
+            "dominant_badput": state,
+            "dominant_badput_s": round(secs, 6),
+            "chip_seconds": states}
+
+
+def reset():
+    """Drop the accounting clock, accumulators, timeline and both
+    metric families (conftest: one test's chip-time must not leak
+    into the next)."""
+    global _t0_unix, _t0_perf, _last_perf, _cur, _drain_start
+    with _lock:
+        _t0_unix = _t0_perf = _last_perf = None
+        _accum.clear()
+        _segments.clear()
+        _cur = None
+        _drain_start = None
+    _m_chip.clear()
+    _m_fraction.clear()
+    _m_badput.clear()
+
+
+# --- offline reconstructor -------------------------------------------------
+
+def reconstruct_events(events: Sequence[dict],
+                       runlog_records: Optional[Sequence[dict]] = None
+                       ) -> dict:
+    """Replay a merged journal stream (+ optional runlog) into the
+    same per-rank timeline the live plane publishes.
+
+    * ``goodput/segment`` events give each rank's timeline and
+      ``goodput/final`` events its live per-state totals (summed over
+      incarnations, so a retired-then-revived rank accumulates);
+    * restart gaps come from supervisor ``restart -> spawn`` pairs and
+      park gaps (a shrink parking the rank until a later grow) from
+      ``park -> spawn`` pairs — chip-time no process could account for
+      itself, kept under offline-only keys per rank;
+    * ``master/resize_applied`` events become the fleet resize log;
+    * runlog step records back-fill compute/input_wait for a rank that
+      journaled but never ran the live plane (goodput off, journal on).
+    """
+    ranks: Dict[int, dict] = {}
+
+    def rank_rec(r) -> dict:
+        return ranks.setdefault(int(r), {
+            "states": {}, "offline_states": {}, "segments": [],
+            "finals": 0})
+
+    pending: Dict[int, Tuple[str, float]] = {}   # worker -> (why, t)
+    restart_gaps: List[dict] = []
+    resizes: List[dict] = []
+    for e in events:
+        kind, ev = e.get("kind"), e.get("event")
+        t = float(e.get("time_unix", 0.0))
+        if kind == "goodput" and ev == "segment":
+            rec = rank_rec(e.get("rank", 0))
+            rec["segments"].append(
+                {"state": e.get("state"),
+                 "start_unix": float(e.get("seg_start_unix", t)),
+                 "dur": float(e.get("dur", 0.0))})
+        elif kind == "goodput" and ev == "final":
+            rec = rank_rec(e.get("rank", 0))
+            rec["finals"] += 1
+            for s, v in (e.get("states") or {}).items():
+                rec["states"][s] = rec["states"].get(s, 0.0) + float(v)
+        elif kind == "supervisor" and ev in ("restart", "park"):
+            w = e.get("worker")
+            if w is not None:
+                pending[int(w)] = (ev, t)
+        elif kind == "supervisor" and ev == "spawn":
+            w = e.get("worker")
+            if w is None or int(w) not in pending:
+                continue
+            why, t_dead = pending.pop(int(w))
+            gap = max(0.0, t - t_dead)
+            state = "restart_gap" if why == "restart" \
+                else "resize_barrier"
+            rec = rank_rec(w)
+            rec["offline_states"][state] = \
+                rec["offline_states"].get(state, 0.0) + gap
+            rec["segments"].append({"state": state, "start_unix": t_dead,
+                                    "dur": gap, "offline": True})
+            restart_gaps.append({"rank": int(w), "why": why,
+                                 "start_unix": t_dead,
+                                 "dur": round(gap, 6)})
+        elif kind == "master" and ev == "resize_applied":
+            resizes.append({"old": e.get("old_world"),
+                            "new": e.get("new_world"),
+                            "epoch": e.get("epoch"), "time_unix": t})
+    # a rank that never closed a final still has segments: derive its
+    # live totals from them so a chaos-killed incarnation's chip-time
+    # is not dropped from the fleet sum
+    for rec in ranks.values():
+        if rec["finals"] == 0 and rec["segments"]:
+            for seg in rec["segments"]:
+                if seg.get("offline"):
+                    continue
+                rec["states"][seg["state"]] = \
+                    rec["states"].get(seg["state"], 0.0) + seg["dur"]
+    # runlog back-fill: only for the emitting rank 0 timeline when no
+    # goodput events exist at all (journal-only runs)
+    if runlog_records and not any(r["states"] or r["segments"]
+                                  for r in ranks.values()):
+        rec = rank_rec(0)
+        for r in runlog_records:
+            if r.get("kind") not in ("step", "bench"):
+                continue
+            dt = float(r.get("step_seconds", r.get("seconds", 0.0))
+                       or 0.0)
+            dw = float(r.get("data_wait_seconds", 0.0) or 0.0)
+            if dt <= 0.0:
+                continue
+            rec["states"]["input_wait"] = \
+                rec["states"].get("input_wait", 0.0) + min(dw, dt)
+            rec["states"][GOOD_STATE] = \
+                rec["states"].get(GOOD_STATE, 0.0) + max(0.0, dt - dw)
+    fleet: Dict[str, float] = {}
+    out_ranks: Dict[str, dict] = {}
+    for r in sorted(ranks):
+        rec = ranks[r]
+        full = dict(rec["states"])
+        for s, v in rec["offline_states"].items():
+            full[s] = full.get(s, 0.0) + v
+        for s, v in full.items():
+            fleet[s] = fleet.get(s, 0.0) + v
+        tracked = sum(full.values())
+        segs = sorted(rec["segments"],
+                      key=lambda seg: seg["start_unix"])
+        out_ranks[str(r)] = {
+            "states": {k: round(v, 6)
+                       for k, v in sorted(rec["states"].items())},
+            "offline_states": {k: round(v, 6) for k, v in
+                               sorted(rec["offline_states"].items())},
+            "states_full": {k: round(v, 6)
+                            for k, v in sorted(full.items())},
+            "chip_seconds": round(tracked, 6),
+            "goodput_fraction": round(
+                full.get(GOOD_STATE, 0.0) / tracked, 6)
+            if tracked > _EPS else 0.0,
+            "segments": segs}
+    total = sum(fleet.values())
+    return {"schema": SCHEMA, "source": "journal",
+            "ranks": out_ranks,
+            "fleet": {"states": {k: round(v, 6)
+                                 for k, v in sorted(fleet.items())},
+                      "chip_seconds": round(total, 6),
+                      "goodput_fraction": round(
+                          fleet.get(GOOD_STATE, 0.0) / total, 6)
+                      if total > _EPS else 0.0},
+            "restart_gaps": restart_gaps, "resizes": resizes}
+
+
+def reconstruct(journal_paths: Sequence[str],
+                runlog_path: Optional[str] = None) -> dict:
+    """File wrapper over :func:`reconstruct_events`.  Raises OSError /
+    ValueError on unreadable or wrong-schema inputs (CLI exit 2)."""
+    from . import journal as obs_journal
+    streams = [obs_journal.read_events(p) for p in journal_paths]
+    events = obs_journal.merge_events(streams)
+    records = None
+    if runlog_path:
+        from . import runlog as obs_runlog
+        records = obs_runlog.read_records(runlog_path)
+    return reconstruct_events(events, runlog_records=records)
+
+
+# --- rendering -------------------------------------------------------------
+
+_TL_CHARS = {"compute": "#", "input_wait": "i", "compile": "c",
+             "checkpoint_save": "s", "checkpoint_restore": "r",
+             "resize_barrier": "b", "restart_gap": "x", "drain": "d",
+             "idle": "."}
+
+
+def badput_table(doc: dict) -> List[str]:
+    """The breakdown table: one row per state, fleet-summed, plus a
+    per-rank goodput column block."""
+    fleet = doc.get("fleet") or {}
+    states = fleet.get("states") or {}
+    total = fleet.get("chip_seconds") or sum(states.values()) or 0.0
+    lines = ["goodput breakdown "
+             f"(fleet chip-seconds {total:.2f}, goodput "
+             f"{100.0 * (fleet.get('goodput_fraction') or 0.0):.1f}%)",
+             f"  {'state':<20} {'seconds':>10} {'share':>7}"]
+    for state in STATES:
+        v = states.get(state, 0.0)
+        if v <= _EPS:
+            continue
+        share = v / total if total > _EPS else 0.0
+        tag = " (goodput)" if state == GOOD_STATE else ""
+        lines.append(f"  {state:<20} {v:>10.2f} {share:>6.1%}{tag}")
+    lines.append("  per-rank goodput:")
+    for r, rec in sorted((doc.get("ranks") or {}).items(),
+                         key=lambda kv: int(kv[0])):
+        lines.append(
+            f"    rank {r}: {100.0 * rec['goodput_fraction']:.1f}% of "
+            f"{rec['chip_seconds']:.2f}s")
+    return lines
+
+
+def timeline_lines(doc: dict, width: int = 64) -> List[str]:
+    """ASCII per-rank timeline: one row per rank, one column per time
+    bucket, the bucket's dominant state as its glyph."""
+    ranks = doc.get("ranks") or {}
+    segs = [s for rec in ranks.values() for s in rec.get("segments", [])
+            if s.get("dur", 0.0) > _EPS]
+    if not segs:
+        return ["(no segments to draw)"]
+    t0 = min(s["start_unix"] for s in segs)
+    t1 = max(s["start_unix"] + s["dur"] for s in segs)
+    span = max(t1 - t0, _EPS)
+    lines = [f"timeline ({span:.1f}s, {width} cols; "
+             + " ".join(f"{c}={s}" for s, c in _TL_CHARS.items()) + ")"]
+    for r, rec in sorted(ranks.items(), key=lambda kv: int(kv[0])):
+        buckets: List[Dict[str, float]] = [{} for _ in range(width)]
+        for seg in rec.get("segments", []):
+            lo = (seg["start_unix"] - t0) / span * width
+            hi = (seg["start_unix"] + seg["dur"] - t0) / span * width
+            for i in range(max(0, int(lo)),
+                           min(width, int(hi) + 1)):
+                ov = min(hi, i + 1) - max(lo, i)
+                if ov > 0:
+                    b = buckets[i]
+                    b[seg["state"]] = b.get(seg["state"], 0.0) + ov
+        row = "".join(
+            _TL_CHARS.get(max(b, key=b.get), "?") if b else " "
+            for b in buckets)
+        lines.append(f"  rank {r:>2} |{row}|")
+    return lines
+
+
+def compare_docs(a: dict, b: dict, tolerance: float = 0.1
+                 ) -> Tuple[List[str], bool]:
+    """Side-by-side fleet breakdown of two reconstructed runs; the
+    second run regresses when its fleet goodput_fraction drops more
+    than ``tolerance`` (absolute) below the first's."""
+    fa, fb = a.get("fleet") or {}, b.get("fleet") or {}
+    ga = fa.get("goodput_fraction") or 0.0
+    gb = fb.get("goodput_fraction") or 0.0
+    lines = [f"  {'state':<20} {'run A (s)':>10} {'run B (s)':>10}"]
+    sa, sb = fa.get("states") or {}, fb.get("states") or {}
+    for state in STATES:
+        va, vb = sa.get(state, 0.0), sb.get(state, 0.0)
+        if va <= _EPS and vb <= _EPS:
+            continue
+        lines.append(f"  {state:<20} {va:>10.2f} {vb:>10.2f}")
+    lines.append(f"  {'goodput_fraction':<20} {ga:>10.3f} {gb:>10.3f}")
+    regressed = (ga - gb) > tolerance
+    if regressed:
+        lines.append(f"  REGRESSION: goodput dropped "
+                     f"{ga - gb:.3f} (> tolerance {tolerance})")
+    return lines, regressed
+
+
+def incident_section(events: Sequence[dict],
+                     min_spike_s: float = 0.25, top: int = 8) -> dict:
+    """The incident --goodput join: the largest badput segments in the
+    window, each annotated with the alert fires / controller decisions
+    within +-5s — "the fleet idled HERE, while THIS was firing"."""
+    doc = reconstruct_events(events)
+    spikes = []
+    for r, rec in (doc.get("ranks") or {}).items():
+        for seg in rec.get("segments", []):
+            if seg["state"] == GOOD_STATE or seg["dur"] < min_spike_s:
+                continue
+            spikes.append({"rank": int(r), "state": seg["state"],
+                           "start_unix": seg["start_unix"],
+                           "dur": round(seg["dur"], 3)})
+    spikes.sort(key=lambda s: -s["dur"])
+    spikes = spikes[:top]
+    for sp in spikes:
+        lo, hi = sp["start_unix"] - 5.0, \
+            sp["start_unix"] + sp["dur"] + 5.0
+        near = []
+        for e in events:
+            if e.get("kind") not in ("alert", "controller"):
+                continue
+            t = float(e.get("time_unix", 0.0))
+            if lo <= t <= hi:
+                near.append(f"{e.get('kind')}/{e.get('event')} "
+                            f"{e.get('rule') or e.get('action') or ''}"
+                            .strip())
+        sp["nearby"] = near[:6]
+    return {"fleet": doc.get("fleet"), "spikes": spikes,
+            "restart_gaps": doc.get("restart_gaps"),
+            "resizes": doc.get("resizes")}
+
+
+# --- CLI -------------------------------------------------------------------
+
+def _self_test() -> int:
+    """Hermetic fixture smoke (the perfscope/memscope CLI idiom):
+    synthetic charges + a synthetic journal replay against TEMPORARY
+    flag state; prints one GOODPUT_SELF_TEST json line, exit 0 on
+    pass."""
+    saved = {k: flags.get_flag(k) for k in
+             ("goodput", "goodput_collapse_fraction")}
+    flags.set_flag("goodput", True)
+    reset()
+    try:
+        checks = {}
+        # a synthetic rank lifetime: wait, a step, a compile span, a
+        # checkpoint — conservation must hold exactly
+        note_wait("idle")
+        note_span("compile", 0.0)      # zero-length span: no-op
+        t_before = time.perf_counter()
+        while time.perf_counter() - t_before < 0.002:
+            pass
+        note_step(data_wait_s=0.001, host_s=0.001, device_s=0.0,
+                  wall_s=0.002)
+        note_span("checkpoint_save", 0.0005)
+        note_wait("resize_barrier")
+        snap = snapshot()
+        tracked, wall = snap["tracked_s"], snap["wall_s"]
+        checks["conservation"] = abs(tracked - wall) <= 0.05 * max(
+            wall, 1e-6)
+        checks["has_compute"] = snap["states"].get("compute", 0.0) > 0
+        checks["has_input_wait"] = \
+            snap["states"].get("input_wait", 0.0) > 0
+        segs = snap["segments"]
+        checks["segments_sorted"] = all(
+            a["start_unix"] + a["dur"] <= b["start_unix"] + 1e-6
+            for a, b in zip(segs, segs[1:]))
+        state, _secs = dominant_badput()
+        checks["dominant_badput"] = state in BADPUT_STATES
+        ctx = alert_context({})
+        checks["alert_context"] = ctx["dominant_badput"] == state
+        # offline replay: synthetic journal with a goodput final, a
+        # segment, and a supervisor restart->spawn pair
+        base = 1000.0
+        events = [
+            {"kind": "supervisor", "event": "spawn", "worker": 1,
+             "time_unix": base, "rank": 0, "pid": 1, "seq": 1},
+            {"kind": "goodput", "event": "segment", "rank": 1,
+             "state": "compute", "seg_start_unix": base + 1.0,
+             "dur": 3.0, "time_unix": base + 4.0, "pid": 2, "seq": 1},
+            {"kind": "goodput", "event": "final", "rank": 1,
+             "states": {"compute": 3.0, "idle": 1.0},
+             "wall_s": 4.0, "fraction": 0.75,
+             "time_unix": base + 5.0, "pid": 2, "seq": 2},
+            {"kind": "supervisor", "event": "restart", "worker": 1,
+             "rc": 1, "time_unix": base + 5.5, "rank": 0, "pid": 1,
+             "seq": 2},
+            {"kind": "supervisor", "event": "spawn", "worker": 1,
+             "time_unix": base + 7.5, "rank": 0, "pid": 1, "seq": 3},
+            {"kind": "master", "event": "resize_applied",
+             "old_world": 2, "new_world": 4, "epoch": 1,
+             "time_unix": base + 8.0, "rank": 0, "pid": 3, "seq": 1},
+        ]
+        doc = reconstruct_events(events)
+        r1 = doc["ranks"]["1"]
+        checks["replay_states"] = r1["states"].get("compute") == 3.0
+        checks["replay_restart_gap"] = abs(
+            r1["offline_states"].get("restart_gap", 0.0) - 2.0) < 1e-6
+        checks["replay_resizes"] = doc["resizes"][0]["new"] == 4
+        checks["table_renders"] = len(badput_table(doc)) >= 3
+        checks["timeline_renders"] = any(
+            "#" in ln for ln in timeline_lines(doc, width=24))
+        _lines, regressed = compare_docs(doc, doc, tolerance=0.1)
+        checks["self_compare_clean"] = not regressed
+        ok = all(checks.values())
+        print("GOODPUT_SELF_TEST " + json.dumps(
+            {"ok": ok, "checks": checks}, sort_keys=True))
+        return 0 if ok else 1
+    finally:
+        reset()
+        for k, v in saved.items():
+            flags.set_flag(k, v)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.goodput",
+        description="Timecard: fleet chip-time accounting — live "
+                    "report, or offline journal replay into a per-rank "
+                    "goodput/badput timeline.")
+    ap.add_argument("journal", nargs="*",
+                    help="fleet journal JSONL path(s) to replay "
+                         "(none: report the LIVE accounting)")
+    ap.add_argument("--runlog", default=None,
+                    help="runlog JSONL to back-fill compute/input_wait "
+                         "for journal-only runs")
+    ap.add_argument("--compare", nargs="+", metavar="JOURNAL",
+                    help="second run's journal path(s); exit 1 when "
+                         "its goodput_fraction regresses past "
+                         "--tolerance")
+    ap.add_argument("--tolerance", type=float, default=0.1,
+                    help="absolute goodput_fraction drop tolerated by "
+                         "--compare (default 0.1)")
+    ap.add_argument("--doc", action="store_true",
+                    help="print the full document as JSON")
+    ap.add_argument("--width", type=int, default=64,
+                    help="ASCII timeline width (default 64)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="hermetic fixture smoke; exit 0 on pass")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.journal:
+        if args.compare:
+            print("goodput: --compare needs a baseline journal",
+                  file=sys.stderr)
+            return 2
+        if not enabled():
+            print("goodput: disabled (set the goodput flag / "
+                  "PTPU_GOODPUT=1)", file=sys.stderr)
+            return 2
+        doc = status_doc()
+        if args.doc:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+            return 0
+        live = {"schema": SCHEMA, "fleet": {
+            "states": doc["states"],
+            "chip_seconds": doc["tracked_s"],
+            "goodput_fraction": doc["goodput_fraction"]},
+            "ranks": {"0": {"states": doc["states"],
+                            "chip_seconds": doc["tracked_s"],
+                            "goodput_fraction": doc["goodput_fraction"],
+                            "segments": doc["segments"]}}}
+        for line in badput_table(live):
+            print(line)
+        return 0
+    try:
+        doc = reconstruct(args.journal, runlog_path=args.runlog)
+    except (OSError, ValueError) as e:
+        print(f"goodput: bad input: {e}", file=sys.stderr)
+        return 2
+    if args.doc:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for line in badput_table(doc):
+            print(line)
+        for line in timeline_lines(doc, width=args.width):
+            print(line)
+    if args.compare:
+        try:
+            other = reconstruct(args.compare,
+                                runlog_path=None)
+        except (OSError, ValueError) as e:
+            print(f"goodput: bad --compare input: {e}",
+                  file=sys.stderr)
+            return 2
+        lines, regressed = compare_docs(doc, other,
+                                        tolerance=args.tolerance)
+        print("compare (A = positional run, B = --compare run):")
+        for line in lines:
+            print(line)
+        return 1 if regressed else 0
+    return 0
+
+
+if __name__ == "__main__":          # pragma: no cover
+    sys.exit(main())

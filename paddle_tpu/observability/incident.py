@@ -220,14 +220,17 @@ def _detail(ev: dict) -> Dict[str, Any]:
 
 def build_report(events: List[dict], alert_history: List[dict],
                  t0: float, t1: float, selector: dict,
-                 runlog_records: Optional[List[dict]] = None) -> dict:
+                 runlog_records: Optional[List[dict]] = None,
+                 with_goodput: bool = False) -> dict:
     """The ``paddle_tpu.incident.v1`` document for one window."""
     rows = []
     trace_ids: List[str] = []
+    win_events: List[dict] = []
     for ev in events:
         t = float(ev.get("time_unix", 0.0))
         if not t0 <= t <= t1:
             continue
+        win_events.append(ev)
         row = {"time_unix": t, "offset_s": round(t - t0, 6),
                "kind": ev.get("kind"), "event": ev.get("event"),
                "rank": ev.get("rank")}
@@ -272,14 +275,20 @@ def build_report(events: List[dict], alert_history: List[dict],
                 and t0 <= float(r.get("time_unix", 0.0)) <= t1)
     ranks = sorted({r["rank"] for r in rows
                     if isinstance(r.get("rank"), int)})
-    return {"schema": SCHEMA, "generated_unix": time.time(),
-            "selector": selector,
-            "window": {"t0_unix": t0, "t1_unix": t1,
-                       "duration_s": round(t1 - t0, 6)},
-            "ranks": ranks,
-            "timeline": rows, "alerts": alerts,
-            "steps_in_window": steps,
-            "trace_ids": trace_ids}
+    doc = {"schema": SCHEMA, "generated_unix": time.time(),
+           "selector": selector,
+           "window": {"t0_unix": t0, "t1_unix": t1,
+                      "duration_s": round(t1 - t0, 6)},
+           "ranks": ranks,
+           "timeline": rows, "alerts": alerts,
+           "steps_in_window": steps,
+           "trace_ids": trace_ids}
+    if with_goodput:
+        # ISSUE 19: join the window's Timecard — badput spikes with the
+        # alert fires / controller decisions nearest each one
+        from . import goodput as obs_goodput
+        doc["goodput"] = obs_goodput.incident_section(win_events)
+    return doc
 
 
 def render_report(doc: dict) -> str:
@@ -324,6 +333,22 @@ def render_report(doc: dict) -> str:
         lines.append(f"  T+{ev['offset_s']:>8.3f}s  {r:<3} "
                      f"{str(ev.get('kind')):<10} "
                      f"{str(ev.get('event')):<20} {det_s}")
+    gp = doc.get("goodput")
+    if gp:
+        fleet = gp.get("fleet") or {}
+        lines.append(
+            f"  goodput: fleet "
+            f"{100.0 * (fleet.get('goodput_fraction') or 0.0):.1f}% of "
+            f"{fleet.get('chip_seconds') or 0.0:.2f} chip-seconds, "
+            f"{len(gp.get('restart_gaps') or [])} restart/park gap(s), "
+            f"{len(gp.get('resizes') or [])} resize(s)")
+        t0w = float(w.get("t0_unix", 0.0))
+        for sp in gp.get("spikes") or []:
+            near = "; ".join(sp.get("nearby") or []) or "-"
+            lines.append(
+                f"    badput r{sp['rank']} {sp['state']:<18} "
+                f"T+{sp['start_unix'] - t0w:>8.3f}s "
+                f"+{sp['dur']:.3f}s  near: {near}"[:118])
     if doc.get("trace_ids"):
         lines.append(f"  waterfall refs: "
                      f"{', '.join(t[:16] + '…' for t in doc['trace_ids'][:6])}"
@@ -466,6 +491,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--pad", type=float, default=5.0,
                     help="seconds of context around --alert/--trace-id "
                          "(default 5)")
+    ap.add_argument("--goodput", action="store_true",
+                    help="join the window's Timecard (ISSUE 19): badput "
+                         "spikes annotated with nearby alerts/decisions")
     ap.add_argument("--json", action="store_true",
                     help="print the raw report document")
     ap.add_argument("--self-test", action="store_true",
@@ -504,7 +532,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             trace_id=args.trace_id, decision=args.decision,
             pad=args.pad)
         doc = build_report(events, history, t0, t1, sel,
-                           runlog_records=runlog_records)
+                           runlog_records=runlog_records,
+                           with_goodput=args.goodput)
     except (OSError, ValueError) as e:
         print(f"incident: {e}", file=sys.stderr)
         return 1

@@ -148,6 +148,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, obs.perf())
             elif path == "/memory":
                 self._send_json(200, obs.memory())
+            elif path == "/goodput":
+                self._send_json(200, obs.goodput())
             elif path == "/journal":
                 self._send_json(200, obs.journal())
             elif path.startswith("/trace/"):
@@ -166,7 +168,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, b"paddle_tpu observability: /metrics "
                                 b"/metrics.json /healthz /flight "
                                 b"/model /serving /alerts /controller "
-                                b"/perf /memory /journal /trace/<id> "
+                                b"/perf /memory /goodput /journal "
+                                b"/trace/<id> "
                                 b"[POST /serving/generate "
                                 b"/serving/drain /profile]\n",
                            "text/plain; charset=utf-8")
@@ -406,6 +409,18 @@ class ObservabilityServer:
                          else "local")
         if self.aggregator is not None:
             doc["ranks"] = self.aggregator.mem_rows()
+        return doc
+
+    def goodput(self) -> dict:
+        """``GET /goodput``: the Timecard chip-time accounting — this
+        process's full status document, plus fleet-merged per-rank
+        breakdown rows (fleet.goodput_rows) on a coordinator."""
+        from . import goodput as obs_goodput
+        doc = obs_goodput.status_doc()
+        doc["source"] = ("fleet" if self.aggregator is not None
+                         else "local")
+        if self.aggregator is not None:
+            doc["ranks"] = self.aggregator.goodput_rows()
         return doc
 
     def _wire_alerts(self, eng) -> None:

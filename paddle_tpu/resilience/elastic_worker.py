@@ -130,6 +130,7 @@ def main(argv=None) -> int:
     from paddle_tpu.distributed.task_queue import (Heartbeater,
                                                    TaskMasterClient)
     from paddle_tpu.incubate import checkpoint as ckpt
+    from paddle_tpu.observability import goodput as obs_goodput
     from paddle_tpu.observability import journal as obs_journal
     from paddle_tpu.resilience import chaos
 
@@ -157,6 +158,7 @@ def main(argv=None) -> int:
     serial = ckpt.latest_checkpoint(ckpt_dir) if os.path.isdir(ckpt_dir) \
         else -1
     if serial >= 0:
+        t_restore = time.perf_counter()
         state, meta, _ = ckpt.load_checkpoint(ckpt_dir, serial)
         w = np.asarray(state["w"], dtype="float64")
         applied = int(meta.get("applied", 0))
@@ -174,11 +176,18 @@ def main(argv=None) -> int:
                 except ValueError:
                     pass
         resumed = True
+        # the resume itself is chip-time (load + ledger reconcile) —
+        # the load's existing boundary feeds checkpoint_restore
+        obs_goodput.note_span("checkpoint_restore",
+                              time.perf_counter() - t_restore)
     completed, fenced_acks, failed_acks = [], 0, 0
     generations = set()
     try:
         while True:
             t = client.get_task(worker=rank)
+            # the lease RPC is this rank's input pipeline — everything
+            # since the last boundary was waiting on the master
+            obs_goodput.note_wait("input_wait")
             if client.master_generation is not None:
                 generations.add(client.master_generation)
             if t is None:
@@ -194,6 +203,8 @@ def main(argv=None) -> int:
                 # all work leased elsewhere, or waiting out a pending
                 # grow (client.wait_resize): spin
                 time.sleep(0.05)
+                obs_goodput.note_wait(
+                    "resize_barrier" if client.wait_resize else "idle")
                 continue
             # the hard-death fault point: an armed exit schedule kills
             # this process HERE, mid-task, lease held — the master's
@@ -207,6 +218,9 @@ def main(argv=None) -> int:
                 w = _apply(w, sh, t.epoch)
                 consumed.append([sh, t.epoch])
             applied += len(t.shards)
+            # chaos point + simulated work + parameter update = the
+            # training step body
+            obs_goodput.note_wait("compute")
             # the meta carries the not-yet-acked task: a crash between
             # this save and the ack is resolved at resume by
             # reconcile_in_flight (ledger truth), never double-applied
@@ -219,8 +233,11 @@ def main(argv=None) -> int:
                                       "lease": t.lease,
                                       "shards": list(t.shards)}},
                                  max_keep=2)
+            obs_goodput.note_wait("checkpoint_save")
             status = client.task_finished(t.task_id, lease=t.lease,
                                           worker=rank)
+            # the ack RPC waits on the master, like the lease
+            obs_goodput.note_wait("input_wait")
             if status == "ok":
                 completed.append([t.task_id, t.epoch])
             elif status == "fenced":
@@ -242,12 +259,18 @@ def main(argv=None) -> int:
                                      {"applied": applied, "rank": rank,
                                       "consumed": consumed},
                                      max_keep=2)
+                obs_goodput.note_wait("checkpoint_save")
             else:
                 failed_acks += 1
     finally:
         hb.stop(goodbye=True)
         client.close()
 
+    # complete the Timecard: close the open segment, journal the final
+    # per-state totals, and carry the snapshot in the worker report so
+    # the soak's conservation check reads live accounting directly
+    obs_goodput.flush()
+    obs_goodput.emit_final()
     with open(out_path, "w") as f:
         json.dump({"rank": rank, "world": world,
                    "restart_count": restart_count,
@@ -259,6 +282,8 @@ def main(argv=None) -> int:
                    "hb_re_registrations": hb.re_registrations,
                    "generations": sorted(generations),
                    "w_sum": float(w.sum()),
+                   "goodput": (obs_goodput.snapshot()
+                               if obs_goodput.enabled() else None),
                    "chaos_spec": flags.get_flag("chaos_spec")}, f)
     print(f"ELASTIC_WORKER_OK rank={rank} completed={len(completed)} "
           f"fenced={fenced_acks} restarts={restart_count} "

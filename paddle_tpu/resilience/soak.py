@@ -70,8 +70,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(
 
 SCHEDULES = ("worker_kill", "master_restart", "rpc_refuse", "combined",
              "fixed", "resize_grow", "resize_shrink", "resize_combined",
-             "resize_soak", "controller", "controller_ramp",
-             "controller_chaos")
+             "resize_soak", "resize_soak_chaos", "controller",
+             "controller_ramp", "controller_chaos")
 
 # world-size plan per resize schedule: one entry per epoch BOUNDARY
 # (requested mid-epoch, applied when the epoch drains), so a plan of
@@ -81,6 +81,10 @@ RESIZE_PLANS = {
     "resize_shrink": (1,),
     "resize_combined": (3,),
     "resize_soak": (4, 1, 3),
+    # ISSUE 19: the Timecard conservation gate — the full 2->4->1->3
+    # resize sweep PLUS a chaos-killed rank 0, so the goodput ledger
+    # must survive restarts, parks and revives in one run
+    "resize_soak_chaos": (4, 1, 3),
 }
 
 # Helmsman closed-loop profiles (ISSUE 17).  ``phases`` is the arrival
@@ -294,7 +298,8 @@ def run_schedule(workdir: str, name: str, seed: int = 0, world: int = 2,
     master.set_dataset([f"shard-{i:03d}" for i in range(n_tasks)])
     srv, _ = serve_master(master, port=port)
 
-    kill_rank0 = name in ("worker_kill", "combined", "resize_combined")
+    kill_rank0 = name in ("worker_kill", "combined", "resize_combined",
+                          "resize_soak_chaos")
     restart_master = name in ("master_restart", "combined")
     refuse = name in ("rpc_refuse", "combined")
 

@@ -314,6 +314,10 @@ class ContinuousBatcher:
         self._thread = None
         self._fail_pending("drained", "serving stopped")
         _m_draining.set(0.0)
+        # lazy: goodput has a python -m CLI and must stay out of the
+        # package-import graph (runpy double-import warning otherwise)
+        from ..observability import goodput as obs_goodput
+        obs_goodput.note_drain_end()
 
     def begin_drain(self, stop: bool = True):
         """SIGTERM semantics (the PR 2 preemption contract, honored at
@@ -326,6 +330,8 @@ class ContinuousBatcher:
             self._stop_after_drain = stop
         _m_draining.set(1.0)
         _m_drains.inc()
+        from ..observability import goodput as obs_goodput
+        obs_goodput.note_drain_begin()
         obs_flight.record("serving", "drain_begin",
                           queued=self.queue_depth,
                           active=len(self._slots))
@@ -527,6 +533,8 @@ class ContinuousBatcher:
             if drain_done:
                 if self._stop_after_drain:
                     obs_journal.emit("serving", "drain_complete")
+                    from ..observability import goodput as obs_goodput
+                    obs_goodput.note_drain_end()
                     break
                 self._wake.wait(0.05)
                 self._wake.clear()

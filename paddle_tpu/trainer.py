@@ -308,14 +308,23 @@ class Trainer:
         # hazard once the guard can roll back mid-epoch)
         meta = {"epoch": epoch_id + 1 if epoch_complete else epoch_id,
                 "step": 0 if epoch_complete else step_id + 1}
+        from .observability import goodput as obs_goodput
+        t_ck = time.perf_counter() if obs_goodput.enabled() else None
         rretry.call_with_retry(
             ckpt.save_checkpoint, _SAVE_RETRY,
             self.checkpoint_cfg.checkpoint_dir, self._persist_state(),
             meta, max_keep=self.checkpoint_cfg.max_num_checkpoints)
+        if t_ck is not None:
+            # Timecard: the save is a boundary the step clock already
+            # excludes — charge its span to checkpoint_save
+            obs_goodput.note_span("checkpoint_save",
+                                  time.perf_counter() - t_ck)
 
     def _load_checkpoint(self, serial: int):
         import jax
         from .incubate import checkpoint as ckpt
+        from .observability import goodput as obs_goodput
+        t_ck = time.perf_counter() if obs_goodput.enabled() else None
         state, meta, _ = ckpt.load_checkpoint(
             self.checkpoint_cfg.checkpoint_dir, serial)
         device = self.exe.place.jax_device() if self.exe.mesh is None \
@@ -326,6 +335,9 @@ class Trainer:
             self.scope.set_var(name, arr)
         self.epoch_offset = int(meta.get("epoch", 0))
         self.step_offset = int(meta.get("step", 0))
+        if t_ck is not None:
+            obs_goodput.note_span("checkpoint_restore",
+                                  time.perf_counter() - t_ck)
 
     def _rollback(self) -> bool:
         """Restore the newest valid checkpoint (params + optimizer
@@ -534,6 +546,14 @@ class Trainer:
                             cost=self.exe.last_run_cost(
                                 prefer_analytic=True),
                             trace_id=self._step_trace_id)
+                    from .observability import goodput as obs_goodput
+                    if obs_goodput.enabled():
+                        # Timecard: the same measured anatomy
+                        # partitions this step's wall into
+                        # input_wait/compute/idle chip-seconds
+                        obs_goodput.note_step(
+                            data_wait_s=data_wait, host_s=host_s,
+                            device_s=device_s, wall_s=dt)
                     if metrics:
                         raw_loss = loss_val = \
                             float(np.mean(np.asarray(metrics[0])))

@@ -24,6 +24,21 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# import-time (pristine) values of every controller_* tuning flag —
+# captured before any test body runs, so a test that tunes cooldowns or
+# clamps and forgets to restore them cannot leak policy into the next
+# case (ISSUE 19 satellite; tests/test_goodput.py has the regression)
+_CONTROLLER_FLAG_DEFAULTS = None
+
+
+def _controller_flag_defaults(flags_mod):
+    global _CONTROLLER_FLAG_DEFAULTS
+    if _CONTROLLER_FLAG_DEFAULTS is None:
+        _CONTROLLER_FLAG_DEFAULTS = {
+            k: v for k, v in flags_mod.all_flags().items()
+            if k.startswith("controller")}
+    return dict(_CONTROLLER_FLAG_DEFAULTS)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -48,6 +63,7 @@ def fresh_programs():
     from paddle_tpu.observability import controller as obs_controller
     from paddle_tpu.observability import costmodel, flight, forensics
     from paddle_tpu.observability import deviceprof, metrics as obs_metrics
+    from paddle_tpu.observability import goodput as obs_goodput
     from paddle_tpu.observability import journal as obs_journal
     from paddle_tpu.observability import memscope as obs_memscope
     from paddle_tpu.observability import perfscope as obs_perfscope
@@ -76,10 +92,12 @@ def fresh_programs():
     pt.core.flags.set_flag("alert_rules_path", "")
     pt.core.flags.set_flag("journal_path", "")
     # Helmsman: drop the controller singleton (decision ring, breaker
-    # state, cooldown clocks) and default the flag back to off — one
-    # case's actuation history must not charge the next case's cooldowns
+    # state, cooldown clocks) and restore EVERY controller_* tuning
+    # flag to its import-time value — one case's actuation history or
+    # tuned cooldowns/clamps must not charge the next case
     obs_controller.reset()
-    pt.core.flags.set_flag("controller", False)
+    for _cf, _cv in _controller_flag_defaults(pt.core.flags).items():
+        pt.core.flags.set_flag(_cf, _cv)
     # request X-ray: traces/captures from one case must not resolve in
     # the next (GET /trace, exemplar trace ids), and the device-prof
     # capture latch must not read busy across cases
@@ -123,6 +141,14 @@ def fresh_programs():
                      ("memscope_hbm_limit_bytes", 0),
                      ("memscope_ratio_factor", 8.0)):
         pt.core.flags.set_flag(_mf, _mv)
+    # Timecard: drop the accounting clock, accumulators, timeline and
+    # chip-time metric families, and default the flag family back off —
+    # one case's chip-seconds must not leak into the next
+    obs_goodput.reset()
+    pt.core.flags.set_flag("goodput", False)
+    for _gf, _gv in (("goodput_collapse_fraction", 0.3),
+                     ("goodput_collapse_for_s", 3.0)):
+        pt.core.flags.set_flag(_gf, _gv)
     yield
     pt.core.flags.set_flag("chaos_spec", "")
     chaos.reset()
@@ -134,7 +160,8 @@ def fresh_programs():
     obs_controller.reset()
     pt.core.flags.set_flag("alert_rules_path", "")
     pt.core.flags.set_flag("journal_path", "")
-    pt.core.flags.set_flag("controller", False)
+    for _cf, _cv in _controller_flag_defaults(pt.core.flags).items():
+        pt.core.flags.set_flag(_cf, _cv)
     pt.core.flags.set_flag("jit_cache_dir", "")
     obs_perfscope.reset()
     pt.core.flags.set_flag("perfscope", False)
@@ -149,6 +176,11 @@ def fresh_programs():
                      ("memscope_hbm_limit_bytes", 0),
                      ("memscope_ratio_factor", 8.0)):
         pt.core.flags.set_flag(_mf, _mv)
+    obs_goodput.reset()
+    pt.core.flags.set_flag("goodput", False)
+    for _gf, _gv in (("goodput_collapse_fraction", 0.3),
+                     ("goodput_collapse_for_s", 3.0)):
+        pt.core.flags.set_flag(_gf, _gv)
 
 
 @pytest.fixture

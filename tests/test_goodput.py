@@ -1,0 +1,587 @@
+"""Timecard (ISSUE 19, observability/goodput.py).
+
+Covers: the per-rank wall-clock state machine's conservation invariant
+(non-overlapping segments summing to tracked wall BY CONSTRUCTION,
+span clipping, note_step anatomy scaling around a prior compile span),
+flag-off inertness, status_doc / metrics-doc row round trips (local +
+fleet-merged + the GET /goodput route), the built-in goodput_collapse
+Watchtower rule and its alert_context, the offline journal
+reconstructor (+--compare and the CLI exit-code contract), the
+incident --goodput join, flag-off bitwise invariance through a real
+checkpointing run with an interleaved A/B overhead gate, the conftest
+controller_*-flag leak regression, and the tier-1 elastic-soak
+conservation gate (2->4->1->3 resize + chaos-killed rank 0: live
+accounting vs offline journal replay per state).
+"""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import flags
+from paddle_tpu.observability import alerts
+from paddle_tpu.observability import fleet
+from paddle_tpu.observability import goodput
+from paddle_tpu.observability import incident
+from paddle_tpu.observability import journal as obs_journal
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.resilience import soak
+
+
+def _spin(seconds):
+    """Busy-wait so perf_counter really advances (sleep can undershoot
+    on coarse clocks)."""
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        pass
+
+
+def _assert_segments_sane(segments):
+    """Non-overlapping and time-ordered — the conservation invariant's
+    structural half.  Start/dur are independently rounded to 6 decimal
+    places on unix-scale floats, so adjacent boundaries can disagree by
+    a few microseconds without any real overlap."""
+    for a, b in zip(segments, segments[1:]):
+        assert a["start_unix"] + a["dur"] <= b["start_unix"] + 1e-5, \
+            (a, b)
+
+
+# ===================================================================
+# the state machine: conservation by construction
+# ===================================================================
+
+def test_conservation_and_segments():
+    flags.set_flag("goodput", True)
+    goodput.note_wait("idle")
+    _spin(0.004)
+    goodput.note_step(data_wait_s=0.001, host_s=0.002, device_s=0.001,
+                      wall_s=0.004)
+    _spin(0.002)
+    goodput.note_span("checkpoint_save", 0.002)
+    _spin(0.002)
+    goodput.note_wait("input_wait")
+    snap = goodput.snapshot()
+    assert snap["tracked_s"] == pytest.approx(snap["wall_s"], rel=1e-6)
+    assert snap["states"]["compute"] > 0
+    assert snap["states"]["input_wait"] > 0
+    assert snap["states"]["checkpoint_save"] > 0
+    assert set(snap["states"]) <= set(goodput.STATES)
+    _assert_segments_sane(snap["segments"])
+    # live registry mirrors the accumulators
+    rows = goodput.rows_from_metrics_doc(obs_metrics.REGISTRY.to_json())
+    for state, v in snap["states"].items():
+        assert rows["states"][state] == pytest.approx(v, abs=1e-5)
+    assert rows["goodput_fraction"] == pytest.approx(
+        snap["goodput_fraction"], abs=1e-5)
+
+
+def test_span_overlap_is_clipped_never_double_booked():
+    flags.set_flag("goodput", True)
+    goodput.note_wait("idle")
+    _spin(0.003)
+    goodput.note_wait("input_wait")     # claims the 3ms
+    # a span claiming 10 WHOLE seconds ending now: only the unclaimed
+    # sliver since the last boundary may be booked
+    goodput.note_span("compile", 10.0)
+    snap = goodput.snapshot()
+    assert snap["tracked_s"] == pytest.approx(snap["wall_s"], rel=1e-6)
+    assert snap["states"].get("compile", 0.0) < 1.0
+
+
+def test_note_step_scales_around_prior_compile_span():
+    flags.set_flag("goodput", True)
+    goodput.note_wait("idle")
+    _spin(0.004)
+    # a compile span eats half the elapsed step wall; the anatomy that
+    # follows must scale into the remainder, not double-book
+    goodput.note_span("compile", 0.002)
+    goodput.note_step(data_wait_s=0.002, host_s=0.002, device_s=0.0,
+                      wall_s=0.004)
+    snap = goodput.snapshot()
+    assert snap["tracked_s"] == pytest.approx(snap["wall_s"], rel=1e-6)
+    assert snap["states"]["compile"] == pytest.approx(0.002, abs=5e-4)
+    # input_wait and compute each got a scaled share of the remainder
+    assert snap["states"]["input_wait"] > 0
+    assert snap["states"]["compute"] > 0
+
+
+def test_flag_off_is_inert():
+    assert not goodput.enabled()
+    goodput.note_wait("idle")
+    goodput.note_step(data_wait_s=0.1, host_s=0.1, device_s=0.1,
+                      wall_s=0.3)
+    goodput.note_span("compile", 0.1)
+    goodput.note_drain_begin()
+    goodput.note_drain_end()
+    goodput.flush()
+    snap = goodput.snapshot()
+    assert snap["states"] == {}
+    assert snap["tracked_s"] == 0.0
+    assert goodput.fraction() == 0.0
+    rows = goodput.rows_from_metrics_doc(obs_metrics.REGISTRY.to_json())
+    assert rows["states"] == {}
+    assert rows["goodput_fraction"] is None
+
+
+def test_drain_pair_charges_drain():
+    flags.set_flag("goodput", True)
+    goodput.note_wait("idle")
+    goodput.note_drain_begin()
+    _spin(0.003)
+    goodput.note_drain_end()
+    snap = goodput.snapshot()
+    assert snap["states"].get("drain", 0.0) >= 0.002
+    assert snap["tracked_s"] == pytest.approx(snap["wall_s"], rel=1e-6)
+
+
+# ===================================================================
+# surfaces: status doc, fleet rows, GET /goodput, alert rule
+# ===================================================================
+
+def test_status_doc_and_dominant_badput():
+    flags.set_flag("goodput", True)
+    goodput.note_wait("idle")
+    _spin(0.002)
+    goodput.note_span("compute", 0.001)
+    _spin(0.003)
+    goodput.note_wait("checkpoint_save")
+    doc = goodput.status_doc()
+    assert doc["schema"] == goodput.SCHEMA
+    assert doc["enabled"] is True
+    assert doc["states_catalog"] == list(goodput.STATES)
+    assert doc["dominant_badput"] in goodput.BADPUT_STATES
+    ctx = goodput.alert_context({})
+    assert ctx["dominant_badput"] == doc["dominant_badput"]
+    assert 0.0 <= ctx["goodput_fraction"] <= 1.0
+
+
+def test_goodput_route_local_and_fleet():
+    flags.set_flag("goodput", True)
+    goodput.note_wait("idle")
+    _spin(0.002)
+    goodput.note_wait("compute")
+    srv = obs_server.start_http_server(port=0)
+    with urllib.request.urlopen(f"{srv.url}/goodput", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["schema"] == goodput.SCHEMA
+    assert doc["source"] == "local"
+    assert doc["states"]["compute"] > 0
+    with urllib.request.urlopen(f"{srv.url}/", timeout=10) as r:
+        assert b"/goodput" in r.read()
+    obs_server.reset()
+
+    agg = fleet.FleetAggregator(stale_after=60.0)
+    agg.ingest("report_metrics",
+               {"schema": fleet.SCHEMA, "rank": 0,
+                "time_unix": time.time(),
+                "perf_counter": time.perf_counter(),
+                "steps_total": 1.0,
+                "metrics": obs_metrics.REGISTRY.to_json()})
+    rows = agg.goodput_rows()
+    assert set(rows) == {"0"}
+    assert rows["0"]["states"]["compute"] > 0
+    srv = obs_server.start_http_server(port=0, aggregator=agg)
+    with urllib.request.urlopen(f"{srv.url}/goodput", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["source"] == "fleet"
+    assert doc["ranks"]["0"]["states"]["compute"] > 0
+
+
+def test_goodput_collapse_rule_gated_on_flag():
+    names = {r.name for r in alerts.default_rules()}
+    assert "goodput_collapse" not in names          # flag off: absent
+    flags.set_flag("goodput", True)
+    rules = {r.name: r for r in alerts.default_rules()}
+    assert "goodput_collapse" in rules
+    rule = rules["goodput_collapse"]
+    # fires on the published complement (badput_fraction >= 1 - gfrac):
+    # a labelless gauge's 0.0 default series means a "goodput low" rule
+    # would false-fire on a rank that tracked nothing yet
+    assert rule.metric == "badput_fraction"
+    assert rule.op == ">="
+    assert rule.value == pytest.approx(
+        1.0 - flags.get_flag("goodput_collapse_fraction"))
+    assert rule.for_seconds == pytest.approx(
+        flags.get_flag("goodput_collapse_for_s"))
+    # threshold flag <= 0 disables the rule even with the plane on
+    flags.set_flag("goodput_collapse_fraction", 0.0)
+    assert "goodput_collapse" not in {r.name
+                                      for r in alerts.default_rules()}
+
+
+def test_reset_is_alert_safe():
+    """After reset() an untracked rank must read as NO data, not as
+    collapsed goodput: chip_seconds_total loses every labeled series,
+    badput_fraction (the alerting series) sits at its safe 0.0
+    default, and the row reconstruction reports fraction None."""
+    flags.set_flag("goodput", True)
+    goodput.note_wait("idle")
+    _spin(0.002)
+    goodput.note_wait("idle")
+    # all-badput tracking pushed the alerting gauge to the firing end
+    assert obs_metrics.REGISTRY.get("badput_fraction").total() \
+        == pytest.approx(1.0)
+    goodput.reset()
+    fams = (obs_metrics.REGISTRY.to_json() or {}).get("metrics") or {}
+    assert not (fams.get("chip_seconds_total") or {}).get("series")
+    assert obs_metrics.REGISTRY.get("badput_fraction").total() == 0.0
+    rows = goodput.rows_from_metrics_doc(obs_metrics.REGISTRY.to_json())
+    assert rows["states"] == {} and rows["goodput_fraction"] is None
+
+
+# ===================================================================
+# offline reconstructor + CLI contract
+# ===================================================================
+
+def _emit_run(path, states):
+    """Write one rank's goodput final (+ a matching segment stream)
+    through the REAL journal writer so read_events round-trips."""
+    flags.set_flag("journal_path", str(path))
+    t = 1000.0
+    for state, dur in states.items():
+        obs_journal.emit("goodput", "segment", state=state,
+                         seg_start_unix=t, dur=dur)
+        t += dur
+    obs_journal.emit("goodput", "final", states=dict(states),
+                     wall_s=sum(states.values()),
+                     fraction=states.get("compute", 0.0)
+                     / max(sum(states.values()), 1e-9))
+    obs_journal.reset()
+    flags.set_flag("journal_path", "")
+
+
+def test_reconstruct_from_real_journal_and_cli(tmp_path, capsys):
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _emit_run(a, {"compute": 9.0, "idle": 1.0})
+    _emit_run(b, {"compute": 1.0, "idle": 9.0})
+    doc = goodput.reconstruct([str(a)])
+    assert doc["fleet"]["goodput_fraction"] == pytest.approx(0.9)
+    rank = list(doc["ranks"].values())[0]
+    assert rank["states"]["compute"] == pytest.approx(9.0)
+    _assert_segments_sane(rank["segments"])
+    # breakdown + timeline render
+    assert goodput.main([str(a)]) == 0
+    out = capsys.readouterr().out
+    assert "goodput breakdown" in out
+    assert "timeline" in out
+    # --compare: 0.9 -> 0.1 regresses past the 0.1 tolerance -> exit 1
+    assert goodput.main([str(a), "--compare", str(b)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # self-compare is clean
+    assert goodput.main([str(a), "--compare", str(a)]) == 0
+
+
+def test_cli_exit_codes():
+    assert not goodput.enabled()
+    assert goodput.main([]) == 2                 # live report, plane off
+    assert goodput.main(["/nonexistent/journal.jsonl"]) == 2
+
+
+def test_cli_self_test(capsys):
+    assert goodput.main(["--self-test"]) == 0
+    out = capsys.readouterr().out
+    assert "GOODPUT_SELF_TEST" in out
+    payload = json.loads(out.split("GOODPUT_SELF_TEST ", 1)[1]
+                         .splitlines()[0])
+    assert payload["ok"] is True
+    # the self-test restored the flag family
+    assert goodput.enabled() is False
+
+
+def test_restart_gap_and_park_gap_reconstruction():
+    base = 2000.0
+
+    def ev(dt, kind, event, seq, **fields):
+        return {"schema": obs_journal.SCHEMA, "kind": kind,
+                "event": event, "time_unix": base + dt, "rank": 0,
+                "pid": 1, "seq": seq, **fields}
+
+    events = [
+        ev(0.0, "supervisor", "spawn", 1, worker=0, incarnation=0),
+        ev(1.0, "supervisor", "restart", 2, worker=0, rc=9, attempt=1),
+        ev(3.0, "supervisor", "spawn", 3, worker=0, incarnation=1),
+        ev(5.0, "supervisor", "park", 4, worker=1, rc=3, target_world=1),
+        ev(9.0, "supervisor", "spawn", 5, worker=1, incarnation=1),
+        ev(9.5, "master", "resize_applied", 6, old_world=2, new_world=3,
+           epoch=2),
+    ]
+    doc = goodput.reconstruct_events(events)
+    r0 = doc["ranks"]["0"]
+    r1 = doc["ranks"]["1"]
+    assert r0["offline_states"]["restart_gap"] == pytest.approx(2.0)
+    assert r1["offline_states"]["resize_barrier"] == pytest.approx(4.0)
+    assert [g["why"] for g in doc["restart_gaps"]] == ["restart",
+                                                       "park"]
+    assert doc["resizes"] == [{"old": 2, "new": 3, "epoch": 2,
+                               "time_unix": base + 9.5}]
+
+
+def test_incident_goodput_join():
+    events = incident._fixture_events()
+    t0 = events[0]["time_unix"]
+    events.append({"schema": obs_journal.SCHEMA, "kind": "goodput",
+                   "event": "segment", "rank": 0, "pid": 100, "seq": 99,
+                   "state": "restart_gap", "seg_start_unix": t0 + 1.4,
+                   "dur": 1.0, "time_unix": t0 + 2.4})
+    doc = incident.build_report(events, [], t0, t0 + 10.0,
+                                {"mode": "window"}, with_goodput=True)
+    gp = doc["goodput"]
+    assert gp["spikes"], gp
+    spike = gp["spikes"][0]
+    assert spike["state"] == "restart_gap"
+    # the dead_rank alert fires within +-5s of the badput spike
+    assert any("alert" in n for n in spike["nearby"])
+    text = incident.render_report(doc)
+    assert "goodput:" in text
+    assert "restart_gap" in text
+
+
+# ===================================================================
+# conftest isolation (satellite): controller_* flags cannot leak
+# ===================================================================
+
+def test_controller_flag_leak_part1_mutates():
+    """Deliberately leak tuned controller knobs; the NEXT test proves
+    the conftest fixture restored every controller_* flag."""
+    flags.set_flag("controller_cooldown_s", 1234.5)
+    flags.set_flag("controller_max_world", 77)
+    flags.set_flag("controller_state_path", "/tmp/leaked")
+    flags.set_flag("controller", True)
+
+
+def test_controller_flag_leak_part2_restored():
+    assert flags.get_flag("controller_cooldown_s") != 1234.5
+    assert flags.get_flag("controller_max_world") != 77
+    assert flags.get_flag("controller_state_path") == ""
+    assert flags.get_flag("controller") is False
+
+
+def test_goodput_state_does_not_leak():
+    """Paired with every test above that charged chip-time: a fresh
+    test starts with an empty Timecard and the flag family at
+    defaults."""
+    assert goodput.enabled() is False
+    assert goodput.snapshot()["tracked_s"] == 0.0
+    assert flags.get_flag("goodput_collapse_fraction") == \
+        pytest.approx(0.3)
+
+
+# ===================================================================
+# flag-off bitwise invariance + interleaved A/B overhead gate
+# ===================================================================
+
+def _ab_train_once(ckdir, enable_goodput):
+    """One checkpointed training run; returns (weights, losses, wall)."""
+
+    def train_func():
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False, name="fc")
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    rng = np.random.RandomState(0)
+    batches = [[(rng.randn(4).astype("float32"),
+                 rng.randn(1).astype("float32")) for _ in range(4)]
+               for _ in range(6)]
+    losses = []
+
+    def handler(event):
+        if isinstance(event, pt.EndStepEvent):
+            losses.append(np.asarray(event.metrics[0]).tobytes())
+
+    pt.reset_default_programs()
+    goodput.reset()
+    flags.set_flag("goodput", bool(enable_goodput))
+    cfg = pt.CheckpointConfig(ckdir, max_num_checkpoints=2,
+                              epoch_interval=1, step_interval=2)
+    t = pt.Trainer(train_func,
+                   lambda: pt.optimizer.SGD(learning_rate=0.05),
+                   place=pt.CPUPlace(), checkpoint_config=cfg)
+    t0 = time.perf_counter()
+    t.train(num_epochs=2, event_handler=handler, reader=lambda:
+            iter(batches), feed_order=["x", "y"])
+    wall = time.perf_counter() - t0
+    w_name, = [n for n in t.scope.var_names() if n.endswith(".w_0")]
+    w = np.asarray(t.scope.find_var(w_name)).copy()
+    flags.set_flag("goodput", False)
+    return w, losses, wall
+
+
+def test_flag_off_bitwise_invariance_and_overhead(tmp_path):
+    """Interleaved A/B (off, on, off, on) through a REAL checkpointed
+    training run: identical weight bytes and loss bytes in both modes
+    (the flag-off contract extends to flag-ON numerics — the plane only
+    reads timings), and the enabled plane costs <= 10% wall overhead
+    (min-of-reps, small absolute slack for CI scheduler noise)."""
+    runs = []
+    for i, on in enumerate((False, True, False, True)):
+        runs.append(_ab_train_once(str(tmp_path / f"ck{i}"), on))
+    w_off, l_off, _ = runs[0]
+    for w, losses, _ in runs[1:]:
+        assert np.array_equal(w, w_off)
+        assert w.tobytes() == w_off.tobytes()
+        assert losses == l_off
+    t_off = min(runs[0][2], runs[2][2])
+    t_on = min(runs[1][2], runs[3][2])
+    assert t_on <= t_off * 1.10 + 0.25, (t_on, t_off)
+    # the ON runs actually tracked chip-time through the trainer seams
+    snap = goodput.snapshot()
+    assert snap["tracked_s"] > 0
+    assert snap["states"].get("compute", 0.0) > 0
+
+
+# ===================================================================
+# bench satellite: bench_goodput_fraction row + trend subseries
+# ===================================================================
+
+def test_bench_row_publishes_goodput_fraction():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "ptpu_bench_module",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    row = {"metric": "probe_tokens_per_sec", "unit": "tokens/s",
+           "value": 1.0, "vs_baseline": 1.0, "goodput_fraction": 0.83}
+    bench._record_row_metrics(row)
+    fam = obs_metrics.REGISTRY.get("bench_goodput_fraction")
+    assert fam is not None
+    assert fam.labels(metric="probe_tokens_per_sec").value \
+        == pytest.approx(0.83)
+
+
+def _bench_rec(value, gfrac=None):
+    return {"m_tokens_per_sec": {"value": value,
+                                 "goodput_fraction": gfrac}}
+
+
+def test_trend_goodput_fraction_subseries():
+    from paddle_tpu.observability import bench_gate
+    # higher-is-better: a goodput drop is a NAMED regression even when
+    # throughput itself improved
+    res = bench_gate.trend([
+        ("r01", _bench_rec(100.0, gfrac=0.9)),
+        ("r02", _bench_rec(104.0, gfrac=0.88)),
+        ("r03", _bench_rec(110.0, gfrac=0.5)),
+    ])
+    rows = {r["metric"]: r for r in res["rows"]}
+    grow = rows["m_tokens_per_sec.goodput_fraction"]
+    assert grow["status"] == "regression"
+    assert "m_tokens_per_sec.goodput_fraction" in res["regressions"]
+    assert rows["m_tokens_per_sec"]["status"] == "ok"
+    # first post-Timecard record: not a regression
+    res = bench_gate.trend([("r01", _bench_rec(100.0)),
+                            ("r02", _bench_rec(101.0, gfrac=0.9))])
+    rows = {r["metric"]: r for r in res["rows"]}
+    assert rows["m_tokens_per_sec.goodput_fraction"]["status"] == "ok"
+    assert res["ok"] is True
+    # the newest record dropping the column is flagged missing
+    res = bench_gate.trend([("r01", _bench_rec(100.0, gfrac=0.9)),
+                            ("r02", _bench_rec(101.0))])
+    rows = {r["metric"]: r for r in res["rows"]}
+    assert rows["m_tokens_per_sec.goodput_fraction"]["status"] \
+        == "missing"
+    # records with no goodput anywhere grow no subseries row at all
+    res = bench_gate.trend([("r01", _bench_rec(100.0)),
+                            ("r02", _bench_rec(101.0))])
+    assert not [r for r in res["rows"]
+                if r["metric"].endswith(".goodput_fraction")]
+    # the runlog summary path round-trips the column
+    rec = bench_gate.load_trend_record(
+        {"summary": {"m": {"value": 7.0, "goodput_fraction": 0.8}}})
+    assert rec["m"]["goodput_fraction"] == 0.8
+
+
+# ===================================================================
+# tier-1 conservation gate: elastic soak with resizes + chaos kill
+# ===================================================================
+
+def test_timecard_conservation_elastic_soak(tmp_path, monkeypatch):
+    """The ISSUE 19 correctness gate: the 2->4->1->3 resize sweep with
+    a chaos-killed rank 0, goodput + journal on for every worker.  Per
+    rank: segments non-overlapping, per-state seconds sum to the
+    tracked wall (+-5%), and the OFFLINE journal reconstruction agrees
+    with the live accounting (+-10% per state); restart gaps appear
+    only offline (chip-time no process could self-account)."""
+    journal_path = str(tmp_path / "fleet_journal.jsonl")
+    monkeypatch.setenv("PTPU_GOODPUT", "1")
+    monkeypatch.setenv("PTPU_JOURNAL_PATH", journal_path)
+    # the supervisor/master live in THIS process: journal their
+    # spawn/restart/park/resize events into the same shared file
+    flags.set_flag("journal_path", journal_path)
+
+    rep = soak.run_schedule(str(tmp_path), "resize_soak_chaos", world=2,
+                            n_tasks=4, epochs=2, timeout=90)
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"][0] >= 1                # chaos kill fired
+    assert rep["resizes_applied"] == 3
+    # flag-ON invariance through checkpointing + resizes: the fleet
+    # lands the EXACT fixed-fleet end state
+    assert rep["w_total"] == pytest.approx(rep["expected_w_total"],
+                                           abs=1e-9)
+
+    live = {}
+    for w in rep["workers"]:
+        gp = w.get("goodput")
+        assert gp is not None, f"rank {w['rank']} report missing goodput"
+        if gp["tracked_s"] == 0:
+            # an incarnation spawned by a late grow can retire before
+            # charging any chip-time (queue already drained) — that
+            # conserves trivially, it must just not invent state time
+            assert sum(gp["states"].values()) == 0
+            continue
+        # conservation: segments non-overlapping, states sum to wall
+        _assert_segments_sane(gp["segments"])
+        assert gp["tracked_s"] == pytest.approx(gp["wall_s"],
+                                                rel=0.05)
+        # each state value is independently round(,6)-ed in the
+        # snapshot, so the sum can drift from tracked_s by up to
+        # ~0.5e-6 per state — absolute tolerance, not relative
+        assert sum(gp["states"].values()) == pytest.approx(
+            gp["tracked_s"], abs=1e-5)
+        live[w["rank"]] = gp
+    # the chaos-killed-and-restarted rank always does real work
+    assert 0 in live, "rank 0 tracked no chip-time"
+
+    flags.set_flag("journal_path", "")
+    obs_journal.reset()
+    events = obs_journal.read_events(journal_path)
+    recon = goodput.reconstruct_events(events)
+    # every live rank reconstructs; restart gaps + all 3 resizes do too
+    assert any(g["why"] == "restart" and g["rank"] == 0
+               for g in recon["restart_gaps"])
+    assert [r["new"] for r in recon["resizes"]] == [4, 1, 3]
+    finals = {}
+    for e in events:
+        if e.get("kind") == "goodput" and e.get("event") == "final":
+            finals[e["rank"]] = finals.get(e["rank"], 0) + 1
+    for rank, gp in live.items():
+        off = recon["ranks"].get(str(rank))
+        assert off is not None, f"rank {rank} missing offline"
+        for state, v_live in gp["states"].items():
+            v_off = off["states"].get(state, 0.0)
+            tol = max(0.10 * v_live, 0.05)
+            if finals.get(rank, 0) <= 1:
+                # single incarnation journaled a final: offline replay
+                # must agree with the live accounting +-10% per state
+                assert abs(v_off - v_live) <= tol, \
+                    (rank, state, v_live, v_off)
+            else:
+                # a parked-then-revived rank sums finals over ALL its
+                # incarnations offline, while the live report covers
+                # only the last one: offline is a superset
+                assert v_off >= v_live - tol, \
+                    (rank, state, v_live, v_off)
+        # the offline-only keys carry gap chip-time, never live keys
+        assert "restart_gap" not in gp["states"]
+    # rank 0's restart gap landed in the offline-only ledger
+    assert recon["ranks"]["0"]["offline_states"].get(
+        "restart_gap", 0.0) > 0

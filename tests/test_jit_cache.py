@@ -519,6 +519,58 @@ def test_supervisor_propagates_cache_dir(tmp_path):
 
 # --- CLI exit-code contract -------------------------------------------------
 
+def test_warm_two_dir_and_dry_run(tmp_path, capsys):
+    """ISSUE 19 satellite: the --warm SRC DST two-dir form needs no
+    active cache dir, and --dry-run validates/names candidates without
+    writing a byte."""
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    flags.set_flag("jit_cache_dir", str(src))
+    loss = _build_fc()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    exe.run(pt.default_main_program(), feed=_feed(), fetch_list=[loss])
+    names = _entries(src)
+    assert len(names) == 2                  # startup + main step
+    flags.set_flag("jit_cache_dir", "")     # two-dir form: no ambient dir
+    # dry run: exit 0, candidates named, NOTHING written
+    assert jit_cache.main(["--warm", str(src), str(dst),
+                           "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "would copy 2 entr(ies)" in out
+    for nm in names:
+        assert f"would copy {nm}" in out
+    assert not os.path.exists(dst) or _entries(dst) == []
+    # three positional dirs is a usage error
+    assert jit_cache.main(["--warm", str(src), str(dst), str(dst)]) == 2
+    # the real copy lands both entries, byte-identical
+    assert jit_cache.main(["--warm", str(src), str(dst)]) == 0
+    assert "copied 2 entr(ies)" in capsys.readouterr().out
+    assert _entries(dst) == names
+    for nm in names:
+        assert open(os.path.join(src, nm), "rb").read() \
+            == open(os.path.join(dst, nm), "rb").read()
+    # re-warm is idempotent: everything already present
+    r = jit_cache.warm(str(src), dst_dir=str(dst))
+    assert r["copied"] == 0 and r["present"] == 2
+    assert r["dry_run"] is False and r["entries"] == []
+
+
+def test_warmed_fresh_process_records_zero_compiles(tmp_path):
+    """ISSUE 19 satellite acceptance: a FRESH process pointed at a dir
+    seeded only by the two-dir CLI warm records ZERO XLA compiles and
+    bit-identical losses — the warm copy is as good as the original."""
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    cold = _run_probe(src)
+    assert cold["executor_compile_total"] > 0
+    assert jit_cache.main(["--warm", str(src), str(dst)]) == 0
+    warm = _run_probe(dst)
+    assert warm["executor_compile_total"] == 0
+    assert warm["jit_cache_errors_total"] == 0
+    assert warm["losses"] == cold["losses"]
+
+
 def test_cli_exit_codes(tmp_path, capsys):
     flags.set_flag("jit_cache_dir", "")
     # no dir, no action -> usage error
