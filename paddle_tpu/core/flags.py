@@ -403,6 +403,37 @@ define_flag("serving_lazy_bucket_compile", False,
             "PR 8 AOT discipline: an unprepared bucket is an error, "
             "never a silent compile.")
 
+# --- multi-replica serving router (serving/router.py, ISSUE 20) ------------
+define_flag("router_retry_budget", 3,
+            "Router retry-elsewhere budget: dispatch attempts beyond "
+            "the first a single client request may consume before the "
+            "router answers 503 (no healthy replica) / 504 (deadline). "
+            "Each retry targets a different replica when one exists.")
+define_flag("router_probe_interval_s", 0.5,
+            "Seconds between router health probes (GET /healthz on "
+            "every replica).  The probe loop is also the router's "
+            "control loop: it notices revived replicas, closes "
+            "recovered circuit breakers and honors a pending SIGTERM "
+            "drain.")
+define_flag("router_breaker_threshold", 3,
+            "Per-replica circuit breaker: consecutive dispatch/probe "
+            "failures before the replica's breaker opens and the "
+            "router stops routing to it.")
+define_flag("router_breaker_reset_s", 2.0,
+            "Seconds an open per-replica breaker holds before "
+            "half-open: the next probe (or, with no alternative, one "
+            "trial request) decides recovery — success closes the "
+            "breaker, failure re-opens it for another window.")
+define_flag("router_backoff_s", 0.05,
+            "Base delay of the router's deterministic retry-elsewhere "
+            "backoff (resilience/retry.py jitter keyed on chaos_seed; "
+            "doubles per attempt, capped at 1s).")
+define_flag("router_default_deadline_s", 30.0,
+            "Default end-to-end request deadline when a client body "
+            "names no timeout_s: the router stops retrying and "
+            "answers 504 once it expires, and the remaining budget "
+            "rides to the replica on every hop.")
+
 # --- elastic fleet (distributed/: task_queue membership, supervisor) -------
 define_flag("worker_timeout", 6.0,
             "Master-side heartbeat lease: a registered worker silent "

@@ -90,8 +90,10 @@ SEVERITIES = ("warning", "critical")
 # action: clause verbs (ISSUE 17 Helmsman) — what a FIRING rule may do
 # to the fleet when the controller flag is on.  "log" is the dry-run:
 # the full decision pipeline (cooldowns, clamps, journal) without an
-# actuator call.
-ACTIONS = ("request_resize", "drain", "revive", "log")
+# actuator call.  spawn_replica/drain_replica (ISSUE 20) scale the
+# Armada serving fleet through the router (controller.wire_router).
+ACTIONS = ("request_resize", "drain", "revive", "log",
+           "spawn_replica", "drain_replica")
 # action-clause fields that only make sense on a resize verb
 _RESIZE_ONLY_FIELDS = ("direction", "step", "proportional", "max_step",
                        "min_world", "max_world", "immediate")

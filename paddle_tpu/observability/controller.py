@@ -142,6 +142,10 @@ class Controller:
         self._last_action: Dict[str, float] = {}
         # last APPLIED resize: (direction, unix time) — hysteresis
         self._last_resize: Optional[List] = None
+        # last APPLIED replica-scale action (spawn_replica=grow /
+        # drain_replica=shrink, unix time) — the same reversal guard
+        # applied to the Armada serving fleet (ISSUE 20)
+        self._last_replica: Optional[List] = None
         self._fails: Dict[str, int] = {}       # consecutive failures
         self._retry_at: Dict[str, float] = {}  # post-failure backoff
         self._inflight: set = set()            # single-flight classes
@@ -164,6 +168,9 @@ class Controller:
                                  in (doc.get("last_action") or {}).items()}
             lr = doc.get("last_resize")
             self._last_resize = [str(lr[0]), float(lr[1])] if lr else None
+            lp = doc.get("last_replica")
+            self._last_replica = [str(lp[0]), float(lp[1])] if lp \
+                else None
             self._fails = {str(k): int(v) for k, v
                            in (doc.get("fails") or {}).items()}
             self._retry_at = {str(k): float(v) for k, v
@@ -184,6 +191,7 @@ class Controller:
         doc = {"schema": _STATE_SCHEMA, "seq": self._seq,
                "last_action": self._last_action,
                "last_resize": self._last_resize,
+               "last_replica": self._last_replica,
                "fails": self._fails, "retry_at": self._retry_at,
                "degraded": self.degraded,
                "time_unix": self._now()}
@@ -311,6 +319,21 @@ class Controller:
                         noop=not dead)
         elif kind == "drain":
             plan.update(magnitude=1)
+        elif kind in ("spawn_replica", "drain_replica"):
+            # Armada serving-fleet scaling (ISSUE 20): one replica per
+            # decision, with the resize-style direction-reversal guard
+            # so a spawn cannot chase a drain (or vice versa) inside
+            # the hysteresis window
+            direction = ("grow" if kind == "spawn_replica"
+                         else "shrink")
+            hys = float(_flag("controller_hysteresis_s",
+                              act.get("hysteresis")))
+            if self._last_replica is not None \
+                    and self._last_replica[0] != direction \
+                    and now - self._last_replica[1] < hys:
+                self._skip("hysteresis")
+                return None
+            plan.update(direction=direction, magnitude=1)
         else:                                    # "log" dry-run
             plan.update(magnitude=0)
         return plan
@@ -402,6 +425,9 @@ class Controller:
             self._retry_at.pop(cls, None)
             if outcome == "applied" and kind == "request_resize":
                 self._last_resize = [plan["direction"], now]
+            if outcome == "applied" and kind in ("spawn_replica",
+                                                 "drain_replica"):
+                self._last_replica = [plan["direction"], now]
         elif outcome == "fenced":
             # a correctness save, not an error and not an action: no
             # cooldown (retry with a fresh token next tick), no
@@ -492,6 +518,8 @@ class Controller:
                 "cooldowns": cooldowns,
                 "last_resize": list(self._last_resize)
                 if self._last_resize else None,
+                "last_replica": list(self._last_replica)
+                if self._last_replica else None,
                 "decisions": [dict(d) for d in self._decisions],
             }
 
@@ -578,6 +606,23 @@ def wire_master(master, supervisor=None,
                           state_path=state_path)
 
 
+def wire_router(router, spawn_replica: Optional[Callable] = None,
+                state_path: Optional[str] = None
+                ) -> Optional[Controller]:
+    """Convenience wiring for an Armada router frontend (ISSUE 20):
+    ``drain_replica`` actuates the router's graceful scale-down verb
+    (least-loaded ready replica stops admitting, then drains);
+    ``spawn_replica`` is the fleet owner's grow callback
+    (ServingFleet.spawn_replica) when it owns one.  Both kinds run
+    through the same fenced single-flight policy layer — cooldowns,
+    hysteresis, breaker — and journal as ``controller.decision``."""
+    actuators: Dict[str, Callable] = {
+        "drain_replica": lambda: router.drain_replica()}
+    if spawn_replica is not None:
+        actuators["spawn_replica"] = spawn_replica
+    return ensure_started(actuators=actuators, state_path=state_path)
+
+
 def status_doc() -> dict:
     """The ``GET /controller`` document — meaningful even while
     disabled (enabled=False, empty decision list)."""
@@ -587,7 +632,7 @@ def status_doc() -> dict:
     return {"schema": SCHEMA, "time_unix": time.time(),
             "enabled": enabled(), "degraded": False, "seq": 0,
             "actuators": [], "breaker": None, "cooldowns": {},
-            "last_resize": None, "decisions": []}
+            "last_resize": None, "last_replica": None, "decisions": []}
 
 
 def reset():

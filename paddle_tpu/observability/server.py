@@ -306,10 +306,29 @@ class ObservabilityServer:
         # is hung mid-train — a k8s probe keyed on the status must
         # restart a deadlocked worker, not 200 it forever
         degraded = bool(fleet and fleet["degraded"]) or trainer["hung"]
-        return {"status": "degraded" if degraded else "ok",
-                "time_unix": time.time(),
-                "trainer": trainer,
-                "fleet": fleet}
+        doc = {"status": "degraded" if degraded else "ok",
+               "time_unix": time.time(),
+               "trainer": trainer,
+               "fleet": fleet}
+        # serving worker (ISSUE 20): report batcher state so the
+        # router's readiness probe and a human operator read ONE truth
+        # (the stdout ready line stops being the only signal).  Looked
+        # up via sys.modules, never imported — a process that never
+        # served keeps a byte-identical healthz body and import graph.
+        import sys as _sys
+        serving_mod = _sys.modules.get("paddle_tpu.serving")
+        b = serving_mod.get() if serving_mod is not None else None
+        if b is not None:
+            state = ("draining" if b.draining
+                     else "running" if b.running else "stopped")
+            doc["serving"] = {"state": state,
+                              "queue_depth": b.queue_depth,
+                              "replica": serving_mod.replica_id()}
+            if state != "running":
+                # readiness semantics: a draining/stopped batcher is
+                # a 503 probe answer (the route maps non-ok to 503)
+                doc["status"] = state
+        return doc
 
     def flight(self) -> dict:
         # a scrape is a pure observer: never advance the counter-delta
@@ -479,8 +498,17 @@ class ObservabilityServer:
 
     def serving_generate(self, body: dict, trace=None):
         """``POST /serving/generate`` body: submit to the attached
-        batcher and block for the result.  Returns (http_code, doc)."""
+        batcher and block for the result.  Returns (http_code, doc).
+
+        With an Armada router attached (serving/router.py), the
+        request is ROUTED instead — health/load-aware replica choice,
+        retry-elsewhere, breakers, deadline propagation.  No router
+        (the default) = the single-replica path below, byte for
+        byte."""
         from .. import serving as serving_mod
+        router = serving_mod.get_router()
+        if router is not None:
+            return router.handle(body, trace=trace)
         batcher = serving_mod.get()
         if batcher is None or not batcher.running:
             return 503, {"error": "no serving batcher attached"}

@@ -22,6 +22,7 @@ through :func:`get`, and tests detach via :func:`reset` (conftest).
 """
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Optional
@@ -36,7 +37,8 @@ from .kv_cache import DecodeEngine, extract_lm_params
 
 __all__ = ["DecodeEngine", "extract_lm_params", "ContinuousBatcher",
            "ServingRequest", "ShedError", "attach", "get", "drain",
-           "reset", "status_doc", "histogram_quantiles"]
+           "reset", "status_doc", "histogram_quantiles", "get_router",
+           "replica_id"]
 
 _lock = threading.Lock()
 _batcher: Optional[ContinuousBatcher] = None
@@ -58,6 +60,24 @@ def get() -> Optional[ContinuousBatcher]:
     return _batcher
 
 
+def get_router():
+    """The attached Armada router (serving/router.py), or None.
+    DELIBERATELY lazy: the router module is looked up, never imported
+    — a single-replica process that never touches the router keeps
+    byte-identical routes, metric families and import graph (the
+    router-off invariance contract; tests/test_router.py)."""
+    mod = sys.modules.get(__name__ + ".router")
+    return None if mod is None else mod.get()
+
+
+def replica_id() -> Optional[str]:
+    """This worker's replica identity in a routed fleet (the
+    supervisor's env_factory sets PTPU_REPLICA_ID), or None when the
+    process is not a fleet member."""
+    import os
+    return os.environ.get("PTPU_REPLICA_ID")
+
+
 def drain(stop: bool = False) -> dict:
     """Drain the attached batcher on command (ISSUE 17): the
     controller's ``drain`` actuator and the body behind
@@ -75,12 +95,17 @@ def drain(stop: bool = False) -> dict:
 
 def reset():
     """Test hook (conftest): stop the attached batcher (loop thread
-    JOINED), detach it from the HTTP routes."""
+    JOINED), detach it from the HTTP routes; same for the router
+    (probe thread joined, per-replica metric series dropped) when its
+    module was ever imported."""
     global _batcher
     with _lock:
         b, _batcher = _batcher, None
     if b is not None:
         b.stop()
+    mod = sys.modules.get(__name__ + ".router")
+    if mod is not None:
+        mod.reset()
 
 
 def status_doc() -> dict:
@@ -94,6 +119,9 @@ def status_doc() -> dict:
     }
     if b is not None:
         doc.update(b.status_doc())
+    r = get_router()
+    if r is not None:
+        doc["router"] = r.status_doc()
 
     def _counter_value(name, **labels):
         m = obs_metrics.REGISTRY.get(name)

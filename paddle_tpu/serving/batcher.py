@@ -396,7 +396,13 @@ class ContinuousBatcher:
         # not a dead slot later (bucket fit AND room to generate)
         self.engine.validate_prompt(len(req.prompt))
         with self._lock:
-            if self._draining or self._stop:
+            # _drain_requested too (ISSUE 20 bugfix): between SIGTERM
+            # landing and the next decode-step boundary the batcher is
+            # already doomed — admitting here would queue-then-shed,
+            # making a failing-over router (or client) WAIT on a dying
+            # replica's queue instead of getting the synchronous
+            # `drained` answer that triggers retry-elsewhere
+            if self._draining or self._stop or self._drain_requested:
                 req._finish("drained", "serving is draining")
                 raise ShedError("serving is draining", self.queue_depth,
                                 draining=True)

@@ -1,0 +1,166 @@
+"""Armada fleet plumbing (ISSUE 20): N supervised serving replicas
+behind one health-aware router.
+
+:class:`ServingFleet` owns the whole topology: it allocates one port
+per replica, puts the PR 5 :class:`~paddle_tpu.distributed.supervisor.
+Supervisor` in charge of the worker processes (crash = deterministic
+backoff restart on the SAME port, chaos-stripped, so the router's
+probe sees the replica RESUME at its old address), and fronts them
+with a :class:`~paddle_tpu.serving.router.Router`.  ``spawn_replica``
+is the grow verb Helmsman's ``spawn_replica`` action actuates: a new
+port, a new supervised rank (``Supervisor.set_world_size`` via the
+cmd/env factories), and a new router member that goes ready when its
+worker answers /healthz.
+
+``python -m paddle_tpu.serving.fleet_worker <port> --replicas N``
+stands up the whole thing for manual poking; tests drive it
+in-process (tests/test_router.py soaks).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ..observability import journal as obs_journal
+from .router import Router
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def default_worker_env(extra: Optional[Dict[str, str]] = None
+                       ) -> Dict[str, str]:
+    """Subprocess env for a serving worker: CPU platform pinned, the
+    test harness's fake-device XLA_FLAGS and PYTHONPATH stripped (the
+    conftest discipline — 8 virtual devices leak into a child as a
+    real topology), chaos disarmed unless the caller arms it."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PYTHONPATH", None)
+    env.pop("PTPU_CHAOS_SPEC", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra or {})
+    return env
+
+
+class ServingFleet:
+    """N supervised serving workers + the router that fronts them."""
+
+    def __init__(self, n_replicas: int, seed: int = 7,
+                 env: Optional[Dict[str, str]] = None,
+                 replica_envs: Optional[
+                     Dict[int, Dict[str, str]]] = None,
+                 cwd: Optional[str] = None,
+                 log_dir: Optional[str] = None,
+                 supervisor_kwargs: Optional[dict] = None,
+                 router_kwargs: Optional[dict] = None):
+        from ..distributed.supervisor import Supervisor
+        self.seed = int(seed)
+        self.ports: List[int] = [_free_port() for _ in range(n_replicas)]
+        self._env = default_worker_env() if env is None else dict(env)
+        replica_envs = dict(replica_envs or {})
+        self.supervisor = Supervisor(
+            cmds=[self._cmd(r) for r in range(n_replicas)],
+            env=self._env,
+            envs=[dict(self._replica_env(r), **replica_envs.get(r, {}))
+                  for r in range(n_replicas)],
+            cwd=cwd, log_dir=log_dir,
+            cmd_factory=self._cmd, env_factory=self._replica_env,
+            **(supervisor_kwargs or {}))
+        self.router = Router(
+            [(str(r), self.url(r)) for r in range(n_replicas)],
+            **(router_kwargs or {}))
+
+    def _cmd(self, rank: int) -> List[str]:
+        while rank >= len(self.ports):
+            self.ports.append(_free_port())
+        return [sys.executable, "-m", "paddle_tpu.serving.worker",
+                str(self.ports[rank]), str(self.seed)]
+
+    def _replica_env(self, rank: int) -> Dict[str, str]:
+        return {"PTPU_REPLICA_ID": str(rank)}
+
+    def url(self, rank: int) -> str:
+        return f"http://127.0.0.1:{self.ports[rank]}"
+
+    @property
+    def world_size(self) -> int:
+        return self.supervisor.target_world
+
+    def start(self) -> "ServingFleet":
+        self.supervisor.start()
+        self.router.start()
+        return self
+
+    def wait_ready(self, timeout: float = 120.0) -> "ServingFleet":
+        """Block until every replica probes ready (worker cold start:
+        interpreter + model build + AOT bucket grid)."""
+        deadline = time.time() + timeout
+        want = self.world_size
+        while time.time() < deadline:
+            if self.router.probe_all() >= want:
+                return self
+            time.sleep(0.3)
+        raise RuntimeError(
+            f"fleet not ready after {timeout}s: "
+            f"{self.router.status_doc()['replicas']} / "
+            f"supervisor={self.supervisor.status()}")
+
+    def spawn_replica(self) -> int:
+        """Grow the fleet by one replica (the Helmsman actuator): new
+        port, new supervised rank, new router member.  Returns the new
+        rank; the router routes to it once its probe goes ready."""
+        rank = self.world_size
+        self.supervisor.set_world_size(rank + 1)
+        self.router.add_replica(self.url(rank), rid=str(rank))
+        obs_journal.emit("router", "spawn_replica", replica=str(rank),
+                         url=self.url(rank))
+        return rank
+
+    def stop(self):
+        self.router.stop()
+        self.supervisor.stop(kill=True)
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """Stand up a fleet + router + observability endpoint and serve
+    until SIGTERM (which drains every replica, then exits)."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving.fleet_worker",
+        description="Armada: N supervised serving replicas behind one "
+                    "health-aware router.")
+    ap.add_argument("port", type=int, help="router HTTP port")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--log-dir", default=None)
+    args = ap.parse_args(argv)
+    from ..observability import server as obs_server
+    from . import router as router_mod
+    fleet = ServingFleet(args.replicas, seed=args.seed,
+                         log_dir=args.log_dir).start()
+    fleet.wait_ready()
+    router_mod.attach(fleet.router)
+    fleet.router.install_signal_handlers()
+    srv = obs_server.start_http_server(port=args.port)
+    print(f"ROUTER_READY {srv.url} replicas={fleet.world_size}",
+          flush=True)
+    try:
+        while fleet.router.running:
+            time.sleep(0.1)
+    finally:
+        router_mod.reset()
+        fleet.stop()
+        obs_server.stop_http_server()
+    print("ROUTER_DRAINED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -76,6 +76,73 @@ def http_submit(url: str, timeout: float = 60.0) -> SubmitFn:
     return submit
 
 
+def round_robin_submit(targets: Sequence[tuple]) -> SubmitFn:
+    """Round-robin over named submit fns (ISSUE 20): ``targets`` is a
+    sequence of ``(name, submit_fn)``.  The returned fn carries a
+    ``per_target`` ledger — per-target ok/shed/error counts — so a
+    multi-replica soak can assert WHERE traffic landed, not just that
+    it terminated."""
+    targets = [(str(n), fn) for n, fn in targets]
+    if not targets:
+        raise ValueError("round_robin_submit: no targets")
+    lock = threading.Lock()
+    cursor = [0]
+    per_target = {n: {"ok": 0, "shed": 0, "error": 0}
+                  for n, _ in targets}
+
+    def submit(prompt, max_new_tokens, temperature):
+        with lock:
+            name, fn = targets[cursor[0] % len(targets)]
+            cursor[0] += 1
+        try:
+            resp = fn(prompt, max_new_tokens, temperature)
+        except ShedError:
+            with lock:
+                per_target[name]["shed"] += 1
+            raise
+        except Exception:
+            with lock:
+                per_target[name]["error"] += 1
+            raise
+        with lock:
+            per_target[name]["ok" if resp.get("status") == "ok"
+                             else "error"] += 1
+        return resp
+
+    submit.per_target = per_target
+    return submit
+
+
+def http_submit_multi(urls: Sequence[str],
+                      timeout: float = 60.0) -> SubmitFn:
+    """Round-robin HTTP submit over several serving endpoints (the
+    multi ``--url`` CLI path): each target keeps its own ledger row."""
+    return round_robin_submit(
+        [(u, http_submit(u, timeout)) for u in urls])
+
+
+def router_submit(router, timeout: float = 60.0) -> SubmitFn:
+    """Submit function bound to an IN-PROCESS Armada router
+    (serving/router.py): same exception contract as http_submit so
+    run_loadgen's ledger semantics carry over — 429 raises ShedError,
+    any other non-200 raises ConnectionError (the stream retries)."""
+
+    def submit(prompt, max_new_tokens, temperature):
+        code, doc = router.handle({
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "timeout_s": timeout})
+        if code == 429:
+            raise ShedError(str(doc.get("error")),
+                            int(doc.get("queue_depth") or 0))
+        if code != 200:
+            raise ConnectionError(f"router HTTP {code}: {doc}")
+        return doc
+
+    return submit
+
+
 def _pct(vals: List[float], q: float) -> Optional[float]:
     if not vals:
         return None
@@ -99,7 +166,7 @@ def run_loadgen(submit: SubmitFn, streams: int = 8,
     if p99_budget_ms is None:
         p99_budget_ms = float(flags.get_flag("serving_p99_budget_ms"))
     counts = {"issued": 0, "ok": 0, "shed": 0, "error": 0,
-              "gave_up": 0, "tokens": 0}
+              "gave_up": 0, "tokens": 0, "retried_ok": 0}
     ttfts: List[float] = []
     per_token: List[float] = []
     trace_ids: List[str] = []      # X-ray: one per ok response that
@@ -135,6 +202,11 @@ def run_loadgen(submit: SubmitFn, streams: int = 8,
                     continue
                 with lock:
                     counts["ok"] += 1
+                    if attempt > 0:
+                        # the zero-lost headline's other half: the
+                        # request DID succeed after riding through a
+                        # shed/kill/drain window on retries
+                        counts["retried_ok"] += 1
                     counts["tokens"] += int(resp.get("n_tokens") or 0)
                     if resp.get("trace_id"):
                         trace_ids.append(str(resp["trace_id"]))
@@ -182,6 +254,10 @@ def run_loadgen(submit: SubmitFn, streams: int = 8,
         "p99_budget_ms": p99_budget_ms,
         "budget_ok": budget_ok,
         "trace_ids": trace_ids,
+        # per-target admission rows when the submit fn keeps them
+        # (round_robin_submit / http_submit_multi)
+        "per_target": {k: dict(v) for k, v in getattr(
+            submit, "per_target", {}).items()} or None,
         "ok": accounted and budget_ok and counts["gave_up"] == 0
               and counts["ok"] == streams * requests_per_stream,
     }
@@ -194,8 +270,11 @@ def _main(argv: Optional[List[str]] = None) -> int:
         prog="python -m paddle_tpu.serving.loadgen",
         description="Closed-loop serving load generator; nonzero exit "
                     "on SLO-budget violation or lost requests.")
-    ap.add_argument("--url", required=True,
-                    help="serving endpoint root, e.g. http://127.0.0.1:8080")
+    ap.add_argument("--url", required=True, action="append",
+                    help="serving endpoint root, e.g. "
+                         "http://127.0.0.1:8080; repeatable — several "
+                         "targets round-robin (ISSUE 20) with a "
+                         "per-target row in the report")
     ap.add_argument("--streams", type=int, default=8)
     ap.add_argument("--requests", type=int, default=4)
     ap.add_argument("--max-new-tokens", type=int, default=8)
@@ -206,7 +285,9 @@ def _main(argv: Optional[List[str]] = None) -> int:
                          "serving_p99_budget_ms flag)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
-    rep = run_loadgen(http_submit(args.url), streams=args.streams,
+    submit = (http_submit(args.url[0]) if len(args.url) == 1
+              else http_submit_multi(args.url))
+    rep = run_loadgen(submit, streams=args.streams,
                       requests_per_stream=args.requests,
                       max_new_tokens=args.max_new_tokens,
                       temperature=args.temperature,

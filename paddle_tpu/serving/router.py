@@ -1,0 +1,687 @@
+"""Armada: health-aware multi-replica serving router (ISSUE 20).
+
+An HTTP frontend over N supervised serving workers — the reference's
+Go cloud tier (etcd-backed fault-tolerant master/pserver) applied to
+the inference plane: clients POST /serving/generate to ONE address and
+replica death, drain or overload is the router's problem, not theirs.
+
+  * Routing: readiness-probed (GET /healthz on every replica, the
+    worker's batcher state) + least-loaded (in-flight count, then the
+    probed queue depth, round-robin among ties).
+  * Retry-elsewhere: a 503-drained / connection-refused /
+    deadline-exceeded dispatch answer is retried on a DIFFERENT
+    replica with deterministic backoff (resilience/retry.py jitter)
+    under a per-request retry budget; 429 (shed) and 4xx pass through
+    — backpressure and client errors are not failover events.
+  * Per-replica circuit breakers: ``router_breaker_threshold``
+    consecutive errors open the breaker (no routing); after
+    ``router_breaker_reset_s`` it half-opens and one probe (or, with
+    no alternative replica, one trial request) decides recovery.
+  * Deadlines end to end: the client's ``timeout_s`` (or
+    ``router_default_deadline_s``) is a hard wall — every hop carries
+    only the REMAINING budget, and an expired deadline is an explicit
+    504, never a lost request.
+  * Graceful drain: ``drain_replica`` stops admitting to a replica
+    BEFORE telling it to drain (in-flight finishes elsewhere);
+    SIGTERM on the router drains every replica, waits out its own
+    in-flight dispatches, then exits.
+
+Chaos sites ``router.dispatch`` / ``router.probe`` make every failure
+mode injectable; journal kind ``router`` records spawn/ready/drain/
+dead/route-away transitions; ``router_*`` metrics put per-replica
+requests, retries, breaker state and the healthy-replica gauge on
+/metrics.  The module is imported LAZILY — a single-replica process
+that never touches the router keeps byte-identical routes, metric
+families and compile keys (the flag-off invariance idiom; regression
+in tests/test_router.py).
+"""
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..core import flags
+from ..observability import journal as obs_journal
+from ..observability import metrics as obs_metrics
+from ..observability import tracectx as obs_tracectx
+from ..resilience import chaos
+from ..resilience import retry as rretry
+
+SCHEMA = "paddle_tpu.serving.router.v1"
+
+_m_requests = obs_metrics.counter(
+    "router_requests_total",
+    "Client requests terminated by the router, by answering replica "
+    "('none' when no replica answered) and terminal status.",
+    ("replica", "status"))
+_m_dispatches = obs_metrics.counter(
+    "router_dispatches_total",
+    "Dispatch attempts started, by target replica (a client request "
+    "that retries elsewhere counts once per hop).", ("replica",))
+_m_retries = obs_metrics.counter(
+    "router_retries_total",
+    "Retry-elsewhere events, by reason (drained | refused | timeout "
+    "| error).", ("reason",))
+_m_breaker = obs_metrics.gauge(
+    "router_breaker_state",
+    "Per-replica circuit breaker: 0 closed, 1 half-open, 2 open.",
+    ("replica",))
+_m_healthy = obs_metrics.gauge(
+    "router_healthy_replicas",
+    "Replicas currently ready with a closed breaker.")
+_m_latency = obs_metrics.histogram(
+    "router_request_seconds",
+    "End-to-end router latency per client request (all hops + "
+    "backoff included).")
+
+# breaker gauge encoding
+_BREAKER_CODE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+
+class HttpTransport:
+    """Default wire: urllib against each replica's observability
+    endpoint.  Returns ``(code, doc)`` for ANY HTTP answer (4xx/5xx
+    included — those are classified by the router, not exceptions);
+    raises ConnectionError when the replica is unreachable and
+    TimeoutError when the socket deadline expires."""
+
+    def get_json(self, url: str, path: str,
+                 timeout: float) -> Tuple[int, dict]:
+        import socket
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + path,
+                                        timeout=timeout) as r:
+                return r.status, json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            return e.code, self._body(e)
+        except socket.timeout as e:
+            raise TimeoutError(f"{url}{path}: {e}") from e
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, socket.timeout):
+                raise TimeoutError(f"{url}{path}: {e.reason}") from e
+            raise ConnectionError(f"{url}{path}: {e.reason}") from e
+
+    def post_json(self, url: str, path: str, body: dict, timeout: float,
+                  headers: Optional[Dict[str, str]] = None
+                  ) -> Tuple[int, dict]:
+        import socket
+        import urllib.error
+        import urllib.request
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        req = urllib.request.Request(
+            url.rstrip("/") + path, data=json.dumps(body).encode(),
+            headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            return e.code, self._body(e)
+        except socket.timeout as e:
+            raise TimeoutError(f"{url}{path}: {e}") from e
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, socket.timeout):
+                raise TimeoutError(f"{url}{path}: {e.reason}") from e
+            raise ConnectionError(f"{url}{path}: {e.reason}") from e
+
+    @staticmethod
+    def _body(e) -> dict:
+        try:
+            return json.loads(e.read().decode() or "{}")
+        except Exception:
+            return {"error": f"HTTP {e.code}"}
+
+
+class Replica:
+    """One routed serving worker: probed health + load + breaker."""
+
+    __slots__ = ("rid", "url", "state", "queue_depth", "inflight",
+                 "breaker", "consecutive", "open_until", "last_seen")
+
+    def __init__(self, rid: str, url: str):
+        self.rid = str(rid)
+        self.url = str(url).rstrip("/")
+        # "starting" | "ready" | "draining" | "dead"
+        self.state = "starting"
+        self.queue_depth = 0
+        self.inflight = 0
+        self.breaker = "closed"          # "closed" | "open"
+        self.consecutive = 0             # consecutive dispatch/probe
+        self.open_until = 0.0            # errors while closed
+        self.last_seen = 0.0
+
+    def breaker_state(self, now: float) -> str:
+        if self.breaker == "closed":
+            return "closed"
+        return "half_open" if now >= self.open_until else "open"
+
+    def to_dict(self, now: float) -> dict:
+        return {"replica": self.rid, "url": self.url,
+                "state": self.state,
+                "breaker": self.breaker_state(now),
+                "inflight": self.inflight,
+                "queue_depth": self.queue_depth,
+                "consecutive_errors": self.consecutive}
+
+
+class Router:
+    """Health/load-aware request router over N serving replicas.
+
+    Every tunable has a constructor override (tests) defaulting to its
+    ``router_*`` flag; `transport`, `now_fn` and `sleep_fn` are seams
+    so the breaker/drain state machines are testable with no sockets
+    and no real sleeps."""
+
+    def __init__(self, replicas: Sequence[Union[str, Tuple[str, str]]],
+                 *, transport: Optional[HttpTransport] = None,
+                 now_fn: Callable[[], float] = time.time,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 retry_budget: Optional[int] = None,
+                 probe_interval: Optional[float] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_reset_s: Optional[float] = None,
+                 backoff_s: Optional[float] = None,
+                 default_deadline_s: Optional[float] = None):
+        self.transport = transport or HttpTransport()
+        self._now = now_fn
+        self._sleep = sleep_fn
+
+        def _f(flag, override):
+            return flags.get_flag(flag) if override is None else override
+
+        self.retry_budget = int(_f("router_retry_budget", retry_budget))
+        self.probe_interval = float(_f("router_probe_interval_s",
+                                       probe_interval))
+        self.breaker_threshold = int(_f("router_breaker_threshold",
+                                        breaker_threshold))
+        self.breaker_reset_s = float(_f("router_breaker_reset_s",
+                                        breaker_reset_s))
+        self.default_deadline_s = float(_f("router_default_deadline_s",
+                                           default_deadline_s))
+        self._retry = rretry.RetryPolicy(
+            name="router_dispatch", max_attempts=self.retry_budget + 1,
+            base_delay=float(_f("router_backoff_s", backoff_s)),
+            max_delay=1.0)
+        self._lock = threading.RLock()
+        self.replicas: List[Replica] = []
+        for i, spec in enumerate(replicas):
+            rid, url = (str(i), spec) if isinstance(spec, str) else spec
+            self.replicas.append(Replica(rid, url))
+        self._rr = 0                     # round-robin tie-break cursor
+        self._draining = False
+        self._drain_requested = False    # SIGTERM flag: the probe loop
+        self._stop_evt = threading.Event()   # honors it off-handler
+        self._thread: Optional[threading.Thread] = None
+        self._update_healthy()
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return not self._stop_evt.is_set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start(self) -> "Router":
+        """Start the probe loop — also the router's control loop (it
+        notices revived replicas, closes recovered breakers, honors a
+        pending SIGTERM drain)."""
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop_evt.clear()
+                self._thread = threading.Thread(
+                    target=self._probe_loop, name="router-probe",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        """Stop the probe loop (no drain — tests/conftest)."""
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT = drain every replica, finish in-flight
+        dispatches, then exit (the worker's preemption contract, one
+        level up).  The handler only sets a flag — the probe loop does
+        the actual teardown outside signal context."""
+
+        def _handler(signum, frame):
+            self._drain_requested = True
+
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+
+    def request_drain(self):
+        """Async-signal-safe drain trigger (what the SIGTERM handler
+        does); the probe loop picks it up within one interval."""
+        self._drain_requested = True
+
+    def _probe_loop(self):
+        while not self._stop_evt.is_set():
+            if self._drain_requested and not self._draining:
+                self.begin_drain(stop=True)
+                return
+            try:
+                self.probe_all()
+            except Exception:
+                pass                     # probes must never kill the loop
+            self._stop_evt.wait(self.probe_interval)
+
+    # -- probing -----------------------------------------------------------
+    def probe_all(self) -> int:
+        """Probe every replica once; returns the ready count."""
+        for rep in list(self.replicas):
+            self.probe_once(rep)
+        with self._lock:
+            return sum(1 for r in self.replicas if r.state == "ready")
+
+    def probe_once(self, rep: Replica) -> bool:
+        """GET /healthz on one replica; classify and update state.
+        Chaos site ``router.probe`` injects probe-path failures."""
+        now = self._now()
+        try:
+            chaos.trigger("router.probe")
+            code, doc = self.transport.get_json(
+                rep.url, "/healthz",
+                timeout=max(self.probe_interval, 1.0))
+        except (ConnectionError, OSError, TimeoutError,
+                chaos.InjectedFault):
+            self._mark(rep, "dead")
+            self._strike(rep, "probe")
+            return False
+        serving = (doc or {}).get("serving") or {}
+        with self._lock:
+            rep.last_seen = now
+            rep.queue_depth = int(serving.get("queue_depth") or 0)
+        state = serving.get("state")
+        if state == "running":
+            self._mark(rep, "ready")
+            self._probe_success(rep)
+            return True
+        if state == "draining":
+            self._mark(rep, "draining")
+        elif state == "stopped":
+            self._mark(rep, "dead")
+        else:
+            # healthz answered but no serving section: the worker's
+            # endpoint is up before/without a batcher — not routable
+            self._mark(rep, "starting")
+        return False
+
+    def _mark(self, rep: Replica, state: str):
+        """State transition with journal on CHANGE only."""
+        with self._lock:
+            old, rep.state = rep.state, state
+            if old == state:
+                return
+            resumed = state == "ready" and old in ("dead", "draining")
+            self._update_healthy()
+        event = {"ready": "ready", "dead": "dead",
+                 "draining": "drain", "starting": "starting"}[state]
+        obs_journal.emit("router", event, replica=rep.rid, url=rep.url,
+                         previous=old)
+        if resumed:
+            # the headline transition: a killed/drained replica is
+            # back in rotation
+            obs_journal.emit("router", "resume", replica=rep.rid,
+                            url=rep.url)
+
+    def _strike(self, rep: Replica, where: str):
+        """One consecutive-error strike; trips/re-arms the breaker."""
+        now = self._now()
+        with self._lock:
+            rep.consecutive += 1
+            tripped = False
+            if rep.breaker == "closed" \
+                    and rep.consecutive >= self.breaker_threshold:
+                rep.breaker = "open"
+                rep.open_until = now + self.breaker_reset_s
+                tripped = True
+            elif rep.breaker == "open" and now >= rep.open_until:
+                # failed half-open trial: re-open for another window
+                rep.open_until = now + self.breaker_reset_s
+            _m_breaker.labels(replica=rep.rid).set(
+                _BREAKER_CODE[rep.breaker_state(now)])
+            self._update_healthy()
+        if tripped:
+            obs_journal.emit("router", "breaker_open", replica=rep.rid,
+                             consecutive=rep.consecutive, where=where)
+
+    def _probe_success(self, rep: Replica):
+        now = self._now()
+        with self._lock:
+            rep.consecutive = 0
+            closed = rep.breaker != "closed"
+            rep.breaker = "closed"
+            _m_breaker.labels(replica=rep.rid).set(0.0)
+            self._update_healthy()
+        if closed:
+            obs_journal.emit("router", "breaker_close", replica=rep.rid)
+
+    def _update_healthy(self):
+        # call under lock
+        now = self._now()
+        _m_healthy.set(float(sum(
+            1 for r in self.replicas
+            if r.state == "ready" and r.breaker_state(now) == "closed")))
+
+    # -- membership --------------------------------------------------------
+    def add_replica(self, url: str, rid: Optional[str] = None) -> Replica:
+        """Register a new (spawning) replica; the probe loop promotes
+        it to ready once its worker answers /healthz running."""
+        with self._lock:
+            rid = str(len(self.replicas)) if rid is None else str(rid)
+            rep = Replica(rid, url)
+            self.replicas.append(rep)
+        obs_journal.emit("router", "spawn", replica=rep.rid, url=rep.url)
+        return rep
+
+    def drain_replica(self, rid: Optional[str] = None,
+                      stop: bool = False) -> str:
+        """Graceful scale-down verb (the Helmsman ``drain_replica``
+        actuator): stop admitting to one replica — chosen, or the
+        least-loaded ready one — THEN tell it to drain.  The mark is
+        synchronous under the router lock, so no dispatch can start
+        against the replica after this returns."""
+        with self._lock:
+            if rid is not None:
+                cands = [r for r in self.replicas if r.rid == str(rid)]
+            else:
+                cands = sorted(
+                    (r for r in self.replicas if r.state == "ready"),
+                    key=lambda r: (r.inflight, r.queue_depth, r.rid))
+            if not cands:
+                raise RuntimeError(
+                    f"drain_replica: no ready replica to drain "
+                    f"(rid={rid!r})")
+            rep = cands[0]
+        self._mark(rep, "draining")
+        try:
+            self.transport.post_json(rep.url, "/serving/drain",
+                                     {"stop": bool(stop)}, timeout=5.0)
+        except (ConnectionError, OSError, TimeoutError) as e:
+            # already gone = already drained; the probe will classify
+            obs_journal.emit("router", "drain_rpc_failed",
+                             replica=rep.rid, error=repr(e)[:120])
+        return rep.rid
+
+    def begin_drain(self, stop: bool = True, timeout: float = 30.0):
+        """Router-wide drain (SIGTERM semantics): stop admitting, tell
+        every replica to drain, wait out in-flight dispatches; with
+        ``stop`` also end the probe loop (process exit follows)."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        obs_journal.emit("router", "drain_begin",
+                         replicas=len(self.replicas), stop=bool(stop))
+        for rep in list(self.replicas):
+            try:
+                self.transport.post_json(rep.url, "/serving/drain",
+                                         {"stop": bool(stop)},
+                                         timeout=5.0)
+            except (ConnectionError, OSError, TimeoutError):
+                pass                     # dead already = drained already
+            self._mark(rep, "draining")
+        deadline = self._now() + timeout
+        while self._now() < deadline:
+            with self._lock:
+                if not any(r.inflight for r in self.replicas):
+                    break
+            self._sleep(0.05)
+        obs_journal.emit("router", "drain_complete",
+                         replicas=len(self.replicas))
+        if stop:
+            self._stop_evt.set()
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self, tried: set) -> Optional[Replica]:
+        """Least-loaded ready replica with a closed breaker; falls back
+        to a half-open trial when nothing closed is routable.  Prefers
+        replicas this request has NOT yet failed on; round-robin among
+        load ties."""
+        now = self._now()
+        with self._lock:
+            cands = [r for r in self.replicas
+                     if r.state == "ready"
+                     and r.breaker_state(now) == "closed"]
+            if not cands:
+                cands = [r for r in self.replicas
+                         if r.state == "ready"
+                         and r.breaker_state(now) == "half_open"]
+            if not cands:
+                return None
+            fresh = [r for r in cands if r.rid not in tried]
+            if fresh:
+                cands = fresh
+            key = min((r.inflight, r.queue_depth) for r in cands)
+            ties = [r for r in cands
+                    if (r.inflight, r.queue_depth) == key]
+            self._rr += 1
+            rep = ties[self._rr % len(ties)]
+            rep.inflight += 1
+            return rep
+
+    def handle(self, body: dict, trace=None) -> Tuple[int, dict]:
+        """Route one ``POST /serving/generate`` body; returns
+        ``(http_code, doc)`` exactly like the single-replica path, plus
+        ``replica`` (who answered) and ``hops`` (dispatches consumed).
+        Every outcome is explicit: ok | shed (429, passthrough) |
+        drained/error (503) | timeout (504) — never a lost request."""
+        t0 = time.perf_counter()
+        if self._draining or not self.running:
+            return 503, {"error": "router is draining",
+                         "status": "drained"}
+        try:
+            timeout_s = float(body.get("timeout_s")
+                              or self.default_deadline_s)
+        except (TypeError, ValueError):
+            return 400, {"error": "malformed request field: timeout_s",
+                         "status": "error"}
+        deadline = self._now() + timeout_s
+        tried: set = set()
+        hops = 0
+        last_reason, last_doc = "error", {}
+        while True:
+            remaining = deadline - self._now()
+            if remaining <= 0:
+                return self._finish(t0, None, 504, {
+                    "error": f"deadline exceeded after {hops} "
+                             f"dispatch(es)", "status": "timeout",
+                    "hops": hops})
+            rep = self._pick(tried)
+            if rep is None:
+                return self._finish(t0, None, 503, {
+                    "error": "no healthy replica "
+                             f"(last: {last_reason})",
+                    "status": "drained" if last_reason == "drained"
+                              else "error",
+                    "hops": hops})
+            hops += 1
+            _m_dispatches.labels(replica=rep.rid).inc()
+            outcome: Tuple[str, int, dict]
+            try:
+                outcome = self._dispatch(rep, body, remaining, trace)
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            verdict, code, doc = outcome
+            if verdict == "done":
+                if isinstance(doc, dict):
+                    doc.setdefault("replica", rep.rid)
+                    doc["hops"] = hops
+                status = {200: "ok", 429: "shed"}.get(
+                    code, str(doc.get("status") or "error"))
+                if code == 200:
+                    self._probe_success(rep)
+                return self._finish(t0, rep, code, doc, status=status)
+            # retry-elsewhere: strike (drain is a clean signal, not an
+            # error), journal, back off, go around
+            last_reason, last_doc = verdict, doc
+            tried.add(rep.rid)
+            if verdict != "drained":
+                self._strike(rep, "dispatch")
+            _m_retries.labels(reason=verdict).inc()
+            obs_journal.emit("router", "route_away", replica=rep.rid,
+                             reason=verdict, hop=hops)
+            if hops > self.retry_budget:
+                code = 504 if verdict == "timeout" else 503
+                status = {"drained": "drained",
+                          "timeout": "timeout"}.get(verdict, "error")
+                return self._finish(t0, None, code, {
+                    "error": f"retry budget exhausted after {hops} "
+                             f"dispatch(es) (last: {verdict})",
+                    "status": status, "hops": hops,
+                    "last": (last_doc or {}).get("error")})
+            delay = self._retry.delay(hops)
+            self._sleep(min(delay, max(0.0, deadline - self._now())))
+
+    def _dispatch(self, rep: Replica, body: dict, remaining: float,
+                  trace) -> Tuple[str, int, dict]:
+        """One hop: returns ("done", code, doc) for a terminal answer
+        or (reason, code, doc) with reason in drained | refused |
+        timeout | error for a retry-elsewhere condition.  Chaos site
+        ``router.dispatch`` injects failures at this seam."""
+        child = obs_tracectx.start_trace("router.dispatch", parent=trace)
+        headers = ({"traceparent": child.traceparent()}
+                   if child is not None else None)
+        hop_body = dict(body, timeout_s=remaining)
+        t0_unix, t0_perf = time.time(), time.perf_counter()
+        reason, code, doc = "error", 0, {}
+        try:
+            chaos.trigger("router.dispatch")
+            code, doc = self.transport.post_json(
+                rep.url, "/serving/generate", hop_body,
+                timeout=remaining + 1.0, headers=headers)
+            if code == 503 and isinstance(doc, dict) \
+                    and doc.get("status") == "drained":
+                self._mark(rep, "draining")
+                reason = "drained"
+            elif code == 504:
+                reason = "timeout"
+            elif code in (200, 429) or 400 <= code < 500:
+                reason = "done"          # terminal: answer, shed, or
+            else:                        # a client error — passthrough
+                reason = "error"         # 5xx: failed on this replica
+            return reason, code, doc
+        except TimeoutError as e:        # before OSError: TimeoutError
+            reason = "timeout"           # IS an OSError since py3.10
+            return "timeout", 0, {"error": repr(e)[:200]}
+        except (ConnectionError, OSError) as e:
+            reason = "refused"
+            return "refused", 0, {"error": repr(e)[:200]}
+        except chaos.InjectedFault as e:
+            reason = "error"
+            return "error", 0, {"error": repr(e)[:200]}
+        finally:
+            if child is not None and trace is not None:
+                obs_tracectx.record_span(
+                    "router.dispatch", trace.trace_id, child.span_id,
+                    trace.span_id, t0_unix, t0_perf,
+                    time.perf_counter() - t0_perf, kind="client",
+                    attrs={"replica": rep.rid, "outcome": reason,
+                           "code": code})
+
+    def _finish(self, t0: float, rep: Optional[Replica], code: int,
+                doc: dict, status: Optional[str] = None
+                ) -> Tuple[int, dict]:
+        status = status or str((doc or {}).get("status") or "error")
+        _m_requests.labels(replica=rep.rid if rep else "none",
+                           status=status).inc()
+        _m_latency.observe(time.perf_counter() - t0)
+        return code, doc
+
+    # -- status ------------------------------------------------------------
+    def status_doc(self) -> dict:
+        now = self._now()
+        with self._lock:
+            reps = [r.to_dict(now) for r in self.replicas]
+        return {
+            "schema": SCHEMA, "time_unix": time.time(),
+            "running": self.running, "draining": self._draining,
+            "retry_budget": self.retry_budget,
+            "healthy": sum(1 for r in reps
+                           if r["state"] == "ready"
+                           and r["breaker"] == "closed"),
+            "replicas": reps,
+        }
+
+
+# -- process-wide singleton (mirrors serving.attach/get/reset) --------------
+_mod_lock = threading.Lock()
+_router: Optional[Router] = None
+
+
+def attach(router: Router) -> Router:
+    """Register the process-wide router ``POST /serving/generate``
+    routes through (takes precedence over a locally attached
+    batcher)."""
+    global _router
+    with _mod_lock:
+        if _router is not None and _router is not router \
+                and _router.running:
+            raise RuntimeError(
+                "a serving router is already attached; reset() first")
+        _router = router
+    return router
+
+
+def get() -> Optional[Router]:
+    return _router
+
+
+def reset():
+    """Test hook (rides serving.reset()/conftest): stop the probe
+    loop, detach, and drop per-replica metric series so one case's
+    fleet cannot leak into the next."""
+    global _router
+    with _mod_lock:
+        r, _router = _router, None
+    if r is not None:
+        r.stop()
+    _m_requests.clear()
+    _m_dispatches.clear()
+    _m_retries.clear()
+    _m_breaker.clear()
+    _m_healthy.set(0.0)
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """``python -m paddle_tpu.serving.router <port> --replica URL ...``
+    — a standalone router frontend over already-running workers."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving.router",
+        description="Armada serving router: health-aware frontend "
+                    "over N serving workers.")
+    ap.add_argument("port", type=int)
+    ap.add_argument("--replica", action="append", required=True,
+                    help="replica base URL (repeatable)")
+    args = ap.parse_args(argv)
+    from ..observability import server as obs_server
+    router = attach(Router(list(args.replica)).start())
+    router.install_signal_handlers()
+    srv = obs_server.start_http_server(port=args.port)
+    print(f"ROUTER_READY {srv.url} replicas={len(router.replicas)}",
+          flush=True)
+    try:
+        while router.running:
+            time.sleep(0.1)
+    finally:
+        reset()
+        obs_server.stop_http_server()
+    print("ROUTER_DRAINED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -90,7 +90,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "Serving cold start: process start to the ready line (model "
         "build + AOT prefill-grid/decode compile included).").set(
         ready_s)
-    print(f"SERVING_READY {srv.url} ready_s={ready_s:.2f}", flush=True)
+    # ready line carries the BOUND port and fleet identity (ISSUE 20):
+    # a router/fleet log grep reads which replica came up where, and
+    # GET /healthz reports the same truth machine-readably (the
+    # "serving" section: running/draining state + queue depth)
+    rid = serving.replica_id() or "0"
+    print(f"SERVING_READY {srv.url} replica={rid} "
+          f"port={srv.address[1]} ready_s={ready_s:.2f}", flush=True)
     try:
         while batcher.running:
             time.sleep(0.1)

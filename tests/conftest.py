@@ -114,7 +114,10 @@ def fresh_programs():
     # re-publish a dead master's fleet_workers / taskmaster_tasks series
     task_queue.reset_state()
     # serving plane: no batcher loop thread or HTTP-routed engine may
-    # survive a case (queue threads joined, routes detached)
+    # survive a case (queue threads joined, routes detached); this
+    # also detaches the Armada router when its module was imported —
+    # probe thread joined, per-replica breaker/metric series dropped
+    # (ISSUE 20)
     serving.reset()
     # persistent executable cache: tier-1 runs with it OFF — cache
     # tests point jit_cache_dir at tmp_path themselves, and the flag

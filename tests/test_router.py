@@ -1,0 +1,622 @@
+"""Armada router tests (ISSUE 20): routing, retry-elsewhere, per-
+replica circuit breakers, drain propagation, Helmsman replica-scale
+actions, and the 2-replica chaos-kill soak headline.
+
+Unit tests drive the Router through its injectable seams (transport,
+clock, sleep) — deterministic, no sockets, no real sleeps.  The soak
+lanes stand up real supervised worker processes via ServingFleet.
+
+NOTE the first test asserts the router module is NOT imported by plain
+serving use — keep router/fleet_worker imports inside test bodies so
+this file cannot break that invariant itself.
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.core import flags
+from paddle_tpu.observability import alerts as obs_alerts
+from paddle_tpu.observability import controller as ctrl_mod
+from paddle_tpu.observability import journal as obs_journal
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.serving import loadgen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cval(name, **labels):
+    m = obs_metrics.REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    return m.labels(**labels).value if labels else m.total()
+
+
+def _router_events():
+    return [e for e in obs_journal.tail(4000) if e["kind"] == "router"]
+
+
+# --- router-off invariance (MUST run first in this module) -----------------
+
+def test_router_off_is_invisible():
+    """Flag-off idiom, router edition: a process that never configures
+    a router keeps a byte-identical serving surface — the module is
+    not even imported (so no router_* metric families exist and no
+    route changes), get_router() resolves lazily to None, and
+    /serving/generate handling is the seed single-replica path."""
+    assert "paddle_tpu.serving.router" not in sys.modules
+    assert serving.get_router() is None
+    assert "router" not in serving.status_doc()
+    for fam in ("router_requests_total", "router_dispatches_total",
+                "router_retries_total", "router_breaker_state",
+                "router_healthy_replicas", "router_request_seconds"):
+        assert obs_metrics.REGISTRY.get(fam) is None, fam
+    # no batcher AND no router: the exact seed answer
+    srv = obs_server.ObservabilityServer.__new__(
+        obs_server.ObservabilityServer)
+    srv.aggregator = None
+    code, doc = obs_server.ObservabilityServer.serving_generate(
+        srv, {"prompt": [1, 2]})
+    assert (code, doc["error"]) == (503, "no serving batcher attached")
+    # and the lazy lookup itself did not import the module
+    assert "paddle_tpu.serving.router" not in sys.modules
+
+
+# --- fakes -----------------------------------------------------------------
+
+class FakeSrv:
+    """Scriptable replica endpoint for the transport seam."""
+
+    def __init__(self):
+        self.state = "running"       # healthz serving.state
+        self.queue_depth = 0
+        self.down = False            # unreachable (refused)
+        self.exc = None              # exception CLASS raised on dispatch
+        self.reply = None            # (code, doc) override for dispatch
+        self.dispatches = []         # (body, headers) per generate POST
+        self.drains = []             # bodies of /serving/drain POSTs
+
+    def ok_doc(self):
+        return {"status": "ok", "tokens": [7], "n_tokens": 1,
+                "ttft_s": 0.01, "latency_s": 0.02}
+
+
+class FakeTransport:
+    def __init__(self, servers):
+        self.servers = dict(servers)   # url -> FakeSrv
+
+    def get_json(self, url, path, timeout):
+        s = self.servers[url]
+        if s.down:
+            raise ConnectionError(f"{url} refused")
+        assert path == "/healthz"
+        code = 200 if s.state == "running" else 503
+        return code, {"status": "ok" if code == 200 else s.state,
+                      "serving": {"state": s.state,
+                                  "queue_depth": s.queue_depth,
+                                  "replica": None}}
+
+    def post_json(self, url, path, body, timeout, headers=None):
+        s = self.servers[url]
+        if path == "/serving/drain":
+            s.drains.append(dict(body))
+            s.state = "draining"
+            return 200, {"status": "draining"}
+        if s.down:
+            raise ConnectionError(f"{url} refused")
+        s.dispatches.append((dict(body), dict(headers or {})))
+        if s.exc is not None:
+            raise s.exc(f"{url} dispatch failed")
+        if s.reply is not None:
+            code, doc = s.reply
+            return code, dict(doc)
+        return 200, s.ok_doc()
+
+
+def _mk_router(n=2, clock=None, **kw):
+    from paddle_tpu.serving.router import Router
+    servers = {f"http://fake{i}": FakeSrv() for i in range(n)}
+    defaults = dict(
+        transport=FakeTransport(servers),
+        retry_budget=4, probe_interval=0.05, breaker_threshold=3,
+        breaker_reset_s=10.0, backoff_s=0.001, default_deadline_s=30.0)
+    if clock is not None:
+        defaults["now_fn"] = lambda: clock[0]
+        defaults["sleep_fn"] = lambda s: None
+    defaults.update(kw)
+    r = Router([(str(i), f"http://fake{i}") for i in range(n)],
+               **defaults)
+    return r, [servers[f"http://fake{i}"] for i in range(n)]
+
+
+# --- routing + retry-elsewhere ---------------------------------------------
+
+def test_routes_by_health_and_load_and_balances_ties():
+    router, (s0, s1) = _mk_router(2, clock=[1000.0])
+    assert router.probe_all() == 2
+    for _ in range(8):
+        code, doc = router.handle({"prompt": [1, 2]})
+        assert code == 200 and doc["status"] == "ok"
+        assert doc["hops"] == 1
+    # round-robin among load ties: both replicas served
+    assert len(s0.dispatches) == 4 and len(s1.dispatches) == 4
+    # load-aware: a deep queue on s1 steers traffic to s0
+    s1.queue_depth = 50
+    assert router.probe_all() == 2
+    s0.dispatches.clear(), s1.dispatches.clear()
+    for _ in range(4):
+        router.handle({"prompt": [1]})
+    assert len(s0.dispatches) == 4 and not s1.dispatches
+
+
+def test_drained_replica_retried_elsewhere_without_breaker_strike():
+    flags.set_flag("journal_path", "/tmp/_ptpu_router_j1.jsonl")
+    clock = [1000.0]
+    router, (s0, s1) = _mk_router(2, clock=clock)
+    router.probe_all()
+    s0.reply = (503, {"status": "drained", "error": "draining"})
+    oks = 0
+    for _ in range(6):
+        code, doc = router.handle({"prompt": [1, 2]})
+        assert code == 200, doc      # failover is invisible to clients
+        oks += 1
+        assert doc["replica"] == "1"
+    assert oks == 6
+    # the drained answer marked the replica draining (no more picks)
+    assert router.status_doc()["replicas"][0]["state"] == "draining"
+    # clean signal, not an error: breaker stays closed
+    assert router.status_doc()["replicas"][0]["breaker"] == "closed"
+    ev = _router_events()
+    assert any(e["event"] == "route_away"
+               and e.get("reason") == "drained" for e in ev)
+    assert _cval("router_retries_total", reason="drained") >= 1
+
+
+def test_connection_refused_retries_elsewhere_and_trips_breaker():
+    clock = [1000.0]
+    router, (s0, s1) = _mk_router(2, clock=clock)
+    router.probe_all()
+    s0.down = True
+    for _ in range(8):
+        code, doc = router.handle({"prompt": [3]})
+        assert code == 200 and doc["replica"] == "1"
+    rep0 = router.status_doc()["replicas"][0]
+    assert rep0["breaker"] == "open"         # tripped after 3 strikes
+    # open breaker = never picked: no more dispatch attempts at s0
+    n = _cval("router_dispatches_total", replica="0")
+    for _ in range(4):
+        router.handle({"prompt": [3]})
+    assert _cval("router_dispatches_total", replica="0") == n
+
+
+def test_deadline_exceeded_is_explicit_504():
+    clock = [1000.0]
+    router, (s0, s1) = _mk_router(2, clock=clock)
+    router.probe_all()
+
+    class _Late(FakeTransport):
+        def post_json(self, url, path, body, timeout, headers=None):
+            clock[0] += 10.0                 # each hop eats the budget
+            raise TimeoutError(f"{url} deadline")
+
+    router.transport = _Late(router.transport.servers)
+    code, doc = router.handle({"prompt": [1], "timeout_s": 15.0})
+    assert code == 504 and doc["status"] == "timeout"
+    # remaining budget rode to the replica: hop 1 carried <= 15s
+    # (checked via the recorded fake clock: 2 hops max inside 15s)
+    assert _cval("router_retries_total", reason="timeout") >= 1
+
+
+def test_no_healthy_replica_is_explicit_503():
+    router, (s0, s1) = _mk_router(2, clock=[1000.0])
+    s0.state = s1.state = "draining"
+    router.probe_all()
+    code, doc = router.handle({"prompt": [1]})
+    assert code == 503
+    assert doc["status"] in ("drained", "error")
+    assert _cval("router_requests_total", replica="none",
+                 status=doc["status"]) >= 1
+
+
+# --- circuit-breaker state-machine matrix ----------------------------------
+
+def test_breaker_matrix_trip_open_halfopen_recover():
+    """trip -> open -> half-open trial -> recover, single replica so
+    the dispatch path (not the probe) drives every transition; fake
+    clock + fake sleep = deterministic, no real waiting."""
+    flags.set_flag("journal_path", "/tmp/_ptpu_router_j2.jsonl")
+    clock = [1000.0]
+    router, (s0,) = _mk_router(1, clock=clock, breaker_threshold=3,
+                               breaker_reset_s=10.0, retry_budget=2)
+    router.probe_all()
+    s0.exc = ConnectionError
+
+    def breaker():
+        return router.status_doc()["replicas"][0]["breaker"]
+
+    # closed -> 3 consecutive errors -> open (one handle: budget 2 =
+    # 3 dispatches, all striking the only replica)
+    code, doc = router.handle({"prompt": [1]})
+    assert code == 503 and breaker() == "open"
+    assert any(e["event"] == "breaker_open" for e in _router_events())
+    # while open and inside the window: never dispatched, fast 503
+    n = len(s0.dispatches)
+    assert router.handle({"prompt": [1]})[0] == 503
+    assert len(s0.dispatches) == n
+    # past the window: half-open admits ONE trial; it fails -> re-open
+    clock[0] += 10.5
+    assert breaker() == "half_open"
+    code, _ = router.handle({"prompt": [1]})
+    assert code == 503
+    assert breaker() == "open"               # re-armed, window reset
+    assert len(s0.dispatches) == n + 1       # exactly one trial hop
+    # past the NEXT window with a healed replica: trial succeeds,
+    # breaker closes, traffic resumes
+    clock[0] += 10.5
+    s0.exc = None
+    code, doc = router.handle({"prompt": [1]})
+    assert code == 200 and breaker() == "closed"
+    assert any(e["event"] == "breaker_close" for e in _router_events())
+    assert _cval("router_breaker_state", replica="0") == 0.0
+
+
+def test_probe_recovers_breaker_and_journals_resume():
+    """The other recovery path: an open breaker on a revived replica
+    is closed by the health probe (no client request risked), and the
+    dead->ready transition journals as resume."""
+    flags.set_flag("journal_path", "/tmp/_ptpu_router_j3.jsonl")
+    clock = [1000.0]
+    router, (s0, s1) = _mk_router(2, clock=clock, breaker_threshold=2,
+                                  breaker_reset_s=5.0)
+    router.probe_all()
+    s0.down = True
+    for _ in range(4):                       # strikes via dispatch
+        router.handle({"prompt": [1]})
+    assert router.status_doc()["replicas"][0]["breaker"] == "open"
+    router.probe_all()                       # probe sees it dead too
+    assert router.status_doc()["replicas"][0]["state"] == "dead"
+    # replica comes back; window passes; the probe closes the breaker
+    s0.down = False
+    clock[0] += 6.0
+    router.probe_all()
+    rep0 = router.status_doc()["replicas"][0]
+    assert rep0["state"] == "ready" and rep0["breaker"] == "closed"
+    ev = _router_events()
+    assert any(e["event"] == "resume" and e.get("replica") == "0"
+               for e in ev)
+    # and it takes traffic again
+    s0.dispatches.clear()
+    for _ in range(4):
+        assert router.handle({"prompt": [1]})[0] == 200
+    assert s0.dispatches
+
+
+# --- drain semantics -------------------------------------------------------
+
+def test_drain_replica_propagates_before_rpc_no_route_after_event():
+    """Satellite: after drain_replica returns (and the drain journal
+    event exists), NO dispatch ever starts against the draining
+    replica — the mark is synchronous under the router lock."""
+    flags.set_flag("journal_path", "/tmp/_ptpu_router_j4.jsonl")
+    router, (s0, s1, s2) = _mk_router(3, clock=[1000.0])
+    router.probe_all()
+    rid = router.drain_replica("1", stop=False)
+    assert rid == "1"
+    assert s1.drains and s1.drains[0]["stop"] is False
+    ev = _router_events()
+    assert any(e["event"] == "drain" and e.get("replica") == "1"
+               for e in ev)
+    n1 = len(s1.dispatches)
+    for _ in range(12):
+        code, doc = router.handle({"prompt": [1]})
+        assert code == 200 and doc["replica"] in ("0", "2")
+    assert len(s1.dispatches) == n1          # zero post-drain routes
+    # unnamed drain picks the least-loaded READY replica
+    s0.queue_depth = 9
+    router.probe_all()
+    assert router.drain_replica() == "2"
+
+
+def test_sigterm_drains_all_replicas_then_exits():
+    """Router-wide SIGTERM semantics: the (async-signal-safe) drain
+    flag is honored by the probe loop — every replica gets a stopping
+    drain, in-flight finishes, running goes False, new requests get an
+    explicit drained 503."""
+    flags.set_flag("journal_path", "/tmp/_ptpu_router_j5.jsonl")
+    router, srvs = _mk_router(3, probe_interval=0.02)
+    router.probe_all()
+    router.start()
+    try:
+        router.request_drain()               # what the handler does
+        deadline = time.time() + 5
+        while router.running and time.time() < deadline:
+            time.sleep(0.02)
+        assert not router.running
+        for s in srvs:
+            assert s.drains and s.drains[0]["stop"] is True
+        ev = _router_events()
+        assert any(e["event"] == "drain_begin" for e in ev)
+        assert any(e["event"] == "drain_complete" for e in ev)
+        code, doc = router.handle({"prompt": [1]})
+        assert (code, doc["status"]) == (503, "drained")
+    finally:
+        router.stop()
+
+
+# --- worker healthz satellite ----------------------------------------------
+
+class _StubBatcher:
+    def __init__(self):
+        self.running, self.draining, self.queue_depth = True, False, 3
+
+    def stop(self, timeout=10.0):
+        pass
+
+
+def test_healthz_reports_batcher_state(monkeypatch):
+    """Satellite: GET /healthz on a serving worker is the one truth the
+    router probes — running/draining state, queue depth, replica id;
+    draining answers 503 (readiness semantics)."""
+    monkeypatch.setenv("PTPU_REPLICA_ID", "4")
+    stub = _StubBatcher()
+    serving.attach(stub)
+    srv = obs_server.start_http_server(port=0)
+    doc = json.loads(urllib.request.urlopen(
+        srv.url + "/healthz", timeout=5).read())
+    assert doc["serving"] == {"state": "running", "queue_depth": 3,
+                              "replica": "4"}
+    assert doc["status"] == "ok"
+    stub.draining = True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+    assert ei.value.code == 503
+    doc = json.loads(ei.value.read())
+    assert doc["serving"]["state"] == "draining"
+    assert doc["status"] == "draining"
+
+
+# --- loadgen satellites ----------------------------------------------------
+
+def test_loadgen_round_robin_per_target_and_retried_counts():
+    calls = {"b": 0}
+
+    def sub_a(p, m, t):
+        return {"status": "ok", "n_tokens": 2}
+
+    def sub_b(p, m, t):
+        calls["b"] += 1
+        if calls["b"] == 1:
+            raise serving.ShedError("busy", 9)
+        return {"status": "ok", "n_tokens": 2}
+
+    submit = loadgen.round_robin_submit([("a", sub_a), ("b", sub_b)])
+    rep = loadgen.run_loadgen(submit, streams=1, requests_per_stream=4,
+                              max_new_tokens=2, max_attempts=5,
+                              retry_sleep_s=0.0, p99_budget_ms=0.0)
+    assert rep["accounted"]
+    assert rep["counts"]["ok"] == 4
+    assert rep["counts"]["shed"] == 1
+    assert rep["counts"]["retried_ok"] == 1   # the shed one succeeded
+    assert rep["per_target"]["a"]["ok"] >= 2  # on a retry elsewhere
+    assert rep["per_target"]["b"]["shed"] == 1
+    assert rep["per_target"]["a"]["ok"] \
+        + rep["per_target"]["b"]["ok"] == 4
+
+
+def test_loadgen_multi_url_builds_per_target_ledger():
+    # the repeated --url CLI path builds this: one ledger row per
+    # endpoint, round-robin — no sockets needed to assert the wiring
+    submit = loadgen.http_submit_multi(
+        ["http://h1:1", "http://h2:2/"])
+    assert set(submit.per_target) == {"http://h1:1", "http://h2:2/"}
+    assert all(v == {"ok": 0, "shed": 0, "error": 0}
+               for v in submit.per_target.values())
+    with pytest.raises(SystemExit):          # --url is still required
+        loadgen._main([])
+
+
+# --- Helmsman replica-scale actions ----------------------------------------
+
+def test_parse_action_accepts_replica_kinds_rejects_resize_fields():
+    act = obs_alerts.parse_action({"kind": "spawn_replica"},
+                                  "r", "threshold")
+    assert act == {"kind": "spawn_replica"}
+    act = obs_alerts.parse_action(
+        {"kind": "drain_replica", "cooldown": 5}, "r", "threshold")
+    assert act["cooldown"] == 5.0
+    with pytest.raises(obs_alerts.RuleError, match="direction"):
+        obs_alerts.parse_action(
+            {"kind": "spawn_replica", "direction": "grow"},
+            "r", "threshold")
+
+
+class _StubRouter:
+    def __init__(self):
+        self.drained = []
+
+    def drain_replica(self, rid=None, stop=False):
+        self.drained.append(rid)
+        return "9"
+
+
+def _replica_rule(kind, value=1.0):
+    return obs_alerts.Rule(
+        name=f"r_{kind}", metric="m", predicate="threshold", op=">",
+        value=value, severity="critical", source="builtin",
+        action=obs_alerts.parse_action({"kind": kind}, "t",
+                                       "threshold"))
+
+
+def test_controller_spawn_and_drain_replica_policy(tmp_path):
+    """Acceptance: spawn_replica/drain_replica actuate through the
+    SAME fenced single-flight policy layer — cooldown per class,
+    grow/shrink hysteresis across the pair, decisions journaled as
+    controller.decision."""
+    flags.set_flag("journal_path", str(tmp_path / "j.jsonl"))
+    flags.set_flag("controller", True)
+    flags.set_flag("alert_rules_path", "builtin")
+    stub = _StubRouter()
+    spawned = []
+    ctrl = ctrl_mod.wire_router(stub,
+                                spawn_replica=lambda: spawned.append(1))
+    assert ctrl is not None
+    assert set(ctrl.actuators) >= {"spawn_replica", "drain_replica"}
+    ent = {"rule": _replica_rule("spawn_replica"), "value": 10.0,
+           "labels": {}, "context": {}}
+    decs = ctrl.consider([ent], now=100.0)
+    assert [d["outcome"] for d in decs] == ["applied"]
+    assert decs[0]["action"] == "spawn_replica"
+    assert decs[0]["direction"] == "grow"
+    assert spawned == [1]
+    # cooldown: a second spawn inside the class cooldown is skipped
+    assert ctrl.consider([ent], now=101.0) == []
+    # hysteresis: a drain (shrink) chasing the spawn (grow) inside the
+    # reversal window is suppressed — no flap
+    ent2 = {"rule": _replica_rule("drain_replica"), "value": 10.0,
+            "labels": {}, "context": {}}
+    assert ctrl.consider([ent2], now=102.0) == []
+    assert stub.drained == []
+    # past the window: the drain applies through the router actuator
+    decs = ctrl.consider([ent2], now=200.0)
+    assert [d["outcome"] for d in decs] == ["applied"]
+    assert stub.drained == [None]
+    # every decision journaled as controller.decision
+    ev = [e for e in obs_journal.tail(500)
+          if e["kind"] == "controller" and e["event"] == "decision"]
+    assert {e["action"] for e in ev} >= {"spawn_replica",
+                                         "drain_replica"}
+
+
+# --- soak lanes ------------------------------------------------------------
+
+def _seed_where_exit_fires(prob, lo, hi, site="serving.decode_step"):
+    for seed in range(10_000):
+        fires = [n for n in range(hi)
+                 if zlib.crc32(f"{seed}:{site}:{n}".encode())
+                 / 0xFFFFFFFF < prob]
+        if fires and lo <= fires[0] < hi:
+            return seed
+    raise RuntimeError("no seed found")
+
+
+def _mini_fleet(n, tmp_path, replica_envs=None, seed=7):
+    from paddle_tpu.serving import fleet_worker
+    return fleet_worker.ServingFleet(
+        n, seed=seed,
+        env=fleet_worker.default_worker_env({
+            "PTPU_SERVING_WORKER_BUCKETS": "8",
+            "PTPU_SERVING_WORKER_BATCH": "2",
+            "PTPU_SERVING_WORKER_MAXLEN": "32"}),
+        replica_envs=replica_envs, cwd=REPO, log_dir=str(tmp_path),
+        router_kwargs=dict(probe_interval=0.25, breaker_threshold=2,
+                           breaker_reset_s=0.5, retry_budget=4,
+                           backoff_s=0.05, default_deadline_s=30.0))
+
+
+@pytest.mark.chaos
+def test_router_soak_2replica_chaos_kill_zero_lost(tmp_path):
+    """Tier-1 headline (miniature lane): a 2-replica fleet under a
+    closed-loop storm, replica 1 chaos-killed mid-decode.  Every
+    request terminates exactly once (ledger clean, zero lost), retried
+    requests succeed on the survivor, the supervisor revives the
+    victim chaos-stripped on the same port, and the router journals
+    route-away + resume and routes to it again."""
+    flags.set_flag("journal_path", str(tmp_path / "journal.jsonl"))
+    kseed = _seed_where_exit_fires(0.2, 5, 18)
+    fleet = _mini_fleet(2, tmp_path, replica_envs={
+        1: {"PTPU_CHAOS_SPEC": "serving.decode_step=exit:0.2:9",
+            "PTPU_CHAOS_SEED": str(kseed)}})
+    fleet.start()
+    try:
+        fleet.wait_ready(timeout=180)
+        rep = loadgen.run_loadgen(
+            loadgen.router_submit(fleet.router, timeout=30),
+            streams=4, requests_per_stream=5, max_new_tokens=5,
+            prompt_len_range=(3, 7), vocab_size=97,
+            p99_budget_ms=0.0, max_attempts=400, retry_sleep_s=0.15)
+        # zero lost: every issued attempt accounted, every request ok
+        assert rep["accounted"], rep
+        assert rep["counts"]["gave_up"] == 0, rep
+        assert rep["counts"]["ok"] == 4 * 5, rep
+        # the kill happened and the supervisor revived the victim
+        assert fleet.supervisor.restarts[1] >= 1, \
+            fleet.supervisor.status()
+        # the router saw it and routed away (journal + retry counter)
+        ev = _router_events()
+        assert any(e["event"] == "route_away" for e in ev), ev[-20:]
+        assert _cval("router_retries_total") >= 1
+        # ...and resumes routing to the revived replica
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if fleet.router.probe_all() >= 2:
+                break
+            time.sleep(0.3)
+        doc = fleet.router.status_doc()
+        assert doc["healthy"] == 2, doc
+        assert any(e["event"] == "resume" and e.get("replica") == "1"
+                   for e in _router_events())
+        s1_before = _cval("router_dispatches_total", replica="1")
+        for _ in range(4):
+            code, d = fleet.router.handle(
+                {"prompt": [5, 6, 7], "max_new_tokens": 3,
+                 "timeout_s": 30})
+            assert code == 200, d
+        assert _cval("router_dispatches_total",
+                     replica="1") > s1_before
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_router_storm_3replica_drain_mid_storm(tmp_path):
+    """Slow lane: 3 replicas, one DRAINED (not killed) mid-storm —
+    zero lost, p99 within budget, and no dispatch ever starts against
+    the draining replica after drain_replica returns."""
+    flags.set_flag("journal_path", str(tmp_path / "journal.jsonl"))
+    fleet = _mini_fleet(3, tmp_path)
+    fleet.start()
+    try:
+        fleet.wait_ready(timeout=240)
+        report = {}
+        submit = loadgen.router_submit(fleet.router, timeout=30)
+
+        def _storm():
+            report.update(loadgen.run_loadgen(
+                submit, streams=6, requests_per_stream=6,
+                max_new_tokens=6, prompt_len_range=(3, 7),
+                vocab_size=97, p99_budget_ms=2000.0,
+                max_attempts=200, retry_sleep_s=0.1))
+
+        th = threading.Thread(target=_storm)
+        th.start()
+        time.sleep(1.0)                      # storm underway
+        assert fleet.router.drain_replica("1", stop=False) == "1"
+        disp_at_drain = _cval("router_dispatches_total", replica="1")
+        th.join(timeout=240)
+        assert not th.is_alive()
+        assert report["accounted"], report
+        assert report["counts"]["gave_up"] == 0, report
+        assert report["counts"]["ok"] == 6 * 6, report
+        assert report["budget_ok"], report
+        # drain propagation at fleet scale: the counter froze the
+        # moment drain_replica returned
+        assert _cval("router_dispatches_total",
+                     replica="1") == disp_at_drain
+        ev = _router_events()
+        assert any(e["event"] == "drain" and e.get("replica") == "1"
+                   for e in ev)
+        doc = fleet.router.status_doc()
+        states = {r["replica"]: r["state"] for r in doc["replicas"]}
+        assert states["1"] == "draining"
+        assert states["0"] == states["2"] == "ready"
+    finally:
+        fleet.stop()

@@ -350,6 +350,44 @@ def test_sigterm_begins_drain_and_chains_handler(lm, batcher):
         signal.signal(signal.SIGTERM, old)
 
 
+def test_submit_after_sigterm_flag_is_drained_synchronously(lm, batcher):
+    """ISSUE 20 bugfix regression: a submit racing begin_drain — after
+    the SIGTERM handler set ``_drain_requested`` but BEFORE the decode
+    loop honors it at the next step boundary — must answer `drained`
+    SYNCHRONOUSLY, not queue-then-shed.  A failing-over router (or
+    client) must never wait on a dying replica's queue."""
+    # simulate exactly what the signal handler does, mid-race
+    batcher._drain_requested = True
+    depth_before = batcher.queue_depth
+    t0 = time.perf_counter()
+    with pytest.raises(serving.ShedError) as ei:
+        batcher.submit([1, 2, 3], max_new_tokens=4)
+    assert ei.value.draining          # 503-drained, not 429-shed
+    assert time.perf_counter() - t0 < 1.0      # synchronous, no wait
+    assert batcher.queue_depth == depth_before  # never entered queue
+    # the real race: many submits against a begin_drain in flight —
+    # every one terminates exactly once as ok|drained|shed, none hang
+    batcher._drain_requested = False
+    results = []
+
+    def _spam():
+        for _ in range(8):
+            try:
+                r = batcher.submit([4, 5, 6], max_new_tokens=3)
+                results.append(r.result(timeout=30)["status"])
+            except serving.ShedError as e:
+                results.append("drained" if e.draining else "shed")
+            except RuntimeError:
+                results.append("drained")      # stopped mid-race
+    th = threading.Thread(target=_spam)
+    th.start()
+    batcher.begin_drain(stop=True)
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert len(results) == 8
+    assert set(results) <= {"ok", "drained", "shed"}
+
+
 @pytest.mark.chaos
 def test_decode_chaos_fails_requests_explicitly_and_recovers(lm, batcher):
     """A chaos fault mid-decode fails the in-flight requests with an
